@@ -1,0 +1,13 @@
+"""pyconsensus_tpu — a TPU-native rebuild of the Truthcoin/Sztorc oracle
+consensus library (reference: IanMadlenya/pyconsensus; blueprint: SURVEY.md).
+
+Public surface:
+
+- :class:`Oracle` — the reference-compatible consensus engine with
+  ``backend="numpy"|"jax"`` and the full ``algorithm=`` dispatch.
+"""
+
+from .oracle import ALGORITHMS, BACKENDS, Oracle
+
+__version__ = "0.1.0"
+__all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "__version__"]

@@ -1,0 +1,8 @@
+"""Algorithm variants (the reference's ``algorithm=`` dispatch, SURVEY.md §2
+#10) and the end-to-end pipeline drivers for both backends."""
+
+from .pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS, ConsensusParams,
+                       consensus_jax, consensus_np)
+
+__all__ = ["ConsensusParams", "consensus_np", "consensus_jax",
+           "JIT_ALGORITHMS", "HYBRID_ALGORITHMS"]

@@ -1,0 +1,170 @@
+"""Clustering consensus variants: k-means / hierarchical / DBSCAN over
+reporter rows (SURVEY.md §2 #10, BASELINE.json config 4).
+
+Scoring rule (shared by all three): cluster the reporter rows of the filled
+reports matrix; a reporter's raw score ("conformity") is the total reputation
+mass of its own cluster — reporters in the dominant cluster carry the most
+weight, outliers/liars the least. The conformity vector then feeds the same
+``row_reward_weighted -> smooth`` machinery as the PCA scores.
+
+Backend split (SURVEY.md §7 M3):
+
+- **k-means** is TPU-native in both backends: fixed-iteration Lloyd with
+  deterministic centroid seeding (evenly-spaced reporter rows) and
+  reputation-weighted centroid updates — a ``lax.fori_loop`` under jit on the
+  JAX side, the identical arithmetic as a Python loop on the numpy side.
+- **hierarchical** and **DBSCAN** are irregular, data-dependent algorithms
+  that resist static-shape compilation; they run on host (scipy / sklearn)
+  against a *device-computed* distance matrix in the jax backend — the hybrid
+  split called out in SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "kmeans_conformity_np", "kmeans_conformity_jax",
+    "hierarchical_conformity", "dbscan_conformity",
+    "pairwise_sq_dists_jax",
+]
+
+KMEANS_ITERS = 32
+
+
+def _seed_indices(n_rows: int, k: int) -> np.ndarray:
+    """Deterministic seeding: k evenly spaced reporter rows."""
+    return np.floor(np.linspace(0, n_rows - 1, k)).astype(np.int64)
+
+
+def _cluster_mass(labels: np.ndarray, reputation: np.ndarray) -> np.ndarray:
+    """conformity[i] = total reputation of reporter i's cluster."""
+    mass = {}
+    for lbl, rep in zip(labels, reputation):
+        mass[lbl] = mass.get(lbl, 0.0) + float(rep)
+    return np.array([mass[lbl] for lbl in labels], dtype=np.float64)
+
+
+def kmeans_conformity_np(reports_filled, reputation, num_clusters,
+                         n_iters: int = KMEANS_ITERS):
+    """Fixed-iteration Lloyd k-means (numpy); reputation-weighted centroid
+    updates; empty clusters keep their previous centroid."""
+    X = np.asarray(reports_filled, dtype=np.float64)
+    rep = np.asarray(reputation, dtype=np.float64)
+    R = X.shape[0]
+    k = int(min(num_clusters, R))
+    centroids = X[_seed_indices(R, k)].copy()
+    for _ in range(n_iters):
+        d2 = ((X[:, None, :] - centroids[None, :, :]) ** 2).sum(axis=2)
+        labels = np.argmin(d2, axis=1)
+        for c in range(k):
+            sel = labels == c
+            w = rep[sel]
+            if w.sum() > 0:
+                centroids[c] = (X[sel] * w[:, None]).sum(axis=0) / w.sum()
+            elif sel.any():
+                centroids[c] = X[sel].mean(axis=0)
+    # final assignment against the final centroids — keeps labels consistent
+    # with the centroids and bit-identical to the jax backend's post-loop
+    # assignment even when Lloyd has not converged within n_iters
+    d2 = ((X[:, None, :] - centroids[None, :, :]) ** 2).sum(axis=2)
+    labels = np.argmin(d2, axis=1)
+    return _cluster_mass(labels, rep)
+
+
+def kmeans_conformity_jax(reports_filled, reputation, num_clusters,
+                          n_iters: int = KMEANS_ITERS):
+    """JAX mirror of :func:`kmeans_conformity_np` under ``lax.fori_loop``.
+    Identical seeding, assignment tie-breaks (first argmin), and weighted
+    updates, so labels match the numpy backend exactly."""
+    X = reports_filled
+    rep = reputation
+    R = X.shape[0]
+    k = int(min(num_clusters, R))
+    seeds = jnp.asarray(_seed_indices(R, k))
+    init_centroids = X[seeds]
+
+    def assign(centroids):
+        d2 = jnp.sum((X[:, None, :] - centroids[None, :, :]) ** 2, axis=2)
+        return jnp.argmin(d2, axis=1)
+
+    def body(_, centroids):
+        labels = assign(centroids)
+        onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(X.dtype)
+        w = onehot * rep[:, None]                      # (R, k)
+        wsum = jnp.sum(w, axis=0)                      # (k,)
+        weighted = w.T @ X                             # (k, E)
+        counts = jnp.sum(onehot, axis=0)
+        plain = onehot.T @ X / jnp.clip(counts, 1.0, None)[:, None]
+        upd = jnp.where(wsum[:, None] > 0.0,
+                        weighted / jnp.where(wsum > 0.0, wsum, 1.0)[:, None],
+                        jnp.where(counts[:, None] > 0.0, plain, centroids))
+        return upd
+
+    centroids = lax.fori_loop(0, n_iters, body, init_centroids)
+    labels = assign(centroids)
+    onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(X.dtype)
+    mass = jnp.sum(onehot * rep[:, None], axis=0)      # (k,)
+    return mass[labels]
+
+
+def _pairwise_sq_dists_np(X: np.ndarray) -> np.ndarray:
+    """Host fallback for :func:`pairwise_sq_dists_jax` (same clamping)."""
+    sq = (X ** 2).sum(axis=1)
+    return np.clip(sq[:, None] + sq[None, :] - 2.0 * X @ X.T, 0.0, None)
+
+
+def pairwise_sq_dists_jax(reports_filled):
+    """Device-side pairwise squared distances between reporter rows — the
+    O(R^2 E) part of hierarchical/DBSCAN, kept on TPU; only the R×R result
+    crosses to host."""
+    sq = jnp.sum(reports_filled ** 2, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (reports_filled @ reports_filled.T)
+    return jnp.clip(d2, 0.0, None)
+
+
+def hierarchical_conformity(reports_filled, reputation, threshold,
+                            sq_dists=None):
+    """Average-linkage agglomerative clustering cut at distance ``threshold``
+    (host side; scipy). ``sq_dists`` may be supplied from
+    :func:`pairwise_sq_dists_jax` to reuse the device computation."""
+    from scipy.cluster.hierarchy import fcluster, linkage
+    from scipy.spatial.distance import squareform
+
+    X = np.asarray(reports_filled, dtype=np.float64)
+    rep = np.asarray(reputation, dtype=np.float64)
+    if X.shape[0] == 1:
+        return rep.copy()
+    if sq_dists is None:
+        sq_dists = _pairwise_sq_dists_np(X)
+    d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
+    np.fill_diagonal(d, 0.0)
+    Z = linkage(squareform(d, checks=False), method="average")
+    labels = fcluster(Z, t=threshold, criterion="distance")
+    return _cluster_mass(labels, rep)
+
+
+def dbscan_conformity(reports_filled, reputation, eps, min_samples,
+                      sq_dists=None):
+    """DBSCAN over reporter rows (host side; sklearn, precomputed device
+    distances). Noise points (label -1) count as singleton clusters — their
+    conformity is just their own reputation."""
+    from sklearn.cluster import DBSCAN
+
+    X = np.asarray(reports_filled, dtype=np.float64)
+    rep = np.asarray(reputation, dtype=np.float64)
+    if sq_dists is None:
+        sq_dists = _pairwise_sq_dists_np(X)
+    d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
+    labels = DBSCAN(eps=eps, min_samples=min_samples, metric="precomputed").fit(d).labels_
+    # noise -> unique singleton labels
+    labels = labels.astype(np.int64)
+    next_label = labels.max() + 1 if labels.size else 0
+    out = labels.copy()
+    for i, lbl in enumerate(labels):
+        if lbl == -1:
+            out[i] = next_label
+            next_label += 1
+    return _cluster_mass(out, rep)

@@ -1,0 +1,96 @@
+"""Native FastICA scoring — the ``"ica"`` algorithm variant (SURVEY.md §2 #10).
+
+The reference guarded ICA behind an optional sklearn import; here it is
+implemented *natively and identically* in numpy and JAX so the variant is
+jit-compatible, TPU-resident, and backend-consistent — no host round-trip and
+no sklearn dependency.
+
+Design: **one-unit FastICA** (tanh contrast, deterministic start, fixed trip
+count) on the reputation-weighted-PCA-whitened top-``k`` subspace. A
+single-unit iteration is used rather than symmetric multi-component FastICA
+deliberately: the consensus mechanism only needs the *single most
+non-Gaussian direction of disagreement* (the analogue of the first principal
+component), and one-unit iterations converge to a stable fixed point — the
+symmetric variant keeps rotating inside the near-degenerate noise bulk of a
+reports matrix, which makes it numerically irreproducible across backends.
+
+The extracted component's scores feed the same direction-fix /
+``row_reward_weighted`` machinery as PCA scores.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
+
+__all__ = ["ica_scores_np", "ica_scores_jax", "ICA_ITERS"]
+
+ICA_ITERS = 128
+_EPS = 1e-12
+
+
+def _canon_signs_np(Z):
+    """Flip each column so its largest-|value| entry is positive. numpy and
+    XLA eigh return eigenvectors with arbitrary per-column signs; canonical
+    signs give both backends the same whitened basis and start point. Same
+    first-argmax tie-break as the jax mirror."""
+    idx = np.argmax(np.abs(Z), axis=0)
+    signs = np.sign(Z[idx, np.arange(Z.shape[1])])
+    signs = np.where(signs == 0.0, 1.0, signs)
+    return Z * signs[None, :]
+
+
+def ica_scores_np(reports_filled, reputation, max_components):
+    k = int(min(max_components, min(reports_filled.shape) - 1))
+    k = max(k, 1)
+    _, scores, _ = nk.weighted_prin_comps(reports_filled, reputation, k)
+    std = np.sqrt(np.clip(np.var(scores, axis=0), _EPS, None))
+    Z = _canon_signs_np(scores / std[None, :])         # (R, k) whitened
+    R = Z.shape[0]
+    w = np.zeros(k)
+    w[0] = 1.0                                         # start at first PC
+    for _ in range(ICA_ITERS):
+        s = Z @ w                                      # (R,)
+        g = np.tanh(s)
+        g_prime = 1.0 - g ** 2
+        w_new = (Z.T @ g) / R - g_prime.mean() * w
+        norm = np.linalg.norm(w_new)
+        if norm > _EPS:
+            w = w_new / norm
+    s = Z @ w
+    return nk.direction_fixed_scores(s, reports_filled, reputation)
+
+
+def _canon_signs_jax(Z):
+    """JAX mirror of ``_canon_signs_np`` (identical tie-break)."""
+    idx = jnp.argmax(jnp.abs(Z), axis=0)
+    signs = jnp.sign(Z[idx, jnp.arange(Z.shape[1])])
+    signs = jnp.where(signs == 0.0, 1.0, signs)
+    return Z * signs[None, :]
+
+
+def ica_scores_jax(reports_filled, reputation, max_components, pca_method="auto"):
+    k = int(min(max_components, min(reports_filled.shape) - 1))
+    k = max(k, 1)
+    _, scores, _ = jk.weighted_prin_comps(reports_filled, reputation, k,
+                                          method=pca_method)
+    std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
+    Z = _canon_signs_jax(scores / std[None, :])
+    R = Z.shape[0]
+    w0 = jnp.zeros((k,), dtype=Z.dtype).at[0].set(1.0)
+
+    def body(_, w):
+        s = Z @ w
+        g = jnp.tanh(s)
+        g_prime = 1.0 - g ** 2
+        w_new = (Z.T @ g) / R - jnp.mean(g_prime) * w
+        norm = jnp.linalg.norm(w_new)
+        return jnp.where(norm > _EPS, w_new / jnp.where(norm > _EPS, norm, 1.0), w)
+
+    w = lax.fori_loop(0, ICA_ITERS, body, w0)
+    s = Z @ w
+    return jk.direction_fixed_scores(s, reports_filled, reputation)

@@ -1,0 +1,291 @@
+"""End-to-end consensus pipeline drivers for both backends.
+
+This is the single place where the full resolution data flow
+(SURVEY.md §1 "Data flow" / §3.1 call stack) is composed:
+
+    raw reports -> rescale -> interpolate -> [algorithm scores ->
+    row_reward_weighted -> smooth] x iterations -> outcome resolution ->
+    catch snap -> un-rescale -> certainty/participation/bonuses
+
+Three drivers:
+
+- :func:`consensus_np` — the numpy reference path (correctness anchor).
+- ``_consensus_core`` under ``jax.jit`` — the TPU path for every
+  jit-compatible algorithm (sztorc, fixed-variance, ica, k-means). The
+  iterative Sztorc reputation loop is a ``lax.scan`` with a fixed trip count
+  and a freeze-once-converged mask (SURVEY.md §7 M2): JAX needs static
+  shapes, so "early exit" means updates stop being applied, not that the
+  loop ends.
+- :func:`consensus_jax` — dispatcher; hierarchical/DBSCAN take the hybrid
+  route (device kernels + host clustering, SURVEY.md §7 M3).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
+from . import clustering as cl
+from .ica import ica_scores_jax, ica_scores_np
+from .sztorc import (fixed_variance_scores_jax, fixed_variance_scores_np,
+                     sztorc_scores_jax, sztorc_scores_np)
+
+__all__ = ["ConsensusParams", "consensus_np", "consensus_jax", "JIT_ALGORITHMS"]
+
+#: algorithms whose full pipeline compiles to one XLA graph
+JIT_ALGORITHMS = ("sztorc", "fixed-variance", "ica", "k-means")
+#: algorithms that need a host-side clustering step (hybrid path)
+HYBRID_ALGORITHMS = ("hierarchical", "dbscan")
+
+
+class ConsensusParams(NamedTuple):
+    """Static (hashable) consensus configuration — the Oracle's tuning knobs
+    (SURVEY.md §2 #1). Used as a jit static argument, so every distinct
+    parameter set compiles once and is cached thereafter."""
+    algorithm: str = "sztorc"
+    alpha: float = 0.1
+    catch_tolerance: float = 0.1
+    variance_threshold: float = 0.9
+    max_components: int = 5
+    max_iterations: int = 1
+    convergence_tolerance: float = 1e-6
+    num_clusters: int = 2
+    hierarchy_threshold: float = 0.5
+    dbscan_eps: float = 0.5
+    dbscan_min_samples: int = 2
+    pca_method: str = "auto"
+    power_iters: int = 128
+
+
+def _scores_np(filled, rep, p: ConsensusParams):
+    """Returns ``(adj_scores, loading-or-None)``; PCA paths surface their
+    first loading so the pipeline never re-decomposes just for reporting."""
+    algo = p.algorithm
+    if algo == "sztorc":
+        return sztorc_scores_np(filled, rep)
+    if algo == "fixed-variance":
+        return fixed_variance_scores_np(filled, rep, p.variance_threshold,
+                                        p.max_components)
+    if algo == "ica":
+        return ica_scores_np(filled, rep, p.max_components), None
+    if algo == "k-means":
+        return cl.kmeans_conformity_np(filled, rep, p.num_clusters), None
+    if algo == "hierarchical":
+        return cl.hierarchical_conformity(filled, rep,
+                                          p.hierarchy_threshold), None
+    if algo == "dbscan":
+        return cl.dbscan_conformity(filled, rep, p.dbscan_eps,
+                                    p.dbscan_min_samples), None
+    raise ValueError(f"unknown algorithm: {algo!r}")
+
+
+def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
+    """NumPy reference pipeline. Returns a flat dict of arrays/scalars; the
+    Oracle assembles the user-facing nested result dict from it."""
+    reports = np.asarray(reports, dtype=np.float64)
+    old_rep = nk.normalize(np.asarray(reputation, dtype=np.float64))
+    scaled = np.asarray(scaled, dtype=bool)
+    rescaled = nk.rescale(reports, scaled, mins, maxs)
+    filled = nk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+
+    rep = old_rep
+    this_rep = old_rep
+    loading = None
+    converged = False
+    iterations = 0
+    for _ in range(max(p.max_iterations, 1)):
+        adj, loading = _scores_np(filled, rep, p)
+        this_rep = nk.row_reward_weighted(adj, rep)
+        new_rep = nk.smooth(this_rep, rep, p.alpha)
+        delta = float(np.max(np.abs(new_rep - rep)))
+        rep = new_rep
+        iterations += 1
+        if delta <= p.convergence_tolerance:
+            converged = True
+            break
+
+    outcomes_raw, outcomes_adjusted = nk.resolve_outcomes(
+        rescaled, filled, rep, scaled, p.catch_tolerance)
+    outcomes_final = nk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
+    extras = nk.certainty_and_bonuses(rescaled, filled, rep, outcomes_adjusted,
+                                      scaled, p.catch_tolerance)
+    result = {
+        "original": reports,
+        "rescaled": rescaled,
+        "filled": filled,
+        "old_rep": old_rep,
+        "this_rep": this_rep,
+        "smooth_rep": rep,
+        "na_row": np.isnan(reports).any(axis=1),
+        "outcomes_raw": outcomes_raw,
+        "outcomes_adjusted": outcomes_adjusted,
+        "outcomes_final": outcomes_final,
+        "iterations": iterations,
+        "convergence": converged,
+    }
+    result.update(extras)
+    if loading is not None:
+        result["first_loading"] = nk.canon_sign(loading)
+    return result
+
+
+def _scores_jax(filled, rep, p: ConsensusParams):
+    """JAX mirror of ``_scores_np``: ``(adj_scores, loading-or-None)``."""
+    algo = p.algorithm
+    if algo == "sztorc":
+        return sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters)
+    if algo == "fixed-variance":
+        return fixed_variance_scores_jax(filled, rep, p.variance_threshold,
+                                         p.max_components, p.pca_method)
+    if algo == "ica":
+        return ica_scores_jax(filled, rep, p.max_components, p.pca_method), None
+    if algo == "k-means":
+        return cl.kmeans_conformity_jax(filled, rep, p.num_clusters), None
+    raise ValueError(f"algorithm {algo!r} is not jit-compatible "
+                     f"(hybrid algorithms: {HYBRID_ALGORITHMS})")
+
+
+def _iterate_jax(filled, old_rep, p: ConsensusParams):
+    """Iterative Sztorc reputation redistribution as a ``lax.scan``
+    (SURVEY.md §7 M2). Carry: (rep, this_rep, converged, iterations). A step
+    whose starting state is already converged applies no update — the numpy
+    backend's ``break`` expressed with static shapes."""
+
+    has_loading = p.algorithm in ("sztorc", "fixed-variance")
+    E = filled.shape[1]
+
+    def step(carry, _):
+        rep, this_rep_prev, loading_prev, converged, iters = carry
+        adj, loading = _scores_jax(filled, rep, p)
+        if loading is None:
+            loading = loading_prev
+        this_rep = jk.row_reward_weighted(adj, rep)
+        new_rep = jk.smooth(this_rep, rep, p.alpha)
+        delta = jnp.max(jnp.abs(new_rep - rep))
+        rep_out = jnp.where(converged, rep, new_rep)
+        this_out = jnp.where(converged, this_rep_prev, this_rep)
+        loading_out = jnp.where(converged, loading_prev, loading)
+        iters_out = jnp.where(converged, iters, iters + 1)
+        conv_out = converged | (delta <= p.convergence_tolerance)
+        return (rep_out, this_out, loading_out, conv_out, iters_out), None
+
+    n = max(p.max_iterations, 1)
+    init = (old_rep, old_rep, jnp.zeros((E,), dtype=filled.dtype),
+            jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+    (rep, this_rep, loading, converged, iters), _ = lax.scan(
+        step, init, None, length=n)
+    return rep, this_rep, (loading if has_loading else None), converged, iters
+
+
+def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
+    """Whole-pipeline XLA graph: one compiled program per (shape, params)."""
+    old_rep = jk.normalize(reputation)
+    rescaled = jk.rescale(reports, scaled, mins, maxs)
+    filled = jk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+    rep, this_rep, loading, converged, iters = _iterate_jax(filled, old_rep, p)
+    outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
+        rescaled, filled, rep, scaled, p.catch_tolerance)
+    outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
+    extras = jk.certainty_and_bonuses(rescaled, filled, rep, outcomes_adjusted,
+                                      scaled, p.catch_tolerance)
+    result = {
+        "original": reports,
+        "rescaled": rescaled,
+        "filled": filled,
+        "old_rep": old_rep,
+        "this_rep": this_rep,
+        "smooth_rep": rep,
+        "na_row": jnp.isnan(reports).any(axis=1),
+        "outcomes_raw": outcomes_raw,
+        "outcomes_adjusted": outcomes_adjusted,
+        "outcomes_final": outcomes_final,
+        "iterations": iters,
+        "convergence": converged,
+    }
+    result.update(extras)
+    if loading is not None:
+        result["first_loading"] = jk.canon_sign(loading)
+    return result
+
+
+consensus_jit = jax.jit(_consensus_core, static_argnames=("p",))
+
+
+def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
+                      p: ConsensusParams):
+    """Hybrid path for hierarchical/DBSCAN: rescale/interpolate/outcomes run
+    on device; the irregular clustering step and the tiny O(R) reputation
+    updates run on host against a device-computed R×R distance matrix."""
+    old_rep = jk.normalize(reputation)
+    rescaled = jk.rescale(reports, scaled, mins, maxs)
+    filled = jk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+
+    filled_host = np.asarray(filled, dtype=np.float64)
+    # the clustering inputs (filled reports, hence distances) are
+    # loop-invariant — only reputation changes across iterations
+    sq = np.asarray(cl.pairwise_sq_dists_jax(filled), dtype=np.float64)
+    rep = np.asarray(old_rep, dtype=np.float64)
+    this_rep = rep
+    converged = False
+    iterations = 0
+    for _ in range(max(p.max_iterations, 1)):
+        if p.algorithm == "hierarchical":
+            adj = cl.hierarchical_conformity(filled_host, rep,
+                                             p.hierarchy_threshold, sq_dists=sq)
+        else:
+            adj = cl.dbscan_conformity(filled_host, rep, p.dbscan_eps,
+                                       p.dbscan_min_samples, sq_dists=sq)
+        this_rep = nk.row_reward_weighted(adj, rep)
+        new_rep = nk.smooth(this_rep, rep, p.alpha)
+        delta = float(np.max(np.abs(new_rep - rep)))
+        rep = new_rep
+        iterations += 1
+        if delta <= p.convergence_tolerance:
+            converged = True
+            break
+
+    rep_dev = jnp.asarray(rep, dtype=filled.dtype)
+    outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
+        rescaled, filled, rep_dev, scaled, p.catch_tolerance)
+    outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
+    extras = jk.certainty_and_bonuses(rescaled, filled, rep_dev,
+                                      outcomes_adjusted, scaled,
+                                      p.catch_tolerance)
+    result = {
+        "original": reports,
+        "rescaled": rescaled,
+        "filled": filled,
+        "old_rep": old_rep,
+        "this_rep": jnp.asarray(this_rep, dtype=filled.dtype),
+        "smooth_rep": rep_dev,
+        "na_row": jnp.isnan(reports).any(axis=1),
+        "outcomes_raw": outcomes_raw,
+        "outcomes_adjusted": outcomes_adjusted,
+        "outcomes_final": outcomes_final,
+        "iterations": iterations,
+        "convergence": converged,
+    }
+    result.update(extras)
+    return result
+
+
+def consensus_jax(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
+    """JAX pipeline dispatcher (jit path for JIT_ALGORITHMS, hybrid for
+    hierarchical/DBSCAN). Inputs may be numpy or jax arrays."""
+    dtype = jnp.asarray(0.0).dtype  # respects jax_enable_x64
+    reports = jnp.asarray(reports, dtype=dtype)
+    reputation = jnp.asarray(reputation, dtype=dtype)
+    scaled = jnp.asarray(scaled, dtype=bool)
+    mins = jnp.asarray(mins, dtype=dtype)
+    maxs = jnp.asarray(maxs, dtype=dtype)
+    if p.algorithm in JIT_ALGORITHMS:
+        return consensus_jit(reports, reputation, scaled, mins, maxs, p)
+    if p.algorithm in HYBRID_ALGORITHMS:
+        return _consensus_hybrid(reports, reputation, scaled, mins, maxs, p)
+    raise ValueError(f"unknown algorithm: {p.algorithm!r}")

@@ -1,0 +1,90 @@
+"""PCA-based scoring: the classic ``"sztorc"`` algorithm and the
+``"fixed-variance"`` multi-component variant (SURVEY.md §2 #4, #5, #10).
+
+Both backends implement the identical selection and combination rules so the
+resulting reputation vectors agree across numpy/jax to float tolerance and
+catch-snapped outcomes agree exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
+
+__all__ = [
+    "sztorc_scores_np", "sztorc_scores_jax",
+    "fixed_variance_scores_np", "fixed_variance_scores_jax",
+]
+
+
+def sztorc_scores_np(reports_filled, reputation):
+    """Direction-fixed first-principal-component scores (numpy). Returns
+    ``(adj_scores, loading)`` — the loading is reported in the result dict,
+    so it is computed once here rather than re-decomposed after the loop."""
+    loading, scores = nk.weighted_prin_comp(reports_filled, reputation)
+    return nk.direction_fixed_scores(scores, reports_filled, reputation), loading
+
+
+def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
+                      power_iters=128):
+    """Direction-fixed first-principal-component scores (jax); returns
+    ``(adj_scores, loading)`` like the numpy mirror."""
+    loading, scores = jk.weighted_prin_comp(reports_filled, reputation,
+                                            method=pca_method, power_iters=power_iters)
+    return jk.direction_fixed_scores(scores, reports_filled, reputation), loading
+
+
+def _component_weights_np(explained, variance_threshold):
+    """Include component c while the cumulative explained variance *before* c
+    has not yet reached ``variance_threshold`` (component 0 always included);
+    weight included components by their explained-variance share."""
+    cum_before = np.concatenate([[0.0], np.cumsum(explained)[:-1]])
+    include = cum_before < variance_threshold
+    include[0] = True
+    w = explained * include
+    total = w.sum()
+    return w / total if total > 0 else include / include.sum()
+
+
+def fixed_variance_scores_np(reports_filled, reputation, variance_threshold,
+                             max_components):
+    """``fixed-variance`` variant: blend direction-fixed scores of the top
+    components, weighted by explained variance, until ``variance_threshold``
+    of the spectrum is covered (SURVEY.md §2 #10)."""
+    k = min(max_components, min(reports_filled.shape))
+    loadings, scores, explained = nk.weighted_prin_comps(reports_filled,
+                                                         reputation, k)
+    w = _component_weights_np(explained, variance_threshold)
+    adj = np.zeros(reports_filled.shape[0], dtype=np.float64)
+    for c in range(k):
+        adj_c = nk.direction_fixed_scores(scores[:, c], reports_filled, reputation)
+        adj = adj + w[c] * adj_c
+    return adj, loadings[:, 0]
+
+
+def fixed_variance_scores_jax(reports_filled, reputation, variance_threshold,
+                              max_components, pca_method="auto"):
+    """JAX mirror of :func:`fixed_variance_scores_np`; the data-dependent
+    component selection stays in-graph as a mask (static component count)."""
+    k = min(max_components, min(reports_filled.shape))
+    loadings, scores, explained = jk.weighted_prin_comps(reports_filled,
+                                                         reputation, k,
+                                                         method=pca_method)
+    cum_before = jnp.concatenate([jnp.zeros((1,), explained.dtype),
+                                  jnp.cumsum(explained)[:-1]])
+    include = cum_before < variance_threshold
+    include = include.at[0].set(True)
+    w = explained * include
+    total = jnp.sum(w)
+    uniform = include / jnp.sum(include)
+    w = jnp.where(total > 0.0, w / jnp.where(total > 0.0, total, 1.0), uniform)
+
+    def fix_one(scores_c):
+        return jk.direction_fixed_scores(scores_c, reports_filled, reputation)
+
+    adj_all = jax.vmap(fix_one, in_axes=1, out_axes=1)(scores)   # (R, k)
+    return adj_all @ w, loadings[:, 0]

@@ -1,0 +1,6 @@
+"""Kernel layer: numpy reference kernels (correctness anchor) and their
+jit-compatible JAX mirrors. See module docstrings for semantics provenance."""
+
+from . import jax_kernels, numpy_kernels
+
+__all__ = ["numpy_kernels", "jax_kernels"]

@@ -1,0 +1,339 @@
+"""JAX/TPU kernels for the oracle consensus pipeline.
+
+Every function here is a pure, jit-compatible mirror of the reference
+semantics defined in ``pyconsensus_tpu.ops.numpy_kernels`` (the correctness
+anchor; see its module docstring for provenance — SURVEY.md §2-3, symbols
+anchored in BASELINE.json). Design rules, per SURVEY.md §7 M0:
+
+- **No masked arrays.** Missing reports are ``NaN`` in the input matrix; every
+  kernel derives an explicit ``present`` mask with ``jnp.isnan`` and works
+  through ``jnp.where``. Shapes are static; nothing here branches on values in
+  Python.
+- **No E×E covariance at scale.** :func:`weighted_prin_comp` dispatches between
+  an explicit ``E×E`` eigendecomposition (small E, exact-parity path), the
+  ``R×R`` Gram trick (rank <= R-1, SURVEY.md §7 "hard parts" route b), and
+  matrix-free power iteration (route a) — the latter two only ever contract
+  over the event axis, so they shard cleanly over an event-partitioned mesh
+  with ``psum``-style reductions inserted by XLA.
+- All comparisons and tie-breaks replicate the numpy kernels exactly, so
+  catch-snapped binary outcomes agree bit-identically across backends.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "normalize",
+    "canon_sign",
+    "catch",
+    "rescale",
+    "unscale_outcomes",
+    "interpolate",
+    "weighted_cov",
+    "weighted_prin_comp",
+    "weighted_prin_comps",
+    "weighted_median_cols",
+    "direction_fixed_scores",
+    "row_reward_weighted",
+    "smooth",
+    "resolve_outcomes",
+    "certainty_and_bonuses",
+]
+
+
+def normalize(v: jnp.ndarray) -> jnp.ndarray:
+    """``v / sum(v)`` with the zero-sum vector returned unchanged
+    (numpy_kernels.normalize)."""
+    total = jnp.sum(v)
+    safe = jnp.where(total == 0.0, 1.0, total)
+    return jnp.where(total == 0.0, v, v / safe)
+
+
+def canon_sign(v: jnp.ndarray) -> jnp.ndarray:
+    """JAX mirror of numpy_kernels.canon_sign (identical tie-break)."""
+    s = jnp.sign(v[jnp.argmax(jnp.abs(v))])
+    return v * jnp.where(s == 0.0, 1.0, s)
+
+
+def catch(x: jnp.ndarray, tolerance) -> jnp.ndarray:
+    """Snap toward {0, 0.5, 1} (numpy_kernels.catch)."""
+    return jnp.where(x < 0.5 - tolerance, 0.0, jnp.where(x > 0.5 + tolerance, 1.0, 0.5))
+
+
+def rescale(reports, scaled, mins, maxs):
+    """Scaled columns -> [0, 1]; binary pass through; NaN stays NaN."""
+    span = jnp.where(scaled, maxs - mins, 1.0)
+    span = jnp.where(span == 0.0, 1.0, span)
+    shifted = (reports - jnp.where(scaled, mins, 0.0)[None, :]) / span[None, :]
+    return jnp.where(scaled[None, :], shifted, reports)
+
+
+def unscale_outcomes(outcomes, scaled, mins, maxs):
+    """Scaled outcomes map back through ``x * (max - min) + min``."""
+    return jnp.where(scaled, outcomes * (maxs - mins) + mins, outcomes)
+
+
+def interpolate(reports, reputation, scaled, tolerance):
+    """Reputation-weighted column-mean fill of NaN entries; binary fills are
+    catch-snapped (numpy_kernels.interpolate). One fused pass: XLA folds the
+    mask/where/reduce chain into a single HBM sweep of the (R, E) matrix."""
+    present = ~jnp.isnan(reports)
+    zeroed = jnp.where(present, reports, 0.0)
+    active_rep = jnp.where(present, reputation[:, None], 0.0)
+    denom = jnp.sum(active_rep, axis=0)
+    numer = jnp.sum(zeroed * reputation[:, None], axis=0)
+    fill = jnp.where(denom > 0.0, numer / jnp.where(denom > 0.0, denom, 1.0), 0.5)
+    fill = jnp.where(scaled, fill, catch(fill, tolerance))
+    return jnp.where(present, zeroed, fill[None, :])
+
+
+def weighted_cov(reports_filled, reputation):
+    """(cov (E,E), deviations (R,E)) — only used on small E; the scaled path
+    goes through the Gram trick / power iteration below
+    (numpy_kernels.weighted_cov)."""
+    mu = reputation @ reports_filled
+    dev = reports_filled - mu[None, :]
+    denom = 1.0 - jnp.sum(reputation ** 2)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    cov = (dev * reputation[:, None]).T @ dev / denom
+    return cov, dev
+
+
+def _center(reports_filled, reputation):
+    mu = reputation @ reports_filled
+    dev = reports_filled - mu[None, :]
+    denom = 1.0 - jnp.sum(reputation ** 2)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    return dev, denom
+
+
+def _first_pc_eigh_cov(dev, denom, reputation):
+    cov = (dev * reputation[:, None]).T @ dev / denom
+    _, eigvecs = jnp.linalg.eigh(cov)
+    loading = eigvecs[:, -1]
+    return loading, dev @ loading
+
+
+def _first_pc_eigh_gram(dev, denom, reputation):
+    """Gram trick (SURVEY.md §7 route b): with A = diag(sqrt(rep)) D, the
+    nonzero spectrum of C = A^T A / denom equals that of G = A A^T / denom
+    (R×R). Eigenvector map-back: v = A^T u / ||A^T u||. Never forms E×E."""
+    sqrt_rep = jnp.sqrt(jnp.clip(reputation, 0.0, None))
+    A = dev * sqrt_rep[:, None]                       # (R, E)
+    G = (A @ A.T) / denom                             # (R, R) — contracts over E
+    _, eigvecs = jnp.linalg.eigh(G)
+    u = eigvecs[:, -1]
+    v = A.T @ u                                       # (E,)
+    norm = jnp.linalg.norm(v)
+    loading = v / jnp.where(norm == 0.0, 1.0, norm)
+    return loading, dev @ loading
+
+
+def _first_pc_power(dev, denom, reputation, n_iters: int = 128):
+    """Matrix-free power iteration (SURVEY.md §7 route a): each step is two
+    sharded matvecs through the centered data, O(R*E), no E×E or R×R matrix.
+    Deterministic start: one implicit-covariance application to the ones
+    vector. Fixed trip count keeps the graph static."""
+    E = dev.shape[1]
+
+    def apply_cov(v):
+        t = dev @ v                                    # (R,)  contracts over E
+        return dev.T @ (reputation * t) / denom        # (E,)  contracts over R
+
+    v0 = apply_cov(jnp.ones((E,), dtype=dev.dtype))
+    n0 = jnp.linalg.norm(v0)
+    v0 = jnp.where(n0 == 0.0, jnp.ones((E,), dtype=dev.dtype) / jnp.sqrt(jnp.asarray(E, dev.dtype)), v0 / jnp.where(n0 == 0.0, 1.0, n0))
+
+    def body(_, v):
+        w = apply_cov(v)
+        n = jnp.linalg.norm(w)
+        return jnp.where(n == 0.0, v, w / jnp.where(n == 0.0, 1.0, n))
+
+    loading = lax.fori_loop(0, n_iters, body, v0)
+    return loading, dev @ loading
+
+
+def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
+                       power_iters: int = 128):
+    """First principal component of the reputation-weighted covariance
+    (numpy_kernels.weighted_prin_comp). ``method``:
+
+    - ``"eigh-cov"``  — explicit E×E eigh (parity path, small E);
+    - ``"eigh-gram"`` — R×R Gram-trick eigh (exact, E-shardable);
+    - ``"power"``     — matrix-free power iteration (fully scalable);
+    - ``"auto"``      — picks by static shape: E<=1024 cov, else R<=4096 gram,
+      else power.
+
+    Returns ``(loading (E,), scores (R,))``; sign fixed downstream.
+    """
+    dev, denom = _center(reports_filled, reputation)
+    R, E = reports_filled.shape
+    if method == "auto":
+        if E <= 1024:
+            method = "eigh-cov"
+        elif R <= 4096:
+            method = "eigh-gram"
+        else:
+            method = "power"
+    if method == "eigh-cov":
+        return _first_pc_eigh_cov(dev, denom, reputation)
+    if method == "eigh-gram":
+        return _first_pc_eigh_gram(dev, denom, reputation)
+    if method == "power":
+        return _first_pc_power(dev, denom, reputation, power_iters)
+    raise ValueError(f"unknown PCA method: {method!r}")
+
+
+def weighted_prin_comps(reports_filled, reputation, n_components: int,
+                        method: str = "auto"):
+    """Top-k components + explained-variance fractions for the
+    ``fixed-variance`` variant (numpy_kernels.weighted_prin_comps). Uses the
+    E×E eigh for small E, else the Gram trick (the full nonzero spectrum lives
+    in the R×R Gram matrix)."""
+    dev, denom = _center(reports_filled, reputation)
+    R, E = reports_filled.shape
+    if method == "auto":
+        method = "eigh-cov" if E <= 1024 else "eigh-gram"
+    if method == "eigh-cov":
+        cov = (dev * reputation[:, None]).T @ dev / denom
+        eigvals, eigvecs = jnp.linalg.eigh(cov)
+        loadings = eigvecs[:, ::-1][:, :n_components]
+        eig = jnp.clip(eigvals[::-1][:n_components], 0.0, None)
+        total = jnp.sum(jnp.clip(eigvals, 0.0, None))
+    else:
+        sqrt_rep = jnp.sqrt(jnp.clip(reputation, 0.0, None))
+        A = dev * sqrt_rep[:, None]
+        G = (A @ A.T) / denom
+        eigvals, eigvecs = jnp.linalg.eigh(G)
+        U = eigvecs[:, ::-1][:, :n_components]         # (R, k)
+        V = A.T @ U                                    # (E, k)
+        norms = jnp.linalg.norm(V, axis=0)
+        loadings = V / jnp.where(norms == 0.0, 1.0, norms)[None, :]
+        eig = jnp.clip(eigvals[::-1][:n_components], 0.0, None)
+        total = jnp.sum(jnp.clip(eigvals, 0.0, None))
+    explained = jnp.where(total > 0.0, eig / jnp.where(total > 0.0, total, 1.0),
+                          jnp.zeros_like(eig))
+    scores = dev @ loadings
+    return loadings, scores, explained
+
+
+def weighted_median_cols(values, weights, present):
+    """Per-column weighted median, vectorized over events
+    (numpy_kernels.weighted_median, same comparisons and midpoint rule).
+
+    Absent entries get value +inf (sort last) and weight 0, replicating the
+    numpy kernel's subsetting. ``values``/``weights``/``present``: (R, E).
+    Returns (E,).
+    """
+    R = values.shape[0]
+    big = jnp.where(present, values, jnp.inf)
+    w_raw = jnp.where(present, weights, 0.0)
+    order = jnp.argsort(big, axis=0, stable=True)
+    v = jnp.take_along_axis(big, order, axis=0)
+    w = jnp.take_along_axis(w_raw, order, axis=0)
+    total = jnp.sum(w, axis=0)
+    safe_total = jnp.where(total > 0.0, total, 1.0)
+    cw = jnp.cumsum(w / safe_total[None, :], axis=0)
+    ge = cw >= 0.5
+    idx = jnp.argmax(ge, axis=0)                      # first crossing
+    idx = jnp.where(jnp.any(ge, axis=0), idx, R - 1)
+    cols = jnp.arange(values.shape[1])
+    cw_i = cw[idx, cols]
+    v_i = v[idx, cols]
+    nxt = jnp.clip(idx + 1, 0, R - 1)
+    v_n = v[nxt, cols]
+    # np.isclose(cw_i, 0.5) default tolerances: atol=1e-8, rtol=1e-5
+    exact = jnp.abs(cw_i - 0.5) <= (1e-8 + 1e-5 * 0.5)
+    has_next = (idx + 1 < R) & jnp.isfinite(v_n)
+    med = jnp.where(exact & has_next, 0.5 * (v_i + v_n), v_i)
+    return jnp.where(total > 0.0, med, 0.5)
+
+
+def direction_fixed_scores(scores, reports_filled, reputation):
+    """PCA sign/direction fix (numpy_kernels.direction_fixed_scores). Runs
+    inside the jitted graph; the ``ref_ind <= 0`` tie-break is identical to the
+    numpy kernel so both backends pick the same orientation."""
+    set1 = scores + jnp.abs(jnp.min(scores))
+    set2 = scores - jnp.max(scores)
+    old = reputation @ reports_filled
+    new1 = normalize(set1) @ reports_filled
+    new2 = normalize(set2) @ reports_filled
+    ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
+    return jnp.where(ref_ind <= 0.0, set1, set2)
+
+
+def row_reward_weighted(adj_scores, reputation):
+    """normalize(adj * rep / mean(rep)); unchanged reputation when the
+    adjusted scores vanish (numpy_kernels.row_reward_weighted)."""
+    degenerate = jnp.max(jnp.abs(adj_scores)) == 0.0
+    candidate = normalize(adj_scores * (reputation / jnp.mean(reputation)))
+    return jnp.where(degenerate, reputation, candidate)
+
+
+def smooth(this_rep, old_rep, alpha):
+    """alpha-blend with prior reputation (numpy_kernels.smooth)."""
+    return alpha * this_rep + (1.0 - alpha) * old_rep
+
+
+def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance):
+    """Vectorized outcome resolution (numpy_kernels.resolve_outcomes):
+    participation-restricted renormalized reputation; weighted mean for binary
+    columns, weighted median for scaled; catch-snap binary outcomes."""
+    present = ~jnp.isnan(reports)
+    w = smooth_rep[:, None] * present
+    tw = jnp.sum(w, axis=0)
+    safe_tw = jnp.where(tw > 0.0, tw, 1.0)
+    mean_present = jnp.sum(w * reports_filled, axis=0) / safe_tw
+    full_total = jnp.sum(smooth_rep)
+    full_mean = (smooth_rep @ reports_filled) / jnp.where(full_total == 0.0, 1.0, full_total)
+    means = jnp.where(tw > 0.0, mean_present, full_mean)
+    medians = weighted_median_cols(reports_filled,
+                                   jnp.broadcast_to(smooth_rep[:, None], reports.shape),
+                                   present)
+    outcomes_raw = jnp.where(tw > 0.0, jnp.where(scaled, medians, means), means)
+    outcomes_adjusted = jnp.where(scaled, outcomes_raw, catch(outcomes_raw, tolerance))
+    return outcomes_raw, outcomes_adjusted
+
+
+def certainty_and_bonuses(reports, reports_filled, smooth_rep, outcomes_adjusted,
+                          scaled, tolerance):
+    """Certainty / participation / bonus accounting
+    (numpy_kernels.certainty_and_bonuses). Binary agreement is exact equality
+    on catch-snapped {0, 0.5, 1} values, so it is dtype-independent."""
+    na_mat = jnp.isnan(reports).astype(reports_filled.dtype)
+    agree = jnp.where(
+        scaled[None, :],
+        jnp.abs(reports_filled - outcomes_adjusted[None, :]) <= tolerance,
+        reports_filled == outcomes_adjusted[None, :],
+    )
+    certainty = jnp.sum(agree * smooth_rep[:, None], axis=0)
+    consensus_reward = normalize(certainty)
+    avg_certainty = jnp.mean(certainty)
+
+    participation_columns = 1.0 - smooth_rep @ na_mat
+    participation_rows = 1.0 - na_mat @ consensus_reward
+    percent_na = 1.0 - jnp.mean(participation_columns)
+
+    na_bonus_rows = normalize(participation_rows)
+    reporter_bonus = na_bonus_rows * percent_na + smooth_rep * (1.0 - percent_na)
+    na_bonus_cols = normalize(participation_columns)
+    author_bonus = na_bonus_cols * percent_na + consensus_reward * (1.0 - percent_na)
+
+    return {
+        "certainty": certainty,
+        "consensus_reward": consensus_reward,
+        "avg_certainty": avg_certainty,
+        "participation_columns": participation_columns,
+        "participation_rows": participation_rows,
+        "percent_na": percent_na,
+        "na_bonus_rows": na_bonus_rows,
+        "reporter_bonus": reporter_bonus,
+        "na_bonus_cols": na_bonus_cols,
+        "author_bonus": author_bonus,
+    }

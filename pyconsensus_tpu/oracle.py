@@ -1,0 +1,253 @@
+"""The public ``Oracle`` API — constructor-compatible with the reference
+library's ``Oracle`` class (SURVEY.md §2 #1, kwargs anchored in
+BASELINE.json), plus the TPU-native ``backend="jax"`` path the north star
+demands.
+
+Usage::
+
+    from pyconsensus_tpu import Oracle
+    result = Oracle(reports=my_matrix, algorithm="sztorc").consensus()
+
+``reports`` is a (reporters × events) float matrix; ``NaN`` marks a
+non-report; binary events take values in {0, 0.5, 1}; scaled events carry raw
+values plus an ``event_bounds`` entry ``{"scaled": True, "min": m, "max": M}``.
+
+``consensus()`` returns the reference's nested result dict (SURVEY.md §2 #11):
+``original``, ``filled``, ``agents`` (old_rep, this_rep, smooth_rep, na_row,
+participation_rows, relative_part, reporter_bonus), ``events`` (outcomes_raw,
+consensus_reward, certainty, participation_columns, author_bonus,
+outcomes_adjusted, outcomes_final, and adj_first_loadings on PCA paths),
+``participation``, ``certainty``, ``convergence``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
+                              ConsensusParams, consensus_jax, consensus_np)
+
+__all__ = ["Oracle", "ALGORITHMS", "BACKENDS"]
+
+ALGORITHMS = tuple(JIT_ALGORITHMS) + tuple(HYBRID_ALGORITHMS)
+BACKENDS = ("numpy", "jax")
+
+#: accepted lowercase spellings -> canonical algorithm name
+_ALGORITHM_ALIASES = {
+    "pca": "sztorc",
+    "first-component": "sztorc",
+    "kmeans": "k-means",
+    "agglomerative": "hierarchical",
+}
+
+
+class Oracle:
+    """Truthcoin/Sztorc consensus oracle with selectable compute backend.
+
+    Parameters mirror the reference ``Oracle`` (SURVEY.md §2 #1):
+
+    reports : (R, E) array-like
+        Reports matrix; NaN = no report.
+    event_bounds : list of dicts or None
+        Per-event ``{"scaled": bool, "min": float, "max": float}``; ``None``
+        (or a ``None`` entry) means a binary/categorical event in {0, 0.5, 1}.
+    reputation : (R,) array-like or None
+        Prior reputation; defaults to uniform. Normalized to sum to 1.
+    catch_tolerance : float
+        Half-width of the "ambiguous" band around 0.5 in :func:`catch`.
+    alpha : float
+        Smoothing blend for reputation updates.
+    variance_threshold, max_components :
+        ``fixed-variance`` variant knobs (explained-variance cutoff, component
+        cap; max_components also caps ICA components).
+    max_iterations : int
+        Iterative Sztorc convergence loop trip count (config 3); 1 = single
+        redistribution pass.
+    convergence_tolerance : float
+        Max-abs reputation change that counts as converged.
+    num_clusters, hierarchy_threshold, dbscan_eps, dbscan_min_samples :
+        Clustering-variant knobs (config 4).
+    algorithm : str
+        One of ``sztorc`` (classic PCA), ``fixed-variance``, ``ica``,
+        ``k-means``, ``hierarchical``, ``dbscan`` (SURVEY.md §2 #10).
+    backend : str
+        ``"numpy"`` (reference semantics, correctness anchor) or ``"jax"``
+        (TPU path; jit-compiled for sztorc / fixed-variance / ica / k-means,
+        hybrid device+host for hierarchical / dbscan).
+    pca_method : str
+        JAX PCA strategy: ``auto`` | ``eigh-cov`` | ``eigh-gram`` | ``power``
+        (SURVEY.md §7 "hard parts" — never materialize E×E at scale).
+    verbose : bool
+        Print a result summary after ``consensus()`` (reference fidelity).
+    """
+
+    def __init__(self,
+                 reports=None,
+                 event_bounds: Optional[Sequence] = None,
+                 reputation=None,
+                 catch_tolerance: float = 0.1,
+                 alpha: float = 0.1,
+                 variance_threshold: float = 0.9,
+                 max_components: int = 5,
+                 max_iterations: int = 1,
+                 convergence_tolerance: float = 1e-6,
+                 num_clusters: int = 2,
+                 hierarchy_threshold: float = 0.5,
+                 dbscan_eps: float = 0.5,
+                 dbscan_min_samples: int = 2,
+                 algorithm: str = "sztorc",
+                 backend: str = "numpy",
+                 pca_method: str = "auto",
+                 power_iters: int = 128,
+                 verbose: bool = False):
+        if reports is None:
+            raise ValueError("reports matrix is required")
+        self.reports = np.asarray(reports, dtype=np.float64)
+        if self.reports.ndim != 2:
+            raise ValueError(f"reports must be 2-D (reporters × events), "
+                             f"got shape {self.reports.shape}")
+        n_reporters, n_events = self.reports.shape
+
+        algorithm = algorithm.lower()
+        algorithm = _ALGORITHM_ALIASES.get(algorithm, algorithm)
+        if algorithm not in ALGORITHMS:
+            raise ValueError(f"unknown algorithm {algorithm!r}; "
+                             f"choose from {ALGORITHMS}")
+        if backend not in BACKENDS:
+            raise ValueError(f"unknown backend {backend!r}; "
+                             f"choose from {BACKENDS}")
+
+        self.event_bounds = event_bounds
+        scaled = np.zeros(n_events, dtype=bool)
+        mins = np.zeros(n_events, dtype=np.float64)
+        maxs = np.ones(n_events, dtype=np.float64)
+        if event_bounds is not None:
+            if len(event_bounds) != n_events:
+                raise ValueError(f"event_bounds has {len(event_bounds)} "
+                                 f"entries for {n_events} events")
+            for j, b in enumerate(event_bounds):
+                if b is None:
+                    continue
+                scaled[j] = bool(b.get("scaled", False))
+                mins[j] = float(b.get("min", 0.0))
+                maxs[j] = float(b.get("max", 1.0))
+                if scaled[j] and maxs[j] <= mins[j]:
+                    raise ValueError(f"event {j}: max must exceed min "
+                                     f"for a scaled event")
+        self.scaled, self.mins, self.maxs = scaled, mins, maxs
+
+        if reputation is None:
+            rep = np.full(n_reporters, 1.0 / n_reporters, dtype=np.float64)
+        else:
+            rep = np.asarray(reputation, dtype=np.float64)
+            if rep.shape != (n_reporters,):
+                raise ValueError(f"reputation shape {rep.shape} does not "
+                                 f"match {n_reporters} reporters")
+            if np.isnan(rep).any():
+                raise ValueError("reputation must not contain NaN")
+            if (rep < 0).any():
+                raise ValueError("reputation must be non-negative")
+            if rep.sum() <= 0:
+                raise ValueError("reputation must have positive total mass")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must lie in [0, 1]")
+        if catch_tolerance < 0.0:
+            raise ValueError("catch_tolerance must be non-negative")
+        for name, value in (("max_components", max_components),
+                            ("max_iterations", max_iterations),
+                            ("num_clusters", num_clusters),
+                            ("dbscan_min_samples", dbscan_min_samples),
+                            ("power_iters", power_iters)):
+            if int(value) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if dbscan_eps <= 0.0:
+            raise ValueError("dbscan_eps must be positive")
+
+        self.reputation = rep
+        self.backend = backend
+        self.verbose = verbose
+        self.params = ConsensusParams(
+            algorithm=algorithm,
+            alpha=float(alpha),
+            catch_tolerance=float(catch_tolerance),
+            variance_threshold=float(variance_threshold),
+            max_components=int(max_components),
+            max_iterations=int(max_iterations),
+            convergence_tolerance=float(convergence_tolerance),
+            num_clusters=int(num_clusters),
+            hierarchy_threshold=float(hierarchy_threshold),
+            dbscan_eps=float(dbscan_eps),
+            dbscan_min_samples=int(dbscan_min_samples),
+            pca_method=pca_method,
+            power_iters=int(power_iters),
+        )
+
+    # -- core ---------------------------------------------------------------
+
+    def resolve_raw(self):
+        """Run the pipeline, returning the flat backend result dict. On the
+        jax backend the arrays stay on device — benchmark/sharded callers use
+        this to avoid host transfers; ``consensus()`` wraps it for the
+        user-facing nested dict."""
+        if self.backend == "numpy":
+            return consensus_np(self.reports, self.reputation, self.scaled,
+                                self.mins, self.maxs, self.params)
+        return consensus_jax(self.reports, self.reputation, self.scaled,
+                             self.mins, self.maxs, self.params)
+
+    def consensus(self) -> dict:
+        """Resolve outcomes + reputation; returns the reference-shaped nested
+        result dict (all values host numpy)."""
+        raw = self.resolve_raw()
+        raw = {k: np.asarray(v) for k, v in raw.items()}
+        result = {
+            "original": raw["original"],
+            "filled": raw["filled"],
+            "agents": {
+                "old_rep": raw["old_rep"],
+                "this_rep": raw["this_rep"],
+                "smooth_rep": raw["smooth_rep"],
+                "na_row": raw["na_row"],
+                "participation_rows": raw["participation_rows"],
+                "relative_part": raw["na_bonus_rows"],
+                "reporter_bonus": raw["reporter_bonus"],
+            },
+            "events": {
+                "outcomes_raw": raw["outcomes_raw"],
+                "consensus_reward": raw["consensus_reward"],
+                "certainty": raw["certainty"],
+                "participation_columns": raw["participation_columns"],
+                "author_bonus": raw["author_bonus"],
+                "outcomes_adjusted": raw["outcomes_adjusted"],
+                "outcomes_final": raw["outcomes_final"],
+            },
+            "participation": float(1.0 - raw["percent_na"]),
+            "certainty": float(raw["avg_certainty"]),
+            "convergence": bool(raw["convergence"]),
+            "iterations": int(raw["iterations"]),
+        }
+        if "first_loading" in raw:
+            result["events"]["adj_first_loadings"] = raw["first_loading"]
+        if self.verbose:
+            self._print_summary(result)
+        return result
+
+    # -- reference-fidelity verbose output ----------------------------------
+
+    def _print_summary(self, result: dict) -> None:
+        with np.printoptions(precision=6, suppress=True):
+            self._print_summary_inner(result)
+
+    def _print_summary_inner(self, result: dict) -> None:
+        print(f"pyconsensus_tpu Oracle — algorithm={self.params.algorithm} "
+              f"backend={self.backend}")
+        print(f"  reporters × events: {self.reports.shape[0]} × "
+              f"{self.reports.shape[1]}")
+        print(f"  outcomes_final:     {result['events']['outcomes_final']}")
+        print(f"  smooth_rep:         {result['agents']['smooth_rep']}")
+        print(f"  certainty:          {result['certainty']:.6f}")
+        print(f"  participation:      {result['participation']:.6f}")
+        print(f"  convergence:        {result['convergence']} "
+              f"({result['iterations']} iteration(s))")

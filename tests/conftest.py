@@ -1,0 +1,30 @@
+"""Test configuration: force a deterministic 8-virtual-device CPU platform
+(SURVEY.md §4 — multi-chip behavior is tested on a simulated mesh via
+``--xla_force_host_platform_device_count``) and float64 so the jax backend can
+be compared tightly against the numpy reference. Must run before jax's first
+import anywhere in the test session."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# The session environment pins JAX_PLATFORMS to the real accelerator and a
+# sitecustomize hook pre-imports jax, so the env var alone is not enough —
+# tests must run on the simulated 8-device CPU mesh regardless (SURVEY.md §4),
+# forced via jax.config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

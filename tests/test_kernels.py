@@ -1,0 +1,313 @@
+"""Kernel-level unit tests: numpy reference semantics and numpy<->jax
+agreement (SURVEY.md §4 — method-level tests for the small pure functions)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.ops import jax_kernels as jk
+from pyconsensus_tpu.ops import numpy_kernels as nk
+
+
+def random_reports(rng, R=12, E=7, na_frac=0.2, scaled_frac=0.3):
+    reports = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+    scaled = rng.random(E) < scaled_frac
+    mins = np.where(scaled, -2.0, 0.0)
+    maxs = np.where(scaled, 3.0, 1.0)
+    raw_scaled = rng.uniform(-2.0, 3.0, size=(R, E))
+    reports = np.where(scaled[None, :], raw_scaled, reports)
+    na = rng.random((R, E)) < na_frac
+    # keep at least one report per column
+    na[rng.integers(0, R), :] = False
+    reports = np.where(na, np.nan, reports)
+    rep = rng.random(R) + 0.1
+    rep = rep / rep.sum()
+    return reports, rep, scaled, mins, maxs
+
+
+class TestCatch:
+    def test_boundaries(self):
+        tol = 0.1
+        assert nk.catch(0.39, tol) == 0.0
+        assert nk.catch(0.40, tol) == 0.5   # not strictly below 0.5 - tol
+        assert nk.catch(0.5, tol) == 0.5
+        assert nk.catch(0.60, tol) == 0.5
+        assert nk.catch(0.61, tol) == 1.0
+
+    def test_elementwise_and_jax_match(self):
+        xs = np.linspace(-0.2, 1.2, 57)
+        for tol in (0.0, 0.1, 0.25):
+            a = nk.catch(xs, tol)
+            b = np.asarray(jk.catch(jnp.asarray(xs), tol))
+            np.testing.assert_array_equal(a, b)
+
+
+class TestNormalize:
+    def test_sums_to_one(self):
+        v = np.array([1.0, 2.0, 3.0])
+        assert nk.normalize(v).sum() == pytest.approx(1.0)
+
+    def test_negative_sum_orientation(self):
+        v = np.array([-3.0, -1.0])   # the set2 orientation case
+        out = nk.normalize(v)
+        assert out.sum() == pytest.approx(1.0)
+        assert (out >= 0).all()
+
+    def test_zero_vector_unchanged(self):
+        v = np.zeros(4)
+        np.testing.assert_array_equal(nk.normalize(v), v)
+        np.testing.assert_array_equal(np.asarray(jk.normalize(jnp.zeros(4))),
+                                      np.zeros(4))
+
+    def test_jax_match(self, rng):
+        v = rng.normal(size=9)
+        np.testing.assert_allclose(np.asarray(jk.normalize(jnp.asarray(v))),
+                                   nk.normalize(v), rtol=1e-12)
+
+
+class TestRescale:
+    def test_round_trip(self, rng):
+        reports, rep, scaled, mins, maxs = random_reports(rng)
+        scaled[:] = True
+        mins[:] = -5.0
+        maxs[:] = 11.0
+        out = nk.rescale(reports, scaled, mins, maxs)
+        finite = ~np.isnan(reports)
+        assert np.nanmax(out) <= 1.0 + 1e-12 and np.nanmin(out) >= -1e-12
+        back = nk.unscale_outcomes(out, scaled, mins, maxs)
+        np.testing.assert_allclose(back[finite], reports[finite], rtol=1e-12)
+
+    def test_binary_passthrough_and_nan(self):
+        reports = np.array([[0.0, 2.0], [np.nan, 4.0]])
+        scaled = np.array([False, True])
+        out = nk.rescale(reports, scaled, np.array([0.0, 2.0]),
+                         np.array([1.0, 6.0]))
+        assert out[0, 0] == 0.0
+        assert out[0, 1] == pytest.approx(0.0)
+        assert out[1, 1] == pytest.approx(0.5)
+        assert np.isnan(out[1, 0])
+
+    def test_jax_match(self, rng):
+        reports, rep, scaled, mins, maxs = random_reports(rng)
+        a = nk.rescale(reports, scaled, mins, maxs)
+        b = np.asarray(jk.rescale(jnp.asarray(reports), jnp.asarray(scaled),
+                                  jnp.asarray(mins), jnp.asarray(maxs)))
+        np.testing.assert_allclose(a, b, rtol=1e-12, equal_nan=True)
+
+
+class TestInterpolate:
+    def test_weighted_mean_fill_binary_snap(self):
+        # column 0: reporters 0,1 report {1, 1} with rep {.5, .25}; missing
+        # entry fills with catch(weighted mean)=1. column 1 scaled: raw mean.
+        reports = np.array([[1.0, 2.0],
+                            [1.0, np.nan],
+                            [np.nan, 4.0]])
+        rep = np.array([0.5, 0.25, 0.25])
+        scaled = np.array([False, True])
+        filled = nk.interpolate(reports, rep, scaled, 0.1)
+        assert filled[2, 0] == 1.0
+        # scaled fill: (0.5*2 + 0.25*4) / 0.75 = 8/3
+        assert filled[1, 1] == pytest.approx(8.0 / 3.0)
+
+    def test_ambiguous_fill_snaps_to_half(self):
+        reports = np.array([[1.0], [0.0], [np.nan]])
+        rep = np.array([0.5, 0.5, 0.0])
+        filled = nk.interpolate(reports, rep, np.array([False]), 0.1)
+        assert filled[2, 0] == 0.5
+
+    def test_no_nan_passthrough(self, rng):
+        reports, rep, scaled, mins, maxs = random_reports(rng, na_frac=0.0)
+        filled = nk.interpolate(reports, rep, scaled, 0.1)
+        np.testing.assert_array_equal(filled, reports)
+
+    def test_jax_match(self, rng):
+        reports, rep, scaled, mins, maxs = random_reports(rng)
+        rescaled = nk.rescale(reports, scaled, mins, maxs)
+        a = nk.interpolate(rescaled, rep, scaled, 0.1)
+        b = np.asarray(jk.interpolate(jnp.asarray(rescaled), jnp.asarray(rep),
+                                      jnp.asarray(scaled), 0.1))
+        np.testing.assert_allclose(a, b, rtol=1e-12)
+
+
+class TestWeightedCov:
+    def test_against_manual(self, rng):
+        X = rng.random((6, 4))
+        rep = nk.normalize(rng.random(6) + 0.1)
+        cov, dev = nk.weighted_cov(X, rep)
+        mu = rep @ X
+        np.testing.assert_allclose(dev, X - mu, rtol=1e-12)
+        manual = np.zeros((4, 4))
+        for i in range(6):
+            manual += rep[i] * np.outer(X[i] - mu, X[i] - mu)
+        manual /= 1.0 - np.sum(rep ** 2)
+        np.testing.assert_allclose(cov, manual, rtol=1e-10)
+
+    def test_jax_match(self, rng):
+        X = rng.random((6, 4))
+        rep = nk.normalize(rng.random(6) + 0.1)
+        cov_np, dev_np = nk.weighted_cov(X, rep)
+        cov_j, dev_j = jk.weighted_cov(jnp.asarray(X), jnp.asarray(rep))
+        np.testing.assert_allclose(np.asarray(cov_j), cov_np, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(dev_j), dev_np, rtol=1e-12)
+
+
+def _align_sign(v, ref):
+    return v if np.dot(v, ref) >= 0 else -v
+
+
+class TestWeightedPrinComp:
+    def test_loading_is_top_eigvec(self, rng):
+        X = rng.random((8, 5))
+        rep = nk.normalize(rng.random(8) + 0.1)
+        loading, scores = nk.weighted_prin_comp(X, rep)
+        cov, dev = nk.weighted_cov(X, rep)
+        w, V = np.linalg.eigh(cov)
+        top = V[:, -1]
+        np.testing.assert_allclose(_align_sign(loading, top), top, rtol=1e-8)
+        np.testing.assert_allclose(scores, dev @ loading, rtol=1e-12)
+
+    @pytest.mark.parametrize("method", ["eigh-cov", "eigh-gram", "power"])
+    def test_jax_methods_agree_up_to_sign(self, rng, method):
+        X = rng.random((10, 6))
+        rep = nk.normalize(rng.random(10) + 0.1)
+        load_np, _ = nk.weighted_prin_comp(X, rep)
+        load_j, scores_j = jk.weighted_prin_comp(jnp.asarray(X),
+                                                 jnp.asarray(rep),
+                                                 method=method)
+        load_j = np.asarray(load_j)
+        np.testing.assert_allclose(_align_sign(load_j, load_np), load_np,
+                                   rtol=0, atol=5e-6)
+
+    def test_multi_component_explained_variance(self, rng):
+        X = rng.random((9, 5))
+        rep = nk.normalize(rng.random(9) + 0.1)
+        loadings, scores, explained = nk.weighted_prin_comps(X, rep, 3)
+        assert explained.shape == (3,)
+        assert np.all(np.diff(explained) <= 1e-12)  # descending
+        assert explained.sum() <= 1.0 + 1e-9
+        lj, sj, ej = jk.weighted_prin_comps(jnp.asarray(X), jnp.asarray(rep), 3)
+        np.testing.assert_allclose(np.asarray(ej), explained, atol=1e-8)
+        lj, ej2 = np.asarray(lj), np.asarray(ej)
+        for c in range(3):
+            np.testing.assert_allclose(_align_sign(lj[:, c], loadings[:, c]),
+                                       loadings[:, c], atol=1e-6)
+
+    def test_gram_matches_cov_method(self, rng):
+        X = rng.random((7, 20))
+        rep = nk.normalize(rng.random(7) + 0.1)
+        l_cov, s_cov = jk.weighted_prin_comp(jnp.asarray(X), jnp.asarray(rep),
+                                             method="eigh-cov")
+        l_gram, s_gram = jk.weighted_prin_comp(jnp.asarray(X), jnp.asarray(rep),
+                                               method="eigh-gram")
+        l_cov, l_gram = np.asarray(l_cov), np.asarray(l_gram)
+        np.testing.assert_allclose(_align_sign(l_gram, l_cov), l_cov, atol=1e-8)
+
+
+class TestWeightedMedian:
+    def test_simple(self):
+        assert nk.weighted_median(np.array([1.0, 2.0, 3.0]),
+                                  np.array([1.0, 1.0, 1.0])) == 2.0
+
+    def test_weight_dominant(self):
+        assert nk.weighted_median(np.array([1.0, 2.0, 3.0]),
+                                  np.array([10.0, 1.0, 1.0])) == 1.0
+
+    def test_exact_half_midpoint(self):
+        # cumulative weight hits exactly 0.5 at value 1 -> midpoint with 2
+        assert nk.weighted_median(np.array([1.0, 2.0]),
+                                  np.array([0.5, 0.5])) == 1.5
+
+    def test_jax_columns_match(self, rng):
+        R, E = 11, 6
+        values = rng.random((R, E))
+        weights = rng.random((R, E))
+        present = rng.random((R, E)) < 0.8
+        present[0, :] = True
+        expected = np.array([
+            nk.weighted_median(values[present[:, j], j],
+                               weights[present[:, j], j])
+            for j in range(E)
+        ])
+        got = np.asarray(jk.weighted_median_cols(jnp.asarray(values),
+                                                 jnp.asarray(weights),
+                                                 jnp.asarray(present)))
+        np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+    def test_exact_half_midpoint_jax(self):
+        values = jnp.array([[1.0], [2.0]])
+        weights = jnp.array([[0.5], [0.5]])
+        present = jnp.ones((2, 1), dtype=bool)
+        got = np.asarray(jk.weighted_median_cols(values, weights, present))
+        assert got[0] == 1.5
+
+
+class TestDirectionFix:
+    def test_majority_orientation(self):
+        # 4 honest (agree), 2 liars: direction fix must give honest reporters
+        # the higher adjusted scores once reweighted
+        X = np.array([[1.0, 1, 0, 0]] * 4 + [[0.0, 0, 1, 1]] * 2)
+        rep = np.full(6, 1 / 6)
+        adj = nk.direction_fixed_scores(
+            nk.weighted_prin_comp(X, rep)[1], X, rep)
+        this_rep = nk.row_reward_weighted(adj, rep)
+        assert this_rep[:4].sum() > this_rep[4:].sum()
+
+    def test_jax_match(self, rng):
+        X = rng.choice([0.0, 0.5, 1.0], size=(8, 5))
+        rep = nk.normalize(rng.random(8) + 0.1)
+        _, scores = nk.weighted_prin_comp(X, rep)
+        adj_np = nk.direction_fixed_scores(scores, X, rep)
+        adj_j = np.asarray(jk.direction_fixed_scores(
+            jnp.asarray(scores), jnp.asarray(X), jnp.asarray(rep)))
+        np.testing.assert_allclose(adj_j, adj_np, rtol=0, atol=1e-10)
+
+
+class TestRowRewardSmooth:
+    def test_degenerate_unanimous(self):
+        rep = np.array([0.25, 0.25, 0.5])
+        out = nk.row_reward_weighted(np.zeros(3), rep)
+        np.testing.assert_array_equal(out, rep)
+        out_j = np.asarray(jk.row_reward_weighted(jnp.zeros(3),
+                                                  jnp.asarray(rep)))
+        np.testing.assert_array_equal(out_j, rep)
+
+    def test_smooth_blend(self):
+        a = np.array([1.0, 0.0])
+        b = np.array([0.0, 1.0])
+        np.testing.assert_allclose(nk.smooth(a, b, 0.1), [0.1, 0.9])
+        np.testing.assert_allclose(np.asarray(jk.smooth(jnp.asarray(a),
+                                                        jnp.asarray(b), 0.1)),
+                                   [0.1, 0.9])
+
+
+class TestResolveOutcomes:
+    def test_parity_random(self, rng):
+        for _ in range(5):
+            reports, rep, scaled, mins, maxs = random_reports(rng)
+            rescaled = nk.rescale(reports, scaled, mins, maxs)
+            filled = nk.interpolate(rescaled, rep, scaled, 0.1)
+            raw_np, adj_np = nk.resolve_outcomes(rescaled, filled, rep,
+                                                 scaled, 0.1)
+            raw_j, adj_j = jk.resolve_outcomes(jnp.asarray(rescaled),
+                                               jnp.asarray(filled),
+                                               jnp.asarray(rep),
+                                               jnp.asarray(scaled), 0.1)
+            np.testing.assert_allclose(np.asarray(raw_j), raw_np, rtol=1e-12)
+            # binary outcomes catch-snapped -> exact equality
+            np.testing.assert_array_equal(np.asarray(adj_j)[~scaled],
+                                          adj_np[~scaled])
+
+    def test_bonuses_parity(self, rng):
+        reports, rep, scaled, mins, maxs = random_reports(rng)
+        rescaled = nk.rescale(reports, scaled, mins, maxs)
+        filled = nk.interpolate(rescaled, rep, scaled, 0.1)
+        raw_np, adj_np = nk.resolve_outcomes(rescaled, filled, rep, scaled, 0.1)
+        e_np = nk.certainty_and_bonuses(rescaled, filled, rep, adj_np,
+                                        scaled, 0.1)
+        e_j = jk.certainty_and_bonuses(jnp.asarray(rescaled),
+                                       jnp.asarray(filled), jnp.asarray(rep),
+                                       jnp.asarray(adj_np),
+                                       jnp.asarray(scaled), 0.1)
+        for key, val in e_np.items():
+            np.testing.assert_allclose(np.asarray(e_j[key]), val, rtol=0,
+                                       atol=1e-10, err_msg=key)

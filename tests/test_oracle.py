@@ -1,0 +1,332 @@
+"""Oracle API tests: golden examples, the numpy<->jax parity harness
+(BASELINE.json north star — bit-identical binary outcomes), result-dict
+contract, and validation (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import ALGORITHMS, Oracle
+
+# The canonical Truthcoin whitepaper-style example: 6 reporters × 4 binary
+# events; reporters 0-3 form the honest majority, 4-5 answer inverted
+# (SURVEY.md §4 "canonical example").
+CANONICAL = np.array([
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 1.0, 1.0, 0.0],
+    [0.0, 0.0, 1.0, 1.0],
+    [0.0, 0.0, 1.0, 1.0],
+])
+
+MISSING = np.array([
+    [1.0, 1.0, 0.0, np.nan],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, np.nan, 0.0, 0.0],
+    [1.0, 1.0, np.nan, 0.0],
+    [np.nan, 0.0, 1.0, 1.0],
+    [0.0, 0.0, 1.0, 1.0],
+])
+
+SCALED_REPORTS = np.array([
+    [1.0, 0.5, 0.0, 233.0, 16027.59],
+    [1.0, 0.5, 0.0, 199.0, np.nan],
+    [1.0, 1.0, 0.0, 233.0, 16027.59],
+    [1.0, 0.5, 0.0, 250.0, 0.0],
+    [0.0, 0.5, 1.0, 435.8, 8001.0],
+    [0.0, 0.5, 1.0, 435.8, 19999.0],
+])
+SCALED_BOUNDS = [
+    None,
+    None,
+    None,
+    {"scaled": True, "min": 0.0, "max": 435.8},
+    {"scaled": True, "min": 0.0, "max": 20000.0},
+]
+
+
+def make_majority(rng, R=50, E=25, liars=10):
+    truth = rng.choice([0.0, 1.0], size=E)
+    reports = np.tile(truth, (R, 1))
+    flip = rng.random((R - liars, E)) < 0.1
+    reports[:R - liars] = np.abs(reports[:R - liars] - flip)
+    reports[R - liars:] = 1.0 - truth  # coordinated liars
+    return reports, truth
+
+
+class TestCanonical:
+    def test_majority_outcomes(self):
+        # events 1 and 2 are 3-vs-3 splits: a single redistribution pass
+        # under near-uniform reputation leaves them ambiguous (0.5) ...
+        result = Oracle(reports=CANONICAL).consensus()
+        np.testing.assert_array_equal(result["events"]["outcomes_final"],
+                                      [1.0, 0.5, 0.5, 0.0])
+        # ... while iterative redistribution concentrates reputation on the
+        # PCA-coherent honest cluster and resolves them (the Truthcoin
+        # "lie detector" working as intended)
+        result = Oracle(reports=CANONICAL, max_iterations=5).consensus()
+        np.testing.assert_array_equal(result["events"]["outcomes_final"],
+                                      [1.0, 1.0, 0.0, 0.0])
+
+    def test_liars_lose_reputation(self):
+        result = Oracle(reports=CANONICAL).consensus()
+        rep = result["agents"]["smooth_rep"]
+        assert rep.sum() == pytest.approx(1.0)
+        assert rep[:4].mean() > rep[4:].mean()
+
+    def test_reputation_simplex(self):
+        result = Oracle(reports=CANONICAL).consensus()
+        for key in ("old_rep", "this_rep", "smooth_rep"):
+            v = result["agents"][key]
+            assert (v >= -1e-12).all(), key
+            assert v.sum() == pytest.approx(1.0), key
+
+    def test_permutation_equivariance(self):
+        perm = np.array([3, 1, 5, 0, 2, 4])
+        base = Oracle(reports=CANONICAL).consensus()
+        permed = Oracle(reports=CANONICAL[perm]).consensus()
+        np.testing.assert_array_equal(base["events"]["outcomes_final"],
+                                      permed["events"]["outcomes_final"])
+        np.testing.assert_allclose(permed["agents"]["smooth_rep"],
+                                   base["agents"]["smooth_rep"][perm],
+                                   atol=1e-12)
+
+    def test_result_dict_contract(self):
+        result = Oracle(reports=CANONICAL).consensus()
+        assert set(result) == {"original", "filled", "agents", "events",
+                               "participation", "certainty", "convergence",
+                               "iterations"}
+        assert set(result["agents"]) == {
+            "old_rep", "this_rep", "smooth_rep", "na_row",
+            "participation_rows", "relative_part", "reporter_bonus"}
+        assert set(result["events"]) == {
+            "outcomes_raw", "consensus_reward", "certainty",
+            "participation_columns", "author_bonus", "outcomes_adjusted",
+            "outcomes_final", "adj_first_loadings"}
+        assert result["participation"] == pytest.approx(1.0)
+
+
+class TestMissing:
+    def test_filled_no_nan(self):
+        result = Oracle(reports=MISSING, max_iterations=10).consensus()
+        assert not np.isnan(result["filled"]).any()
+        np.testing.assert_array_equal(result["events"]["outcomes_final"],
+                                      [1.0, 1.0, 0.0, 0.0])
+
+    def test_participation_below_one(self):
+        result = Oracle(reports=MISSING).consensus()
+        assert result["participation"] < 1.0
+        assert result["agents"]["na_row"].sum() == 4
+
+
+class TestScaled:
+    def test_outcomes_in_bounds(self):
+        result = Oracle(reports=SCALED_REPORTS,
+                        event_bounds=SCALED_BOUNDS).consensus()
+        out = result["events"]["outcomes_final"]
+        assert 0.0 <= out[3] <= 435.8
+        assert 0.0 <= out[4] <= 20000.0
+        # scaled outcome is the rep-weighted median of honest cluster
+        np.testing.assert_array_equal(out[:3], [1.0, 0.5, 0.0])
+
+
+@pytest.mark.parametrize("backend_algo", [
+    ("sztorc", {}),
+    ("fixed-variance", {}),
+    ("ica", {}),
+    ("k-means", {}),
+    ("sztorc", {"max_iterations": 5}),
+    ("sztorc", {"pca_method": "eigh-gram"}),
+    ("sztorc", {"pca_method": "power"}),
+])
+class TestBackendParity:
+    """The north star: jax outcomes bit-identical to numpy on binary events
+    (catch-snapped), reputation equal to float tolerance."""
+
+    def _run(self, reports, algo, kwargs, backend, event_bounds=None):
+        return Oracle(reports=reports, event_bounds=event_bounds,
+                      algorithm=algo, backend=backend, **kwargs).consensus()
+
+    def test_binary_dense(self, rng, backend_algo):
+        algo, kwargs = backend_algo
+        reports, _ = make_majority(rng)
+        a = self._run(reports, algo, kwargs, "numpy")
+        b = self._run(reports, algo, kwargs, "jax")
+        np.testing.assert_array_equal(a["events"]["outcomes_final"],
+                                      b["events"]["outcomes_final"])
+        np.testing.assert_allclose(b["agents"]["smooth_rep"],
+                                   a["agents"]["smooth_rep"], atol=1e-8)
+        np.testing.assert_allclose(b["events"]["certainty"],
+                                   a["events"]["certainty"], atol=1e-8)
+
+    def test_missing_and_scaled(self, rng, backend_algo):
+        algo, kwargs = backend_algo
+        a = self._run(SCALED_REPORTS, algo, kwargs, "numpy", SCALED_BOUNDS)
+        b = self._run(SCALED_REPORTS, algo, kwargs, "jax", SCALED_BOUNDS)
+        scaled = np.array([bool(x and x.get("scaled")) for x in SCALED_BOUNDS])
+        np.testing.assert_array_equal(
+            a["events"]["outcomes_final"][~scaled],
+            b["events"]["outcomes_final"][~scaled])
+        np.testing.assert_allclose(b["events"]["outcomes_final"],
+                                   a["events"]["outcomes_final"], rtol=1e-8)
+        np.testing.assert_allclose(b["agents"]["smooth_rep"],
+                                   a["agents"]["smooth_rep"], atol=1e-8)
+
+
+class TestKmeansLowIterParity:
+    def test_unconverged_lloyd_matches_across_backends(self):
+        """Regression: labels must come from the *final* centroids in both
+        backends even when Lloyd hasn't converged within n_iters."""
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.models import clustering as cl
+        rng = np.random.default_rng(3)
+        X = rng.random((12, 6))
+        rep = np.full(12, 1 / 12)
+        a = cl.kmeans_conformity_np(X, rep, 3, n_iters=2)
+        b = np.asarray(cl.kmeans_conformity_jax(jnp.asarray(X),
+                                                jnp.asarray(rep), 3, n_iters=2))
+        np.testing.assert_allclose(b, a, atol=1e-12)
+
+
+class TestLoadingParity:
+    @pytest.mark.parametrize("algo", ["sztorc", "fixed-variance"])
+    def test_loading_sign_canonical_across_backends(self, rng, algo):
+        reports, _ = make_majority(rng)
+        a = Oracle(reports=reports, algorithm=algo,
+                   backend="numpy").consensus()
+        b = Oracle(reports=reports, algorithm=algo, backend="jax").consensus()
+        np.testing.assert_allclose(b["events"]["adj_first_loadings"],
+                                   a["events"]["adj_first_loadings"],
+                                   atol=1e-6)
+
+
+class TestHybridAlgorithms:
+    @pytest.mark.parametrize("algo", ["hierarchical", "dbscan"])
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_runs_and_detects_liars(self, rng, algo, backend):
+        reports, truth = make_majority(rng, R=20, E=10, liars=5)
+        kwargs = {"dbscan_eps": 1.0, "dbscan_min_samples": 2,
+                  "hierarchy_threshold": 1.5}
+        result = Oracle(reports=reports, algorithm=algo, backend=backend,
+                        **kwargs).consensus()
+        rep = result["agents"]["smooth_rep"]
+        assert rep.sum() == pytest.approx(1.0)
+        assert rep[:15].mean() > rep[15:].mean()
+
+    @pytest.mark.parametrize("algo", ["hierarchical", "dbscan"])
+    def test_backend_parity(self, rng, algo):
+        reports, _ = make_majority(rng, R=16, E=8, liars=4)
+        kwargs = {"dbscan_eps": 1.0, "hierarchy_threshold": 1.5}
+        a = Oracle(reports=reports, algorithm=algo, backend="numpy",
+                   **kwargs).consensus()
+        b = Oracle(reports=reports, algorithm=algo, backend="jax",
+                   **kwargs).consensus()
+        np.testing.assert_array_equal(a["events"]["outcomes_final"],
+                                      b["events"]["outcomes_final"])
+        np.testing.assert_allclose(b["agents"]["smooth_rep"],
+                                   a["agents"]["smooth_rep"], atol=1e-8)
+
+
+class TestValidation:
+    def test_requires_reports(self):
+        with pytest.raises(ValueError, match="reports"):
+            Oracle()
+
+    def test_rejects_1d(self):
+        with pytest.raises(ValueError, match="2-D"):
+            Oracle(reports=np.ones(5))
+
+    def test_rejects_bad_algorithm(self):
+        with pytest.raises(ValueError, match="algorithm"):
+            Oracle(reports=CANONICAL, algorithm="nope")
+
+    def test_rejects_bad_backend(self):
+        with pytest.raises(ValueError, match="backend"):
+            Oracle(reports=CANONICAL, backend="torch")
+
+    def test_rejects_bad_reputation(self):
+        with pytest.raises(ValueError, match="reputation"):
+            Oracle(reports=CANONICAL, reputation=np.ones(3))
+        with pytest.raises(ValueError, match="non-negative"):
+            Oracle(reports=CANONICAL, reputation=np.array([1, 1, 1, 1, 1, -1.0]))
+        with pytest.raises(ValueError, match="NaN"):
+            Oracle(reports=CANONICAL,
+                   reputation=np.array([1, np.nan, 1, 1, 1, 1.0]))
+
+    def test_rejects_bad_knobs(self):
+        with pytest.raises(ValueError, match="num_clusters"):
+            Oracle(reports=CANONICAL, algorithm="k-means", num_clusters=0)
+        with pytest.raises(ValueError, match="alpha"):
+            Oracle(reports=CANONICAL, alpha=1.5)
+        with pytest.raises(ValueError, match="dbscan_eps"):
+            Oracle(reports=CANONICAL, dbscan_eps=0.0)
+        with pytest.raises(ValueError, match="max_iterations"):
+            Oracle(reports=CANONICAL, max_iterations=0)
+
+    def test_rejects_bad_bounds(self):
+        bounds = [None, None, None, {"scaled": True, "min": 2.0, "max": 1.0}]
+        with pytest.raises(ValueError, match="max must exceed"):
+            Oracle(reports=CANONICAL, event_bounds=bounds)
+        with pytest.raises(ValueError, match="entries"):
+            Oracle(reports=CANONICAL, event_bounds=[None])
+
+    def test_algorithm_aliases(self):
+        o = Oracle(reports=CANONICAL, algorithm="kmeans")
+        assert o.params.algorithm == "k-means"
+        o = Oracle(reports=CANONICAL, algorithm="DBSCAN")
+        assert o.params.algorithm == "dbscan"
+
+    def test_nonuniform_reputation(self):
+        rep = np.array([10.0, 1, 1, 1, 1, 1])
+        result = Oracle(reports=CANONICAL, reputation=rep).consensus()
+        assert result["agents"]["old_rep"][0] == pytest.approx(10.0 / 15.0)
+
+
+class TestVerbose:
+    def test_prints_summary(self, capsys):
+        Oracle(reports=CANONICAL, verbose=True).consensus()
+        out = capsys.readouterr().out
+        assert "outcomes_final" in out
+        assert "sztorc" in out
+
+
+class TestConvergence:
+    def test_iterative_converges(self):
+        # reputation fully concentrates on the coherent cluster by ~240
+        # iterations, after which the update is a fixed point
+        result = Oracle(reports=CANONICAL, max_iterations=300).consensus()
+        assert result["convergence"]
+        assert 1 <= result["iterations"] < 300
+
+    def test_unanimous_converges_immediately(self):
+        reports = np.tile(np.array([1.0, 0.0, 1.0, 0.0]), (6, 1))
+        result = Oracle(reports=reports, max_iterations=10).consensus()
+        assert result["convergence"]
+        assert result["iterations"] == 1
+        np.testing.assert_array_equal(result["events"]["outcomes_final"],
+                                      [1.0, 0.0, 1.0, 0.0])
+        np.testing.assert_allclose(result["agents"]["smooth_rep"],
+                                   np.full(6, 1 / 6), atol=1e-12)
+
+    def test_single_iteration_no_convergence_claim(self):
+        r1 = Oracle(reports=CANONICAL, max_iterations=1).consensus()
+        assert r1["iterations"] == 1
+
+    def test_iterations_match_across_backends(self):
+        a = Oracle(reports=CANONICAL, max_iterations=50,
+                   backend="numpy").consensus()
+        b = Oracle(reports=CANONICAL, max_iterations=50,
+                   backend="jax").consensus()
+        assert a["iterations"] == b["iterations"]
+        assert a["convergence"] == b["convergence"]
+        np.testing.assert_allclose(b["agents"]["smooth_rep"],
+                                   a["agents"]["smooth_rep"], atol=1e-8)
+
+    def test_more_iterations_pushes_liar_rep_down(self, rng):
+        reports, _ = make_majority(rng, R=30, E=15, liars=8)
+        r1 = Oracle(reports=reports, max_iterations=1).consensus()
+        r20 = Oracle(reports=reports, max_iterations=20).consensus()
+        liar1 = r1["agents"]["smooth_rep"][22:].sum()
+        liar20 = r20["agents"]["smooth_rep"][22:].sum()
+        assert liar20 < liar1
