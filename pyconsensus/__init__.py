@@ -1,0 +1,13 @@
+"""Drop-in import alias for the reference library's package name.
+
+Code written against the reference (``from pyconsensus import Oracle``;
+``Oracle(reports=..., event_bounds=..., algorithm=...).consensus()``) works
+unchanged — it just runs on the TPU-native rebuild. The ``backend=`` kwarg
+(default ``"numpy"``, matching reference semantics exactly) opts into the
+jit-compiled JAX path.
+"""
+
+from pyconsensus_tpu import ALGORITHMS, BACKENDS, Oracle, __version__
+from pyconsensus_tpu.cli import main
+
+__all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "main", "__version__"]

@@ -5,6 +5,11 @@ Public surface:
 
 - :class:`Oracle` — the reference-compatible consensus engine with
   ``backend="numpy"|"jax"`` and the full ``algorithm=`` dispatch.
+- :mod:`pyconsensus_tpu.sim` — the Monte-Carlo collusion simulator
+  (one vmap-batched XLA call per sweep).
+- :mod:`pyconsensus_tpu.parallel` — device-mesh sharding for large oracles
+  (events sharded across chips, ICI collectives inserted by XLA).
+- :mod:`pyconsensus_tpu.utils` — phase timers and profiler hooks.
 """
 
 from .oracle import ALGORITHMS, BACKENDS, Oracle

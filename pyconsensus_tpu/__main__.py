@@ -1,0 +1,7 @@
+"""``python -m pyconsensus_tpu`` — CLI demo driver (SURVEY.md §2 #12)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,163 @@
+"""CLI demo driver — the rebuild of the reference's ``main()`` entry point
+(SURVEY.md §2 #12, §3.2: example / missing-data / scaled-data demo runs with
+pretty-printed agent and event tables), plus a ``--simulate`` mode exposing
+the Monte-Carlo collusion sweep (SURVEY.md §2 #13).
+
+Usage::
+
+    python -m pyconsensus_tpu --example
+    python -m pyconsensus_tpu --missing --scaled --backend jax
+    python -m pyconsensus_tpu --simulate --trials 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .oracle import ALGORITHMS, BACKENDS, Oracle
+
+# The canonical demo matrices (SURVEY.md §3.2: ~6 reporters × 4 events).
+EXAMPLE_REPORTS = np.array([
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 1.0, 1.0, 0.0],
+    [0.0, 0.0, 1.0, 1.0],
+    [0.0, 0.0, 1.0, 1.0],
+])
+
+MISSING_REPORTS = np.array([
+    [1.0, 1.0, 0.0, np.nan],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, np.nan, 0.0, 0.0],
+    [1.0, 1.0, np.nan, 0.0],
+    [np.nan, 0.0, 1.0, 1.0],
+    [0.0, 0.0, 1.0, 1.0],
+])
+
+SCALED_REPORTS = np.array([
+    [1.0, 1.0, 0.0, 0.0, 233.0, 16027.59],
+    [1.0, 0.0, 0.0, 0.0, 199.1, np.nan],
+    [1.0, 1.0, 0.0, 0.0, 233.0, 16027.59],
+    [1.0, 1.0, 1.0, 0.0, 250.0, 0.0],
+    [0.0, 0.0, 1.0, 1.0, 435.8, 8001.0],
+    [0.0, 0.0, 1.0, 1.0, 435.8, 19999.0],
+])
+SCALED_BOUNDS = [None, None, None, None,
+                 {"scaled": True, "min": 0.0, "max": 435.8},
+                 {"scaled": True, "min": 0.0, "max": 20000.0}]
+
+
+def _print_table(title: str, headers: Sequence[str], rows) -> None:
+    print(f"\n{title}")
+    widths = [max(len(h), 10) for h in headers]
+    print("  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for row in rows:
+        cells = [f"{v:.6f}" if isinstance(v, float) else str(v) for v in row]
+        print("  " + "  ".join(c.ljust(w) for c, w in zip(cells, widths)))
+
+
+def _run_demo(name: str, reports, bounds, args) -> None:
+    print(f"=== {name} ===")
+    oracle = Oracle(reports=reports, event_bounds=bounds,
+                    algorithm=args.algorithm, backend=args.backend,
+                    max_iterations=args.iterations)
+    result = oracle.consensus()
+    agents = result["agents"]
+    events = result["events"]
+    _print_table("Reporters", ["reporter", "old_rep", "smooth_rep", "bonus"],
+                 [(i, float(agents["old_rep"][i]),
+                   float(agents["smooth_rep"][i]),
+                   float(agents["reporter_bonus"][i]))
+                  for i in range(len(agents["old_rep"]))])
+    _print_table("Events", ["event", "outcome_raw", "outcome_final",
+                            "certainty"],
+                 [(j, float(events["outcomes_raw"][j]),
+                   float(events["outcomes_final"][j]),
+                   float(events["certainty"][j]))
+                  for j in range(len(events["outcomes_raw"]))])
+    print(f"\n  participation: {result['participation']:.6f}"
+          f"   certainty: {result['certainty']:.6f}"
+          f"   converged: {result['convergence']} "
+          f"({result['iterations']} iteration(s))\n")
+
+
+def _run_simulation(args) -> None:
+    from .sim import CollusionSimulator
+
+    # the simulator is always the vmap-batched jax pipeline — --backend
+    # applies to the demo runs only
+    print(f"=== Monte-Carlo collusion sweep "
+          f"({args.trials} trials/cell, batched jax pipeline) ===")
+    sim = CollusionSimulator(n_reporters=args.reporters,
+                             n_events=args.events,
+                             max_iterations=args.iterations,
+                             algorithm=args.algorithm)
+    lf = [0.0, 0.1, 0.2, 0.3, 0.4]
+    var = [0.0, 0.1, 0.2]
+    res = sim.run(lf, var, args.trials, seed=args.seed)
+    headers = ["liar_frac"] + [f"var={v:g}" for v in var]
+    rows = []
+    for i, f in enumerate(lf):
+        rows.append([f"{f:g}"] + [float(res["mean"]["correct_rate"][i, j])
+                                  for j in range(len(var))])
+    _print_table("Correct-outcome rate", headers, rows)
+    rows = [[f"{f:g}"] + [float(res["mean"]["liar_rep_share"][i, j])
+                          for j in range(len(var))]
+            for i, f in enumerate(lf)]
+    _print_table("Liar reputation share (post-resolution)", headers, rows)
+    print()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pyconsensus_tpu",
+        description="Truthcoin/Sztorc oracle consensus on TPU — demo driver")
+    ap.add_argument("-x", "--example", action="store_true",
+                    help="run the canonical 6x4 binary example")
+    ap.add_argument("-m", "--missing", action="store_true",
+                    help="run the example with missing (NaN) reports")
+    ap.add_argument("-s", "--scaled", action="store_true",
+                    help="run the example with scaled events + event_bounds")
+    ap.add_argument("--simulate", action="store_true",
+                    help="run a Monte-Carlo collusion sweep")
+    ap.add_argument("--algorithm", default="sztorc", choices=ALGORITHMS)
+    ap.add_argument("--backend", default="jax", choices=BACKENDS)
+    ap.add_argument("--iterations", type=int, default=5,
+                    help="max reputation-redistribution iterations")
+    ap.add_argument("--trials", type=int, default=100,
+                    help="simulation trials per grid cell")
+    ap.add_argument("--reporters", type=int, default=20)
+    ap.add_argument("--events", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    for name in ("iterations", "trials", "reporters", "events"):
+        if getattr(args, name) < 1:
+            ap.error(f"--{name} must be >= 1")
+    if args.simulate and args.algorithm in ("hierarchical", "dbscan"):
+        ap.error(f"--simulate requires a jit-compatible algorithm "
+                 f"(got {args.algorithm!r}); choose sztorc, fixed-variance, "
+                 f"ica, or k-means")
+
+    if not (args.example or args.missing or args.scaled or args.simulate):
+        args.example = True  # default demo, like the reference CLI
+
+    if args.example:
+        _run_demo("Example (dense binary)", EXAMPLE_REPORTS, None, args)
+    if args.missing:
+        _run_demo("Example with missing reports", MISSING_REPORTS, None, args)
+    if args.scaled:
+        _run_demo("Example with scaled events", SCALED_REPORTS,
+                  SCALED_BOUNDS, args)
+    if args.simulate:
+        _run_simulation(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,12 @@ class ConsensusParams(NamedTuple):
     dbscan_min_samples: int = 2
     pca_method: str = "auto"
     power_iters: int = 128
+    #: static shape-of-the-data flags, set by the Oracle from the host-side
+    #: matrix. They never change results — they let XLA skip whole phases
+    #: (the NA fill pass, the per-column median sort, rescaling) when the
+    #: data provably doesn't need them, which matters at 10k × 100k scale.
+    any_scaled: bool = True
+    has_na: bool = True
 
 
 def _scores_np(filled, rep, p: ConsensusParams):
@@ -184,16 +190,23 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
 
 
 def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
-    """Whole-pipeline XLA graph: one compiled program per (shape, params)."""
+    """Whole-pipeline XLA graph: one compiled program per (shape, params).
+    The static ``p.any_scaled`` / ``p.has_na`` hints elide the rescale, NA
+    fill, and median phases when the host knows the data can't need them —
+    at north-star scale each elided phase is a multi-GB HBM pass."""
     old_rep = jk.normalize(reputation)
-    rescaled = jk.rescale(reports, scaled, mins, maxs)
-    filled = jk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+    rescaled = jk.rescale(reports, scaled, mins, maxs) if p.any_scaled else reports
+    filled = (jk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+              if p.has_na else rescaled)
     rep, this_rep, loading, converged, iters = _iterate_jax(filled, old_rep, p)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
-        rescaled, filled, rep, scaled, p.catch_tolerance)
-    outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
+        rescaled, filled, rep, scaled, p.catch_tolerance,
+        any_scaled=p.any_scaled)
+    outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
+                      if p.any_scaled else outcomes_adjusted)
     extras = jk.certainty_and_bonuses(rescaled, filled, rep, outcomes_adjusted,
-                                      scaled, p.catch_tolerance)
+                                      scaled, p.catch_tolerance,
+                                      has_na=p.has_na)
     result = {
         "original": reports,
         "rescaled": rescaled,
@@ -201,7 +214,8 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
         "old_rep": old_rep,
         "this_rep": this_rep,
         "smooth_rep": rep,
-        "na_row": jnp.isnan(reports).any(axis=1),
+        "na_row": (jnp.isnan(reports).any(axis=1) if p.has_na
+                   else jnp.zeros((reports.shape[0],), dtype=bool)),
         "outcomes_raw": outcomes_raw,
         "outcomes_adjusted": outcomes_adjusted,
         "outcomes_final": outcomes_final,
@@ -215,6 +229,24 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
 
 
 consensus_jit = jax.jit(_consensus_core, static_argnames=("p",))
+
+#: keys whose values are (R, E)-sized — everything else is O(R) or O(E)
+_LARGE_RESULT_KEYS = ("original", "rescaled", "filled")
+
+
+def _consensus_core_light(reports, reputation, scaled, mins, maxs,
+                          p: ConsensusParams):
+    """Pipeline variant whose outputs exclude the (R, E)-sized matrices.
+    At 10k reporters × 100k events each omitted output is a 4 GB HBM buffer;
+    XLA dead-code-eliminates whatever only fed those outputs. Used by the
+    benchmark and the sharded front-end."""
+    result = _consensus_core(reports, reputation, scaled, mins, maxs, p)
+    for key in _LARGE_RESULT_KEYS:
+        result.pop(key)
+    return result
+
+
+consensus_light_jit = jax.jit(_consensus_core_light, static_argnames=("p",))
 
 
 def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
@@ -252,7 +284,8 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
 
     rep_dev = jnp.asarray(rep, dtype=filled.dtype)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
-        rescaled, filled, rep_dev, scaled, p.catch_tolerance)
+        rescaled, filled, rep_dev, scaled, p.catch_tolerance,
+        any_scaled=p.any_scaled)
     outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
     extras = jk.certainty_and_bonuses(rescaled, filled, rep_dev,
                                       outcomes_adjusted, scaled,

@@ -195,11 +195,15 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
     """Top-k components + explained-variance fractions for the
     ``fixed-variance`` variant (numpy_kernels.weighted_prin_comps). Uses the
     E×E eigh for small E, else the Gram trick (the full nonzero spectrum lives
-    in the R×R Gram matrix)."""
+    in the R×R Gram matrix). ``"power"`` is a first-component-only strategy,
+    so multi-component extraction treats it as ``"auto"`` — the Gram path is
+    the scalable exact option here (O(R²) memory, never E×E)."""
     dev, denom = _center(reports_filled, reputation)
     R, E = reports_filled.shape
-    if method == "auto":
+    if method in ("auto", "power"):
         method = "eigh-cov" if E <= 1024 else "eigh-gram"
+    if method not in ("eigh-cov", "eigh-gram"):
+        raise ValueError(f"unknown PCA method: {method!r}")
     if method == "eigh-cov":
         cov = (dev * reputation[:, None]).T @ dev / denom
         eigvals, eigvecs = jnp.linalg.eigh(cov)
@@ -281,10 +285,17 @@ def smooth(this_rep, old_rep, alpha):
     return alpha * this_rep + (1.0 - alpha) * old_rep
 
 
-def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance):
+def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance,
+                     any_scaled: bool = True):
     """Vectorized outcome resolution (numpy_kernels.resolve_outcomes):
     participation-restricted renormalized reputation; weighted mean for binary
-    columns, weighted median for scaled; catch-snap binary outcomes."""
+    columns, weighted median for scaled; catch-snap binary outcomes.
+
+    ``any_scaled`` is a *static* hint: when False (host knows every event is
+    binary) the per-column weighted-median sort — the only O(R log R * E)
+    phase of resolution — is skipped entirely instead of computed and
+    discarded by the ``where``.
+    """
     present = ~jnp.isnan(reports)
     w = smooth_rep[:, None] * present
     tw = jnp.sum(w, axis=0)
@@ -293,20 +304,31 @@ def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance):
     full_total = jnp.sum(smooth_rep)
     full_mean = (smooth_rep @ reports_filled) / jnp.where(full_total == 0.0, 1.0, full_total)
     means = jnp.where(tw > 0.0, mean_present, full_mean)
-    medians = weighted_median_cols(reports_filled,
-                                   jnp.broadcast_to(smooth_rep[:, None], reports.shape),
-                                   present)
-    outcomes_raw = jnp.where(tw > 0.0, jnp.where(scaled, medians, means), means)
+    if any_scaled:
+        medians = weighted_median_cols(
+            reports_filled,
+            jnp.broadcast_to(smooth_rep[:, None], reports.shape), present)
+        outcomes_raw = jnp.where(tw > 0.0, jnp.where(scaled, medians, means),
+                                 means)
+    else:
+        outcomes_raw = means
     outcomes_adjusted = jnp.where(scaled, outcomes_raw, catch(outcomes_raw, tolerance))
     return outcomes_raw, outcomes_adjusted
 
 
 def certainty_and_bonuses(reports, reports_filled, smooth_rep, outcomes_adjusted,
-                          scaled, tolerance):
+                          scaled, tolerance, has_na: bool = True):
     """Certainty / participation / bonus accounting
     (numpy_kernels.certainty_and_bonuses). Binary agreement is exact equality
-    on catch-snapped {0, 0.5, 1} values, so it is dtype-independent."""
-    na_mat = jnp.isnan(reports).astype(reports_filled.dtype)
+    on catch-snapped {0, 0.5, 1} values, so it is dtype-independent.
+
+    ``has_na=False`` (static, host-known dense matrix) short-circuits the NA
+    accounting to its closed form — an all-zero ``na_mat`` makes
+    participation exactly 1 and every bonus collapse onto its base weight —
+    eliding an isnan sweep and two (R, E) contractions over the full matrix.
+    """
+    R, E = reports.shape
+    dtype = reports_filled.dtype
     agree = jnp.where(
         scaled[None, :],
         jnp.abs(reports_filled - outcomes_adjusted[None, :]) <= tolerance,
@@ -316,14 +338,25 @@ def certainty_and_bonuses(reports, reports_filled, smooth_rep, outcomes_adjusted
     consensus_reward = normalize(certainty)
     avg_certainty = jnp.mean(certainty)
 
-    participation_columns = 1.0 - smooth_rep @ na_mat
-    participation_rows = 1.0 - na_mat @ consensus_reward
-    percent_na = 1.0 - jnp.mean(participation_columns)
-
-    na_bonus_rows = normalize(participation_rows)
-    reporter_bonus = na_bonus_rows * percent_na + smooth_rep * (1.0 - percent_na)
-    na_bonus_cols = normalize(participation_columns)
-    author_bonus = na_bonus_cols * percent_na + consensus_reward * (1.0 - percent_na)
+    if has_na:
+        na_mat = jnp.isnan(reports).astype(dtype)
+        participation_columns = 1.0 - smooth_rep @ na_mat
+        participation_rows = 1.0 - na_mat @ consensus_reward
+        percent_na = 1.0 - jnp.mean(participation_columns)
+        na_bonus_rows = normalize(participation_rows)
+        reporter_bonus = (na_bonus_rows * percent_na
+                          + smooth_rep * (1.0 - percent_na))
+        na_bonus_cols = normalize(participation_columns)
+        author_bonus = (na_bonus_cols * percent_na
+                        + consensus_reward * (1.0 - percent_na))
+    else:
+        participation_columns = jnp.ones((E,), dtype=dtype)
+        participation_rows = jnp.ones((R,), dtype=dtype)
+        percent_na = jnp.asarray(0.0, dtype=dtype)
+        na_bonus_rows = jnp.full((R,), 1.0 / R, dtype=dtype)
+        reporter_bonus = smooth_rep
+        na_bonus_cols = jnp.full((E,), 1.0 / E, dtype=dtype)
+        author_bonus = consensus_reward
 
     return {
         "certainty": certainty,

@@ -29,7 +29,8 @@ import numpy as np
 from .models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
                               ConsensusParams, consensus_jax, consensus_np)
 
-__all__ = ["Oracle", "ALGORITHMS", "BACKENDS"]
+__all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "parse_event_bounds",
+           "assemble_result"]
 
 ALGORITHMS = tuple(JIT_ALGORITHMS) + tuple(HYBRID_ALGORITHMS)
 BACKENDS = ("numpy", "jax")
@@ -41,6 +42,68 @@ _ALGORITHM_ALIASES = {
     "kmeans": "k-means",
     "agglomerative": "hierarchical",
 }
+
+
+def parse_event_bounds(event_bounds, n_events: int):
+    """Parse the reference's ``event_bounds`` list (per-event
+    ``{"scaled": bool, "min": float, "max": float}`` dicts, ``None`` = binary)
+    into ``(scaled, mins, maxs)`` arrays. Shared by :class:`Oracle` and the
+    sharded front-end."""
+    scaled = np.zeros(n_events, dtype=bool)
+    mins = np.zeros(n_events, dtype=np.float64)
+    maxs = np.ones(n_events, dtype=np.float64)
+    if event_bounds is None:
+        return scaled, mins, maxs
+    if len(event_bounds) != n_events:
+        raise ValueError(f"event_bounds has {len(event_bounds)} "
+                         f"entries for {n_events} events")
+    for j, b in enumerate(event_bounds):
+        if b is None:
+            continue
+        scaled[j] = bool(b.get("scaled", False))
+        mins[j] = float(b.get("min", 0.0))
+        maxs[j] = float(b.get("max", 1.0))
+        if scaled[j] and maxs[j] <= mins[j]:
+            raise ValueError(f"event {j}: max must exceed min "
+                             f"for a scaled event")
+    return scaled, mins, maxs
+
+
+def assemble_result(raw: dict) -> dict:
+    """Build the reference-shaped nested result dict (SURVEY.md §2 #11) from
+    a flat backend result. The (R, E)-sized keys (``original``, ``filled``)
+    are included only when present — the sharded/light path deliberately
+    never brings them to host."""
+    result = {
+        "agents": {
+            "old_rep": raw["old_rep"],
+            "this_rep": raw["this_rep"],
+            "smooth_rep": raw["smooth_rep"],
+            "na_row": raw["na_row"],
+            "participation_rows": raw["participation_rows"],
+            "relative_part": raw["na_bonus_rows"],
+            "reporter_bonus": raw["reporter_bonus"],
+        },
+        "events": {
+            "outcomes_raw": raw["outcomes_raw"],
+            "consensus_reward": raw["consensus_reward"],
+            "certainty": raw["certainty"],
+            "participation_columns": raw["participation_columns"],
+            "author_bonus": raw["author_bonus"],
+            "outcomes_adjusted": raw["outcomes_adjusted"],
+            "outcomes_final": raw["outcomes_final"],
+        },
+        "participation": float(1.0 - raw["percent_na"]),
+        "certainty": float(raw["avg_certainty"]),
+        "convergence": bool(raw["convergence"]),
+        "iterations": int(raw["iterations"]),
+    }
+    for key in ("original", "filled"):
+        if key in raw:
+            result[key] = raw[key]
+    if "first_loading" in raw:
+        result["events"]["adj_first_loadings"] = raw["first_loading"]
+    return result
 
 
 class Oracle:
@@ -120,22 +183,7 @@ class Oracle:
                              f"choose from {BACKENDS}")
 
         self.event_bounds = event_bounds
-        scaled = np.zeros(n_events, dtype=bool)
-        mins = np.zeros(n_events, dtype=np.float64)
-        maxs = np.ones(n_events, dtype=np.float64)
-        if event_bounds is not None:
-            if len(event_bounds) != n_events:
-                raise ValueError(f"event_bounds has {len(event_bounds)} "
-                                 f"entries for {n_events} events")
-            for j, b in enumerate(event_bounds):
-                if b is None:
-                    continue
-                scaled[j] = bool(b.get("scaled", False))
-                mins[j] = float(b.get("min", 0.0))
-                maxs[j] = float(b.get("max", 1.0))
-                if scaled[j] and maxs[j] <= mins[j]:
-                    raise ValueError(f"event {j}: max must exceed min "
-                                     f"for a scaled event")
+        scaled, mins, maxs = parse_event_bounds(event_bounds, n_events)
         self.scaled, self.mins, self.maxs = scaled, mins, maxs
 
         if reputation is None:
@@ -169,6 +217,8 @@ class Oracle:
         self.backend = backend
         self.verbose = verbose
         self.params = ConsensusParams(
+            any_scaled=bool(scaled.any()),
+            has_na=bool(np.isnan(self.reports).any()),
             algorithm=algorithm,
             alpha=float(alpha),
             catch_tolerance=float(catch_tolerance),
@@ -200,36 +250,8 @@ class Oracle:
     def consensus(self) -> dict:
         """Resolve outcomes + reputation; returns the reference-shaped nested
         result dict (all values host numpy)."""
-        raw = self.resolve_raw()
-        raw = {k: np.asarray(v) for k, v in raw.items()}
-        result = {
-            "original": raw["original"],
-            "filled": raw["filled"],
-            "agents": {
-                "old_rep": raw["old_rep"],
-                "this_rep": raw["this_rep"],
-                "smooth_rep": raw["smooth_rep"],
-                "na_row": raw["na_row"],
-                "participation_rows": raw["participation_rows"],
-                "relative_part": raw["na_bonus_rows"],
-                "reporter_bonus": raw["reporter_bonus"],
-            },
-            "events": {
-                "outcomes_raw": raw["outcomes_raw"],
-                "consensus_reward": raw["consensus_reward"],
-                "certainty": raw["certainty"],
-                "participation_columns": raw["participation_columns"],
-                "author_bonus": raw["author_bonus"],
-                "outcomes_adjusted": raw["outcomes_adjusted"],
-                "outcomes_final": raw["outcomes_final"],
-            },
-            "participation": float(1.0 - raw["percent_na"]),
-            "certainty": float(raw["avg_certainty"]),
-            "convergence": bool(raw["convergence"]),
-            "iterations": int(raw["iterations"]),
-        }
-        if "first_loading" in raw:
-            result["events"]["adj_first_loadings"] = raw["first_loading"]
+        raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
+        result = assemble_result(raw)
         if self.verbose:
             self._print_summary(result)
         return result

@@ -1,0 +1,11 @@
+"""Device-mesh parallelism: event-axis sharding for large oracles (the
+long-context analogue, SURVEY.md §5) and batch sharding for sweeps.
+XLA/GSPMD inserts the ICI collectives; no hand-written communication."""
+
+from .mesh import (Mesh, NamedSharding, P, batch_event_sharding,
+                   event_sharding, make_mesh, replicated)
+from .sharded import ShardedOracle, sharded_consensus
+
+__all__ = ["make_mesh", "event_sharding", "batch_event_sharding",
+           "replicated", "Mesh", "NamedSharding", "P",
+           "ShardedOracle", "sharded_consensus"]

@@ -1,0 +1,60 @@
+"""Device-mesh construction helpers (SURVEY.md §2 "Parallelism components",
+§7 M5).
+
+The framework's parallel axes:
+
+- ``"event"`` — the scaling axis (the reference's 100k-event matrices held in
+  one process are exactly what breaks at target scale `[B]`): the (R, E)
+  reports matrix is sharded column-wise; every contraction over events
+  becomes a per-shard partial + an XLA-inserted all-reduce over ICI.
+- ``"batch"`` — embarrassingly parallel independent resolutions (the
+  Monte-Carlo sweep, multi-market resolution): pure data parallelism, no
+  cross-device traffic except the final metric gather.
+
+Meshes here are ordinary ``jax.sharding.Mesh`` objects: on a real pod the
+same code spans hosts (``jax.distributed.initialize`` + ``jax.devices()``),
+on CPU tests an ``--xla_force_host_platform_device_count=8`` simulated mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "event_sharding", "batch_event_sharding",
+           "replicated", "P", "Mesh", "NamedSharding"]
+
+
+def make_mesh(batch: int = 1, event: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(batch, event)`` mesh. ``event`` defaults to using every
+    remaining device. ``batch * event`` must divide the device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if event is None:
+        if n % batch != 0:
+            raise ValueError(f"batch={batch} does not divide {n} devices")
+        event = n // batch
+    if batch * event > n:
+        raise ValueError(f"mesh {batch}x{event} needs {batch * event} devices, "
+                         f"have {n}")
+    grid = np.asarray(devices[:batch * event]).reshape(batch, event)
+    return Mesh(grid, ("batch", "event"))
+
+
+def event_sharding(mesh: Mesh) -> NamedSharding:
+    """(R, E) matrix sharded over events, replicated over reporters."""
+    return NamedSharding(mesh, P(None, "event"))
+
+
+def batch_event_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, R, E) batch of matrices: batch axis over "batch", events over
+    "event" — data parallelism composed with the long-axis sharding."""
+    return NamedSharding(mesh, P("batch", None, "event"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,126 @@
+"""Event-sharded consensus: the 10k-reporter × 100k-event north star
+(BASELINE.json, SURVEY.md §7 M5).
+
+Approach: GSPMD, not hand-written collectives. The whole pipeline
+(``_consensus_core``) is already one jitted graph of matmuls, reductions, and
+elementwise ops; placing the reports matrix with an ``("event",)``-sharded
+``NamedSharding`` and letting XLA propagate is the idiomatic TPU equivalent
+of the reference's (nonexistent) distributed backend — XLA inserts the
+``psum`` partial-covariance reductions over ICI that SURVEY.md §5 calls for:
+
+- per-event phases (interpolate, weighted means, outcome resolution, catch)
+  touch only local columns — zero traffic;
+- the Gram matrix ``A A^T`` and the power-iteration matvec ``dev @ v``
+  contract over the sharded event axis — XLA emits an all-reduce of the
+  (R, R) / (R,) partials;
+- the O(R) reputation vectors and O(1) scalars are replicated.
+
+Use :func:`sharded_consensus` for one big oracle, or
+:class:`ShardedOracle` for the drop-in class API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..models.pipeline import ConsensusParams, consensus_light_jit
+from ..oracle import Oracle, assemble_result, parse_event_bounds
+from .mesh import Mesh, event_sharding, make_mesh, replicated
+
+__all__ = ["sharded_consensus", "ShardedOracle"]
+
+#: PCA methods that never materialize the E×E covariance and whose
+#: contractions ride the event axis (SURVEY.md §7 "hard parts")
+_SHARDABLE_PCA = ("eigh-gram", "power")
+#: algorithms needing the full top-k spectrum (first-PC-only power iteration
+#: cannot serve them; the R×R Gram eigh is their scalable exact path)
+_MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
+
+
+def _pick_pca_method(params: ConsensusParams, n_reporters: int) -> str:
+    if params.algorithm in _MULTI_COMPONENT_ALGOS:
+        return "eigh-gram"
+    if params.pca_method in _SHARDABLE_PCA:
+        return params.pca_method
+    # "auto"/"eigh-cov" on a sharded matrix would build E×E — never do that;
+    # closed-form Gram when R is small enough to eigh, matrix-free otherwise
+    return "eigh-gram" if n_reporters <= 4096 else "power"
+
+
+def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
+    """device_put the pipeline inputs with the event axis sharded: the
+    (R, E) matrix and all E-vectors split over "event", the O(R) reputation
+    replicated."""
+    jnp = jax.numpy
+    dtype = jnp.asarray(0.0).dtype
+    x_shard = event_sharding(mesh)
+    e_shard = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec("event"))
+    r_shard = replicated(mesh)
+    return (jax.device_put(jnp.asarray(reports, dtype=dtype), x_shard),
+            jax.device_put(jnp.asarray(reputation, dtype=dtype), r_shard),
+            jax.device_put(jnp.asarray(scaled, dtype=bool), e_shard),
+            jax.device_put(jnp.asarray(mins, dtype=dtype), e_shard),
+            jax.device_put(jnp.asarray(maxs, dtype=dtype), e_shard))
+
+
+def sharded_consensus(reports, reputation=None, event_bounds=None,
+                      mesh: Optional[Mesh] = None,
+                      params: Optional[ConsensusParams] = None):
+    """Resolve one large oracle with the events axis sharded over ``mesh``.
+
+    ``reports`` may be a host numpy array or an already-device-resident jax
+    array (e.g. generated on-device — avoids any 4 GB host round-trip at
+    north-star scale). Returns the light result dict (no (R, E) matrices),
+    outputs left on device.
+    """
+    mesh = mesh if mesh is not None else make_mesh(batch=1)
+    if reports.ndim != 2:
+        raise ValueError(f"reports must be 2-D, got shape {reports.shape}")
+    R, E = reports.shape
+
+    scaled, mins, maxs = parse_event_bounds(event_bounds, E)
+    p = params if params is not None else ConsensusParams()
+    is_host = isinstance(reports, np.ndarray)
+    p = p._replace(
+        pca_method=_pick_pca_method(p, R),
+        any_scaled=bool(scaled.any()),
+        # device-resident input: can't cheaply inspect for NaN on host — keep
+        # the fill pass unless the caller's params already opted out
+        has_na=bool(np.isnan(reports).any()) if is_host else p.has_na,
+    )
+    if reputation is None:
+        reputation = np.full((R,), 1.0 / R)
+    placed = _place_inputs(mesh, reports, reputation, scaled, mins, maxs)
+    return consensus_light_jit(*placed, p)
+
+
+class ShardedOracle(Oracle):
+    """Drop-in :class:`Oracle` that resolves with events sharded over a
+    device mesh. Constructor adds ``mesh=``; ``consensus()`` returns the
+    reference-shaped dict minus the (R, E) matrices (which at north-star
+    scale should never cross to host)."""
+
+    def __init__(self, *args, mesh: Optional[Mesh] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.backend != "jax":
+            raise ValueError("ShardedOracle requires backend='jax'")
+        if self.params.algorithm not in ("sztorc", "fixed-variance", "ica"):
+            raise ValueError("sharded resolution supports the PCA/ICA "
+                             "algorithms (clustering shards over batch via "
+                             "the simulator instead)")
+        self.mesh = mesh if mesh is not None else make_mesh(batch=1)
+        self.params = self.params._replace(
+            pca_method=_pick_pca_method(self.params, self.reports.shape[0]))
+
+    def resolve_raw(self):
+        placed = _place_inputs(self.mesh, self.reports, self.reputation,
+                               self.scaled, self.mins, self.maxs)
+        return consensus_light_jit(*placed, self.params)
+
+    def consensus(self) -> dict:
+        raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
+        return assemble_result(raw)

@@ -1,0 +1,156 @@
+"""Monte-Carlo collusion simulator (SURVEY.md §2 #13, §3.3; BASELINE.json
+config 5).
+
+The reference ran thousands of independent oracle resolutions in a Python
+triple loop over (liar_fraction × variance × seed). Here the whole sweep is a
+single batched XLA program: report generation is a pure function of
+``(key, liar_fraction, variance)``, the full resolution pipeline runs under
+``jax.vmap`` over the flattened grid, and only *scalar metrics per trial* ever
+leave the device — the (R, E) report matrices exist only inside the fused
+graph, so a 10k-trial sweep needs no more HBM than a handful of matrices.
+
+Threat model (mirroring the reference's simulator `[B]`):
+
+- **truth**: each event has a random binary ground truth.
+- **honest reporters** report the truth with per-entry flip probability
+  ``variance`` (the noise knob).
+- **liars** (each reporter independently with probability ``liar_fraction``):
+  - ``collude=True``: all liars report the *shared anti-truth* — the
+    coordinated attack PCA is supposed to catch;
+  - ``collude=False``: each liar reports uniform random noise.
+
+Metrics per trial: fraction of events resolved correctly / captured by the
+lie / left ambiguous (0.5), the liars' share of post-resolution reputation,
+and convergence of the iterative loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.pipeline import ConsensusParams, _iterate_jax
+from ..ops import jax_kernels as jk
+
+__all__ = ["CollusionSimulator", "simulate_grid", "generate_reports"]
+
+
+def generate_reports(key, liar_fraction, variance, n_reporters: int,
+                     n_events: int, collude: bool = True):
+    """Pure synthetic-report generator: ``(reports, truth, liar_mask)`` as a
+    function of the PRNG key and the two sweep knobs. Public so tests and
+    users can replay any trial's exact matrix through :class:`Oracle`."""
+    dtype = jnp.asarray(0.0).dtype
+    k_truth, k_liar, k_noise, k_lie = jax.random.split(key, 4)
+    truth = jax.random.bernoulli(k_truth, 0.5, (n_events,)).astype(dtype)
+    liar = jax.random.bernoulli(k_liar, liar_fraction, (n_reporters,))
+    flip = jax.random.bernoulli(k_noise, jnp.clip(variance, 0.0, 0.5),
+                                (n_reporters, n_events))
+    honest = jnp.abs(truth[None, :] - flip.astype(dtype))
+    if collude:
+        lie_reports = jnp.broadcast_to(1.0 - truth, (n_reporters, n_events))
+    else:
+        lie_reports = jax.random.bernoulli(k_lie, 0.5,
+                                           (n_reporters, n_events)).astype(dtype)
+    reports = jnp.where(liar[:, None], lie_reports, honest)
+    return reports, truth, liar
+
+
+def _trial_metrics(key, liar_fraction, variance, *, n_reporters: int,
+                   n_events: int, collude: bool, p: ConsensusParams):
+    """One oracle resolution on synthetic reports; returns scalars only."""
+    dtype = jnp.asarray(0.0).dtype
+    reports, truth, liar = generate_reports(key, liar_fraction, variance,
+                                            n_reporters, n_events, collude)
+
+    # dense binary reports: rescale/interpolate are identities, so the trial
+    # goes straight into the iterative scoring loop
+    rep0 = jnp.full((n_reporters,), 1.0 / n_reporters, dtype=dtype)
+    rep, _, _, converged, iters = _iterate_jax(reports, rep0, p)
+    scaled = jnp.zeros((n_events,), dtype=bool)
+    _, outcomes_adj = jk.resolve_outcomes(reports, reports, rep, scaled,
+                                          p.catch_tolerance, any_scaled=False)
+    liar_f = liar.astype(dtype)
+    return {
+        "correct_rate": jnp.mean((outcomes_adj == truth).astype(dtype)),
+        "capture_rate": jnp.mean((outcomes_adj == 1.0 - truth).astype(dtype)),
+        "ambiguous_rate": jnp.mean((outcomes_adj == 0.5).astype(dtype)),
+        "liar_rep_share": jnp.sum(rep * liar_f),
+        "liar_fraction_realized": jnp.mean(liar_f),
+        "converged": converged,
+        "iterations": iters,
+    }
+
+
+class CollusionSimulator:
+    """Batched Monte-Carlo collusion sweeps.
+
+    Parameters
+    ----------
+    n_reporters, n_events : trial matrix shape (static — one XLA program per
+        shape).
+    collude : shared-lie attack vs independent random liars.
+    algorithm, max_iterations, alpha, catch_tolerance, pca_method,
+    power_iters : consensus knobs, as on :class:`~pyconsensus_tpu.Oracle`.
+        ``pca_method="power"`` is the default here: power iteration is pure
+        matmuls, which batch perfectly under vmap on the MXU (batched eigh
+        does not).
+    """
+
+    def __init__(self, n_reporters: int = 20, n_events: int = 10,
+                 collude: bool = True, algorithm: str = "sztorc",
+                 max_iterations: int = 1, alpha: float = 0.1,
+                 catch_tolerance: float = 0.1, pca_method: str = "power",
+                 power_iters: int = 64):
+        if algorithm not in ("sztorc", "fixed-variance", "ica", "k-means"):
+            raise ValueError(
+                f"simulator requires a jit-compatible algorithm, got "
+                f"{algorithm!r}")
+        self.n_reporters = int(n_reporters)
+        self.n_events = int(n_events)
+        self.collude = bool(collude)
+        self.params = ConsensusParams(
+            algorithm=algorithm, alpha=float(alpha),
+            catch_tolerance=float(catch_tolerance),
+            max_iterations=int(max_iterations), pca_method=pca_method,
+            power_iters=int(power_iters), any_scaled=False, has_na=False)
+        trial = functools.partial(_trial_metrics,
+                                  n_reporters=self.n_reporters,
+                                  n_events=self.n_events,
+                                  collude=self.collude, p=self.params)
+        self._batched = jax.jit(jax.vmap(trial))
+
+    def run(self, liar_fractions: Sequence[float],
+            variances: Sequence[float], n_trials: int, seed: int = 0) -> dict:
+        """Sweep the (liar_fraction × variance × seed) grid in one batched
+        call. Returns a dict of host arrays shaped (L, V, T) per metric plus
+        ``"mean"``: per-cell averages shaped (L, V)."""
+        lf = np.asarray(liar_fractions, dtype=np.float64)
+        var = np.asarray(variances, dtype=np.float64)
+        L, V, T = len(lf), len(var), int(n_trials)
+        if L < 1 or V < 1 or T < 1:
+            raise ValueError("liar_fractions, variances, and n_trials must "
+                             "all be non-empty/positive")
+        grid_lf = np.repeat(lf, V * T)
+        grid_var = np.tile(np.repeat(var, T), L)
+        base = jax.random.key(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(L * V * T))
+        out = self._batched(keys, jnp.asarray(grid_lf), jnp.asarray(grid_var))
+        result = {k: np.asarray(v).reshape(L, V, T) for k, v in out.items()}
+        result["mean"] = {k: v.mean(axis=2) for k, v in result.items()}
+        result["liar_fractions"] = lf
+        result["variances"] = var
+        return result
+
+
+def simulate_grid(liar_fractions=(0.0, 0.1, 0.2, 0.3, 0.4),
+                  variances=(0.0, 0.1, 0.2), n_trials: int = 100,
+                  seed: int = 0, **kwargs) -> dict:
+    """Convenience one-call sweep (the reference's script entry point)."""
+    return CollusionSimulator(**kwargs).run(liar_fractions, variances,
+                                            n_trials, seed)

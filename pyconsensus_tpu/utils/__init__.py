@@ -1,0 +1,6 @@
+"""Observability helpers: phase timers and profiler hooks (the rebuild's
+answer to SURVEY.md §5 "tracing/profiling: absent in reference")."""
+
+from .profiling import PhaseTimer, trace
+
+__all__ = ["PhaseTimer", "trace"]

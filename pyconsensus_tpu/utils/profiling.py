@@ -1,0 +1,76 @@
+"""Lightweight observability: wall-clock phase timers and a jax.profiler
+wrapper (SURVEY.md §5 — the reference had only ``verbose`` prints; the
+rebuild adds structured timing and real TPU traces)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+__all__ = ["PhaseTimer", "trace"]
+
+
+class PhaseTimer:
+    """Accumulating named-phase wall-clock timer.
+
+    >>> timer = PhaseTimer()
+    >>> with timer.phase("pca"):
+    ...     ...
+    >>> timer.totals()
+    {'pca': 0.0123}
+
+    ``block=True`` (default) calls ``block_until_ready`` on the value the
+    body stores via :meth:`observe`, so asynchronous dispatch doesn't
+    attribute device time to the wrong phase.
+    """
+
+    def __init__(self) -> None:
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._pending = None
+
+    def observe(self, value):
+        """Mark a jax value whose completion the current phase should wait
+        on before stopping the clock."""
+        self._pending = value
+        return value
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block: bool = True) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block and self._pending is not None:
+                jax.block_until_ready(self._pending)
+                self._pending = None
+            elapsed = time.perf_counter() - start
+            self._totals[name] = self._totals.get(name, 0.0) + elapsed
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def totals(self) -> Dict[str, float]:
+        return dict(self._totals)
+
+    def means(self) -> Dict[str, float]:
+        return {k: v / self._counts[k] for k, v in self._totals.items()}
+
+    def report(self) -> str:
+        lines = [f"  {name:24s} {total * 1e3:10.3f} ms "
+                 f"({self._counts[name]} call(s))"
+                 for name, total in sorted(self._totals.items(),
+                                           key=lambda kv: -kv[1])]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` wrapper that no-ops when ``log_dir`` is None,
+    so callers can thread a ``--trace`` flag straight through."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,47 @@
+"""CLI demo driver tests (SURVEY.md §2 #12)."""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.cli import main
+
+
+class TestCli:
+    def test_default_runs_example(self, capsys):
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "Example (dense binary)" in out
+        assert "Reporters" in out and "Events" in out
+        assert "participation" in out
+
+    def test_all_demo_flags(self, capsys):
+        assert main(["--example", "--missing", "--scaled",
+                     "--backend", "numpy"]) == 0
+        out = capsys.readouterr().out
+        assert "missing reports" in out
+        assert "scaled events" in out
+
+    def test_short_flags(self, capsys):
+        assert main(["-x", "-m", "-s", "--iterations", "2"]) == 0
+        assert "scaled events" in capsys.readouterr().out
+
+    def test_algorithm_selection(self, capsys):
+        assert main(["--example", "--algorithm", "k-means"]) == 0
+        capsys.readouterr()
+
+    def test_simulate(self, capsys):
+        assert main(["--simulate", "--trials", "5",
+                     "--reporters", "10", "--events", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "Correct-outcome rate" in out
+        assert "Liar reputation share" in out
+
+    def test_bad_flag_exits_nonzero(self):
+        with pytest.raises(SystemExit):
+            main(["--algorithm", "nope"])
+
+    def test_scaled_outcomes_unscaled_in_output(self, capsys):
+        main(["--scaled", "--backend", "numpy"])
+        out = capsys.readouterr().out
+        # the 16027.59 weighted-median outcome appears un-rescaled
+        assert "16027.59" in out

@@ -1,0 +1,124 @@
+"""Multi-device sharding tests on the simulated 8-device CPU mesh
+(SURVEY.md §4: this is how "multi-node" is tested without a TPU pod).
+Key property: sharded resolution == single-device resolution."""
+
+import jax
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle
+from pyconsensus_tpu.models.pipeline import ConsensusParams
+from pyconsensus_tpu.parallel import (ShardedOracle, make_mesh,
+                                      sharded_consensus)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return make_mesh(batch=1, event=8)
+
+
+def make_reports(rng, R=32, E=64, na_frac=0.05):
+    truth = rng.choice([0.0, 1.0], size=E)
+    reports = np.tile(truth, (R, 1))
+    flip = rng.random((R - 6, E)) < 0.1
+    reports[:R - 6] = np.abs(reports[:R - 6] - flip)
+    reports[R - 6:] = 1.0 - truth
+    reports[rng.random((R, E)) < na_frac] = np.nan
+    return reports
+
+
+class TestShardedParity:
+    @pytest.mark.parametrize("pca_method", ["eigh-gram", "power"])
+    def test_sharded_equals_unsharded(self, rng, mesh8, pca_method):
+        reports = make_reports(rng)
+        unsharded = Oracle(reports=reports, backend="jax", max_iterations=3,
+                           pca_method=pca_method).consensus()
+        sharded = ShardedOracle(reports=reports, backend="jax",
+                                max_iterations=3, pca_method=pca_method,
+                                mesh=mesh8).consensus()
+        np.testing.assert_array_equal(
+            sharded["events"]["outcomes_final"],
+            unsharded["events"]["outcomes_final"])
+        np.testing.assert_allclose(sharded["agents"]["smooth_rep"],
+                                   unsharded["agents"]["smooth_rep"],
+                                   atol=1e-8)
+        np.testing.assert_allclose(sharded["events"]["certainty"],
+                                   unsharded["events"]["certainty"],
+                                   atol=1e-8)
+
+    def test_sharded_matches_numpy_reference(self, rng, mesh8):
+        """End-to-end: 8-way sharded jax == single-process numpy."""
+        reports = make_reports(rng)
+        reference = Oracle(reports=reports, backend="numpy",
+                           max_iterations=3).consensus()
+        sharded = ShardedOracle(reports=reports, backend="jax",
+                                max_iterations=3, mesh=mesh8).consensus()
+        np.testing.assert_array_equal(
+            sharded["events"]["outcomes_final"],
+            reference["events"]["outcomes_final"])
+        np.testing.assert_allclose(sharded["agents"]["smooth_rep"],
+                                   reference["agents"]["smooth_rep"],
+                                   atol=1e-8)
+
+    def test_scaled_events_sharded(self, rng, mesh8):
+        reports = make_reports(rng, E=16, na_frac=0.0)
+        bounds = [None] * 14 + [{"scaled": True, "min": 0.0, "max": 10.0}] * 2
+        reports[:, 14:] *= 10.0
+        mesh2 = make_mesh(batch=1, event=2)
+        unsharded = Oracle(reports=reports, event_bounds=bounds,
+                           backend="jax", pca_method="eigh-gram").consensus()
+        out = sharded_consensus(reports, event_bounds=bounds, mesh=mesh2,
+                                params=ConsensusParams(pca_method="eigh-gram"))
+        np.testing.assert_allclose(
+            np.asarray(out["outcomes_final"]),
+            unsharded["events"]["outcomes_final"], rtol=1e-8)
+
+    def test_functional_api_device_resident(self, rng, mesh8):
+        """sharded_consensus accepts a device array without host round-trip."""
+        import jax.numpy as jnp
+        reports = jnp.asarray(make_reports(rng, na_frac=0.0))
+        out = sharded_consensus(reports, mesh=mesh8,
+                                params=ConsensusParams(pca_method="power",
+                                                       has_na=False))
+        outcomes = np.asarray(out["outcomes_final"])
+        assert np.isin(outcomes, [0.0, 0.5, 1.0]).all()
+
+    def test_rejects_clustering(self, rng, mesh8):
+        with pytest.raises(ValueError, match="sharded"):
+            ShardedOracle(reports=make_reports(rng), backend="jax",
+                          algorithm="k-means", mesh=mesh8)
+        with pytest.raises(ValueError, match="backend"):
+            ShardedOracle(reports=make_reports(rng), backend="numpy",
+                          mesh=mesh8)
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self):
+        m = make_mesh(batch=2, event=4)
+        assert m.shape == {"batch": 2, "event": 4}
+        m = make_mesh(batch=2)
+        assert m.shape == {"batch": 2, "event": 4}
+
+    def test_bad_mesh(self):
+        with pytest.raises(ValueError):
+            make_mesh(batch=3)
+        with pytest.raises(ValueError):
+            make_mesh(batch=4, event=4)
+
+
+class TestGraftEntry:
+    def test_entry_compiles_and_runs(self):
+        import sys
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as ge
+        fn, args = ge.entry()
+        out = jax.jit(fn)(*args)
+        jax.block_until_ready(out)
+        outcomes = np.asarray(out["outcomes_final"])
+        assert np.isin(outcomes, [0.0, 0.5, 1.0]).all()
+
+    def test_dryrun_multichip_8(self, capsys):
+        import __graft_entry__ as ge
+        ge.dryrun_multichip(8)
+        assert "OK" in capsys.readouterr().out

@@ -1,0 +1,112 @@
+"""Monte-Carlo collusion simulator tests (BASELINE.json config 5,
+SURVEY.md §3.3)."""
+
+import jax
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle
+from pyconsensus_tpu.sim import CollusionSimulator, simulate_grid
+from pyconsensus_tpu.sim.collusion import generate_reports
+
+
+class TestGeneration:
+    def test_shapes_and_values(self):
+        key = jax.random.key(7)
+        reports, truth, liar = generate_reports(key, 0.3, 0.1, 15, 8)
+        assert reports.shape == (15, 8)
+        assert truth.shape == (8,)
+        assert liar.shape == (15,)
+        assert set(np.unique(np.asarray(reports))) <= {0.0, 1.0}
+
+    def test_no_liars_no_noise_reports_truth(self):
+        key = jax.random.key(3)
+        reports, truth, liar = generate_reports(key, 0.0, 0.0, 10, 6)
+        np.testing.assert_array_equal(np.asarray(reports),
+                                      np.tile(np.asarray(truth), (10, 1)))
+        assert not np.asarray(liar).any()
+
+    def test_colluding_liars_report_anti_truth(self):
+        key = jax.random.key(11)
+        reports, truth, liar = generate_reports(key, 0.99, 0.0, 10, 6)
+        liar = np.asarray(liar)
+        assert liar.any()
+        np.testing.assert_array_equal(
+            np.asarray(reports)[liar],
+            np.tile(1.0 - np.asarray(truth), (liar.sum(), 1)))
+
+
+class TestSweep:
+    @pytest.fixture(scope="class")
+    def sweep(self):
+        sim = CollusionSimulator(n_reporters=20, n_events=8,
+                                 max_iterations=5)
+        return sim.run(liar_fractions=[0.0, 0.2, 0.45],
+                       variances=[0.0, 0.1], n_trials=20, seed=0)
+
+    def test_shapes(self, sweep):
+        assert sweep["correct_rate"].shape == (3, 2, 20)
+        assert sweep["mean"]["correct_rate"].shape == (3, 2)
+
+    def test_no_liars_no_noise_perfect(self, sweep):
+        assert sweep["mean"]["correct_rate"][0, 0] == pytest.approx(1.0)
+        assert sweep["mean"]["liar_rep_share"][0, 0] == 0.0
+        assert sweep["mean"]["capture_rate"][0, 0] == 0.0
+
+    def test_oracle_resists_moderate_collusion(self, sweep):
+        # 20% colluding liars, no noise: consensus should still be correct
+        assert sweep["mean"]["correct_rate"][1, 0] > 0.95
+
+    def test_lie_detection_cuts_liar_reputation(self, sweep):
+        # liars' post-resolution rep share is below their population share
+        realized = sweep["mean"]["liar_fraction_realized"][1, 0]
+        assert sweep["mean"]["liar_rep_share"][1, 0] < 0.8 * realized
+
+    def test_more_liars_worse_outcomes(self, sweep):
+        correct = sweep["mean"]["correct_rate"]
+        assert correct[2, 0] <= correct[1, 0] + 1e-9
+        assert correct[2, 1] <= correct[0, 1] + 1e-9
+
+    def test_deterministic(self):
+        sim = CollusionSimulator(n_reporters=10, n_events=5)
+        a = sim.run([0.2], [0.1], 10, seed=4)
+        b = sim.run([0.2], [0.1], 10, seed=4)
+        np.testing.assert_array_equal(a["correct_rate"], b["correct_rate"])
+
+    def test_trial_replay_matches_oracle(self):
+        """A trial's metrics must equal running its exact report matrix
+        through the public Oracle (numpy backend) — the simulator is the same
+        pipeline, just batched."""
+        sim = CollusionSimulator(n_reporters=12, n_events=6,
+                                 max_iterations=3, pca_method="eigh-cov")
+        res = sim.run([0.25], [0.1], 4, seed=9)
+        base = jax.random.key(9)
+        for t in range(4):
+            key = jax.random.fold_in(base, t)  # L=V=1 -> flat index == t
+            reports, truth, liar = generate_reports(key, 0.25, 0.1, 12, 6)
+            r = Oracle(reports=np.asarray(reports), max_iterations=3,
+                       backend="numpy").consensus()
+            outcomes = r["events"]["outcomes_final"]
+            truth = np.asarray(truth)
+            assert res["correct_rate"][0, 0, t] == pytest.approx(
+                np.mean(outcomes == truth))
+            assert res["liar_rep_share"][0, 0, t] == pytest.approx(
+                r["agents"]["smooth_rep"][np.asarray(liar)].sum(), abs=1e-8)
+
+    def test_independent_liars_mode(self):
+        res = simulate_grid(liar_fractions=[0.3], variances=[0.05],
+                            n_trials=10, seed=2, collude=False,
+                            n_reporters=16, n_events=8, max_iterations=3)
+        assert res["mean"]["correct_rate"][0, 0] > 0.9
+
+    def test_rejects_hybrid_algorithms(self):
+        with pytest.raises(ValueError, match="jit-compatible"):
+            CollusionSimulator(algorithm="dbscan")
+
+    def test_10k_trials_single_call(self):
+        """Config 5 scale: 10k trials in one batched call (CPU-sized)."""
+        sim = CollusionSimulator(n_reporters=10, n_events=5, power_iters=16)
+        res = sim.run(np.linspace(0.0, 0.4, 5), [0.0, 0.1, 0.2], 667, seed=1)
+        total = np.prod(res["correct_rate"].shape)
+        assert total == 5 * 3 * 667  # 10,005 resolutions
+        assert np.isfinite(res["correct_rate"]).all()
