@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .models.pipeline import JIT_ALGORITHMS
 from .oracle import ALGORITHMS, BACKENDS, Oracle
 
 # The canonical demo matrices (SURVEY.md §3.2: ~6 reporters × 4 events).
@@ -139,10 +140,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     for name in ("iterations", "trials", "reporters", "events"):
         if getattr(args, name) < 1:
             ap.error(f"--{name} must be >= 1")
-    if args.simulate and args.algorithm in ("hierarchical", "dbscan"):
+    if args.simulate and args.algorithm not in JIT_ALGORITHMS:
         ap.error(f"--simulate requires a jit-compatible algorithm "
-                 f"(got {args.algorithm!r}); choose sztorc, fixed-variance, "
-                 f"ica, or k-means")
+                 f"(got {args.algorithm!r}); choose from "
+                 f"{', '.join(JIT_ALGORITHMS)}")
 
     if not (args.example or args.missing or args.scaled or args.simulate):
         args.example = True  # default demo, like the reference CLI

@@ -201,7 +201,7 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     rep, this_rep, loading, converged, iters = _iterate_jax(filled, old_rep, p)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         rescaled, filled, rep, scaled, p.catch_tolerance,
-        any_scaled=p.any_scaled)
+        any_scaled=p.any_scaled, has_na=p.has_na)
     outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
                       if p.any_scaled else outcomes_adjusted)
     extras = jk.certainty_and_bonuses(rescaled, filled, rep, outcomes_adjusted,
@@ -285,7 +285,7 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     rep_dev = jnp.asarray(rep, dtype=filled.dtype)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         rescaled, filled, rep_dev, scaled, p.catch_tolerance,
-        any_scaled=p.any_scaled)
+        any_scaled=p.any_scaled, has_na=p.has_na)
     outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
     extras = jk.certainty_and_bonuses(rescaled, filled, rep_dev,
                                       outcomes_adjusted, scaled,

@@ -286,24 +286,31 @@ def smooth(this_rep, old_rep, alpha):
 
 
 def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance,
-                     any_scaled: bool = True):
+                     any_scaled: bool = True, has_na: bool = True):
     """Vectorized outcome resolution (numpy_kernels.resolve_outcomes):
     participation-restricted renormalized reputation; weighted mean for binary
     columns, weighted median for scaled; catch-snap binary outcomes.
 
-    ``any_scaled`` is a *static* hint: when False (host knows every event is
-    binary) the per-column weighted-median sort — the only O(R log R * E)
-    phase of resolution — is skipped entirely instead of computed and
-    discarded by the ``where``.
+    ``any_scaled`` / ``has_na`` are *static* hints: when ``any_scaled`` is
+    False (host knows every event is binary) the per-column weighted-median
+    sort — the only O(R log R * E) phase of resolution — is skipped entirely;
+    when ``has_na`` is False the participation-restriction reduces to the
+    single full-reputation matvec (the mask is all-True), eliding an isnan
+    sweep and two (R, E) contractions.
     """
-    present = ~jnp.isnan(reports)
-    w = smooth_rep[:, None] * present
-    tw = jnp.sum(w, axis=0)
-    safe_tw = jnp.where(tw > 0.0, tw, 1.0)
-    mean_present = jnp.sum(w * reports_filled, axis=0) / safe_tw
     full_total = jnp.sum(smooth_rep)
     full_mean = (smooth_rep @ reports_filled) / jnp.where(full_total == 0.0, 1.0, full_total)
-    means = jnp.where(tw > 0.0, mean_present, full_mean)
+    if has_na:
+        present = ~jnp.isnan(reports)
+        w = smooth_rep[:, None] * present
+        tw = jnp.sum(w, axis=0)
+        safe_tw = jnp.where(tw > 0.0, tw, 1.0)
+        mean_present = jnp.sum(w * reports_filled, axis=0) / safe_tw
+        means = jnp.where(tw > 0.0, mean_present, full_mean)
+    else:
+        present = jnp.ones(reports.shape, dtype=bool)
+        tw = jnp.broadcast_to(full_total, reports.shape[1:])
+        means = full_mean
     if any_scaled:
         medians = weighted_median_cols(
             reports_filled,

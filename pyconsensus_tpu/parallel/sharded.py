@@ -123,4 +123,7 @@ class ShardedOracle(Oracle):
 
     def consensus(self) -> dict:
         raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
-        return assemble_result(raw)
+        result = assemble_result(raw)
+        if self.verbose:
+            self._print_summary(result)
+        return result

@@ -1,6 +1,6 @@
 """Monte-Carlo collusion simulation (BASELINE.json config 5): thousands of
 oracle resolutions as one vmap-batched XLA call."""
 
-from .collusion import CollusionSimulator, simulate_grid
+from .collusion import CollusionSimulator, generate_reports, simulate_grid
 
-__all__ = ["CollusionSimulator", "simulate_grid"]
+__all__ = ["CollusionSimulator", "generate_reports", "simulate_grid"]

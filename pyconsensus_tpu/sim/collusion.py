@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.pipeline import ConsensusParams, _iterate_jax
+from ..models.pipeline import JIT_ALGORITHMS, ConsensusParams, _iterate_jax
 from ..ops import jax_kernels as jk
 
 __all__ = ["CollusionSimulator", "simulate_grid", "generate_reports"]
@@ -73,7 +73,8 @@ def _trial_metrics(key, liar_fraction, variance, *, n_reporters: int,
     rep, _, _, converged, iters = _iterate_jax(reports, rep0, p)
     scaled = jnp.zeros((n_events,), dtype=bool)
     _, outcomes_adj = jk.resolve_outcomes(reports, reports, rep, scaled,
-                                          p.catch_tolerance, any_scaled=False)
+                                          p.catch_tolerance, any_scaled=False,
+                                          has_na=False)
     liar_f = liar.astype(dtype)
     return {
         "correct_rate": jnp.mean((outcomes_adj == truth).astype(dtype)),
@@ -106,10 +107,10 @@ class CollusionSimulator:
                  max_iterations: int = 1, alpha: float = 0.1,
                  catch_tolerance: float = 0.1, pca_method: str = "power",
                  power_iters: int = 64):
-        if algorithm not in ("sztorc", "fixed-variance", "ica", "k-means"):
+        if algorithm not in JIT_ALGORITHMS:
             raise ValueError(
-                f"simulator requires a jit-compatible algorithm, got "
-                f"{algorithm!r}")
+                f"simulator requires a jit-compatible algorithm "
+                f"{JIT_ALGORITHMS}, got {algorithm!r}")
         self.n_reporters = int(n_reporters)
         self.n_events = int(n_events)
         self.collude = bool(collude)
