@@ -61,6 +61,12 @@ class ConsensusParams(NamedTuple):
     dbscan_min_samples: int = 2
     pca_method: str = "auto"
     power_iters: int = 128
+    #: power-iteration early-exit tolerance (0 = machine-precision floor)
+    power_tol: float = 0.0
+    #: low-precision dtype name for the bandwidth-bound power-iteration
+    #: matvecs ("" = full precision; "bfloat16" halves the HBM traffic of
+    #: the dominant phase at north-star scale; outcomes stay catch-snapped)
+    matvec_dtype: str = ""
     #: static shape-of-the-data flags, set by the Oracle from the host-side
     #: matrix. They never change results — they let XLA skip whole phases
     #: (the NA fill pass, the per-column median sort, rescaling) when the
@@ -145,7 +151,8 @@ def _scores_jax(filled, rep, p: ConsensusParams):
     """JAX mirror of ``_scores_np``: ``(adj_scores, loading-or-None)``."""
     algo = p.algorithm
     if algo == "sztorc":
-        return sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters)
+        return sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters,
+                                 p.power_tol, p.matvec_dtype)
     if algo == "fixed-variance":
         return fixed_variance_scores_jax(filled, rep, p.variance_threshold,
                                          p.max_components, p.pca_method)

@@ -41,11 +41,14 @@ def sztorc_scores_np(reports_filled, reputation):
 
 
 def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
-                      power_iters=128):
+                      power_iters=128, power_tol=0.0, matvec_dtype=""):
     """Direction-fixed first-principal-component scores (jax); returns
     ``(adj_scores, loading)`` like the numpy mirror."""
     loading, scores = jk.weighted_prin_comp(reports_filled, reputation,
-                                            method=pca_method, power_iters=power_iters)
+                                            method=pca_method,
+                                            power_iters=power_iters,
+                                            power_tol=power_tol,
+                                            matvec_dtype=matvec_dtype)
     return jk.direction_fixed_scores(scores, reports_filled, reputation), loading
 
 

@@ -105,12 +105,18 @@ def weighted_cov(reports_filled, reputation):
     return cov, dev
 
 
-def _center(reports_filled, reputation):
+def _mu_denom(reports_filled, reputation):
+    """Weighted column means + the ``1 - sum(rep^2)`` unbiased-weight
+    denominator (zero-guarded) — the single definition of the weighting
+    convention shared by every PCA strategy."""
     mu = reputation @ reports_filled
-    dev = reports_filled - mu[None, :]
     denom = 1.0 - jnp.sum(reputation ** 2)
-    denom = jnp.where(denom == 0.0, 1.0, denom)
-    return dev, denom
+    return mu, jnp.where(denom == 0.0, 1.0, denom)
+
+
+def _center(reports_filled, reputation):
+    mu, denom = _mu_denom(reports_filled, reputation)
+    return reports_filled - mu[None, :], denom
 
 
 def _first_pc_eigh_cov(dev, denom, reputation):
@@ -135,58 +141,151 @@ def _first_pc_eigh_gram(dev, denom, reputation):
     return loading, dev @ loading
 
 
-def _first_pc_power(dev, denom, reputation, n_iters: int = 128):
-    """Matrix-free power iteration (SURVEY.md §7 route a): each step is two
-    sharded matvecs through the centered data, O(R*E), no E×E or R×R matrix.
-    Deterministic start: one implicit-covariance application to the ones
-    vector. Fixed trip count keeps the graph static."""
-    E = dev.shape[1]
+def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float):
+    """Shared power-iteration driver (used by the XLA matvec path below and
+    the fused Pallas path in ``pallas_kernels``): deterministic start — one
+    implicit-covariance application to the ones vector — then a
+    ``lax.while_loop`` that stops once successive (normalized) iterates
+    align to ``|<v_k, v_{k-1}>| >= 1 - max(tol, 8*eps(dtype))``. With a
+    strong first-eigenvalue gap (the coordinated-collusion signal PCA
+    exists to detect) this converges in a handful of steps, and each
+    avoided step is a full HBM sweep of the (R, E) matrix at north-star
+    scale. The machine-epsilon floor means ``tol=0`` stops once per-step
+    improvement falls below float noise — the loading then differs from an
+    exhaustive run only by O(eps / eigengap); ``tol < 0`` disables the
+    early exit entirely (exactly ``n_iters`` sweeps — the testing
+    baseline). The
+    dynamic trip count is jit/vmap/GSPMD-compatible (vmapped lanes run
+    until all converge). Returns the unit-norm loading (sign arbitrary).
+    """
+    no_exit = tol < 0
+    tol = max(float(tol), 8.0 * float(jnp.finfo(dtype).eps))
 
-    def apply_cov(v):
-        t = dev @ v                                    # (R,)  contracts over E
-        return dev.T @ (reputation * t) / denom        # (E,)  contracts over R
-
-    v0 = apply_cov(jnp.ones((E,), dtype=dev.dtype))
+    v0 = apply_cov(jnp.ones((E,), dtype=dtype))
     n0 = jnp.linalg.norm(v0)
-    v0 = jnp.where(n0 == 0.0, jnp.ones((E,), dtype=dev.dtype) / jnp.sqrt(jnp.asarray(E, dev.dtype)), v0 / jnp.where(n0 == 0.0, 1.0, n0))
+    v0 = jnp.where(n0 == 0.0,
+                   jnp.ones((E,), dtype) / jnp.sqrt(jnp.asarray(E, dtype)),
+                   v0 / jnp.where(n0 == 0.0, 1.0, n0))
 
-    def body(_, v):
+    def cond(state):
+        i, _, done = state
+        return (i < n_iters) & ~done
+
+    def body(state):
+        i, v, _ = state
         w = apply_cov(v)
         n = jnp.linalg.norm(w)
-        return jnp.where(n == 0.0, v, w / jnp.where(n == 0.0, 1.0, n))
+        w = jnp.where(n == 0.0, v, w / jnp.where(n == 0.0, 1.0, n))
+        if no_exit:
+            done = jnp.asarray(False)
+        else:
+            done = jnp.abs(jnp.vdot(w, v)) >= 1.0 - tol
+        return i + 1, w, done
 
-    loading = lax.fori_loop(0, n_iters, body, v0)
-    return loading, dev @ loading
+    _, loading, _ = lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), v0, jnp.asarray(False)))
+    return loading
+
+
+def _first_pc_power(reports_filled, mu, denom, reputation,
+                    n_iters: int = 128, tol: float = 0.0, matvec_dtype=None):
+    """Matrix-free power iteration (SURVEY.md §7 route a): each step is two
+    sharded matvecs, O(R*E), no E×E or R×R matrix. Convergence/early-exit
+    semantics in :func:`_power_loop`.
+
+    Centering is matrix-free too: with D = X - 1 mu^T,
+
+        D v            = X v - (mu . v) 1
+        D^T (rep ⊙ t)  = X^T (rep ⊙ t) - mu * sum(rep ⊙ t)
+
+    so the centered matrix is never materialized — the matvecs stream the
+    *raw* filled reports, saving a full (R, E) write + read at north-star
+    scale, and ``matvec_dtype`` (e.g. ``jnp.bfloat16``) can keep the one
+    low-precision copy as the only large buffer for the bandwidth-bound
+    sweeps (f32 accumulation via ``preferred_element_type``; outcomes are
+    catch-snapped, so the loading noise stays far below the snap tolerance
+    — the parity-critical f64 path leaves it None).
+    """
+    out_dtype = reports_filled.dtype
+    mm = (reports_filled if matvec_dtype is None
+          else reports_filled.astype(matvec_dtype))
+    rep = reputation.astype(out_dtype)
+
+    def apply_cov(v):
+        t = jnp.matmul(mm, v.astype(mm.dtype),
+                       preferred_element_type=out_dtype) - mu @ v   # (R,)
+        rt = rep * t
+        y = (jnp.matmul(mm.T, rt.astype(mm.dtype),
+                        preferred_element_type=out_dtype)
+             - mu * jnp.sum(rt))                                    # (E,)
+        return y / denom
+
+    loading = _power_loop(apply_cov, reports_filled.shape[1], out_dtype,
+                          n_iters, tol)
+    scores = reports_filled @ loading - mu @ loading
+    return loading, scores
 
 
 def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
-                       power_iters: int = 128):
+                       power_iters: int = 128, power_tol: float = 0.0,
+                       matvec_dtype: str = ""):
     """First principal component of the reputation-weighted covariance
     (numpy_kernels.weighted_prin_comp). ``method``:
 
     - ``"eigh-cov"``  — explicit E×E eigh (parity path, small E);
     - ``"eigh-gram"`` — R×R Gram-trick eigh (exact, E-shardable);
-    - ``"power"``     — matrix-free power iteration (fully scalable);
+    - ``"power"``     — matrix-free power iteration (fully scalable), with
+      ``power_tol`` early exit and optional low-precision ``matvec_dtype``
+      (e.g. ``"bfloat16"``) for the bandwidth-bound sweeps;
+    - ``"power-fused"`` — power iteration through the Pallas row-panel
+      kernel (pallas_kernels.apply_weighted_cov): one HBM sweep per step
+      instead of two, centered matrix never materialized. Single-device
+      TPU path (runs interpreted elsewhere — tests only);
     - ``"auto"``      — picks by static shape: E<=1024 cov, else R<=4096 gram,
       else power.
 
     Returns ``(loading (E,), scores (R,))``; sign fixed downstream.
     """
-    dev, denom = _center(reports_filled, reputation)
     R, E = reports_filled.shape
     if method == "auto":
         if E <= 1024:
             method = "eigh-cov"
         elif R <= 4096:
             method = "eigh-gram"
+        elif jax.default_backend() == "tpu":
+            method = "power-fused"
         else:
             method = "power"
+    if method == "power-fused" and jax.default_backend() != "tpu" and R * E > (1 << 20):
+        # an explicit power-fused request off-TPU would run the Pallas
+        # *interpreter* — pathological beyond toy/test sizes; the XLA
+        # matvec path computes the same loading
+        method = "power"
+    if method == "power-fused":
+        from .pallas_kernels import power_iteration_fused
+
+        mu, denom = _mu_denom(reports_filled, reputation)
+        xmm = (reports_filled.astype(jnp.dtype(matvec_dtype))
+               if matvec_dtype else reports_filled)
+        loading = power_iteration_fused(
+            xmm, mu, denom, reputation, power_iters, power_tol,
+            interpret=jax.default_backend() != "tpu").astype(
+                reports_filled.dtype)
+        # scores = (X - mu) @ loading without materializing the centered
+        # matrix: X @ loading is one sweep; mu . loading is a scalar
+        scores = reports_filled @ loading - mu @ loading
+        return loading, scores
+    if method == "power":
+        mu, denom = _mu_denom(reports_filled, reputation)
+        return _first_pc_power(reports_filled, mu, denom, reputation,
+                               power_iters, tol=power_tol,
+                               matvec_dtype=(jnp.dtype(matvec_dtype)
+                                             if matvec_dtype else None))
+    dev, denom = _center(reports_filled, reputation)
     if method == "eigh-cov":
         return _first_pc_eigh_cov(dev, denom, reputation)
     if method == "eigh-gram":
         return _first_pc_eigh_gram(dev, denom, reputation)
-    if method == "power":
-        return _first_pc_power(dev, denom, reputation, power_iters)
     raise ValueError(f"unknown PCA method: {method!r}")
 
 
@@ -200,7 +299,7 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
     the scalable exact option here (O(R²) memory, never E×E)."""
     dev, denom = _center(reports_filled, reputation)
     R, E = reports_filled.shape
-    if method in ("auto", "power"):
+    if method in ("auto", "power", "power-fused"):
         method = "eigh-cov" if E <= 1024 else "eigh-gram"
     if method not in ("eigh-cov", "eigh-gram"):
         raise ValueError(f"unknown PCA method: {method!r}")

@@ -1,0 +1,142 @@
+"""Pallas TPU kernels for the consensus hot loop.
+
+The single hottest operation in the framework (SURVEY.md §3.5, §7 "hard
+parts") is the implicit-covariance application inside power iteration:
+
+    y = D^T (rep * (D v)) / denom,      D = X - mu   (R x E, centered)
+
+XLA computes this as two matvecs — ``t = D @ v`` then ``D.T @ (rep*t)`` —
+each a full HBM sweep of the (R, E) matrix, because dot operands must be
+materialized and the matrix exceeds VMEM by orders of magnitude. At the
+north-star scale (10k x 100k, 4 GB f32) that is 8 GB of HBM traffic per
+iteration, and the op is purely bandwidth-bound.
+
+:func:`apply_weighted_cov` halves that: a grid over *row panels* keeps each
+panel resident in VMEM and uses it for **both** contractions —
+
+    per panel i:   t_i = (X_i - mu) v          (panel read from HBM once)
+                   y  += (X_i - mu)^T (rep_i * t_i)
+
+TPU Pallas grid steps run sequentially on a core, so the (1, E) output block
+accumulates across steps (constant index map keeps it in VMEM). Centering
+happens in-register — the centered matrix D is never materialized at all,
+which also lets the caller keep ``X`` in bfloat16 (half the traffic again)
+while all arithmetic accumulates in f32.
+
+Padding contract: rows beyond R must be zero-filled and carry zero
+reputation if the caller pads R up to the panel size — padded rows then
+contribute exactly 0 to ``y`` (t on a zero row is finite, and rep=0 zeroes
+the second contraction). :func:`_pad_rows` does this.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["apply_weighted_cov", "power_iteration_fused"]
+
+#: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
+#: times this (double-buffered input + in-register f32 upcast)
+_PANEL_BYTES = 4 * 1024 * 1024
+
+
+def _panel_rows(n_events: int, itemsize: int) -> int:
+    """Rows per panel: ~_PANEL_BYTES big, multiple of 8 sublanes, >= 8."""
+    rows = max(1, _PANEL_BYTES // max(1, n_events * itemsize))
+    return max(8, (rows // 8) * 8)
+
+
+def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref):
+    """One row panel: both contractions off a single HBM read of the panel."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        y_ref[:] = jnp.zeros_like(y_ref)
+
+    xc = x_ref[:].astype(jnp.float32) - mu_ref[:]          # (T, E) centered
+    t = jnp.sum(xc * v_ref[:], axis=1, keepdims=True)      # (T, 1) = D_i v
+    w = rep_ref[:] * t                                     # (T, 1)
+    y_ref[:] += jnp.sum(xc * w, axis=0, keepdims=True)     # (1, E) partial
+
+
+def _pad_rows(x, rep, tile_r: int):
+    """Zero-pad rows (and reputation) up to a multiple of the panel size —
+    see the padding contract in the module docstring."""
+    R = x.shape[0]
+    pad = (-R) % tile_r
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        rep = jnp.pad(rep, (0, pad))
+    return x, rep
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def apply_weighted_cov(x, mu, rep, v, interpret: bool = False):
+    """``(X - mu)^T (rep * ((X - mu) v))`` in ONE HBM sweep of ``X``.
+
+    x : (R, E) filled reports, f32 or bf16 (row count padded internally).
+    mu : (E,) f32 weighted column means.  rep : (R,) f32.  v : (E,) f32.
+    Returns (E,) f32. Caller divides by the unbiased-weight denominator.
+    ``interpret=True`` runs the Pallas interpreter (CPU tests).
+    """
+    R, E = x.shape
+    tile_r = _panel_rows(E, x.dtype.itemsize)
+    x, rep = _pad_rows(x, rep, tile_r)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    grid = (Rp // tile_r,)
+    y = pl.pallas_call(
+        _apply_cov_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, E), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, E), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, mu.astype(f32).reshape(1, E), rep.astype(f32).reshape(-1, 1),
+      v.astype(f32).reshape(1, E))
+    return y.reshape(E)
+
+
+def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
+                          interpret: bool = False):
+    """First principal component via power iteration with the fused
+    one-HBM-pass covariance application. Runs the shared convergence driver
+    (``jax_kernels._power_loop`` — same start vector, normalization, and
+    early-exit rule as the XLA matvec path) but never materializes the
+    centered matrix and reads ``x`` once — not twice — per step.
+
+    x : (R, E) filled reports (f32 or bf16 — bf16 halves the HBM traffic).
+    mu, denom : weighted column means and the ``1 - sum(rep^2)`` scalar.
+    Returns the (E,) f32 loading (unit norm, sign arbitrary).
+    """
+    from .jax_kernels import _power_loop
+
+    E = x.shape[1]
+    f32 = jnp.float32
+    # pad once, outside the convergence loop — apply_weighted_cov's own pad
+    # then no-ops, instead of copying the matrix on every sweep when R is
+    # not a panel multiple
+    tile_r = _panel_rows(E, x.dtype.itemsize)
+    x, rep = _pad_rows(x, rep.astype(f32), tile_r)
+
+    def apply_cov(v):
+        return apply_weighted_cov(x, mu, rep, v, interpret=interpret) / denom
+
+    return _power_loop(apply_cov, E, f32, n_iters, tol)

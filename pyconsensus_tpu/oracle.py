@@ -141,7 +141,11 @@ class Oracle:
         hybrid device+host for hierarchical / dbscan).
     pca_method : str
         JAX PCA strategy: ``auto`` | ``eigh-cov`` | ``eigh-gram`` | ``power``
+        | ``power-fused`` (Pallas one-HBM-pass kernel, single-device TPU)
         (SURVEY.md §7 "hard parts" — never materialize E×E at scale).
+    power_iters, power_tol, matvec_dtype :
+        Power-iteration cap, early-exit tolerance (0 = machine-precision
+        floor), and optional low-precision matvec storage ("bfloat16").
     verbose : bool
         Print a result summary after ``consensus()`` (reference fidelity).
     """
@@ -164,6 +168,8 @@ class Oracle:
                  backend: str = "numpy",
                  pca_method: str = "auto",
                  power_iters: int = 128,
+                 power_tol: float = 0.0,
+                 matvec_dtype: str = "",
                  verbose: bool = False):
         if reports is None:
             raise ValueError("reports matrix is required")
@@ -232,6 +238,8 @@ class Oracle:
             dbscan_min_samples=int(dbscan_min_samples),
             pca_method=pca_method,
             power_iters=int(power_iters),
+            power_tol=float(power_tol),
+            matvec_dtype=str(matvec_dtype),
         )
 
     # -- core ---------------------------------------------------------------

@@ -34,20 +34,34 @@ __all__ = ["sharded_consensus", "ShardedOracle"]
 
 #: PCA methods that never materialize the E×E covariance and whose
 #: contractions ride the event axis (SURVEY.md §7 "hard parts")
-_SHARDABLE_PCA = ("eigh-gram", "power")
+_SHARDABLE_PCA = ("eigh-gram", "power", "power-fused")
 #: algorithms needing the full top-k spectrum (first-PC-only power iteration
 #: cannot serve them; the R×R Gram eigh is their scalable exact path)
 _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
 
 
-def _pick_pca_method(params: ConsensusParams, n_reporters: int) -> str:
+def _pick_pca_method(params: ConsensusParams, n_reporters: int,
+                     n_devices: int = 1) -> str:
     if params.algorithm in _MULTI_COMPONENT_ALGOS:
         return "eigh-gram"
     if params.pca_method in _SHARDABLE_PCA:
+        # the Pallas kernel is a black box to the GSPMD partitioner — an
+        # explicit "power-fused" request downgrades to the XLA matvecs on a
+        # multi-device mesh so the event-axis contractions actually shard
+        if params.pca_method == "power-fused" and n_devices > 1:
+            return "power"
         return params.pca_method
     # "auto"/"eigh-cov" on a sharded matrix would build E×E — never do that;
-    # closed-form Gram when R is small enough to eigh, matrix-free otherwise
-    return "eigh-gram" if n_reporters <= 4096 else "power"
+    # closed-form Gram when R is small enough to eigh, matrix-free otherwise.
+    # On a single real TPU the fused Pallas kernel halves the power-iteration
+    # HBM traffic; the multi-device path stays on XLA matvecs so GSPMD can
+    # shard the event-axis contractions (a Pallas kernel is a black box to
+    # the partitioner).
+    if n_reporters <= 4096:
+        return "eigh-gram"
+    if n_devices == 1 and jax.default_backend() == "tpu":
+        return "power-fused"
+    return "power"
 
 
 def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
@@ -86,7 +100,7 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
     p = params if params is not None else ConsensusParams()
     is_host = isinstance(reports, np.ndarray)
     p = p._replace(
-        pca_method=_pick_pca_method(p, R),
+        pca_method=_pick_pca_method(p, R, mesh.devices.size),
         any_scaled=bool(scaled.any()),
         # device-resident input: can't cheaply inspect for NaN on host — keep
         # the fill pass unless the caller's params already opted out
@@ -114,7 +128,8 @@ class ShardedOracle(Oracle):
                              "the simulator instead)")
         self.mesh = mesh if mesh is not None else make_mesh(batch=1)
         self.params = self.params._replace(
-            pca_method=_pick_pca_method(self.params, self.reports.shape[0]))
+            pca_method=_pick_pca_method(self.params, self.reports.shape[0],
+                                        self.mesh.devices.size))
 
     def resolve_raw(self):
         placed = _place_inputs(self.mesh, self.reports, self.reputation,
