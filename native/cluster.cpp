@@ -1,0 +1,233 @@
+// Native host-side clustering for the hybrid consensus algorithms
+// (SURVEY.md §7 M3: hierarchical / DBSCAN resist static-shape compilation,
+// so they run on host against a device-computed R×R distance matrix).
+//
+// This is the framework's native runtime component: the irregular,
+// data-dependent clustering loops that would be slow in Python and
+// impossible under XLA's static-shape model. The Python side
+// (pyconsensus_tpu.models.clustering) loads it via ctypes and falls back to
+// scipy/sklearn when the shared library is unavailable.
+//
+// Algorithms:
+//  - average-linkage agglomerative clustering via the nearest-neighbor
+//    chain algorithm (average linkage is reducible, so NN-chain gives the
+//    same dendrogram as the classic O(n^3) algorithm), cut at a distance
+//    threshold — semantics of scipy linkage(method="average") +
+//    fcluster(criterion="distance").
+//  - DBSCAN over a precomputed distance matrix — semantics of sklearn
+//    DBSCAN(metric="precomputed"): core point = >= min_samples neighbors
+//    within eps (self included); clusters grow by BFS over core points;
+//    border points join the first cluster that reaches them; noise = -1.
+//
+// Build: `make -C native` (g++ -O3 -shared), output
+// pyconsensus_tpu/_native/libconsensus_cluster.so.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+// Union-find over the 2n-1 dendrogram nodes, tracking each cluster's
+// current representative node id.
+struct UnionFind {
+    std::vector<int> parent;
+    explicit UnionFind(int n) : parent(n, -1) {}
+    int find(int x) {
+        int root = x;
+        while (parent[root] >= 0) root = parent[root];
+        while (parent[x] >= 0) {  // path compression
+            int next = parent[x];
+            parent[x] = root;
+            x = next;
+        }
+        return root;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Average-linkage agglomerative clustering, threshold cut.
+//   dist: n*n row-major symmetric distance matrix (diagonal ignored)
+//   labels: out, n ints, 0-based cluster ids
+// Returns the number of clusters, or -1 on invalid input.
+int pc_avg_linkage_labels(const double* dist, int n, double threshold,
+                          int32_t* labels) {
+    if (n <= 0 || dist == nullptr || labels == nullptr) return -1;
+    if (n == 1) {
+        labels[0] = 0;
+        return 1;
+    }
+
+    // Working copy of inter-cluster average distances. Active clusters are
+    // identified by their current "slot" (0..n-1); merging moves one
+    // cluster into the other's slot.
+    std::vector<double> d(static_cast<size_t>(n) * n);
+    std::memcpy(d.data(), dist, sizeof(double) * static_cast<size_t>(n) * n);
+    std::vector<int> size(n, 1);
+    std::vector<char> active(n, 1);
+    // dendrogram: for each of the n-1 merges, the merge height and the two
+    // member slots; member lists track which points sit in each slot
+    std::vector<std::vector<int>> members(n);
+    for (int i = 0; i < n; ++i) members[i] = {i};
+    std::vector<double> merge_height;
+    merge_height.reserve(n - 1);
+    std::vector<std::pair<int, int>> merge_slots;  // (kept, absorbed)
+    merge_slots.reserve(n - 1);
+    // per-point: list of (height_index) at which its cluster merged —
+    // reconstructed at the end via a second union-find pass instead.
+
+    // NN-chain algorithm.
+    std::vector<int> chain;
+    chain.reserve(n);
+    std::vector<char> in_chain(n, 0);
+    std::vector<std::pair<double, std::pair<int, int>>> merges;  // height, slots
+    merges.reserve(n - 1);
+
+    int n_active = n;
+    while (n_active > 1) {
+        if (chain.empty()) {
+            for (int i = 0; i < n; ++i)
+                if (active[i]) {
+                    chain.push_back(i);
+                    in_chain[i] = 1;
+                    break;
+                }
+        }
+        while (true) {
+            int a = chain.back();
+            // nearest active neighbor of a (smallest distance, lowest index
+            // tie-break)
+            int best = -1;
+            double best_d = 0.0;
+            for (int j = 0; j < n; ++j) {
+                if (!active[j] || j == a) continue;
+                double dj = d[static_cast<size_t>(a) * n + j];
+                if (best < 0 || dj < best_d) {
+                    best = j;
+                    best_d = dj;
+                }
+            }
+            if (chain.size() >= 2 && best_d >= // reciprocal pair check:
+                d[static_cast<size_t>(a) * n + chain[chain.size() - 2]]) {
+                // a and its predecessor are mutual nearest neighbors
+                int b = chain[chain.size() - 2];
+                double h = d[static_cast<size_t>(a) * n + b];
+                chain.pop_back();
+                in_chain[a] = 0;
+                chain.pop_back();
+                in_chain[b] = 0;
+                // survivor slot = LARGER index — scipy's nn_chain writes the
+                // merged cluster into the higher slot, and on tied distances
+                // the slot index feeds later nearest-neighbor tie-breaks, so
+                // matching it is required for identical partitions on the
+                // discrete (tie-heavy) report matrices this processes
+                int kept = b < a ? a : b;
+                int absorbed = b < a ? b : a;
+                merges.push_back({h, {kept, absorbed}});
+                // Lance-Williams update for average linkage
+                int sk = size[kept], sa = size[absorbed];
+                for (int j = 0; j < n; ++j) {
+                    if (!active[j] || j == kept || j == absorbed) continue;
+                    double dk = d[static_cast<size_t>(kept) * n + j];
+                    double da = d[static_cast<size_t>(absorbed) * n + j];
+                    double nd = (sk * dk + sa * da) / (sk + sa);
+                    d[static_cast<size_t>(kept) * n + j] = nd;
+                    d[static_cast<size_t>(j) * n + kept] = nd;
+                }
+                size[kept] += size[absorbed];
+                active[absorbed] = 0;
+                members[kept].insert(members[kept].end(),
+                                     members[absorbed].begin(),
+                                     members[absorbed].end());
+                members[absorbed].clear();
+                members[absorbed].shrink_to_fit();
+                --n_active;
+                break;
+            }
+            chain.push_back(best);
+            in_chain[best] = 1;
+        }
+    }
+
+    // Cut: replay merges in ascending height order, union-find the points
+    // whose merge height is <= threshold (fcluster "distance" criterion:
+    // clusters of cophenetic distance <= t).
+    std::vector<int> order(merges.size());
+    for (size_t i = 0; i < merges.size(); ++i) order[i] = static_cast<int>(i);
+    std::sort(order.begin(), order.end(), [&](int x, int y) {
+        return merges[x].first < merges[y].first;
+    });
+    UnionFind uf(n);
+    for (int idx : order) {
+        if (merges[idx].first > threshold) break;
+        // slots identified points at merge time; after all merges the slot
+        // pair maps to point sets — but union-find over *any* member pair
+        // is enough because earlier (lower) merges already joined each
+        // slot's internal points
+        int a = merges[idx].second.first;
+        int b = merges[idx].second.second;
+        int ra = uf.find(a);
+        int rb = uf.find(b);
+        if (ra != rb) uf.parent[rb] = ra;
+    }
+    // compact labels, ordered by first occurrence
+    std::vector<int32_t> remap(n, -1);
+    int next = 0;
+    for (int i = 0; i < n; ++i) {
+        int r = uf.find(i);
+        if (remap[r] < 0) remap[r] = next++;
+        labels[i] = remap[r];
+    }
+    return next;
+}
+
+// DBSCAN over a precomputed distance matrix (sklearn semantics).
+// Returns the number of (non-noise) clusters, or -1 on invalid input.
+// Noise points get label -1.
+int pc_dbscan_labels(const double* dist, int n, double eps, int min_samples,
+                     int32_t* labels) {
+    if (n <= 0 || dist == nullptr || labels == nullptr || min_samples < 1)
+        return -1;
+
+    std::vector<std::vector<int>> neighbors(n);
+    std::vector<char> core(n, 0);
+    for (int i = 0; i < n; ++i) {
+        auto& nb = neighbors[i];
+        for (int j = 0; j < n; ++j)
+            if (dist[static_cast<size_t>(i) * n + j] <= eps) nb.push_back(j);
+        core[i] = nb.size() >= static_cast<size_t>(min_samples);
+    }
+
+    const int32_t UNVISITED = -2;
+    for (int i = 0; i < n; ++i) labels[i] = UNVISITED;
+    int32_t cluster = 0;
+    for (int i = 0; i < n; ++i) {
+        if (labels[i] != UNVISITED || !core[i]) continue;
+        // BFS from core point i
+        labels[i] = cluster;
+        std::queue<int> q;
+        q.push(i);
+        while (!q.empty()) {
+            int p = q.front();
+            q.pop();
+            if (!core[p]) continue;  // border points don't expand
+            for (int j : neighbors[p]) {
+                if (labels[j] == UNVISITED) {
+                    labels[j] = cluster;
+                    if (core[j]) q.push(j);
+                }
+            }
+        }
+        ++cluster;
+    }
+    for (int i = 0; i < n; ++i)
+        if (labels[i] == UNVISITED) labels[i] = -1;
+    return cluster;
+}
+
+}  // extern "C"

@@ -1,0 +1,105 @@
+"""ctypes loader for the native clustering runtime (native/cluster.cpp).
+
+The shared library is built by ``make -C native`` (g++, no external deps).
+If it is missing, :func:`load` builds it on first use when a compiler is
+available; callers treat a ``None`` return as "fall back to scipy/sklearn".
+Results are binary-compatible with the host fallbacks (same label
+partitions), verified by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["load", "avg_linkage_labels", "dbscan_labels"]
+
+_LIB_PATH = pathlib.Path(__file__).parent / "libconsensus_cluster.so"
+_SRC_DIR = pathlib.Path(__file__).parent.parent.parent / "native"
+_lib = None
+_load_failed = False
+_load_lock = threading.Lock()
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on failure.
+
+    The first call may compile the library (``make -C native``, bounded at
+    120 s) — concurrent callers serialize on a lock so a half-finished
+    build is never dlopened and a lost race can't poison ``_load_failed``.
+    """
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        if not _LIB_PATH.exists() and (_SRC_DIR / "Makefile").exists():
+            subprocess.run(["make", "-C", str(_SRC_DIR)], check=True,
+                           capture_output=True, timeout=120)
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.pc_avg_linkage_labels.restype = ctypes.c_int
+        lib.pc_avg_linkage_labels.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.pc_dbscan_labels.restype = ctypes.c_int
+        lib.pc_dbscan_labels.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
+        _lib = lib
+    except (OSError, subprocess.SubprocessError):
+        _load_failed = True
+    return _lib
+
+
+def _as_dist_ptr(dist: np.ndarray):
+    d = np.ascontiguousarray(dist, dtype=np.float64)
+    if d.ndim != 2 or d.shape[0] != d.shape[1]:
+        raise ValueError(f"distance matrix must be square, got {d.shape}")
+    return d, d.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def avg_linkage_labels(dist: np.ndarray, threshold: float) -> Optional[np.ndarray]:
+    """Average-linkage labels cut at ``threshold`` (scipy fcluster
+    "distance" semantics); None if the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    d, ptr = _as_dist_ptr(dist)
+    n = d.shape[0]
+    labels = np.empty(n, dtype=np.int32)
+    rc = lib.pc_avg_linkage_labels(
+        ptr, n, float(threshold),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc < 0:
+        raise RuntimeError("pc_avg_linkage_labels failed")
+    return labels
+
+
+def dbscan_labels(dist: np.ndarray, eps: float,
+                  min_samples: int) -> Optional[np.ndarray]:
+    """DBSCAN labels (sklearn precomputed-metric semantics, noise = -1);
+    None if the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    d, ptr = _as_dist_ptr(dist)
+    n = d.shape[0]
+    labels = np.empty(n, dtype=np.int32)
+    rc = lib.pc_dbscan_labels(
+        ptr, n, float(eps), int(min_samples),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc < 0:
+        raise RuntimeError("pc_dbscan_labels failed")
+    return labels

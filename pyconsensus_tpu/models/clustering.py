@@ -128,10 +128,15 @@ def pairwise_sq_dists_jax(reports_filled):
 def hierarchical_conformity(reports_filled, reputation, threshold,
                             sq_dists=None):
     """Average-linkage agglomerative clustering cut at distance ``threshold``
-    (host side; scipy). ``sq_dists`` may be supplied from
-    :func:`pairwise_sq_dists_jax` to reuse the device computation."""
-    from scipy.cluster.hierarchy import fcluster, linkage
-    from scipy.spatial.distance import squareform
+    (host side). ``sq_dists`` may be supplied from
+    :func:`pairwise_sq_dists_jax` to reuse the device computation.
+
+    The irregular merge loop runs in the native C++ runtime
+    (native/cluster.cpp, NN-chain algorithm) when the shared library is
+    available, with a scipy fallback — both implement scipy
+    ``linkage(method="average")`` + ``fcluster(criterion="distance")``
+    semantics and produce identical partitions (tests/test_native.py)."""
+    from .. import _native
 
     X = np.asarray(reports_filled, dtype=np.float64)
     rep = np.asarray(reputation, dtype=np.float64)
@@ -141,24 +146,38 @@ def hierarchical_conformity(reports_filled, reputation, threshold,
         sq_dists = _pairwise_sq_dists_np(X)
     d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
     np.fill_diagonal(d, 0.0)
-    Z = linkage(squareform(d, checks=False), method="average")
-    labels = fcluster(Z, t=threshold, criterion="distance")
+    labels = _native.avg_linkage_labels(d, threshold)
+    if labels is None:
+        from scipy.cluster.hierarchy import fcluster, linkage
+        from scipy.spatial.distance import squareform
+
+        Z = linkage(squareform(d, checks=False), method="average")
+        labels = fcluster(Z, t=threshold, criterion="distance")
     return _cluster_mass(labels, rep)
 
 
 def dbscan_conformity(reports_filled, reputation, eps, min_samples,
                       sq_dists=None):
-    """DBSCAN over reporter rows (host side; sklearn, precomputed device
-    distances). Noise points (label -1) count as singleton clusters — their
-    conformity is just their own reputation."""
-    from sklearn.cluster import DBSCAN
+    """DBSCAN over reporter rows (host side, precomputed device distances).
+    Noise points (label -1) count as singleton clusters — their conformity
+    is just their own reputation.
+
+    The BFS cluster expansion runs in the native C++ runtime
+    (native/cluster.cpp) when available, with an sklearn fallback — both
+    implement ``DBSCAN(metric="precomputed")`` semantics."""
+    from .. import _native
 
     X = np.asarray(reports_filled, dtype=np.float64)
     rep = np.asarray(reputation, dtype=np.float64)
     if sq_dists is None:
         sq_dists = _pairwise_sq_dists_np(X)
     d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
-    labels = DBSCAN(eps=eps, min_samples=min_samples, metric="precomputed").fit(d).labels_
+    labels = _native.dbscan_labels(d, eps, min_samples)
+    if labels is None:
+        from sklearn.cluster import DBSCAN
+
+        labels = DBSCAN(eps=eps, min_samples=min_samples,
+                        metric="precomputed").fit(d).labels_
     # noise -> unique singleton labels
     labels = labels.astype(np.int64)
     next_label = labels.max() + 1 if labels.size else 0

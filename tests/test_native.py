@@ -1,0 +1,136 @@
+"""Parity tests: the native C++ clustering runtime (native/cluster.cpp via
+ctypes) against its scipy/sklearn host fallbacks — same partitions on the
+same distance matrices, across random data, tie-free by construction."""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import _native
+
+pytestmark = pytest.mark.skipif(_native.load() is None,
+                                reason="native library unavailable")
+
+
+def partitions_equal(a, b) -> bool:
+    """Label vectors describe the same partition (up to renaming), with
+    noise (-1) matched exactly as a class of singletons-by-flag."""
+    a, b = np.asarray(a), np.asarray(b)
+    if a.shape != b.shape:
+        return False
+    if not np.array_equal(a == -1, b == -1):
+        return False
+    mask = a != -1
+    seen = {}
+    for x, y in zip(a[mask], b[mask]):
+        if x in seen:
+            if seen[x] != y:
+                return False
+        else:
+            if y in seen.values():
+                return False
+            seen[x] = y
+    return True
+
+
+def random_dist(rng, n, dim=6):
+    X = rng.random((n, dim))
+    d = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+    np.fill_diagonal(d, 0.0)
+    return d
+
+
+class TestAvgLinkage:
+    @pytest.mark.parametrize("n", [2, 3, 10, 40])
+    @pytest.mark.parametrize("frac", [0.1, 0.4, 0.8])
+    def test_matches_scipy(self, rng, n, frac):
+        from scipy.cluster.hierarchy import fcluster, linkage
+        from scipy.spatial.distance import squareform
+
+        d = random_dist(rng, n)
+        t = frac * d.max()
+        ours = _native.avg_linkage_labels(d, t)
+        Z = linkage(squareform(d, checks=False), method="average")
+        ref = fcluster(Z, t=t, criterion="distance")
+        assert partitions_equal(ours, ref)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_matches_scipy_on_tied_discrete_data(self, seed):
+        """Report matrices are discrete ({0, 0.5, 1}) so distances are
+        heavily tied — the regime where NN-chain tie-breaks (survivor slot =
+        larger index, predecessor wins nearest-neighbor ties) must replicate
+        scipy exactly or partitions silently diverge."""
+        from scipy.cluster.hierarchy import fcluster, linkage
+        from scipy.spatial.distance import squareform
+
+        rng = np.random.default_rng(seed)
+        for _ in range(25):
+            n = int(rng.integers(4, 21))
+            X = rng.choice([0.0, 0.5, 1.0],
+                           size=(n, int(rng.integers(3, 8))))
+            d = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+            np.fill_diagonal(d, 0.0)
+            t = float(rng.random()) * (d.max() + 0.1)
+            ours = _native.avg_linkage_labels(d, t)
+            Z = linkage(squareform(d, checks=False), method="average")
+            ref = fcluster(Z, t=t, criterion="distance")
+            assert partitions_equal(ours, ref)
+
+    def test_single_point(self):
+        labels = _native.avg_linkage_labels(np.zeros((1, 1)), 0.5)
+        assert labels.tolist() == [0]
+
+    def test_threshold_extremes(self, rng):
+        d = random_dist(rng, 12)
+        all_one = _native.avg_linkage_labels(d, d.max() * 10)
+        assert len(set(all_one.tolist())) == 1
+        all_sep = _native.avg_linkage_labels(d, -1.0)
+        assert len(set(all_sep.tolist())) == 12
+
+
+class TestDBSCAN:
+    @pytest.mark.parametrize("n", [3, 15, 50])
+    @pytest.mark.parametrize("eps_frac,min_samples", [(0.2, 2), (0.4, 3),
+                                                      (0.7, 5)])
+    def test_matches_sklearn(self, rng, n, eps_frac, min_samples):
+        from sklearn.cluster import DBSCAN
+
+        d = random_dist(rng, n)
+        eps = eps_frac * np.median(d[d > 0]) if n > 1 else 0.5
+        ours = _native.dbscan_labels(d, eps, min_samples)
+        ref = DBSCAN(eps=eps, min_samples=min_samples,
+                     metric="precomputed").fit(d).labels_
+        assert partitions_equal(ours, ref)
+
+    def test_two_blobs_and_noise(self, rng):
+        X = np.concatenate([rng.normal(0.0, 0.05, (10, 3)),
+                            rng.normal(5.0, 0.05, (10, 3)),
+                            [[2.5, 2.5, 2.5]]])
+        d = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+        labels = _native.dbscan_labels(d, 0.5, 3)
+        assert labels[-1] == -1                      # lone midpoint = noise
+        assert len(set(labels[:10].tolist())) == 1
+        assert len(set(labels[10:20].tolist())) == 1
+        assert labels[0] != labels[10]
+
+
+class TestHybridPipelineUsesNative:
+    def test_conformity_same_with_and_without_native(self, rng, monkeypatch):
+        """The hybrid algorithms give identical conformity vectors through
+        the native library and the scipy/sklearn fallbacks."""
+        from pyconsensus_tpu.models import clustering as cl
+
+        X = rng.choice([0.0, 0.5, 1.0], size=(14, 6))
+        rep = rng.random(14) + 0.1
+        rep = rep / rep.sum()
+
+        h_native = cl.hierarchical_conformity(X, rep, 0.9)
+        d_native = cl.dbscan_conformity(X, rep, 0.8, 2)
+
+        monkeypatch.setattr(_native, "avg_linkage_labels",
+                            lambda *a, **k: None)
+        monkeypatch.setattr(_native, "dbscan_labels", lambda *a, **k: None)
+        h_fallback = cl.hierarchical_conformity(X, rep, 0.9)
+        d_fallback = cl.dbscan_conformity(X, rep, 0.8, 2)
+
+        np.testing.assert_allclose(h_native, h_fallback, rtol=1e-12)
+        np.testing.assert_allclose(d_native, d_fallback, rtol=1e-12)
