@@ -9,10 +9,14 @@ Public surface:
   (one vmap-batched XLA call per sweep).
 - :mod:`pyconsensus_tpu.parallel` — device-mesh sharding for large oracles
   (events sharded across chips, ICI collectives inserted by XLA).
+- :class:`ReputationLedger` — multi-round reputation carry with
+  checkpoint/resume (SURVEY.md §5).
 - :mod:`pyconsensus_tpu.utils` — phase timers and profiler hooks.
 """
 
+from .ledger import ReputationLedger
 from .oracle import ALGORITHMS, BACKENDS, Oracle
 
 __version__ = "0.1.0"
-__all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "__version__"]
+__all__ = ["Oracle", "ReputationLedger", "ALGORITHMS", "BACKENDS",
+           "__version__"]

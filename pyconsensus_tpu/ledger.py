@@ -1,0 +1,126 @@
+"""Multi-round reputation ledger: checkpoint/resume across oracle rounds.
+
+The reference is stateless per call — "reputation carried across *rounds* by
+the caller" (SURVEY.md §5, checkpoint/resume row). This module is that
+caller, made first-class: a :class:`ReputationLedger` feeds each round's
+``smooth_rep`` into the next resolution, records per-round metrics, and
+serializes its full state to a single ``.npz`` so a long-running oracle
+(e.g. a Truthcoin-style voting period sequence) can stop and resume
+anywhere.
+
+>>> ledger = ReputationLedger(n_reporters=50)
+>>> result = ledger.resolve(reports_round_1)       # uniform prior
+>>> result = ledger.resolve(reports_round_2)       # carries reputation
+>>> ledger.save("state.npz")
+>>> resumed = ReputationLedger.load("state.npz")
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Optional
+
+import numpy as np
+
+from .oracle import Oracle
+
+__all__ = ["ReputationLedger"]
+
+_FORMAT_VERSION = 1
+
+
+class ReputationLedger:
+    """Carries the reputation vector (and resolution history) across rounds.
+
+    Parameters
+    ----------
+    n_reporters : int
+        Fixed reporter-set size (reputation dimension).
+    reputation : (R,) array or None
+        Starting reputation; uniform if None. Normalized on entry.
+    oracle_kwargs : dict
+        Default :class:`Oracle` knobs applied to every round (individual
+        ``resolve`` calls may override).
+    """
+
+    def __init__(self, n_reporters: int, reputation=None,
+                 **oracle_kwargs) -> None:
+        self.n_reporters = int(n_reporters)
+        if reputation is None:
+            rep = np.full(self.n_reporters, 1.0 / self.n_reporters)
+        else:
+            rep = np.asarray(reputation, dtype=np.float64)
+            if rep.shape != (self.n_reporters,):
+                raise ValueError(f"reputation shape {rep.shape} does not "
+                                 f"match {self.n_reporters} reporters")
+            total = rep.sum()
+            if total <= 0:
+                raise ValueError("reputation must have positive mass")
+            rep = rep / total
+        self.reputation = rep
+        self.oracle_kwargs = dict(oracle_kwargs)
+        self.round = 0
+        #: per-round scalars: certainty / participation / convergence
+        self.history: list[dict] = []
+
+    # -- rounds --------------------------------------------------------------
+
+    def resolve(self, reports, event_bounds=None, **overrides) -> dict:
+        """Run one oracle round with the ledger's current reputation, feed
+        the resulting ``smooth_rep`` forward, and return the round's full
+        result dict."""
+        kwargs = {**self.oracle_kwargs, **overrides}
+        oracle = Oracle(reports=reports, event_bounds=event_bounds,
+                        reputation=self.reputation, **kwargs)
+        result = oracle.consensus()
+        self.reputation = np.asarray(result["agents"]["smooth_rep"],
+                                     dtype=np.float64)
+        self.round += 1
+        self.history.append({
+            "round": self.round,
+            "certainty": float(result["certainty"]),
+            "participation": float(result["participation"]),
+            "convergence": bool(result["convergence"]),
+            "iterations": int(result["iterations"]),
+        })
+        return result
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def save(self, path) -> None:
+        """Serialize full ledger state to ``path`` (.npz, single file; the
+        suffix is appended if missing, matching what np.savez writes so
+        ``load(path)`` round-trips either spelling)."""
+        path = pathlib.Path(path)
+        if path.suffix != ".npz":
+            path = path.with_name(path.name + ".npz")
+        np.savez(
+            path,
+            format_version=np.int64(_FORMAT_VERSION),
+            reputation=self.reputation,
+            round=np.int64(self.round),
+            history=np.frombuffer(
+                json.dumps(self.history).encode(), dtype=np.uint8),
+            oracle_kwargs=np.frombuffer(
+                json.dumps(self.oracle_kwargs).encode(), dtype=np.uint8),
+        )
+
+    @classmethod
+    def load(cls, path) -> "ReputationLedger":
+        """Restore a ledger exactly as :meth:`save` left it."""
+        path = pathlib.Path(path)
+        if not path.exists() and path.suffix != ".npz":
+            path = path.with_name(path.name + ".npz")
+        with np.load(path) as data:
+            version = int(data["format_version"])
+            if version > _FORMAT_VERSION:
+                raise ValueError(f"checkpoint format {version} is newer "
+                                 f"than supported {_FORMAT_VERSION}")
+            rep = np.asarray(data["reputation"], dtype=np.float64)
+            kwargs = json.loads(bytes(data["oracle_kwargs"]).decode())
+            ledger = cls(n_reporters=rep.shape[0], reputation=rep, **kwargs)
+            ledger.reputation = rep      # verbatim — no re-normalization,
+            ledger.round = int(data["round"])  # resume is bit-exact
+            ledger.history = json.loads(bytes(data["history"]).decode())
+        return ledger
