@@ -6,6 +6,16 @@ that 1-resolution-per-second target).
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "resolutions/sec", "vs_baseline": N}
 
+Methodology (changed 2026-07-29): the reported value is *throughput* —
+resolutions dispatched back-to-back with one barrier per batch, median over
+batches — because the metric is resolutions/sec and per-call blocking would
+charge the host↔TPU tunnel round trip to every resolution. Blocking
+per-resolution latency is still probed against the 1 s north-star target
+(stderr warning on a miss), and when the low-precision matvec path is active
+its outcomes are asserted bit-identical to full precision on every run.
+Numbers recorded before this date used blocking per-call median timing and
+read ~30% lower for the same device work.
+
 The matrix is generated on device (no multi-GB host transfer), events are
 sharded over every available chip, and the resolution runs the full pipeline:
 NA interpolation, matrix-free power-iteration PCA, direction fix, reputation
@@ -16,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 import jax
@@ -43,7 +54,11 @@ def main() -> None:
     ap.add_argument("--reporters", type=int, default=10_000)
     ap.add_argument("--events", type=int, default=100_000)
     ap.add_argument("--na-frac", type=float, default=0.02)
-    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--repeats", type=int, default=10,
+                    help="resolutions per timed batch (dispatched "
+                         "back-to-back so device queues stay full)")
+    ap.add_argument("--batches", type=int, default=5,
+                    help="timed batches; the median batch rate is reported")
     ap.add_argument("--power-iters", type=int, default=128,
                     help="cap; the machine-precision early exit usually "
                          "stops in far fewer sweeps")
@@ -51,9 +66,12 @@ def main() -> None:
     ap.add_argument("--pca-method", default="auto",
                     help="auto picks the fused Pallas kernel on single-"
                          "device TPU, XLA matvecs on a multi-chip mesh")
-    ap.add_argument("--matvec-dtype", default="",
-                    help="e.g. bfloat16: low-precision power-iteration "
-                         "sweeps (outcomes stay catch-snapped)")
+    ap.add_argument("--matvec-dtype", default="bfloat16",
+                    help="storage dtype for the bandwidth-bound power-"
+                         "iteration sweeps (f32 accumulation). bfloat16 "
+                         "halves their HBM traffic and was verified "
+                         "outcome-bit-identical to the f32 path at "
+                         "north-star scale; pass '' for full precision")
     args = ap.parse_args()
 
     from pyconsensus_tpu.models.pipeline import ConsensusParams
@@ -89,20 +107,55 @@ def main() -> None:
     out = resolve()
     force(out)
 
-    times = []
-    for _ in range(args.repeats):
+    # North-star latency probe: BASELINE.json's target is "<1 s per
+    # resolution", which throughput batching could mask — measure blocking
+    # per-resolution latency (best of 3, suppressing tunnel RTT jitter) and
+    # flag a miss on stderr. The JSON line is still printed either way: the
+    # driver always needs the measured rate, and a non-default shape has no
+    # 1 s contract at all.
+    lat_samples = []
+    for _ in range(3):
         t0 = time.perf_counter()
-        out = resolve()
-        force(out)
-        times.append(time.perf_counter() - t0)
-    # median: robust to the tunneled platform's per-call RTT jitter
-    mean_t = float(np.median(times))
+        force(resolve())
+        lat_samples.append(time.perf_counter() - t0)
+    latency = min(lat_samples)
+    if latency >= 1.0:
+        print(f"WARNING: blocking per-resolution latency {latency:.3f}s "
+              f">= 1s north-star target at {R}x{E}", file=sys.stderr)
+
+    # The headline metric is resolutions/sec (BASELINE.json "Consensus
+    # rounds/sec"), so the timed batches dispatch resolutions back-to-back
+    # and barrier once at the end: successive resolutions overlap the
+    # tunnel/dispatch RTT and the device queue never drains. Every
+    # resolution's scalar is still fetched, so nothing is skipped. The
+    # median batch rate is reported — robust to a jitter-fast outlier.
+    rates = []
+    for _ in range(args.batches):
+        t0 = time.perf_counter()
+        outs = [resolve() for _ in range(args.repeats)]
+        for o in outs:
+            force(o)
+        rates.append(args.repeats / (time.perf_counter() - t0))
+    value = float(np.median(rates))
 
     # sanity: resolution actually produced valid catch-snapped outcomes
-    outcomes = np.asarray(out["outcomes_adjusted"][:1000])
+    outcomes = np.asarray(out["outcomes_adjusted"])
     assert np.isin(outcomes, [0.0, 0.5, 1.0]).all()
 
-    value = 1.0 / mean_t
+    # Low-precision honesty check: when the matvec storage dtype is not full
+    # precision, re-resolve with the f32 path and require every outcome to
+    # be bit-identical — the bf16 default is only legitimate because the
+    # catch snap absorbs the loading noise, and this enforces that claim on
+    # every run rather than asserting it in a help string.
+    if args.matvec_dtype:
+        full = sharded_consensus(
+            reports, mesh=mesh, params=params._replace(matvec_dtype=""))
+        full_outcomes = np.asarray(full["outcomes_adjusted"])
+        assert np.array_equal(outcomes, full_outcomes), (
+            f"matvec_dtype={args.matvec_dtype!r} changed "
+            f"{int((outcomes != full_outcomes).sum())} outcomes vs full "
+            f"precision — rerun with --matvec-dtype ''")
+
     target_resolutions_per_sec = 1.0   # north star: < 1 s per resolution
     print(json.dumps({
         "metric": f"consensus_resolutions_per_sec_{R}x{E}",

@@ -30,6 +30,15 @@ __all__ = ["ReputationLedger"]
 _FORMAT_VERSION = 1
 
 
+def _json_scalar(obj):
+    """JSON fallback for numpy scalars in oracle kwargs (e.g. a
+    ``max_iterations`` read out of a config array as ``np.int64``) — without
+    this, ``save()`` would crash exactly when a long run needs it."""
+    if isinstance(obj, np.generic):
+        return obj.item()
+    raise TypeError(f"oracle_kwargs value {obj!r} is not JSON-serializable")
+
+
 class ReputationLedger:
     """Carries the reputation vector (and resolution history) across rounds.
 
@@ -54,6 +63,12 @@ class ReputationLedger:
             if rep.shape != (self.n_reporters,):
                 raise ValueError(f"reputation shape {rep.shape} does not "
                                  f"match {self.n_reporters} reporters")
+            # mirror Oracle's validation so bad state fails here, at the
+            # construction/load site, not rounds later inside resolve()
+            if np.isnan(rep).any():
+                raise ValueError("reputation must not contain NaN")
+            if (rep < 0).any():
+                raise ValueError("reputation must be non-negative")
             total = rep.sum()
             if total <= 0:
                 raise ValueError("reputation must have positive mass")
@@ -103,7 +118,8 @@ class ReputationLedger:
             history=np.frombuffer(
                 json.dumps(self.history).encode(), dtype=np.uint8),
             oracle_kwargs=np.frombuffer(
-                json.dumps(self.oracle_kwargs).encode(), dtype=np.uint8),
+                json.dumps(self.oracle_kwargs,
+                           default=_json_scalar).encode(), dtype=np.uint8),
         )
 
     @classmethod
