@@ -1,0 +1,218 @@
+// Native report-matrix loader: multithreaded CSV parsing for the IO
+// subsystem (pyconsensus_tpu.io).
+//
+// The reference library has no data loader at all — reports matrices are
+// built inline in Python (SURVEY.md §2: the library is 100% Python with no
+// IO layer). At TPU scale the framework ingests reporters×events matrices
+// from disk, and Python-side CSV parsing (np.genfromtxt) is 50-100x slower
+// than this parser; the binary (.npy) path needs no native help (mmap via
+// numpy), so CSV is the one hot IO path implemented natively.
+//
+// Design: mmap the file read-only, index newlines in one scan, then parse
+// rows in parallel with std::from_chars (locale-independent, does not
+// require null termination, so parsing works directly against the mapping).
+// Missing reports — empty fields, "na"/"nan"/"null" in any case — become
+// quiet NaN, the framework-wide non-participation marker.
+//
+// API (extern "C", consumed via ctypes from pyconsensus_tpu._native):
+//   pc_reports_csv_open(path, &rows, &cols) -> handle | NULL
+//   pc_reports_csv_read(handle, out)        -> 0 | -row_with_bad_field
+//   pc_reports_csv_close(handle)
+//
+// Build: `make -C native` (g++ -O3 -shared), output
+// pyconsensus_tpu/_native/libconsensus_loader.so.
+
+#include <algorithm>
+#include <cctype>
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct CsvHandle {
+    int fd = -1;
+    const char* map = nullptr;
+    size_t size = 0;
+    // byte range [begin, end) of each data row (header excluded)
+    std::vector<size_t> row_begin;
+    std::vector<size_t> row_end;
+    int64_t cols = 0;
+};
+
+inline const char* trim(const char* b, const char*& e) {
+    while (b < e && (*b == ' ' || *b == '\t')) ++b;
+    while (e > b && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r')) --e;
+    return b;
+}
+
+inline bool is_na_token(const char* b, const char* e) {
+    size_t n = static_cast<size_t>(e - b);
+    if (n == 0) return true;
+    char low[5];
+    if (n > 4) return false;
+    for (size_t i = 0; i < n; ++i)
+        low[i] = static_cast<char>(std::tolower(static_cast<unsigned char>(b[i])));
+    return (n == 2 && std::memcmp(low, "na", 2) == 0) ||
+           (n == 3 && std::memcmp(low, "nan", 3) == 0) ||
+           (n == 4 && std::memcmp(low, "null", 4) == 0);
+}
+
+// Parse one row's fields into out[0..cols); true on success.
+bool parse_row(const char* b, const char* e, int64_t cols, double* out) {
+    int64_t c = 0;
+    const char* field = b;
+    for (const char* p = b; ; ++p) {
+        if (p == e || *p == ',') {
+            if (c >= cols) return false;
+            const char* fe = p;
+            const char* fb = trim(field, fe);
+            if (is_na_token(fb, fe)) {
+                out[c] = std::numeric_limits<double>::quiet_NaN();
+            } else {
+                // std::from_chars rejects a leading '+' (valid in CSV floats)
+                if (fb < fe && *fb == '+') ++fb;
+                double v;
+                auto [ptr, ec] = std::from_chars(fb, fe, v);
+                if (ec != std::errc() || ptr != fe) return false;
+                out[c] = v;
+            }
+            ++c;
+            if (p == e) break;
+            field = p + 1;
+        }
+    }
+    return c == cols;
+}
+
+int64_t count_fields(const char* b, const char* e) {
+    return 1 + std::count(b, e, ',');
+}
+
+}  // namespace
+
+extern "C" {
+
+void pc_reports_csv_close(void* handle);
+
+// Open + index a reports CSV. Returns an opaque handle (NULL on IO error,
+// empty file, or ragged rows) and writes the data-row/column counts. A
+// non-numeric first row (header) is detected and skipped.
+void* pc_reports_csv_open(const char* path, int64_t* rows, int64_t* cols) {
+    if (path == nullptr || rows == nullptr || cols == nullptr) return nullptr;
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+        ::close(fd);
+        return nullptr;
+    }
+    void* map = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                     MAP_PRIVATE, fd, 0);
+    if (map == MAP_FAILED) {
+        ::close(fd);
+        return nullptr;
+    }
+    auto* h = new CsvHandle;
+    h->fd = fd;
+    h->map = static_cast<const char*>(map);
+    h->size = static_cast<size_t>(st.st_size);
+
+    // index line ranges, skipping blank lines
+    size_t pos = 0;
+    while (pos < h->size) {
+        const char* nl = static_cast<const char*>(
+            std::memchr(h->map + pos, '\n', h->size - pos));
+        size_t end = nl ? static_cast<size_t>(nl - h->map) : h->size;
+        size_t b = pos, e = end;
+        while (b < e && (h->map[b] == ' ' || h->map[b] == '\t')) ++b;
+        while (e > b && (h->map[e - 1] == '\r' || h->map[e - 1] == ' ' ||
+                         h->map[e - 1] == '\t')) --e;
+        if (e > b) {
+            h->row_begin.push_back(pos);
+            h->row_end.push_back(end);
+        }
+        pos = end + 1;
+    }
+    if (h->row_begin.empty()) {
+        pc_reports_csv_close(h);
+        return nullptr;
+    }
+
+    // header detection: if the first line fails to parse as numbers/NA but
+    // the second parses, treat the first as a header
+    h->cols = count_fields(h->map + h->row_begin[0], h->map + h->row_end[0]);
+    std::vector<double> probe(static_cast<size_t>(h->cols));
+    if (!parse_row(h->map + h->row_begin[0], h->map + h->row_end[0], h->cols,
+                   probe.data())) {
+        if (h->row_begin.size() < 2) {
+            pc_reports_csv_close(h);
+            return nullptr;
+        }
+        h->row_begin.erase(h->row_begin.begin());
+        h->row_end.erase(h->row_end.begin());
+        h->cols = count_fields(h->map + h->row_begin[0],
+                               h->map + h->row_end[0]);
+    }
+    *rows = static_cast<int64_t>(h->row_begin.size());
+    *cols = h->cols;
+    return h;
+}
+
+// Parse every data row into out (rows*cols doubles, row-major).
+// Returns 0 on success, -(i+1) if data row i is ragged or has a bad field.
+int64_t pc_reports_csv_read(void* handle, double* out) {
+    if (handle == nullptr || out == nullptr) return -1;
+    auto* h = static_cast<CsvHandle*>(handle);
+    const int64_t R = static_cast<int64_t>(h->row_begin.size());
+    const int64_t C = h->cols;
+
+    unsigned hw = std::thread::hardware_concurrency();
+    int64_t n_threads = std::max<int64_t>(
+        1, std::min<int64_t>(hw ? hw : 1, R / 256 + 1));
+    std::vector<int64_t> first_bad(static_cast<size_t>(n_threads), 0);
+
+    auto worker = [&](int64_t t) {
+        int64_t lo = R * t / n_threads, hi = R * (t + 1) / n_threads;
+        for (int64_t i = lo; i < hi; ++i) {
+            if (!parse_row(h->map + h->row_begin[static_cast<size_t>(i)],
+                           h->map + h->row_end[static_cast<size_t>(i)], C,
+                           out + i * C)) {
+                first_bad[static_cast<size_t>(t)] = -(i + 1);
+                return;
+            }
+        }
+    };
+    if (n_threads == 1) {
+        worker(0);
+    } else {
+        std::vector<std::thread> pool;
+        pool.reserve(static_cast<size_t>(n_threads));
+        for (int64_t t = 0; t < n_threads; ++t) pool.emplace_back(worker, t);
+        for (auto& th : pool) th.join();
+    }
+    for (int64_t bad : first_bad)
+        if (bad != 0) return bad;
+    return 0;
+}
+
+void pc_reports_csv_close(void* handle) {
+    if (handle == nullptr) return;
+    auto* h = static_cast<CsvHandle*>(handle);
+    if (h->map != nullptr)
+        munmap(const_cast<char*>(h->map), h->size);
+    if (h->fd >= 0) ::close(h->fd);
+    delete h;
+}
+
+}  // extern "C"

@@ -11,6 +11,8 @@ Public surface:
   (events sharded across chips, ICI collectives inserted by XLA).
 - :class:`ReputationLedger` — multi-round reputation carry with
   checkpoint/resume (SURVEY.md §5).
+- :mod:`pyconsensus_tpu.io` — report-matrix IO: npy/csv on host (native
+  multithreaded CSV parser), event-sharded loading straight onto a mesh.
 - :mod:`pyconsensus_tpu.utils` — phase timers and profiler hooks.
 """
 

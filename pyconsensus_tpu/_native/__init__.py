@@ -1,10 +1,11 @@
-"""ctypes loader for the native clustering runtime (native/cluster.cpp).
+"""ctypes loaders for the native runtime (native/*.cpp).
 
-The shared library is built by ``make -C native`` (g++, no external deps).
-If it is missing, :func:`load` builds it on first use when a compiler is
-available; callers treat a ``None`` return as "fall back to scipy/sklearn".
-Results are binary-compatible with the host fallbacks (same label
-partitions), verified by tests/test_native.py.
+Two shared libraries, both built by ``make -C native`` (g++, no external
+deps): the clustering runtime (cluster.cpp — hybrid host path) and the CSV
+report loader (loader.cpp — IO subsystem). If one is missing, the first use
+builds it when a compiler is available; callers treat a ``None`` return as
+"fall back to the pure-Python path". Results are binary-compatible with the
+host fallbacks, verified by tests/test_native.py and tests/test_io.py.
 """
 
 from __future__ import annotations
@@ -17,50 +18,102 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["load", "avg_linkage_labels", "dbscan_labels"]
+__all__ = ["load", "avg_linkage_labels", "dbscan_labels", "load_loader",
+           "csv_read"]
 
-_LIB_PATH = pathlib.Path(__file__).parent / "libconsensus_cluster.so"
-_SRC_DIR = pathlib.Path(__file__).parent.parent.parent / "native"
-_lib = None
-_load_failed = False
+_NATIVE_DIR = pathlib.Path(__file__).parent
+_SRC_DIR = _NATIVE_DIR.parent.parent / "native"
 _load_lock = threading.Lock()
+#: lib name -> loaded CDLL, or None if a load attempt failed
+_libs: dict = {}
+#: lib name -> the Makefile target that builds only that library (so one
+#: library failing to compile cannot block the other)
+_MAKE_TARGETS = {"libconsensus_cluster.so": "cluster",
+                 "libconsensus_loader.so": "loader"}
+
+
+def _load_lib(name: str, configure) -> Optional[ctypes.CDLL]:
+    """Load (building via ``make -C native <target>`` if needed, bounded at
+    120 s) the shared library ``name``; None on failure. Concurrent callers
+    serialize on a lock so a half-finished build is never dlopened and a
+    lost race can't poison the failure cache."""
+    if name in _libs:           # hit: loaded CDLL, or None = failed earlier
+        return _libs[name]
+    with _load_lock:
+        if name in _libs:
+            return _libs[name]
+        path = _NATIVE_DIR / name
+        try:
+            if not path.exists() and (_SRC_DIR / "Makefile").exists():
+                subprocess.run(["make", "-C", str(_SRC_DIR),
+                                _MAKE_TARGETS[name]], check=True,
+                               capture_output=True, timeout=120)
+            lib = ctypes.CDLL(str(path))
+            configure(lib)
+        except (OSError, subprocess.SubprocessError, KeyError):
+            lib = None
+        _libs[name] = lib
+        return lib
+
+
+def _configure_cluster(lib: ctypes.CDLL) -> None:
+    lib.pc_avg_linkage_labels.restype = ctypes.c_int
+    lib.pc_avg_linkage_labels.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.pc_dbscan_labels.restype = ctypes.c_int
+    lib.pc_dbscan_labels.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
+
+
+def _configure_loader(lib: ctypes.CDLL) -> None:
+    lib.pc_reports_csv_open.restype = ctypes.c_void_p
+    lib.pc_reports_csv_open.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.pc_reports_csv_read.restype = ctypes.c_int64
+    lib.pc_reports_csv_read.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double)]
+    lib.pc_reports_csv_close.restype = None
+    lib.pc_reports_csv_close.argtypes = [ctypes.c_void_p]
 
 
 def load() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None on failure.
-
-    The first call may compile the library (``make -C native``, bounded at
-    120 s) — concurrent callers serialize on a lock so a half-finished
-    build is never dlopened and a lost race can't poison ``_load_failed``.
-    """
-    global _lib, _load_failed
-    if _lib is not None or _load_failed:
-        return _lib
-    with _load_lock:
-        return _load_locked()
+    """The clustering runtime library; None if unavailable."""
+    return _load_lib("libconsensus_cluster.so", _configure_cluster)
 
 
-def _load_locked() -> Optional[ctypes.CDLL]:
-    global _lib, _load_failed
-    if _lib is not None or _load_failed:
-        return _lib
+def load_loader() -> Optional[ctypes.CDLL]:
+    """The CSV report-loader library; None if unavailable."""
+    return _load_lib("libconsensus_loader.so", _configure_loader)
+
+
+def csv_read(path) -> Optional[np.ndarray]:
+    """Parse a reports CSV (rows = reporters, NA/empty -> NaN, optional
+    header auto-skipped) with the multithreaded native parser. Returns a
+    float64 (R, E) array, None if the native library is unavailable.
+    Raises ValueError on a malformed file (the caller should *not* fall
+    back: the file itself is bad)."""
+    lib = load_loader()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    handle = lib.pc_reports_csv_open(str(path).encode(),
+                                     ctypes.byref(rows), ctypes.byref(cols))
+    if not handle:
+        raise ValueError(f"{path}: not a readable, non-empty CSV")
     try:
-        if not _LIB_PATH.exists() and (_SRC_DIR / "Makefile").exists():
-            subprocess.run(["make", "-C", str(_SRC_DIR)], check=True,
-                           capture_output=True, timeout=120)
-        lib = ctypes.CDLL(str(_LIB_PATH))
-        lib.pc_avg_linkage_labels.restype = ctypes.c_int
-        lib.pc_avg_linkage_labels.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.pc_dbscan_labels.restype = ctypes.c_int
-        lib.pc_dbscan_labels.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
-            ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
-        _lib = lib
-    except (OSError, subprocess.SubprocessError):
-        _load_failed = True
-    return _lib
+        out = np.empty((rows.value, cols.value), dtype=np.float64)
+        rc = lib.pc_reports_csv_read(
+            handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        if rc < 0:
+            raise ValueError(f"{path}: bad field or ragged row at data row "
+                             f"{-rc - 1}")
+        return out
+    finally:
+        lib.pc_reports_csv_close(handle)
 
 
 def _as_dist_ptr(dist: np.ndarray):

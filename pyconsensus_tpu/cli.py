@@ -126,6 +126,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="run the example with scaled events + event_bounds")
     ap.add_argument("--simulate", action="store_true",
                     help="run a Monte-Carlo collusion sweep")
+    ap.add_argument("-f", "--file", metavar="PATH",
+                    help="resolve a reports matrix loaded from PATH "
+                         "(.npy or .csv; NA/NaN = missing report)")
     ap.add_argument("--algorithm", default="sztorc", choices=ALGORITHMS)
     ap.add_argument("--backend", default="jax", choices=BACKENDS)
     ap.add_argument("--iterations", type=int, default=5,
@@ -145,9 +148,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  f"(got {args.algorithm!r}); choose from "
                  f"{', '.join(JIT_ALGORITHMS)}")
 
-    if not (args.example or args.missing or args.scaled or args.simulate):
+    if not (args.example or args.missing or args.scaled or args.simulate
+            or args.file):
         args.example = True  # default demo, like the reference CLI
 
+    if args.file:
+        from .io import load_reports
+
+        try:
+            file_reports = load_reports(args.file)
+        except (OSError, ValueError) as exc:
+            ap.error(f"--file: {exc}")
+        _run_demo(f"Reports from {args.file}", file_reports, None, args)
     if args.example:
         _run_demo("Example (dense binary)", EXAMPLE_REPORTS, None, args)
     if args.missing:

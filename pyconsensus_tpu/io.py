@@ -1,0 +1,132 @@
+"""Report-matrix IO: load/save on host, and event-sharded loading straight
+onto a device mesh.
+
+The reference library has no IO layer — reports matrices are Python lists
+built inline (SURVEY.md §2: 100% Python, no data loader). This module is the
+TPU-native framework's ingestion path:
+
+- :func:`save_reports` / :func:`load_reports` — ``.npy`` (binary, mmap-able)
+  and ``.csv`` (human-readable; parsed by the multithreaded native loader in
+  ``native/loader.cpp`` when built, ``np.genfromtxt`` otherwise). NaN is the
+  non-participation marker in both formats.
+- :func:`load_reports_sharded` — build a global jax array whose event
+  (column) axis is sharded over a mesh **without ever materializing the full
+  matrix in host RAM**: the ``.npy`` file is memory-mapped and each device's
+  column block is copied out and ``device_put`` individually, then assembled
+  with ``jax.make_array_from_single_device_arrays``. This is how a
+  north-star-scale matrix (10k × 100k = 4 GB fp32, larger in future rounds)
+  gets from disk to an 8-chip mesh with peak host memory of one shard.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["save_reports", "load_reports", "load_reports_sharded"]
+
+
+def save_reports(path, reports) -> pathlib.Path:
+    """Write a reports matrix to ``path`` (format by suffix: ``.npy`` binary
+    or ``.csv`` text with ``NA`` for missing entries). Returns the path."""
+    path = pathlib.Path(path)
+    reports = np.asarray(reports, dtype=np.float64)
+    if reports.ndim != 2:
+        raise ValueError(f"reports must be 2-D, got shape {reports.shape}")
+    if path.suffix == ".npy":
+        np.save(path, reports)
+    elif path.suffix == ".csv":
+        with open(path, "w") as f:
+            for row in reports:
+                f.write(",".join("NA" if np.isnan(v) else repr(float(v))
+                                 for v in row))
+                f.write("\n")
+    else:
+        raise ValueError(f"unsupported reports format {path.suffix!r} "
+                         f"(use .npy or .csv)")
+    return path
+
+
+_NA_TOKENS = frozenset({"", "na", "nan", "null"})
+
+
+def _csv_header_lines(path) -> int:
+    """1 if the first non-blank line is a header (any token neither numeric
+    nor an NA marker), else 0 — mirrors the native parser's detection so the
+    numpy fallback sees the same matrix."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            for tok in line.split(","):
+                tok = tok.strip()
+                if tok.lower() in _NA_TOKENS:
+                    continue
+                try:
+                    float(tok)
+                except ValueError:
+                    return 1
+            return 0
+    return 0
+
+
+def load_reports(path, mmap: bool = False) -> np.ndarray:
+    """Load a reports matrix from ``.npy`` or ``.csv``.
+
+    ``mmap=True`` memory-maps a ``.npy`` file read-only (no copy until
+    sliced) — the building block for shard-wise ingestion of matrices
+    larger than host RAM.
+    """
+    path = pathlib.Path(path)
+    if path.suffix == ".npy":
+        arr = np.load(path, mmap_mode="r" if mmap else None)
+        if arr.ndim != 2:
+            raise ValueError(f"{path}: expected a 2-D reports matrix, got "
+                             f"shape {arr.shape}")
+        return arr
+    if path.suffix == ".csv":
+        from . import _native
+
+        arr = _native.csv_read(path)
+        if arr is None:                      # no compiler: pure-numpy path
+            arr = np.genfromtxt(path, delimiter=",",
+                                skip_header=_csv_header_lines(path),
+                                missing_values=("NA", "na", "null", "NULL",
+                                                ""),
+                                filling_values=np.nan, ndmin=2)
+            if arr.ndim != 2 or np.isnan(arr).all():
+                raise ValueError(f"{path}: not a parseable reports CSV")
+        return arr
+    raise ValueError(f"unsupported reports format {path.suffix!r} "
+                     f"(use .npy or .csv)")
+
+
+def load_reports_sharded(path, mesh=None, dtype=None):
+    """Load a ``.npy`` reports matrix with its event axis sharded over
+    ``mesh`` (default: all devices on one ``event`` axis), copying only one
+    column block per device through host memory.
+
+    Returns a global jax array placed like ``sharded_consensus`` expects
+    (rows replicated per shard spec ``P(None, "event")``).
+    """
+    import jax
+
+    from .parallel.mesh import event_sharding, make_mesh
+
+    mesh = mesh if mesh is not None else make_mesh(batch=1)
+    src = load_reports(path, mmap=True)
+    if dtype is None:
+        dtype = jax.numpy.asarray(0.0).dtype
+    sharding = event_sharding(mesh)
+    R, E = src.shape
+
+    # one device_put per addressable device, each of one contiguous column
+    # block — host peak = one shard, not the full matrix
+    arrays = []
+    for d, idx in sharding.addressable_devices_indices_map((R, E)).items():
+        block = np.ascontiguousarray(src[idx], dtype=dtype)
+        arrays.append(jax.device_put(block, d))
+    return jax.make_array_from_single_device_arrays((R, E), sharding, arrays)

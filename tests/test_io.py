@@ -1,0 +1,166 @@
+"""IO subsystem tests: report save/load round-trips, the native CSV parser
+vs the numpy fallback, and event-sharded device loading (SURVEY.md §2 — the
+reference has no data loader; this is the rebuild's ingestion path)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pyconsensus_tpu import Oracle, _native
+from pyconsensus_tpu.io import (load_reports, load_reports_sharded,
+                                save_reports)
+from pyconsensus_tpu.models.pipeline import ConsensusParams
+from pyconsensus_tpu.parallel import make_mesh, sharded_consensus
+
+
+@pytest.fixture
+def matrix(rng):
+    m = rng.random((17, 9))
+    m[rng.random((17, 9)) < 0.2] = np.nan
+    return m
+
+
+def test_npy_roundtrip(tmp_path, matrix):
+    p = save_reports(tmp_path / "r.npy", matrix)
+    out = load_reports(p)
+    np.testing.assert_array_equal(out, matrix)
+
+
+def test_npy_mmap(tmp_path, matrix):
+    p = save_reports(tmp_path / "r.npy", matrix)
+    out = load_reports(p, mmap=True)
+    assert isinstance(out, np.memmap)
+    np.testing.assert_array_equal(np.asarray(out), matrix)
+
+
+def test_csv_roundtrip(tmp_path, matrix):
+    p = save_reports(tmp_path / "r.csv", matrix)
+    out = load_reports(p)
+    np.testing.assert_array_equal(out, matrix)   # repr() round-trips floats
+
+
+def test_csv_native_matches_fallback(tmp_path, matrix):
+    p = save_reports(tmp_path / "r.csv", matrix)
+    native = _native.csv_read(p)
+    if native is None:
+        pytest.skip("no compiler for the native loader")
+    fallback = np.genfromtxt(p, delimiter=",", filling_values=np.nan,
+                             missing_values=("NA",), ndmin=2)
+    np.testing.assert_array_equal(native, fallback)
+
+
+def test_csv_header_and_na_tokens(tmp_path):
+    p = tmp_path / "r.csv"
+    p.write_text("event_a,event_b,event_c\n"
+                 "1.0, 0.5 ,NA\n"
+                 "na,0.0,1\n"
+                 "\n"
+                 "null,NaN,0.25\n")
+    out = load_reports(p)
+    assert out.shape == (3, 3)
+    np.testing.assert_array_equal(
+        out, np.array([[1.0, 0.5, np.nan],
+                       [np.nan, 0.0, 1.0],
+                       [np.nan, np.nan, 0.25]]))
+
+
+def test_csv_plus_prefixed_numbers(tmp_path):
+    """'+'-prefixed floats are valid CSV; the first row must not be
+    mistaken for a header because of one."""
+    p = tmp_path / "r.csv"
+    p.write_text("1,+2.5\n3,4\n")
+    out = load_reports(p)
+    np.testing.assert_array_equal(out, np.array([[1.0, 2.5], [3.0, 4.0]]))
+
+
+def test_fallback_header_detection(tmp_path):
+    """The numpy fallback must skip a header exactly like the native parser
+    (same matrix on machines without a compiler)."""
+    from pyconsensus_tpu.io import _csv_header_lines
+    p = tmp_path / "r.csv"
+    p.write_text("event_a,event_b\n1,NA\n0,1\n")
+    assert _csv_header_lines(p) == 1
+    arr = np.genfromtxt(p, delimiter=",", skip_header=1,
+                        missing_values=("NA",), filling_values=np.nan,
+                        ndmin=2)
+    native = _native.csv_read(p)
+    if native is not None:
+        np.testing.assert_array_equal(arr, native)
+    p.write_text("1,NA\n0,1\n")
+    assert _csv_header_lines(p) == 0
+    p.write_text("\n\nNA,na,NULL\n")          # all-NA first line: data
+    assert _csv_header_lines(p) == 0
+
+
+def test_make_per_library_targets():
+    """Each library builds via its own Makefile target, so one failing to
+    compile cannot block the other."""
+    import pathlib
+    import subprocess
+    src = pathlib.Path(__file__).parent.parent / "native"
+    for target in ("cluster", "loader"):
+        subprocess.run(["make", "-C", str(src), target], check=True,
+                       capture_output=True, timeout=120)
+
+
+def test_csv_ragged_row_rejected(tmp_path):
+    if _native.load_loader() is None:
+        pytest.skip("no compiler for the native loader")
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,5\n")
+    with pytest.raises(ValueError, match="row 1"):
+        _native.csv_read(p)
+
+
+def test_csv_bad_field_rejected(tmp_path):
+    if _native.load_loader() is None:
+        pytest.skip("no compiler for the native loader")
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,bogus,6\n")
+    with pytest.raises(ValueError, match="row 1"):
+        _native.csv_read(p)
+
+
+def test_unknown_suffix(tmp_path, matrix):
+    with pytest.raises(ValueError, match="format"):
+        save_reports(tmp_path / "r.parquet", matrix)
+    with pytest.raises(ValueError, match="format"):
+        load_reports(tmp_path / "r.parquet")
+
+
+def test_sharded_load_matches_dense(tmp_path, rng):
+    """The event-sharded loaded array must resolve identically to the dense
+    host path — same outcomes, same reputation."""
+    R, E = 12, 16
+    truth = rng.choice([0.0, 1.0], size=E)
+    reports = np.tile(truth, (R, 1))
+    reports[rng.random((R, E)) < 0.2] = np.nan
+    p = save_reports(tmp_path / "r.npy", reports)
+
+    mesh = make_mesh(batch=1, event=8)
+    global_arr = load_reports_sharded(p, mesh)
+    assert global_arr.shape == (R, E)
+    assert not global_arr.sharding.is_fully_replicated
+
+    params = ConsensusParams(algorithm="sztorc", pca_method="eigh-gram",
+                             any_scaled=False, has_na=True)
+    sharded = sharded_consensus(global_arr, mesh=mesh, params=params)
+    dense = Oracle(reports=reports, backend="jax",
+                   pca_method="eigh-gram").consensus()
+    np.testing.assert_array_equal(
+        np.asarray(sharded["outcomes_final"]),
+        dense["events"]["outcomes_final"])
+    np.testing.assert_allclose(np.asarray(sharded["smooth_rep"]),
+                               dense["agents"]["smooth_rep"], atol=1e-12)
+
+
+def test_sharded_load_copies_blocks(tmp_path, rng):
+    """Each device holds exactly its column block of the source matrix."""
+    R, E = 6, 8
+    m = rng.random((R, E))
+    p = save_reports(tmp_path / "r.npy", m)
+    mesh = make_mesh(batch=1, event=8)
+    arr = load_reports_sharded(p, mesh)
+    for shard in arr.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data), m[shard.index])
