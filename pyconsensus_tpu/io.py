@@ -7,8 +7,9 @@ TPU-native framework's ingestion path:
 
 - :func:`save_reports` / :func:`load_reports` — ``.npy`` (binary, mmap-able)
   and ``.csv`` (human-readable; parsed by the multithreaded native loader in
-  ``native/loader.cpp`` when built, ``np.genfromtxt`` otherwise). NaN is the
-  non-participation marker in both formats.
+  ``native/loader.cpp`` when built, a strict pure-Python parser with the
+  same error contract otherwise). NaN is the non-participation marker in
+  both formats.
 - :func:`load_reports_sharded` — build a global jax array whose event
   (column) axis is sharded over a mesh **without ever materializing the full
   matrix in host RAM**: the ``.npy`` file is memory-mapped and each device's
@@ -73,6 +74,51 @@ def _csv_header_lines(path) -> int:
     return 0
 
 
+def _csv_read_fallback(path) -> np.ndarray:
+    """Strict pure-Python CSV parse with the native loader's exact contract:
+    NA markers -> NaN, but a field that is neither numeric nor an NA marker,
+    or a ragged row, raises ValueError with the same 0-based data-row index
+    the native parser reports. (``np.genfromtxt`` is NOT used: it silently
+    coerces corrupt fields to NaN — i.e. to "non-participation" — which
+    would make results differ between machines with and without a
+    compiler.)"""
+    skip = _csv_header_lines(path)
+    rows: list = []
+    width = -1
+    with open(path) as f:
+        data_row = 0
+        header_left = skip
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if header_left > 0:
+                header_left -= 1
+                continue
+            vals = []
+            for tok in line.split(","):
+                tok = tok.strip()
+                if tok.lower() in _NA_TOKENS:
+                    vals.append(np.nan)
+                    continue
+                try:
+                    vals.append(float(tok))
+                except ValueError:
+                    raise ValueError(
+                        f"{path}: bad field or ragged row at data row "
+                        f"{data_row}") from None
+            if width < 0:
+                width = len(vals)
+            elif len(vals) != width:
+                raise ValueError(f"{path}: bad field or ragged row at data "
+                                 f"row {data_row}")
+            rows.append(vals)
+            data_row += 1
+    if not rows:
+        raise ValueError(f"{path}: not a readable, non-empty CSV")
+    return np.asarray(rows, dtype=np.float64)
+
+
 def load_reports(path, mmap: bool = False) -> np.ndarray:
     """Load a reports matrix from ``.npy`` or ``.csv``.
 
@@ -91,14 +137,8 @@ def load_reports(path, mmap: bool = False) -> np.ndarray:
         from . import _native
 
         arr = _native.csv_read(path)
-        if arr is None:                      # no compiler: pure-numpy path
-            arr = np.genfromtxt(path, delimiter=",",
-                                skip_header=_csv_header_lines(path),
-                                missing_values=("NA", "na", "null", "NULL",
-                                                ""),
-                                filling_values=np.nan, ndmin=2)
-            if arr.ndim != 2 or np.isnan(arr).all():
-                raise ValueError(f"{path}: not a parseable reports CSV")
+        if arr is None:                      # no compiler: pure-Python path
+            arr = _csv_read_fallback(path)
         return arr
     raise ValueError(f"unsupported reports format {path.suffix!r} "
                      f"(use .npy or .csv)")

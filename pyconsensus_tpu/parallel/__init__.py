@@ -1,11 +1,19 @@
 """Device-mesh parallelism: event-axis sharding for large oracles (the
-long-context analogue, SURVEY.md §5) and batch sharding for sweeps.
-XLA/GSPMD inserts the ICI collectives; no hand-written communication."""
+long-context analogue, SURVEY.md §5), batch sharding for sweeps, explicit
+ring collectives (``ring``), and the multi-host ICI x DCN runtime
+(``distributed``). The production path is GSPMD (XLA inserts the ICI
+collectives); the ring module is the hand-written backend for panel-wise
+accumulation and fixed reduction order."""
 
+from .distributed import (initialize, is_distributed, make_hybrid_mesh,
+                          num_slices)
 from .mesh import (Mesh, NamedSharding, P, batch_event_sharding,
                    event_sharding, make_mesh, replicated)
+from .ring import ring_allreduce, ring_first_pc, ring_gram, ring_matvec
 from .sharded import ShardedOracle, sharded_consensus
 
 __all__ = ["make_mesh", "event_sharding", "batch_event_sharding",
            "replicated", "Mesh", "NamedSharding", "P",
-           "ShardedOracle", "sharded_consensus"]
+           "ShardedOracle", "sharded_consensus",
+           "ring_allreduce", "ring_gram", "ring_matvec", "ring_first_pc",
+           "initialize", "is_distributed", "make_hybrid_mesh", "num_slices"]

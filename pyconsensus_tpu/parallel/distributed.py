@@ -1,0 +1,132 @@
+"""Multi-host / multi-slice runtime: process initialization and hybrid
+ICI x DCN meshes.
+
+The reference is one Python process (SURVEY.md §2 "Parallelism components":
+no NCCL/MPI/Gloo, zero IPC). Its rebuild equivalent is the JAX distributed
+runtime: every host runs the same SPMD program, ``jax.distributed`` wires
+the processes into one system, and XLA compiles the collectives — intra-
+slice reductions ride ICI, cross-slice traffic rides DCN. The design rule
+(scaling-book recipe) is to put the *bandwidth-hungry* axis on ICI and the
+*embarrassingly parallel* axis on DCN:
+
+- ``"event"`` (sharded covariance/Gram contractions, the per-step
+  all-reduces of power iteration) -> **ICI within a slice**;
+- ``"batch"`` (independent oracle resolutions: Monte-Carlo trials,
+  multi-market sweeps — one small metric gather at the end) -> **DCN
+  across slices**.
+
+:func:`initialize` is the one call a launcher makes on each host;
+:func:`make_hybrid_mesh` builds the (batch, event) mesh with DCN on the
+batch axis whenever the platform reports multiple slices, and degrades to
+the flat single-slice mesh (:func:`.mesh.make_mesh`) everywhere else, so
+the same user code runs from 1 chip to a multi-pod fleet unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import make_mesh
+
+__all__ = ["initialize", "make_hybrid_mesh", "num_slices", "is_distributed"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """Join this process to the distributed JAX runtime (idempotent).
+
+    Must be the first jax call in the process (before anything that
+    initializes the XLA backend — ``jax.devices()``, any computation), the
+    same contract as ``jax.distributed.initialize``, which this wraps.
+
+    With no arguments, defers to jax's own cluster auto-detection (Cloud
+    TPU metadata, SLURM, Open MPI, ``JAX_COORDINATOR_ADDRESS``-style env);
+    when nothing is detectable — a plain single-host run — it degrades to
+    a no-op instead of raising, so the same launcher code runs from one
+    chip to a pod. Explicit arguments mirror ``jax.distributed.initialize``
+    and *do* raise on failure (a misconfigured multi-host launch must not
+    silently continue as N single-process jobs).
+    """
+    global _initialized
+    if _initialized:
+        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        _initialized = True
+        return
+    explicit = coordinator_address is not None
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id,
+                                   local_device_ids=local_device_ids)
+    except ValueError:
+        # jax's auto-detection found no cluster spec
+        # ('coordinator_address should be defined') -> single-process run
+        if explicit:
+            raise
+        return
+    except RuntimeError:
+        # 'distributed.initialize should only be called once' — someone
+        # (launcher framework, user code) already joined the runtime
+        if explicit:
+            raise
+    _initialized = True
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def _slice_index(device) -> int:
+    # TPU devices expose slice_index on multi-slice (Megascale/DCN)
+    # topologies; everything else is one slice
+    return getattr(device, "slice_index", 0)
+
+
+def num_slices(devices: Optional[Sequence] = None) -> int:
+    devices = devices if devices is not None else jax.devices()
+    return len({_slice_index(d) for d in devices})
+
+
+def make_hybrid_mesh(batch: Optional[int] = None,
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """(batch, event) mesh laid out so the event axis never crosses DCN.
+
+    Multi-slice topology: batch axis = slices (DCN), event axis = chips
+    within a slice (ICI); ``batch`` may further subdivide within slices if
+    it is a multiple of the slice count. Single slice: plain
+    :func:`.mesh.make_mesh` (batch defaults to 1 -> all chips on events).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    slices = sorted({_slice_index(d) for d in devices})
+    if len(slices) <= 1:
+        return make_mesh(batch=batch or 1, devices=devices)
+
+    by_slice = [[d for d in devices if _slice_index(d) == s] for s in slices]
+    per = len(by_slice[0])
+    if any(len(g) != per for g in by_slice):
+        raise ValueError("uneven chips per slice: "
+                         f"{[len(g) for g in by_slice]}")
+    n_slices = len(slices)
+    batch = batch if batch is not None else n_slices
+    if batch % n_slices != 0:
+        raise ValueError(f"batch={batch} must be a multiple of the slice "
+                         f"count {n_slices} so the event axis stays inside "
+                         "a slice (ICI)")
+    sub = batch // n_slices            # extra batch ways inside each slice
+    if per % sub != 0:
+        raise ValueError(f"chips-per-slice {per} not divisible by "
+                         f"within-slice batch factor {sub}")
+    # grid rows = batch groups; each row's event neighbors are same-slice
+    grid = np.asarray([g[i * (per // sub):(i + 1) * (per // sub)]
+                       for g in by_slice for i in range(sub)])
+    return Mesh(grid, ("batch", "event"))

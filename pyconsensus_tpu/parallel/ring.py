@@ -1,0 +1,211 @@
+"""Explicit ring collectives over the event-sharded mesh (shard_map +
+``ppermute``).
+
+The production sharded path (:mod:`.sharded`) is GSPMD: XLA sees the
+event-axis contractions and inserts all-reduces itself, which on TPU lower
+to the same bandwidth-optimal ring over ICI that NCCL uses on GPU. This
+module is the framework's *hand-written* collective backend — the
+ring-attention / sequence-parallel analogue called for by SURVEY.md §5
+("block-wise/ring-style partial covariance accumulation over event shards")
+— for the cases where explicit control beats the partitioner:
+
+- **Chunked Gram accumulation** (:func:`ring_gram`): at large R the (R, R)
+  Gram partial is itself big (10k reporters -> 400 MB f32 per device). The
+  ring reduce-scatter accumulates it in R/n-row *panels* that hop
+  neighbor-to-neighbor, so each step's live communication buffer is 1/n of
+  the matrix and the adds overlap the ICI transfers — exactly how ring
+  attention keeps KV panels flowing while the local block computes.
+- **Deterministic reduction order**: a ring visits shards in a fixed
+  neighbor order, so sums are bitwise-reproducible run-to-run for a given
+  mesh size — useful for the parity harness, where GSPMD's reduction
+  topology is an implementation detail that may change between XLA
+  versions.
+
+Everything here is pure jax: ``shard_map`` gives per-device programs,
+``lax.ppermute`` moves panels around the ring, and the whole thing jits and
+composes with the rest of the pipeline. Reference parallel: none — the
+reference (SURVEY.md §2 "Parallelism components") is a single-process numpy
+library with zero inter-process communication; this subsystem is new,
+TPU-native surface.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 canonical location
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    # manual ppermute rings defeat shard_map's static replication checker —
+    # the all-reduced outputs ARE replicated, the checker just can't prove it
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover - pre-0.8 spelling
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+__all__ = ["ring_allreduce", "ring_gram", "ring_first_pc", "ring_matvec"]
+
+
+def _ring_perm(n: int):
+    """Neighbor permutation i -> i+1 (mod n): one hop around the ICI ring."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_allreduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Bandwidth-optimal ring all-reduce of a per-device partial ``x``:
+    reduce-scatter phase (n-1 hops, each device ends up owning the full sum
+    of one 1/n chunk) followed by an all-gather phase (n-1 hops circulating
+    the finished chunks). Per-device bytes on the wire: 2(n-1)/n of the
+    tensor — the same as ``psum``'s lowering, but written out so the chunk
+    order (and therefore the floating-point add order) is fixed by
+    construction.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound. ``x`` is padded
+    up to a multiple of n on the leading axis internally.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    idx = lax.axis_index(axis_name)
+    shape = x.shape
+    lead = shape[0] if shape else 1
+    flat = x.reshape(lead, -1) if shape else x.reshape(1, 1)
+    pad = (-lead) % n
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad, flat.shape[1]), flat.dtype)], axis=0)
+    chunks = flat.reshape(n, -1, flat.shape[1])  # (n, lead/n, cols)
+    perm = _ring_perm(n)
+
+    # --- reduce-scatter: after n-1 hops, device d owns sum-chunk (d+1) mod n
+    def rs_body(k, carry):
+        # each hop: send the running chunk to our successor, receive the
+        # predecessor's running chunk and add our local contribution to it
+        acc = carry
+        moving = lax.ppermute(acc[0], axis_name, perm)
+        recv_idx = (idx - k - 1) % n
+        acc = acc.at[0].set(moving + chunks[recv_idx])
+        return acc
+
+    # carry[0] is the in-flight accumulating chunk; start with our own
+    # contribution to the chunk our successor chain will finish
+    start = chunks[idx][None]
+    acc = lax.fori_loop(0, n - 1, rs_body, start)
+    owned = acc[0]                     # device idx owns chunk (idx+1-n) % n
+    owned_idx = (idx + 1) % n
+
+    # --- all-gather: circulate the n finished chunks around the ring
+    out = jnp.zeros_like(chunks)
+    out = out.at[owned_idx].set(owned)
+
+    def ag_body(k, carry):
+        out, moving, moving_idx = carry
+        moving = lax.ppermute(moving, axis_name, perm)
+        moving_idx = (moving_idx - 1) % n
+        out = out.at[moving_idx].set(moving)
+        return out, moving, moving_idx
+
+    out, _, _ = lax.fori_loop(0, n - 1, ag_body, (out, owned, owned_idx))
+    full = out.reshape(-1, flat.shape[1])
+    if pad:
+        full = full[:lead]
+    return full.reshape(shape) if shape else full.reshape(())
+
+
+def _gram_local(A_local: jnp.ndarray) -> jnp.ndarray:
+    """Local event-shard partial of A @ A.T (contracts the local columns)."""
+    return jnp.matmul(A_local, A_local.T,
+                      preferred_element_type=A_local.dtype)
+
+
+def ring_gram(A: jnp.ndarray, mesh: Mesh, axis_name: str = "event"):
+    """G = A @ A.T for an (R, E) matrix sharded over ``axis_name`` columns,
+    with the (R, R) partials combined by the explicit ring all-reduce —
+    panel-wise accumulation in fixed neighbor order (1/n of the matrix in
+    flight per hop) instead of a partitioner-scheduled one-shot all-reduce.
+    Returns G fully replicated.
+    """
+    f = shard_map(
+        lambda a: ring_allreduce(_gram_local(a), axis_name),
+        mesh=mesh,
+        in_specs=P(None, axis_name),
+        out_specs=P(),
+    )
+    return f(A)
+
+
+def ring_matvec(A: jnp.ndarray, v: jnp.ndarray, mesh: Mesh,
+                axis_name: str = "event"):
+    """t = A @ v with A (R, E) and v (E,) both sharded over events: local
+    partial matvec + ring all-reduce of the (R,) partials. Returns t
+    replicated."""
+    f = shard_map(
+        lambda a, vv: ring_allreduce(a @ vv, axis_name),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(axis_name)),
+        out_specs=P(),
+    )
+    return f(A, v)
+
+
+def ring_first_pc(reports_filled, reputation, mesh: Mesh,
+                  axis_name: str = "event", n_iters: int = 128,
+                  tol: float = 0.0):
+    """First principal component of the reputation-weighted covariance with
+    every cross-shard reduction on the explicit ring (jax_kernels
+    ``_first_pc_eigh_gram`` semantics — exact Gram-trick eigh — but the
+    O(R^2) partial combination is panel-wise over ICI, and the map-back
+    matvec keeps the loading event-sharded until the final gather).
+
+    ``n_iters``/``tol`` are accepted for signature parity with the power
+    path but unused (the Gram eigh is closed-form).
+    Returns ``(loading (E,), scores (R,))`` replicated, sign arbitrary.
+    """
+    dtype = reports_filled.dtype
+    rep = reputation.astype(dtype)
+
+    # weighted column means: local over events — no communication
+    def _center_local(x_local, rep_):
+        mu_local = rep_ @ x_local
+        return x_local - mu_local[None, :]
+
+    center = shard_map(_center_local, mesh=mesh,
+                       in_specs=(P(None, axis_name), P()),
+                       out_specs=P(None, axis_name))
+    dev = center(reports_filled, rep)
+
+    denom = 1.0 - jnp.sum(rep ** 2)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    sqrt_rep = jnp.sqrt(jnp.clip(rep, 0.0, None))
+    A = dev * sqrt_rep[:, None]
+
+    G = ring_gram(A, mesh, axis_name) / denom          # (R, R) replicated
+    _, eigvecs = jnp.linalg.eigh(G)
+    u = eigvecs[:, -1]
+
+    # map back to the event axis: v = A.T u stays sharded; only its norm
+    # (a scalar) crosses the ring
+    def _mapback_local(a_local, u_):
+        v_local = a_local.T @ u_
+        sq = ring_allreduce(jnp.sum(v_local ** 2)[None], axis_name)[0]
+        norm = jnp.sqrt(sq)
+        return v_local / jnp.where(norm == 0.0, 1.0, norm)
+
+    mapback = shard_map(_mapback_local, mesh=mesh,
+                        in_specs=(P(None, axis_name), P()),
+                        out_specs=P(axis_name))
+    loading = mapback(A, u)
+
+    scores = ring_matvec(dev, loading, mesh, axis_name)
+    return loading, scores

@@ -97,11 +97,41 @@ def test_make_per_library_targets():
     """Each library builds via its own Makefile target, so one failing to
     compile cannot block the other."""
     import pathlib
+    import shutil
     import subprocess
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no make/g++ toolchain")
     src = pathlib.Path(__file__).parent.parent / "native"
     for target in ("cluster", "loader"):
         subprocess.run(["make", "-C", str(src), target], check=True,
                        capture_output=True, timeout=120)
+
+
+def test_fallback_strict_like_native(tmp_path):
+    """The pure-Python fallback must REJECT corrupt fields and ragged rows
+    exactly like the native parser — never coerce them to NaN (which would
+    silently turn corruption into 'non-participation' and make results
+    differ between machines with and without a compiler)."""
+    from pyconsensus_tpu.io import _csv_read_fallback
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,bogus,6\n")
+    with pytest.raises(ValueError, match="row 1"):
+        _csv_read_fallback(p)
+    p.write_text("1,2,3\n4,5\n")
+    with pytest.raises(ValueError, match="row 1"):
+        _csv_read_fallback(p)
+    p.write_text("")
+    with pytest.raises(ValueError, match="non-empty"):
+        _csv_read_fallback(p)
+    # and it must ACCEPT the full valid grammar identically: header, NA
+    # markers, blank lines, +-prefixed floats
+    p.write_text("event_a,event_b\n\n1.0,+2.5\nNA, 0.5 \n")
+    out = _csv_read_fallback(p)
+    np.testing.assert_array_equal(
+        out, np.array([[1.0, 2.5], [np.nan, 0.5]]))
+    native = _native.csv_read(p)
+    if native is not None:
+        np.testing.assert_array_equal(out, native)
 
 
 def test_csv_ragged_row_rejected(tmp_path):

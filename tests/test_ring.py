@@ -1,0 +1,160 @@
+"""Explicit ring-collective tests (parallel.ring) on the simulated
+8-device CPU mesh, plus the hybrid ICI x DCN mesh builder
+(parallel.distributed). The ring results must match both plain numpy and
+the GSPMD kernel path — same math, different (fixed) reduction order."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.ops import jax_kernels as jk
+from pyconsensus_tpu.parallel import (make_hybrid_mesh, make_mesh, num_slices,
+                                      ring_allreduce, ring_first_pc,
+                                      ring_gram, ring_matvec)
+from pyconsensus_tpu.parallel.ring import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(batch=1, event=8)
+
+
+class TestRingAllreduce:
+    @pytest.mark.parametrize("shape", [(8, 5), (16, 3), (7, 4), (1, 9), (24,)])
+    def test_matches_psum(self, rng, mesh8, shape):
+        """Ring all-reduce of per-device partials == sum over the axis,
+        including leading dims not divisible by the 8 devices (padding)."""
+        parts = rng.standard_normal((8,) + shape)
+
+        def local(x):
+            return ring_allreduce(x[0], "event")
+
+        f = shard_map(local, mesh8, in_specs=P("event"), out_specs=P())
+        out = f(jnp.asarray(parts))
+        np.testing.assert_allclose(np.asarray(out), parts.sum(axis=0),
+                                   rtol=1e-12)
+
+    def test_scalarish(self, mesh8):
+        parts = np.arange(8.0).reshape(8, 1)
+        f = shard_map(lambda x: ring_allreduce(x[0], "event"),
+                      mesh8, in_specs=P("event"), out_specs=P())
+        np.testing.assert_allclose(np.asarray(f(jnp.asarray(parts))), [28.0])
+
+    def test_deterministic_order(self, rng, mesh8):
+        """Same inputs -> bitwise-identical sums across calls (the ring's
+        fixed neighbor order is the whole point)."""
+        parts = rng.standard_normal((8, 13, 7)).astype(np.float32)
+        f = jax.jit(shard_map(lambda x: ring_allreduce(x[0], "event"),
+                              mesh8, in_specs=P("event"), out_specs=P()))
+        a = np.asarray(f(jnp.asarray(parts)))
+        b = np.asarray(f(jnp.asarray(parts)))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestRingGramMatvec:
+    def test_gram(self, rng, mesh8):
+        X = rng.standard_normal((24, 64))
+        G = ring_gram(jnp.asarray(X), mesh8)
+        np.testing.assert_allclose(np.asarray(G), X @ X.T, rtol=1e-10)
+
+    def test_gram_uneven_reporters(self, rng, mesh8):
+        # R=13 not divisible by 8: exercises the padding path on (R, R)
+        X = rng.standard_normal((13, 40))
+        G = ring_gram(jnp.asarray(X), mesh8)
+        np.testing.assert_allclose(np.asarray(G), X @ X.T, rtol=1e-10)
+
+    def test_matvec(self, rng, mesh8):
+        X = rng.standard_normal((24, 64))
+        v = rng.standard_normal(64)
+        t = ring_matvec(jnp.asarray(X), jnp.asarray(v), mesh8)
+        np.testing.assert_allclose(np.asarray(t), X @ v, rtol=1e-10)
+
+
+class TestRingFirstPC:
+    def test_matches_gram_kernel(self, rng, mesh8):
+        X = rng.random((24, 64))
+        rep = np.full(24, 1.0 / 24)
+        l_ref, s_ref = jk.weighted_prin_comp(jnp.asarray(X), jnp.asarray(rep),
+                                             method="eigh-gram")
+        l, s = ring_first_pc(jnp.asarray(X), jnp.asarray(rep), mesh8)
+        sign = np.sign(np.dot(np.asarray(l), np.asarray(l_ref)))
+        np.testing.assert_allclose(sign * np.asarray(l), np.asarray(l_ref),
+                                   atol=1e-9)
+        np.testing.assert_allclose(sign * np.asarray(s), np.asarray(s_ref),
+                                   atol=1e-9)
+
+    def test_nonuniform_reputation(self, rng, mesh8):
+        X = rng.random((16, 32))
+        rep = rng.random(16)
+        rep /= rep.sum()
+        l_ref, s_ref = jk.weighted_prin_comp(jnp.asarray(X), jnp.asarray(rep),
+                                             method="eigh-gram")
+        l, s = ring_first_pc(jnp.asarray(X), jnp.asarray(rep), mesh8)
+        sign = np.sign(np.dot(np.asarray(l), np.asarray(l_ref)))
+        np.testing.assert_allclose(sign * np.asarray(s), np.asarray(s_ref),
+                                   atol=1e-9)
+
+    def test_jits(self, rng, mesh8):
+        X = jnp.asarray(rng.random((16, 32)))
+        rep = jnp.full((16,), 1.0 / 16)
+        f = jax.jit(lambda x, r: ring_first_pc(x, r, mesh8))
+        l, s = f(X, rep)
+        assert l.shape == (32,) and s.shape == (16,)
+
+
+class TestHybridMesh:
+    def test_single_slice_falls_back(self):
+        """CPU devices report no slice_index -> one slice -> flat mesh."""
+        assert num_slices() == 1
+        m = make_hybrid_mesh()
+        assert m.shape == {"batch": 1, "event": 8}
+        m = make_hybrid_mesh(batch=2)
+        assert m.shape == {"batch": 2, "event": 4}
+
+    def test_multi_slice_layout(self):
+        """Fake a 2-slice x 4-chip topology: event neighbors must be
+        same-slice (ICI), batch crosses slices (DCN)."""
+
+        class FakeDev:
+            def __init__(self, i, s):
+                self.id, self.slice_index = i, s
+
+            def __repr__(self):
+                return f"d{self.id}s{self.slice_index}"
+
+        devs = [FakeDev(i, i // 4) for i in range(8)]
+        assert num_slices(devs) == 2
+        import numpy as _np
+
+        from pyconsensus_tpu.parallel.distributed import _slice_index
+        from jax.sharding import Mesh
+        m = make_hybrid_mesh(devices=devs)
+        assert isinstance(m, Mesh)
+        grid = _np.asarray(m.devices)
+        assert grid.shape == (2, 4)
+        for row in grid:           # each event row lives in exactly 1 slice
+            assert len({_slice_index(d) for d in row}) == 1
+
+    def test_multi_slice_subdivided_batch(self):
+        class FakeDev:
+            def __init__(self, i, s):
+                self.id, self.slice_index = i, s
+
+        devs = [FakeDev(i, i // 4) for i in range(8)]
+        import numpy as _np
+        m = make_hybrid_mesh(batch=4, devices=devs)
+        grid = _np.asarray(m.devices)
+        assert grid.shape == (4, 2)
+        for row in grid:
+            assert len({d.slice_index for d in row}) == 1
+
+    def test_bad_batch_rejected(self):
+        class FakeDev:
+            def __init__(self, i, s):
+                self.id, self.slice_index = i, s
+
+        devs = [FakeDev(i, i // 4) for i in range(8)]
+        with pytest.raises(ValueError, match="multiple of the slice"):
+            make_hybrid_mesh(batch=3, devices=devs)
