@@ -6,15 +6,18 @@ that 1-resolution-per-second target).
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "resolutions/sec", "vs_baseline": N}
 
-Methodology (changed 2026-07-29): the reported value is *throughput* —
-resolutions dispatched back-to-back with one barrier per batch, median over
-batches — because the metric is resolutions/sec and per-call blocking would
-charge the host↔TPU tunnel round trip to every resolution. Blocking
-per-resolution latency is still probed against the 1 s north-star target
-(stderr warning on a miss), and when the low-precision matvec path is active
-its outcomes are asserted bit-identical to full precision on every run.
-Numbers recorded before this date used blocking per-call median timing and
-read ~30% lower for the same device work.
+Methodology (changed 2026-07-29, barrier fixed 2026-07-30): the reported
+value is *throughput* — resolutions dispatched back-to-back, one barrier
+per batch on a device-side combine of every resolution's certainty scalar,
+median over batches — because the metric is resolutions/sec and per-call
+blocking would charge the host↔TPU tunnel round trip (~90 ms) to every
+resolution. Blocking per-resolution latency is still probed against the 1 s
+north-star target (stderr warning on a miss), and whenever low-precision
+storage is active its outcomes are asserted bit-identical to full precision
+on every run. Numbers before 2026-07-30 fetched each resolution's scalar
+separately, serializing one tunnel round-trip per resolution (~45% of the
+reported time); numbers before 2026-07-29 blocked per call and read lower
+still for the same device work.
 
 The matrix is generated on device (no multi-GB host transfer), events are
 sharded over every available chip, and the resolution runs the full pipeline:
@@ -66,12 +69,17 @@ def main() -> None:
     ap.add_argument("--pca-method", default="auto",
                     help="auto picks the fused Pallas kernel on single-"
                          "device TPU, XLA matvecs on a multi-chip mesh")
-    ap.add_argument("--matvec-dtype", default="bfloat16",
-                    help="storage dtype for the bandwidth-bound power-"
-                         "iteration sweeps (f32 accumulation). bfloat16 "
-                         "halves their HBM traffic and was verified "
-                         "outcome-bit-identical to the f32 path at "
-                         "north-star scale; pass '' for full precision")
+    ap.add_argument("--matvec-dtype", default="",
+                    help="low-precision dtype for only the power-iteration "
+                         "sweeps (subsumed by --storage-dtype; pass "
+                         "'bfloat16' with --storage-dtype '' to lower just "
+                         "the PCA phase)")
+    ap.add_argument("--storage-dtype", default="bfloat16",
+                    help="storage dtype for the filled matrix through the "
+                         "whole pipeline (f32 accumulation everywhere). "
+                         "bfloat16 halves every O(R*E) phase's HBM traffic; "
+                         "outcomes are asserted bit-identical to the full-"
+                         "precision path on every run. Pass '' for f32")
     args = ap.parse_args()
 
     from pyconsensus_tpu.models.pipeline import ConsensusParams
@@ -91,7 +99,7 @@ def main() -> None:
     params = ConsensusParams(
         algorithm="sztorc", max_iterations=args.max_iterations,
         pca_method=args.pca_method, power_iters=args.power_iters,
-        matvec_dtype=args.matvec_dtype,
+        matvec_dtype=args.matvec_dtype, storage_dtype=args.storage_dtype,
         any_scaled=False, has_na=True)
 
     def resolve():
@@ -125,36 +133,45 @@ def main() -> None:
 
     # The headline metric is resolutions/sec (BASELINE.json "Consensus
     # rounds/sec"), so the timed batches dispatch resolutions back-to-back
-    # and barrier once at the end: successive resolutions overlap the
-    # tunnel/dispatch RTT and the device queue never drains. Every
-    # resolution's scalar is still fetched, so nothing is skipped. The
-    # median batch rate is reported — robust to a jitter-fast outlier.
-    rates = []
-    for _ in range(args.batches):
+    # and barrier ONCE per batch on a device-side combine of every
+    # resolution's certainty scalar: each resolution's output feeds the
+    # fetched value (nothing is skipped), the device queue never drains,
+    # and only one tunnel round-trip (~90 ms here) is charged per batch
+    # instead of per resolution — fetch serialization was costing ~45% of
+    # the reported rate. The median batch rate is reported.
+    def run_batch(n):
         t0 = time.perf_counter()
-        outs = [resolve() for _ in range(args.repeats)]
-        for o in outs:
-            force(o)
-        rates.append(args.repeats / (time.perf_counter() - t0))
+        outs = [resolve() for _ in range(n)]
+        float(np.asarray(jnp.stack([o["avg_certainty"] for o in outs]).sum()))
+        return time.perf_counter() - t0
+
+    # warm the (repeats,)-shaped stacked-combine jit on replicas of the
+    # already-computed warm output — compiling it must not cost a whole
+    # batch of full resolutions
+    float(np.asarray(jnp.stack([out["avg_certainty"]] * args.repeats).sum()))
+    rates = [args.repeats / run_batch(args.repeats)
+             for _ in range(args.batches)]
     value = float(np.median(rates))
 
     # sanity: resolution actually produced valid catch-snapped outcomes
     outcomes = np.asarray(out["outcomes_adjusted"])
     assert np.isin(outcomes, [0.0, 0.5, 1.0]).all()
 
-    # Low-precision honesty check: when the matvec storage dtype is not full
+    # Low-precision honesty check: when any storage dtype is below full
     # precision, re-resolve with the f32 path and require every outcome to
     # be bit-identical — the bf16 default is only legitimate because the
-    # catch snap absorbs the loading noise, and this enforces that claim on
+    # catch snap absorbs the float noise, and this enforces that claim on
     # every run rather than asserting it in a help string.
-    if args.matvec_dtype:
+    if args.matvec_dtype or args.storage_dtype:
         full = sharded_consensus(
-            reports, mesh=mesh, params=params._replace(matvec_dtype=""))
+            reports, mesh=mesh,
+            params=params._replace(matvec_dtype="", storage_dtype=""))
         full_outcomes = np.asarray(full["outcomes_adjusted"])
         assert np.array_equal(outcomes, full_outcomes), (
-            f"matvec_dtype={args.matvec_dtype!r} changed "
+            f"low-precision storage (matvec={args.matvec_dtype!r}, "
+            f"storage={args.storage_dtype!r}) changed "
             f"{int((outcomes != full_outcomes).sum())} outcomes vs full "
-            f"precision — rerun with --matvec-dtype ''")
+            f"precision — rerun with --matvec-dtype '' --storage-dtype ''")
 
     target_resolutions_per_sec = 1.0   # north star: < 1 s per resolution
     print(json.dumps({

@@ -22,6 +22,7 @@ TPU-native framework's ingestion path:
 from __future__ import annotations
 
 import pathlib
+import re as _re
 from typing import Optional
 
 import numpy as np
@@ -51,6 +52,14 @@ def save_reports(path, reports) -> pathlib.Path:
 
 
 _NA_TOKENS = frozenset({"", "na", "nan", "null"})
+
+#: the float grammar ``native/loader.cpp`` accepts — optional sign (the
+#: native parser strips a leading '+' before std::from_chars), ASCII
+#: decimal/scientific, inf/infinity. No digit separators, no hex, no
+#: unicode digits.
+_FLOAT_GRAMMAR = _re.compile(
+    r"[+-]?(?:inf(?:inity)?|(?:[0-9]+\.?[0-9]*|\.[0-9]+)(?:[eE][+-]?[0-9]+)?)$",
+    _re.IGNORECASE | _re.ASCII)
 
 
 def _csv_header_lines(path) -> int:
@@ -101,6 +110,12 @@ def _csv_read_fallback(path) -> np.ndarray:
                 if tok.lower() in _NA_TOKENS:
                     vals.append(np.nan)
                     continue
+                # bare float() is LOOSER than the native std::from_chars
+                # grammar (it takes '1_5', unicode digits); gate on the
+                # exact grammar first so both parsers accept the same files
+                if not _FLOAT_GRAMMAR.match(tok):
+                    raise ValueError(f"{path}: bad field or ragged row at "
+                                     f"data row {data_row}")
                 try:
                     vals.append(float(tok))
                 except ValueError:

@@ -67,6 +67,16 @@ class ConsensusParams(NamedTuple):
     #: matvecs ("" = full precision; "bfloat16" halves the HBM traffic of
     #: the dominant phase at north-star scale; outcomes stay catch-snapped)
     matvec_dtype: str = ""
+    #: storage dtype for the filled reports matrix through the WHOLE
+    #: pipeline ("" = input dtype). "bfloat16" halves the HBM traffic of
+    #: every O(R*E) phase — fill, PCA sweeps, direction fix, outcome and
+    #: bonus contractions — while all reductions still accumulate in the
+    #: reputation dtype (f32). Binary report values {0, 0.5, 1} and their
+    #: catch-snapped fills are bf16-exact, so catch-snapped outcomes are
+    #: unaffected (the bench asserts this every run); scaled-event medians
+    #: round to bf16 resolution (~3 decimal digits) — leave unset for
+    #: scaled workloads that need full precision.
+    storage_dtype: str = ""
     #: static shape-of-the-data flags, set by the Oracle from the host-side
     #: matrix. They never change results — they let XLA skip whole phases
     #: (the NA fill pass, the per-column median sort, rescaling) when the
@@ -189,7 +199,7 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
         return (rep_out, this_out, loading_out, conv_out, iters_out), None
 
     n = max(p.max_iterations, 1)
-    init = (old_rep, old_rep, jnp.zeros((E,), dtype=filled.dtype),
+    init = (old_rep, old_rep, jnp.zeros((E,), dtype=old_rep.dtype),
             jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
     (rep, this_rep, loading, converged, iters), _ = lax.scan(
         step, init, None, length=n)
@@ -203,15 +213,23 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     at north-star scale each elided phase is a multi-GB HBM pass."""
     old_rep = jk.normalize(reputation)
     rescaled = jk.rescale(reports, scaled, mins, maxs) if p.any_scaled else reports
-    filled = (jk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
-              if p.has_na else rescaled)
+    if p.has_na:
+        filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
+                                                p.catch_tolerance)
+    else:
+        filled, present = rescaled, None
+    if p.storage_dtype:
+        # downstream of the fill, the matrix is pure payload: store it
+        # compactly (one (R, E) buffer) and let every later phase sweep
+        # half the bytes; `present` is the only memory of where NaNs were
+        filled = filled.astype(jnp.dtype(p.storage_dtype))
     rep, this_rep, loading, converged, iters = _iterate_jax(filled, old_rep, p)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
-        rescaled, filled, rep, scaled, p.catch_tolerance,
+        present, filled, rep, scaled, p.catch_tolerance,
         any_scaled=p.any_scaled, has_na=p.has_na)
     outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
                       if p.any_scaled else outcomes_adjusted)
-    extras = jk.certainty_and_bonuses(rescaled, filled, rep, outcomes_adjusted,
+    extras = jk.certainty_and_bonuses(present, filled, rep, outcomes_adjusted,
                                       scaled, p.catch_tolerance,
                                       has_na=p.has_na)
     result = {
@@ -221,7 +239,7 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
         "old_rep": old_rep,
         "this_rep": this_rep,
         "smooth_rep": rep,
-        "na_row": (jnp.isnan(reports).any(axis=1) if p.has_na
+        "na_row": (~present.all(axis=1) if p.has_na
                    else jnp.zeros((reports.shape[0],), dtype=bool)),
         "outcomes_raw": outcomes_raw,
         "outcomes_adjusted": outcomes_adjusted,
@@ -263,7 +281,8 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     updates run on host against a device-computed R×R distance matrix."""
     old_rep = jk.normalize(reputation)
     rescaled = jk.rescale(reports, scaled, mins, maxs)
-    filled = jk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+    filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
+                                            p.catch_tolerance)
 
     filled_host = np.asarray(filled, dtype=np.float64)
     # the clustering inputs (filled reports, hence distances) are
@@ -291,10 +310,10 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
 
     rep_dev = jnp.asarray(rep, dtype=filled.dtype)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
-        rescaled, filled, rep_dev, scaled, p.catch_tolerance,
+        present, filled, rep_dev, scaled, p.catch_tolerance,
         any_scaled=p.any_scaled, has_na=p.has_na)
     outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
-    extras = jk.certainty_and_bonuses(rescaled, filled, rep_dev,
+    extras = jk.certainty_and_bonuses(present, filled, rep_dev,
                                       outcomes_adjusted, scaled,
                                       p.catch_tolerance)
     result = {

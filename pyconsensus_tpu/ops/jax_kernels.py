@@ -35,6 +35,7 @@ __all__ = [
     "rescale",
     "unscale_outcomes",
     "interpolate",
+    "interpolate_masked",
     "weighted_cov",
     "weighted_prin_comp",
     "weighted_prin_comps",
@@ -79,10 +80,16 @@ def unscale_outcomes(outcomes, scaled, mins, maxs):
     return jnp.where(scaled, outcomes * (maxs - mins) + mins, outcomes)
 
 
-def interpolate(reports, reputation, scaled, tolerance):
+def interpolate_masked(reports, reputation, scaled, tolerance):
     """Reputation-weighted column-mean fill of NaN entries; binary fills are
     catch-snapped (numpy_kernels.interpolate). One fused pass: XLA folds the
-    mask/where/reduce chain into a single HBM sweep of the (R, E) matrix."""
+    mask/where/reduce chain into a single HBM sweep of the (R, E) matrix.
+
+    Returns ``(filled, present)`` — the bool participation mask is a
+    by-product of the fill and every downstream phase that needs NA
+    accounting (outcome resolution, certainty/bonuses, ``na_row``) consumes
+    it instead of re-deriving ``isnan`` from the raw f32 matrix: after this
+    kernel the original reports never need to be read again."""
     present = ~jnp.isnan(reports)
     zeroed = jnp.where(present, reports, 0.0)
     active_rep = jnp.where(present, reputation[:, None], 0.0)
@@ -90,7 +97,12 @@ def interpolate(reports, reputation, scaled, tolerance):
     numer = jnp.sum(zeroed * reputation[:, None], axis=0)
     fill = jnp.where(denom > 0.0, numer / jnp.where(denom > 0.0, denom, 1.0), 0.5)
     fill = jnp.where(scaled, fill, catch(fill, tolerance))
-    return jnp.where(present, zeroed, fill[None, :])
+    return jnp.where(present, zeroed, fill[None, :]), present
+
+
+def interpolate(reports, reputation, scaled, tolerance):
+    """:func:`interpolate_masked` without the mask (reference-shaped API)."""
+    return interpolate_masked(reports, reputation, scaled, tolerance)[0]
 
 
 def weighted_cov(reports_filled, reputation):
@@ -205,11 +217,17 @@ def _first_pc_power(reports_filled, mu, denom, reputation,
     sweeps (f32 accumulation via ``preferred_element_type``; outcomes are
     catch-snapped, so the loading noise stays far below the snap tolerance
     — the parity-critical f64 path leaves it None).
+
+    The iterates, norms, and early-exit test run in the *reputation* dtype
+    (the accumulation precision), never the matrix storage dtype — a bf16
+    matrix (via ``matvec_dtype`` or a pipeline ``storage_dtype``) only
+    lowers the precision of the streamed operand, not of the convergence
+    arithmetic.
     """
-    out_dtype = reports_filled.dtype
+    out_dtype = reputation.dtype
     mm = (reports_filled if matvec_dtype is None
           else reports_filled.astype(matvec_dtype))
-    rep = reputation.astype(out_dtype)
+    rep = reputation
 
     def apply_cov(v):
         t = jnp.matmul(mm, v.astype(mm.dtype),
@@ -222,7 +240,9 @@ def _first_pc_power(reports_filled, mu, denom, reputation,
 
     loading = _power_loop(apply_cov, reports_filled.shape[1], out_dtype,
                           n_iters, tol)
-    scores = reports_filled @ loading - mu @ loading
+    scores = (jnp.matmul(reports_filled,
+                         loading.astype(reports_filled.dtype),
+                         preferred_element_type=out_dtype) - mu @ loading)
     return loading, scores
 
 
@@ -264,16 +284,18 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
     if method == "power-fused":
         from .pallas_kernels import power_iteration_fused
 
+        acc = reputation.dtype
         mu, denom = _mu_denom(reports_filled, reputation)
         xmm = (reports_filled.astype(jnp.dtype(matvec_dtype))
                if matvec_dtype else reports_filled)
         loading = power_iteration_fused(
             xmm, mu, denom, reputation, power_iters, power_tol,
-            interpret=jax.default_backend() != "tpu").astype(
-                reports_filled.dtype)
+            interpret=jax.default_backend() != "tpu").astype(acc)
         # scores = (X - mu) @ loading without materializing the centered
         # matrix: X @ loading is one sweep; mu . loading is a scalar
-        scores = reports_filled @ loading - mu @ loading
+        scores = (jnp.matmul(reports_filled,
+                             loading.astype(reports_filled.dtype),
+                             preferred_element_type=acc) - mu @ loading)
         return loading, scores
     if method == "power":
         mu, denom = _mu_denom(reports_filled, reputation)
@@ -361,12 +383,18 @@ def weighted_median_cols(values, weights, present):
 def direction_fixed_scores(scores, reports_filled, reputation):
     """PCA sign/direction fix (numpy_kernels.direction_fixed_scores). Runs
     inside the jitted graph; the ``ref_ind <= 0`` tie-break is identical to the
-    numpy kernel so both backends pick the same orientation."""
+    numpy kernel so both backends pick the same orientation.
+
+    The three candidate-outcome projections are stacked into one (3, R) x
+    (R, E) matmul so the matrix is swept once, not three times — at
+    north-star scale each avoided sweep is a multi-GB HBM pass."""
+    acc = scores.dtype
     set1 = scores + jnp.abs(jnp.min(scores))
     set2 = scores - jnp.max(scores)
-    old = reputation @ reports_filled
-    new1 = normalize(set1) @ reports_filled
-    new2 = normalize(set2) @ reports_filled
+    W = jnp.stack([reputation.astype(acc), normalize(set1), normalize(set2)])
+    M = jnp.matmul(W.astype(reports_filled.dtype), reports_filled,
+                   preferred_element_type=acc)
+    old, new1, new2 = M[0], M[1], M[2]
     ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
     return jnp.where(ref_ind <= 0.0, set1, set2)
 
@@ -384,36 +412,47 @@ def smooth(this_rep, old_rep, alpha):
     return alpha * this_rep + (1.0 - alpha) * old_rep
 
 
-def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance,
+def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
                      any_scaled: bool = True, has_na: bool = True):
     """Vectorized outcome resolution (numpy_kernels.resolve_outcomes):
     participation-restricted renormalized reputation; weighted mean for binary
     columns, weighted median for scaled; catch-snap binary outcomes.
 
+    ``present`` is the bool participation mask from
+    :func:`interpolate_masked` (ignored, may be None, when ``has_na`` is
+    False) — threading it here instead of re-deriving ``isnan`` saves a
+    full sweep of the raw f32 matrix, and lets ``reports_filled`` live in a
+    compact storage dtype (the mask is the only memory of where the NaNs
+    were). All contractions accumulate in the reputation dtype.
+
     ``any_scaled`` / ``has_na`` are *static* hints: when ``any_scaled`` is
     False (host knows every event is binary) the per-column weighted-median
     sort — the only O(R log R * E) phase of resolution — is skipped entirely;
     when ``has_na`` is False the participation-restriction reduces to the
-    single full-reputation matvec (the mask is all-True), eliding an isnan
-    sweep and two (R, E) contractions.
+    single full-reputation matvec (the mask is all-True), eliding two
+    (R, E) contractions.
     """
+    acc = smooth_rep.dtype
     full_total = jnp.sum(smooth_rep)
-    full_mean = (smooth_rep @ reports_filled) / jnp.where(full_total == 0.0, 1.0, full_total)
+    full_mean = (jnp.matmul(smooth_rep.astype(reports_filled.dtype),
+                            reports_filled, preferred_element_type=acc)
+                 / jnp.where(full_total == 0.0, 1.0, full_total))
+    R, E = reports_filled.shape
     if has_na:
-        present = ~jnp.isnan(reports)
-        w = smooth_rep[:, None] * present
+        w = jnp.where(present, smooth_rep[:, None].astype(acc), 0.0)
         tw = jnp.sum(w, axis=0)
         safe_tw = jnp.where(tw > 0.0, tw, 1.0)
-        mean_present = jnp.sum(w * reports_filled, axis=0) / safe_tw
+        mean_present = jnp.sum(w * reports_filled.astype(acc),
+                               axis=0) / safe_tw
         means = jnp.where(tw > 0.0, mean_present, full_mean)
     else:
-        present = jnp.ones(reports.shape, dtype=bool)
-        tw = jnp.broadcast_to(full_total, reports.shape[1:])
+        present = jnp.ones((R, E), dtype=bool)
+        tw = jnp.broadcast_to(full_total, (E,))
         means = full_mean
     if any_scaled:
         medians = weighted_median_cols(
-            reports_filled,
-            jnp.broadcast_to(smooth_rep[:, None], reports.shape), present)
+            reports_filled.astype(acc),
+            jnp.broadcast_to(smooth_rep[:, None], (R, E)), present)
         outcomes_raw = jnp.where(tw > 0.0, jnp.where(scaled, medians, means),
                                  means)
     else:
@@ -422,30 +461,36 @@ def resolve_outcomes(reports, reports_filled, smooth_rep, scaled, tolerance,
     return outcomes_raw, outcomes_adjusted
 
 
-def certainty_and_bonuses(reports, reports_filled, smooth_rep, outcomes_adjusted,
+def certainty_and_bonuses(present, reports_filled, smooth_rep, outcomes_adjusted,
                           scaled, tolerance, has_na: bool = True):
     """Certainty / participation / bonus accounting
     (numpy_kernels.certainty_and_bonuses). Binary agreement is exact equality
     on catch-snapped {0, 0.5, 1} values, so it is dtype-independent.
 
+    ``present`` is the participation mask from :func:`interpolate_masked`
+    (ignored, may be None, when ``has_na`` is False); the NA contractions
+    run on it directly rather than re-deriving ``isnan`` from the raw
+    matrix. Reductions accumulate in the reputation dtype.
+
     ``has_na=False`` (static, host-known dense matrix) short-circuits the NA
     accounting to its closed form — an all-zero ``na_mat`` makes
     participation exactly 1 and every bonus collapse onto its base weight —
-    eliding an isnan sweep and two (R, E) contractions over the full matrix.
+    eliding two (R, E) contractions over the full matrix.
     """
-    R, E = reports.shape
-    dtype = reports_filled.dtype
+    R, E = reports_filled.shape
+    dtype = smooth_rep.dtype
     agree = jnp.where(
         scaled[None, :],
-        jnp.abs(reports_filled - outcomes_adjusted[None, :]) <= tolerance,
-        reports_filled == outcomes_adjusted[None, :],
+        jnp.abs(reports_filled.astype(dtype)
+                - outcomes_adjusted[None, :]) <= tolerance,
+        reports_filled.astype(dtype) == outcomes_adjusted[None, :],
     )
     certainty = jnp.sum(agree * smooth_rep[:, None], axis=0)
     consensus_reward = normalize(certainty)
     avg_certainty = jnp.mean(certainty)
 
     if has_na:
-        na_mat = jnp.isnan(reports).astype(dtype)
+        na_mat = (~present).astype(dtype)
         participation_columns = 1.0 - smooth_rep @ na_mat
         participation_rows = 1.0 - na_mat @ consensus_reward
         percent_na = 1.0 - jnp.mean(participation_columns)

@@ -146,6 +146,11 @@ class Oracle:
     power_iters, power_tol, matvec_dtype :
         Power-iteration cap, early-exit tolerance (0 = machine-precision
         floor), and optional low-precision matvec storage ("bfloat16").
+    storage_dtype : str
+        Optional compact storage dtype ("bfloat16") for the filled matrix
+        through the whole jax pipeline — halves HBM traffic of every
+        O(R·E) phase; reductions still accumulate in f32. Binary outcomes
+        stay catch-snap exact; scaled medians round to bf16 resolution.
     verbose : bool
         Print a result summary after ``consensus()`` (reference fidelity).
     """
@@ -170,6 +175,7 @@ class Oracle:
                  power_iters: int = 128,
                  power_tol: float = 0.0,
                  matvec_dtype: str = "",
+                 storage_dtype: str = "",
                  verbose: bool = False):
         if reports is None:
             raise ValueError("reports matrix is required")
@@ -240,6 +246,7 @@ class Oracle:
             power_iters=int(power_iters),
             power_tol=float(power_tol),
             matvec_dtype=str(matvec_dtype),
+            storage_dtype=str(storage_dtype),
         )
 
     # -- core ---------------------------------------------------------------

@@ -62,7 +62,11 @@ def initialize(coordinator_address: Optional[str] = None,
     if is_init is not None and is_init():
         _initialized = True
         return
-    explicit = coordinator_address is not None
+    # ANY explicit argument means the caller is describing a multi-host
+    # launch — a failure must raise, never silently degrade to N isolated
+    # single-process jobs
+    explicit = any(a is not None for a in (coordinator_address, num_processes,
+                                           process_id, local_device_ids))
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

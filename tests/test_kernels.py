@@ -288,10 +288,9 @@ class TestResolveOutcomes:
             filled = nk.interpolate(rescaled, rep, scaled, 0.1)
             raw_np, adj_np = nk.resolve_outcomes(rescaled, filled, rep,
                                                  scaled, 0.1)
-            raw_j, adj_j = jk.resolve_outcomes(jnp.asarray(rescaled),
-                                               jnp.asarray(filled),
-                                               jnp.asarray(rep),
-                                               jnp.asarray(scaled), 0.1)
+            raw_j, adj_j = jk.resolve_outcomes(
+                jnp.asarray(~np.isnan(rescaled)), jnp.asarray(filled),
+                jnp.asarray(rep), jnp.asarray(scaled), 0.1)
             np.testing.assert_allclose(np.asarray(raw_j), raw_np, rtol=1e-12)
             # binary outcomes catch-snapped -> exact equality
             np.testing.assert_array_equal(np.asarray(adj_j)[~scaled],
@@ -304,7 +303,7 @@ class TestResolveOutcomes:
         raw_np, adj_np = nk.resolve_outcomes(rescaled, filled, rep, scaled, 0.1)
         e_np = nk.certainty_and_bonuses(rescaled, filled, rep, adj_np,
                                         scaled, 0.1)
-        e_j = jk.certainty_and_bonuses(jnp.asarray(rescaled),
+        e_j = jk.certainty_and_bonuses(jnp.asarray(~np.isnan(rescaled)),
                                        jnp.asarray(filled), jnp.asarray(rep),
                                        jnp.asarray(adj_np),
                                        jnp.asarray(scaled), 0.1)
