@@ -6,9 +6,12 @@ Public surface:
 - :class:`Oracle` — the reference-compatible consensus engine with
   ``backend="numpy"|"jax"`` and the full ``algorithm=`` dispatch.
 - :mod:`pyconsensus_tpu.sim` — the Monte-Carlo collusion simulator
-  (one vmap-batched XLA call per sweep).
+  (one vmap-batched XLA call per sweep) and its plotting helpers.
 - :mod:`pyconsensus_tpu.parallel` — device-mesh sharding for large oracles
-  (events sharded across chips, ICI collectives inserted by XLA).
+  (events sharded across chips, ICI collectives inserted by XLA), explicit
+  ring collectives, and the multi-host ICI x DCN runtime.
+- :func:`compare_algorithms` — concurrent algorithm-variant sweep (the
+  expert-parallel analogue, SURVEY.md §2).
 - :class:`ReputationLedger` — multi-round reputation carry with
   checkpoint/resume (SURVEY.md §5).
 - :mod:`pyconsensus_tpu.io` — report-matrix IO: npy/csv on host (native
@@ -18,7 +21,8 @@ Public surface:
 
 from .ledger import ReputationLedger
 from .oracle import ALGORITHMS, BACKENDS, Oracle
+from .sweep import compare_algorithms, disagreement_matrix
 
 __version__ = "0.1.0"
 __all__ = ["Oracle", "ReputationLedger", "ALGORITHMS", "BACKENDS",
-           "__version__"]
+           "compare_algorithms", "disagreement_matrix", "__version__"]

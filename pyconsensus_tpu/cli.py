@@ -112,6 +112,11 @@ def _run_simulation(args) -> None:
             for i, f in enumerate(lf)]
     _print_table("Liar reputation share (post-resolution)", headers, rows)
     print()
+    if args.plot:
+        from .sim import save_sweep_report
+
+        save_sweep_report(res, args.plot)
+        print(f"sweep report written to {args.plot}")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -126,6 +131,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="run the example with scaled events + event_bounds")
     ap.add_argument("--simulate", action="store_true",
                     help="run a Monte-Carlo collusion sweep")
+    ap.add_argument("--plot", metavar="PATH",
+                    help="with --simulate: write a PNG sweep report "
+                         "(heatmaps + retention curves; needs matplotlib)")
     ap.add_argument("-f", "--file", metavar="PATH",
                     help="resolve a reports matrix loaded from PATH "
                          "(.npy or .csv; NA/NaN = missing report)")

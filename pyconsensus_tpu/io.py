@@ -75,9 +75,10 @@ def _csv_header_lines(path) -> int:
                 tok = tok.strip()
                 if tok.lower() in _NA_TOKENS:
                     continue
-                try:
-                    float(tok)
-                except ValueError:
+                # classify with the native parser's grammar, not bare
+                # float() — float('1_5') succeeds, std::from_chars doesn't,
+                # and header detection must agree between the two parsers
+                if not _FLOAT_GRAMMAR.match(tok):
                     return 1
             return 0
     return 0

@@ -79,7 +79,12 @@ def kmeans_conformity_jax(reports_filled, reputation, num_clusters,
     """JAX mirror of :func:`kmeans_conformity_np` under ``lax.fori_loop``.
     Identical seeding, assignment tie-breaks (first argmin), and weighted
     updates, so labels match the numpy backend exactly."""
-    X = reports_filled
+    # centroid/assignment arithmetic runs in the reputation (accumulation)
+    # dtype: with a bf16 storage_dtype the rep-weighted centroid update
+    # promotes to f32, which would make the fori_loop carry type-unstable
+    # (and degrade the distance math) if the carry started as bf16
+    acc = reputation.dtype
+    X = reports_filled.astype(acc)
     rep = reputation
     R = X.shape[0]
     k = int(min(num_clusters, R))

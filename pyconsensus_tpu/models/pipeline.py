@@ -283,6 +283,11 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     rescaled = jk.rescale(reports, scaled, mins, maxs)
     filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
                                             p.catch_tolerance)
+    # host clustering runs on f64 regardless; the device-side outcome and
+    # bonus phases honor the compact storage dtype like the jit path
+    # (mask threading makes the cast safe — NaN locations live in `present`)
+    if p.storage_dtype:
+        filled = filled.astype(jnp.dtype(p.storage_dtype))
 
     filled_host = np.asarray(filled, dtype=np.float64)
     # the clustering inputs (filled reports, hence distances) are

@@ -1,6 +1,11 @@
 """Monte-Carlo collusion simulation (BASELINE.json config 5): thousands of
-oracle resolutions as one vmap-batched XLA call."""
+oracle resolutions as one vmap-batched XLA call, plus plotting helpers for
+the sweep results."""
 
 from .collusion import CollusionSimulator, generate_reports, simulate_grid
+from .plots import (plot_retention_curves, plot_sweep_heatmap,
+                    save_sweep_report)
 
-__all__ = ["CollusionSimulator", "generate_reports", "simulate_grid"]
+__all__ = ["CollusionSimulator", "generate_reports", "simulate_grid",
+           "plot_sweep_heatmap", "plot_retention_curves",
+           "save_sweep_report"]

@@ -72,7 +72,7 @@ def _trial_metrics(key, liar_fraction, variance, *, n_reporters: int,
     rep0 = jnp.full((n_reporters,), 1.0 / n_reporters, dtype=dtype)
     rep, _, _, converged, iters = _iterate_jax(reports, rep0, p)
     scaled = jnp.zeros((n_events,), dtype=bool)
-    _, outcomes_adj = jk.resolve_outcomes(reports, reports, rep, scaled,
+    _, outcomes_adj = jk.resolve_outcomes(None, reports, rep, scaled,
                                           p.catch_tolerance, any_scaled=False,
                                           has_na=False)
     liar_f = liar.astype(dtype)

@@ -1,0 +1,145 @@
+"""Plotting helpers for collusion-sweep results (SURVEY.md §3.3 — the
+reference's sweep ends in "aggregate / plot"; these are the rebuild's
+equivalents for :meth:`CollusionSimulator.run` result dicts).
+
+Design rules applied: magnitude grids use a single-hue sequential colormap
+(light -> dark, never a rainbow); per-variance curves use a fixed
+categorical hue order (never cycled, capped before hues run out); text
+stays in neutral ink, color carries only series identity; grid/axes are
+recessive. matplotlib is imported lazily so the library works without it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["plot_sweep_heatmap", "plot_retention_curves", "save_sweep_report"]
+
+#: fixed categorical hue order (validated palette; assigned in order, never
+#: cycled — plot_retention_curves raises past the 8-hue budget: facet or
+#: subset the sweep instead)
+_SERIES = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100",
+           "#e87ba4", "#008300", "#4a3aa7", "#e34948"]
+_INK = "#0b0b0b"
+_INK_2 = "#52514e"
+_GRID = "#d8d7d2"
+
+_METRIC_LABELS = {
+    "correct_rate": "events resolved to truth",
+    "capture_rate": "events captured by the lie",
+    "ambiguous_rate": "events left ambiguous (0.5)",
+    "liar_rep_share": "reputation held by liars",
+}
+
+
+def _require_mpl():
+    try:
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("plotting requires matplotlib "
+                          "(pip install matplotlib)") from e
+
+
+def _style_axes(ax):
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(_GRID)
+    ax.tick_params(colors=_INK_2, labelsize=9)
+
+
+def plot_sweep_heatmap(result: dict, metric: str = "capture_rate", ax=None,
+                       annotate: Optional[bool] = None):
+    """Heatmap of a per-cell mean metric over the (liar_fraction x variance)
+    grid. Magnitude -> single-hue sequential (Blues, light -> dark); cells
+    are annotated with their values when the grid is small enough to read.
+    Returns the matplotlib Axes."""
+    plt = _require_mpl()
+    if metric not in result["mean"]:
+        raise ValueError(f"metric {metric!r} not in result; choose from "
+                         f"{sorted(result['mean'])}")
+    grid = np.asarray(result["mean"][metric])          # (L, V)
+    lf, var = result["liar_fractions"], result["variances"]
+    if ax is None:
+        _, ax = plt.subplots(figsize=(1.2 + 0.6 * len(var),
+                                      1.0 + 0.45 * len(lf)), dpi=120)
+    im = ax.imshow(grid, cmap="Blues", vmin=0.0, vmax=1.0, aspect="auto",
+                   origin="lower")
+    ax.set_xticks(range(len(var)), [f"{v:g}" for v in var])
+    ax.set_yticks(range(len(lf)), [f"{f:g}" for f in lf])
+    ax.set_xlabel("honest-reporter noise (variance)", color=_INK, fontsize=10)
+    ax.set_ylabel("liar fraction", color=_INK, fontsize=10)
+    ax.set_title(_METRIC_LABELS.get(metric, metric), color=_INK, fontsize=11)
+    _style_axes(ax)
+    if annotate is None:
+        annotate = grid.size <= 60
+    if annotate:
+        for i in range(grid.shape[0]):
+            for j in range(grid.shape[1]):
+                # ink flips to white on the dark end of the ramp
+                dark = grid[i, j] > 0.6
+                ax.text(j, i, f"{grid[i, j]:.2f}", ha="center", va="center",
+                        fontsize=8, color="#ffffff" if dark else _INK)
+    else:
+        ax.figure.colorbar(im, ax=ax, shrink=0.85)
+    return ax
+
+
+def plot_retention_curves(result: dict, metric: str = "liar_rep_share",
+                          ax=None):
+    """Mean metric vs liar fraction, one line per variance level (fixed
+    categorical hue order; >8 levels raise — facet instead). Lines are
+    direct-labeled at their right end when there are <= 4, and a legend is
+    always present for >= 2. Returns the matplotlib Axes."""
+    plt = _require_mpl()
+    grid = np.asarray(result["mean"][metric])          # (L, V)
+    lf, var = result["liar_fractions"], result["variances"]
+    if len(var) > len(_SERIES):
+        raise ValueError(f"{len(var)} variance levels exceed the "
+                         f"{len(_SERIES)}-hue categorical budget — facet "
+                         "the sweep or subset `variances`")
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5.2, 3.4), dpi=120)
+    # direct end-labels only when every pair of line ends is separated
+    # enough to read (colliding labels are worse than legend-only)
+    ends = grid[-1, :]
+    separable = (len(var) <= 4 and
+                 np.min(np.diff(np.sort(ends))) > 0.04 if len(var) > 1
+                 else True)
+    for k, v in enumerate(var):
+        ax.plot(lf, grid[:, k], color=_SERIES[k], lw=2,
+                marker="o", ms=4, label=f"variance {v:g}")
+        if separable:
+            ax.annotate(f" {v:g}", (lf[-1], grid[-1, k]),
+                        color=_SERIES[k], fontsize=8, va="center")
+    ax.set_xlabel("liar fraction", color=_INK, fontsize=10)
+    ax.set_ylabel(_METRIC_LABELS.get(metric, metric), color=_INK, fontsize=10)
+    ax.set_ylim(-0.02, 1.02)
+    ax.grid(True, color=_GRID, lw=0.5, alpha=0.6)
+    ax.set_axisbelow(True)
+    _style_axes(ax)
+    if len(var) >= 2:
+        ax.legend(frameon=False, fontsize=8, labelcolor=_INK_2)
+    return ax
+
+
+def save_sweep_report(result: dict, path, metrics=("correct_rate",
+                                                   "capture_rate",
+                                                   "liar_rep_share")):
+    """Write a one-file PNG report: one heatmap per metric plus the
+    retention curves. Returns the path."""
+    plt = _require_mpl()
+    n = len(metrics) + 1
+    fig, axes = plt.subplots(1, n, figsize=(4.6 * n, 3.6), dpi=120)
+    for ax, m in zip(axes[:-1], metrics):
+        plot_sweep_heatmap(result, metric=m, ax=ax)
+    plot_retention_curves(result, ax=axes[-1])
+    fig.tight_layout()
+    fig.savefig(path, bbox_inches="tight")
+    plt.close(fig)
+    return path

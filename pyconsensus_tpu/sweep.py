@@ -1,0 +1,87 @@
+"""Algorithm-variant sweep: resolve one reports matrix under several
+``algorithm=`` backends concurrently.
+
+SURVEY.md §2 ("Parallelism components") maps expert parallelism onto
+"dispatching different ``algorithm=`` variants across devices in a sweep" —
+the reference has no parallelism at all, and its users compare variants by
+re-running the library serially. Here every jit-compatible variant is
+dispatched asynchronously (XLA queues the compiled programs back-to-back,
+so device work for variant k overlaps host dispatch of variant k+1; on a
+multi-controller deployment each process can pass a disjoint
+``algorithms=`` slice to spread variants across hosts), and the hybrid
+host-clustering variants run while the device queue drains.
+
+>>> from pyconsensus_tpu.sweep import compare_algorithms
+>>> res = compare_algorithms(reports, max_iterations=3)
+>>> res["sztorc"]["events"]["outcomes_final"]
+>>> disagreement_matrix(res)          # which variants disagree where
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .models.pipeline import HYBRID_ALGORITHMS, JIT_ALGORITHMS
+from .oracle import ALGORITHMS, Oracle
+
+__all__ = ["compare_algorithms", "disagreement_matrix"]
+
+
+def compare_algorithms(reports, algorithms: Optional[Sequence[str]] = None,
+                       event_bounds=None, reputation=None,
+                       **oracle_kwargs) -> Dict[str, dict]:
+    """Resolve ``reports`` under every algorithm in ``algorithms`` (default:
+    all six), returning ``{algorithm: consensus-result-dict}``.
+
+    The jit variants are dispatched first without blocking — their XLA
+    programs queue on the device and execute back-to-back — then the hybrid
+    (host-clustering) variants run on CPU while that queue drains, and only
+    afterwards are the queued device results fetched. ``oracle_kwargs``
+    pass through to :class:`Oracle` (``backend`` is forced to ``"jax"``).
+    """
+    algorithms = tuple(algorithms if algorithms is not None else
+                       sorted(ALGORITHMS))
+    for a in algorithms:
+        if a not in ALGORITHMS:
+            raise ValueError(f"unknown algorithm {a!r}; "
+                             f"choose from {sorted(ALGORITHMS)}")
+    oracle_kwargs.pop("backend", None)
+    oracle_kwargs.pop("algorithm", None)
+
+    def make(a):
+        return Oracle(reports=reports, event_bounds=event_bounds,
+                      reputation=reputation, algorithm=a, backend="jax",
+                      **oracle_kwargs)
+
+    # async device dispatch for the jit variants...
+    raw: Dict[str, dict] = {}
+    for a in algorithms:
+        if a in JIT_ALGORITHMS:
+            raw[a] = make(a).resolve_raw()
+    # ...hybrid variants overlap the draining device queue...
+    results: Dict[str, dict] = {}
+    for a in algorithms:
+        if a in HYBRID_ALGORITHMS:
+            results[a] = make(a).consensus()
+    # ...then fetch the queued device results
+    from .oracle import assemble_result
+    for a, r in raw.items():
+        results[a] = assemble_result({k: np.asarray(v) for k, v in r.items()})
+    return {a: results[a] for a in algorithms}
+
+
+def disagreement_matrix(results: Dict[str, dict]) -> np.ndarray:
+    """(n_algorithms, n_algorithms) count of events whose final outcomes
+    differ between each pair of variants in a :func:`compare_algorithms`
+    result — the quick "which lie detectors disagree" diagnostic."""
+    names = list(results)
+    outs = [np.asarray(results[a]["events"]["outcomes_final"])
+            for a in names]
+    n = len(names)
+    m = np.zeros((n, n), dtype=np.int64)
+    for i in range(n):
+        for j in range(n):
+            m[i, j] = int(np.sum(outs[i] != outs[j]))
+    return m

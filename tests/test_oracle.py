@@ -213,6 +213,21 @@ class TestStorageDtype:
         np.testing.assert_array_equal(full["events"]["outcomes_final"],
                                       compact["events"]["outcomes_final"])
 
+    @pytest.mark.parametrize("algo", sorted(ALGORITHMS))
+    def test_every_algorithm_runs_compact(self, rng, algo):
+        """storage_dtype must work (not crash, keep catch-snapped outcomes)
+        under every algorithm= variant, including the k-means fori_loop
+        (carry dtype stability) and the hybrid host-clustering paths."""
+        reports, _ = make_majority(rng)
+        full = Oracle(reports=reports, backend="jax", algorithm=algo,
+                      max_iterations=2).consensus()
+        compact = Oracle(reports=reports, backend="jax", algorithm=algo,
+                         max_iterations=2,
+                         storage_dtype="bfloat16").consensus()
+        np.testing.assert_array_equal(full["events"]["outcomes_final"],
+                                      compact["events"]["outcomes_final"],
+                                      err_msg=algo)
+
 
 class TestKmeansLowIterParity:
     def test_unconverged_lloyd_matches_across_backends(self):

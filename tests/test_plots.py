@@ -1,0 +1,73 @@
+"""Sweep-plot helpers (sim.plots): render to files, validate structure.
+matplotlib is available in CI; the helpers must also import cleanly
+without rendering anything at module import time."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+from pyconsensus_tpu.sim import (plot_retention_curves, plot_sweep_heatmap,
+                                 save_sweep_report)
+
+
+@pytest.fixture(scope="module")
+def result():
+    lf = np.array([0.0, 0.2, 0.4])
+    var = np.array([0.0, 0.1])
+    rng = np.random.default_rng(0)
+    mean = {
+        "correct_rate": np.clip(1.0 - lf[:, None] - var[None, :], 0, 1),
+        "capture_rate": np.clip(lf[:, None] * var[None, :] * 4, 0, 1),
+        "liar_rep_share": np.tile(lf[:, None] / 2, (1, 2)),
+    }
+    full = {k: np.repeat(v[:, :, None], 5, axis=2) for k, v in mean.items()}
+    full["mean"] = mean
+    full["liar_fractions"] = lf
+    full["variances"] = var
+    return full
+
+
+def test_heatmap_axes(result):
+    ax = plot_sweep_heatmap(result, metric="correct_rate")
+    assert ax.get_xlabel().startswith("honest-reporter")
+    assert len(ax.get_images()) == 1
+    img = ax.get_images()[0].get_array()
+    assert img.shape[:2] == (3, 2)
+    matplotlib.pyplot.close(ax.figure)
+
+
+def test_heatmap_unknown_metric(result):
+    with pytest.raises(ValueError, match="metric"):
+        plot_sweep_heatmap(result, metric="nope")
+
+
+def test_retention_curves(result):
+    ax = plot_retention_curves(result)
+    assert len(ax.get_lines()) == 2            # one per variance level
+    assert ax.get_legend() is not None         # >= 2 series -> legend
+    matplotlib.pyplot.close(ax.figure)
+
+
+def test_retention_too_many_levels(result):
+    r = dict(result)
+    r["variances"] = np.linspace(0, 0.4, 9)
+    r["mean"] = {"liar_rep_share": np.zeros((3, 9))}
+    with pytest.raises(ValueError, match="categorical budget"):
+        plot_retention_curves(r)
+
+
+def test_save_report(result, tmp_path):
+    p = tmp_path / "sweep.png"
+    out = save_sweep_report(result, p)
+    assert out == p and p.exists() and p.stat().st_size > 10_000
+
+
+def test_cli_plot_flag(tmp_path, capsys):
+    from pyconsensus_tpu.cli import main
+    p = tmp_path / "cli_sweep.png"
+    main(["--simulate", "--trials", "5", "--reporters", "10",
+          "--events", "6", "--plot", str(p)])
+    assert p.exists()
+    assert "sweep report" in capsys.readouterr().out

@@ -1,0 +1,58 @@
+"""Algorithm-variant sweep (sweep.compare_algorithms) — the EP-analogue
+concurrent dispatch (SURVEY.md §2 parallelism table)."""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import (ALGORITHMS, Oracle, compare_algorithms,
+                             disagreement_matrix)
+
+
+@pytest.fixture
+def reports(rng):
+    truth = rng.choice([0.0, 1.0], size=12)
+    reports = np.tile(truth, (16, 1))
+    flip = rng.random((12, 12)) < 0.1
+    reports[:12] = np.abs(reports[:12] - flip)
+    reports[12:] = 1.0 - truth
+    return reports
+
+
+def test_all_variants_match_serial(rng, reports):
+    swept = compare_algorithms(reports, max_iterations=2)
+    assert set(swept) == set(ALGORITHMS)
+    for algo, res in swept.items():
+        serial = Oracle(reports=reports, algorithm=algo, backend="jax",
+                        max_iterations=2).consensus()
+        np.testing.assert_array_equal(
+            res["events"]["outcomes_final"],
+            serial["events"]["outcomes_final"], err_msg=algo)
+        np.testing.assert_allclose(res["agents"]["smooth_rep"],
+                                   serial["agents"]["smooth_rep"],
+                                   atol=1e-10, err_msg=algo)
+
+
+def test_subset_and_order(rng, reports):
+    swept = compare_algorithms(reports, algorithms=["k-means", "sztorc"])
+    assert list(swept) == ["k-means", "sztorc"]
+
+
+def test_disagreement_matrix(rng, reports):
+    swept = compare_algorithms(reports, algorithms=["sztorc", "ica"])
+    m = disagreement_matrix(swept)
+    assert m.shape == (2, 2)
+    assert m[0, 0] == 0 and m[1, 1] == 0
+    assert m[0, 1] == m[1, 0]
+
+
+def test_unknown_algorithm_rejected(reports):
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        compare_algorithms(reports, algorithms=["pca2000"])
+
+
+def test_kwargs_passthrough(rng, reports):
+    reports = reports.copy()
+    reports[0, 0] = np.nan
+    swept = compare_algorithms(reports, algorithms=["sztorc"],
+                               max_iterations=3, alpha=0.2)
+    assert swept["sztorc"]["agents"]["smooth_rep"].shape == (16,)
