@@ -2,10 +2,11 @@
 oracle resolutions as one vmap-batched XLA call, plus plotting helpers for
 the sweep results."""
 
-from .collusion import CollusionSimulator, generate_reports, simulate_grid
-from .plots import (plot_retention_curves, plot_sweep_heatmap,
-                    save_sweep_report)
+from .collusion import (CollusionSimulator, RoundsSimulator,
+                        generate_reports, simulate_grid)
+from .plots import (plot_retention_curves, plot_round_trajectories,
+                    plot_sweep_heatmap, save_sweep_report)
 
-__all__ = ["CollusionSimulator", "generate_reports", "simulate_grid",
-           "plot_sweep_heatmap", "plot_retention_curves",
-           "save_sweep_report"]
+__all__ = ["CollusionSimulator", "RoundsSimulator", "generate_reports",
+           "simulate_grid", "plot_sweep_heatmap", "plot_retention_curves",
+           "plot_round_trajectories", "save_sweep_report"]

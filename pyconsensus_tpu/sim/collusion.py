@@ -32,11 +32,32 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..models.pipeline import JIT_ALGORITHMS, ConsensusParams, _iterate_jax
 from ..ops import jax_kernels as jk
 
-__all__ = ["CollusionSimulator", "simulate_grid", "generate_reports"]
+__all__ = ["CollusionSimulator", "RoundsSimulator", "simulate_grid",
+           "generate_reports"]
+
+
+def _synth_reports(k_truth, k_noise, k_lie, liar, variance, n_reporters: int,
+                   n_events: int, collude: bool):
+    """Shared threat-model body: given a liar mask, build one round's
+    ``(reports, truth)`` — fresh truth, honest noise-flips at probability
+    ``variance``, liars reporting the shared anti-truth (collude) or
+    uniform noise."""
+    dtype = jnp.asarray(0.0).dtype
+    truth = jax.random.bernoulli(k_truth, 0.5, (n_events,)).astype(dtype)
+    flip = jax.random.bernoulli(k_noise, jnp.clip(variance, 0.0, 0.5),
+                                (n_reporters, n_events))
+    honest = jnp.abs(truth[None, :] - flip.astype(dtype))
+    if collude:
+        lie_reports = jnp.broadcast_to(1.0 - truth, (n_reporters, n_events))
+    else:
+        lie_reports = jax.random.bernoulli(
+            k_lie, 0.5, (n_reporters, n_events)).astype(dtype)
+    return jnp.where(liar[:, None], lie_reports, honest), truth
 
 
 def generate_reports(key, liar_fraction, variance, n_reporters: int,
@@ -44,19 +65,10 @@ def generate_reports(key, liar_fraction, variance, n_reporters: int,
     """Pure synthetic-report generator: ``(reports, truth, liar_mask)`` as a
     function of the PRNG key and the two sweep knobs. Public so tests and
     users can replay any trial's exact matrix through :class:`Oracle`."""
-    dtype = jnp.asarray(0.0).dtype
     k_truth, k_liar, k_noise, k_lie = jax.random.split(key, 4)
-    truth = jax.random.bernoulli(k_truth, 0.5, (n_events,)).astype(dtype)
     liar = jax.random.bernoulli(k_liar, liar_fraction, (n_reporters,))
-    flip = jax.random.bernoulli(k_noise, jnp.clip(variance, 0.0, 0.5),
-                                (n_reporters, n_events))
-    honest = jnp.abs(truth[None, :] - flip.astype(dtype))
-    if collude:
-        lie_reports = jnp.broadcast_to(1.0 - truth, (n_reporters, n_events))
-    else:
-        lie_reports = jax.random.bernoulli(k_lie, 0.5,
-                                           (n_reporters, n_events)).astype(dtype)
-    reports = jnp.where(liar[:, None], lie_reports, honest)
+    reports, truth = _synth_reports(k_truth, k_noise, k_lie, liar, variance,
+                                    n_reporters, n_events, collude)
     return reports, truth, liar
 
 
@@ -119,17 +131,22 @@ class CollusionSimulator:
             catch_tolerance=float(catch_tolerance),
             max_iterations=int(max_iterations), pca_method=pca_method,
             power_iters=int(power_iters), any_scaled=False, has_na=False)
-        trial = functools.partial(_trial_metrics,
-                                  n_reporters=self.n_reporters,
-                                  n_events=self.n_events,
-                                  collude=self.collude, p=self.params)
-        self._batched = jax.jit(jax.vmap(trial))
+        self._batched = jax.jit(jax.vmap(self._trial_fn()))
+
+    def _trial_fn(self):
+        """Subclass hook: the per-trial function ``(key, lf, var) -> metrics``
+        that ``__init__`` wraps in one ``jit(vmap(...))``."""
+        return functools.partial(_trial_metrics, n_reporters=self.n_reporters,
+                                 n_events=self.n_events, collude=self.collude,
+                                 p=self.params)
 
     def run(self, liar_fractions: Sequence[float],
             variances: Sequence[float], n_trials: int, seed: int = 0) -> dict:
         """Sweep the (liar_fraction × variance × seed) grid in one batched
-        call. Returns a dict of host arrays shaped (L, V, T) per metric plus
-        ``"mean"``: per-cell averages shaped (L, V)."""
+        call. Returns a dict of host arrays shaped (L, V, T) per metric —
+        (L, V, T, ...) for metrics with trailing per-trial axes, e.g. the
+        per-round trajectories of :class:`RoundsSimulator` — plus ``"mean"``:
+        per-cell averages over the trial axis."""
         lf = np.asarray(liar_fractions, dtype=np.float64)
         var = np.asarray(variances, dtype=np.float64)
         L, V, T = len(lf), len(var), int(n_trials)
@@ -142,11 +159,18 @@ class CollusionSimulator:
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.arange(L * V * T))
         out = self._batched(keys, jnp.asarray(grid_lf), jnp.asarray(grid_var))
-        result = {k: np.asarray(v).reshape(L, V, T) for k, v in out.items()}
+        result = {}
+        for k, v in out.items():
+            arr = np.asarray(v)
+            result[k] = arr.reshape((L, V, T) + arr.shape[1:])
         result["mean"] = {k: v.mean(axis=2) for k, v in result.items()}
         result["liar_fractions"] = lf
         result["variances"] = var
+        self._annotate(result)
         return result
+
+    def _annotate(self, result: dict) -> None:
+        """Subclass hook: add extra metadata keys to a finished sweep."""
 
 
 def simulate_grid(liar_fractions=(0.0, 0.1, 0.2, 0.3, 0.4),
@@ -155,3 +179,72 @@ def simulate_grid(liar_fractions=(0.0, 0.1, 0.2, 0.3, 0.4),
     """Convenience one-call sweep (the reference's script entry point)."""
     return CollusionSimulator(**kwargs).run(liar_fractions, variances,
                                             n_trials, seed)
+
+
+def _reports_for_round(key, liar, variance, n_reporters: int, n_events: int,
+                       collude: bool):
+    """Per-round report generation with a FIXED liar set: fresh truth and
+    fresh honest noise every round, the same reporters keep lying — the
+    repeated-game setting the reputation mechanism exists for."""
+    k_truth, k_noise, k_lie = jax.random.split(key, 3)
+    return _synth_reports(k_truth, k_noise, k_lie, liar, variance,
+                          n_reporters, n_events, collude)
+
+
+def _trial_rounds(key, liar_fraction, variance, *, n_rounds: int,
+                  n_reporters: int, n_events: int, collude: bool,
+                  p: ConsensusParams):
+    """One multi-round trial: reputation carries from round to round
+    (ReputationLedger semantics, but fully on device as a ``lax.scan``) —
+    measures whether sustained colluders get ground down or capture the
+    oracle. Returns per-round metric trajectories."""
+    dtype = jnp.asarray(0.0).dtype
+    k_liar, k_rounds = jax.random.split(key)
+    liar = jax.random.bernoulli(k_liar, liar_fraction, (n_reporters,))
+    liar_f = liar.astype(dtype)
+    scaled = jnp.zeros((n_events,), dtype=bool)
+    rep0 = jnp.full((n_reporters,), 1.0 / n_reporters, dtype=dtype)
+
+    def round_step(rep, k):
+        reports, truth = _reports_for_round(k, liar, variance, n_reporters,
+                                            n_events, collude)
+        new_rep, _, _, _, _ = _iterate_jax(reports, rep, p)
+        _, outcomes_adj = jk.resolve_outcomes(None, reports, new_rep, scaled,
+                                              p.catch_tolerance,
+                                              any_scaled=False, has_na=False)
+        metrics = {
+            "correct_rate": jnp.mean((outcomes_adj == truth).astype(dtype)),
+            "capture_rate": jnp.mean(
+                (outcomes_adj == 1.0 - truth).astype(dtype)),
+            "liar_rep_share": jnp.sum(new_rep * liar_f),
+        }
+        return new_rep, metrics
+
+    keys = jax.random.split(k_rounds, n_rounds)
+    _, traj = lax.scan(round_step, rep0, keys)
+    return traj
+
+
+class RoundsSimulator(CollusionSimulator):
+    """Multi-round variant of :class:`CollusionSimulator`: each trial is a
+    ``lax.scan`` over ``n_rounds`` oracle resolutions with the reputation
+    vector carried between rounds (fixed liar set, fresh events each
+    round), and the whole (liar_fraction x variance x trial) grid is still
+    one vmapped XLA call. The reference has no equivalent — its simulator
+    resets reputation every trial; this is the repeated-game experiment
+    its README motivates (does sustained collusion get ground down?)."""
+
+    def __init__(self, n_rounds: int = 10, **kwargs):
+        if int(n_rounds) < 1:
+            raise ValueError("n_rounds must be >= 1")
+        self.n_rounds = int(n_rounds)   # before super().__init__ → _trial_fn
+        super().__init__(**kwargs)
+
+    def _trial_fn(self):
+        return functools.partial(_trial_rounds, n_rounds=self.n_rounds,
+                                 n_reporters=self.n_reporters,
+                                 n_events=self.n_events,
+                                 collude=self.collude, p=self.params)
+
+    def _annotate(self, result: dict) -> None:
+        result["n_rounds"] = self.n_rounds

@@ -15,7 +15,8 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["plot_sweep_heatmap", "plot_retention_curves", "save_sweep_report"]
+__all__ = ["plot_sweep_heatmap", "plot_retention_curves",
+           "plot_round_trajectories", "save_sweep_report"]
 
 #: fixed categorical hue order (validated palette; assigned in order, never
 #: cycled — plot_retention_curves raises past the 8-hue budget: facet or
@@ -45,6 +46,19 @@ def _require_mpl():
                           "(pip install matplotlib)") from e
 
 
+def _mean_grid(result: dict, metric: str) -> np.ndarray:
+    """The (L, V) per-cell mean for single-round sweep plots; rejects the
+    (L, V, n_rounds) trajectories a RoundsSimulator produces with a pointer
+    to the right entry point instead of a garbage render."""
+    grid = np.asarray(result["mean"][metric])
+    if grid.ndim != 2:
+        raise ValueError(f"metric {metric!r} has shape {grid.shape}, not the "
+                         "(liar_fractions, variances) grid this plot needs — "
+                         "for RoundsSimulator results use "
+                         "plot_round_trajectories")
+    return grid
+
+
 def _style_axes(ax):
     for side in ("top", "right"):
         ax.spines[side].set_visible(False)
@@ -63,7 +77,7 @@ def plot_sweep_heatmap(result: dict, metric: str = "capture_rate", ax=None,
     if metric not in result["mean"]:
         raise ValueError(f"metric {metric!r} not in result; choose from "
                          f"{sorted(result['mean'])}")
-    grid = np.asarray(result["mean"][metric])          # (L, V)
+    grid = _mean_grid(result, metric)                  # (L, V)
     lf, var = result["liar_fractions"], result["variances"]
     if ax is None:
         _, ax = plt.subplots(figsize=(1.2 + 0.6 * len(var),
@@ -97,7 +111,7 @@ def plot_retention_curves(result: dict, metric: str = "liar_rep_share",
     direct-labeled at their right end when there are <= 4, and a legend is
     always present for >= 2. Returns the matplotlib Axes."""
     plt = _require_mpl()
-    grid = np.asarray(result["mean"][metric])          # (L, V)
+    grid = _mean_grid(result, metric)                  # (L, V)
     lf, var = result["liar_fractions"], result["variances"]
     if len(var) > len(_SERIES):
         raise ValueError(f"{len(var)} variance levels exceed the "
@@ -124,6 +138,54 @@ def plot_retention_curves(result: dict, metric: str = "liar_rep_share",
     ax.set_axisbelow(True)
     _style_axes(ax)
     if len(var) >= 2:
+        ax.legend(frameon=False, fontsize=8, labelcolor=_INK_2)
+    return ax
+
+
+def plot_round_trajectories(result: dict, metric: str = "liar_rep_share",
+                            variance_index: int = 0, ax=None):
+    """Multi-round trajectories from a :class:`RoundsSimulator` result:
+    mean metric vs round, one line per liar fraction at one variance level
+    (fixed categorical hue order; raises past the hue budget). Answers the
+    repeated-game question at a glance — do sustained colluders get ground
+    down round over round, or capture the oracle?"""
+    plt = _require_mpl()
+    if metric not in result["mean"]:
+        raise ValueError(f"metric {metric!r} not in result; choose from "
+                         f"{sorted(result['mean'])}")
+    traj = np.asarray(result["mean"][metric])          # (L, V, n_rounds)
+    if traj.ndim != 3:
+        raise ValueError(f"metric {metric!r} has no per-round axis — run "
+                         "RoundsSimulator (shape (L, V, n_rounds)), got "
+                         f"shape {traj.shape}")
+    lf, var = result["liar_fractions"], result["variances"]
+    if not 0 <= variance_index < len(var):
+        raise ValueError(f"variance_index {variance_index} out of range for "
+                         f"{len(var)} variance level(s)")
+    if len(lf) > len(_SERIES):
+        raise ValueError(f"{len(lf)} liar fractions exceed the "
+                         f"{len(_SERIES)}-hue categorical budget — facet "
+                         "or subset `liar_fractions`")
+    rounds = np.arange(1, traj.shape[2] + 1)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5.2, 3.4), dpi=120)
+    for k, f in enumerate(lf):
+        ax.plot(rounds, traj[k, variance_index], color=_SERIES[k], lw=2,
+                marker="o", ms=4, label=f"liar fraction {f:g}")
+    ax.set_xlabel("round", color=_INK, fontsize=10)
+    ax.set_ylabel(_METRIC_LABELS.get(metric, metric), color=_INK, fontsize=10)
+    if len(rounds) <= 15:
+        ax.set_xticks(rounds)
+    else:
+        from matplotlib.ticker import MaxNLocator
+        ax.xaxis.set_major_locator(MaxNLocator(integer=True))
+    ax.set_ylim(-0.02, 1.02)
+    ax.set_title(f"variance {var[variance_index]:g}, reputation carried "
+                 "across rounds", color=_INK, fontsize=11)
+    ax.grid(True, color=_GRID, lw=0.5, alpha=0.6)
+    ax.set_axisbelow(True)
+    _style_axes(ax)
+    if len(lf) >= 2:
         ax.legend(frameon=False, fontsize=8, labelcolor=_INK_2)
     return ax
 
