@@ -5,9 +5,23 @@ Code written against the reference (``from pyconsensus import Oracle``;
 unchanged — it just runs on the TPU-native rebuild. The ``backend=`` kwarg
 (default ``"numpy"``, matching reference semantics exactly) opts into the
 jit-compiled JAX path.
+
+Beyond the ``Oracle`` class, the reference exposed its pipeline as small
+module-level helpers (symbol list anchored in BASELINE.json / SURVEY.md §2:
+``interpolate``, ``weighted_cov``, ``weighted_prin_comp``, ``catch``,
+``smooth``, ``row_reward_weighted``; ``weighted_median`` came from the
+``weightedstats`` dependency). They are re-exported here from the numpy
+kernel set — the correctness anchor with reference semantics — so
+method-level callers and tests written against the reference keep working.
 """
 
 from pyconsensus_tpu import ALGORITHMS, BACKENDS, Oracle, __version__
 from pyconsensus_tpu.cli import main
+from pyconsensus_tpu.ops.numpy_kernels import (catch, interpolate, normalize,
+                                               row_reward_weighted, smooth,
+                                               weighted_cov, weighted_median,
+                                               weighted_prin_comp)
 
-__all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "main", "__version__"]
+__all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "main", "__version__",
+           "interpolate", "weighted_cov", "weighted_prin_comp", "catch",
+           "smooth", "row_reward_weighted", "weighted_median", "normalize"]

@@ -1,0 +1,10 @@
+"""``python -m pyconsensus`` — the reference's console entry point
+(SURVEY.md §1, CLI demo layer: ``python -m pyconsensus`` / ``pyconsensus``
+console script)."""
+
+import sys
+
+from pyconsensus_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:], prog="pyconsensus"))

@@ -119,9 +119,10 @@ def _run_simulation(args) -> None:
         print(f"sweep report written to {args.plot}")
 
 
-def main(argv: Optional[Sequence[str]] = None) -> int:
+def main(argv: Optional[Sequence[str]] = None,
+         prog: str = "pyconsensus_tpu") -> int:
     ap = argparse.ArgumentParser(
-        prog="pyconsensus_tpu",
+        prog=prog,
         description="Truthcoin/Sztorc oracle consensus on TPU — demo driver")
     ap.add_argument("-x", "--example", action="store_true",
                     help="run the canonical 6x4 binary example")

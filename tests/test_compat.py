@@ -1,0 +1,75 @@
+"""Drop-in compatibility surface of the ``pyconsensus`` package alias
+(SURVEY.md §1 packaging layer; §2 #12 console entry; BASELINE.json symbol
+list). A user of the reference should be able to switch imports and find
+everything: the ``Oracle`` class, the module-level pipeline helpers, and
+``python -m pyconsensus``."""
+
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+import pyconsensus
+
+CANONICAL = np.array([[1, 1, 0, 0],
+                      [1, 0, 0, 0],
+                      [1, 1, 0, 0],
+                      [1, 1, 1, 0],
+                      [0, 0, 1, 1],
+                      [0, 0, 1, 1]], dtype=float)
+
+
+class TestImportSurface:
+    def test_oracle_resolves(self):
+        result = pyconsensus.Oracle(reports=CANONICAL,
+                                    max_iterations=5).consensus()
+        np.testing.assert_array_equal(
+            result["events"]["outcomes_final"], [1.0, 1.0, 0.0, 0.0])
+
+    def test_reference_symbols_exported(self):
+        # the BASELINE.json-anchored function surface, callable as the
+        # reference exposed it
+        for name in ("interpolate", "weighted_cov", "weighted_prin_comp",
+                     "catch", "smooth", "row_reward_weighted",
+                     "weighted_median", "normalize", "main",
+                     "ALGORITHMS", "BACKENDS", "__version__"):
+            assert hasattr(pyconsensus, name), name
+
+    def test_helper_pipeline_matches_oracle(self):
+        """Driving the module-level helpers by hand reproduces the Oracle's
+        one-iteration resolution on the canonical matrix."""
+        rep = np.full(6, 1.0 / 6.0)
+        scaled = np.zeros(4, dtype=bool)
+        filled = pyconsensus.interpolate(CANONICAL, rep, scaled, 0.1)
+        np.testing.assert_array_equal(filled, CANONICAL)  # dense: identity
+        cov, dev = pyconsensus.weighted_cov(filled, rep)
+        assert cov.shape == (4, 4)
+        loading, scores = pyconsensus.weighted_prin_comp(filled, rep)
+        assert loading.shape == (4,) and scores.shape == (6,)
+        from pyconsensus_tpu.ops.numpy_kernels import direction_fixed_scores
+        adj = direction_fixed_scores(scores, filled, rep)
+        this_rep = pyconsensus.row_reward_weighted(adj, rep)
+        smooth_rep = pyconsensus.smooth(this_rep, rep, alpha=0.1)
+        result = pyconsensus.Oracle(reports=CANONICAL, alpha=0.1).consensus()
+        np.testing.assert_allclose(result["agents"]["smooth_rep"], smooth_rep,
+                                   atol=1e-12)
+
+    def test_catch_and_median(self):
+        assert pyconsensus.catch(0.2, 0.1) == 0.0
+        assert pyconsensus.catch(0.55, 0.1) == 0.5
+        assert pyconsensus.weighted_median([1.0, 2.0, 3.0],
+                                           [0.1, 0.1, 0.8]) == 3.0
+
+
+class TestModuleEntry:
+    def test_python_dash_m_pyconsensus(self, capsys, monkeypatch):
+        """``python -m pyconsensus --example`` runs the reference's demo
+        (exercised in-process via runpy; conftest already pinned the CPU
+        platform)."""
+        monkeypatch.setattr(sys, "argv", ["pyconsensus", "--example",
+                                          "--backend", "numpy"])
+        with pytest.raises(SystemExit) as exc:
+            runpy.run_module("pyconsensus", run_name="__main__")
+        assert exc.value.code == 0
+        assert "Example (dense binary)" in capsys.readouterr().out
