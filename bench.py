@@ -57,9 +57,11 @@ def main() -> None:
     ap.add_argument("--reporters", type=int, default=10_000)
     ap.add_argument("--events", type=int, default=100_000)
     ap.add_argument("--na-frac", type=float, default=0.02)
-    ap.add_argument("--repeats", type=int, default=10,
+    ap.add_argument("--repeats", type=int, default=25,
                     help="resolutions per timed batch (dispatched "
-                         "back-to-back so device queues stay full)")
+                         "back-to-back so device queues stay full; the one "
+                         "tunnel RTT charged per batch amortizes across "
+                         "them — ~90 ms over 25 is ~4 ms per resolution)")
     ap.add_argument("--batches", type=int, default=5,
                     help="timed batches; the median batch rate is reported")
     ap.add_argument("--power-iters", type=int, default=128,
@@ -115,22 +117,6 @@ def main() -> None:
     out = resolve()
     force(out)
 
-    # North-star latency probe: BASELINE.json's target is "<1 s per
-    # resolution", which throughput batching could mask — measure blocking
-    # per-resolution latency (best of 3, suppressing tunnel RTT jitter) and
-    # flag a miss on stderr. The JSON line is still printed either way: the
-    # driver always needs the measured rate, and a non-default shape has no
-    # 1 s contract at all.
-    lat_samples = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        force(resolve())
-        lat_samples.append(time.perf_counter() - t0)
-    latency = min(lat_samples)
-    if latency >= 1.0:
-        print(f"WARNING: blocking per-resolution latency {latency:.3f}s "
-              f">= 1s north-star target at {R}x{E}", file=sys.stderr)
-
     # The headline metric is resolutions/sec (BASELINE.json "Consensus
     # rounds/sec"), so the timed batches dispatch resolutions back-to-back
     # and barrier ONCE per batch on a device-side combine of every
@@ -149,6 +135,30 @@ def main() -> None:
     # already-computed warm output — compiling it must not cost a whole
     # batch of full resolutions
     float(np.asarray(jnp.stack([out["avg_certainty"]] * args.repeats).sum()))
+    # warm-in: the first executions of a freshly compiled executable on the
+    # tunneled chip run up to 10x slower than steady state (measured:
+    # 347 ms -> 34 ms for the identical dispatch); one untimed batch
+    # absorbs that so the timed work measures the pipeline, not the
+    # runtime settling
+    run_batch(min(args.repeats, 5))
+
+    # North-star latency probe: BASELINE.json's target is "<1 s per
+    # resolution", which throughput batching could mask — measure blocking
+    # per-resolution latency (best of 3, suppressing tunnel RTT jitter,
+    # AFTER the warm-in so the settling window isn't charged to the
+    # pipeline) and flag a miss on stderr. The JSON line is still printed
+    # either way: the driver always needs the measured rate, and a
+    # non-default shape has no 1 s contract at all.
+    lat_samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        force(resolve())
+        lat_samples.append(time.perf_counter() - t0)
+    latency = min(lat_samples)
+    if latency >= 1.0:
+        print(f"WARNING: blocking per-resolution latency {latency:.3f}s "
+              f">= 1s north-star target at {R}x{E}", file=sys.stderr)
+
     rates = [args.repeats / run_batch(args.repeats)
              for _ in range(args.batches)]
     value = float(np.median(rates))

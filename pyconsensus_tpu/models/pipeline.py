@@ -83,6 +83,15 @@ class ConsensusParams(NamedTuple):
     #: data provably doesn't need them, which matters at 10k × 100k scale.
     any_scaled: bool = True
     has_na: bool = True
+    #: NaN-threaded fast path for the light pipeline (single-device TPU,
+    #: binary events, sztorc): the storage matrix keeps NaN where reports
+    #: are absent and every Pallas kernel reconstructs filled values
+    #: in-register from a per-column fill vector — the filled matrix and
+    #: the participation mask never exist in HBM, and the whole back half
+    #: (outcomes + certainty + participation/bonuses) is ONE fused sweep
+    #: (pallas_kernels.resolve_certainty_fused). Set by the sharded
+    #: front-end, not user-facing.
+    fused_resolution: bool = False
 
 
 def _scores_np(filled, rep, p: ConsensusParams):
@@ -239,7 +248,7 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
         "old_rep": old_rep,
         "this_rep": this_rep,
         "smooth_rep": rep,
-        "na_row": (~present.all(axis=1) if p.has_na
+        "na_row": (jk.row_any(~present, old_rep.dtype) if p.has_na
                    else jnp.zeros((reports.shape[0],), dtype=bool)),
         "outcomes_raw": outcomes_raw,
         "outcomes_adjusted": outcomes_adjusted,
@@ -259,12 +268,134 @@ consensus_jit = jax.jit(_consensus_core, static_argnames=("p",))
 _LARGE_RESULT_KEYS = ("original", "rescaled", "filled")
 
 
+def _fill_stats(reports, reputation, tolerance: float, storage_dtype: str):
+    """One XLA pass over the raw reports for the NaN-threaded fast path:
+    the storage cast (NaN preserved) plus the per-column interpolate fill
+    vector and the present-weight stats that make the first-iteration
+    weighted means free (mu = numer + (total - tw) * fill). Binary events
+    only — fills are catch-snapped like interpolate_masked's."""
+    acc = reputation.dtype
+    x = reports.astype(jnp.dtype(storage_dtype)) if storage_dtype else reports
+    na = jnp.isnan(reports)
+    w = jnp.where(na, 0.0, reputation[:, None])
+    tw = jnp.sum(w, axis=0)
+    numer = jnp.sum(jnp.where(na, 0.0, reports).astype(acc) * w, axis=0)
+    fill = jnp.where(tw > 0.0, numer / jnp.where(tw > 0.0, tw, 1.0), 0.5)
+    fill = jk.catch(fill, tolerance)
+    return x, fill, tw, numer
+
+
+def _masked_mu(x, fill, reputation):
+    """Weighted column means of the implicitly-filled matrix — a fused
+    elementwise+reduce pass over the NaN-threaded storage (no (R, E)
+    filled buffer is ever written)."""
+    acc = reputation.dtype
+    filled = jnp.where(jnp.isnan(x), fill.astype(x.dtype), x).astype(acc)
+    return jnp.sum(filled * reputation[:, None], axis=0)
+
+
+def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
+                          p: ConsensusParams):
+    """The light pipeline on the NaN-threaded Pallas fast path (see
+    ``ConsensusParams.fused_resolution``). HBM passes over the (R, E)
+    matrix, at bench shape: one f32 read + storage write (fill stats +
+    cast), one storage read per power sweep, one for scores+direction fix,
+    and ONE for the entire back half — versus separate fill, scores,
+    direction-fix, outcome, and certainty/bonus passes (plus mask traffic)
+    on the XLA path. Semantics identical; parity is asserted by tests and
+    by the benchmark's every-run bf16-vs-f32 outcome check."""
+    from ..ops.pallas_kernels import resolve_certainty_fused
+
+    interp = jax.default_backend() != "tpu"
+    old_rep = jk.normalize(reputation)
+    acc = old_rep.dtype
+    x, fill, tw0, numer0 = _fill_stats(reports, old_rep, p.catch_tolerance,
+                                       p.storage_dtype)
+    full0 = jnp.sum(old_rep)
+    mu1 = numer0 + (full0 - tw0) * fill
+
+    def scores_at(rep_k, mu_k):
+        return jk.sztorc_scores_power_fused(
+            x, rep_k, p.power_iters, p.power_tol, p.matvec_dtype,
+            interpret=interp, fill=fill, mu=mu_k)
+
+    if p.max_iterations <= 1:
+        adj, loading = scores_at(old_rep, mu1)
+        this_rep = jk.row_reward_weighted(adj, old_rep)
+        rep = jk.smooth(this_rep, old_rep, p.alpha)
+        converged = jnp.max(jnp.abs(rep - old_rep)) <= p.convergence_tolerance
+        iters = jnp.asarray(1, dtype=jnp.int32)
+    else:
+        E = x.shape[1]
+
+        def step(carry, _):
+            rep_c, this_prev, loading_prev, conv, it = carry
+            adj, loading = scores_at(rep_c, _masked_mu(x, fill, rep_c))
+            this_rep = jk.row_reward_weighted(adj, rep_c)
+            new_rep = jk.smooth(this_rep, rep_c, p.alpha)
+            delta = jnp.max(jnp.abs(new_rep - rep_c))
+            rep_out = jnp.where(conv, rep_c, new_rep)
+            this_out = jnp.where(conv, this_prev, this_rep)
+            loading_out = jnp.where(conv, loading_prev, loading)
+            it_out = jnp.where(conv, it, it + 1)
+            conv_out = conv | (delta <= p.convergence_tolerance)
+            return (rep_out, this_out, loading_out, conv_out, it_out), None
+
+        init = (old_rep, old_rep, jnp.zeros((E,), dtype=acc),
+                jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+        (rep, this_rep, loading, converged, iters), _ = lax.scan(
+            step, init, None, length=p.max_iterations)
+
+    raw, adjusted, certainty, pcol, prow, narow = resolve_certainty_fused(
+        x, rep, fill, jnp.sum(rep), float(p.catch_tolerance),
+        interpret=interp)
+    certainty = certainty.astype(acc)
+    participation_columns = (1.0 - pcol).astype(acc)
+    consensus_reward = jk.normalize(certainty)
+    total_cert = jnp.sum(certainty)
+    participation_rows = (1.0 - jnp.where(
+        total_cert == 0.0, prow.astype(acc),
+        prow.astype(acc) / jnp.where(total_cert == 0.0, 1.0, total_cert)))
+    percent_na = 1.0 - jnp.mean(participation_columns)
+    na_bonus_rows = jk.normalize(participation_rows)
+    reporter_bonus = na_bonus_rows * percent_na + rep * (1.0 - percent_na)
+    na_bonus_cols = jk.normalize(participation_columns)
+    author_bonus = (na_bonus_cols * percent_na
+                    + consensus_reward * (1.0 - percent_na))
+    return {
+        "old_rep": old_rep,
+        "this_rep": this_rep,
+        "smooth_rep": rep,
+        "na_row": narow > 0.0,
+        "outcomes_raw": raw.astype(acc),
+        "outcomes_adjusted": adjusted.astype(acc),
+        "outcomes_final": adjusted.astype(acc),
+        "iterations": iters,
+        "convergence": converged,
+        "first_loading": jk.canon_sign(loading),
+        "certainty": certainty,
+        "consensus_reward": consensus_reward,
+        "avg_certainty": jnp.mean(certainty),
+        "participation_columns": participation_columns,
+        "participation_rows": participation_rows,
+        "percent_na": percent_na,
+        "na_bonus_rows": na_bonus_rows,
+        "reporter_bonus": reporter_bonus,
+        "na_bonus_cols": na_bonus_cols,
+        "author_bonus": author_bonus,
+    }
+
+
 def _consensus_core_light(reports, reputation, scaled, mins, maxs,
                           p: ConsensusParams):
     """Pipeline variant whose outputs exclude the (R, E)-sized matrices.
     At 10k reporters × 100k events each omitted output is a 4 GB HBM buffer;
     XLA dead-code-eliminates whatever only fed those outputs. Used by the
-    benchmark and the sharded front-end."""
+    benchmark and the sharded front-end. ``p.fused_resolution`` routes to
+    the NaN-threaded Pallas fast path."""
+    if p.fused_resolution:
+        return _consensus_core_fused(reports, reputation, scaled, mins, maxs,
+                                     p)
     result = _consensus_core(reports, reputation, scaled, mins, maxs, p)
     for key in _LARGE_RESULT_KEYS:
         result.pop(key)
@@ -328,7 +459,7 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
         "old_rep": old_rep,
         "this_rep": jnp.asarray(this_rep, dtype=filled.dtype),
         "smooth_rep": rep_dev,
-        "na_row": jnp.isnan(reports).any(axis=1),
+        "na_row": jk.row_any(jnp.isnan(reports), old_rep.dtype),
         "outcomes_raw": outcomes_raw,
         "outcomes_adjusted": outcomes_adjusted,
         "outcomes_final": outcomes_final,

@@ -43,9 +43,17 @@ def sztorc_scores_np(reports_filled, reputation):
 def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
                       power_iters=128, power_tol=0.0, matvec_dtype=""):
     """Direction-fixed first-principal-component scores (jax); returns
-    ``(adj_scores, loading)`` like the numpy mirror."""
+    ``(adj_scores, loading)`` like the numpy mirror. On the single-device
+    TPU fast path (resolved method ``"power-fused"``) the scores and
+    direction-fix contractions fuse into one Pallas HBM sweep
+    (jax_kernels.sztorc_scores_power_fused)."""
+    method = jk.resolve_pca_method(*reports_filled.shape, pca_method)
+    if method == "power-fused":
+        return jk.sztorc_scores_power_fused(
+            reports_filled, reputation, power_iters, power_tol, matvec_dtype,
+            interpret=jax.default_backend() != "tpu")
     loading, scores = jk.weighted_prin_comp(reports_filled, reputation,
-                                            method=pca_method,
+                                            method=method,
                                             power_iters=power_iters,
                                             power_tol=power_tol,
                                             matvec_dtype=matvec_dtype)

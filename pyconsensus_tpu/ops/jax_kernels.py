@@ -67,6 +67,16 @@ def catch(x: jnp.ndarray, tolerance) -> jnp.ndarray:
     return jnp.where(x < 0.5 - tolerance, 0.0, jnp.where(x > 0.5 + tolerance, 1.0, 0.5))
 
 
+def row_any(mask, dtype):
+    """``mask.any(axis=1)`` for a big (R, E) bool matrix, as an MXU matvec.
+
+    Row-axis bool reductions lower pathologically on TPU (~360 ms at
+    10k x 100k, measured — 35x the matrix read time); counting via a
+    matmul against ones is one bandwidth-bound pass."""
+    return jnp.matmul(mask.astype(dtype),
+                      jnp.ones((mask.shape[1],), dtype=dtype)) > 0.0
+
+
 def rescale(reports, scaled, mins, maxs):
     """Scaled columns -> [0, 1]; binary pass through; NaN stays NaN."""
     span = jnp.where(scaled, maxs - mins, 1.0)
@@ -246,6 +256,25 @@ def _first_pc_power(reports_filled, mu, denom, reputation,
     return loading, scores
 
 
+def resolve_pca_method(R: int, E: int, method: str) -> str:
+    """Resolve ``"auto"`` by static shape (E<=1024 explicit cov eigh, else
+    R<=4096 Gram eigh, else power iteration — Pallas-fused on TPU), and
+    downgrade an explicit ``"power-fused"`` request off-TPU beyond toy sizes
+    (the Pallas *interpreter* would be pathological; the XLA matvec path
+    computes the same loading)."""
+    if method == "auto":
+        if E <= 1024:
+            return "eigh-cov"
+        if R <= 4096:
+            return "eigh-gram"
+        if jax.default_backend() == "tpu":
+            return "power-fused"
+        return "power"
+    if method == "power-fused" and jax.default_backend() != "tpu" and R * E > (1 << 20):
+        return "power"
+    return method
+
+
 def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
                        power_iters: int = 128, power_tol: float = 0.0,
                        matvec_dtype: str = ""):
@@ -267,20 +296,7 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
     Returns ``(loading (E,), scores (R,))``; sign fixed downstream.
     """
     R, E = reports_filled.shape
-    if method == "auto":
-        if E <= 1024:
-            method = "eigh-cov"
-        elif R <= 4096:
-            method = "eigh-gram"
-        elif jax.default_backend() == "tpu":
-            method = "power-fused"
-        else:
-            method = "power"
-    if method == "power-fused" and jax.default_backend() != "tpu" and R * E > (1 << 20):
-        # an explicit power-fused request off-TPU would run the Pallas
-        # *interpreter* — pathological beyond toy/test sizes; the XLA
-        # matvec path computes the same loading
-        method = "power"
+    method = resolve_pca_method(R, E, method)
     if method == "power-fused":
         from .pallas_kernels import power_iteration_fused
 
@@ -397,6 +413,68 @@ def direction_fixed_scores(scores, reports_filled, reputation):
     old, new1, new2 = M[0], M[1], M[2]
     ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
     return jnp.where(ref_ind <= 0.0, set1, set2)
+
+
+def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
+                              power_tol: float, matvec_dtype: str = "",
+                              interpret: bool = False, fill=None, mu=None):
+    """The whole sztorc scoring step on the Pallas fast path: power-iteration
+    PCA (one HBM sweep per step, pallas_kernels.apply_weighted_cov) followed
+    by the scores + direction-fix contractions in ONE further sweep
+    (pallas_kernels.scores_dirfix_pass) — the XLA composition
+    (:func:`weighted_prin_comp` + :func:`direction_fixed_scores`) needs two.
+
+    Algebraically identical to the two-pass form: with raw projection
+    ``t = X @ loading`` and ``ml = mu . loading``,
+
+        scores   = t - ml
+        scores^T X = t^T X - ml * colsum(X)
+        set1^T X = scores^T X + |min scores| * colsum(X)   (set2 analogous)
+
+    so the stacked (3, R) x (R, E) direction-fix matmul collapses to O(E)
+    arithmetic on the pass outputs. Same ``ref_ind <= 0`` tie-break.
+    Returns ``(adj_scores (R,), loading (E,))`` in the reputation dtype.
+
+    With ``fill`` (and the matching precomputed ``mu``) the input is
+    NaN-threaded storage — absent entries NaN, filled values reconstructed
+    in-register by the kernels — so the filled matrix never exists in HBM.
+    """
+    from .pallas_kernels import power_iteration_fused, scores_dirfix_pass
+
+    acc = reputation.dtype
+    if fill is None:
+        mu, denom = _mu_denom(reports_filled, reputation)
+    else:
+        denom = 1.0 - jnp.sum(reputation ** 2)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+    xmm = (reports_filled.astype(jnp.dtype(matvec_dtype)) if matvec_dtype
+           else reports_filled)
+    loading = power_iteration_fused(xmm, mu, denom, reputation, power_iters,
+                                    power_tol, fill=fill,
+                                    interpret=interpret).astype(acc)
+    t, q, c, o = scores_dirfix_pass(xmm, reputation, loading, fill=fill,
+                                    interpret=interpret)
+    ml = mu @ loading
+    scores = t.astype(acc) - ml
+    qs = q.astype(acc) - ml * c.astype(acc)        # scores^T X
+    a1 = jnp.abs(jnp.min(scores))
+    a2 = jnp.max(scores)
+    set1 = scores + a1
+    set2 = scores - a2
+    R = scores.shape[0]
+    sum_s = jnp.sum(scores)
+    s1_tot = sum_s + R * a1
+    s2_tot = sum_s - R * a2
+    set1X = qs + a1 * c.astype(acc)
+    set2X = qs - a2 * c.astype(acc)
+    # normalize()'s zero-sum guard, applied to the projected form
+    new1 = jnp.where(s1_tot == 0.0, set1X,
+                     set1X / jnp.where(s1_tot == 0.0, 1.0, s1_tot))
+    new2 = jnp.where(s2_tot == 0.0, set2X,
+                     set2X / jnp.where(s2_tot == 0.0, 1.0, s2_tot))
+    old = o.astype(acc)
+    ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
+    return jnp.where(ref_ind <= 0.0, set1, set2), loading
 
 
 def row_reward_weighted(adj_scores, reputation):

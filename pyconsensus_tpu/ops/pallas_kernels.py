@@ -38,28 +38,39 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["apply_weighted_cov", "power_iteration_fused"]
+__all__ = ["apply_weighted_cov", "power_iteration_fused",
+           "scores_dirfix_pass", "resolve_certainty_fused"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
 #: times this (double-buffered input + in-register f32 upcast)
 _PANEL_BYTES = 4 * 1024 * 1024
 
 
-def _panel_rows(n_events: int, itemsize: int) -> int:
-    """Rows per panel: ~_PANEL_BYTES big, multiple of 8 sublanes, >= 8."""
-    rows = max(1, _PANEL_BYTES // max(1, n_events * itemsize))
+def _panel_rows(n_events: int, itemsize: int,
+                panel_bytes: int = _PANEL_BYTES) -> int:
+    """Rows per panel: ~panel_bytes big, multiple of 8 sublanes, >= 8."""
+    rows = max(1, panel_bytes // max(1, n_events * itemsize))
     return max(8, (rows // 8) * 8)
 
 
-def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref):
-    """One row panel: both contractions off a single HBM read of the panel."""
+def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *, nan_fill):
+    """One row panel: both contractions off a single HBM read of the panel.
+
+    ``nan_fill=True`` reads NaN-threaded storage: absent entries are NaN in
+    ``x`` and ``mu_ref`` row 1 carries ``fill - mu`` (the centered per-column
+    fill value), so the filled matrix is reconstructed in-register and never
+    exists in HBM."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
         y_ref[:] = jnp.zeros_like(y_ref)
 
-    xc = x_ref[:].astype(jnp.float32) - mu_ref[:]          # (T, E) centered
+    xp = x_ref[:].astype(jnp.float32)
+    if nan_fill:
+        xc = jnp.where(jnp.isnan(xp), mu_ref[1:2, :], xp - mu_ref[0:1, :])
+    else:
+        xc = xp - mu_ref[0:1, :]                           # (T, E) centered
     t = jnp.sum(xc * v_ref[:], axis=1, keepdims=True)      # (T, 1) = D_i v
     w = rep_ref[:] * t                                     # (T, 1)
     y_ref[:] += jnp.sum(xc * w, axis=0, keepdims=True)     # (1, E) partial
@@ -77,27 +88,39 @@ def _pad_rows(x, rep, tile_r: int):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def apply_weighted_cov(x, mu, rep, v, interpret: bool = False):
+def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
     """``(X - mu)^T (rep * ((X - mu) v))`` in ONE HBM sweep of ``X``.
 
-    x : (R, E) filled reports, f32 or bf16 (row count padded internally).
+    x : (R, E) filled reports, f32 or bf16 (row count padded internally) —
+        or, with ``fill`` given, NaN-threaded storage (absent entries NaN)
+        whose filled values are reconstructed in-register from the (E,)
+        per-column fill vector, so the filled matrix never exists in HBM.
     mu : (E,) f32 weighted column means.  rep : (R,) f32.  v : (E,) f32.
     Returns (E,) f32. Caller divides by the unbiased-weight denominator.
     ``interpret=True`` runs the Pallas interpreter (CPU tests).
     """
     R, E = x.shape
-    tile_r = _panel_rows(E, x.dtype.itemsize)
+    nan_fill = fill is not None
+    tile_r = _panel_rows(E, x.dtype.itemsize,
+                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
     x, rep = _pad_rows(x, rep, tile_r)
     Rp = x.shape[0]
     f32 = jnp.float32
     grid = (Rp // tile_r,)
+    mu = mu.astype(f32).reshape(1, E)
+    if nan_fill:
+        # row 0: mu; row 1: fill - mu (the centered value of an absent entry)
+        mu2 = jnp.concatenate([mu, fill.astype(f32).reshape(1, E) - mu])
+    else:
+        mu2 = mu
     y = pl.pallas_call(
-        _apply_cov_kernel,
+        functools.partial(_apply_cov_kernel, nan_fill=nan_fill),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_r, E), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((mu2.shape[0], E), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -109,20 +132,274 @@ def apply_weighted_cov(x, mu, rep, v, interpret: bool = False):
             flops=4 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
             transcendentals=0),
         interpret=interpret,
-    )(x, mu.astype(f32).reshape(1, E), rep.astype(f32).reshape(-1, 1),
-      v.astype(f32).reshape(1, E))
+    )(x, mu2, rep.astype(f32).reshape(-1, 1), v.astype(f32).reshape(1, E))
     return y.reshape(E)
 
 
+def _scores_dirfix_kernel(x_ref, rep_ref, lf_ref, t_ref, acc_ref, *,
+                          nan_fill):
+    """One row panel: the raw projection t = X_i @ loading plus all three
+    direction-fix contractions (t^T X, column sums, rep^T X) off a single
+    HBM read. t_i is row-local, so t_i^T X_i accumulates exactly like the
+    two-pass form. ``nan_fill=True`` reconstructs filled values in-register
+    from ``lf_ref`` row 1 (the per-column fill vector).
+
+    Both contractions ride the MXU (``dot_general``, f32 operands — Mosaic
+    cannot lower the mixed bf16xf32 form) — the first VPU-reduction version
+    of this kernel was ~3.5x slower than the HBM read it covers."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    f32 = jnp.float32
+    xp = x_ref[:].astype(f32)                              # (T, E)
+    if nan_fill:
+        xp = jnp.where(jnp.isnan(xp), lf_ref[1:2, :], xp)
+    t = jax.lax.dot_general(xp, lf_ref[0:1, :],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=f32)    # (T, 1)
+    t_ref[:] = t
+    ones = jnp.ones_like(t)
+    w3 = jnp.concatenate([t, rep_ref[:], ones], axis=1)    # (T, 3) f32
+    acc_ref[:] += jax.lax.dot_general(
+        w3, xp, (((0,), (0,)), ((), ())),
+        preferred_element_type=f32)                        # (3, E): q, o, c
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scores_dirfix_pass(x, rep, loading, fill=None, interpret: bool = False):
+    """The post-PCA contractions of the sztorc scoring step in ONE HBM sweep.
+
+    XLA needs two sweeps of the (R, E) matrix after power iteration: one for
+    ``scores = X @ loading`` and one for the stacked direction-fix
+    projections (jax_kernels.direction_fixed_scores). But every
+    direction-fix projection decomposes over those same rows:
+
+        set1^T X = scores^T X + a1 * colsum(X),   scores^T X row-local in t
+
+    so a single row-panel pass yields everything the direction fix needs:
+
+    Returns ``(t (R,), q (E,), c (E,), o (E,))`` — raw projection
+    ``t = X @ loading``, ``q = t^T X``, column sums ``c = 1^T X``, and
+    ``o = rep^T X`` — all f32. The caller finishes the (O(R) + O(E))
+    direction-fix arithmetic (jax_kernels.sztorc_scores_power_fused).
+
+    x : (R, E) filled reports, f32 or bf16 — or NaN-threaded storage when
+    the (E,) ``fill`` vector is given. rep : (R,). loading : (E,).
+    """
+    R, E = x.shape
+    # halved panel budget: 16-row panels at E=100k blow the 16 MB scoped
+    # VMEM limit (observed on v5e), 8-row panels fit comfortably
+    tile_r = _panel_rows(E, x.dtype.itemsize, _PANEL_BYTES // 2)
+    x, rep = _pad_rows(x, rep.astype(jnp.float32), tile_r)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    grid = (Rp // tile_r,)
+    loading = loading.astype(f32).reshape(1, E)
+    if fill is not None:
+        lf = jnp.concatenate([loading, fill.astype(f32).reshape(1, E)])
+    else:
+        lf = loading
+    t, acc = pl.pallas_call(
+        functools.partial(_scores_dirfix_kernel, nan_fill=fill is not None),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((lf.shape[0], E), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Rp, 1), f32),
+            jax.ShapeDtypeStruct((3, E), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=8 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, rep.reshape(-1, 1), lf)
+    return t.reshape(Rp)[:R], acc[0], acc[2], acc[1]
+
+
+def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
+                              cert_ref, pcol_ref, prow_ref, narow_ref, *,
+                              tolerance, chunk, n_chunks, n_events):
+    """One column panel, one HBM read, the whole back half of the pipeline.
+
+    The panel's full column must be resident before outcomes exist (they are
+    column reductions) and outcomes must exist before agreement/certainty,
+    which in turn must exist before the per-row NA participation partials —
+    so the kernel loops over row chunks of the resident block three times
+    (VMEM traversals; HBM is only touched once):
+
+      1. column stats: present-weight totals, present-weighted sums,
+         full-reputation filled means, per-row NA counts, NA participation
+         columns -> outcomes (weighted mean, catch-snapped);
+      2. certainty: reputation mass on the agreeing reporters;
+      3. row partials: na @ certainty, which needs this panel's finished
+         certainty.
+
+    ``fv_ref``: row 0 = per-column fill value, row 1 = full reputation total
+    (broadcast). Columns beyond ``n_events`` (the ragged last block) are
+    masked out of every row-indexed accumulation and their column outputs
+    are sliced off by the caller.
+    """
+    jc = pl.program_id(0)
+
+    @pl.when(jc == 0)
+    def _():
+        prow_ref[:] = jnp.zeros_like(prow_ref)
+        narow_ref[:] = jnp.zeros_like(narow_ref)
+
+    f32 = jnp.float32
+    C = out_ref.shape[1]
+    # ragged-E guard: garbage columns of the physically padded last block
+    # must not leak into row-indexed accumulations
+    col_ok = (jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+              + jc * C) < n_events
+    fill = fv_ref[0:1, :]
+    zero = jnp.zeros((1, C), f32)
+
+    def stats_body(i, acc):
+        tw, numer, fmn, pcol = acc
+        xs = x_ref[pl.ds(i * chunk, chunk), :].astype(f32)
+        rs = rep_ref[pl.ds(i * chunk, chunk), :]
+        na = jnp.isnan(xs)
+        w = jnp.where(na, 0.0, rs)
+        naf = (na & col_ok).astype(f32)
+        narow_ref[pl.ds(i * chunk, chunk), :] += jnp.sum(
+            naf, axis=1, keepdims=True)
+        return (tw + jnp.sum(w, axis=0, keepdims=True),
+                numer + jnp.sum(w * jnp.where(na, 0.0, xs), axis=0,
+                                keepdims=True),
+                fmn + jnp.sum(rs * jnp.where(na, fill, xs), axis=0,
+                              keepdims=True),
+                pcol + jnp.sum(naf * rs, axis=0, keepdims=True))
+
+    tw, numer, fmn, pcol = jax.lax.fori_loop(
+        0, n_chunks, stats_body, (zero, zero, zero, zero))
+    pcol_ref[:] = pcol
+    ft = fv_ref[1:2, :]
+    full_mean = fmn / jnp.where(ft == 0.0, 1.0, ft)
+    means = jnp.where(tw > 0.0,
+                      numer / jnp.where(tw > 0.0, tw, 1.0), full_mean)
+    out = jnp.where(means < 0.5 - tolerance, 0.0,
+                    jnp.where(means > 0.5 + tolerance, 1.0, 0.5))
+    raw_ref[:] = means
+    out_ref[:] = out
+
+    def cert_body(i, cert):
+        xs = x_ref[pl.ds(i * chunk, chunk), :].astype(f32)
+        rs = rep_ref[pl.ds(i * chunk, chunk), :]
+        xf = jnp.where(jnp.isnan(xs), fill, xs)
+        return cert + jnp.sum(jnp.where(xf == out, rs, 0.0), axis=0,
+                              keepdims=True)
+
+    cert = jax.lax.fori_loop(0, n_chunks, cert_body, zero)
+    cert_ref[:] = cert
+
+    def row_body(i, _):
+        xs = x_ref[pl.ds(i * chunk, chunk), :].astype(f32)
+        na_cert = jnp.where(jnp.isnan(xs) & col_ok, cert, 0.0)
+        prow_ref[pl.ds(i * chunk, chunk), :] += jnp.sum(
+            na_cert, axis=1, keepdims=True)
+        return 0
+
+    jax.lax.fori_loop(0, n_chunks, row_body, 0)
+
+
+def _pick_chunk(R: int, cap: int = 1024):
+    """Largest row-chunk <= cap that divides R and is a multiple of 8
+    sublanes; None when R has no such divisor (caller falls back to XLA)."""
+    for c in range(min(cap, R), 7, -1):
+        if R % c == 0 and c % 8 == 0:
+            return c
+    return None
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tolerance", "block_cols", "interpret"))
+def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
+                            block_cols: int = 128, interpret: bool = False):
+    """Outcome resolution + certainty/participation accounting in ONE HBM
+    sweep (binary events; jax_kernels.resolve_outcomes +
+    certainty_and_bonuses semantics on NaN-threaded storage).
+
+    x : (R, E) reports with NaN marking absence (f32 or bf16); R must have a
+        divisor that is a multiple of 8 and <= 1024 (_pick_chunk) — the
+        pipeline gate checks this before routing here.
+    rep : (R,) final (smooth) reputation. fill : (E,) per-column fill values
+    (computed from the INITIAL reputation — interpolate semantics).
+    full_total : () sum of ``rep`` (the XLA path's zero-guarded total).
+
+    Returns ``(outcomes_raw, outcomes_adjusted, certainty, pcol, prow,
+    na_count_rows)`` where ``pcol = rep^T [is-NaN]`` (so
+    ``participation_columns = 1 - pcol``) and ``prow = [is-NaN] @ certainty``
+    (the caller normalizes by total certainty for ``participation_rows``).
+    """
+    R, E = x.shape
+    f32 = jnp.float32
+    chunk = _pick_chunk(R)
+    if chunk is None:
+        raise ValueError(f"R={R} has no 8-multiple divisor <= 1024; use the "
+                         "XLA resolution path")
+    n_chunks = R // chunk
+    C = min(block_cols, E)
+    n_blocks = pl.cdiv(E, C)
+    fv = jnp.concatenate([
+        fill.astype(f32).reshape(1, E),
+        jnp.broadcast_to(jnp.asarray(full_total, f32), (1, E)),
+    ])
+    col_spec = pl.BlockSpec((1, C), lambda j: (0, j), memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((R, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
+    raw, out, cert, pcol, prow, narow = pl.pallas_call(
+        functools.partial(_resolve_certainty_kernel,
+                          tolerance=float(tolerance), chunk=chunk,
+                          n_chunks=n_chunks, n_events=E),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((R, C), lambda j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, C), lambda j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[col_spec, col_spec, col_spec, col_spec,
+                   row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, E), f32),
+            jax.ShapeDtypeStruct((1, E), f32),
+            jax.ShapeDtypeStruct((1, E), f32),
+            jax.ShapeDtypeStruct((1, E), f32),
+            jax.ShapeDtypeStruct((R, 1), f32),
+            jax.ShapeDtypeStruct((R, 1), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=10 * R * E, bytes_accessed=R * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, rep.astype(f32).reshape(-1, 1), fv)
+    return (raw.reshape(E), out.reshape(E), cert.reshape(E), pcol.reshape(E),
+            prow.reshape(R), narow.reshape(R))
+
+
 def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
-                          interpret: bool = False):
+                          fill=None, interpret: bool = False):
     """First principal component via power iteration with the fused
     one-HBM-pass covariance application. Runs the shared convergence driver
     (``jax_kernels._power_loop`` — same start vector, normalization, and
     early-exit rule as the XLA matvec path) but never materializes the
     centered matrix and reads ``x`` once — not twice — per step.
 
-    x : (R, E) filled reports (f32 or bf16 — bf16 halves the HBM traffic).
+    x : (R, E) filled reports (f32 or bf16 — bf16 halves the HBM traffic),
+        or NaN-threaded storage when the (E,) ``fill`` vector is given.
     mu, denom : weighted column means and the ``1 - sum(rep^2)`` scalar.
     Returns the (E,) f32 loading (unit norm, sign arbitrary).
     """
@@ -133,10 +410,13 @@ def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
     # pad once, outside the convergence loop — apply_weighted_cov's own pad
     # then no-ops, instead of copying the matrix on every sweep when R is
     # not a panel multiple
-    tile_r = _panel_rows(E, x.dtype.itemsize)
+    tile_r = _panel_rows(E, x.dtype.itemsize,
+                         _PANEL_BYTES // 2 if fill is not None
+                         else _PANEL_BYTES)
     x, rep = _pad_rows(x, rep.astype(f32), tile_r)
 
     def apply_cov(v):
-        return apply_weighted_cov(x, mu, rep, v, interpret=interpret) / denom
+        return apply_weighted_cov(x, mu, rep, v, fill=fill,
+                                  interpret=interpret) / denom
 
     return _power_loop(apply_cov, E, f32, n_iters, tol)

@@ -21,6 +21,7 @@ Use :func:`sharded_consensus` for one big oracle, or
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -64,6 +65,49 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
     return "power"
 
 
+def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
+                          n_devices: int) -> bool:
+    """Gate for the NaN-threaded Pallas fast path
+    (``ConsensusParams.fused_resolution``): single real TPU (a Pallas call
+    is a black box to the GSPMD partitioner, so the multi-chip mesh stays
+    on XLA), binary events, the sztorc algorithm scored by power iteration
+    (``params.pca_method`` must already be resolved — an explicit or
+    auto-picked exact eigh must NOT be silently swapped for power
+    iteration), and a reporter count the fused resolution kernel's
+    row-chunk loop can tile."""
+    from ..ops.pallas_kernels import _pick_chunk
+
+    return (n_devices == 1
+            and jax.default_backend() == "tpu"
+            and params.algorithm == "sztorc"
+            and params.pca_method in ("power", "power-fused")
+            and not params.any_scaled
+            and _pick_chunk(n_reporters) is not None)
+
+
+@functools.lru_cache(maxsize=16)
+def _default_bounds_placed(mesh: Mesh, E: int):
+    """Device-resident, event-sharded all-binary bounds vectors, cached per
+    (mesh, E) — these are constants; rebuilding them per resolution costs
+    host->device uploads or extra dispatches on every call."""
+    jnp = jax.numpy
+    dtype = jnp.asarray(0.0).dtype
+    e_shard = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec("event"))
+    scaled = jax.device_put(jnp.zeros((E,), dtype=bool), e_shard)
+    mins = jax.device_put(jnp.zeros((E,), dtype=dtype), e_shard)
+    maxs = jax.device_put(jnp.ones((E,), dtype=dtype), e_shard)
+    return scaled, mins, maxs
+
+
+@functools.lru_cache(maxsize=16)
+def _default_reputation_placed(mesh: Mesh, R: int):
+    """Device-resident replicated uniform reputation, cached per (mesh, R)."""
+    jnp = jax.numpy
+    return jax.device_put(jnp.full((R,), 1.0 / R, dtype=jnp.asarray(0.0).dtype),
+                          replicated(mesh))
+
+
 def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
     """device_put the pipeline inputs with the event axis sharded: the
     (R, E) matrix and all E-vectors split over "event", the O(R) reputation
@@ -96,18 +140,40 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         raise ValueError(f"reports must be 2-D, got shape {reports.shape}")
     R, E = reports.shape
 
-    scaled, mins, maxs = parse_event_bounds(event_bounds, E)
     p = params if params is not None else ConsensusParams()
     is_host = isinstance(reports, np.ndarray)
+    if event_bounds is None:
+        # all-binary default: the E-vectors are constants — build them ON
+        # DEVICE, pre-sharded, and cache per (mesh, E). Materializing them
+        # on host re-uploads ~3 E-vectors through the host<->device link on
+        # every call (measured ~70 ms per resolution through the
+        # tunneled-TPU link at E=100k — 2-3x the entire resolution), and
+        # even device-side re-creation costs several dispatches per call.
+        scaled, mins, maxs = _default_bounds_placed(mesh, E)
+        any_scaled = False
+    else:
+        scaled, mins, maxs = parse_event_bounds(event_bounds, E)
+        any_scaled = bool(scaled.any())
     p = p._replace(
         pca_method=_pick_pca_method(p, R, mesh.devices.size),
-        any_scaled=bool(scaled.any()),
+        any_scaled=any_scaled,
         # device-resident input: can't cheaply inspect for NaN on host — keep
         # the fill pass unless the caller's params already opted out
         has_na=bool(np.isnan(reports).any()) if is_host else p.has_na,
     )
+    p = p._replace(fused_resolution=_use_fused_resolution(
+        p, R, mesh.devices.size))
     if reputation is None:
-        reputation = np.full((R,), 1.0 / R)
+        reputation = _default_reputation_placed(mesh, R)   # cached, on device
+        if event_bounds is None:
+            # everything but the matrix is already placed; skip the
+            # per-call device_put round entirely
+            x_shard = event_sharding(mesh)
+            dtype = jax.numpy.asarray(0.0).dtype
+            reports_placed = jax.device_put(
+                jax.numpy.asarray(reports, dtype=dtype), x_shard)
+            return consensus_light_jit(reports_placed, reputation, scaled,
+                                       mins, maxs, p)
     placed = _place_inputs(mesh, reports, reputation, scaled, mins, maxs)
     return consensus_light_jit(*placed, p)
 
@@ -130,6 +196,9 @@ class ShardedOracle(Oracle):
         self.params = self.params._replace(
             pca_method=_pick_pca_method(self.params, self.reports.shape[0],
                                         self.mesh.devices.size))
+        self.params = self.params._replace(
+            fused_resolution=_use_fused_resolution(
+                self.params, self.reports.shape[0], self.mesh.devices.size))
 
     def resolve_raw(self):
         placed = _place_inputs(self.mesh, self.reports, self.reputation,

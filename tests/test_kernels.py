@@ -344,6 +344,87 @@ class TestPallasFused:
         np.testing.assert_allclose(_align_sign(s, scores_np), scores_np,
                                    atol=3e-3)
 
+    def test_scores_dirfix_pass_contractions(self, rng):
+        """The one-sweep contraction outputs equal their two-pass XLA
+        definitions: t = X@loading, q = t^T X, c = colsums, o = rep^T X."""
+        from pyconsensus_tpu.ops.pallas_kernels import scores_dirfix_pass
+        R, E = 13, 9            # deliberately not panel multiples
+        X = rng.random((R, E))
+        rep = nk.normalize(rng.random(R) + 0.1)
+        loading = rng.random(E)
+        t, q, c, o = scores_dirfix_pass(jnp.asarray(X, jnp.float32),
+                                        jnp.asarray(rep, jnp.float32),
+                                        jnp.asarray(loading, jnp.float32),
+                                        interpret=True)
+        t_ref = X @ loading
+        np.testing.assert_allclose(np.asarray(t), t_ref, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(q), t_ref @ X, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(c), X.sum(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(o), rep @ X, rtol=1e-5)
+
+    def test_sztorc_fused_matches_two_pass(self, rng):
+        """The fused sztorc scoring step (power PCA + one-sweep direction
+        fix) agrees with the numpy composition on matrices with a decisive
+        collusion direction, and picks the same orientation."""
+        honest = np.tile(rng.choice([0.0, 1.0], size=(1, 12)), (9, 1))
+        liars = 1.0 - honest[:3]
+        X = np.concatenate([honest, liars])          # 12 reporters
+        noise = rng.choice([0.0, 0.5], size=X.shape, p=[0.9, 0.1])
+        X = np.abs(X - noise)
+        rep = nk.normalize(rng.random(12) + 0.5)
+        adj_np = nk.direction_fixed_scores(
+            nk.weighted_prin_comp(X, rep)[1], X, rep)
+        adj_f, loading = jk.sztorc_scores_power_fused(
+            jnp.asarray(X), jnp.asarray(rep), power_iters=256,
+            power_tol=-1.0, interpret=True)
+        # the PCA eigensign is arbitrary, and the direction fix compensates:
+        # with a flipped loading the fused path picks set2(-s) = -set1(s),
+        # and row_reward_weighted's normalize cancels the overall sign — the
+        # REPUTATION is the invariant to compare, not the raw adj vector
+        rep_np = nk.row_reward_weighted(adj_np, rep)
+        rep_f = np.asarray(jk.row_reward_weighted(adj_f, jnp.asarray(rep)))
+        np.testing.assert_allclose(rep_f, rep_np, atol=2e-4)
+        # honest majority rewarded
+        assert rep_f[:9].sum() > rep_f[9:].sum()
+
+    def test_resolve_certainty_fused_parity(self, rng):
+        """The one-sweep resolution kernel reproduces resolve_outcomes +
+        certainty_and_bonuses on NaN-threaded binary reports, including the
+        ragged last column block (E not a multiple of the block width)."""
+        from pyconsensus_tpu.ops.pallas_kernels import resolve_certainty_fused
+        R, E = 24, 7
+        X = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+        X[rng.random((R, E)) < 0.2] = np.nan
+        rep = nk.normalize(rng.random(R) + 0.1)
+        scaled = np.zeros(E, dtype=bool)
+        filled = nk.interpolate(X, rep, scaled, 0.1)
+        present = ~np.isnan(X)
+        raw_np, adj_np = nk.resolve_outcomes(X, filled, rep, scaled, 0.1)
+        extras = nk.certainty_and_bonuses(X, filled, rep, adj_np, scaled, 0.1)
+        # fill vector: interpolate's rule (rep-weighted present mean,
+        # catch-snapped for binary events)
+        w = np.where(present, rep[:, None], 0.0)
+        tw = w.sum(axis=0)
+        numer = (w * np.where(present, X, 0.0)).sum(axis=0)
+        fill = nk.catch(np.where(tw > 0, numer / np.maximum(tw, 1e-30), 0.5),
+                        0.1)
+        raw, adj, cert, pcol, prow, narow = resolve_certainty_fused(
+            jnp.asarray(X, jnp.float32), jnp.asarray(rep, jnp.float32),
+            jnp.asarray(fill, jnp.float32), jnp.asarray(rep.sum()), 0.1,
+            block_cols=4, interpret=True)   # block_cols=4 -> ragged E=7
+        np.testing.assert_allclose(np.asarray(raw), raw_np, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(adj), adj_np)
+        np.testing.assert_allclose(np.asarray(cert), extras["certainty"],
+                                   atol=1e-5)
+        np.testing.assert_allclose(1.0 - np.asarray(pcol),
+                                   extras["participation_columns"], atol=1e-5)
+        total_cert = extras["certainty"].sum()
+        np.testing.assert_allclose(
+            1.0 - np.asarray(prow) / total_cert,
+            extras["participation_rows"], atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(narow) > 0,
+                                      np.isnan(X).any(axis=1))
+
     def test_power_early_exit_matches_full_run(self, rng):
         """tol=0 (machine-precision floor) must give the same loading as a
         full fixed-trip run (power_tol=-1 disables the early exit) — the

@@ -93,6 +93,70 @@ class TestShardedParity:
                           mesh=mesh8)
 
 
+class TestFusedResolution:
+    """The NaN-threaded Pallas fast path (ConsensusParams.fused_resolution,
+    Pallas interpreter on the CPU test platform) must reproduce the XLA
+    light pipeline key-for-key — it replaces the fill/PCA/direction-fix/
+    outcome/certainty passes with fused kernels but not their semantics."""
+
+    @pytest.mark.parametrize("max_iterations", [1, 4])
+    def test_matches_xla_light_path(self, rng, max_iterations):
+        from pyconsensus_tpu.models.pipeline import (_consensus_core_fused,
+                                                     _consensus_core_light)
+        import jax.numpy as jnp
+        reports = make_reports(rng, R=24, E=7)    # ragged vs 128-col blocks
+        R, E = reports.shape
+        rep = np.full(R, 1.0 / R)
+        args = (jnp.asarray(reports), jnp.asarray(rep),
+                jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E))
+        base = ConsensusParams(algorithm="sztorc",
+                               max_iterations=max_iterations,
+                               pca_method="power", power_iters=256,
+                               power_tol=-1.0, any_scaled=False, has_na=True)
+        ref = _consensus_core_light(*args, base)
+        fused = _consensus_core_fused(
+            *args, base._replace(fused_resolution=True))
+        assert set(fused) == set(ref)
+        for key in ref:
+            a, b = np.asarray(ref[key]), np.asarray(fused[key])
+            if key in ("outcomes_adjusted", "outcomes_final", "na_row",
+                       "iterations", "convergence"):
+                np.testing.assert_array_equal(a, b, err_msg=key)
+            elif key == "first_loading":
+                # eigensign is arbitrary between the paths
+                np.testing.assert_allclose(np.abs(a), np.abs(b), atol=2e-3,
+                                           err_msg=key)
+            else:
+                np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+    def test_gate_requires_single_tpu(self):
+        from pyconsensus_tpu.parallel.sharded import _use_fused_resolution
+        p = ConsensusParams(algorithm="sztorc", any_scaled=False,
+                            pca_method="power-fused")   # as resolved
+        # CPU test platform: never on, regardless of other conditions
+        assert not _use_fused_resolution(p, 10_000, 1)
+        # and the non-sztorc / exact-PCA / scaled / multi-device /
+        # untileable-R gates
+        assert not _use_fused_resolution(
+            p._replace(algorithm="k-means"), 10_000, 1)
+        # an explicitly requested (or auto-picked, R<=4096) exact eigh must
+        # never be silently swapped for power iteration by the fused path
+        assert not _use_fused_resolution(
+            p._replace(pca_method="eigh-gram"), 10_000, 1)
+        assert not _use_fused_resolution(
+            p._replace(any_scaled=True), 10_000, 1)
+        assert not _use_fused_resolution(p, 10_000, 8)
+        assert not _use_fused_resolution(p, 10_007, 1)   # prime-ish R
+
+    def test_chunk_picker(self):
+        from pyconsensus_tpu.ops.pallas_kernels import _pick_chunk
+        assert _pick_chunk(10_000) == 1000
+        assert _pick_chunk(16) == 16
+        assert _pick_chunk(24) == 24
+        assert _pick_chunk(10_007) is None
+        assert _pick_chunk(2048) == 1024
+
+
 class TestMesh:
     def test_make_mesh_shapes(self):
         m = make_mesh(batch=2, event=4)

@@ -1,0 +1,103 @@
+"""Differential phase timing of the fused light pipeline at bench shape.
+
+Method (docs/PERFORMANCE.md): marginal time = (t(1+N dispatches) - t(1)) / N
+with one device-combined scalar fetched per batch, cancelling tunnel RTT and
+fixed dispatch costs. All large arrays are passed as jit ARGUMENTS (closing
+over them bakes 4 GB constants into the lowering).
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pyconsensus_tpu.models.pipeline import (ConsensusParams, _fill_stats,
+                                             _consensus_core_fused)
+from pyconsensus_tpu.ops.pallas_kernels import (power_iteration_fused,
+                                                scores_dirfix_pass,
+                                                resolve_certainty_fused)
+from bench import generate_reports_device
+
+R, E = 10_000, 100_000
+gen = jax.jit(generate_reports_device, static_argnums=(1, 2))
+reports = gen(jax.random.key(0), R, E, 0.02, 0.1, 0.05)
+jax.block_until_ready(reports)
+
+rep0 = jnp.full((R,), 1.0 / R)
+scaled = jnp.zeros((E,), bool)
+zeros = jnp.zeros((E,))
+ones = jnp.ones((E,))
+
+
+def timeit(fn, *args, n=8):
+    float(np.asarray(fn(*args)))      # warm + force
+    t0 = time.perf_counter()
+    float(np.asarray(fn(*args)))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(n + 1)]
+    float(np.asarray(jnp.stack(outs).sum()))
+    tN = time.perf_counter() - t0
+    return (tN - t1) / n
+
+
+@jax.jit
+def ph_fill(reports, rep):
+    x, fill, tw, numer = _fill_stats(reports, rep, 0.1, "bfloat16")
+    return jnp.sum(fill) + jnp.sum(tw) + x[0, 0].astype(jnp.float32)
+
+
+fillout = jax.jit(lambda r, p: _fill_stats(r, p, 0.1, "bfloat16"))
+x_s, fill_s, tw_s, numer_s = fillout(reports, rep0)
+jax.block_until_ready(x_s)
+mu1 = numer_s + (1.0 - tw_s) * fill_s
+denom = 1.0 - jnp.sum(rep0 ** 2)
+
+
+@jax.jit
+def ph_power1(x, mu, dn, rep, fill):
+    return jnp.sum(power_iteration_fused(x, mu, dn, rep, 1, -1.0, fill=fill))
+
+
+@jax.jit
+def ph_power(x, mu, dn, rep, fill):
+    return jnp.sum(power_iteration_fused(x, mu, dn, rep, 128, 0.0, fill=fill))
+
+
+loading_s = jax.jit(lambda x, mu, dn, rep, fill: power_iteration_fused(
+    x, mu, dn, rep, 128, 0.0, fill=fill))(x_s, mu1, denom, rep0, fill_s)
+jax.block_until_ready(loading_s)
+
+
+@jax.jit
+def ph_dirfix(x, rep, loading, fill):
+    t, q, c, o = scores_dirfix_pass(x, rep, loading, fill=fill)
+    return jnp.sum(t) + jnp.sum(q)
+
+
+@jax.jit
+def ph_resolve(x, rep, fill):
+    raw, adj, cert, pcol, prow, narow = resolve_certainty_fused(
+        x, rep, fill, jnp.sum(rep), 0.1)
+    return jnp.sum(cert) + jnp.sum(adj) + jnp.sum(prow)
+
+
+P = ConsensusParams(algorithm="sztorc", max_iterations=1, pca_method="auto",
+                    power_iters=128, storage_dtype="bfloat16",
+                    any_scaled=False, has_na=True, fused_resolution=True)
+
+
+@jax.jit
+def ph_full(reports, rep, scaled, zeros, ones):
+    return _consensus_core_fused(reports, rep, scaled, zeros, ones,
+                                 P)["avg_certainty"]
+
+
+for name, fn, args in [
+        ("fill_stats", ph_fill, (reports, rep0)),
+        ("power_1sweep", ph_power1, (x_s, mu1, denom, rep0, fill_s)),
+        ("power_earlyexit", ph_power, (x_s, mu1, denom, rep0, fill_s)),
+        ("scores_dirfix", ph_dirfix, (x_s, rep0, loading_s, fill_s)),
+        ("resolve_cert", ph_resolve, (x_s, rep0, fill_s)),
+        ("FULL_PIPELINE", ph_full, (reports, rep0, scaled, zeros, ones))]:
+    ms = timeit(fn, *args) * 1e3
+    print(f"{name:18s} {ms:8.2f} ms", flush=True)
