@@ -65,8 +65,16 @@ def main() -> None:
     ap.add_argument("--batches", type=int, default=5,
                     help="timed batches; the median batch rate is reported")
     ap.add_argument("--power-iters", type=int, default=128,
-                    help="cap; the machine-precision early exit usually "
-                         "stops in far fewer sweeps")
+                    help="cap; the early exit usually stops in far fewer "
+                         "sweeps")
+    ap.add_argument("--power-tol", type=float, default=1e-5,
+                    help="power-iteration early-exit alignment tolerance. "
+                         "Each saved sweep is a full HBM pass; catch-snapped "
+                         "outcomes are insensitive to loading error far "
+                         "below the snap tolerance, and the every-run "
+                         "parity assert re-resolves at tol=0 (machine "
+                         "precision) to prove it. Pass 0 for the "
+                         "machine-precision floor")
     ap.add_argument("--max-iterations", type=int, default=1)
     ap.add_argument("--pca-method", default="auto",
                     help="auto picks the fused Pallas kernel on single-"
@@ -101,8 +109,8 @@ def main() -> None:
     params = ConsensusParams(
         algorithm="sztorc", max_iterations=args.max_iterations,
         pca_method=args.pca_method, power_iters=args.power_iters,
-        matvec_dtype=args.matvec_dtype, storage_dtype=args.storage_dtype,
-        any_scaled=False, has_na=True)
+        power_tol=args.power_tol, matvec_dtype=args.matvec_dtype,
+        storage_dtype=args.storage_dtype, any_scaled=False, has_na=True)
 
     def resolve():
         return sharded_consensus(reports, mesh=mesh, params=params)
@@ -167,21 +175,24 @@ def main() -> None:
     outcomes = np.asarray(out["outcomes_adjusted"])
     assert np.isin(outcomes, [0.0, 0.5, 1.0]).all()
 
-    # Low-precision honesty check: when any storage dtype is below full
-    # precision, re-resolve with the f32 path and require every outcome to
-    # be bit-identical — the bf16 default is only legitimate because the
+    # Precision honesty check: when any storage dtype is below full
+    # precision or the power early-exit is loosened, re-resolve with the
+    # f32 machine-precision path and require every outcome to be
+    # bit-identical — the fast defaults are only legitimate because the
     # catch snap absorbs the float noise, and this enforces that claim on
     # every run rather than asserting it in a help string.
-    if args.matvec_dtype or args.storage_dtype:
+    if args.matvec_dtype or args.storage_dtype or args.power_tol > 0:
         full = sharded_consensus(
             reports, mesh=mesh,
-            params=params._replace(matvec_dtype="", storage_dtype=""))
+            params=params._replace(matvec_dtype="", storage_dtype="",
+                                   power_tol=0.0))
         full_outcomes = np.asarray(full["outcomes_adjusted"])
         assert np.array_equal(outcomes, full_outcomes), (
-            f"low-precision storage (matvec={args.matvec_dtype!r}, "
-            f"storage={args.storage_dtype!r}) changed "
-            f"{int((outcomes != full_outcomes).sum())} outcomes vs full "
-            f"precision — rerun with --matvec-dtype '' --storage-dtype ''")
+            f"fast path (matvec={args.matvec_dtype!r}, "
+            f"storage={args.storage_dtype!r}, power_tol={args.power_tol}) "
+            f"changed {int((outcomes != full_outcomes).sum())} outcomes vs "
+            f"the f32 machine-precision path — rerun with --matvec-dtype '' "
+            f"--storage-dtype '' --power-tol 0")
 
     target_resolutions_per_sec = 1.0   # north star: < 1 s per resolution
     print(json.dumps({

@@ -88,18 +88,45 @@ def _run_demo(name: str, reports, bounds, args) -> None:
 
 
 def _run_simulation(args) -> None:
-    from .sim import CollusionSimulator
+    from .sim import CollusionSimulator, RoundsSimulator
 
     # the simulator is always the vmap-batched jax pipeline — --backend
     # applies to the demo runs only
+    lf = [0.0, 0.1, 0.2, 0.3, 0.4]
+    var = [0.0, 0.1, 0.2]
+    if args.rounds > 1:
+        print(f"=== Monte-Carlo repeated-game sweep ({args.rounds} rounds, "
+              f"{args.trials} trials/cell, reputation carried) ===")
+        sim = RoundsSimulator(n_rounds=args.rounds,
+                              n_reporters=args.reporters,
+                              n_events=args.events,
+                              max_iterations=args.iterations,
+                              algorithm=args.algorithm)
+        res = sim.run(lf, var, args.trials, seed=args.seed)
+        headers = ["liar_frac"] + [f"round {r}" for r in (1, args.rounds)]
+        for metric, title in (("correct_rate", "Correct-outcome rate "
+                                               "(variance 0.1)"),
+                              ("liar_rep_share", "Liar reputation share "
+                                                 "(variance 0.1)")):
+            traj = res["mean"][metric]                  # (L, V, n_rounds)
+            rows = [[f"{f:g}", float(traj[i, 1, 0]), float(traj[i, 1, -1])]
+                    for i, f in enumerate(lf)]
+            _print_table(f"{title}: first vs final round", headers, rows)
+        print()
+        if args.plot:
+            from .sim import plot_round_trajectories
+
+            ax = plot_round_trajectories(res, "liar_rep_share",
+                                         variance_index=1)
+            ax.figure.savefig(args.plot, bbox_inches="tight")
+            print(f"round-trajectory plot written to {args.plot}")
+        return
     print(f"=== Monte-Carlo collusion sweep "
           f"({args.trials} trials/cell, batched jax pipeline) ===")
     sim = CollusionSimulator(n_reporters=args.reporters,
                              n_events=args.events,
                              max_iterations=args.iterations,
                              algorithm=args.algorithm)
-    lf = [0.0, 0.1, 0.2, 0.3, 0.4]
-    var = [0.0, 0.1, 0.2]
     res = sim.run(lf, var, args.trials, seed=args.seed)
     headers = ["liar_frac"] + [f"var={v:g}" for v in var]
     rows = []
@@ -134,7 +161,9 @@ def main(argv: Optional[Sequence[str]] = None,
                     help="run a Monte-Carlo collusion sweep")
     ap.add_argument("--plot", metavar="PATH",
                     help="with --simulate: write a PNG sweep report "
-                         "(heatmaps + retention curves; needs matplotlib)")
+                         "(heatmaps + retention curves; with --rounds > 1, "
+                         "a per-round liar-reputation trajectory plot "
+                         "instead; needs matplotlib)")
     ap.add_argument("-f", "--file", metavar="PATH",
                     help="resolve a reports matrix loaded from PATH "
                          "(.npy or .csv; NA/NaN = missing report)")
@@ -144,12 +173,16 @@ def main(argv: Optional[Sequence[str]] = None,
                     help="max reputation-redistribution iterations")
     ap.add_argument("--trials", type=int, default=100,
                     help="simulation trials per grid cell")
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="with --simulate: rounds per trial with reputation "
+                         "carried between rounds (the repeated-game "
+                         "experiment; 1 = independent single-round trials)")
     ap.add_argument("--reporters", type=int, default=20)
     ap.add_argument("--events", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
-    for name in ("iterations", "trials", "reporters", "events"):
+    for name in ("iterations", "trials", "reporters", "events", "rounds"):
         if getattr(args, name) < 1:
             ap.error(f"--{name} must be >= 1")
     if args.simulate and args.algorithm not in JIT_ALGORITHMS:

@@ -36,6 +36,21 @@ class TestCli:
         assert "Correct-outcome rate" in out
         assert "Liar reputation share" in out
 
+    def test_simulate_rounds(self, capsys, tmp_path):
+        pytest.importorskip("matplotlib").use("Agg")
+        path = str(tmp_path / "rounds.png")
+        assert main(["--simulate", "--rounds", "3", "--trials", "4",
+                     "--reporters", "10", "--events", "5",
+                     "--plot", path]) == 0
+        out = capsys.readouterr().out
+        assert "repeated-game sweep" in out
+        assert "first vs final round" in out
+        assert (tmp_path / "rounds.png").exists()
+
+    def test_rounds_validation(self):
+        with pytest.raises(SystemExit):
+            main(["--simulate", "--rounds", "0"])
+
     def test_bad_flag_exits_nonzero(self):
         with pytest.raises(SystemExit):
             main(["--algorithm", "nope"])

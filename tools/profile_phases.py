@@ -5,7 +5,12 @@ with one device-combined scalar fetched per batch, cancelling tunnel RTT and
 fixed dispatch costs. All large arrays are passed as jit ARGUMENTS (closing
 over them bakes 4 GB constants into the lowering).
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import jax
 import jax.numpy as jnp
