@@ -1,11 +1,11 @@
-"""Clustering consensus variants: k-means / hierarchical / DBSCAN over
-reporter rows (SURVEY.md §2 #10, BASELINE.json config 4).
+"""Clustering consensus variants: k-means / hierarchical / DBSCAN (hybrid
+and fully-jit) over reporter rows (SURVEY.md §2 #10, BASELINE.json config 4).
 
-Scoring rule (shared by all three): cluster the reporter rows of the filled
-reports matrix; a reporter's raw score ("conformity") is the total reputation
-mass of its own cluster — reporters in the dominant cluster carry the most
-weight, outliers/liars the least. The conformity vector then feeds the same
-``row_reward_weighted -> smooth`` machinery as the PCA scores.
+Scoring rule (shared by every variant): cluster the reporter rows of the
+filled reports matrix; a reporter's raw score ("conformity") is the total
+reputation mass of its own cluster — reporters in the dominant cluster carry
+the most weight, outliers/liars the least. The conformity vector then feeds
+the same ``row_reward_weighted -> smooth`` machinery as the PCA scores.
 
 Backend split (SURVEY.md §7 M3):
 
@@ -13,10 +13,15 @@ Backend split (SURVEY.md §7 M3):
   deterministic centroid seeding (evenly-spaced reporter rows) and
   reputation-weighted centroid updates — a ``lax.fori_loop`` under jit on the
   JAX side, the identical arithmetic as a Python loop on the numpy side.
-- **hierarchical** and **DBSCAN** are irregular, data-dependent algorithms
-  that resist static-shape compilation; they run on host (scipy / sklearn)
-  against a *device-computed* distance matrix in the jax backend — the hybrid
-  split called out in SURVEY.md §7.
+- **dbscan-jit** is the fully on-device DBSCAN (the SURVEY.md §7 M3
+  stretch): a static-shape reformulation as min-label propagation over the
+  core-point graph — jit- and vmap-compatible, so it batches under the
+  Monte-Carlo simulator.
+- **hierarchical** and classic **dbscan** are irregular, data-dependent
+  algorithms that resist static-shape compilation; they run on host
+  (native/cluster.cpp, with scipy/sklearn fallback) against a
+  *device-computed* distance matrix in the jax backend — the hybrid split
+  called out in SURVEY.md §7.
 """
 
 from __future__ import annotations
@@ -28,6 +33,7 @@ from jax import lax
 __all__ = [
     "kmeans_conformity_np", "kmeans_conformity_jax",
     "hierarchical_conformity", "dbscan_conformity",
+    "dbscan_jit_conformity_np", "dbscan_jit_conformity_jax",
     "pairwise_sq_dists_jax",
 ]
 
@@ -159,6 +165,99 @@ def hierarchical_conformity(reports_filled, reputation, threshold,
         Z = linkage(squareform(d, checks=False), method="average")
         labels = fcluster(Z, t=threshold, criterion="distance")
     return _cluster_mass(labels, rep)
+
+
+def _dbscan_jit_labels_np(d2: np.ndarray, eps: float,
+                          min_samples: int) -> np.ndarray:
+    """Deterministic DBSCAN labeling (numpy reference for the jit variant):
+    every cluster is labeled by the smallest core-point index it contains,
+    border points take the minimum label among their core neighbors, and
+    noise points become singletons labeled by their own index. Identical
+    clusters to classic DBSCAN; the only difference is the deterministic
+    (min-label) assignment of border points reachable from two clusters,
+    where sklearn's answer depends on scan order."""
+    R = d2.shape[0]
+    nbr = d2 <= eps * eps                       # includes self
+    core = nbr.sum(axis=1) >= min_samples
+    adj = nbr & core[None, :] & core[:, None]
+    labels = np.where(core, np.arange(R), R)
+    while True:
+        cand = np.where(adj, labels[None, :], R).min(axis=1)
+        new = np.minimum(labels, cand)
+        valid = new < R
+        jumped = np.where(valid, new[np.where(valid, new, 0)], new)
+        if np.array_equal(jumped, labels):
+            break
+        labels = jumped
+    border_mass = nbr & core[None, :]
+    border_label = np.where(border_mass, labels[None, :], R).min(axis=1)
+    is_border = (~core) & (border_label < R)
+    out = np.where(core, labels,
+                   np.where(is_border, border_label, np.arange(R)))
+    return out.astype(np.int64)
+
+
+def dbscan_jit_conformity_np(reports_filled, reputation, eps, min_samples):
+    """``dbscan-jit`` conformity, numpy backend (parity anchor for
+    :func:`dbscan_jit_conformity_jax`)."""
+    X = np.asarray(reports_filled, dtype=np.float64)
+    rep = np.asarray(reputation, dtype=np.float64)
+    labels = _dbscan_jit_labels_np(_pairwise_sq_dists_np(X), float(eps),
+                                   int(min_samples))
+    return _cluster_mass(labels, rep)
+
+
+def dbscan_jit_conformity_jax(reports_filled, reputation, eps, min_samples):
+    """Fully on-device DBSCAN conformity (SURVEY.md §7 M3 stretch: the
+    jit-compatible DBSCAN variant).
+
+    Classic DBSCAN is a data-dependent BFS — hostile to static shapes. The
+    same clusters fall out of a static-shape formulation: core points are
+    rows with >= ``min_samples`` neighbors within ``eps``; clusters are the
+    connected components of the core-core neighborhood graph, found by
+    min-label propagation with pointer jumping under ``lax.while_loop``
+    (O(log R) rounds of an O(R^2) relaxation — R x R fits comfortably for
+    clustering-scale reporter counts); border points take the minimum label
+    among their core neighbors; noise points are singletons. Deterministic
+    border tie-break (min label) where sklearn is scan-order-dependent —
+    mirrored exactly by :func:`dbscan_jit_conformity_np`.
+
+    Everything is jit/vmap-compatible, so this variant batches under the
+    Monte-Carlo simulator, unlike the hybrid host DBSCAN.
+    """
+    acc = reputation.dtype
+    X = reports_filled.astype(acc)
+    rep = reputation
+    R = X.shape[0]
+    d2 = pairwise_sq_dists_jax(X)
+    nbr = d2 <= eps * eps
+    core = jnp.sum(nbr, axis=1) >= min_samples
+    adj = nbr & core[None, :] & core[:, None]
+    idx = jnp.arange(R)
+    init = jnp.where(core, idx, R)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        cand = jnp.min(jnp.where(adj, labels[None, :], R), axis=1)
+        new = jnp.minimum(labels, cand)
+        # pointer jump: a label is a core index, and labels[label] <= label,
+        # so one gather halves the remaining propagation distance
+        jumped = jnp.where(new < R, new[jnp.where(new < R, new, 0)], new)
+        return jumped, jnp.any(jumped != labels)
+
+    labels, _ = lax.while_loop(cond, body, (init, jnp.asarray(True)))
+    border_label = jnp.min(jnp.where(nbr & core[None, :], labels[None, :], R),
+                           axis=1)
+    is_border = (~core) & (border_label < R)
+    final = jnp.where(core, labels,
+                      jnp.where(is_border, border_label, idx))
+    # conformity via the R x R same-label matmul (one MXU contraction)
+    same = (final[:, None] == final[None, :]).astype(acc)
+    return same @ rep
 
 
 def dbscan_conformity(reports_filled, reputation, eps, min_samples,

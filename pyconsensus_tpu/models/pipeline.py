@@ -39,7 +39,7 @@ from .sztorc import (fixed_variance_scores_jax, fixed_variance_scores_np,
 __all__ = ["ConsensusParams", "consensus_np", "consensus_jax", "JIT_ALGORITHMS"]
 
 #: algorithms whose full pipeline compiles to one XLA graph
-JIT_ALGORITHMS = ("sztorc", "fixed-variance", "ica", "k-means")
+JIT_ALGORITHMS = ("sztorc", "fixed-variance", "ica", "k-means", "dbscan-jit")
 #: algorithms that need a host-side clustering step (hybrid path)
 HYBRID_ALGORITHMS = ("hierarchical", "dbscan")
 
@@ -107,6 +107,9 @@ def _scores_np(filled, rep, p: ConsensusParams):
         return ica_scores_np(filled, rep, p.max_components), None
     if algo == "k-means":
         return cl.kmeans_conformity_np(filled, rep, p.num_clusters), None
+    if algo == "dbscan-jit":
+        return cl.dbscan_jit_conformity_np(filled, rep, p.dbscan_eps,
+                                           p.dbscan_min_samples), None
     if algo == "hierarchical":
         return cl.hierarchical_conformity(filled, rep,
                                           p.hierarchy_threshold), None
@@ -179,6 +182,9 @@ def _scores_jax(filled, rep, p: ConsensusParams):
         return ica_scores_jax(filled, rep, p.max_components, p.pca_method), None
     if algo == "k-means":
         return cl.kmeans_conformity_jax(filled, rep, p.num_clusters), None
+    if algo == "dbscan-jit":
+        return cl.dbscan_jit_conformity_jax(filled, rep, p.dbscan_eps,
+                                            p.dbscan_min_samples), None
     raise ValueError(f"algorithm {algo!r} is not jit-compatible "
                      f"(hybrid algorithms: {HYBRID_ALGORITHMS})")
 

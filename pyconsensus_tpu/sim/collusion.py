@@ -108,17 +108,22 @@ class CollusionSimulator:
         shape).
     collude : shared-lie attack vs independent random liars.
     algorithm, max_iterations, alpha, catch_tolerance, pca_method,
-    power_iters : consensus knobs, as on :class:`~pyconsensus_tpu.Oracle`.
+    power_iters, num_clusters, dbscan_eps, dbscan_min_samples : consensus
+        knobs, as on :class:`~pyconsensus_tpu.Oracle`.
         ``pca_method="power"`` is the default here: power iteration is pure
         matmuls, which batch perfectly under vmap on the MXU (batched eigh
-        does not).
+        does not). For ``algorithm="dbscan-jit"`` on binary reports, note
+        squared row distances count disagreeing events — set ``dbscan_eps``
+        to roughly ``sqrt(expected disagreements between honest rows)``
+        (e.g. ``sqrt(2 * variance * n_events)``), not the 0.5 default.
     """
 
     def __init__(self, n_reporters: int = 20, n_events: int = 10,
                  collude: bool = True, algorithm: str = "sztorc",
                  max_iterations: int = 1, alpha: float = 0.1,
                  catch_tolerance: float = 0.1, pca_method: str = "power",
-                 power_iters: int = 64):
+                 power_iters: int = 64, num_clusters: int = 2,
+                 dbscan_eps: float = 0.5, dbscan_min_samples: int = 2):
         if algorithm not in JIT_ALGORITHMS:
             raise ValueError(
                 f"simulator requires a jit-compatible algorithm "
@@ -130,7 +135,10 @@ class CollusionSimulator:
             algorithm=algorithm, alpha=float(alpha),
             catch_tolerance=float(catch_tolerance),
             max_iterations=int(max_iterations), pca_method=pca_method,
-            power_iters=int(power_iters), any_scaled=False, has_na=False)
+            power_iters=int(power_iters), num_clusters=int(num_clusters),
+            dbscan_eps=float(dbscan_eps),
+            dbscan_min_samples=int(dbscan_min_samples),
+            any_scaled=False, has_na=False)
         self._batched = jax.jit(jax.vmap(self._trial_fn()))
 
     def _trial_fn(self):

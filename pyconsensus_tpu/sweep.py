@@ -33,7 +33,7 @@ def compare_algorithms(reports, algorithms: Optional[Sequence[str]] = None,
                        event_bounds=None, reputation=None,
                        **oracle_kwargs) -> Dict[str, dict]:
     """Resolve ``reports`` under every algorithm in ``algorithms`` (default:
-    all six), returning ``{algorithm: consensus-result-dict}``.
+    all seven), returning ``{algorithm: consensus-result-dict}``.
 
     The jit variants are dispatched first without blocking — their XLA
     programs queue on the device and execute back-to-back — then the hybrid
