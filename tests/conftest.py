@@ -28,3 +28,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def collusion_reports(rng, R, E, liars, flip_rate=0.1, na_frac=0.0):
+    """Shared synthetic-report builder: an honest majority reporting truth
+    with per-entry flip noise, a block of coordinated liars reporting
+    anti-truth, optional NaN non-reports. Returns ``(reports, truth)``."""
+    truth = rng.choice([0.0, 1.0], size=E)
+    reports = np.tile(truth, (R, 1))
+    honest = R - liars
+    flips = rng.random((honest, E)) < flip_rate
+    reports[:honest] = np.abs(reports[:honest] - flips)
+    reports[honest:] = 1.0 - truth
+    if na_frac > 0.0:
+        reports[rng.random((R, E)) < na_frac] = np.nan
+    return reports, truth

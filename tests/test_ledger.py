@@ -4,16 +4,12 @@
 import numpy as np
 import pytest
 
+from conftest import collusion_reports
 from pyconsensus_tpu import Oracle, ReputationLedger
 
 
 def make_reports(rng, R=10, E=6, liars=3):
-    truth = rng.choice([0.0, 1.0], size=E)
-    reports = np.tile(truth, (R, 1))
-    flip = rng.random((R - liars, E)) < 0.1
-    reports[:R - liars] = np.abs(reports[:R - liars] - flip)
-    reports[R - liars:] = 1.0 - truth
-    return reports
+    return collusion_reports(rng, R, E, liars)[0]
 
 
 class TestLedger:

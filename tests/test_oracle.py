@@ -5,6 +5,7 @@ contract, and validation (SURVEY.md §4)."""
 import numpy as np
 import pytest
 
+from conftest import collusion_reports
 from pyconsensus_tpu import ALGORITHMS, Oracle
 
 # The canonical Truthcoin whitepaper-style example: 6 reporters × 4 binary
@@ -46,12 +47,7 @@ SCALED_BOUNDS = [
 
 
 def make_majority(rng, R=50, E=25, liars=10):
-    truth = rng.choice([0.0, 1.0], size=E)
-    reports = np.tile(truth, (R, 1))
-    flip = rng.random((R - liars, E)) < 0.1
-    reports[:R - liars] = np.abs(reports[:R - liars] - flip)
-    reports[R - liars:] = 1.0 - truth  # coordinated liars
-    return reports, truth
+    return collusion_reports(rng, R, E, liars)
 
 
 class TestCanonical:

@@ -6,6 +6,7 @@ import jax
 import numpy as np
 import pytest
 
+from conftest import collusion_reports
 from pyconsensus_tpu import Oracle
 from pyconsensus_tpu.models.pipeline import ConsensusParams
 from pyconsensus_tpu.parallel import (ShardedOracle, make_mesh,
@@ -19,13 +20,7 @@ def mesh8():
 
 
 def make_reports(rng, R=32, E=64, na_frac=0.05):
-    truth = rng.choice([0.0, 1.0], size=E)
-    reports = np.tile(truth, (R, 1))
-    flip = rng.random((R - 6, E)) < 0.1
-    reports[:R - 6] = np.abs(reports[:R - 6] - flip)
-    reports[R - 6:] = 1.0 - truth
-    reports[rng.random((R, E)) < na_frac] = np.nan
-    return reports
+    return collusion_reports(rng, R, E, liars=6, na_frac=na_frac)[0]
 
 
 class TestShardedParity:
