@@ -412,7 +412,10 @@ def direction_fixed_scores(scores, reports_filled, reputation):
                    preferred_element_type=acc)
     old, new1, new2 = M[0], M[1], M[2]
     ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
-    return jnp.where(ref_ind <= 0.0, set1, set2)
+    # the winning orientation in non-negative form (numpy_kernels
+    # .direction_fixed_scores: -set2, an exact no-op through normalize for
+    # one component, simplex-safe for blends)
+    return jnp.where(ref_ind <= 0.0, set1, -set2)
 
 
 def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
@@ -474,7 +477,8 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
                      set2X / jnp.where(s2_tot == 0.0, 1.0, s2_tot))
     old = o.astype(acc)
     ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
-    return jnp.where(ref_ind <= 0.0, set1, set2), loading
+    # non-negative winning orientation, as in direction_fixed_scores
+    return jnp.where(ref_ind <= 0.0, set1, -set2), loading
 
 
 def row_reward_weighted(adj_scores, reputation):

@@ -51,10 +51,11 @@ __all__ = [
 def normalize(v: np.ndarray) -> np.ndarray:
     """Rescale ``v`` to sum to 1 (SURVEY.md §2 #6, the R ``GetWeight`` rule).
 
-    Plain ``v / sum(v)``: a vector with negative entries and a negative sum
-    (the ``set2`` orientation in the direction fix) normalizes back to a
-    non-negative weighting. A zero-sum vector is returned unchanged — callers
-    guard degenerate cases explicitly (see ``row_reward_weighted``).
+    Plain ``v / sum(v)``; a vector with negative entries and a negative sum
+    normalizes back to a non-negative weighting (which is why a global sign
+    flip of adjusted scores is a no-op through ``row_reward_weighted``). A
+    zero-sum vector is returned unchanged — callers guard degenerate cases
+    explicitly (see ``row_reward_weighted``).
     """
     v = np.asarray(v, dtype=np.float64)
     total = v.sum()
@@ -214,6 +215,14 @@ def direction_fixed_scores(scores: np.ndarray, reports_filled: np.ndarray,
     ``set2 = scores - max(scores)`` imply two outcome vectors; whichever lies
     closer (squared distance) to the current reputation-weighted outcomes
     ``old = rep^T X`` wins. Ties (``ref_ind <= 0``) go to ``set1``.
+
+    The chosen orientation is returned in its NON-NEGATIVE form: when
+    ``set2`` (entrywise <= 0) wins, ``-set2 = max(scores) - scores`` is
+    returned instead. Through ``row_reward_weighted``'s normalize a global
+    sign flip is an exact no-op for a single component, and the
+    non-negative convention keeps multi-component blends (fixed-variance)
+    on the reputation simplex — a mixed-sign blend of raw set1/set2
+    vectors can otherwise produce negative reputation entries.
     """
     s = np.asarray(scores, dtype=np.float64)
     set1 = s + np.abs(np.min(s))
@@ -222,7 +231,7 @@ def direction_fixed_scores(scores: np.ndarray, reports_filled: np.ndarray,
     new1 = normalize(set1) @ reports_filled
     new2 = normalize(set2) @ reports_filled
     ref_ind = np.sum((new1 - old) ** 2) - np.sum((new2 - old) ** 2)
-    return set1 if ref_ind <= 0.0 else set2
+    return set1 if ref_ind <= 0.0 else -set2
 
 
 def row_reward_weighted(adj_scores: np.ndarray, reputation: np.ndarray) -> np.ndarray:

@@ -1,0 +1,97 @@
+"""Randomized invariant fuzzing (SURVEY.md §4's property-based tests,
+implemented with plain seeded sampling — no hypothesis dependency).
+
+Every sampled configuration must satisfy the structural invariants of the
+consensus mechanism regardless of shape, NA pattern, event mix, algorithm,
+or backend:
+
+- reputation vectors live on the simplex (non-negative, sum 1);
+- binary/categorical outcomes land exactly on {0, 0.5, 1};
+- scaled outcomes stay inside their event bounds;
+- participation and certainty are in [0, 1];
+- numpy and jax backends agree bit-identically on snapped outcomes;
+- resolutions are deterministic (same inputs -> same outputs).
+"""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle
+
+N_CASES = 25
+
+
+def _random_case(rng):
+    R = int(rng.integers(3, 40))
+    E = int(rng.integers(2, 30))
+    n_scaled = int(rng.integers(0, max(1, E // 3) + 1))
+    scaled_cols = rng.choice(E, size=n_scaled, replace=False)
+    reports = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+    bounds = [None] * E
+    for j in scaled_cols:
+        lo = float(rng.uniform(-100.0, 100.0))
+        hi = lo + float(rng.uniform(1.0, 500.0))
+        bounds[j] = {"scaled": True, "min": lo, "max": hi}
+        reports[:, j] = rng.uniform(lo, hi, size=R)
+    # NA pattern, but never an all-NaN column (reference precondition)
+    mask = rng.random((R, E)) < rng.uniform(0.0, 0.3)
+    keep = rng.integers(0, R, size=E)
+    mask[keep, np.arange(E)] = False
+    reports[mask] = np.nan
+    reputation = None
+    if rng.random() < 0.5:
+        reputation = rng.random(R) + 0.05
+    kwargs = {
+        "algorithm": str(rng.choice(["sztorc", "fixed-variance", "ica",
+                                     "k-means", "dbscan-jit"])),
+        "max_iterations": int(rng.integers(1, 6)),
+        "alpha": float(rng.uniform(0.05, 0.5)),
+        "catch_tolerance": float(rng.uniform(0.05, 0.3)),
+    }
+    return reports, bounds, reputation, kwargs, np.asarray(
+        [b is not None for b in bounds])
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_invariants_hold(seed):
+    rng = np.random.default_rng(1000 + seed)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    results = {}
+    for backend in ("numpy", "jax"):
+        r = Oracle(reports=reports, event_bounds=bounds,
+                   reputation=reputation, backend=backend,
+                   **kwargs).consensus()
+        for key in ("old_rep", "this_rep", "smooth_rep"):
+            v = np.asarray(r["agents"][key], dtype=float)
+            assert (v >= -1e-9).all(), (backend, key)
+            assert v.sum() == pytest.approx(1.0, abs=1e-6), (backend, key)
+        final = np.asarray(r["events"]["outcomes_final"], dtype=float)
+        assert np.isin(final[~scaled], [0.0, 0.5, 1.0]).all(), backend
+        for j in np.flatnonzero(scaled):
+            lo, hi = bounds[j]["min"], bounds[j]["max"]
+            assert lo - 1e-6 <= final[j] <= hi + 1e-6, (backend, j)
+        assert 0.0 <= r["participation"] <= 1.0 + 1e-9, backend
+        assert 0.0 <= r["certainty"] <= 1.0 + 1e-9, backend
+        cert = np.asarray(r["events"]["certainty"], dtype=float)
+        assert ((cert >= -1e-9) & (cert <= 1.0 + 1e-6)).all(), backend
+        results[backend] = r
+    # cross-backend: snapped outcomes bit-identical
+    np.testing.assert_array_equal(
+        np.asarray(results["numpy"]["events"]["outcomes_final"])[~scaled],
+        np.asarray(results["jax"]["events"]["outcomes_final"])[~scaled],
+        err_msg=str(kwargs))
+    # ICA is an iterated nonlinear fixed point: tiny rounding differences
+    # between backends amplify along the iteration, so its reputation
+    # tolerance is looser (outcomes above are still bit-identical)
+    rep_atol = 5e-3 if kwargs["algorithm"] == "ica" else 5e-6
+    np.testing.assert_allclose(
+        np.asarray(results["jax"]["agents"]["smooth_rep"], dtype=float),
+        np.asarray(results["numpy"]["agents"]["smooth_rep"], dtype=float),
+        atol=rep_atol, err_msg=str(kwargs))
+    # determinism: resolving again reproduces the jax result exactly
+    again = Oracle(reports=reports, event_bounds=bounds,
+                   reputation=reputation, backend="jax",
+                   **kwargs).consensus()
+    np.testing.assert_array_equal(
+        np.asarray(again["events"]["outcomes_final"]),
+        np.asarray(results["jax"]["events"]["outcomes_final"]))

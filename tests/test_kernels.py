@@ -377,10 +377,11 @@ class TestPallasFused:
         adj_f, loading = jk.sztorc_scores_power_fused(
             jnp.asarray(X), jnp.asarray(rep), power_iters=256,
             power_tol=-1.0, interpret=True)
-        # the PCA eigensign is arbitrary, and the direction fix compensates:
-        # with a flipped loading the fused path picks set2(-s) = -set1(s),
-        # and row_reward_weighted's normalize cancels the overall sign — the
-        # REPUTATION is the invariant to compare, not the raw adj vector
+        # the PCA eigensign is arbitrary, and the direction fix compensates
+        # (it returns the winning orientation in non-negative form either
+        # way); the REPUTATION after row_reward_weighted is the clean
+        # invariant to compare, independent of which eigensign each
+        # backend's solver happened to pick
         rep_np = nk.row_reward_weighted(adj_np, rep)
         rep_f = np.asarray(jk.row_reward_weighted(adj_f, jnp.asarray(rep)))
         np.testing.assert_allclose(rep_f, rep_np, atol=2e-4)
