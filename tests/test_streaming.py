@@ -1,0 +1,89 @@
+"""Out-of-core streaming resolution (parallel/streaming.py): two passes
+over host panels must reproduce the in-memory light pipeline."""
+
+import numpy as np
+import pytest
+
+from conftest import collusion_reports
+from pyconsensus_tpu.models.pipeline import (ConsensusParams,
+                                             _consensus_core_light)
+from pyconsensus_tpu.parallel import streaming_consensus
+
+
+def reference_light(reports, bounds=None):
+    import jax.numpy as jnp
+
+    from pyconsensus_tpu.oracle import parse_event_bounds
+    R, E = reports.shape
+    scaled, mins, maxs = parse_event_bounds(bounds, E)
+    p = ConsensusParams(algorithm="sztorc", max_iterations=1,
+                        pca_method="eigh-gram",
+                        any_scaled=bool(scaled.any()), has_na=True)
+    out = _consensus_core_light(jnp.asarray(reports),
+                                jnp.full((R,), 1.0 / R),
+                                jnp.asarray(scaled), jnp.asarray(mins),
+                                jnp.asarray(maxs), p)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+class TestStreamingParity:
+    @pytest.mark.parametrize("panel_events", [4, 7, 64])
+    def test_matches_in_memory(self, rng, panel_events):
+        """Panel width must not matter — including ragged last panels and
+        panels wider than E."""
+        reports, _ = collusion_reports(rng, R=18, E=23, liars=5,
+                                       na_frac=0.1)
+        ref = reference_light(reports)
+        out = streaming_consensus(reports, panel_events=panel_events)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      ref["outcomes_adjusted"])
+        np.testing.assert_allclose(out["smooth_rep"], ref["smooth_rep"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(out["certainty"], ref["certainty"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(out["participation_rows"],
+                                   ref["participation_rows"], atol=1e-9)
+        np.testing.assert_allclose(out["participation_columns"],
+                                   ref["participation_columns"], atol=1e-9)
+        np.testing.assert_allclose(out["reporter_bonus"],
+                                   ref["reporter_bonus"], atol=1e-9)
+        np.testing.assert_array_equal(out["na_row"], ref["na_row"])
+        np.testing.assert_allclose(
+            np.abs(out["first_loading"]), np.abs(ref["first_loading"]),
+            atol=1e-8)
+
+    def test_scaled_events(self, rng):
+        reports, _ = collusion_reports(rng, R=12, E=10, liars=3)
+        reports[:, 8:] = rng.uniform(0.0, 50.0, size=(12, 2))
+        bounds = [None] * 8 + [{"scaled": True, "min": 0.0,
+                                "max": 50.0}] * 2
+        ref = reference_light(reports, bounds)
+        out = streaming_consensus(reports, event_bounds=bounds,
+                                  panel_events=3)
+        np.testing.assert_allclose(out["outcomes_final"],
+                                   ref["outcomes_final"], atol=1e-9)
+        np.testing.assert_allclose(out["smooth_rep"], ref["smooth_rep"],
+                                   atol=1e-9)
+
+    def test_from_npy_path(self, rng, tmp_path):
+        from pyconsensus_tpu.io import save_reports
+        reports, truth = collusion_reports(rng, R=16, E=12, liars=4)
+        path = save_reports(tmp_path / "big.npy", reports)
+        out = streaming_consensus(path, panel_events=5)
+        ref = reference_light(reports)
+        np.testing.assert_array_equal(out["outcomes_final"],
+                                      ref["outcomes_final"])
+        # truth-or-ambiguous, never captured
+        final = out["outcomes_final"]
+        assert not np.any(final == 1.0 - truth)
+
+    def test_rejects_unsupported(self, rng):
+        reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
+        with pytest.raises(ValueError, match="sztorc"):
+            streaming_consensus(reports,
+                                params=ConsensusParams(algorithm="k-means"))
+        with pytest.raises(ValueError, match="max_iterations"):
+            streaming_consensus(
+                reports, params=ConsensusParams(max_iterations=3))
+        with pytest.raises(ValueError, match="panel_events"):
+            streaming_consensus(reports, panel_events=0)
