@@ -258,20 +258,30 @@ def _first_pc_power(reports_filled, mu, denom, reputation,
 
 def resolve_pca_method(R: int, E: int, method: str) -> str:
     """Resolve ``"auto"`` by static shape (E<=1024 explicit cov eigh, else
-    R<=4096 Gram eigh, else power iteration — Pallas-fused on TPU), and
-    downgrade an explicit ``"power-fused"`` request off-TPU beyond toy sizes
-    (the Pallas *interpreter* would be pathological; the XLA matvec path
-    computes the same loading)."""
+    R<=4096 Gram eigh, else power iteration — Pallas-fused on TPU when the
+    E-wide kernel fits scoped VMEM), and downgrade a ``"power-fused"``
+    request that cannot run: off-TPU beyond toy sizes (the Pallas
+    *interpreter* would be pathological) or past the VMEM budget (the
+    compile fails outright) — the XLA matvec path computes the same
+    loading."""
+    from .pallas_kernels import fused_pca_fits
+
+    # conservative f32 itemsize: the matrix may be f32 even when a bf16
+    # matvec dtype is configured
+    fits = fused_pca_fits(E, 4)
     if method == "auto":
         if E <= 1024:
             return "eigh-cov"
         if R <= 4096:
             return "eigh-gram"
-        if jax.default_backend() == "tpu":
+        if jax.default_backend() == "tpu" and fits:
             return "power-fused"
         return "power"
-    if method == "power-fused" and jax.default_backend() != "tpu" and R * E > (1 << 20):
-        return "power"
+    if method == "power-fused":
+        if jax.default_backend() != "tpu" and R * E > (1 << 20):
+            return "power"
+        if not fits:
+            return "power"
     return method
 
 

@@ -53,6 +53,42 @@ def _panel_rows(n_events: int, itemsize: int,
     return max(8, (rows // 8) * 8)
 
 
+#: scoped-VMEM budget the fit models target (the hardware limit is 16 MB;
+#: leave headroom for Mosaic's own stack)
+_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def fused_pca_fits(n_events: int, itemsize: int) -> bool:
+    """Whether the E-wide row-panel kernels (apply_weighted_cov,
+    scores_dirfix_pass) fit scoped VMEM at the minimum 8-row panel:
+    double-buffered block + f32 upcast/temps + the (E,) f32 vectors.
+    Measured failure: E=200k f32 blows the 16 MB limit by ~2 MB."""
+    est = 8 * n_events * itemsize * 2 + 8 * n_events * 4 + 4 * n_events * 4
+    return est <= _VMEM_BUDGET
+
+
+def _resolve_block_cols(n_reporters: int, itemsize: int):
+    """Largest column-block width the fused resolution kernel can hold in
+    scoped VMEM for this R (double-buffered (R, C) block + (R, 1) f32
+    outputs + chunk-loop temps); None when even the narrowest legal block
+    does not fit. Pallas TPU lowering requires the block width be a
+    multiple of 128 (or the whole array), so 128 is the floor."""
+    chunk = min(_pick_chunk(n_reporters) or 8, 1024)
+    for C in (256, 128):
+        est = (n_reporters * C * itemsize * 2 + n_reporters * 4 * 4
+               + 6 * chunk * C * 4 + 8 * C * 4)
+        if est <= _VMEM_BUDGET:
+            return C
+    return None
+
+
+def resolve_kernel_fits(n_reporters: int, itemsize: int) -> bool:
+    """Whether resolve_certainty_fused has a workable column-block width.
+    Measured failure: R=20k f32 at C=128 blows the 16 MB limit by ~3.5 MB
+    (C=64 fits)."""
+    return _resolve_block_cols(n_reporters, itemsize) is not None
+
+
 def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *, nan_fill):
     """One row panel: both contractions off a single HBM read of the panel.
 
@@ -341,7 +377,7 @@ def _pick_chunk(R: int, cap: int = 1024):
 @functools.partial(jax.jit,
                    static_argnames=("tolerance", "block_cols", "interpret"))
 def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
-                            block_cols: int = 128, interpret: bool = False):
+                            block_cols: int = 0, interpret: bool = False):
     """Outcome resolution + certainty/participation accounting in ONE HBM
     sweep (binary events; jax_kernels.resolve_outcomes +
     certainty_and_bonuses semantics on NaN-threaded storage).
@@ -365,6 +401,14 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
         raise ValueError(f"R={R} has no 8-multiple divisor <= 1024; use the "
                          "XLA resolution path")
     n_chunks = R // chunk
+    if not block_cols:          # 0 = auto: widest block that fits VMEM
+        if interpret:
+            block_cols = 128    # the interpreter has no VMEM limit
+        else:
+            block_cols = _resolve_block_cols(R, x.dtype.itemsize)
+            if block_cols is None:
+                raise ValueError(f"R={R} does not fit the fused resolution "
+                                 "kernel's VMEM budget; use the XLA path")
     C = min(block_cols, E)
     n_blocks = pl.cdiv(E, C)
     fv = jnp.concatenate([

@@ -66,23 +66,34 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
 
 
 def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
-                          n_devices: int) -> bool:
+                          n_events: int, n_devices: int) -> bool:
     """Gate for the NaN-threaded Pallas fast path
     (``ConsensusParams.fused_resolution``): single real TPU (a Pallas call
     is a black box to the GSPMD partitioner, so the multi-chip mesh stays
     on XLA), binary events, the sztorc algorithm scored by power iteration
     (``params.pca_method`` must already be resolved — an explicit or
     auto-picked exact eigh must NOT be silently swapped for power
-    iteration), and a reporter count the fused resolution kernel's
-    row-chunk loop can tile."""
-    from ..ops.pallas_kernels import _pick_chunk
+    iteration), a reporter count the fused resolution kernel's row-chunk
+    loop can tile, and a shape that fits the kernels' scoped-VMEM budget
+    (out-of-budget shapes take the XLA path — correct, just fewer fused
+    passes)."""
+    from ..ops.pallas_kernels import (_pick_chunk, fused_pca_fits,
+                                      resolve_kernel_fits)
 
+    # actual matrix itemsize: the storage dtype if set, else the default
+    # compute dtype (8 under jax_enable_x64 — modeling that as 4 would
+    # approve shapes the kernels then reject)
+    itemsize = (jax.numpy.dtype(params.storage_dtype).itemsize
+                if params.storage_dtype
+                else jax.numpy.asarray(0.0).dtype.itemsize)
     return (n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
             and params.pca_method in ("power", "power-fused")
             and not params.any_scaled
-            and _pick_chunk(n_reporters) is not None)
+            and _pick_chunk(n_reporters) is not None
+            and fused_pca_fits(n_events, itemsize)
+            and resolve_kernel_fits(n_reporters, itemsize))
 
 
 @functools.lru_cache(maxsize=16)
@@ -162,7 +173,7 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         has_na=bool(np.isnan(reports).any()) if is_host else p.has_na,
     )
     p = p._replace(fused_resolution=_use_fused_resolution(
-        p, R, mesh.devices.size))
+        p, R, E, mesh.devices.size))
     if reputation is None:
         reputation = _default_reputation_placed(mesh, R)   # cached, on device
         if event_bounds is None:
@@ -198,7 +209,8 @@ class ShardedOracle(Oracle):
                                         self.mesh.devices.size))
         self.params = self.params._replace(
             fused_resolution=_use_fused_resolution(
-                self.params, self.reports.shape[0], self.mesh.devices.size))
+                self.params, self.reports.shape[0], self.reports.shape[1],
+                self.mesh.devices.size))
 
     def resolve_raw(self):
         placed = _place_inputs(self.mesh, self.reports, self.reputation,

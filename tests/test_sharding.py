@@ -129,19 +129,39 @@ class TestFusedResolution:
         p = ConsensusParams(algorithm="sztorc", any_scaled=False,
                             pca_method="power-fused")   # as resolved
         # CPU test platform: never on, regardless of other conditions
-        assert not _use_fused_resolution(p, 10_000, 1)
+        assert not _use_fused_resolution(p, 10_000, 100_000, 1)
         # and the non-sztorc / exact-PCA / scaled / multi-device /
         # untileable-R gates
         assert not _use_fused_resolution(
-            p._replace(algorithm="k-means"), 10_000, 1)
+            p._replace(algorithm="k-means"), 10_000, 100_000, 1)
         # an explicitly requested (or auto-picked, R<=4096) exact eigh must
         # never be silently swapped for power iteration by the fused path
         assert not _use_fused_resolution(
-            p._replace(pca_method="eigh-gram"), 10_000, 1)
+            p._replace(pca_method="eigh-gram"), 10_000, 100_000, 1)
         assert not _use_fused_resolution(
-            p._replace(any_scaled=True), 10_000, 1)
-        assert not _use_fused_resolution(p, 10_000, 8)
-        assert not _use_fused_resolution(p, 10_007, 1)   # prime-ish R
+            p._replace(any_scaled=True), 10_000, 100_000, 1)
+        assert not _use_fused_resolution(p, 10_000, 100_000, 8)
+        assert not _use_fused_resolution(p, 10_007, 100_000, 1)  # prime R
+
+    def test_vmem_fit_models(self):
+        """The scoped-VMEM fit models encode the measured compile failures:
+        E=200k f32 and R=20k f32-at-C=128 blow the 16 MB limit; the bench
+        shape fits in both dtypes; bigger shapes keep a narrower column
+        block or fall back to XLA."""
+        from pyconsensus_tpu.ops.pallas_kernels import (_resolve_block_cols,
+                                                        fused_pca_fits,
+                                                        resolve_kernel_fits)
+        assert fused_pca_fits(100_000, 4) and fused_pca_fits(100_000, 2)
+        assert not fused_pca_fits(200_000, 4)     # measured OOM
+        assert fused_pca_fits(150_000, 2)
+        assert resolve_kernel_fits(10_000, 4)
+        assert _resolve_block_cols(10_000, 2) == 128
+        # R=20k f32: C=128 measured OOM, and narrower blocks are illegal
+        # (Pallas requires width % 128 == 0) -> XLA fallback
+        assert _resolve_block_cols(20_000, 4) is None
+        assert not resolve_kernel_fits(20_000, 4)
+        # bf16 at R=20k still fits at C=128
+        assert _resolve_block_cols(20_000, 2) == 128
 
     def test_chunk_picker(self):
         from pyconsensus_tpu.ops.pallas_kernels import _pick_chunk
