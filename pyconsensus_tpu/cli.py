@@ -146,6 +146,28 @@ def _run_simulation(args) -> None:
         print(f"sweep report written to {args.plot}")
 
 
+def _run_streaming(args) -> None:
+    from .models.pipeline import ConsensusParams
+    from .parallel import streaming_consensus
+
+    print(f"=== Streaming resolution of {args.file} "
+          f"({args.panel_events} events/panel, two passes) ===")
+    out = streaming_consensus(
+        args.file, panel_events=args.panel_events,
+        params=ConsensusParams(algorithm="sztorc", max_iterations=1))
+    rep = out["smooth_rep"]
+    _print_table("Reporters (top 8 by reputation)",
+                 ["reporter", "smooth_rep", "reporter_bonus"],
+                 [(int(i), float(rep[i]), float(out["reporter_bonus"][i]))
+                  for i in np.argsort(rep)[::-1][:8]])
+    outcomes = out["outcomes_final"]
+    counts = {v: int((outcomes == v).sum()) for v in (0.0, 0.5, 1.0)}
+    print(f"\n  events: {len(outcomes)}   outcomes 0/0.5/1: "
+          f"{counts[0.0]}/{counts[0.5]}/{counts[1.0]}"
+          f"   avg certainty: {out['avg_certainty']:.6f}"
+          f"   participation: {1.0 - out['percent_na']:.6f}\n")
+
+
 def main(argv: Optional[Sequence[str]] = None,
          prog: str = "pyconsensus_tpu") -> int:
     ap = argparse.ArgumentParser(
@@ -167,10 +189,17 @@ def main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("-f", "--file", metavar="PATH",
                     help="resolve a reports matrix loaded from PATH "
                          "(.npy or .csv; NA/NaN = missing report)")
+    ap.add_argument("--stream", action="store_true",
+                    help="with --file: resolve out-of-core (two streaming "
+                         "passes over event panels; for matrices larger "
+                         "than device memory; .npy is memory-mapped)")
+    ap.add_argument("--panel-events", type=int, default=8192,
+                    help="with --stream: events per streamed panel")
     ap.add_argument("--algorithm", default="sztorc", choices=ALGORITHMS)
     ap.add_argument("--backend", default="jax", choices=BACKENDS)
-    ap.add_argument("--iterations", type=int, default=5,
-                    help="max reputation-redistribution iterations")
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="max reputation-redistribution iterations "
+                         "(default 5; --stream supports only 1)")
     ap.add_argument("--trials", type=int, default=100,
                     help="simulation trials per grid cell")
     ap.add_argument("--rounds", type=int, default=1,
@@ -183,7 +212,8 @@ def main(argv: Optional[Sequence[str]] = None,
     args = ap.parse_args(argv)
 
     for name in ("iterations", "trials", "reporters", "events", "rounds"):
-        if getattr(args, name) < 1:
+        value = getattr(args, name)
+        if value is not None and value < 1:
             ap.error(f"--{name} must be >= 1")
     if args.simulate and args.algorithm not in JIT_ALGORITHMS:
         ap.error(f"--simulate requires a jit-compatible algorithm "
@@ -194,14 +224,34 @@ def main(argv: Optional[Sequence[str]] = None,
             or args.file):
         args.example = True  # default demo, like the reference CLI
 
+    if args.stream and not args.file:
+        ap.error("--stream requires --file")
+    if args.panel_events < 1:
+        ap.error("--panel-events must be >= 1")
+    # reject EXPLICIT options --stream cannot honor (rather than silently
+    # overriding them); an unset --iterations defaults per mode below
+    if args.stream and (args.algorithm != "sztorc"
+                        or (args.iterations is not None
+                            and args.iterations != 1)):
+        ap.error("--stream resolves out-of-core with algorithm=sztorc and "
+                 "a single iteration (see streaming_consensus); drop the "
+                 "conflicting --algorithm/--iterations flags or --stream")
+    if args.iterations is None:
+        args.iterations = 1 if args.stream else 5
     if args.file:
-        from .io import load_reports
+        if args.stream:
+            try:
+                _run_streaming(args)
+            except (OSError, ValueError) as exc:
+                ap.error(f"--stream: {exc}")
+        else:
+            from .io import load_reports
 
-        try:
-            file_reports = load_reports(args.file)
-        except (OSError, ValueError) as exc:
-            ap.error(f"--file: {exc}")
-        _run_demo(f"Reports from {args.file}", file_reports, None, args)
+            try:
+                file_reports = load_reports(args.file)
+            except (OSError, ValueError) as exc:
+                ap.error(f"--file: {exc}")
+            _run_demo(f"Reports from {args.file}", file_reports, None, args)
     if args.example:
         _run_demo("Example (dense binary)", EXAMPLE_REPORTS, None, args)
     if args.missing:

@@ -51,6 +51,33 @@ class TestCli:
         with pytest.raises(SystemExit):
             main(["--simulate", "--rounds", "0"])
 
+    def test_stream_file(self, capsys, tmp_path, rng):
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=16, E=20, liars=4,
+                                       na_frac=0.1)
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        assert main(["--file", path, "--stream",
+                     "--panel-events", "6"]) == 0
+        out = capsys.readouterr().out
+        assert "Streaming resolution" in out
+        assert "outcomes 0/0.5/1" in out
+
+    def test_stream_requires_file(self):
+        with pytest.raises(SystemExit):
+            main(["--stream"])
+
+    def test_stream_rejects_incompatible_flags(self, tmp_path):
+        with pytest.raises(SystemExit):
+            main(["--file", "x.npy", "--stream", "--algorithm", "k-means"])
+        with pytest.raises(SystemExit):
+            main(["--file", "x.npy", "--stream", "--iterations", "5"])
+
+    def test_stream_bad_path_clean_error(self, capsys):
+        with pytest.raises(SystemExit):
+            main(["--file", "/nonexistent/x.npy", "--stream"])
+        assert "--stream" in capsys.readouterr().err
+
     def test_bad_flag_exits_nonzero(self):
         with pytest.raises(SystemExit):
             main(["--algorithm", "nope"])
