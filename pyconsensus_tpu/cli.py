@@ -151,10 +151,12 @@ def _run_streaming(args) -> None:
     from .parallel import streaming_consensus
 
     print(f"=== Streaming resolution of {args.file} "
-          f"({args.panel_events} events/panel, two passes) ===")
+          f"({args.panel_events} events/panel, "
+          f"{args.iterations} iteration(s)) ===")
     out = streaming_consensus(
         args.file, panel_events=args.panel_events,
-        params=ConsensusParams(algorithm="sztorc", max_iterations=1))
+        params=ConsensusParams(algorithm="sztorc",
+                               max_iterations=args.iterations))
     rep = out["smooth_rep"]
     _print_table("Reporters (top 8 by reputation)",
                  ["reporter", "smooth_rep", "reporter_bonus"],
@@ -199,7 +201,8 @@ def main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("--backend", default="jax", choices=BACKENDS)
     ap.add_argument("--iterations", type=int, default=None,
                     help="max reputation-redistribution iterations "
-                         "(default 5; --stream supports only 1)")
+                         "(default 5; with --stream default 1 — each "
+                         "iteration is one full pass over the file)")
     ap.add_argument("--trials", type=int, default=100,
                     help="simulation trials per grid cell")
     ap.add_argument("--rounds", type=int, default=1,
@@ -230,13 +233,13 @@ def main(argv: Optional[Sequence[str]] = None,
         ap.error("--panel-events must be >= 1")
     # reject EXPLICIT options --stream cannot honor (rather than silently
     # overriding them); an unset --iterations defaults per mode below
-    if args.stream and (args.algorithm != "sztorc"
-                        or (args.iterations is not None
-                            and args.iterations != 1)):
-        ap.error("--stream resolves out-of-core with algorithm=sztorc and "
-                 "a single iteration (see streaming_consensus); drop the "
-                 "conflicting --algorithm/--iterations flags or --stream")
+    if args.stream and args.algorithm != "sztorc":
+        ap.error("--stream resolves out-of-core with algorithm=sztorc "
+                 "(see streaming_consensus); drop the conflicting "
+                 "--algorithm flag or --stream")
     if args.iterations is None:
+        # streaming pays one full pass over the file per iteration — default
+        # to the cheap single-iteration resolution there
         args.iterations = 1 if args.stream else 5
     if args.file:
         if args.stream:

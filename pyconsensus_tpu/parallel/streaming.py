@@ -2,8 +2,9 @@
 
 The reference (and the in-memory paths here) hold the full (R, E) matrix
 resident. This module streams the event axis from host (numpy array,
-``np.memmap``, or an ``.npy`` path) in panels and resolves in exactly TWO
-passes, because everything the PCA scoring step needs collapses into R x R
+``np.memmap``, or an ``.npy`` path) in panels and resolves in
+``iterations + 1`` passes (two for the common single-iteration case),
+because everything the PCA scoring step needs collapses into R x R
 accumulators (R = reporters, the small axis):
 
 pass 1 (per event panel ``F_p`` = filled panel, ``D_p`` centered,
@@ -27,9 +28,11 @@ certainty, and NA participation — all column-local given the reputation —
 with the per-row ``na @ certainty`` partials accumulated panel by panel.
 
 Host memory holds only E-vectors (fill, certainty, outcomes, ...); device
-memory holds one panel plus three R x R accumulators. Restrictions:
-``algorithm="sztorc"``, ``max_iterations=1`` (iterating would need one
-extra pass per iteration — the accumulators depend on the reputation).
+memory holds one panel plus three R x R accumulators. Restriction:
+``algorithm="sztorc"``. Iterative redistribution (``max_iterations > 1``)
+costs one accumulation pass per executed iteration, because G and M
+follow the iterating reputation; S and the interpolate fill are pinned to
+the initial reputation (reference semantics) and computed once.
 
 Throughput is bound by the host->device link (every byte crosses twice):
 on directly-attached hardware that is PCIe/DMA at tens of GB/s; through
@@ -55,35 +58,45 @@ from ..oracle import parse_event_bounds
 __all__ = ["streaming_consensus"]
 
 
-@functools.partial(jax.jit, static_argnames=("tolerance",))
-def _pass1_panel(panel, rep, scaled, mins, maxs, valid, tolerance: float):
-    """One event panel -> (G, M, S) contributions + column stats.
+@functools.partial(jax.jit, static_argnames=("tolerance", "with_s"))
+def _pass1_panel(panel, fill_rep, weight_rep, scaled, mins, maxs, valid,
+                 tolerance: float, with_s: bool):
+    """One event panel -> (G, M[, S]) contributions.
+
+    ``fill_rep`` is the INITIAL reputation (interpolate fills are computed
+    once, reference semantics); ``weight_rep`` is the current iteration's
+    reputation (weighted means and the Gram weighting follow it).
+    ``S = F F^T`` depends only on the filled matrix, which is fixed across
+    iterations — ``with_s`` skips it after the first accumulation pass.
     ``valid`` masks the zero-padded tail of the last panel out of every
     cross-panel accumulator."""
-    acc = rep.dtype
+    acc = weight_rep.dtype
     rescaled = jk.rescale(panel, scaled, mins, maxs)
-    filled, present = jk.interpolate_masked(rescaled, rep, scaled, tolerance)
+    filled, present = jk.interpolate_masked(rescaled, fill_rep, scaled,
+                                            tolerance)
     F = jnp.where(valid[None, :], filled, 0.0)
-    mu = rep @ F                                    # (P,), zero on padding
+    mu = weight_rep @ F                             # (P,), zero on padding
     D = jnp.where(valid[None, :], F - mu[None, :], 0.0)
-    A = D * jnp.sqrt(jnp.clip(rep, 0.0, None))[:, None]
+    A = D * jnp.sqrt(jnp.clip(weight_rep, 0.0, None))[:, None]
     G = jnp.matmul(A, A.T, preferred_element_type=acc)
     M = jnp.matmul(D, A.T, preferred_element_type=acc)
-    S = jnp.matmul(F, F.T, preferred_element_type=acc)
-    return G, M, S
+    if with_s:
+        S = jnp.matmul(F, F.T, preferred_element_type=acc)
+        return G, M, S
+    return G, M, jnp.zeros_like(G)
 
 
 @functools.partial(jax.jit, static_argnames=("tolerance",))
-def _pass2_panel(panel, old_rep, final_rep, u_over_nAu, scaled, mins, maxs,
-                 tolerance: float):
+def _pass2_panel(panel, fill_rep, score_rep, final_rep, u_over_nAu, scaled,
+                 mins, maxs, tolerance: float):
     """Per-panel resolution with the final reputation: outcomes, certainty,
     participation columns, per-row NA partials, and this panel's slice of
-    the first loading (``A^T u / ||A^T u||``, scoring-time reputation). The
-    fill is recomputed with the INITIAL reputation (interpolate
-    semantics)."""
+    the first loading (``A^T u / ||A^T u||`` with ``score_rep``, the
+    reputation of the last executed scoring iteration). The fill is
+    recomputed with the INITIAL reputation (interpolate semantics)."""
     acc = final_rep.dtype
     rescaled = jk.rescale(panel, scaled, mins, maxs)
-    filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
+    filled, present = jk.interpolate_masked(rescaled, fill_rep, scaled,
                                             tolerance)
     raw, adjusted = jk.resolve_outcomes(present, filled, final_rep, scaled,
                                         tolerance)
@@ -97,9 +110,9 @@ def _pass2_panel(panel, old_rep, final_rep, u_over_nAu, scaled, mins, maxs,
     pcol = final_rep @ na                            # rep mass on NA
     prow = na @ certainty                            # per-row partials
     na_count = jnp.sum(na, axis=1)
-    mu = old_rep @ filled
+    mu = score_rep @ filled
     A = (filled - mu[None, :]) * jnp.sqrt(
-        jnp.clip(old_rep, 0.0, None))[:, None]
+        jnp.clip(score_rep, 0.0, None))[:, None]
     loading = A.T @ u_over_nAu
     return raw, adjusted, final, certainty, pcol, prow, na_count, loading
 
@@ -111,8 +124,8 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
 
     ``reports_src``: numpy array / ``np.memmap`` / path to an ``.npy``
     file (loaded memory-mapped). Returns the light result dict as host
-    numpy arrays. See the module docstring for the two-pass algorithm and
-    restrictions.
+    numpy arrays. See the module docstring for the pass structure
+    (``executed iterations + 1``) and restrictions.
     """
     if isinstance(reports_src, (str, bytes)) or hasattr(reports_src,
                                                         "__fspath__"):
@@ -123,11 +136,8 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
         raise ValueError(f"reports must be 2-D, got {reports_src.shape}")
     R, E = reports_src.shape
     p = params if params is not None else ConsensusParams()
-    if p.algorithm != "sztorc" or p.max_iterations > 1:
-        raise ValueError("streaming_consensus supports algorithm='sztorc' "
-                         "with max_iterations=1 (the R x R accumulators "
-                         "depend on the reputation, so iterating would "
-                         "need one extra pass per iteration)")
+    if p.algorithm != "sztorc":
+        raise ValueError("streaming_consensus supports algorithm='sztorc'")
     P = int(panel_events)
     if P < 1:
         raise ValueError("panel_events must be >= 1")
@@ -137,13 +147,8 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     if reputation is None:
         reputation = np.full((R,), 1.0 / R)
     old_rep = nk.normalize(np.asarray(reputation, dtype=float))
-    rep_dev = jnp.asarray(old_rep, dtype=dtype)
+    fill_rep = jnp.asarray(old_rep, dtype=dtype)
     tol = float(p.catch_tolerance)
-
-    # ---- pass 1: accumulate the R x R sufficient statistics -------------
-    G = jnp.zeros((R, R), dtype=dtype)
-    M = jnp.zeros((R, R), dtype=dtype)
-    S = jnp.zeros((R, R), dtype=dtype)
 
     def panels():
         for start in range(0, E, P):
@@ -165,32 +170,59 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
                    jnp.asarray(sc), jnp.asarray(mn, dtype=dtype),
                    jnp.asarray(mx, dtype=dtype), jnp.asarray(valid))
 
-    for _, _, block, sc, mn, mx, valid in panels():
-        dG, dM, dS = _pass1_panel(block, rep_dev, sc, mn, mx, valid, tol)
-        G, M, S = G + dG, M + dM, S + dS
+    # ---- scoring iterations: one accumulation pass per iteration --------
+    # (the G/M statistics follow the iterating reputation; S = F F^T is
+    # fixed because the interpolate fill is pinned to the initial
+    # reputation — reference semantics)
+    rep_k = fill_rep
+    this_rep = fill_rep
+    S = None
+    converged = False
+    iterations = 0
+    score_rep = fill_rep
+    u_over_nAu = jnp.zeros((R,), dtype=dtype)
+    for _ in range(max(p.max_iterations, 1)):
+        G = jnp.zeros((R, R), dtype=dtype)
+        M = jnp.zeros((R, R), dtype=dtype)
+        with_s = S is None
+        S_acc = jnp.zeros((R, R), dtype=dtype) if with_s else None
+        for _, _, block, sc, mn, mx, valid in panels():
+            dG, dM, dS = _pass1_panel(block, fill_rep, rep_k, sc, mn, mx,
+                                      valid, tol, with_s)
+            G, M = G + dG, M + dM
+            if with_s:
+                S_acc = S_acc + dS
+        if with_s:
+            S = S_acc
 
-    # ---- PCA + direction fix + redistribution, all O(R^2) ---------------
-    denom = 1.0 - jnp.sum(rep_dev ** 2)
-    denom = jnp.where(denom == 0.0, 1.0, denom)
-    _, eigvecs = jnp.linalg.eigh(G / denom)
-    u = eigvecs[:, -1]
-    nAu = jnp.sqrt(jnp.clip(u @ G @ u, 0.0, None))
-    scores = (M @ u) / jnp.where(nAu == 0.0, 1.0, nAu)
+        denom = 1.0 - jnp.sum(rep_k ** 2)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        _, eigvecs = jnp.linalg.eigh(G / denom)
+        u = eigvecs[:, -1]
+        nAu = jnp.sqrt(jnp.clip(u @ G @ u, 0.0, None))
+        u_over_nAu = u / jnp.where(nAu == 0.0, 1.0, nAu)
+        scores = M @ u_over_nAu
 
-    set1 = scores + jnp.abs(jnp.min(scores))
-    set2 = scores - jnp.max(scores)
+        set1 = scores + jnp.abs(jnp.min(scores))
+        set2 = scores - jnp.max(scores)
 
-    def sq_dist_to_old(w):
-        d = w - rep_dev
-        return d @ S @ d
+        def sq_dist_to_old(w, rep_ref=rep_k):
+            d = w - rep_ref
+            return d @ S @ d
 
-    ref_ind = (sq_dist_to_old(jk.normalize(set1))
-               - sq_dist_to_old(jk.normalize(set2)))
-    adj = jnp.where(ref_ind <= 0.0, set1, -set2)
-    this_rep = jk.row_reward_weighted(adj, rep_dev)
-    smooth_rep = jk.smooth(this_rep, rep_dev, p.alpha)
-    converged = bool(jnp.max(jnp.abs(smooth_rep - rep_dev))
-                     <= p.convergence_tolerance)
+        ref_ind = (sq_dist_to_old(jk.normalize(set1))
+                   - sq_dist_to_old(jk.normalize(set2)))
+        adj = jnp.where(ref_ind <= 0.0, set1, -set2)
+        this_rep = jk.row_reward_weighted(adj, rep_k)
+        new_rep = jk.smooth(this_rep, rep_k, p.alpha)
+        delta = float(jnp.max(jnp.abs(new_rep - rep_k)))
+        score_rep = rep_k
+        rep_k = new_rep
+        iterations += 1
+        if delta <= p.convergence_tolerance:
+            converged = True
+            break
+    smooth_rep = rep_k
 
     # ---- pass 2: per-panel resolution with the final reputation ---------
     outcomes_raw = np.empty(E)
@@ -201,10 +233,10 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     first_loading = np.empty(E)
     prow = np.zeros(R)
     na_count = np.zeros(R)
-    u_over_nAu = u / jnp.where(nAu == 0.0, 1.0, nAu)
     for start, stop, block, sc, mn, mx, _ in panels():
         raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
-            block, rep_dev, smooth_rep, u_over_nAu, sc, mn, mx, tol)
+            block, fill_rep, score_rep, smooth_rep, u_over_nAu, sc, mn, mx,
+            tol)
         width = stop - start
         outcomes_raw[start:stop] = np.asarray(raw)[:width]
         outcomes_adjusted[start:stop] = np.asarray(adjd)[:width]
@@ -237,7 +269,7 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
         "outcomes_raw": outcomes_raw,
         "outcomes_adjusted": outcomes_adjusted,
         "outcomes_final": outcomes_final,
-        "iterations": 1,
+        "iterations": iterations,
         "convergence": converged,
         "first_loading": first_loading,
         "certainty": certainty,

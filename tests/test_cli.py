@@ -70,8 +70,15 @@ class TestCli:
     def test_stream_rejects_incompatible_flags(self, tmp_path):
         with pytest.raises(SystemExit):
             main(["--file", "x.npy", "--stream", "--algorithm", "k-means"])
-        with pytest.raises(SystemExit):
-            main(["--file", "x.npy", "--stream", "--iterations", "5"])
+
+    def test_stream_iterations(self, capsys, tmp_path, rng):
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=12, E=10, liars=3)
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        assert main(["--file", path, "--stream", "--iterations", "3",
+                     "--panel-events", "4"]) == 0
+        assert "3 iteration(s)" in capsys.readouterr().out
 
     def test_stream_bad_path_clean_error(self, capsys):
         with pytest.raises(SystemExit):

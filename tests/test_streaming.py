@@ -82,8 +82,30 @@ class TestStreamingParity:
         with pytest.raises(ValueError, match="sztorc"):
             streaming_consensus(reports,
                                 params=ConsensusParams(algorithm="k-means"))
-        with pytest.raises(ValueError, match="max_iterations"):
-            streaming_consensus(
-                reports, params=ConsensusParams(max_iterations=3))
         with pytest.raises(ValueError, match="panel_events"):
             streaming_consensus(reports, panel_events=0)
+
+    @pytest.mark.parametrize("max_iterations", [3, 25])
+    def test_multi_iteration_matches_in_memory(self, rng, max_iterations):
+        """Iterative redistribution: one accumulation pass per executed
+        iteration must reproduce the in-memory scan (same outcomes,
+        reputation, iteration count, convergence flag)."""
+        import jax.numpy as jnp
+        reports, _ = collusion_reports(rng, R=20, E=17, liars=5,
+                                       na_frac=0.08)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm="sztorc",
+                            max_iterations=max_iterations,
+                            convergence_tolerance=1e-3,
+                            pca_method="eigh-gram", any_scaled=False,
+                            has_na=True)
+        ref = _consensus_core_light(
+            jnp.asarray(reports), jnp.full((R,), 1.0 / R),
+            jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E), p)
+        out = streaming_consensus(reports, panel_events=5, params=p)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]), atol=1e-9)
+        assert out["iterations"] == int(ref["iterations"])
+        assert out["convergence"] == bool(ref["convergence"])
