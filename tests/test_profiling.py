@@ -1,0 +1,56 @@
+"""Observability helpers (SURVEY.md §5 tracing/profiling row):
+PhaseTimer accumulation/blocking semantics and the trace() no-op/active
+paths."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from pyconsensus_tpu.utils import PhaseTimer, trace
+
+
+class TestPhaseTimer:
+    def test_accumulates_and_counts(self):
+        timer = PhaseTimer()
+        for _ in range(3):
+            with timer.phase("work"):
+                time.sleep(0.01)
+        with timer.phase("other"):
+            pass
+        totals = timer.totals()
+        assert set(totals) == {"work", "other"}
+        assert totals["work"] >= 0.03
+        assert timer.means()["work"] == pytest.approx(totals["work"] / 3)
+
+    def test_observe_blocks_on_device_value(self):
+        timer = PhaseTimer()
+        with timer.phase("matmul"):
+            x = jnp.ones((64, 64))
+            timer.observe(x @ x)
+        assert timer.totals()["matmul"] > 0.0
+        assert timer._pending is None          # consumed by the phase exit
+
+    def test_report_sorted_by_total(self):
+        timer = PhaseTimer()
+        with timer.phase("slow"):
+            time.sleep(0.02)
+        with timer.phase("fast"):
+            pass
+        report = timer.report()
+        assert report.index("slow") < report.index("fast")
+        assert "call(s)" in report
+
+
+class TestTrace:
+    def test_noop_without_dir(self):
+        with trace(None):
+            x = jnp.ones(4).sum()
+        assert float(x) == 4.0
+
+    def test_writes_profile(self, tmp_path):
+        with trace(str(tmp_path)):
+            jnp.ones((16, 16)).sum().block_until_ready()
+        # jax.profiler.trace writes a plugins/profile tree
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "trace(log_dir) produced no profile output"
