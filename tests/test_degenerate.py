@@ -1,0 +1,56 @@
+"""Degenerate-shape and degenerate-content regression suite: the inputs
+where the mechanism's guards (zero-sum normalize, single-reporter
+covariance denominator, no-disagreement direction) do the work. Behavior
+pinned identically across both backends."""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle
+
+CASES = {
+    # (reports, expected outcomes_final)
+    "single_reporter": (np.array([[1.0, 0.0, 1.0]]), [1.0, 0.0, 1.0]),
+    "single_event": (np.array([[1.0], [1.0], [0.0]]), [1.0]),
+    "one_by_one": (np.array([[1.0]]), [1.0]),
+    "unanimous": (np.ones((5, 4)), [1.0] * 4),
+    "all_half": (np.full((4, 3), 0.5), [0.5] * 3),
+    "two_reporters_opposed": (np.array([[1.0, 0.0], [0.0, 1.0]]),
+                              [0.5, 0.5]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_degenerate_case(name, backend):
+    reports, expected = CASES[name]
+    r = Oracle(reports=reports, backend=backend,
+               max_iterations=2).consensus()
+    rep = np.asarray(r["agents"]["smooth_rep"], dtype=float)
+    assert np.isfinite(rep).all()
+    assert (rep >= -1e-12).all()
+    assert rep.sum() == pytest.approx(1.0)
+    np.testing.assert_array_equal(
+        np.asarray(r["events"]["outcomes_final"], dtype=float), expected)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_unanimous_keeps_reputation(backend):
+    """No disagreement direction -> row_reward_weighted's degenerate guard
+    returns the prior reputation unchanged (up to the smooth blend)."""
+    prior = np.array([0.5, 0.3, 0.2])
+    r = Oracle(reports=np.ones((3, 4)), reputation=prior,
+               backend=backend).consensus()
+    np.testing.assert_allclose(r["agents"]["smooth_rep"], prior, atol=1e-12)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_extreme_reputation_concentration(backend):
+    """One reporter holding ~all reputation dictates outcomes."""
+    reports = np.array([[1.0, 1.0, 0.0],
+                        [0.0, 0.0, 1.0],
+                        [0.0, 0.0, 1.0]])
+    rep = np.array([1e6, 1.0, 1.0])
+    r = Oracle(reports=reports, reputation=rep, backend=backend).consensus()
+    np.testing.assert_array_equal(r["events"]["outcomes_final"],
+                                  [1.0, 1.0, 0.0])
