@@ -4,9 +4,10 @@ The reference is stateless per call — "reputation carried across *rounds* by
 the caller" (SURVEY.md §5, checkpoint/resume row). This module is that
 caller, made first-class: a :class:`ReputationLedger` feeds each round's
 ``smooth_rep`` into the next resolution, records per-round metrics, and
-serializes its full state to a single ``.npz`` so a long-running oracle
-(e.g. a Truthcoin-style voting period sequence) can stop and resume
-anywhere.
+serializes its full state — to a single ``.npz`` file or an orbax
+checkpoint directory (``save(..., format="orbax")``) — so a long-running
+oracle (e.g. a Truthcoin-style voting period sequence) can stop and
+resume anywhere.
 
 >>> ledger = ReputationLedger(n_reporters=50)
 >>> result = ledger.resolve(reports_round_1)       # uniform prior
@@ -103,40 +104,69 @@ class ReputationLedger:
 
     # -- checkpoint / resume -------------------------------------------------
 
-    def save(self, path) -> None:
-        """Serialize full ledger state to ``path`` (.npz, single file; the
-        suffix is appended if missing, matching what np.savez writes so
-        ``load(path)`` round-trips either spelling)."""
+    def _state_tree(self) -> dict:
+        return {
+            "format_version": np.int64(_FORMAT_VERSION),
+            "reputation": self.reputation,
+            "round": np.int64(self.round),
+            "history": np.frombuffer(
+                json.dumps(self.history).encode(), dtype=np.uint8),
+            "oracle_kwargs": np.frombuffer(
+                json.dumps(self.oracle_kwargs,
+                           default=_json_scalar).encode(), dtype=np.uint8),
+        }
+
+    def save(self, path, format: str = "npz") -> None:
+        """Serialize full ledger state to ``path``.
+
+        ``format="npz"`` (default): a single ``.npz`` file (the suffix is
+        appended if missing, matching what np.savez writes so
+        ``load(path)`` round-trips either spelling). ``format="orbax"``:
+        an orbax checkpoint DIRECTORY (SURVEY.md §5's "orbax if sweeps get
+        huge" — atomic writes, async-friendly, the idiomatic choice when
+        the ledger lives next to other orbax-managed state).
+        """
+        if format == "orbax":
+            import orbax.checkpoint as ocp
+
+            # force=True: re-checkpointing to a fixed path every round is
+            # the module's core use case — match npz overwrite semantics
+            ocp.PyTreeCheckpointer().save(
+                pathlib.Path(path).resolve(), self._state_tree(), force=True)
+            return
+        if format != "npz":
+            raise ValueError(f"unknown checkpoint format {format!r}; "
+                             "choose 'npz' or 'orbax'")
         path = pathlib.Path(path)
         if path.suffix != ".npz":
             path = path.with_name(path.name + ".npz")
-        np.savez(
-            path,
-            format_version=np.int64(_FORMAT_VERSION),
-            reputation=self.reputation,
-            round=np.int64(self.round),
-            history=np.frombuffer(
-                json.dumps(self.history).encode(), dtype=np.uint8),
-            oracle_kwargs=np.frombuffer(
-                json.dumps(self.oracle_kwargs,
-                           default=_json_scalar).encode(), dtype=np.uint8),
-        )
+        np.savez(path, **self._state_tree())
+
+    @classmethod
+    def _from_state(cls, data) -> "ReputationLedger":
+        version = int(data["format_version"])
+        if version > _FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {version} is newer "
+                             f"than supported {_FORMAT_VERSION}")
+        rep = np.asarray(data["reputation"], dtype=np.float64)
+        kwargs = json.loads(bytes(data["oracle_kwargs"]).decode())
+        ledger = cls(n_reporters=rep.shape[0], reputation=rep, **kwargs)
+        ledger.reputation = rep          # verbatim — no re-normalization,
+        ledger.round = int(data["round"])  # resume is bit-exact
+        ledger.history = json.loads(bytes(data["history"]).decode())
+        return ledger
 
     @classmethod
     def load(cls, path) -> "ReputationLedger":
-        """Restore a ledger exactly as :meth:`save` left it."""
+        """Restore a ledger exactly as :meth:`save` left it. The format is
+        auto-detected: an orbax checkpoint is a directory, an npz a file."""
         path = pathlib.Path(path)
+        if path.is_dir():
+            import orbax.checkpoint as ocp
+
+            data = ocp.PyTreeCheckpointer().restore(path.resolve())
+            return cls._from_state(data)
         if not path.exists() and path.suffix != ".npz":
             path = path.with_name(path.name + ".npz")
         with np.load(path) as data:
-            version = int(data["format_version"])
-            if version > _FORMAT_VERSION:
-                raise ValueError(f"checkpoint format {version} is newer "
-                                 f"than supported {_FORMAT_VERSION}")
-            rep = np.asarray(data["reputation"], dtype=np.float64)
-            kwargs = json.loads(bytes(data["oracle_kwargs"]).decode())
-            ledger = cls(n_reporters=rep.shape[0], reputation=rep, **kwargs)
-            ledger.reputation = rep      # verbatim — no re-normalization,
-            ledger.round = int(data["round"])  # resume is bit-exact
-            ledger.history = json.loads(bytes(data["history"]).decode())
-        return ledger
+            return cls._from_state(data)

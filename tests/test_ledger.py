@@ -52,6 +52,31 @@ class TestLedger:
         b = resumed.resolve(nxt)["agents"]["smooth_rep"]
         np.testing.assert_array_equal(a, b)
 
+    def test_orbax_checkpoint_resume_bitwise(self, rng, tmp_path):
+        """format='orbax' writes a checkpoint DIRECTORY; load()
+        auto-detects it and resumes bit-exactly, like the npz path."""
+        pytest.importorskip("orbax.checkpoint")
+        ledger = ReputationLedger(n_reporters=10, max_iterations=2)
+        ledger.resolve(make_reports(rng))
+        path = tmp_path / "ck"
+        ledger.save(path, format="orbax")
+        assert path.is_dir()
+        ledger.save(path, format="orbax")   # re-checkpoint to a fixed path
+        resumed = ReputationLedger.load(path)
+        np.testing.assert_array_equal(resumed.reputation, ledger.reputation)
+        assert resumed.round == ledger.round
+        assert resumed.history == ledger.history
+        assert resumed.oracle_kwargs == ledger.oracle_kwargs
+        nxt = make_reports(rng)
+        np.testing.assert_array_equal(
+            ledger.resolve(nxt)["agents"]["smooth_rep"],
+            resumed.resolve(nxt)["agents"]["smooth_rep"])
+
+    def test_unknown_format_rejected(self, tmp_path):
+        ledger = ReputationLedger(n_reporters=4)
+        with pytest.raises(ValueError, match="format"):
+            ledger.save(tmp_path / "x", format="pickle")
+
     def test_resolve_matches_manual_chain(self, rng):
         """The ledger is exactly the caller-side carry the reference
         expects: manual Oracle chaining gives identical results."""
