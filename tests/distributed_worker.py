@@ -1,0 +1,60 @@
+"""Worker for the true multi-process distributed test (not collected by
+pytest — launched as ``python distributed_worker.py <process_id> <port>``
+by tests/test_distributed.py with a clean environment).
+
+Each of the two OS processes contributes 2 virtual CPU devices, joins the
+JAX distributed runtime through ``pyconsensus_tpu.parallel.initialize``,
+and runs ONE event-sharded resolution over the resulting 4-device global
+mesh — the collectives cross the process boundary via the gloo CPU
+backend, which is how the multi-host claim is validated without a TPU
+pod (SURVEY.md §4, §5 distributed rows)."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+process_id, port = int(sys.argv[1]), sys.argv[2]
+
+from pyconsensus_tpu.parallel import initialize  # noqa: E402
+
+initialize(coordinator_address=f"localhost:{port}", num_processes=2,
+           process_id=process_id)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from pyconsensus_tpu.models.pipeline import (ConsensusParams,  # noqa: E402
+                                             consensus_light_jit)
+from pyconsensus_tpu.parallel import make_mesh  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
+
+# the same deterministic matrix on every process (the multi-process
+# device_put contract for replicated-from-host inputs)
+rng = np.random.default_rng(0)
+truth = rng.choice([0.0, 1.0], size=16)
+reports = np.tile(truth, (12, 1))
+reports[:9] = np.abs(reports[:9] - (rng.random((9, 16)) < 0.1))
+reports[9:] = 1.0 - truth
+
+mesh = make_mesh(batch=1, event=4)
+x = jax.device_put(jnp.asarray(reports), NamedSharding(mesh, P(None, "event")))
+rep = jax.device_put(jnp.full((12,), 1.0 / 12.0), NamedSharding(mesh, P()))
+sc = jax.device_put(jnp.zeros((16,), bool), NamedSharding(mesh, P("event")))
+mn = jax.device_put(jnp.zeros((16,)), NamedSharding(mesh, P("event")))
+mx = jax.device_put(jnp.ones((16,)), NamedSharding(mesh, P("event")))
+params = ConsensusParams(algorithm="sztorc", max_iterations=2,
+                         pca_method="eigh-gram")
+out = consensus_light_jit(x, rep, sc, mn, mx, params)
+
+outcomes = multihost_utils.process_allgather(out["outcomes_adjusted"],
+                                             tiled=True)
+smooth = np.asarray(out["smooth_rep"])          # replicated -> addressable
+print("RESULT", ",".join(f"{float(v):g}" for v in np.ravel(outcomes)),
+      flush=True)
+print("REP", ",".join(f"{float(v):.6f}" for v in smooth), flush=True)
