@@ -155,7 +155,7 @@ def _run_streaming(args) -> None:
           f"{args.iterations} iteration(s)) ===")
     out = streaming_consensus(
         args.file, panel_events=args.panel_events,
-        params=ConsensusParams(algorithm="sztorc",
+        params=ConsensusParams(algorithm=args.algorithm,
                                max_iterations=args.iterations))
     rep = out["smooth_rep"]
     _print_table("Reporters (top 8 by reputation)",
@@ -233,9 +233,9 @@ def main(argv: Optional[Sequence[str]] = None,
         ap.error("--panel-events must be >= 1")
     # reject EXPLICIT options --stream cannot honor (rather than silently
     # overriding them); an unset --iterations defaults per mode below
-    if args.stream and args.algorithm != "sztorc":
-        ap.error("--stream resolves out-of-core with algorithm=sztorc "
-                 "(see streaming_consensus); drop the conflicting "
+    if args.stream and args.algorithm not in ("sztorc", "k-means"):
+        ap.error("--stream resolves out-of-core with algorithm=sztorc or "
+                 "k-means (see streaming_consensus); drop the conflicting "
                  "--algorithm flag or --stream")
     if args.iterations is None:
         # streaming pays one full pass over the file per iteration — default

@@ -28,8 +28,13 @@ certainty, and NA participation — all column-local given the reputation —
 with the per-row ``na @ certainty`` partials accumulated panel by panel.
 
 Host memory holds only E-vectors (fill, certainty, outcomes, ...); device
-memory holds one panel plus three R x R accumulators. Restriction:
-``algorithm="sztorc"``. Iterative redistribution (``max_iterations > 1``)
+memory holds one panel plus three R x R accumulators. Algorithms:
+``"sztorc"`` (above) and ``"k-means"`` (out-of-core Lloyd — host-resident
+(k, E) centroids, two passes per Lloyd iteration; conformity = cluster
+reputation mass, the in-memory variant's rule; cross-panel accumulation
+order differs, so agreement is to accumulation precision — bit-exact in
+the x64 test harness, float-noise-level on an f32 device). Iterative
+redistribution (``max_iterations > 1``)
 costs one accumulation pass per executed iteration, because G and M
 follow the iterating reputation; S and the interpolate fill are pinned to
 the initial reputation (reference semantics) and computed once.
@@ -87,9 +92,9 @@ def _pass1_panel(panel, fill_rep, weight_rep, scaled, mins, maxs, valid,
     return G, M, jnp.zeros_like(G)
 
 
-@functools.partial(jax.jit, static_argnames=("tolerance",))
+@functools.partial(jax.jit, static_argnames=("tolerance", "with_loading"))
 def _pass2_panel(panel, fill_rep, score_rep, final_rep, u_over_nAu, scaled,
-                 mins, maxs, tolerance: float):
+                 mins, maxs, tolerance: float, with_loading: bool = True):
     """Per-panel resolution with the final reputation: outcomes, certainty,
     participation columns, per-row NA partials, and this panel's slice of
     the first loading (``A^T u / ||A^T u||`` with ``score_rep``, the
@@ -111,11 +116,128 @@ def _pass2_panel(panel, fill_rep, score_rep, final_rep, u_over_nAu, scaled,
     pcol = final_rep @ na                            # rep mass on NA
     prow = na @ certainty                            # per-row partials
     na_count = jnp.sum(na, axis=1)
-    mu = score_rep @ filled
-    A = (filled - mu[None, :]) * jnp.sqrt(
-        jnp.clip(score_rep, 0.0, None))[:, None]
-    loading = A.T @ u_over_nAu
+    if with_loading:
+        mu = score_rep @ filled
+        A = (filled - mu[None, :]) * jnp.sqrt(
+            jnp.clip(score_rep, 0.0, None))[:, None]
+        loading = A.T @ u_over_nAu
+    else:       # k-means has no loading; skip the centering matvec
+        loading = jnp.zeros((panel.shape[1],), dtype=acc)
     return raw, adjusted, final, certainty, pcol, prow, na_count, loading
+
+
+@functools.partial(jax.jit, static_argnames=("tolerance",))
+def _kmeans_assign_panel(panel, fill_rep, cent_slice, valid,
+                         scaled, mins, maxs, tolerance: float):
+    """Partial squared distances of every reporter to every centroid over
+    one event panel: sum_e (x_ie - c_je)^2, accumulated across panels on
+    host. Fill semantics identical to the scoring passes."""
+    rescaled = jk.rescale(panel, scaled, mins, maxs)
+    filled, _ = jk.interpolate_masked(rescaled, fill_rep, scaled, tolerance)
+    F = jnp.where(valid[None, :], filled, 0.0)
+    C = jnp.where(valid[None, :], cent_slice, 0.0)       # (k, P)
+    x2 = jnp.sum(F * F, axis=1)                          # (R,)
+    c2 = jnp.sum(C * C, axis=1)                          # (k,)
+    cross = F @ C.T                                      # (R, k)
+    return x2[:, None] - 2.0 * cross + c2[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("tolerance", "k"))
+def _kmeans_update_panel(panel, fill_rep, labels, weight_rep, valid,
+                         scaled, mins, maxs, tolerance: float, k: int):
+    """Per-cluster weighted sums over one event panel — the numerators of
+    the reputation-weighted centroid update (the (R,)-sized weights and
+    counts are panel-invariant and computed on host)."""
+    rescaled = jk.rescale(panel, scaled, mins, maxs)
+    filled, _ = jk.interpolate_masked(rescaled, fill_rep, scaled, tolerance)
+    F = jnp.where(valid[None, :], filled, 0.0)
+    onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(F.dtype)
+    weighted = (onehot * weight_rep[:, None]).T @ F       # (k, P)
+    plain = onehot.T @ F                                  # (k, P)
+    return weighted, plain
+
+
+def _streaming_kmeans_seeds(panels, fill_rep, E, R, k: int, tol: float):
+    """Seed centroids = the FILLED rows at the evenly-spaced seed indices,
+    gathered on device panel by panel ((k, P) crosses the link, not the
+    full panel). Depends only on the pinned fill reputation — computed
+    once, reused across redistribution iterations."""
+    from ..models import clustering as cl
+
+    k = int(min(k, R))
+    seeds = jnp.asarray(cl._seed_indices(R, k))
+    centroids = np.empty((k, E))
+    for start, stop, block, sc, mn, mx, valid in panels():
+        rows = _fill_rows_panel(block, fill_rep, seeds, sc, mn, mx, tol)
+        centroids[:, start:stop] = np.asarray(rows)[:, :stop - start]
+    return centroids
+
+
+def _streaming_kmeans_conformity(panels, fill_rep, rep, seed_centroids,
+                                 E, P, k: int,
+                                 n_iters: int, tol: float, dtype):
+    """Out-of-core Lloyd following clustering.kmeans_conformity_np's
+    rules (summation order differs across panels — agreement is to
+    accumulation precision): evenly-spaced-row seeding, reputation-weighted centroid updates (empty
+    clusters keep their centroid, zero-reputation clusters fall back to
+    the plain mean), final assignment against the final centroids. Two
+    passes over the source per Lloyd iteration plus one final assignment
+    pass; centroids live on host as a (k, E) array."""
+    R = rep.shape[0]
+    k = int(min(k, R))
+    centroids = seed_centroids.copy()
+    labels = None
+    for _ in range(n_iters):
+        d2 = np.zeros((R, k))
+        for start, stop, block, sc, mn, mx, valid in panels():
+            cent = jnp.asarray(
+                np.pad(centroids[:, start:stop],
+                       ((0, 0), (0, P - (stop - start)))), dtype=dtype)
+            d2 += np.asarray(_kmeans_assign_panel(
+                block, fill_rep, cent, valid, sc, mn, mx, tol))
+        labels = np.argmin(d2, axis=1)
+        onehot = labels[:, None] == np.arange(k)[None, :]
+        wsum = (onehot * np.asarray(rep)[:, None]).sum(axis=0)   # (k,)
+        counts = onehot.sum(axis=0)
+        new_centroids = centroids.copy()
+        for start, stop, block, sc, mn, mx, valid in panels():
+            weighted, plain = _kmeans_update_panel(
+                block, fill_rep, jnp.asarray(labels), rep, valid,
+                sc, mn, mx, tol, k)
+            w = np.asarray(weighted)[:, :stop - start]
+            pl = np.asarray(plain)[:, :stop - start]
+            upd = np.where(
+                wsum[:, None] > 0.0,
+                w / np.where(wsum > 0.0, wsum, 1.0)[:, None],
+                np.where(counts[:, None] > 0.0,
+                         pl / np.clip(counts, 1.0, None)[:, None],
+                         centroids[:, start:stop]))
+            new_centroids[:, start:stop] = upd
+        centroids = new_centroids
+
+    # final assignment against the final centroids (parity with the
+    # in-memory post-loop assignment)
+    d2 = np.zeros((R, k))
+    for start, stop, block, sc, mn, mx, valid in panels():
+        cent = jnp.asarray(
+            np.pad(centroids[:, start:stop],
+                   ((0, 0), (0, P - (stop - start)))), dtype=dtype)
+        d2 += np.asarray(_kmeans_assign_panel(
+            block, fill_rep, cent, valid, sc, mn, mx, tol))
+    labels = np.argmin(d2, axis=1)
+    onehot = labels[:, None] == np.arange(k)[None, :]
+    mass = (onehot * np.asarray(rep)[:, None]).sum(axis=0)
+    return jnp.asarray(mass[labels], dtype=dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("tolerance",))
+def _fill_rows_panel(panel, fill_rep, rows, scaled, mins, maxs,
+                     tolerance: float):
+    """The filled values of ``rows`` only — a (k, P) gather on device, so
+    the seeding pass never ships the full (R, P) panel to host."""
+    rescaled = jk.rescale(panel, scaled, mins, maxs)
+    filled, _ = jk.interpolate_masked(rescaled, fill_rep, scaled, tolerance)
+    return filled[rows]
 
 
 def streaming_consensus(reports_src, reputation=None, event_bounds=None,
@@ -137,8 +259,9 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
         raise ValueError(f"reports must be 2-D, got {reports_src.shape}")
     R, E = reports_src.shape
     p = params if params is not None else ConsensusParams()
-    if p.algorithm != "sztorc":
-        raise ValueError("streaming_consensus supports algorithm='sztorc'")
+    if p.algorithm not in ("sztorc", "k-means"):
+        raise ValueError("streaming_consensus supports algorithm='sztorc' "
+                         "or 'k-means'")
     P = int(panel_events)
     if P < 1:
         raise ValueError("panel_events must be >= 1")
@@ -192,42 +315,53 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     rep_k = fill_rep
     this_rep = fill_rep
     S = None
+    kmeans_seeds = None
     converged = False
     iterations = 0
     score_rep = fill_rep
     u_over_nAu = jnp.zeros((R,), dtype=dtype)
     for _ in range(max(p.max_iterations, 1)):
-        G = jnp.zeros((R, R), dtype=dtype)
-        M = jnp.zeros((R, R), dtype=dtype)
-        with_s = S is None
-        S_acc = jnp.zeros((R, R), dtype=dtype) if with_s else None
-        for _, _, block, sc, mn, mx, valid in panels():
-            dG, dM, dS = _pass1_panel(block, fill_rep, rep_k, sc, mn, mx,
-                                      valid, tol, with_s)
-            G, M = G + dG, M + dM
+        if p.algorithm == "k-means":
+            from ..models.clustering import KMEANS_ITERS
+
+            if kmeans_seeds is None:        # fill-pinned: compute once
+                kmeans_seeds = _streaming_kmeans_seeds(
+                    panels, fill_rep, E, R, p.num_clusters, tol)
+            adj = _streaming_kmeans_conformity(
+                panels, fill_rep, rep_k, kmeans_seeds, E, P,
+                p.num_clusters, KMEANS_ITERS, tol, dtype)
+        else:
+            G = jnp.zeros((R, R), dtype=dtype)
+            M = jnp.zeros((R, R), dtype=dtype)
+            with_s = S is None
+            S_acc = jnp.zeros((R, R), dtype=dtype) if with_s else None
+            for _, _, block, sc, mn, mx, valid in panels():
+                dG, dM, dS = _pass1_panel(block, fill_rep, rep_k, sc, mn,
+                                          mx, valid, tol, with_s)
+                G, M = G + dG, M + dM
+                if with_s:
+                    S_acc = S_acc + dS
             if with_s:
-                S_acc = S_acc + dS
-        if with_s:
-            S = S_acc
+                S = S_acc
 
-        denom = 1.0 - jnp.sum(rep_k ** 2)
-        denom = jnp.where(denom == 0.0, 1.0, denom)
-        _, eigvecs = jnp.linalg.eigh(G / denom)
-        u = eigvecs[:, -1]
-        nAu = jnp.sqrt(jnp.clip(u @ G @ u, 0.0, None))
-        u_over_nAu = u / jnp.where(nAu == 0.0, 1.0, nAu)
-        scores = M @ u_over_nAu
+            denom = 1.0 - jnp.sum(rep_k ** 2)
+            denom = jnp.where(denom == 0.0, 1.0, denom)
+            _, eigvecs = jnp.linalg.eigh(G / denom)
+            u = eigvecs[:, -1]
+            nAu = jnp.sqrt(jnp.clip(u @ G @ u, 0.0, None))
+            u_over_nAu = u / jnp.where(nAu == 0.0, 1.0, nAu)
+            scores = M @ u_over_nAu
 
-        set1 = scores + jnp.abs(jnp.min(scores))
-        set2 = scores - jnp.max(scores)
+            set1 = scores + jnp.abs(jnp.min(scores))
+            set2 = scores - jnp.max(scores)
 
-        def sq_dist_to_old(w, rep_ref=rep_k):
-            d = w - rep_ref
-            return d @ S @ d
+            def sq_dist_to_old(w, rep_ref=rep_k):
+                d = w - rep_ref
+                return d @ S @ d
 
-        ref_ind = (sq_dist_to_old(jk.normalize(set1))
-                   - sq_dist_to_old(jk.normalize(set2)))
-        adj = jnp.where(ref_ind <= 0.0, set1, -set2)
+            ref_ind = (sq_dist_to_old(jk.normalize(set1))
+                       - sq_dist_to_old(jk.normalize(set2)))
+            adj = jnp.where(ref_ind <= 0.0, set1, -set2)
         this_rep = jk.row_reward_weighted(adj, rep_k)
         new_rep = jk.smooth(this_rep, rep_k, p.alpha)
         delta = float(jnp.max(jnp.abs(new_rep - rep_k)))
@@ -251,7 +385,7 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     for start, stop, block, sc, mn, mx, _ in panels():
         raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
             block, fill_rep, score_rep, smooth_rep, u_over_nAu, sc, mn, mx,
-            tol)
+            tol, with_loading=p.algorithm == "sztorc")
         width = stop - start
         outcomes_raw[start:stop] = np.asarray(raw)[:width]
         outcomes_adjusted[start:stop] = np.asarray(adjd)[:width]
@@ -262,6 +396,8 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
         prow += np.asarray(pr)       # padded cols: certainty * na(=0) = 0
         na_count += np.asarray(nc)
     first_loading = nk.canon_sign(first_loading)
+    result_extra = ({"first_loading": first_loading}
+                    if p.algorithm == "sztorc" else {})
 
     # ---- finalize the bonus accounting (numpy_kernels semantics) --------
     total_cert = certainty.sum()
@@ -286,7 +422,6 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
         "outcomes_final": outcomes_final,
         "iterations": iterations,
         "convergence": converged,
-        "first_loading": first_loading,
         "certainty": certainty,
         "consensus_reward": consensus_reward,
         "avg_certainty": float(certainty.mean()),
@@ -297,4 +432,5 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
         "reporter_bonus": reporter_bonus,
         "na_bonus_cols": na_bonus_cols,
         "author_bonus": author_bonus,
+        **result_extra,
     }

@@ -80,10 +80,34 @@ class TestStreamingParity:
     def test_rejects_unsupported(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
         with pytest.raises(ValueError, match="sztorc"):
-            streaming_consensus(reports,
-                                params=ConsensusParams(algorithm="k-means"))
+            streaming_consensus(
+                reports, params=ConsensusParams(algorithm="hierarchical"))
         with pytest.raises(ValueError, match="panel_events"):
             streaming_consensus(reports, panel_events=0)
+
+    @pytest.mark.parametrize("panel_events", [4, 64])
+    def test_kmeans_matches_in_memory(self, rng, panel_events):
+        """Out-of-core Lloyd reproduces the in-memory k-means variant:
+        identical labels -> identical conformity -> identical reputation
+        and outcomes."""
+        import jax.numpy as jnp
+        reports, _ = collusion_reports(rng, R=18, E=23, liars=5,
+                                       na_frac=0.1)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm="k-means", num_clusters=3,
+                            max_iterations=1, any_scaled=False, has_na=True)
+        ref = _consensus_core_light(
+            jnp.asarray(reports), jnp.full((R,), 1.0 / R),
+            jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E), p)
+        out = streaming_consensus(reports, panel_events=panel_events,
+                                  params=p)
+        assert "first_loading" not in out
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]), atol=1e-9)
+        np.testing.assert_allclose(out["certainty"],
+                                   np.asarray(ref["certainty"]), atol=1e-9)
 
     @pytest.mark.parametrize("max_iterations", [3, 25])
     def test_multi_iteration_matches_in_memory(self, rng, max_iterations):
