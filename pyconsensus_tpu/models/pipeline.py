@@ -84,14 +84,20 @@ class ConsensusParams(NamedTuple):
     any_scaled: bool = True
     has_na: bool = True
     #: NaN-threaded fast path for the light pipeline (single-device TPU,
-    #: binary events, sztorc): the storage matrix keeps NaN where reports
-    #: are absent and every Pallas kernel reconstructs filled values
-    #: in-register from a per-column fill vector — the filled matrix and
-    #: the participation mask never exist in HBM, and the whole back half
-    #: (outcomes + certainty + participation/bonuses) is ONE fused sweep
+    #: sztorc): the storage matrix keeps NaN where reports are absent and
+    #: every Pallas kernel reconstructs filled values in-register from a
+    #: per-column fill vector — the filled matrix and the participation
+    #: mask never exist in HBM, and the whole back half (outcomes +
+    #: certainty + participation/bonuses) is ONE fused sweep
     #: (pallas_kernels.resolve_certainty_fused). Set by the sharded
     #: front-end, not user-facing.
     fused_resolution: bool = False
+    #: static count of scaled events, set by the sharded front-end from the
+    #: host-side bounds. The fused path handles scaled events by gathering
+    #: exactly this many columns after the binary kernel and re-resolving
+    #: them with the sort-based weighted median (O(R * n_scaled) — the gate
+    #: only routes here when that is a small fraction of the matrix).
+    n_scaled: int = 0
 
 
 def _scores_np(filled, rep, p: ConsensusParams):
@@ -274,12 +280,14 @@ consensus_jit = jax.jit(_consensus_core, static_argnames=("p",))
 _LARGE_RESULT_KEYS = ("original", "rescaled", "filled")
 
 
-def _fill_stats(reports, reputation, tolerance: float, storage_dtype: str):
-    """One XLA pass over the raw reports for the NaN-threaded fast path:
-    the storage cast (NaN preserved) plus the per-column interpolate fill
-    vector and the present-weight stats that make the first-iteration
-    weighted means free (mu = numer + (total - tw) * fill). Binary events
-    only — fills are catch-snapped like interpolate_masked's."""
+def _fill_stats(reports, reputation, tolerance: float, storage_dtype: str,
+                scaled=None):
+    """One XLA pass over the (already rescaled) reports for the NaN-threaded
+    fast path: the storage cast (NaN preserved) plus the per-column
+    interpolate fill vector and the present-weight stats that make the
+    first-iteration weighted means free (mu = numer + (total - tw) * fill).
+    Fills are catch-snapped like interpolate_masked's — except scaled
+    columns (``scaled`` given), whose fills stay raw weighted means."""
     acc = reputation.dtype
     x = reports.astype(jnp.dtype(storage_dtype)) if storage_dtype else reports
     na = jnp.isnan(reports)
@@ -287,7 +295,8 @@ def _fill_stats(reports, reputation, tolerance: float, storage_dtype: str):
     tw = jnp.sum(w, axis=0)
     numer = jnp.sum(jnp.where(na, 0.0, reports).astype(acc) * w, axis=0)
     fill = jnp.where(tw > 0.0, numer / jnp.where(tw > 0.0, tw, 1.0), 0.5)
-    fill = jk.catch(fill, tolerance)
+    snapped = jk.catch(fill, tolerance)
+    fill = snapped if scaled is None else jnp.where(scaled, fill, snapped)
     return x, fill, tw, numer
 
 
@@ -315,8 +324,12 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     interp = jax.default_backend() != "tpu"
     old_rep = jk.normalize(reputation)
     acc = old_rep.dtype
+    raw_reports = reports
+    if p.any_scaled:
+        reports = jk.rescale(reports, scaled, mins, maxs)  # NaN stays NaN
     x, fill, tw0, numer0 = _fill_stats(reports, old_rep, p.catch_tolerance,
-                                       p.storage_dtype)
+                                       p.storage_dtype,
+                                       scaled if p.any_scaled else None)
     full0 = jnp.sum(old_rep)
     mu1 = numer0 + (full0 - tw0) * fill
 
@@ -355,13 +368,62 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     raw, adjusted, certainty, pcol, prow, narow = resolve_certainty_fused(
         x, rep, fill, jnp.sum(rep), float(p.catch_tolerance),
         interpret=interp)
+    if p.n_scaled:
+        # keep the scaled-column scatter updates below from being fused
+        # into the kernel's output buffers: that fusion pins two (1, E)
+        # outputs into scoped VMEM (S(1)) and blows the kernel's 16 MB
+        # budget at north-star f32 scale (measured +3.5 MB over)
+        raw, adjusted, certainty, pcol, prow, narow = (
+            lax.optimization_barrier(
+                (raw, adjusted, certainty, pcol, prow, narow)))
+    raw = raw.astype(acc)
+    adjusted = adjusted.astype(acc)
     certainty = certainty.astype(acc)
+    prow = prow.astype(acc)
+    outcomes_final = adjusted
+    if p.n_scaled:
+        # scaled columns: the kernel's catch-snapped weighted means are
+        # wrong for them — gather the (statically counted) scaled columns
+        # and re-resolve with the exact sort-based weighted median +
+        # tolerance-agreement certainty (resolve_outcomes /
+        # certainty_and_bonuses semantics), then scatter back. O(R *
+        # n_scaled) against the kernel's O(R * E) sweep.
+        #
+        # The gather reads the RAW reports and redoes the rescale (and
+        # storage rounding) on just the slice: slicing the full rescaled
+        # intermediate instead gives it a second consumer besides the
+        # Pallas kernels, which flips XLA's layout/buffering choices for
+        # the custom-call operand and blows the kernel's scoped-VMEM
+        # budget (measured: 19.5M vs the 16M limit at 10k x 100k f32;
+        # either consumer alone compiles at 13.5M).
+        idx = jnp.nonzero(scaled, size=p.n_scaled)[0]
+        xs = jk.rescale(raw_reports[:, idx], scaled[idx], mins[idx],
+                        maxs[idx])
+        if p.storage_dtype:
+            xs = xs.astype(jnp.dtype(p.storage_dtype))  # XLA-path rounding
+        xs = xs.astype(acc)
+        pres = ~jnp.isnan(xs)
+        filled_s = jnp.where(pres, xs, fill[idx].astype(acc)[None, :])
+        med = jk.weighted_median_cols(
+            filled_s, jnp.broadcast_to(rep[:, None], filled_s.shape), pres)
+        tw_s = jnp.sum(jnp.where(pres, rep[:, None], 0.0), axis=0)
+        out_s = jnp.where(tw_s > 0.0, med, raw[idx])
+        agree_s = jnp.abs(filled_s - out_s[None, :]) <= p.catch_tolerance
+        cert_s = jnp.sum(agree_s * rep[:, None], axis=0)
+        # prow = [is-NaN] @ certainty used the kernel's binary certainty
+        # for these columns; swap in the scaled-agreement certainty
+        prow = prow + (~pres).astype(acc) @ (cert_s - certainty[idx])
+        certainty = certainty.at[idx].set(cert_s)
+        raw = raw.at[idx].set(out_s)
+        adjusted = adjusted.at[idx].set(out_s)     # scaled: no catch snap
+        outcomes_final = adjusted.at[idx].set(
+            out_s * (maxs[idx] - mins[idx]) + mins[idx])
     participation_columns = (1.0 - pcol).astype(acc)
     consensus_reward = jk.normalize(certainty)
     total_cert = jnp.sum(certainty)
     participation_rows = (1.0 - jnp.where(
-        total_cert == 0.0, prow.astype(acc),
-        prow.astype(acc) / jnp.where(total_cert == 0.0, 1.0, total_cert)))
+        total_cert == 0.0, prow,
+        prow / jnp.where(total_cert == 0.0, 1.0, total_cert)))
     percent_na = 1.0 - jnp.mean(participation_columns)
     na_bonus_rows = jk.normalize(participation_rows)
     reporter_bonus = na_bonus_rows * percent_na + rep * (1.0 - percent_na)
@@ -373,9 +435,9 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
         "this_rep": this_rep,
         "smooth_rep": rep,
         "na_row": narow > 0.0,
-        "outcomes_raw": raw.astype(acc),
-        "outcomes_adjusted": adjusted.astype(acc),
-        "outcomes_final": adjusted.astype(acc),
+        "outcomes_raw": raw,
+        "outcomes_adjusted": adjusted,
+        "outcomes_final": outcomes_final,
         "iterations": iters,
         "convergence": converged,
         "first_loading": jk.canon_sign(loading),

@@ -10,11 +10,13 @@ from .distributed import (initialize, is_distributed, make_hybrid_mesh,
 from .mesh import (Mesh, NamedSharding, P, batch_event_sharding,
                    event_sharding, make_mesh, replicated)
 from .ring import ring_allreduce, ring_first_pc, ring_gram, ring_matvec
-from .sharded import ShardedOracle, sharded_consensus
+from .sharded import (PlacedBounds, ShardedOracle, place_event_bounds,
+                      sharded_consensus)
 from .streaming import streaming_consensus
 
 __all__ = ["make_mesh", "event_sharding", "batch_event_sharding",
            "replicated", "Mesh", "NamedSharding", "P",
            "ShardedOracle", "sharded_consensus", "streaming_consensus",
+           "PlacedBounds", "place_event_bounds",
            "ring_allreduce", "ring_gram", "ring_matvec", "ring_first_pc",
            "initialize", "is_distributed", "make_hybrid_mesh", "num_slices"]

@@ -22,7 +22,7 @@ Use :func:`sharded_consensus` for one big oracle, or
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -31,7 +31,8 @@ from ..models.pipeline import ConsensusParams, consensus_light_jit
 from ..oracle import Oracle, assemble_result, parse_event_bounds
 from .mesh import Mesh, event_sharding, make_mesh, replicated
 
-__all__ = ["sharded_consensus", "ShardedOracle"]
+__all__ = ["sharded_consensus", "ShardedOracle", "PlacedBounds",
+           "place_event_bounds"]
 
 #: PCA methods that never materialize the E×E covariance and whose
 #: contractions ride the event axis (SURVEY.md §7 "hard parts")
@@ -70,7 +71,11 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     """Gate for the NaN-threaded Pallas fast path
     (``ConsensusParams.fused_resolution``): single real TPU (a Pallas call
     is a black box to the GSPMD partitioner, so the multi-chip mesh stays
-    on XLA), binary events, the sztorc algorithm scored by power iteration
+    on XLA), binary events — or a small statically-counted scaled fraction
+    (``params.n_scaled``, re-resolved exactly by an O(R * n_scaled)
+    gather-and-fix pass after the binary kernel; a scaled-heavy matrix
+    would make that pass rival the fused sweep it rides on, so it takes
+    the XLA path) — the sztorc algorithm scored by power iteration
     (``params.pca_method`` must already be resolved — an explicit or
     auto-picked exact eigh must NOT be silently swapped for power
     iteration), a reporter count the fused resolution kernel's row-chunk
@@ -86,14 +91,49 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     itemsize = (jax.numpy.dtype(params.storage_dtype).itemsize
                 if params.storage_dtype
                 else jax.numpy.asarray(0.0).dtype.itemsize)
+    scaled_ok = (not params.any_scaled
+                 or 0 < params.n_scaled <= n_events // 8)
     return (n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
             and params.pca_method in ("power", "power-fused")
-            and not params.any_scaled
+            and scaled_ok
             and _pick_chunk(n_reporters) is not None
             and fused_pca_fits(n_events, itemsize)
             and resolve_kernel_fits(n_reporters, itemsize))
+
+
+class PlacedBounds(NamedTuple):
+    """Event bounds parsed once and resident on device, for callers that
+    resolve repeatedly with the same bounds: re-parsing a Python
+    ``event_bounds`` list is an O(E) host loop and re-placing the three
+    E-vectors is a host->device upload — measured together at ~100 ms per
+    call through the tunneled-TPU link at E=100k, several times the
+    resolution itself. Build with :func:`place_event_bounds` and pass as
+    ``sharded_consensus(..., event_bounds=placed)``."""
+    scaled: jax.Array
+    mins: jax.Array
+    maxs: jax.Array
+    any_scaled: bool
+    n_scaled: int
+
+
+def place_event_bounds(event_bounds, n_events: int,
+                       mesh: Optional[Mesh] = None) -> PlacedBounds:
+    """Parse a reference-style ``event_bounds`` list and place the three
+    E-vectors on ``mesh`` (event-sharded), returning a :class:`PlacedBounds`
+    that repeat resolutions can reuse for free."""
+    jnp = jax.numpy
+    mesh = mesh if mesh is not None else make_mesh(batch=1)
+    scaled, mins, maxs = parse_event_bounds(event_bounds, n_events)
+    dtype = jnp.asarray(0.0).dtype
+    e_shard = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec("event"))
+    return PlacedBounds(
+        jax.device_put(jnp.asarray(scaled, dtype=bool), e_shard),
+        jax.device_put(jnp.asarray(mins, dtype=dtype), e_shard),
+        jax.device_put(jnp.asarray(maxs, dtype=dtype), e_shard),
+        bool(scaled.any()), int(scaled.sum()))
 
 
 @functools.lru_cache(maxsize=16)
@@ -119,36 +159,43 @@ def _default_reputation_placed(mesh: Mesh, R: int):
                           replicated(mesh))
 
 
-def _maybe_place_reports(reports, x_shard, dtype):
-    """device_put the (R, E) matrix with the event axis sharded — skipped
-    when it is already a committed device array with the target dtype and
-    an equivalent sharding (every repeat resolution of a resident matrix,
-    e.g. the benchmark). ``getattr`` keeps tracers on the unconditional
-    placement path (a traced array has no ``.sharding``)."""
-    sharding = getattr(reports, "sharding", None)
-    if (isinstance(reports, jax.Array)
+def _maybe_place(arr, shard, dtype):
+    """device_put with the target sharding — skipped when the array is
+    already a committed device array with the target dtype and an
+    equivalent sharding (every repeat resolution of resident inputs, e.g.
+    the benchmark, or a ShardedOracle resolving more than once; each
+    avoided put is a host->device upload through the tunnel). ``getattr``
+    keeps tracers on the unconditional placement path (a traced array has
+    no ``.sharding``)."""
+    sharding = getattr(arr, "sharding", None)
+    if (isinstance(arr, jax.Array)
             and sharding is not None
-            and reports.dtype == dtype
-            and sharding.is_equivalent_to(x_shard, reports.ndim)):
-        return reports
-    return jax.device_put(jax.numpy.asarray(reports, dtype=dtype), x_shard)
+            and arr.dtype == dtype
+            and sharding.is_equivalent_to(shard, arr.ndim)):
+        return arr
+    return jax.device_put(jax.numpy.asarray(arr, dtype=dtype), shard)
+
+
+# back-compat alias used by callers/tests
+def _maybe_place_reports(reports, x_shard, dtype):
+    return _maybe_place(reports, x_shard, dtype)
 
 
 def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
     """device_put the pipeline inputs with the event axis sharded: the
     (R, E) matrix and all E-vectors split over "event", the O(R) reputation
-    replicated."""
+    replicated. Already-placed inputs are passed through untouched."""
     jnp = jax.numpy
     dtype = jnp.asarray(0.0).dtype
     x_shard = event_sharding(mesh)
     e_shard = jax.sharding.NamedSharding(mesh,
                                          jax.sharding.PartitionSpec("event"))
     r_shard = replicated(mesh)
-    return (_maybe_place_reports(reports, x_shard, dtype),
-            jax.device_put(jnp.asarray(reputation, dtype=dtype), r_shard),
-            jax.device_put(jnp.asarray(scaled, dtype=bool), e_shard),
-            jax.device_put(jnp.asarray(mins, dtype=dtype), e_shard),
-            jax.device_put(jnp.asarray(maxs, dtype=dtype), e_shard))
+    return (_maybe_place(reports, x_shard, dtype),
+            _maybe_place(reputation, r_shard, dtype),
+            _maybe_place(scaled, e_shard, jnp.dtype(bool)),
+            _maybe_place(mins, e_shard, dtype),
+            _maybe_place(maxs, e_shard, dtype))
 
 
 def sharded_consensus(reports, reputation=None, event_bounds=None,
@@ -177,9 +224,15 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         # even device-side re-creation costs several dispatches per call.
         scaled, mins, maxs = _default_bounds_placed(mesh, E)
         any_scaled = False
+        p = p._replace(n_scaled=0)   # a reused params object may carry one
+    elif isinstance(event_bounds, PlacedBounds):
+        scaled, mins, maxs = event_bounds[:3]
+        any_scaled = event_bounds.any_scaled
+        p = p._replace(n_scaled=event_bounds.n_scaled)
     else:
         scaled, mins, maxs = parse_event_bounds(event_bounds, E)
         any_scaled = bool(scaled.any())
+        p = p._replace(n_scaled=int(scaled.sum()))
     p = p._replace(
         pca_method=_pick_pca_method(p, R, mesh.devices.size),
         any_scaled=any_scaled,
@@ -189,6 +242,11 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
     )
     p = p._replace(fused_resolution=_use_fused_resolution(
         p, R, E, mesh.devices.size))
+    if not p.fused_resolution:
+        # only the fused gather reads n_scaled; keeping it in the
+        # jit-static params on the XLA path would recompile the whole
+        # pipeline per distinct scaled COUNT instead of per any_scaled
+        p = p._replace(n_scaled=0)
     if reputation is None:
         reputation = _default_reputation_placed(mesh, R)   # cached, on device
         if event_bounds is None:
@@ -220,11 +278,28 @@ class ShardedOracle(Oracle):
         self.mesh = mesh if mesh is not None else make_mesh(batch=1)
         self.params = self.params._replace(
             pca_method=_pick_pca_method(self.params, self.reports.shape[0],
-                                        self.mesh.devices.size))
+                                        self.mesh.devices.size),
+            n_scaled=int(np.asarray(self.scaled).sum()))
         self.params = self.params._replace(
             fused_resolution=_use_fused_resolution(
                 self.params, self.reports.shape[0], self.reports.shape[1],
                 self.mesh.devices.size))
+        if not self.params.fused_resolution:
+            # keep the jit cache keyed on any_scaled, not the scaled count
+            self.params = self.params._replace(n_scaled=0)
+
+    def place(self):
+        """Optionally pin the oracle's inputs on device (event-sharded)
+        before resolving repeatedly: subsequent ``consensus()`` calls skip
+        every host->device upload (``_maybe_place`` passes committed
+        arrays through untouched). Trade-off: the public attributes become
+        immutable JAX arrays in the compute dtype — don't call this if you
+        plan to mutate ``reports`` in place between rounds."""
+        (self.reports, self.reputation, self.scaled, self.mins,
+         self.maxs) = _place_inputs(self.mesh, self.reports,
+                                    self.reputation, self.scaled,
+                                    self.mins, self.maxs)
+        return self
 
     def resolve_raw(self):
         placed = _place_inputs(self.mesh, self.reports, self.reputation,

@@ -174,7 +174,7 @@ def _streaming_kmeans_seeds(panels, fill_rep, E, R, k: int, tol: float):
 
 
 def _streaming_kmeans_conformity(panels, fill_rep, rep, seed_centroids,
-                                 E, P, k: int,
+                                 P, k: int,
                                  n_iters: int, tol: float, dtype):
     """Out-of-core Lloyd following clustering.kmeans_conformity_np's
     rules (summation order differs across panels — agreement is to
@@ -186,16 +186,21 @@ def _streaming_kmeans_conformity(panels, fill_rep, rep, seed_centroids,
     R = rep.shape[0]
     k = int(min(k, R))
     centroids = seed_centroids.copy()
-    labels = None
-    for _ in range(n_iters):
+
+    def assign(cents):
+        """One full assignment pass: accumulate squared distances panel by
+        panel against ``cents`` and argmin on host."""
         d2 = np.zeros((R, k))
         for start, stop, block, sc, mn, mx, valid in panels():
             cent = jnp.asarray(
-                np.pad(centroids[:, start:stop],
+                np.pad(cents[:, start:stop],
                        ((0, 0), (0, P - (stop - start)))), dtype=dtype)
             d2 += np.asarray(_kmeans_assign_panel(
                 block, fill_rep, cent, valid, sc, mn, mx, tol))
-        labels = np.argmin(d2, axis=1)
+        return np.argmin(d2, axis=1)
+
+    for _ in range(n_iters):
+        labels = assign(centroids)
         onehot = labels[:, None] == np.arange(k)[None, :]
         wsum = (onehot * np.asarray(rep)[:, None]).sum(axis=0)   # (k,)
         counts = onehot.sum(axis=0)
@@ -217,14 +222,7 @@ def _streaming_kmeans_conformity(panels, fill_rep, rep, seed_centroids,
 
     # final assignment against the final centroids (parity with the
     # in-memory post-loop assignment)
-    d2 = np.zeros((R, k))
-    for start, stop, block, sc, mn, mx, valid in panels():
-        cent = jnp.asarray(
-            np.pad(centroids[:, start:stop],
-                   ((0, 0), (0, P - (stop - start)))), dtype=dtype)
-        d2 += np.asarray(_kmeans_assign_panel(
-            block, fill_rep, cent, valid, sc, mn, mx, tol))
-    labels = np.argmin(d2, axis=1)
+    labels = assign(centroids)
     onehot = labels[:, None] == np.arange(k)[None, :]
     mass = (onehot * np.asarray(rep)[:, None]).sum(axis=0)
     return jnp.asarray(mass[labels], dtype=dtype)
@@ -328,7 +326,7 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
                 kmeans_seeds = _streaming_kmeans_seeds(
                     panels, fill_rep, E, R, p.num_clusters, tol)
             adj = _streaming_kmeans_conformity(
-                panels, fill_rep, rep_k, kmeans_seeds, E, P,
+                panels, fill_rep, rep_k, kmeans_seeds, P,
                 p.num_clusters, KMEANS_ITERS, tol, dtype)
         else:
             G = jnp.zeros((R, R), dtype=dtype)

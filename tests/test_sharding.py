@@ -124,6 +124,88 @@ class TestFusedResolution:
             else:
                 np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
 
+    def test_matches_xla_light_path_scaled(self, rng):
+        """Mixed binary + scaled events: the fused path's gather-and-fix
+        median pass must reproduce the XLA light pipeline (same sort-based
+        weighted median, tolerance-agreement certainty, un-rescale)."""
+        from pyconsensus_tpu.models.pipeline import (_consensus_core_fused,
+                                                     _consensus_core_light)
+        import jax.numpy as jnp
+        reports = make_reports(rng, R=24, E=12, na_frac=0.1)
+        R, E = reports.shape
+        scaled = np.zeros(E, dtype=bool)
+        scaled[[3, 7, 11]] = True
+        mins = np.where(scaled, -5.0, 0.0)
+        maxs = np.where(scaled, 15.0, 1.0)
+        reports[:, scaled] = reports[:, scaled] * 20.0 - 5.0   # into bounds
+        rep = np.full(R, 1.0 / R)
+        args = (jnp.asarray(reports), jnp.asarray(rep), jnp.asarray(scaled),
+                jnp.asarray(mins), jnp.asarray(maxs))
+        base = ConsensusParams(algorithm="sztorc", max_iterations=2,
+                               pca_method="power", power_iters=256,
+                               power_tol=-1.0, any_scaled=True, has_na=True,
+                               n_scaled=3)
+        ref = _consensus_core_light(*args, base._replace(n_scaled=0))
+        fused = _consensus_core_fused(
+            *args, base._replace(fused_resolution=True))
+        assert set(fused) == set(ref)
+        binary = ~scaled
+        for key in ref:
+            a, b = np.asarray(ref[key]), np.asarray(fused[key])
+            if key in ("na_row", "iterations", "convergence"):
+                np.testing.assert_array_equal(a, b, err_msg=key)
+            elif key in ("outcomes_adjusted", "outcomes_final"):
+                # binary outcomes are catch-snapped -> exact; scaled carry
+                # float differences from the two fill computations
+                np.testing.assert_array_equal(a[binary], b[binary],
+                                              err_msg=key)
+                np.testing.assert_allclose(a[scaled], b[scaled], atol=2e-3,
+                                           err_msg=key)
+            elif key == "first_loading":
+                np.testing.assert_allclose(np.abs(a), np.abs(b), atol=2e-3,
+                                           err_msg=key)
+            else:
+                np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+    def test_gate_scaled_fraction(self, monkeypatch):
+        """On TPU the gate admits a small static scaled fraction and rejects
+        scaled-heavy matrices (and any_scaled without a count)."""
+        import pyconsensus_tpu.parallel.sharded as sh
+        monkeypatch.setattr(sh.jax, "default_backend", lambda: "tpu")
+        p = ConsensusParams(algorithm="sztorc", any_scaled=False,
+                            pca_method="power-fused",
+                            storage_dtype="float32")   # x64 test env
+        assert sh._use_fused_resolution(p, 10_000, 100_000, 1)
+        ok = p._replace(any_scaled=True, n_scaled=1000)
+        assert sh._use_fused_resolution(ok, 10_000, 100_000, 1)
+        heavy = p._replace(any_scaled=True, n_scaled=20_000)
+        assert not sh._use_fused_resolution(heavy, 10_000, 100_000, 1)
+        uncounted = p._replace(any_scaled=True, n_scaled=0)
+        assert not sh._use_fused_resolution(uncounted, 10_000, 100_000, 1)
+
+    def test_stale_n_scaled_is_reset(self, rng, monkeypatch):
+        """A reused params object carrying n_scaled>0 must not leak into a
+        boundsless resolution (the fused gather would then mis-resolve
+        binary column 0 as scaled), and the XLA path must not key its jit
+        cache on the scaled count."""
+        import pyconsensus_tpu.parallel.sharded as sh
+        from pyconsensus_tpu.models.pipeline import consensus_light_jit
+        seen = []
+
+        def spy(*args):
+            seen.append(args[-1])
+            return consensus_light_jit(*args)
+
+        monkeypatch.setattr(sh, "consensus_light_jit", spy)
+        stale = ConsensusParams(pca_method="power", n_scaled=3)
+        sh.sharded_consensus(make_reports(rng), params=stale)  # no bounds
+        assert seen[-1].n_scaled == 0
+        # bounds given but gate rejects (CPU): n_scaled must also be reset
+        reports = make_reports(rng, E=16, na_frac=0.0)
+        bounds = [None] * 14 + [{"scaled": True, "min": 0.0, "max": 1.0}] * 2
+        sh.sharded_consensus(reports, event_bounds=bounds, params=stale)
+        assert seen[-1].n_scaled == 0
+
     def test_gate_requires_single_tpu(self):
         from pyconsensus_tpu.parallel.sharded import _use_fused_resolution
         p = ConsensusParams(algorithm="sztorc", any_scaled=False,

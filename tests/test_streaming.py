@@ -109,6 +109,27 @@ class TestStreamingParity:
         np.testing.assert_allclose(out["certainty"],
                                    np.asarray(ref["certainty"]), atol=1e-9)
 
+    def test_kmeans_multi_iteration_matches_in_memory(self, rng):
+        """Iterative redistribution with k-means scoring: the fill-pinned
+        seed reuse and per-iteration reputation threading must reproduce
+        the in-memory scan."""
+        import jax.numpy as jnp
+        reports, _ = collusion_reports(rng, R=18, E=23, liars=5,
+                                       na_frac=0.1)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm="k-means", num_clusters=3,
+                            max_iterations=4, any_scaled=False, has_na=True)
+        ref = _consensus_core_light(
+            jnp.asarray(reports), jnp.full((R,), 1.0 / R),
+            jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E), p)
+        out = streaming_consensus(reports, panel_events=6, params=p)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]), atol=1e-9)
+        assert out["iterations"] == int(ref["iterations"])
+        assert out["convergence"] == bool(ref["convergence"])
+
     @pytest.mark.parametrize("max_iterations", [3, 25])
     def test_multi_iteration_matches_in_memory(self, rng, max_iterations):
         """Iterative redistribution: one accumulation pass per executed
