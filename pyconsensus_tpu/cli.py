@@ -146,7 +146,7 @@ def _run_simulation(args) -> None:
         print(f"sweep report written to {args.plot}")
 
 
-def _run_streaming(args) -> None:
+def _run_streaming(args, bounds) -> None:
     from .models.pipeline import ConsensusParams
     from .parallel import streaming_consensus
 
@@ -154,7 +154,7 @@ def _run_streaming(args) -> None:
           f"({args.panel_events} events/panel, "
           f"{args.iterations} iteration(s)) ===")
     out = streaming_consensus(
-        args.file, panel_events=args.panel_events,
+        args.file, event_bounds=bounds, panel_events=args.panel_events,
         params=ConsensusParams(algorithm=args.algorithm,
                                max_iterations=args.iterations))
     rep = out["smooth_rep"]
@@ -163,10 +163,16 @@ def _run_streaming(args) -> None:
                  [(int(i), float(rep[i]), float(out["reporter_bonus"][i]))
                   for i in np.argsort(rep)[::-1][:8]])
     outcomes = out["outcomes_final"]
-    counts = {v: int((outcomes == v).sum()) for v in (0.0, 0.5, 1.0)}
+    # the scaled/binary split comes from the bounds, not by value: a scaled
+    # outcome can legitimately land exactly on 0/0.5/1
+    binary = np.array([not (b and b.get("scaled")) for b in bounds]
+                      if bounds else [True] * len(outcomes))
+    n_scaled = int((~binary).sum())
+    counts = {v: int((outcomes[binary] == v).sum()) for v in (0.0, 0.5, 1.0)}
     print(f"\n  events: {len(outcomes)}   outcomes 0/0.5/1: "
           f"{counts[0.0]}/{counts[0.5]}/{counts[1.0]}"
-          f"   avg certainty: {out['avg_certainty']:.6f}"
+          + (f" (+{n_scaled} scaled)" if n_scaled else "")
+          + f"   avg certainty: {out['avg_certainty']:.6f}"
           f"   participation: {1.0 - out['percent_na']:.6f}\n")
 
 
@@ -191,10 +197,16 @@ def main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("-f", "--file", metavar="PATH",
                     help="resolve a reports matrix loaded from PATH "
                          "(.npy or .csv; NA/NaN = missing report)")
+    ap.add_argument("--bounds", metavar="PATH",
+                    help="with --file: JSON event-bounds sidecar — a list "
+                         "with one entry per event, null for binary or "
+                         '{"scaled": true, "min": M, "max": X} for scaled '
+                         "events (the Oracle event_bounds format)")
     ap.add_argument("--stream", action="store_true",
                     help="with --file: resolve out-of-core (two streaming "
                          "passes over event panels; for matrices larger "
-                         "than device memory; .npy is memory-mapped)")
+                         "than device memory; .npy is memory-mapped, .csv "
+                         "is staged to .npy in row chunks)")
     ap.add_argument("--panel-events", type=int, default=8192,
                     help="with --stream: events per streamed panel")
     ap.add_argument("--algorithm", default="sztorc", choices=ALGORITHMS)
@@ -229,6 +241,21 @@ def main(argv: Optional[Sequence[str]] = None,
 
     if args.stream and not args.file:
         ap.error("--stream requires --file")
+    if args.bounds and not args.file:
+        ap.error("--bounds requires --file")
+    file_bounds = None
+    if args.bounds:
+        import json
+
+        try:
+            with open(args.bounds) as f:
+                file_bounds = json.load(f)
+        except (OSError, ValueError) as exc:
+            ap.error(f"--bounds: {exc}")
+        if not isinstance(file_bounds, list):
+            ap.error(f"--bounds: {args.bounds} must contain a JSON list "
+                     "(one entry per event: null or a "
+                     '{"scaled": ..., "min": ..., "max": ...} object)')
     if args.panel_events < 1:
         ap.error("--panel-events must be >= 1")
     # reject EXPLICIT options --stream cannot honor (rather than silently
@@ -244,7 +271,7 @@ def main(argv: Optional[Sequence[str]] = None,
     if args.file:
         if args.stream:
             try:
-                _run_streaming(args)
+                _run_streaming(args, file_bounds)
             except (OSError, ValueError) as exc:
                 ap.error(f"--stream: {exc}")
         else:
@@ -254,7 +281,15 @@ def main(argv: Optional[Sequence[str]] = None,
                 file_reports = load_reports(args.file)
             except (OSError, ValueError) as exc:
                 ap.error(f"--file: {exc}")
-            _run_demo(f"Reports from {args.file}", file_reports, None, args)
+            if file_bounds is not None:
+                from .oracle import parse_event_bounds
+
+                try:
+                    parse_event_bounds(file_bounds, file_reports.shape[1])
+                except ValueError as exc:
+                    ap.error(f"--bounds: {exc}")
+            _run_demo(f"Reports from {args.file}", file_reports,
+                      file_bounds, args)
     if args.example:
         _run_demo("Example (dense binary)", EXAMPLE_REPORTS, None, args)
     if args.missing:

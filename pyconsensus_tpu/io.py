@@ -27,7 +27,8 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["save_reports", "load_reports", "load_reports_sharded"]
+__all__ = ["save_reports", "load_reports", "load_reports_sharded",
+           "csv_to_npy"]
 
 
 def save_reports(path, reports) -> pathlib.Path:
@@ -105,24 +106,11 @@ def _csv_read_fallback(path) -> np.ndarray:
             if header_left > 0:
                 header_left -= 1
                 continue
-            vals = []
-            for tok in line.split(","):
-                tok = tok.strip()
-                if tok.lower() in _NA_TOKENS:
-                    vals.append(np.nan)
-                    continue
-                # bare float() is LOOSER than the native std::from_chars
-                # grammar (it takes '1_5', unicode digits); gate on the
-                # exact grammar first so both parsers accept the same files
-                if not _FLOAT_GRAMMAR.match(tok):
-                    raise ValueError(f"{path}: bad field or ragged row at "
-                                     f"data row {data_row}")
-                try:
-                    vals.append(float(tok))
-                except ValueError:
-                    raise ValueError(
-                        f"{path}: bad field or ragged row at data row "
-                        f"{data_row}") from None
+            # bare float() is LOOSER than the native std::from_chars
+            # grammar (it takes '1_5', unicode digits); _parse_csv_row
+            # gates on the exact grammar so both parsers accept the same
+            # files
+            vals = _parse_csv_row(line, path, data_row)
             if width < 0:
                 width = len(vals)
             elif len(vals) != width:
@@ -133,6 +121,101 @@ def _csv_read_fallback(path) -> np.ndarray:
     if not rows:
         raise ValueError(f"{path}: not a readable, non-empty CSV")
     return np.asarray(rows, dtype=np.float64)
+
+
+def _parse_csv_row(line: str, path, data_row: int) -> list:
+    """One CSV data line -> list of floats (NaN for NA markers), with the
+    native loader's strict field contract and error message."""
+    vals = []
+    for tok in line.split(","):
+        tok = tok.strip()
+        if tok.lower() in _NA_TOKENS:
+            vals.append(np.nan)
+            continue
+        if not _FLOAT_GRAMMAR.match(tok):
+            raise ValueError(f"{path}: bad field or ragged row at "
+                             f"data row {data_row}")
+        vals.append(float(tok))
+    return vals
+
+
+def csv_to_npy(src, dst=None, chunk_rows: int = 4096) -> pathlib.Path:
+    """Stage a ``.csv`` reports file into an ``.npy`` file **incrementally**:
+    peak host memory is one ``chunk_rows`` x E block, never the full matrix
+    — the ingestion step that lets :func:`streaming_consensus` (and
+    :func:`load_reports_sharded`) consume text files bigger than host RAM.
+
+    Field/NA/header semantics and error messages are identical to
+    :func:`load_reports`'s CSV contract (the whole-file parsers — native or
+    fallback — produce the same matrix). Two text passes: one to count data
+    rows (the ``.npy`` header needs the shape up front), one to parse into
+    the open memmap. ``dst`` defaults to ``src`` with an ``.npy`` suffix.
+    Returns ``dst``.
+    """
+    src = pathlib.Path(src)
+    if src.suffix != ".csv":
+        raise ValueError(f"{src}: csv_to_npy stages .csv files")
+    dst = pathlib.Path(dst) if dst is not None else src.with_suffix(".npy")
+    if chunk_rows < 1:
+        raise ValueError("chunk_rows must be >= 1")
+
+    skip = _csv_header_lines(src)
+    n_rows = 0
+    width = -1
+    with open(src) as f:
+        header_left = skip
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if header_left > 0:
+                header_left -= 1
+                continue
+            if width < 0:
+                width = len(line.split(","))
+            n_rows += 1
+    if n_rows == 0:
+        raise ValueError(f"{src}: not a readable, non-empty CSV")
+
+    out = np.lib.format.open_memmap(dst, mode="w+", dtype=np.float64,
+                                    shape=(n_rows, width))
+    try:
+        # parse straight into a preallocated float64 block: a Python
+        # list-of-lists chunk costs ~4x the block in PyFloat objects,
+        # which at wide-E scale is the difference between fitting the
+        # documented one-block budget and an OOM
+        buf = np.empty((min(chunk_rows, n_rows), width), dtype=np.float64)
+        fill = 0
+        base = 0
+        with open(src) as f:
+            header_left = skip
+            data_row = 0
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                if header_left > 0:
+                    header_left -= 1
+                    continue
+                vals = _parse_csv_row(line, src, data_row)
+                if len(vals) != width:
+                    raise ValueError(f"{src}: bad field or ragged row at "
+                                     f"data row {data_row}")
+                buf[fill] = vals
+                fill += 1
+                data_row += 1
+                if fill == buf.shape[0]:
+                    out[base:base + fill] = buf[:fill]
+                    base += fill
+                    fill = 0
+        if fill:
+            out[base:base + fill] = buf[:fill]
+        out.flush()
+    except Exception:
+        del out
+        dst.unlink(missing_ok=True)
+        raise
+    return dst
 
 
 def load_reports(path, mmap: bool = False) -> np.ndarray:

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -244,15 +245,48 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     """Resolve an oracle whose reports matrix never fits on device.
 
     ``reports_src``: numpy array / ``np.memmap`` / path to an ``.npy``
-    file (loaded memory-mapped). Returns the light result dict as host
-    numpy arrays. See the module docstring for the pass structure
-    (``executed iterations + 1``) and restrictions.
+    file (loaded memory-mapped) or a ``.csv`` file (staged incrementally
+    to a temporary ``.npy`` beside it via :func:`..io.csv_to_npy` —
+    chunked parse, so peak host memory stays one row-chunk even for text
+    files bigger than RAM; the staging file is removed after resolution).
+    Returns the light result dict as host numpy arrays. See the module
+    docstring for the pass structure (``executed iterations + 1``) and
+    restrictions.
     """
+    staged = None
     if isinstance(reports_src, (str, bytes)) or hasattr(reports_src,
                                                         "__fspath__"):
-        from ..io import load_reports
+        import pathlib
+        import tempfile
 
-        reports_src = load_reports(reports_src, mmap=True)
+        from ..io import csv_to_npy, load_reports
+
+        src_path = pathlib.Path(
+            reports_src if not isinstance(reports_src, bytes)
+            else reports_src.decode())
+        if src_path.suffix == ".csv":
+            # a per-call unique temp file: a fixed name beside the source
+            # would let two concurrent resolutions of the same CSV truncate
+            # each other's staging mid-mmap, and fails for read-only data
+            # directories
+            fd, name = tempfile.mkstemp(suffix=".npy",
+                                        prefix=f"{src_path.stem}-stage-")
+            os.close(fd)
+            staged = pathlib.Path(name)
+            csv_to_npy(src_path, staged)
+            reports_src = load_reports(staged, mmap=True)
+        else:
+            reports_src = load_reports(reports_src, mmap=True)
+    try:
+        return _streaming_consensus_impl(reports_src, reputation,
+                                         event_bounds, panel_events, params)
+    finally:
+        if staged is not None:
+            staged.unlink(missing_ok=True)
+
+
+def _streaming_consensus_impl(reports_src, reputation, event_bounds,
+                              panel_events, params):
     if reports_src.ndim != 2:
         raise ValueError(f"reports must be 2-D, got {reports_src.shape}")
     R, E = reports_src.shape

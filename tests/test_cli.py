@@ -85,6 +85,70 @@ class TestCli:
             main(["--file", "/nonexistent/x.npy", "--stream"])
         assert "--stream" in capsys.readouterr().err
 
+    def test_stream_csv_file(self, capsys, tmp_path, rng):
+        """--stream on a .csv source stages in row chunks and resolves."""
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=12, E=10, liars=3,
+                                       na_frac=0.1)
+        path = str(save_reports(tmp_path / "r.csv", reports))
+        assert main(["--file", path, "--stream",
+                     "--panel-events", "4"]) == 0
+        assert "Streaming resolution" in capsys.readouterr().out
+        assert [f for f in tmp_path.iterdir() if "stage" in f.name] == []
+
+    def test_file_with_bounds(self, capsys, tmp_path, rng):
+        """--bounds JSON sidecar: scaled outcomes come back un-rescaled."""
+        import json
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=10, E=4, liars=3)
+        reports[:, 3] = reports[:, 3] * 400.0 + 100.0     # into [100, 500]
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        bounds = [None, None, None,
+                  {"scaled": True, "min": 100.0, "max": 500.0}]
+        bpath = tmp_path / "bounds.json"
+        bpath.write_text(json.dumps(bounds))
+        assert main(["--file", path, "--bounds", str(bpath)]) == 0
+        out = capsys.readouterr().out
+        # the scaled event's outcome is in original units, not [0, 1]
+        last_event_line = [l for l in out.splitlines()
+                          if l.strip().startswith("3 ")][-1]
+        assert any(float(tok) > 1.0 for tok in last_event_line.split()[1:3])
+
+    def test_stream_with_bounds(self, capsys, tmp_path, rng):
+        import json
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=10, E=4, liars=3)
+        reports[:, 3] = reports[:, 3] * 400.0 + 100.0
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        bounds = [None, None, None,
+                  {"scaled": True, "min": 100.0, "max": 500.0}]
+        bpath = tmp_path / "bounds.json"
+        bpath.write_text(json.dumps(bounds))
+        assert main(["--file", path, "--stream", "--bounds", str(bpath),
+                     "--panel-events", "2"]) == 0
+        assert "(+1 scaled)" in capsys.readouterr().out
+
+    def test_bounds_validation(self, capsys, tmp_path):
+        import json
+        with pytest.raises(SystemExit):
+            main(["--bounds", "b.json"])          # requires --file
+        bpath = tmp_path / "bounds.json"
+        bpath.write_text(json.dumps({"not": "a list"}))
+        with pytest.raises(SystemExit):
+            main(["--file", "r.npy", "--bounds", str(bpath)])
+        assert "JSON list" in capsys.readouterr().err
+        # wrong entry count against a real file
+        import numpy as np
+        from pyconsensus_tpu.io import save_reports
+        path = str(save_reports(tmp_path / "r.npy", np.eye(3)))
+        bpath.write_text(json.dumps([None]))
+        with pytest.raises(SystemExit):
+            main(["--file", path, "--bounds", str(bpath)])
+        assert "entries" in capsys.readouterr().err
+
     def test_bad_flag_exits_nonzero(self):
         with pytest.raises(SystemExit):
             main(["--algorithm", "nope"])

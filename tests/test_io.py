@@ -8,8 +8,8 @@ import pytest
 import jax
 
 from pyconsensus_tpu import Oracle, _native
-from pyconsensus_tpu.io import (load_reports, load_reports_sharded,
-                                save_reports)
+from pyconsensus_tpu.io import (csv_to_npy, load_reports,
+                                load_reports_sharded, save_reports)
 from pyconsensus_tpu.models.pipeline import ConsensusParams
 from pyconsensus_tpu.parallel import make_mesh, sharded_consensus
 
@@ -150,6 +150,65 @@ def test_csv_bad_field_rejected(tmp_path):
     p.write_text("1,2,3\n4,bogus,6\n")
     with pytest.raises(ValueError, match="row 1"):
         _native.csv_read(p)
+
+
+class TestCsvToNpy:
+    def test_matches_whole_file_parse(self, tmp_path, matrix):
+        """Chunked staging produces the exact matrix the whole-file CSV
+        parsers produce, at every chunk size (incl. chunk > rows and a
+        ragged final chunk)."""
+        p = save_reports(tmp_path / "r.csv", matrix)
+        whole = load_reports(p)
+        for chunk_rows in (1, 5, 17, 100):
+            dst = csv_to_npy(p, tmp_path / f"s{chunk_rows}.npy",
+                             chunk_rows=chunk_rows)
+            np.testing.assert_array_equal(np.load(dst), whole)
+
+    def test_default_dst_and_header(self, tmp_path):
+        p = tmp_path / "r.csv"
+        p.write_text("a,b\n1.0,NA\n0.5,0.0\n")
+        dst = csv_to_npy(p)
+        assert dst == tmp_path / "r.npy"
+        out = np.load(dst)
+        assert out.shape == (2, 2)
+        assert np.isnan(out[0, 1])
+
+    def test_bad_field_cleans_up(self, tmp_path):
+        p = tmp_path / "r.csv"
+        p.write_text("1.0,2.0\n1.0,bogus\n")
+        with pytest.raises(ValueError, match="data row 1"):
+            csv_to_npy(p, tmp_path / "out.npy")
+        assert not (tmp_path / "out.npy").exists()
+
+    def test_ragged_row_rejected(self, tmp_path):
+        p = tmp_path / "r.csv"
+        p.write_text("1.0,2.0\n1.0\n")
+        with pytest.raises(ValueError, match="data row 1"):
+            csv_to_npy(p, tmp_path / "out.npy")
+
+    def test_rejects_non_csv_and_empty(self, tmp_path):
+        with pytest.raises(ValueError, match="stages .csv"):
+            csv_to_npy(tmp_path / "r.npy")
+        p = tmp_path / "empty.csv"
+        p.write_text("header_a,header_b\n")
+        with pytest.raises(ValueError, match="non-empty"):
+            csv_to_npy(p)
+
+
+def test_streaming_from_csv(tmp_path, rng):
+    """streaming_consensus on a .csv source: staged in row chunks, outcomes
+    identical to the in-memory resolution, staging file removed."""
+    from conftest import collusion_reports
+    from pyconsensus_tpu.parallel import streaming_consensus
+
+    reports, _ = collusion_reports(rng, R=14, E=11, liars=4, na_frac=0.1)
+    p = save_reports(tmp_path / "big.csv", reports)
+    out = streaming_consensus(p, panel_events=4)
+    ref = Oracle(reports=reports, backend="jax").consensus()
+    np.testing.assert_array_equal(out["outcomes_final"],
+                                  ref["events"]["outcomes_final"])
+    leftovers = [f for f in tmp_path.iterdir() if "stage" in f.name]
+    assert leftovers == []
 
 
 def test_unknown_suffix(tmp_path, matrix):
