@@ -2,11 +2,13 @@
 oracle resolutions as one vmap-batched XLA call, plus plotting helpers for
 the sweep results."""
 
-from .collusion import (CollusionSimulator, RoundsSimulator,
+from .collusion import (CollusionSimulator, RoundsSimulator, flat_grid,
                         generate_reports, simulate_grid)
 from .plots import (plot_retention_curves, plot_round_trajectories,
                     plot_sweep_heatmap, save_sweep_report)
+from .runner import CheckpointedSweep
 
 __all__ = ["CollusionSimulator", "RoundsSimulator", "generate_reports",
-           "simulate_grid", "plot_sweep_heatmap", "plot_retention_curves",
+           "simulate_grid", "flat_grid", "CheckpointedSweep",
+           "plot_sweep_heatmap", "plot_retention_curves",
            "plot_round_trajectories", "save_sweep_report"]

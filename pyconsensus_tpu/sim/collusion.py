@@ -99,6 +99,33 @@ def _trial_metrics(key, liar_fraction, variance, *, n_reporters: int,
     }
 
 
+def flat_grid(liar_fractions, variances, n_trials: int):
+    """The flattened (liar_fraction × variance × trial) sweep grid in the
+    canonical layout (trial-major: flat index ``i = (l*V + v)*T + t``) —
+    the single definition shared by :meth:`CollusionSimulator.run` and the
+    checkpointed sweep runner, so a chunked/resumed sweep reproduces a
+    monolithic one bit-for-bit."""
+    lf = np.asarray(liar_fractions, dtype=np.float64)
+    var = np.asarray(variances, dtype=np.float64)
+    L, V, T = len(lf), len(var), int(n_trials)
+    if L < 1 or V < 1 or T < 1:
+        raise ValueError("liar_fractions, variances, and n_trials must "
+                         "all be non-empty/positive")
+    grid_lf = np.repeat(lf, V * T)
+    grid_var = np.tile(np.repeat(var, T), L)
+    return lf, var, grid_lf, grid_var
+
+
+def _fold_keys(seed: int, indices):
+    """Per-trial PRNG keys: ``fold_in(key(seed), flat_index)`` — a pure
+    function of the GLOBAL flat index, so any slice of the grid can be
+    computed independently (the checkpointed runner's correctness
+    contract)."""
+    base = jax.random.key(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.asarray(indices))
+
+
 class CollusionSimulator:
     """Batched Monte-Carlo collusion sweeps.
 
@@ -155,17 +182,10 @@ class CollusionSimulator:
         (L, V, T, ...) for metrics with trailing per-trial axes, e.g. the
         per-round trajectories of :class:`RoundsSimulator` — plus ``"mean"``:
         per-cell averages over the trial axis."""
-        lf = np.asarray(liar_fractions, dtype=np.float64)
-        var = np.asarray(variances, dtype=np.float64)
+        lf, var, grid_lf, grid_var = flat_grid(liar_fractions, variances,
+                                               n_trials)
         L, V, T = len(lf), len(var), int(n_trials)
-        if L < 1 or V < 1 or T < 1:
-            raise ValueError("liar_fractions, variances, and n_trials must "
-                             "all be non-empty/positive")
-        grid_lf = np.repeat(lf, V * T)
-        grid_var = np.tile(np.repeat(var, T), L)
-        base = jax.random.key(seed)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(L * V * T))
+        keys = _fold_keys(seed, np.arange(L * V * T))
         out = self._batched(keys, jnp.asarray(grid_lf), jnp.asarray(grid_var))
         result = {}
         for k, v in out.items():

@@ -1,0 +1,196 @@
+"""Fault-tolerant, multi-host Monte-Carlo sweep execution.
+
+The reference's simulator is one long Python loop — a crash loses
+everything, and there is no way to spread a sweep across machines
+(SURVEY.md §5: no failure detection / elastic recovery exists upstream).
+:class:`CheckpointedSweep` is the TPU-native framework's answer, built on
+the same work-sharding + checkpoint + resume pattern as elastic training
+loops:
+
+- the flattened (liar_fraction × variance × trial) grid is split into
+  contiguous **chunks** of flat indices; per-trial PRNG keys are a pure
+  function of the GLOBAL flat index (``collusion._fold_keys``), so every
+  chunk's result is independent of which host computes it, when, or what
+  completed before — a resumed/re-sharded sweep is bit-identical to a
+  monolithic :meth:`CollusionSimulator.run`;
+- each finished chunk is written atomically (tmp file + rename) to a
+  shared checkpoint directory; a crashed host loses at most the chunk it
+  was computing;
+- hosts claim chunks round-robin by rank (``host_id``/``n_hosts`` —
+  defaults read ``jax.process_index``/``process_count``, so a
+  ``jax.distributed``-initialized multi-host job shards automatically);
+  any host (or a fresh process after ALL hosts died) can finish the
+  leftovers with ``run(host_id=0, n_hosts=1)``;
+- :meth:`gather` merges the chunk files into exactly the result dict
+  :meth:`CollusionSimulator.run` returns (per-metric (L, V, T[, ...])
+  arrays plus per-cell means and annotations).
+
+>>> sweep = CheckpointedSweep(sim, lf, var, n_trials=1000,
+...                           checkpoint_dir="ckpt", seed=0)
+>>> sweep.run()                    # this host's share; crash-safe
+>>> result = sweep.gather()        # == sim.run(lf, var, 1000, seed=0)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .collusion import CollusionSimulator, _fold_keys, flat_grid
+
+__all__ = ["CheckpointedSweep"]
+
+_MANIFEST = "sweep.json"
+
+
+class CheckpointedSweep:
+    """Chunked, checkpointed, host-sharded execution of one simulator sweep.
+
+    Parameters
+    ----------
+    simulator : CollusionSimulator (or subclass, e.g. RoundsSimulator)
+        The batched trial runner; its vmapped program is invoked per chunk.
+    liar_fractions, variances, n_trials, seed :
+        The sweep definition, exactly as :meth:`CollusionSimulator.run`
+        takes it.
+    checkpoint_dir : path
+        Shared directory (shared filesystem for multi-host) for chunk
+        files and the manifest.
+    trials_per_chunk : int
+        Chunk granularity in flat trials (default 1024): the unit of loss
+        on a crash and of re-dispatch on resume. Every chunk but the last
+        has this exact batch size, so resuming re-uses the chunk-sized
+        XLA program from cache.
+    """
+
+    def __init__(self, simulator: CollusionSimulator,
+                 liar_fractions: Sequence[float],
+                 variances: Sequence[float], n_trials: int, seed: int = 0,
+                 checkpoint_dir="sweep-ckpt",
+                 trials_per_chunk: int = 1024) -> None:
+        self.sim = simulator
+        self.lf, self.var, self._grid_lf, self._grid_var = flat_grid(
+            liar_fractions, variances, n_trials)
+        self.n_trials = int(n_trials)
+        self.seed = int(seed)
+        if int(trials_per_chunk) < 1:
+            raise ValueError("trials_per_chunk must be >= 1")
+        self.trials_per_chunk = int(trials_per_chunk)
+        self.total = len(self._grid_lf)
+        self.n_chunks = -(-self.total // self.trials_per_chunk)
+        self.dir = pathlib.Path(checkpoint_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._check_manifest()
+
+    # -- manifest: guard against mixing two different sweeps in one dir ------
+
+    def _manifest(self) -> dict:
+        # the simulator fingerprint matters as much as the grid: chunks
+        # computed by two differently-configured simulators concatenate
+        # without shape errors, so a config mismatch must fail HERE, not
+        # surface as silently mixed results at gather()
+        sim_config = {
+            "class": type(self.sim).__name__,
+            "n_reporters": self.sim.n_reporters,
+            "n_events": self.sim.n_events,
+            "collude": self.sim.collude,
+            "params": dict(self.sim.params._asdict()),   # JSON-stable form
+        }
+        if hasattr(self.sim, "n_rounds"):
+            sim_config["n_rounds"] = self.sim.n_rounds
+        return {
+            "liar_fractions": self.lf.tolist(),
+            "variances": self.var.tolist(),
+            "n_trials": self.n_trials,
+            "seed": self.seed,
+            "trials_per_chunk": self.trials_per_chunk,
+            "simulator": sim_config,
+        }
+
+    def _check_manifest(self) -> None:
+        path = self.dir / _MANIFEST
+        mine = self._manifest()
+        if path.exists():
+            have = json.loads(path.read_text())
+            if have != mine:
+                raise ValueError(
+                    f"{self.dir} holds a different sweep "
+                    f"({have} != {mine}); use a fresh checkpoint_dir")
+        else:
+            tmp = path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(mine))
+            os.replace(tmp, path)
+
+    # -- chunk execution -----------------------------------------------------
+
+    def _chunk_path(self, c: int) -> pathlib.Path:
+        return self.dir / f"chunk_{c:06d}.npz"
+
+    def pending(self) -> list:
+        """Chunk indices not yet checkpointed (by any host)."""
+        return [c for c in range(self.n_chunks)
+                if not self._chunk_path(c).exists()]
+
+    def _run_chunk(self, c: int) -> None:
+        import jax.numpy as jnp
+
+        lo = c * self.trials_per_chunk
+        hi = min(lo + self.trials_per_chunk, self.total)
+        keys = _fold_keys(self.seed, np.arange(lo, hi))
+        out = self.sim._batched(keys, jnp.asarray(self._grid_lf[lo:hi]),
+                                jnp.asarray(self._grid_var[lo:hi]))
+        tmp = self.dir / f"chunk_{c:06d}.tmp.npz"
+        np.savez(tmp, **{k: np.asarray(v) for k, v in out.items()})
+        os.replace(tmp, self._chunk_path(c))   # atomic: all-or-nothing
+
+    def run(self, host_id: Optional[int] = None,
+            n_hosts: Optional[int] = None) -> int:
+        """Compute this host's pending chunks (round-robin assignment:
+        chunk ``c`` belongs to host ``c % n_hosts``). Already-checkpointed
+        chunks — including ones another incarnation of this host wrote
+        before crashing — are skipped. Returns the number of chunks this
+        call computed."""
+        if host_id is None or n_hosts is None:
+            import jax
+
+            host_id = jax.process_index() if host_id is None else host_id
+            n_hosts = jax.process_count() if n_hosts is None else n_hosts
+        if not (0 <= host_id < n_hosts):
+            raise ValueError(f"host_id {host_id} not in [0, {n_hosts})")
+        done = 0
+        for c in self.pending():
+            if c % n_hosts == host_id:
+                self._run_chunk(c)
+                done += 1
+        return done
+
+    # -- result assembly -----------------------------------------------------
+
+    def gather(self) -> dict:
+        """Merge all chunk checkpoints into the monolithic
+        :meth:`CollusionSimulator.run` result dict. Raises if any chunk is
+        missing (run ``run(host_id=0, n_hosts=1)`` first to mop up after
+        lost hosts)."""
+        missing = self.pending()
+        if missing:
+            raise ValueError(f"sweep incomplete: {len(missing)} of "
+                             f"{self.n_chunks} chunks missing "
+                             f"(e.g. {missing[:4]}); call run() to finish")
+        parts: list = []
+        for c in range(self.n_chunks):
+            with np.load(self._chunk_path(c)) as data:
+                parts.append({k: data[k] for k in data.files})
+        L, V, T = len(self.lf), len(self.var), self.n_trials
+        result = {}
+        for k in parts[0]:
+            arr = np.concatenate([p[k] for p in parts], axis=0)
+            result[k] = arr.reshape((L, V, T) + arr.shape[1:])
+        result["mean"] = {k: v.mean(axis=2) for k, v in result.items()}
+        result["liar_fractions"] = self.lf
+        result["variances"] = self.var
+        self.sim._annotate(result)
+        return result

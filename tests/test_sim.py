@@ -171,3 +171,108 @@ class TestRoundsSimulator:
                                   power_iters=16).run([0.0], [0.0], 2)
         with pytest.raises(ValueError, match="per-round"):
             plot_round_trajectories(flat)
+
+
+class TestCheckpointedSweep:
+    """Fault-tolerant sweep runner: chunked execution must be bit-identical
+    to the monolithic run, survive crashes (lost chunks re-run), and shard
+    across hosts deterministically."""
+
+    LF = [0.0, 0.2, 0.4]
+    VAR = [0.0, 0.2]
+    T = 7          # deliberately not a multiple of trials_per_chunk
+
+    def _sim(self):
+        return CollusionSimulator(n_reporters=10, n_events=6,
+                                  max_iterations=2)
+
+    def test_matches_monolithic_run(self, tmp_path):
+        from pyconsensus_tpu.sim import CheckpointedSweep
+        sim = self._sim()
+        mono = sim.run(self.LF, self.VAR, self.T, seed=3)
+        sweep = CheckpointedSweep(sim, self.LF, self.VAR, self.T, seed=3,
+                                  checkpoint_dir=tmp_path / "ck",
+                                  trials_per_chunk=5)
+        assert sweep.run(host_id=0, n_hosts=1) == sweep.n_chunks
+        got = sweep.gather()
+        for key in ("correct_rate", "capture_rate", "liar_rep_share"):
+            np.testing.assert_array_equal(got[key], mono[key], err_msg=key)
+            np.testing.assert_array_equal(got["mean"][key],
+                                          mono["mean"][key], err_msg=key)
+
+    def test_crash_resume(self, tmp_path):
+        from pyconsensus_tpu.sim import CheckpointedSweep
+        sim = self._sim()
+        sweep = CheckpointedSweep(sim, self.LF, self.VAR, self.T, seed=3,
+                                  checkpoint_dir=tmp_path / "ck",
+                                  trials_per_chunk=5)
+        # "crash" after two chunks: compute them, leave the rest
+        for c in sweep.pending()[:2]:
+            sweep._run_chunk(c)
+        with pytest.raises(ValueError, match="incomplete"):
+            sweep.gather()
+        # a fresh process resumes: only the missing chunks run
+        resumed = CheckpointedSweep(sim, self.LF, self.VAR, self.T, seed=3,
+                                    checkpoint_dir=tmp_path / "ck",
+                                    trials_per_chunk=5)
+        assert resumed.run(host_id=0, n_hosts=1) == resumed.n_chunks - 2
+        got = resumed.gather()
+        mono = sim.run(self.LF, self.VAR, self.T, seed=3)
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+
+    def test_multi_host_sharding(self, tmp_path):
+        from pyconsensus_tpu.sim import CheckpointedSweep
+        sim = self._sim()
+        sweep = CheckpointedSweep(sim, self.LF, self.VAR, self.T, seed=3,
+                                  checkpoint_dir=tmp_path / "ck",
+                                  trials_per_chunk=4)
+        # three hosts run their round-robin shares (any order / interleaving)
+        counts = [sweep.run(host_id=h, n_hosts=3) for h in (2, 0, 1)]
+        assert sum(counts) == sweep.n_chunks
+        assert sweep.pending() == []
+        got = sweep.gather()
+        mono = sim.run(self.LF, self.VAR, self.T, seed=3)
+        np.testing.assert_array_equal(got["liar_rep_share"],
+                                      mono["liar_rep_share"])
+
+    def test_rounds_simulator_trajectories(self, tmp_path):
+        from pyconsensus_tpu.sim import CheckpointedSweep, RoundsSimulator
+        sim = RoundsSimulator(n_rounds=3, n_reporters=8, n_events=5)
+        sweep = CheckpointedSweep(sim, [0.0, 0.3], [0.1], 5, seed=1,
+                                  checkpoint_dir=tmp_path / "ck",
+                                  trials_per_chunk=3)
+        sweep.run(host_id=0, n_hosts=1)
+        got = sweep.gather()
+        mono = sim.run([0.0, 0.3], [0.1], 5, seed=1)
+        assert got["correct_rate"].shape == (2, 1, 5, 3)   # trailing rounds
+        assert got["n_rounds"] == 3
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+
+    def test_manifest_guards_mixed_sweeps(self, tmp_path):
+        from pyconsensus_tpu.sim import CheckpointedSweep
+        sim = self._sim()
+        CheckpointedSweep(sim, self.LF, self.VAR, self.T, seed=3,
+                          checkpoint_dir=tmp_path / "ck")
+        with pytest.raises(ValueError, match="different sweep"):
+            CheckpointedSweep(sim, self.LF, self.VAR, self.T, seed=4,
+                              checkpoint_dir=tmp_path / "ck")
+        # a differently-configured SIMULATOR must be rejected too — its
+        # chunks would concatenate without shape errors and silently mix
+        other_sim = CollusionSimulator(n_reporters=10, n_events=6,
+                                       max_iterations=3)
+        with pytest.raises(ValueError, match="different sweep"):
+            CheckpointedSweep(other_sim, self.LF, self.VAR, self.T, seed=3,
+                              checkpoint_dir=tmp_path / "ck")
+
+    def test_validation(self, tmp_path):
+        from pyconsensus_tpu.sim import CheckpointedSweep
+        sweep = CheckpointedSweep(self._sim(), self.LF, self.VAR, self.T,
+                                  checkpoint_dir=tmp_path / "ck")
+        with pytest.raises(ValueError, match="host_id"):
+            sweep.run(host_id=5, n_hosts=2)
+        with pytest.raises(ValueError, match="trials_per_chunk"):
+            CheckpointedSweep(self._sim(), self.LF, self.VAR, self.T,
+                              checkpoint_dir=tmp_path / "ck2",
+                              trials_per_chunk=0)
