@@ -16,6 +16,16 @@ reports matrix, which makes it numerically irreproducible across backends.
 
 The extracted component's scores feed the same direction-fix /
 ``row_reward_weighted`` machinery as PCA scores.
+
+**Convergence contract.** The loop stops once successive iterates align to
+``|<w_k+1, w_k>| >= 1 - tol`` (sign-insensitive — FastICA fixed points are
+defined up to sign). If ``ICA_ITERS`` pass without convergence the
+iteration is chaotic for this matrix (measured: a 4e-15 perturbation of
+the whitened basis moved the iterate-128 result by 3e-3) — there is no
+stable most-non-Gaussian direction, and returning the wandering iterate
+would make results irreproducible across backends/hardware. Both backends
+then fall back deterministically to the first whitened component (the
+dominant-variance direction the iteration started from).
 """
 
 from __future__ import annotations
@@ -31,6 +41,14 @@ __all__ = ["ica_scores_np", "ica_scores_jax", "ICA_ITERS"]
 
 ICA_ITERS = 128
 _EPS = 1e-12
+
+
+def _conv_tol(dtype) -> float:
+    """Alignment tolerance for the convergence test: 1e-12 in f64; scaled
+    to machine precision in lower-precision arithmetic (an f32 fixed point
+    cannot align past ~32 eps, and a tolerance it can never meet would
+    turn every f32 resolution into the fallback)."""
+    return max(1e-12, 32.0 * float(np.finfo(np.dtype(dtype)).eps))
 
 
 def _canon_signs_np(Z):
@@ -51,16 +69,25 @@ def ica_scores_np(reports_filled, reputation, max_components):
     std = np.sqrt(np.clip(np.var(scores, axis=0), _EPS, None))
     Z = _canon_signs_np(scores / std[None, :])         # (R, k) whitened
     R = Z.shape[0]
-    w = np.zeros(k)
-    w[0] = 1.0                                         # start at first PC
+    tol = _conv_tol(Z.dtype)
+    w0 = np.zeros(k)
+    w0[0] = 1.0                                        # start at first PC
+    w = w0
+    converged = False
     for _ in range(ICA_ITERS):
         s = Z @ w                                      # (R,)
         g = np.tanh(s)
         g_prime = 1.0 - g ** 2
         w_new = (Z.T @ g) / R - g_prime.mean() * w
         norm = np.linalg.norm(w_new)
-        if norm > _EPS:
-            w = w_new / norm
+        w_next = w_new / norm if norm > _EPS else w
+        align = abs(float(np.dot(w_next, w)))
+        w = w_next
+        if align >= 1.0 - tol:
+            converged = True
+            break
+    if not converged:                # chaotic case: see module docstring
+        w = w0
     s = Z @ w
     return nk.direction_fixed_scores(s, reports_filled, reputation)
 
@@ -81,16 +108,27 @@ def ica_scores_jax(reports_filled, reputation, max_components, pca_method="auto"
     std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
     Z = _canon_signs_jax(scores / std[None, :])
     R = Z.shape[0]
+    tol = _conv_tol(Z.dtype)
     w0 = jnp.zeros((k,), dtype=Z.dtype).at[0].set(1.0)
 
-    def body(_, w):
+    def cond(state):
+        i, _, done = state
+        return (i < ICA_ITERS) & ~done
+
+    def body(state):
+        i, w, _ = state
         s = Z @ w
         g = jnp.tanh(s)
         g_prime = 1.0 - g ** 2
         w_new = (Z.T @ g) / R - jnp.mean(g_prime) * w
         norm = jnp.linalg.norm(w_new)
-        return jnp.where(norm > _EPS, w_new / jnp.where(norm > _EPS, norm, 1.0), w)
+        w_next = jnp.where(norm > _EPS,
+                           w_new / jnp.where(norm > _EPS, norm, 1.0), w)
+        done = jnp.abs(jnp.vdot(w_next, w)) >= 1.0 - tol
+        return i + 1, w_next, done
 
-    w = lax.fori_loop(0, ICA_ITERS, body, w0)
+    _, w, converged = lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), w0, jnp.asarray(False)))
+    w = jnp.where(converged, w, w0)  # chaotic case: see module docstring
     s = Z @ w
     return jk.direction_fixed_scores(s, reports_filled, reputation)

@@ -80,14 +80,14 @@ def test_invariants_hold(seed):
         np.asarray(results["numpy"]["events"]["outcomes_final"])[~scaled],
         np.asarray(results["jax"]["events"]["outcomes_final"])[~scaled],
         err_msg=str(kwargs))
-    # ICA is an iterated nonlinear fixed point: tiny rounding differences
-    # between backends amplify along the iteration, so its reputation
-    # tolerance is looser (outcomes above are still bit-identical)
-    rep_atol = 5e-3 if kwargs["algorithm"] == "ica" else 5e-6
+    # one tolerance for every algorithm: ICA's convergence-or-fallback
+    # contract (models/ica.py) makes even its iterated nonlinear fixed
+    # point reproducible across backends — chaotic cases fall back to the
+    # first whitened component instead of returning a wandering iterate
     np.testing.assert_allclose(
         np.asarray(results["jax"]["agents"]["smooth_rep"], dtype=float),
         np.asarray(results["numpy"]["agents"]["smooth_rep"], dtype=float),
-        atol=rep_atol, err_msg=str(kwargs))
+        atol=5e-6, err_msg=str(kwargs))
     # determinism: resolving again reproduces the jax result exactly
     again = Oracle(reports=reports, event_bounds=bounds,
                    reputation=reputation, backend="jax",
