@@ -1,0 +1,80 @@
+"""Fault-tolerant sweep + out-of-core CSV ingestion.
+
+Part 1 — CheckpointedSweep: a Monte-Carlo sweep split into chunks with
+atomic checkpoints. We simulate a crash halfway through, "restart", and
+show the resumed sweep (a) only re-runs the missing chunks and (b) is
+bit-identical to a monolithic run. On a real multi-host job every host
+calls ``sweep.run()`` (chunk assignment comes from ``jax.process_index``)
+against a shared checkpoint directory.
+
+Part 2 — streaming a CSV that "doesn't fit": reports land in a .csv,
+``streaming_consensus`` stages it to .npy in row chunks and resolves
+panel by panel — peak memory is one chunk/panel, never the matrix.
+
+Run:  python examples/fault_tolerant_sweep.py [workdir]
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+
+workdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp()
+ckdir = os.path.join(workdir, "sweep-ck")
+
+liar_fractions = [0.0, 0.2, 0.4]
+variances = [0.0, 0.1]
+n_trials = 50
+
+sim = CollusionSimulator(n_reporters=24, n_events=10, max_iterations=2)
+sweep = CheckpointedSweep(sim, liar_fractions, variances, n_trials, seed=7,
+                          checkpoint_dir=ckdir, trials_per_chunk=64)
+print(f"sweep: {sweep.total} trials in {sweep.n_chunks} chunks -> {ckdir}")
+
+# compute a couple of chunks, then "crash"
+for c in sweep.pending()[:2]:
+    sweep._run_chunk(c)
+print(f"crashed after 2 chunks; {len(sweep.pending())} left on disk to do")
+
+# a fresh process resumes: same definition, same directory
+resumed = CheckpointedSweep(sim, liar_fractions, variances, n_trials,
+                            seed=7, checkpoint_dir=ckdir,
+                            trials_per_chunk=64)
+ran = resumed.run(host_id=0, n_hosts=1)
+print(f"resume ran {ran} chunks (only the missing ones)")
+
+got = resumed.gather()
+mono = sim.run(liar_fractions, variances, n_trials, seed=7)
+assert np.array_equal(got["correct_rate"], mono["correct_rate"])
+print("gathered result is bit-identical to a monolithic run")
+print("correct-outcome rate (rows = liar fraction):")
+for i, lf in enumerate(liar_fractions):
+    cells = "  ".join(f"{got['mean']['correct_rate'][i, j]:.3f}"
+                      for j in range(len(variances)))
+    print(f"  {lf:.1f}:  {cells}")
+
+# ---- part 2: stream a CSV bigger than you'd want in RAM ----------------
+from pyconsensus_tpu.io import save_reports
+from pyconsensus_tpu.parallel import streaming_consensus
+
+rng = np.random.default_rng(0)
+truth = rng.choice([0.0, 1.0], size=400)
+reports = np.tile(truth, (60, 1))
+reports[:45] = np.abs(reports[:45] - (rng.random((45, 400)) < 0.1))
+reports[45:] = 1.0 - truth                      # 15 colluding liars
+reports[rng.random(reports.shape) < 0.05] = np.nan
+
+csv_path = os.path.join(workdir, "reports.csv")
+save_reports(csv_path, reports)
+print(f"\nstreaming {csv_path} ({os.path.getsize(csv_path)//1024} KB) "
+      "in 64-event panels...")
+out = streaming_consensus(csv_path, panel_events=64)
+correct = float(np.mean(out["outcomes_final"] == truth))
+print(f"resolved {len(truth)} events out-of-core; "
+      f"correct-outcome rate {correct:.3f}; "
+      f"liar reputation share "
+      f"{float(out['smooth_rep'][45:].sum()):.4f}")

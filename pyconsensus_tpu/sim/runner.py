@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import tempfile
 from typing import Optional, Sequence
 
 import numpy as np
@@ -86,6 +87,27 @@ class CheckpointedSweep:
         self.dir.mkdir(parents=True, exist_ok=True)
         self._check_manifest()
 
+    def _write_atomic(self, final: pathlib.Path, writer,
+                      suffix: str = ".tmp") -> None:
+        """All-or-nothing file creation safe against CONCURRENT writers of
+        ``final`` (several hosts racing on a shared checkpoint dir, or a
+        mop-up process overlapping a restarted host on the same chunk):
+        each writer gets its own ``mkstemp``-unique tmp in the target
+        directory — pids alone are not unique across hosts — and the
+        atomic rename makes last-writer-wins harmless because racers
+        write identical content by construction."""
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=suffix)
+        os.close(fd)
+        try:
+            writer(tmp)
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            raise
+
     # -- manifest: guard against mixing two different sweeps in one dir ------
 
     def _manifest(self) -> dict:
@@ -121,9 +143,8 @@ class CheckpointedSweep:
                     f"{self.dir} holds a different sweep "
                     f"({have} != {mine}); use a fresh checkpoint_dir")
         else:
-            tmp = path.with_suffix(".tmp")
-            tmp.write_text(json.dumps(mine))
-            os.replace(tmp, path)
+            self._write_atomic(
+                path, lambda t: pathlib.Path(t).write_text(json.dumps(mine)))
 
     # -- chunk execution -----------------------------------------------------
 
@@ -143,9 +164,9 @@ class CheckpointedSweep:
         keys = _fold_keys(self.seed, np.arange(lo, hi))
         out = self.sim._batched(keys, jnp.asarray(self._grid_lf[lo:hi]),
                                 jnp.asarray(self._grid_var[lo:hi]))
-        tmp = self.dir / f"chunk_{c:06d}.tmp.npz"
-        np.savez(tmp, **{k: np.asarray(v) for k, v in out.items()})
-        os.replace(tmp, self._chunk_path(c))   # atomic: all-or-nothing
+        host = {k: np.asarray(v) for k, v in out.items()}
+        self._write_atomic(self._chunk_path(c),
+                           lambda t: np.savez(t, **host), suffix=".tmp.npz")
 
     def run(self, host_id: Optional[int] = None,
             n_hosts: Optional[int] = None) -> int:

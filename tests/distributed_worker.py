@@ -58,3 +58,17 @@ smooth = np.asarray(out["smooth_rep"])          # replicated -> addressable
 print("RESULT", ",".join(f"{float(v):g}" for v in np.ravel(outcomes)),
       flush=True)
 print("REP", ",".join(f"{float(v):.6f}" for v in smooth), flush=True)
+
+# optional phase 2: each process computes ITS round-robin share of one
+# checkpointed sweep into a shared directory (host_id/n_hosts default to
+# jax.process_index/process_count) — the real multi-host story for
+# sim.CheckpointedSweep, chunks crossing no process boundary at all
+if len(sys.argv) > 3:
+    from pyconsensus_tpu.sim import (CheckpointedSweep,  # noqa: E402
+                                     CollusionSimulator)
+
+    sim = CollusionSimulator(n_reporters=8, n_events=5, max_iterations=1)
+    sweep = CheckpointedSweep(sim, [0.0, 0.3], [0.1], 6, seed=2,
+                              checkpoint_dir=sys.argv[3],
+                              trials_per_chunk=4)
+    print("SWEEP", sweep.run(), flush=True)

@@ -40,11 +40,12 @@ def _worker_env() -> dict:
     return env
 
 
-def test_two_process_global_mesh():
+def test_two_process_global_mesh(tmp_path):
     port = _free_port()
     env = _worker_env()
+    ckdir = tmp_path / "sweep-ck"
     procs = [subprocess.Popen([sys.executable, str(_WORKER), str(i),
-                               str(port)],
+                               str(port), str(ckdir)],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True, env=env)
              for i in range(2)]
@@ -83,3 +84,18 @@ def test_two_process_global_mesh():
     np.testing.assert_array_equal(res0,
                                   ref["events"]["outcomes_adjusted"])
     np.testing.assert_allclose(rep0, ref["agents"]["smooth_rep"], atol=1e-5)
+
+    # phase 2: the two processes split one CheckpointedSweep round-robin
+    # (host_id from jax.process_index); the merged result must equal a
+    # monolithic single-process run
+    from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+    counts = [int(parse("SWEEP", o)[0]) for o in outputs]
+    sim = CollusionSimulator(n_reporters=8, n_events=5, max_iterations=1)
+    sweep = CheckpointedSweep(sim, [0.0, 0.3], [0.1], 6, seed=2,
+                              checkpoint_dir=ckdir, trials_per_chunk=4)
+    assert sum(counts) == sweep.n_chunks
+    assert sweep.pending() == []
+    got = sweep.gather()
+    mono = sim.run([0.0, 0.3], [0.1], 6, seed=2)
+    np.testing.assert_array_equal(got["correct_rate"],
+                                  mono["correct_rate"])
