@@ -27,7 +27,8 @@ from typing import NamedTuple, Optional
 import jax
 import numpy as np
 
-from ..models.pipeline import ConsensusParams, consensus_light_jit
+from ..models.pipeline import (JIT_ALGORITHMS, ConsensusParams,
+                               consensus_light_jit)
 from ..oracle import Oracle, assemble_result, parse_event_bounds
 from .mesh import Mesh, event_sharding, make_mesh, replicated
 
@@ -271,10 +272,13 @@ class ShardedOracle(Oracle):
         super().__init__(*args, **kwargs)
         if self.backend != "jax":
             raise ValueError("ShardedOracle requires backend='jax'")
-        if self.params.algorithm not in ("sztorc", "fixed-variance", "ica"):
-            raise ValueError("sharded resolution supports the PCA/ICA "
-                             "algorithms (clustering shards over batch via "
-                             "the simulator instead)")
+        if self.params.algorithm not in JIT_ALGORITHMS:
+            raise ValueError(
+                "sharded resolution supports the jit algorithms "
+                f"{JIT_ALGORITHMS}: the hybrid host-clustering variants "
+                "(hierarchical/dbscan) need a host step between device "
+                "phases — run them unsharded, or shard over batch via the "
+                "simulator")
         self.mesh = mesh if mesh is not None else make_mesh(batch=1)
         self.params = self.params._replace(
             pca_method=_pick_pca_method(self.params, self.reports.shape[0],

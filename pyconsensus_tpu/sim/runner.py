@@ -97,8 +97,14 @@ class CheckpointedSweep:
         atomic rename makes last-writer-wins harmless because racers
         write identical content by construction."""
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=suffix)
-        os.close(fd)
         try:
+            # mkstemp creates 0600 and os.replace preserves it — restore
+            # umask-based permissions so a different account (gather /
+            # mop-up on a shared filesystem) can read the installed file
+            umask = os.umask(0)
+            os.umask(umask)
+            os.fchmod(fd, 0o666 & ~umask)
+            os.close(fd)
             writer(tmp)
             os.replace(tmp, final)
         except BaseException:
@@ -107,6 +113,27 @@ class CheckpointedSweep:
             except FileNotFoundError:
                 pass
             raise
+
+    #: tmp files older than this are orphans from hard-killed writers
+    #: (no Python-level except ran); any entry point may reap them
+    _TMP_MAX_AGE_S = 3600.0
+
+    def _reap_stale_tmps(self) -> None:
+        """Remove orphaned ``*.tmp*`` files left by writers that died
+        between mkstemp and the atomic rename (SIGKILL/power loss — the
+        exception cleanup never ran, and the next retry gets a fresh
+        unique name, so orphans would otherwise accumulate forever under
+        a crash loop). Age-gated so a live host's in-flight tmp is never
+        touched."""
+        import time
+
+        cutoff = time.time() - self._TMP_MAX_AGE_S
+        for f in self.dir.glob("tmp*.tmp*"):
+            try:
+                if f.stat().st_mtime < cutoff:
+                    f.unlink()
+            except OSError:
+                pass                      # already reaped by another host
 
     # -- manifest: guard against mixing two different sweeps in one dir ------
 
@@ -182,6 +209,7 @@ class CheckpointedSweep:
             n_hosts = jax.process_count() if n_hosts is None else n_hosts
         if not (0 <= host_id < n_hosts):
             raise ValueError(f"host_id {host_id} not in [0, {n_hosts})")
+        self._reap_stale_tmps()
         done = 0
         for c in self.pending():
             if c % n_hosts == host_id:
@@ -196,6 +224,7 @@ class CheckpointedSweep:
         :meth:`CollusionSimulator.run` result dict. Raises if any chunk is
         missing (run ``run(host_id=0, n_hosts=1)`` first to mop up after
         lost hosts)."""
+        self._reap_stale_tmps()
         missing = self.pending()
         if missing:
             raise ValueError(f"sweep incomplete: {len(missing)} of "

@@ -79,10 +79,30 @@ class TestShardedParity:
         outcomes = np.asarray(out["outcomes_final"])
         assert np.isin(outcomes, [0.0, 0.5, 1.0]).all()
 
-    def test_rejects_clustering(self, rng, mesh8):
-        with pytest.raises(ValueError, match="sharded"):
+    @pytest.mark.parametrize("algo", ["k-means", "dbscan-jit"])
+    def test_jit_clustering_shards(self, rng, mesh8, algo):
+        """The jit clustering variants shard over events too: their
+        distance contractions reduce over the sharded axis (GSPMD psum),
+        and the R-sized label machinery replicates."""
+        reports = make_reports(rng)
+        kwargs = ({"num_clusters": 3} if algo == "k-means"
+                  else {"dbscan_eps": 2.5, "dbscan_min_samples": 3})
+        unsharded = Oracle(reports=reports, backend="jax", algorithm=algo,
+                           **kwargs).consensus()
+        sharded = ShardedOracle(reports=reports, backend="jax",
+                                algorithm=algo, mesh=mesh8,
+                                **kwargs).consensus()
+        np.testing.assert_array_equal(
+            sharded["events"]["outcomes_final"],
+            unsharded["events"]["outcomes_final"])
+        np.testing.assert_allclose(sharded["agents"]["smooth_rep"],
+                                   unsharded["agents"]["smooth_rep"],
+                                   atol=1e-8)
+
+    def test_rejects_hybrid_clustering(self, rng, mesh8):
+        with pytest.raises(ValueError, match="hybrid"):
             ShardedOracle(reports=make_reports(rng), backend="jax",
-                          algorithm="k-means", mesh=mesh8)
+                          algorithm="hierarchical", mesh=mesh8)
         with pytest.raises(ValueError, match="backend"):
             ShardedOracle(reports=make_reports(rng), backend="numpy",
                           mesh=mesh8)
