@@ -95,3 +95,71 @@ def test_invariants_hold(seed):
     np.testing.assert_array_equal(
         np.asarray(again["events"]["outcomes_final"]),
         np.asarray(results["jax"]["events"]["outcomes_final"]))
+
+
+_ALL_ALGOS = ("sztorc", "fixed-variance", "ica", "k-means", "dbscan-jit")
+#: k-means excluded: its deterministic evenly-spaced-ROW centroid seeding
+#: (models/clustering.py::_seed_indices) makes the clustering itself
+#: depend on row order by design
+_ROW_ORDER_FREE_ALGOS = ("sztorc", "fixed-variance", "ica", "dbscan-jit")
+
+
+@pytest.mark.parametrize("algorithm", _ALL_ALGOS)
+@pytest.mark.parametrize("seed", (0, 5))
+def test_event_permutation_equivariance(seed, algorithm):
+    """Permuting event columns (with their bounds) permutes the per-event
+    outputs identically and leaves the reporter-side outputs unchanged —
+    no event may influence another through ordering (SURVEY.md §4's
+    property-test suggestion, extended from reporters to events).
+    Parametrized over every jit algorithm explicitly — a random draw left
+    some scorers untested."""
+    rng = np.random.default_rng(2000 + seed)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    kwargs["algorithm"] = algorithm
+    E = reports.shape[1]
+    perm = rng.permutation(E)
+    base = Oracle(reports=reports, event_bounds=bounds,
+                  reputation=reputation, backend="jax", **kwargs).consensus()
+    permed = Oracle(reports=reports[:, perm],
+                    event_bounds=[bounds[j] for j in perm],
+                    reputation=reputation, backend="jax",
+                    **kwargs).consensus()
+    for key in ("outcomes_final", "certainty", "participation_columns"):
+        np.testing.assert_allclose(
+            np.asarray(permed["events"][key], dtype=float),
+            np.asarray(base["events"][key], dtype=float)[perm],
+            atol=1e-9, err_msg=key)
+    np.testing.assert_allclose(
+        np.asarray(permed["agents"]["smooth_rep"], dtype=float),
+        np.asarray(base["agents"]["smooth_rep"], dtype=float),
+        atol=1e-9, err_msg=str(kwargs))
+
+
+@pytest.mark.parametrize("algorithm", _ROW_ORDER_FREE_ALGOS)
+@pytest.mark.parametrize("seed", (0, 5))
+def test_reporter_permutation_equivariance(seed, algorithm):
+    """Permuting reporter rows (with their reputation) permutes the
+    reporter-side outputs and leaves the event-side outputs unchanged —
+    for every scorer without row-order-dependent seeding (see
+    _ROW_ORDER_FREE_ALGOS)."""
+    rng = np.random.default_rng(3000 + seed)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    kwargs["algorithm"] = algorithm
+    R = reports.shape[0]
+    if reputation is None:
+        reputation = np.full(R, 1.0 / R)
+    perm = rng.permutation(R)
+    base = Oracle(reports=reports, event_bounds=bounds,
+                  reputation=reputation, backend="jax", **kwargs).consensus()
+    permed = Oracle(reports=reports[perm], event_bounds=bounds,
+                    reputation=reputation[perm], backend="jax",
+                    **kwargs).consensus()
+    for key in ("smooth_rep", "reporter_bonus", "participation_rows"):
+        np.testing.assert_allclose(
+            np.asarray(permed["agents"][key], dtype=float),
+            np.asarray(base["agents"][key], dtype=float)[perm],
+            atol=1e-9, err_msg=key)
+    np.testing.assert_allclose(
+        np.asarray(permed["events"]["outcomes_final"], dtype=float),
+        np.asarray(base["events"]["outcomes_final"], dtype=float),
+        atol=1e-9, err_msg=str(kwargs))
