@@ -97,14 +97,15 @@ def test_invariants_hold(seed):
         np.asarray(results["jax"]["events"]["outcomes_final"]))
 
 
-_ALL_ALGOS = ("sztorc", "fixed-variance", "ica", "k-means", "dbscan-jit")
+from pyconsensus_tpu.models.pipeline import JIT_ALGORITHMS  # noqa: E402
+
 #: k-means excluded: its deterministic evenly-spaced-ROW centroid seeding
 #: (models/clustering.py::_seed_indices) makes the clustering itself
 #: depend on row order by design
-_ROW_ORDER_FREE_ALGOS = ("sztorc", "fixed-variance", "ica", "dbscan-jit")
+_ROW_ORDER_FREE_ALGOS = tuple(a for a in JIT_ALGORITHMS if a != "k-means")
 
 
-@pytest.mark.parametrize("algorithm", _ALL_ALGOS)
+@pytest.mark.parametrize("algorithm", JIT_ALGORITHMS)
 @pytest.mark.parametrize("seed", (0, 5))
 def test_event_permutation_equivariance(seed, algorithm):
     """Permuting event columns (with their bounds) permutes the per-event
