@@ -38,6 +38,7 @@ if len(sys.argv) > 1:
         from pyconsensus_tpu.sim import (plot_round_trajectories,
                                          save_sweep_report)
         out = sys.argv[1]
+        os.makedirs(out, exist_ok=True)
         save_sweep_report(res, f"{out}/sweep.png")
         ax = plot_round_trajectories(traj, "liar_rep_share")
         ax.figure.savefig(f"{out}/rounds.png", bbox_inches="tight")

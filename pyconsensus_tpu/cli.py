@@ -116,9 +116,11 @@ def _run_simulation(args) -> None:
         if args.plot:
             from .sim import plot_round_trajectories
 
+            from .io import ensure_parent
+
             ax = plot_round_trajectories(res, "liar_rep_share",
                                          variance_index=1)
-            ax.figure.savefig(args.plot, bbox_inches="tight")
+            ax.figure.savefig(ensure_parent(args.plot), bbox_inches="tight")
             print(f"round-trajectory plot written to {args.plot}")
         return
     print(f"=== Monte-Carlo collusion sweep "

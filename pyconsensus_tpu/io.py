@@ -28,7 +28,17 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["save_reports", "load_reports", "load_reports_sharded",
-           "csv_to_npy"]
+           "csv_to_npy", "ensure_parent"]
+
+
+def ensure_parent(path) -> pathlib.Path:
+    """Create ``path``'s parent directory if missing and return ``path``
+    as a Path — shared guard for every save site (plots, ledger, CLI):
+    an expensive computation must not be lost to a missing output
+    directory at write time."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    return path
 
 
 def save_reports(path, reports) -> pathlib.Path:

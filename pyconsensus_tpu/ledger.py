@@ -24,6 +24,7 @@ from typing import Optional
 
 import numpy as np
 
+from .io import ensure_parent
 from .oracle import Oracle
 
 __all__ = ["ReputationLedger"]
@@ -140,7 +141,7 @@ class ReputationLedger:
         path = pathlib.Path(path)
         if path.suffix != ".npz":
             path = path.with_name(path.name + ".npz")
-        np.savez(path, **self._state_tree())
+        np.savez(ensure_parent(path), **self._state_tree())
 
     @classmethod
     def _from_state(cls, data) -> "ReputationLedger":

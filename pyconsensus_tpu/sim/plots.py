@@ -15,6 +15,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..io import ensure_parent
+
 __all__ = ["plot_sweep_heatmap", "plot_retention_curves",
            "plot_round_trajectories", "save_sweep_report"]
 
@@ -202,6 +204,6 @@ def save_sweep_report(result: dict, path, metrics=("correct_rate",
         plot_sweep_heatmap(result, metric=m, ax=ax)
     plot_retention_curves(result, ax=axes[-1])
     fig.tight_layout()
-    fig.savefig(path, bbox_inches="tight")
+    fig.savefig(ensure_parent(path), bbox_inches="tight")
     plt.close(fig)
     return path
