@@ -44,7 +44,7 @@ def ensure_parent(path) -> pathlib.Path:
 def save_reports(path, reports) -> pathlib.Path:
     """Write a reports matrix to ``path`` (format by suffix: ``.npy`` binary
     or ``.csv`` text with ``NA`` for missing entries). Returns the path."""
-    path = pathlib.Path(path)
+    path = ensure_parent(path)
     reports = np.asarray(reports, dtype=np.float64)
     if reports.ndim != 2:
         raise ValueError(f"reports must be 2-D, got shape {reports.shape}")
@@ -187,8 +187,8 @@ def csv_to_npy(src, dst=None, chunk_rows: int = 4096) -> pathlib.Path:
     if n_rows == 0:
         raise ValueError(f"{src}: not a readable, non-empty CSV")
 
-    out = np.lib.format.open_memmap(dst, mode="w+", dtype=np.float64,
-                                    shape=(n_rows, width))
+    out = np.lib.format.open_memmap(ensure_parent(dst), mode="w+",
+                                    dtype=np.float64, shape=(n_rows, width))
     try:
         # parse straight into a preallocated float64 block: a Python
         # list-of-lists chunk costs ~4x the block in PyFloat objects,

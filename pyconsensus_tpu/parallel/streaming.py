@@ -241,7 +241,8 @@ def _fill_rows_panel(panel, fill_rep, rows, scaled, mins, maxs,
 
 def streaming_consensus(reports_src, reputation=None, event_bounds=None,
                         panel_events: int = 8192,
-                        params: Optional[ConsensusParams] = None) -> dict:
+                        params: Optional[ConsensusParams] = None,
+                        mesh=None) -> dict:
     """Resolve an oracle whose reports matrix never fits on device.
 
     ``reports_src``: numpy array / ``np.memmap`` / path to an ``.npy``
@@ -252,6 +253,13 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     Returns the light result dict as host numpy arrays. See the module
     docstring for the pass structure (``executed iterations + 1``) and
     restrictions.
+
+    ``mesh``: optional device mesh — each streamed panel is placed with
+    its event axis sharded over the mesh, so the out-of-core path uses
+    EVERY chip's HBM bandwidth (the per-panel contractions reduce over
+    the sharded axis; GSPMD inserts the partial-sum collectives and the
+    R×R accumulators come back replicated). ``panel_events`` is rounded
+    up to a multiple of the mesh's event-axis size.
     """
     staged = None
     if isinstance(reports_src, (str, bytes)) or hasattr(reports_src,
@@ -279,14 +287,15 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
             reports_src = load_reports(reports_src, mmap=True)
     try:
         return _streaming_consensus_impl(reports_src, reputation,
-                                         event_bounds, panel_events, params)
+                                         event_bounds, panel_events, params,
+                                         mesh)
     finally:
         if staged is not None:
             staged.unlink(missing_ok=True)
 
 
 def _streaming_consensus_impl(reports_src, reputation, event_bounds,
-                              panel_events, params):
+                              panel_events, params, mesh=None):
     if reports_src.ndim != 2:
         raise ValueError(f"reports must be 2-D, got {reports_src.shape}")
     R, E = reports_src.shape
@@ -297,6 +306,17 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     P = int(panel_events)
     if P < 1:
         raise ValueError("panel_events must be >= 1")
+    panel_shard = vec_shard = None
+    if mesh is not None:
+        if "event" not in mesh.axis_names:
+            raise ValueError(f"streaming mesh must have an 'event' axis to "
+                             f"shard panels over, got axes "
+                             f"{mesh.axis_names}")
+        P = -(-P // mesh.shape["event"]) * mesh.shape["event"]  # shardable
+        panel_shard = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, "event"))
+        vec_shard = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("event"))
 
     scaled_all, mins_all, maxs_all = parse_event_bounds(event_bounds, E)
     dtype = jnp.asarray(0.0).dtype
@@ -317,9 +337,24 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         valid = np.zeros(P, dtype=bool)
         valid[:width] = True
         sc = np.pad(scaled_all[start:stop], (0, P - width))
-        mn = np.pad(mins_all[start:stop], (0, P - width))
-        mx = np.pad(maxs_all[start:stop], (0, P - width),
-                    constant_values=1.0)
+        mn = np.asarray(np.pad(mins_all[start:stop], (0, P - width)),
+                        dtype=np.dtype(dtype))
+        mx = np.asarray(np.pad(maxs_all[start:stop], (0, P - width),
+                               constant_values=1.0), dtype=np.dtype(dtype))
+        if panel_shard is not None:
+            # place this panel event-sharded across the mesh straight
+            # from the HOST arrays (device_put on numpy ships each shard
+            # to its own device once — an asarray detour would stage the
+            # whole panel through the default device and double the
+            # traffic on the bandwidth-bound ingest link); the panel
+            # contractions then reduce over the sharded axis on every
+            # chip, with GSPMD inserting the psum of the R x R partials
+            return (start, stop,
+                    jax.device_put(block, panel_shard),
+                    jax.device_put(sc, vec_shard),
+                    jax.device_put(mn, vec_shard),
+                    jax.device_put(mx, vec_shard),
+                    jax.device_put(valid, vec_shard))
         return (start, stop, jnp.asarray(block, dtype=dtype),
                 jnp.asarray(sc), jnp.asarray(mn, dtype=dtype),
                 jnp.asarray(mx, dtype=dtype), jnp.asarray(valid))

@@ -109,6 +109,33 @@ class TestStreamingParity:
         np.testing.assert_allclose(out["certainty"],
                                    np.asarray(ref["certainty"]), atol=1e-9)
 
+    @pytest.mark.parametrize("algorithm", ["sztorc", "k-means"])
+    def test_mesh_sharded_panels_match_unsharded(self, rng, algorithm):
+        """Out-of-core x multi-chip composition: panels placed
+        event-sharded over the 8-device mesh must reproduce the
+        single-device streaming result (the per-panel contractions reduce
+        over the sharded axis; GSPMD all-reduces the R x R partials).
+        panel_events=5 also exercises the round-up to a shardable
+        width."""
+        import jax
+        from pyconsensus_tpu.parallel import make_mesh
+
+        assert len(jax.devices()) == 8
+        mesh = make_mesh(batch=1, event=8)
+        reports, _ = collusion_reports(rng, R=18, E=21, liars=5,
+                                       na_frac=0.1)
+        p = ConsensusParams(algorithm=algorithm, max_iterations=2,
+                            num_clusters=3)
+        plain = streaming_consensus(reports, panel_events=5, params=p)
+        sharded = streaming_consensus(reports, panel_events=5, params=p,
+                                      mesh=mesh)
+        np.testing.assert_array_equal(sharded["outcomes_adjusted"],
+                                      plain["outcomes_adjusted"])
+        np.testing.assert_allclose(sharded["smooth_rep"],
+                                   plain["smooth_rep"], atol=1e-9)
+        np.testing.assert_allclose(sharded["certainty"],
+                                   plain["certainty"], atol=1e-9)
+
     def test_kmeans_multi_iteration_matches_in_memory(self, rng):
         """Iterative redistribution with k-means scoring: the fill-pinned
         seed reuse and per-iteration reputation threading must reproduce
