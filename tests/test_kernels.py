@@ -233,6 +233,35 @@ class TestWeightedMedian:
                                                  jnp.asarray(present)))
         np.testing.assert_allclose(got, expected, rtol=1e-12)
 
+    def test_blocked_matches_full_width(self, rng, monkeypatch):
+        """Above _MEDIAN_BLOCK columns the median runs as a lax.map over
+        column blocks (bounded sort temporaries); results must be bitwise
+        identical to the full-width form, including a ragged last block
+        and all-absent columns."""
+        monkeypatch.setattr(jk, "_MEDIAN_BLOCK", 5)
+        R, E = 9, 13                      # 2 full blocks + ragged 3
+        values = rng.random((R, E))
+        weights = rng.random((R, E))
+        present = rng.random((R, E)) < 0.7
+        present[:, 4] = False             # all-absent column -> 0.5
+        full = np.asarray(jk._weighted_median_cols_block(
+            jnp.asarray(values), jnp.asarray(weights), jnp.asarray(present)))
+        blocked = np.asarray(jk.weighted_median_cols(
+            jnp.asarray(values), jnp.asarray(weights), jnp.asarray(present)))
+        np.testing.assert_array_equal(blocked, full)
+        assert blocked[4] == 0.5
+        # (R,) per-reporter weights (the at-scale form: a broadcast (R, E)
+        # operand would be materialized across the block loop) must match
+        # the explicit broadcast
+        rep = rng.random(R)
+        wide = np.asarray(jk.weighted_median_cols(
+            jnp.asarray(values),
+            jnp.asarray(np.broadcast_to(rep[:, None], (R, E)).copy()),
+            jnp.asarray(present)))
+        narrow = np.asarray(jk.weighted_median_cols(
+            jnp.asarray(values), jnp.asarray(rep), jnp.asarray(present)))
+        np.testing.assert_array_equal(narrow, wide)
+
     def test_exact_half_midpoint_jax(self):
         values = jnp.array([[1.0], [2.0]])
         weights = jnp.array([[0.5], [0.5]])
