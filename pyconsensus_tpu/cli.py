@@ -63,11 +63,16 @@ def _print_table(title: str, headers: Sequence[str], rows) -> None:
 
 
 def _run_demo(name: str, reports, bounds, args) -> None:
+    from .utils import trace
+
     print(f"=== {name} ===")
     oracle = Oracle(reports=reports, event_bounds=bounds,
                     algorithm=args.algorithm, backend=args.backend,
                     max_iterations=args.iterations)
-    result = oracle.consensus()
+    with trace(args.profile):
+        result = oracle.consensus()
+    if args.profile:
+        print(f"  profiler trace written to {args.profile}")
     agents = result["agents"]
     events = result["events"]
     _print_table("Reporters", ["reporter", "old_rep", "smooth_rep", "bonus"],
@@ -88,6 +93,15 @@ def _run_demo(name: str, reports, bounds, args) -> None:
 
 
 def _run_simulation(args) -> None:
+    from .utils import trace
+
+    with trace(args.profile):           # no-ops when --profile is unset
+        _run_simulation_body(args)
+    if args.profile:
+        print(f"profiler trace written to {args.profile}")
+
+
+def _run_simulation_body(args) -> None:
     from .sim import CollusionSimulator, RoundsSimulator
 
     # the simulator is always the vmap-batched jax pipeline — --backend
@@ -151,14 +165,18 @@ def _run_simulation(args) -> None:
 def _run_streaming(args, bounds) -> None:
     from .models.pipeline import ConsensusParams
     from .parallel import streaming_consensus
+    from .utils import trace
 
     print(f"=== Streaming resolution of {args.file} "
           f"({args.panel_events} events/panel, "
           f"{args.iterations} iteration(s)) ===")
-    out = streaming_consensus(
-        args.file, event_bounds=bounds, panel_events=args.panel_events,
-        params=ConsensusParams(algorithm=args.algorithm,
-                               max_iterations=args.iterations))
+    with trace(args.profile):
+        out = streaming_consensus(
+            args.file, event_bounds=bounds, panel_events=args.panel_events,
+            params=ConsensusParams(algorithm=args.algorithm,
+                                   max_iterations=args.iterations))
+    if args.profile:
+        print(f"  profiler trace written to {args.profile}")
     rep = out["smooth_rep"]
     _print_table("Reporters (top 8 by reputation)",
                  ["reporter", "smooth_rep", "reporter_bonus"],
@@ -199,6 +217,10 @@ def main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("-f", "--file", metavar="PATH",
                     help="resolve a reports matrix loaded from PATH "
                          "(.npy or .csv; NA/NaN = missing report)")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="write a jax.profiler trace of each resolution "
+                         "(demo, --file, --stream, or --simulate sweep) "
+                         "to DIR (open with TensorBoard / Perfetto)")
     ap.add_argument("--bounds", metavar="PATH",
                     help="with --file: JSON event-bounds sidecar — a list "
                          "with one entry per event, null for binary or "

@@ -149,6 +149,27 @@ class TestCli:
             main(["--file", path, "--bounds", str(bpath)])
         assert "entries" in capsys.readouterr().err
 
+    def test_profile_writes_trace(self, capsys, tmp_path):
+        out = tmp_path / "trace"
+        assert main(["--example", "--profile", str(out)]) == 0
+        assert "profiler trace written" in capsys.readouterr().out
+        assert any(out.rglob("*"))          # trace events on disk
+
+    def test_profile_covers_stream_and_simulate(self, capsys, tmp_path,
+                                                rng):
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=10, E=8, liars=3)
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        out1 = tmp_path / "t1"
+        assert main(["--file", path, "--stream", "--panel-events", "4",
+                     "--profile", str(out1)]) == 0
+        assert any(out1.rglob("*"))
+        out2 = tmp_path / "t2"
+        assert main(["--simulate", "--trials", "4", "--reporters", "8",
+                     "--events", "5", "--profile", str(out2)]) == 0
+        assert any(out2.rglob("*"))
+
     def test_bad_flag_exits_nonzero(self):
         with pytest.raises(SystemExit):
             main(["--algorithm", "nope"])
