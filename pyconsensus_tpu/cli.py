@@ -93,16 +93,8 @@ def _run_demo(name: str, reports, bounds, args) -> None:
 
 
 def _run_simulation(args) -> None:
-    from .utils import trace
-
-    with trace(args.profile):           # no-ops when --profile is unset
-        _run_simulation_body(args)
-    if args.profile:
-        print(f"profiler trace written to {args.profile}")
-
-
-def _run_simulation_body(args) -> None:
     from .sim import CollusionSimulator, RoundsSimulator
+    from .utils import trace
 
     # the simulator is always the vmap-batched jax pipeline — --backend
     # applies to the demo runs only
@@ -116,7 +108,10 @@ def _run_simulation_body(args) -> None:
                               n_events=args.events,
                               max_iterations=args.iterations,
                               algorithm=args.algorithm)
-        res = sim.run(lf, var, args.trials, seed=args.seed)
+        with trace(args.profile):       # the resolution sweep only —
+            res = sim.run(lf, var, args.trials, seed=args.seed)
+        if args.profile:                    # plotting stays untraced
+            print(f"profiler trace written to {args.profile}")
         headers = ["liar_frac"] + [f"round {r}" for r in (1, args.rounds)]
         for metric, title in (("correct_rate", "Correct-outcome rate "
                                                "(variance 0.1)"),
@@ -143,7 +138,10 @@ def _run_simulation_body(args) -> None:
                              n_events=args.events,
                              max_iterations=args.iterations,
                              algorithm=args.algorithm)
-    res = sim.run(lf, var, args.trials, seed=args.seed)
+    with trace(args.profile):           # the resolution sweep only —
+        res = sim.run(lf, var, args.trials, seed=args.seed)
+    if args.profile:                        # plotting stays untraced
+        print(f"profiler trace written to {args.profile}")
     headers = ["liar_frac"] + [f"var={v:g}" for v in var]
     rows = []
     for i, f in enumerate(lf):
