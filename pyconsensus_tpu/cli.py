@@ -92,9 +92,20 @@ def _run_demo(name: str, reports, bounds, args) -> None:
           f"({result['iterations']} iteration(s))\n")
 
 
+def _traced_sweep(sim, lf, var, args):
+    """Run the sweep under --profile's jax.profiler trace (resolution
+    only; table printing and plotting stay untraced)."""
+    from .utils import trace
+
+    with trace(args.profile):
+        res = sim.run(lf, var, args.trials, seed=args.seed)
+    if args.profile:
+        print(f"profiler trace written to {args.profile}")
+    return res
+
+
 def _run_simulation(args) -> None:
     from .sim import CollusionSimulator, RoundsSimulator
-    from .utils import trace
 
     # the simulator is always the vmap-batched jax pipeline — --backend
     # applies to the demo runs only
@@ -108,10 +119,7 @@ def _run_simulation(args) -> None:
                               n_events=args.events,
                               max_iterations=args.iterations,
                               algorithm=args.algorithm)
-        with trace(args.profile):       # the resolution sweep only —
-            res = sim.run(lf, var, args.trials, seed=args.seed)
-        if args.profile:                    # plotting stays untraced
-            print(f"profiler trace written to {args.profile}")
+        res = _traced_sweep(sim, lf, var, args)
         headers = ["liar_frac"] + [f"round {r}" for r in (1, args.rounds)]
         for metric, title in (("correct_rate", "Correct-outcome rate "
                                                "(variance 0.1)"),
@@ -138,10 +146,7 @@ def _run_simulation(args) -> None:
                              n_events=args.events,
                              max_iterations=args.iterations,
                              algorithm=args.algorithm)
-    with trace(args.profile):           # the resolution sweep only —
-        res = sim.run(lf, var, args.trials, seed=args.seed)
-    if args.profile:                        # plotting stays untraced
-        print(f"profiler trace written to {args.profile}")
+    res = _traced_sweep(sim, lf, var, args)
     headers = ["liar_frac"] + [f"var={v:g}" for v in var]
     rows = []
     for i, f in enumerate(lf):

@@ -54,3 +54,39 @@ def test_extreme_reputation_concentration(backend):
     r = Oracle(reports=reports, reputation=rep, backend=backend).consensus()
     np.testing.assert_array_equal(r["events"]["outcomes_final"],
                                   [1.0, 1.0, 0.0])
+
+
+def test_none_entries_are_missing_reports():
+    """Reference compat: Python ``None`` in a reports list coerces to NaN
+    (non-participation), like the reference's masked-array input."""
+    r = Oracle(reports=[[1.0, None, 0.0], [1.0, 1.0, 0.0],
+                        [0.0, 1.0, 1.0]]).consensus()
+    np.testing.assert_array_equal(r["events"]["outcomes_final"],
+                                  [1.0, 1.0, 0.0])
+    assert bool(r["agents"]["na_row"][0])
+
+
+def test_streaming_degenerate_shapes():
+    """Single-column, single-panel, and panel-larger-than-E inputs all
+    stream correctly."""
+    from pyconsensus_tpu.parallel import streaming_consensus
+
+    one_col = np.array([[1.0], [1.0], [0.0]])
+    out = streaming_consensus(one_col, panel_events=4)
+    np.testing.assert_array_equal(out["outcomes_final"], [1.0])
+    wide = np.tile([1.0, 0.0, 1.0], (4, 1))
+    out = streaming_consensus(wide, panel_events=1)
+    np.testing.assert_array_equal(out["outcomes_final"], [1.0, 0.0, 1.0])
+
+
+def test_checkpointed_sweep_single_trial(tmp_path):
+    """A 1-trial, 1-chunk sweep round-trips through checkpoint + gather."""
+    from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+
+    sim = CollusionSimulator(n_reporters=6, n_events=4)
+    sweep = CheckpointedSweep(sim, [0.2], [0.1], 1,
+                              checkpoint_dir=tmp_path / "ck")
+    assert sweep.n_chunks == 1
+    assert sweep.run(host_id=0, n_hosts=1) == 1
+    got = sweep.gather()
+    assert got["correct_rate"].shape == (1, 1, 1)
