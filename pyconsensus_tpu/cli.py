@@ -68,7 +68,7 @@ def _run_demo(name: str, reports, bounds, args) -> None:
     print(f"=== {name} ===")
     oracle = Oracle(reports=reports, event_bounds=bounds,
                     algorithm=args.algorithm, backend=args.backend,
-                    max_iterations=args.iterations)
+                    max_iterations=args.iterations, verbose=args.verbose)
     with trace(args.profile):
         result = oracle.consensus()
     if args.profile:
@@ -220,6 +220,9 @@ def main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("-f", "--file", metavar="PATH",
                     help="resolve a reports matrix loaded from PATH "
                          "(.npy or .csv; NA/NaN = missing report)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="verbose Oracle prints during demo/--file "
+                         "resolutions (the reference's verbose knob)")
     ap.add_argument("--profile", metavar="DIR",
                     help="write a jax.profiler trace of each resolution "
                          "(demo, --file, --stream, or --simulate sweep) "

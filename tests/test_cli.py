@@ -170,6 +170,15 @@ class TestCli:
                      "--events", "5", "--profile", str(out2)]) == 0
         assert any(out2.rglob("*"))
 
+    def test_verbose_flag(self, capsys):
+        assert main(["--example", "--verbose", "--backend", "numpy"]) == 0
+        out = capsys.readouterr().out
+        # the Oracle's verbose summary (printed ONLY under --verbose)
+        assert "pyconsensus_tpu Oracle" in out
+        assert "smooth_rep:" in out
+        main(["--example", "--backend", "numpy"])
+        assert "pyconsensus_tpu Oracle" not in capsys.readouterr().out
+
     def test_bad_flag_exits_nonzero(self):
         with pytest.raises(SystemExit):
             main(["--algorithm", "nope"])
