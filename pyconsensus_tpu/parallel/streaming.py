@@ -239,10 +239,21 @@ def _fill_rows_panel(panel, fill_rep, rows, scaled, mins, maxs,
     return filled[rows]
 
 
+def _default_allreduce(x):
+    """Cross-process sum via the jax distributed runtime (requires
+    ``parallel.initialize``); the ``allreduce=`` hook exists so tests and
+    custom deployments can substitute their own reduction."""
+    from jax.experimental import multihost_utils
+
+    return jnp.sum(multihost_utils.process_allgather(jnp.asarray(x)), axis=0)
+
+
 def streaming_consensus(reports_src, reputation=None, event_bounds=None,
                         panel_events: int = 8192,
                         params: Optional[ConsensusParams] = None,
-                        mesh=None) -> dict:
+                        mesh=None, host_id: Optional[int] = None,
+                        n_hosts: Optional[int] = None,
+                        allreduce=None) -> dict:
     """Resolve an oracle whose reports matrix never fits on device.
 
     ``reports_src``: numpy array / ``np.memmap`` / path to an ``.npy``
@@ -260,6 +271,16 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     the sharded axis; GSPMD inserts the partial-sum collectives and the
     R×R accumulators come back replicated). ``panel_events`` is rounded
     up to a multiple of the mesh's event-axis size.
+
+    ``n_hosts > 1``: multi-host out-of-core (sztorc only) — each host
+    streams only panels ``host_id::n_hosts`` (``host_id`` defaults to
+    ``jax.process_index()``), the R×R sufficient statistics all-reduce
+    across hosts once per iteration, and the disjoint per-panel output
+    slices sum-reduce at the end, so every host returns the identical
+    full result. ``allreduce`` defaults to a
+    ``jax.distributed``/``process_allgather`` sum; pass a custom
+    callable for other transports. Composes with ``mesh`` (each host's
+    local chips shard its panels).
     """
     staged = None
     if isinstance(reports_src, (str, bytes)) or hasattr(reports_src,
@@ -288,14 +309,15 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     try:
         return _streaming_consensus_impl(reports_src, reputation,
                                          event_bounds, panel_events, params,
-                                         mesh)
+                                         mesh, host_id, n_hosts, allreduce)
     finally:
         if staged is not None:
             staged.unlink(missing_ok=True)
 
 
 def _streaming_consensus_impl(reports_src, reputation, event_bounds,
-                              panel_events, params, mesh=None):
+                              panel_events, params, mesh=None,
+                              host_id=None, n_hosts=None, allreduce=None):
     if reports_src.ndim != 2:
         raise ValueError(f"reports must be 2-D, got {reports_src.shape}")
     R, E = reports_src.shape
@@ -306,6 +328,33 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     P = int(panel_events)
     if P < 1:
         raise ValueError("panel_events must be >= 1")
+    multi = n_hosts is not None and int(n_hosts) > 1
+    if multi:
+        if p.algorithm != "sztorc":
+            raise ValueError("multi-host streaming supports "
+                             "algorithm='sztorc'")
+        if host_id is None:
+            host_id = jax.process_index()
+        host_id, n_hosts = int(host_id), int(n_hosts)
+        if not 0 <= host_id < n_hosts:
+            raise ValueError(f"host_id {host_id} not in [0, {n_hosts})")
+        if allreduce is None:
+            # the default reduction spans jax.process_count() processes:
+            # fewer declared hosts would deadlock the surplus processes
+            # inside the collective, more would silently drop the panels
+            # assigned to hosts that don't exist
+            if n_hosts != jax.process_count():
+                raise ValueError(
+                    f"n_hosts={n_hosts} but the jax distributed runtime "
+                    f"has {jax.process_count()} process(es); pass a "
+                    "custom allreduce to use a different host group")
+            allreduce = _default_allreduce
+    else:
+        if allreduce is not None:
+            raise ValueError("allreduce given without n_hosts > 1 — the "
+                             "multi-host split never engages; pass "
+                             "n_hosts (and optionally host_id)")
+        allreduce = None
     panel_shard = vec_shard = None
     if mesh is not None:
         if "event" not in mesh.axis_names:
@@ -365,7 +414,9 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         # device compute (jax dispatch is async) — on directly-attached
         # hardware this hides most of the PCIe time behind the kernels
         starts = list(range(0, E, P))
-        if not starts:                     # E == 0: nothing to stream
+        if multi:                          # this host's round-robin slice
+            starts = starts[host_id::n_hosts]
+        if not starts:                     # E == 0 / more hosts than panels
             return
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
             pending = pool.submit(_prepare, starts[0])
@@ -408,6 +459,13 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
                 G, M = G + dG, M + dM
                 if with_s:
                     S_acc = S_acc + dS
+            if allreduce is not None:
+                # sum the R x R partials across hosts: every host then
+                # runs the identical eigh/score/redistribution arithmetic
+                G = allreduce(G)
+                M = allreduce(M)
+                if with_s:
+                    S_acc = allreduce(S_acc)
             if with_s:
                 S = S_acc
 
@@ -441,12 +499,14 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     smooth_rep = rep_k
 
     # ---- pass 2: per-panel resolution with the final reputation ---------
-    outcomes_raw = np.empty(E)
-    outcomes_adjusted = np.empty(E)
-    outcomes_final = np.empty(E)
-    certainty = np.empty(E)
-    pcols = np.empty(E)
-    first_loading = np.empty(E)
+    # (zeros, not empty: under multi-host each host fills only its
+    # disjoint panel slices and the final sum-allreduce assembles them)
+    outcomes_raw = np.zeros(E)
+    outcomes_adjusted = np.zeros(E)
+    outcomes_final = np.zeros(E)
+    certainty = np.zeros(E)
+    pcols = np.zeros(E)
+    first_loading = np.zeros(E)
     prow = np.zeros(R)
     na_count = np.zeros(R)
     for start, stop, block, sc, mn, mx, _ in panels():
@@ -462,6 +522,20 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         first_loading[start:stop] = np.asarray(ld)[:width]
         prow += np.asarray(pr)       # padded cols: certainty * na(=0) = 0
         na_count += np.asarray(nc)
+    if allreduce is not None:
+        # disjoint panel slices + zero elsewhere: the cross-host sum IS
+        # the assembly; the row partials are genuine additive reductions.
+        # Stacked into two collectives (one (6, E), one (2, R)) — each
+        # allreduce is a blocking DCN round-trip, so eight sequential
+        # calls would serialize eight of them per resolution
+        e_stack = np.asarray(allreduce(np.stack(
+            [outcomes_raw, outcomes_adjusted, outcomes_final, certainty,
+             pcols, first_loading])), dtype=float)
+        (outcomes_raw, outcomes_adjusted, outcomes_final, certainty,
+         pcols, first_loading) = e_stack
+        r_stack = np.asarray(allreduce(np.stack([prow, na_count])),
+                             dtype=float)
+        prow, na_count = r_stack
     first_loading = nk.canon_sign(first_loading)
     result_extra = ({"first_loading": first_loading}
                     if p.algorithm == "sztorc" else {})

@@ -72,3 +72,19 @@ if len(sys.argv) > 3:
                               checkpoint_dir=sys.argv[3],
                               trials_per_chunk=4)
     print("SWEEP", sweep.run(), flush=True)
+
+    # phase 3: multi-host OUT-OF-CORE streaming — each process streams
+    # its round-robin half of the event panels, the R x R sufficient
+    # statistics all-reduce across the two processes every iteration,
+    # and both return the identical full resolution
+    from pyconsensus_tpu.parallel import streaming_consensus  # noqa: E402
+
+    s_out = streaming_consensus(
+        reports, panel_events=3,
+        params=ConsensusParams(algorithm="sztorc", max_iterations=2),
+        n_hosts=2)
+    print("STREAM", ",".join(f"{float(v):g}"
+                             for v in s_out["outcomes_adjusted"]),
+          flush=True)
+    print("STREAMREP", ",".join(f"{float(v):.6f}"
+                                for v in s_out["smooth_rep"]), flush=True)

@@ -99,3 +99,18 @@ def test_two_process_global_mesh(tmp_path):
     mono = sim.run([0.0, 0.3], [0.1], 6, seed=2)
     np.testing.assert_array_equal(got["correct_rate"],
                                   mono["correct_rate"])
+
+    # phase 3: multi-host out-of-core streaming — both processes must
+    # return the identical full resolution, equal to a single-process
+    # streaming run of the same matrix
+    from pyconsensus_tpu.models.pipeline import ConsensusParams
+    from pyconsensus_tpu.parallel import streaming_consensus
+    s0, s1 = (parse("STREAM", o) for o in outputs)
+    sr0, sr1 = (parse("STREAMREP", o) for o in outputs)
+    np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_allclose(sr0, sr1, atol=1e-6)
+    local = streaming_consensus(
+        reports, panel_events=3,
+        params=ConsensusParams(algorithm="sztorc", max_iterations=2))
+    np.testing.assert_array_equal(s0, local["outcomes_adjusted"])
+    np.testing.assert_allclose(sr0, local["smooth_rep"], atol=1e-5)

@@ -136,6 +136,79 @@ class TestStreamingParity:
         np.testing.assert_allclose(sharded["certainty"],
                                    plain["certainty"], atol=1e-9)
 
+    def test_multi_host_split_matches_single(self, rng):
+        """Two 'hosts' (threads with a rendezvous-sum allreduce) each
+        stream half the panels; the reduced result must equal the
+        single-host resolution bit-for-bit on snapped outcomes. The same
+        wiring runs across real OS processes in test_distributed.py."""
+        import threading
+
+        bar = threading.Barrier(2)
+        contrib = {}
+        summed = {}
+
+        def make_allreduce(i):
+            def allreduce(x):
+                contrib[i] = np.asarray(x)
+                bar.wait()
+                if i == 0:
+                    summed["v"] = contrib[0] + contrib[1]
+                bar.wait()
+                out = summed["v"]
+                bar.wait()          # both read before the next round
+                return out
+            return allreduce
+
+        reports, _ = collusion_reports(rng, R=16, E=23, liars=4,
+                                       na_frac=0.1)
+        p = ConsensusParams(algorithm="sztorc", max_iterations=3)
+        plain = streaming_consensus(reports, panel_events=4, params=p)
+
+        results = {}
+        errors = []
+
+        def host(i):
+            try:
+                results[i] = streaming_consensus(
+                    reports, panel_events=4, params=p, host_id=i,
+                    n_hosts=2, allreduce=make_allreduce(i))
+            except Exception as exc:       # surface thread failures
+                errors.append(exc)
+                bar.abort()
+
+        threads = [threading.Thread(target=host, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for i in (0, 1):
+            np.testing.assert_array_equal(results[i]["outcomes_adjusted"],
+                                          plain["outcomes_adjusted"])
+            np.testing.assert_allclose(results[i]["smooth_rep"],
+                                       plain["smooth_rep"], atol=1e-9)
+            np.testing.assert_allclose(results[i]["participation_rows"],
+                                       plain["participation_rows"],
+                                       atol=1e-9)
+            assert results[i]["iterations"] == plain["iterations"]
+
+    def test_multi_host_validation(self, rng):
+        reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
+        with pytest.raises(ValueError, match="sztorc"):
+            streaming_consensus(reports,
+                                params=ConsensusParams(algorithm="k-means"),
+                                host_id=0, n_hosts=2)
+        with pytest.raises(ValueError, match="host_id"):
+            streaming_consensus(reports, host_id=5, n_hosts=2)
+        # default allreduce requires n_hosts == jax.process_count()
+        # (1 in-process): fewer deadlocks, more silently drops panels
+        with pytest.raises(ValueError, match="process"):
+            streaming_consensus(reports, host_id=0, n_hosts=2)
+        # a custom allreduce without the host split is a silent no-op —
+        # reject it
+        with pytest.raises(ValueError, match="allreduce"):
+            streaming_consensus(reports, allreduce=lambda x: x)
+
     def test_kmeans_multi_iteration_matches_in_memory(self, rng):
         """Iterative redistribution with k-means scoring: the fill-pinned
         seed reuse and per-iteration reputation threading must reproduce
