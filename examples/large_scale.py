@@ -41,3 +41,11 @@ print("streaming outcomes identical to in-memory:",
                           np.asarray(result["events"]["outcomes_adjusted"]))))
 print("liar reputation share:",
       round(float(out["smooth_rep"][400:].sum()), 4))
+
+# --- out-of-core x multi-chip: each panel event-sharded over the mesh ---
+out_mesh = streaming_consensus(reports, panel_events=512,
+                               params=ConsensusParams(max_iterations=1),
+                               mesh=mesh)
+print("mesh-sharded streaming identical:",
+      bool(np.array_equal(out_mesh["outcomes_adjusted"],
+                          out["outcomes_adjusted"])))

@@ -460,12 +460,15 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
                 if with_s:
                     S_acc = S_acc + dS
             if allreduce is not None:
-                # sum the R x R partials across hosts: every host then
-                # runs the identical eigh/score/redistribution arithmetic
-                G = allreduce(G)
-                M = allreduce(M)
+                # sum the R x R partials across hosts in ONE stacked
+                # collective (each allreduce is a blocking DCN
+                # round-trip); every host then runs the identical
+                # eigh/score/redistribution arithmetic
+                stats = [G, M] + ([S_acc] if with_s else [])
+                reduced = allreduce(jnp.stack(stats))
+                G, M = reduced[0], reduced[1]
                 if with_s:
-                    S_acc = allreduce(S_acc)
+                    S_acc = reduced[2]
             if with_s:
                 S = S_acc
 
