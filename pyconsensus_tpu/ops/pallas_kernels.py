@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["apply_weighted_cov", "power_iteration_fused",
+           "power_iteration_mono",
            "scores_dirfix_pass", "resolve_certainty_fused"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
@@ -89,27 +90,34 @@ def resolve_kernel_fits(n_reporters: int, itemsize: int) -> bool:
     return _resolve_block_cols(n_reporters, itemsize) is not None
 
 
-def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *, nan_fill):
-    """One row panel: both contractions off a single HBM read of the panel.
+def _cov_panel_contribution(x_ref, mu_ref, rep_ref, v, *, nan_fill):
+    """One row panel's ``D_i^T (rep_i * (D_i v))`` contribution, centered
+    in-register. ``nan_fill=True`` reads NaN-threaded storage: absent
+    entries are NaN in ``x`` and ``mu_ref`` row 1 carries ``fill - mu``
+    (the centered per-column fill value), so the filled matrix is
+    reconstructed in-register and never exists in HBM. Shared by the
+    per-sweep kernel (:func:`apply_weighted_cov`) and the single-launch
+    power loop (:func:`power_iteration_mono`)."""
+    xp = x_ref[:].astype(jnp.float32)
+    if nan_fill:
+        xc = jnp.where(jnp.isnan(xp), mu_ref[1:2, :], xp - mu_ref[0:1, :])
+    else:
+        xc = xp - mu_ref[0:1, :]                           # (T, E) centered
+    t = jnp.sum(xc * v, axis=1, keepdims=True)             # (T, 1) = D_i v
+    return jnp.sum(xc * (rep_ref[:] * t), axis=0, keepdims=True)
 
-    ``nan_fill=True`` reads NaN-threaded storage: absent entries are NaN in
-    ``x`` and ``mu_ref`` row 1 carries ``fill - mu`` (the centered per-column
-    fill value), so the filled matrix is reconstructed in-register and never
-    exists in HBM."""
+
+def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *, nan_fill):
+    """One row panel: both contractions off a single HBM read of the
+    panel (see :func:`_cov_panel_contribution`)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
         y_ref[:] = jnp.zeros_like(y_ref)
 
-    xp = x_ref[:].astype(jnp.float32)
-    if nan_fill:
-        xc = jnp.where(jnp.isnan(xp), mu_ref[1:2, :], xp - mu_ref[0:1, :])
-    else:
-        xc = xp - mu_ref[0:1, :]                           # (T, E) centered
-    t = jnp.sum(xc * v_ref[:], axis=1, keepdims=True)      # (T, 1) = D_i v
-    w = rep_ref[:] * t                                     # (T, 1)
-    y_ref[:] += jnp.sum(xc * w, axis=0, keepdims=True)     # (1, E) partial
+    y_ref[:] += _cov_panel_contribution(x_ref, mu_ref, rep_ref, v_ref[:],
+                                        nan_fill=nan_fill)
 
 
 def _pad_rows(x, rep, tile_r: int):
@@ -121,6 +129,26 @@ def _pad_rows(x, rep, tile_r: int):
         x = jnp.pad(x, ((0, pad), (0, 0)))
         rep = jnp.pad(rep, (0, pad))
     return x, rep
+
+
+def _prep_cov_inputs(x, mu, rep, fill):
+    """Shared input prep for the covariance-application kernels: panel
+    sizing (halved budget under NaN threading), row padding, and the
+    stacked ``[mu; fill - mu]`` operand. Returns
+    ``(x, rep, tile_r, mu2)``."""
+    E = x.shape[1]
+    nan_fill = fill is not None
+    tile_r = _panel_rows(E, x.dtype.itemsize,
+                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    x, rep = _pad_rows(x, rep.astype(jnp.float32), tile_r)
+    mu = mu.astype(jnp.float32).reshape(1, E)
+    if nan_fill:
+        # row 0: mu; row 1: fill - mu (the centered value of an absent entry)
+        mu2 = jnp.concatenate([mu, fill.astype(jnp.float32).reshape(1, E)
+                               - mu])
+    else:
+        mu2 = mu
+    return x, rep, tile_r, mu2
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -137,18 +165,10 @@ def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
     """
     R, E = x.shape
     nan_fill = fill is not None
-    tile_r = _panel_rows(E, x.dtype.itemsize,
-                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
-    x, rep = _pad_rows(x, rep, tile_r)
+    x, rep, tile_r, mu2 = _prep_cov_inputs(x, mu, rep, fill)
     Rp = x.shape[0]
     f32 = jnp.float32
     grid = (Rp // tile_r,)
-    mu = mu.astype(f32).reshape(1, E)
-    if nan_fill:
-        # row 0: mu; row 1: fill - mu (the centered value of an absent entry)
-        mu2 = jnp.concatenate([mu, fill.astype(f32).reshape(1, E) - mu])
-    else:
-        mu2 = mu
     y = pl.pallas_call(
         functools.partial(_apply_cov_kernel, nan_fill=nan_fill),
         grid=grid,
@@ -444,6 +464,105 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
     )(x, rep.astype(f32).reshape(-1, 1), fv)
     return (raw.reshape(E), out.reshape(E), cert.reshape(E), pcol.reshape(E),
             prow.reshape(R), narow.reshape(R))
+
+
+def _power_mono_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *,
+                       nan_fill: bool):
+    """One (iteration, row-panel) grid step of the single-launch power
+    loop. Panel 0 of each iteration finalizes the PREVIOUS iteration's
+    accumulated ``y`` into the new normalized iterate ``v`` (the division
+    by the covariance denominator is dropped — power iteration is
+    scale-invariant and every step renormalizes), then every panel adds
+    its ``D_i^T (rep_i * (D_i v))`` contribution exactly like
+    ``_apply_cov_kernel``. TPU grid steps run sequentially on a core, so
+    the cross-step carry through the constant-indexed ``v``/``y`` blocks
+    is well-defined."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when((i == 0) & (j == 0))
+    def _():
+        v_ref[:] = jnp.ones_like(v_ref)       # iterate 0: the ones vector
+        y_ref[:] = jnp.zeros_like(y_ref)
+
+    @pl.when((i > 0) & (j == 0))
+    def _():
+        y = y_ref[:]
+        norm = jnp.sqrt(jnp.sum(y * y))
+        # zero-norm guard (degenerate covariance): keep the previous
+        # iterate, matching jax_kernels._power_loop's fallback
+        v_ref[:] = jnp.where(norm == 0.0, v_ref[:],
+                             y / jnp.where(norm == 0.0, 1.0, norm))
+        y_ref[:] = jnp.zeros_like(y_ref)
+
+    y_ref[:] += _cov_panel_contribution(x_ref, mu_ref, rep_ref, v_ref[:],
+                                        nan_fill=nan_fill)
+
+
+@functools.partial(jax.jit, static_argnames=("n_iters", "interpret"))
+def power_iteration_mono(x, mu, rep, n_iters: int, fill=None,
+                         interpret: bool = False):
+    """EXPERIMENTAL (round-2 perf candidate, unmeasured): the whole power
+    loop as ONE ``pallas_call`` with an (iteration × row-panel) grid and
+    VMEM-resident iterate/accumulator, eliminating the per-sweep kernel
+    launches and `lax.while_loop` machinery of
+    :func:`power_iteration_fused`. Fixed trip count (no early exit — the
+    grid is static); the covariance denominator is dropped (power
+    iteration is scale-invariant), so with ``n_iters`` grid iterations
+    this computes the same normalized iterate sequence as the driver
+    path's ``n_iters - 1`` applications after its seeded start. Returns
+    the unit-norm loading (degenerate zero-covariance inputs fall back
+    to the last nonzero iterate, like the driver loop).
+
+    Not wired into any pipeline: the hypothesis that inter-kernel
+    scheduling bubbles cost ~10 ms per resolution could not be measured
+    on a quiet chip in round 1 (docs/ROADMAP.md).
+    """
+    if int(n_iters) < 1:
+        raise ValueError("n_iters must be >= 1 (an empty grid would "
+                         "return uninitialized output memory)")
+    R, E = x.shape
+    nan_fill = fill is not None
+    x, rep, tile_r, mu2 = _prep_cov_inputs(x, mu, rep, fill)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    n_panels = Rp // tile_r
+    v, y = pl.pallas_call(
+        functools.partial(_power_mono_kernel, nan_fill=nan_fill),
+        grid=(int(n_iters), n_panels),
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((mu2.shape[0], E), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, E), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, E), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, E), f32),   # v (iterate)
+            jax.ShapeDtypeStruct((1, E), f32),   # y (accumulator)
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * int(n_iters) * Rp * E,
+            bytes_accessed=int(n_iters) * Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, mu2, rep.reshape(-1, 1))
+    y = y.reshape(E)
+    norm = jnp.sqrt(jnp.sum(y * y))
+    # degenerate guard: a zero final accumulator falls back to the last
+    # iterate (itself guarded to stay nonzero back to the ones start)
+    safe = jnp.where(norm == 0.0, 1.0, norm)
+    v = v.reshape(E)
+    vnorm = jnp.linalg.norm(v)
+    v_unit = v / jnp.where(vnorm == 0.0, 1.0, vnorm)
+    return jnp.where(norm == 0.0, v_unit, y / safe)
 
 
 def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
