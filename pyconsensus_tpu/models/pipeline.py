@@ -336,7 +336,8 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     def scores_at(rep_k, mu_k):
         return jk.sztorc_scores_power_fused(
             x, rep_k, p.power_iters, p.power_tol, p.matvec_dtype,
-            interpret=interp, fill=fill, mu=mu_k)
+            interpret=interp, fill=fill, mu=mu_k,
+            mono=p.pca_method == "power-mono")
 
     if p.max_iterations <= 1:
         adj, loading = scores_at(old_rep, mu1)

@@ -48,10 +48,11 @@ def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
     direction-fix contractions fuse into one Pallas HBM sweep
     (jax_kernels.sztorc_scores_power_fused)."""
     method = jk.resolve_pca_method(*reports_filled.shape, pca_method)
-    if method == "power-fused":
+    if method in ("power-fused", "power-mono"):
         return jk.sztorc_scores_power_fused(
             reports_filled, reputation, power_iters, power_tol, matvec_dtype,
-            interpret=jax.default_backend() != "tpu")
+            interpret=jax.default_backend() != "tpu",
+            mono=method == "power-mono")
     loading, scores = jk.weighted_prin_comp(reports_filled, reputation,
                                             method=method,
                                             power_iters=power_iters,

@@ -277,7 +277,7 @@ def resolve_pca_method(R: int, E: int, method: str) -> str:
         if jax.default_backend() == "tpu" and fits:
             return "power-fused"
         return "power"
-    if method == "power-fused":
+    if method in ("power-fused", "power-mono"):
         if jax.default_backend() != "tpu" and R * E > (1 << 20):
             return "power"
         if not fits:
@@ -307,16 +307,22 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
     """
     R, E = reports_filled.shape
     method = resolve_pca_method(R, E, method)
-    if method == "power-fused":
-        from .pallas_kernels import power_iteration_fused
+    if method in ("power-fused", "power-mono"):
+        from .pallas_kernels import (power_iteration_fused,
+                                     power_iteration_mono)
 
         acc = reputation.dtype
         mu, denom = _mu_denom(reports_filled, reputation)
         xmm = (reports_filled.astype(jnp.dtype(matvec_dtype))
                if matvec_dtype else reports_filled)
-        loading = power_iteration_fused(
-            xmm, mu, denom, reputation, power_iters, power_tol,
-            interpret=jax.default_backend() != "tpu").astype(acc)
+        if method == "power-mono":
+            loading = power_iteration_mono(
+                xmm, mu, reputation, min(int(power_iters), _MONO_MAX_ITERS),
+                interpret=jax.default_backend() != "tpu").astype(acc)
+        else:
+            loading = power_iteration_fused(
+                xmm, mu, denom, reputation, power_iters, power_tol,
+                interpret=jax.default_backend() != "tpu").astype(acc)
         # scores = (X - mu) @ loading without materializing the centered
         # matrix: X @ loading is one sweep; mu . loading is a scalar
         scores = (jnp.matmul(reports_filled,
@@ -347,7 +353,7 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
     the scalable exact option here (O(R²) memory, never E×E)."""
     dev, denom = _center(reports_filled, reputation)
     R, E = reports_filled.shape
-    if method in ("auto", "power", "power-fused"):
+    if method in ("auto", "power", "power-fused", "power-mono"):
         method = "eigh-cov" if E <= 1024 else "eigh-gram"
     if method not in ("eigh-cov", "eigh-gram"):
         raise ValueError(f"unknown PCA method: {method!r}")
@@ -481,9 +487,17 @@ def direction_fixed_scores(scores, reports_filled, reputation):
     return jnp.where(ref_ind <= 0.0, set1, -set2)
 
 
+#: sweep cap for the fixed-trip-count "power-mono" kernel: the early-exit
+#: loop typically stops after ~4-6 sweeps, so 16 fixed sweeps converge at
+#: least as far while bounding the cost of the default power_iters=128
+#: budget (which is sized for the early-exit path)
+_MONO_MAX_ITERS = 16
+
+
 def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
                               power_tol: float, matvec_dtype: str = "",
-                              interpret: bool = False, fill=None, mu=None):
+                              interpret: bool = False, fill=None, mu=None,
+                              mono: bool = False):
     """The whole sztorc scoring step on the Pallas fast path: power-iteration
     PCA (one HBM sweep per step, pallas_kernels.apply_weighted_cov) followed
     by the scores + direction-fix contractions in ONE further sweep
@@ -504,8 +518,16 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     With ``fill`` (and the matching precomputed ``mu``) the input is
     NaN-threaded storage — absent entries NaN, filled values reconstructed
     in-register by the kernels — so the filled matrix never exists in HBM.
+
+    ``mono=True`` (EXPERIMENTAL, ``pca_method="power-mono"``) swaps the
+    per-sweep kernel loop for the single-launch
+    :func:`pallas_kernels.power_iteration_mono` — a FIXED trip count with
+    no early exit, capped at :data:`_MONO_MAX_ITERS` sweeps so the
+    default ``power_iters=128`` budget (sized for the early-exit loop)
+    cannot silently become 128 full HBM sweeps.
     """
-    from .pallas_kernels import power_iteration_fused, scores_dirfix_pass
+    from .pallas_kernels import (power_iteration_fused,
+                                 power_iteration_mono, scores_dirfix_pass)
 
     acc = reputation.dtype
     if fill is None:
@@ -515,9 +537,16 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
         denom = jnp.where(denom == 0.0, 1.0, denom)
     xmm = (reports_filled.astype(jnp.dtype(matvec_dtype)) if matvec_dtype
            else reports_filled)
-    loading = power_iteration_fused(xmm, mu, denom, reputation, power_iters,
-                                    power_tol, fill=fill,
-                                    interpret=interpret).astype(acc)
+    if mono:
+        loading = power_iteration_mono(xmm, mu, reputation,
+                                       min(int(power_iters),
+                                           _MONO_MAX_ITERS),
+                                       fill=fill,
+                                       interpret=interpret).astype(acc)
+    else:
+        loading = power_iteration_fused(xmm, mu, denom, reputation,
+                                        power_iters, power_tol, fill=fill,
+                                        interpret=interpret).astype(acc)
     t, q, c, o = scores_dirfix_pass(xmm, reputation, loading, fill=fill,
                                     interpret=interpret)
     ml = mu @ loading

@@ -514,9 +514,11 @@ def power_iteration_mono(x, mu, rep, n_iters: int, fill=None,
     the unit-norm loading (degenerate zero-covariance inputs fall back
     to the last nonzero iterate, like the driver loop).
 
-    Not wired into any pipeline: the hypothesis that inter-kernel
-    scheduling bubbles cost ~10 ms per resolution could not be measured
-    on a quiet chip in round 1 (docs/ROADMAP.md).
+    Opt-in via ``pca_method="power-mono"`` (sweep count capped at
+    ``jax_kernels._MONO_MAX_ITERS`` there); never auto-selected — the
+    hypothesis that inter-kernel scheduling bubbles cost ~10 ms per
+    resolution could not be measured on a quiet chip in round 1
+    (docs/ROADMAP.md).
     """
     if int(n_iters) < 1:
         raise ValueError("n_iters must be >= 1 (an empty grid would "

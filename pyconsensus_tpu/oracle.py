@@ -142,6 +142,7 @@ class Oracle:
     pca_method : str
         JAX PCA strategy: ``auto`` | ``eigh-cov`` | ``eigh-gram`` | ``power``
         | ``power-fused`` (Pallas one-HBM-pass kernel, single-device TPU)
+        | ``power-mono`` (experimental single-launch loop, opt-in only)
         (SURVEY.md §7 "hard parts" — never materialize E×E at scale).
     power_iters, power_tol, matvec_dtype :
         Power-iteration cap, early-exit tolerance (0 = machine-precision

@@ -36,8 +36,9 @@ __all__ = ["sharded_consensus", "ShardedOracle", "PlacedBounds",
            "place_event_bounds"]
 
 #: PCA methods that never materialize the E×E covariance and whose
-#: contractions ride the event axis (SURVEY.md §7 "hard parts")
-_SHARDABLE_PCA = ("eigh-gram", "power", "power-fused")
+#: contractions ride the event axis (SURVEY.md §7 "hard parts");
+#: "power-mono" is the experimental single-launch kernel (docs/ROADMAP.md)
+_SHARDABLE_PCA = ("eigh-gram", "power", "power-fused", "power-mono")
 #: algorithms needing the full top-k spectrum (first-PC-only power iteration
 #: cannot serve them; the R×R Gram eigh is their scalable exact path)
 _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
@@ -48,10 +49,12 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
     if params.algorithm in _MULTI_COMPONENT_ALGOS:
         return "eigh-gram"
     if params.pca_method in _SHARDABLE_PCA:
-        # the Pallas kernel is a black box to the GSPMD partitioner — an
-        # explicit "power-fused" request downgrades to the XLA matvecs on a
-        # multi-device mesh so the event-axis contractions actually shard
-        if params.pca_method == "power-fused" and n_devices > 1:
+        # the Pallas kernels are black boxes to the GSPMD partitioner — an
+        # explicit "power-fused"/"power-mono" request downgrades to the XLA
+        # matvecs on a multi-device mesh so the event-axis contractions
+        # actually shard
+        if (params.pca_method in ("power-fused", "power-mono")
+                and n_devices > 1):
             return "power"
         return params.pca_method
     # "auto"/"eigh-cov" on a sharded matrix would build E×E — never do that;
@@ -97,7 +100,7 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     return (n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
-            and params.pca_method in ("power", "power-fused")
+            and params.pca_method in ("power", "power-fused", "power-mono")
             and scaled_ok
             and _pick_chunk(n_reporters) is not None
             and fused_pca_fits(n_events, itemsize)

@@ -401,6 +401,30 @@ class TestPallasFused:
             interpret=True))
         np.testing.assert_allclose(_align_sign(mono, ref), ref, atol=1e-4)
 
+    def test_power_mono_multi_panel_carry(self, rng, monkeypatch):
+        """The (i>0, j==0) finalize-then-accumulate carry across MULTIPLE
+        row panels (n_panels > 1, forced via a tiny panel budget) must
+        match the single-panel result — the cross-grid-step VMEM state is
+        where a mis-carry would hide."""
+        import pyconsensus_tpu.ops.pallas_kernels as pk
+        R, E, k = 24, 9, 16
+        X = jnp.asarray(rng.random((R, E)), jnp.float32)
+        rep = jnp.asarray(nk.normalize(rng.random(R) + 0.1), jnp.float32)
+        mu = rep @ X
+        single = np.asarray(pk.power_iteration_mono(X, mu, rep, n_iters=k,
+                                                    interpret=True))
+        monkeypatch.setattr(pk, "_PANEL_BYTES", 64)   # 8-row panels -> 3
+        # the panel size is baked in at trace time; without a cache clear
+        # the second call would silently reuse the single-panel program
+        import jax
+
+        jax.clear_caches()
+        multi = np.asarray(pk.power_iteration_mono(X, mu, rep, n_iters=k,
+                                                   interpret=True))
+        assert X.shape[0] // pk._panel_rows(E, 4, pk._PANEL_BYTES) == 3
+        np.testing.assert_allclose(_align_sign(multi, single), single,
+                                   atol=1e-5)
+
     def test_power_mono_degenerate_and_validation(self, rng):
         """Zero covariance (identical rows) must not return NaN, and an
         empty grid is rejected."""
