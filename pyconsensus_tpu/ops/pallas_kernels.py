@@ -402,9 +402,13 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
     sweep (binary events; jax_kernels.resolve_outcomes +
     certainty_and_bonuses semantics on NaN-threaded storage).
 
-    x : (R, E) reports with NaN marking absence (f32 or bf16); R must have a
-        divisor that is a multiple of 8 and <= 1024 (_pick_chunk) — the
-        pipeline gate checks this before routing here.
+    x : (R, E) reports with NaN marking absence (f32 or bf16). When R has
+        no 8-multiple divisor <= 1024 (_pick_chunk — e.g. a prime reporter
+        count) the matrix is zero-padded to the next multiple of 8: padded
+        rows are non-NaN with zero reputation, so they contribute exactly
+        nothing to any column accumulation, and their row outputs are
+        sliced off. The pad costs one extra HBM copy of the matrix — far
+        cheaper than the multi-pass XLA fallback it replaces.
     rep : (R,) final (smooth) reputation. fill : (E,) per-column fill values
     (computed from the INITIAL reputation — interpolate semantics).
     full_total : () sum of ``rep`` (the XLA path's zero-guarded total).
@@ -416,19 +420,19 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
     """
     R, E = x.shape
     f32 = jnp.float32
-    chunk = _pick_chunk(R)
-    if chunk is None:
-        raise ValueError(f"R={R} has no 8-multiple divisor <= 1024; use the "
-                         "XLA resolution path")
-    n_chunks = R // chunk
+    x, rep = _pad_rows(x, rep, 8)        # no-op when R is a multiple of 8
+    Rp = x.shape[0]
+    chunk = _pick_chunk(Rp)              # always found: 8 divides Rp
+    n_chunks = Rp // chunk
     if not block_cols:          # 0 = auto: widest block that fits VMEM
         if interpret:
             block_cols = 128    # the interpreter has no VMEM limit
         else:
-            block_cols = _resolve_block_cols(R, x.dtype.itemsize)
+            block_cols = _resolve_block_cols(Rp, x.dtype.itemsize)
             if block_cols is None:
-                raise ValueError(f"R={R} does not fit the fused resolution "
-                                 "kernel's VMEM budget; use the XLA path")
+                raise ValueError(f"R={R} (padded to {Rp}) does not fit the "
+                                 "fused resolution kernel's VMEM budget; "
+                                 "use the XLA path")
     C = min(block_cols, E)
     n_blocks = pl.cdiv(E, C)
     fv = jnp.concatenate([
@@ -436,15 +440,17 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
         jnp.broadcast_to(jnp.asarray(full_total, f32), (1, E)),
     ])
     col_spec = pl.BlockSpec((1, C), lambda j: (0, j), memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((R, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((Rp, 1), lambda j: (0, 0),
+                            memory_space=pltpu.VMEM)
     raw, out, cert, pcol, prow, narow = pl.pallas_call(
         functools.partial(_resolve_certainty_kernel,
                           tolerance=float(tolerance), chunk=chunk,
                           n_chunks=n_chunks, n_events=E),
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((R, C), lambda j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((R, 1), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rp, C), lambda j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rp, 1), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((2, C), lambda j: (0, j), memory_space=pltpu.VMEM),
         ],
         out_specs=[col_spec, col_spec, col_spec, col_spec,
@@ -454,16 +460,16 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
             jax.ShapeDtypeStruct((1, E), f32),
             jax.ShapeDtypeStruct((1, E), f32),
             jax.ShapeDtypeStruct((1, E), f32),
-            jax.ShapeDtypeStruct((R, 1), f32),
-            jax.ShapeDtypeStruct((R, 1), f32),
+            jax.ShapeDtypeStruct((Rp, 1), f32),
+            jax.ShapeDtypeStruct((Rp, 1), f32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=10 * R * E, bytes_accessed=R * E * x.dtype.itemsize,
+            flops=10 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
             transcendentals=0),
         interpret=interpret,
     )(x, rep.astype(f32).reshape(-1, 1), fv)
     return (raw.reshape(E), out.reshape(E), cert.reshape(E), pcol.reshape(E),
-            prow.reshape(R), narow.reshape(R))
+            prow.reshape(Rp)[:R], narow.reshape(Rp)[:R])
 
 
 def _power_mono_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *,

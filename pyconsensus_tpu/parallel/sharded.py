@@ -82,12 +82,13 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     the XLA path) — the sztorc algorithm scored by power iteration
     (``params.pca_method`` must already be resolved — an explicit or
     auto-picked exact eigh must NOT be silently swapped for power
-    iteration), a reporter count the fused resolution kernel's row-chunk
-    loop can tile, and a shape that fits the kernels' scoped-VMEM budget
+    iteration), and a shape that fits the kernels' scoped-VMEM budget
     (out-of-budget shapes take the XLA path — correct, just fewer fused
-    passes)."""
-    from ..ops.pallas_kernels import (_pick_chunk, fused_pca_fits,
-                                      resolve_kernel_fits)
+    passes). A reporter count with no tileable row-chunk divisor (e.g. a
+    prime R) is handled inside resolve_certainty_fused by zero-rep row
+    padding, so it no longer disqualifies the fast path — the VMEM fit is
+    checked at the padded count."""
+    from ..ops.pallas_kernels import fused_pca_fits, resolve_kernel_fits
 
     # actual matrix itemsize: the storage dtype if set, else the default
     # compute dtype (8 under jax_enable_x64 — modeling that as 4 would
@@ -97,14 +98,16 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
                 else jax.numpy.asarray(0.0).dtype.itemsize)
     scaled_ok = (not params.any_scaled
                  or 0 < params.n_scaled <= n_events // 8)
+    # the same next-multiple-of-8 the kernel pads to (a no-op for
+    # already-tileable counts)
+    r_padded = n_reporters + (-n_reporters) % 8
     return (n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
             and params.pca_method in ("power", "power-fused", "power-mono")
             and scaled_ok
-            and _pick_chunk(n_reporters) is not None
             and fused_pca_fits(n_events, itemsize)
-            and resolve_kernel_fits(n_reporters, itemsize))
+            and resolve_kernel_fits(r_padded, itemsize))
 
 
 class PlacedBounds(NamedTuple):

@@ -114,12 +114,15 @@ class TestFusedResolution:
     light pipeline key-for-key — it replaces the fill/PCA/direction-fix/
     outcome/certainty passes with fused kernels but not their semantics."""
 
-    @pytest.mark.parametrize("max_iterations", [1, 4])
-    def test_matches_xla_light_path(self, rng, max_iterations):
+    @pytest.mark.parametrize("R,max_iterations", [(24, 1), (24, 4),
+                                                  (23, 1)])
+    def test_matches_xla_light_path(self, rng, R, max_iterations):
+        """R=23 (prime, no 8-multiple chunk divisor) exercises the resolve
+        kernel's zero-rep row-padding path."""
         from pyconsensus_tpu.models.pipeline import (_consensus_core_fused,
                                                      _consensus_core_light)
         import jax.numpy as jnp
-        reports = make_reports(rng, R=24, E=7)    # ragged vs 128-col blocks
+        reports = make_reports(rng, R=R, E=7)     # ragged vs 128-col blocks
         R, E = reports.shape
         rep = np.full(R, 1.0 / R)
         args = (jnp.asarray(reports), jnp.asarray(rep),
@@ -223,6 +226,9 @@ class TestFusedResolution:
         assert sh._use_fused_resolution(p, 10_000, 100_000, 1)
         ok = p._replace(any_scaled=True, n_scaled=1000)
         assert sh._use_fused_resolution(ok, 10_000, 100_000, 1)
+        # prime R no longer disqualifies: the resolve kernel zero-pads to
+        # a tileable row count
+        assert sh._use_fused_resolution(p, 10_007, 100_000, 1)
         heavy = p._replace(any_scaled=True, n_scaled=20_000)
         assert not sh._use_fused_resolution(heavy, 10_000, 100_000, 1)
         uncounted = p._replace(any_scaled=True, n_scaled=0)
@@ -268,7 +274,6 @@ class TestFusedResolution:
         assert not _use_fused_resolution(
             p._replace(any_scaled=True), 10_000, 100_000, 1)
         assert not _use_fused_resolution(p, 10_000, 100_000, 8)
-        assert not _use_fused_resolution(p, 10_007, 100_000, 1)  # prime R
 
     def test_vmem_fit_models(self):
         """The scoped-VMEM fit models encode the measured compile failures:
