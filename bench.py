@@ -23,18 +23,28 @@ The matrix is generated on device (no multi-GB host transfer), events are
 sharded over every available chip, and the resolution runs the full pipeline:
 NA interpolation, matrix-free power-iteration PCA, direction fix, reputation
 redistribution, outcome resolution, certainty/bonus accounting.
+
+Fail-soft contract (added round 2 after BENCH_r01.json recorded rc=1 with no
+parseable output): the tunneled axon TPU backend can wedge so hard that even
+``import jax`` hangs forever, so the parent process here never imports jax.
+It probes the backend in a killable subprocess, runs the real benchmark as a
+child with a bounded timeout, and ALWAYS prints exactly one JSON line: the
+child's measurement on success, or ``{"value": 0.0, "error": ...}`` plus a
+CPU-fallback smoke result on any failure, so ``BENCH_r*.json`` always parses.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+#: environment that forces the CPU backend even under the axon sitecustomize
+#: hook (the empty pool-IPs var must be set before the interpreter starts)
+_CPU_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
 
 
 def generate_reports_device(key, R: int, E: int, na_frac: float,
@@ -43,6 +53,9 @@ def generate_reports_device(key, R: int, E: int, na_frac: float,
     built entirely on device — the simulator's public generator plus an NA
     mask (non-participation is a bench-only concern; simulator trials are
     dense)."""
+    import jax
+    import jax.numpy as jnp
+
     from pyconsensus_tpu.sim import generate_reports
 
     k_gen, k_na = jax.random.split(key)
@@ -52,7 +65,7 @@ def generate_reports_device(key, R: int, E: int, na_frac: float,
     return jnp.where(na, jnp.nan, reports)
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--reporters", type=int, default=10_000)
     ap.add_argument("--events", type=int, default=100_000)
@@ -96,7 +109,24 @@ def main() -> None:
                          "bfloat16 halves every O(R*E) phase's HBM traffic; "
                          "outcomes are asserted bit-identical to the full-"
                          "precision path on every run. Pass '' for f32")
-    args = ap.parse_args()
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="seconds allowed for the backend-availability "
+                         "probe subprocess (a wedged axon tunnel hangs "
+                         "'import jax' forever; the probe is killable)")
+    ap.add_argument("--bench-timeout", type=float, default=900.0,
+                    help="seconds allowed for the benchmark child process "
+                         "before it is killed and an error JSON is emitted")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return ap
+
+
+def run_bench(args) -> None:
+    """The actual benchmark — only ever runs in the child process, where a
+    hang costs the parent's bounded timeout rather than the round's
+    benchmark artifact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from pyconsensus_tpu.models.pipeline import ConsensusParams
     from pyconsensus_tpu.parallel import make_mesh, sharded_consensus
@@ -231,6 +261,122 @@ def main() -> None:
         "value": round(value, 4),
         "unit": "resolutions/sec",
         "vs_baseline": round(value / target_resolutions_per_sec, 4),
+        "latency_s": round(latency, 4),
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+    }))
+
+
+def _probe_backend(timeout: float):
+    """Ask a killable subprocess what backend jax comes up on. Returns
+    ``(backend_name, n_devices)`` or ``(None, reason)`` — never hangs."""
+    code = ("import jax; d = jax.devices(); "
+            "print(jax.default_backend(), len(d))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"probe timed out after {timeout:.0f}s (tunnel wedged)"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:]
+        return None, f"probe failed rc={r.returncode}: {' '.join(tail)}"
+    # parse only the LAST line — jax/libtpu init may print banners first
+    try:
+        backend, n = r.stdout.strip().splitlines()[-1].split()
+        return backend, int(n)
+    except (IndexError, ValueError):
+        return None, f"unparseable probe output: {r.stdout!r}"
+
+
+def _run_child(argv, timeout: float, env_extra=None):
+    """Run ``bench.py --child argv...`` with a hard timeout; return
+    ``(json_line_or_None, reason)``. Child stderr is relayed."""
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    cmd = [sys.executable, os.path.abspath(__file__), *argv, "--child"]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"benchmark child timed out after {timeout:.0f}s"
+    if r.stderr:
+        sys.stderr.write(r.stderr)
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            json.loads(line)
+            return line, ""
+        except ValueError:
+            continue
+    tail = (r.stderr or "").strip().splitlines()[-3:]
+    return None, (f"child rc={r.returncode}, no JSON line; "
+                  f"stderr tail: {' | '.join(tail)}")
+
+
+def _strip_flag(argv, *names):
+    """Remove ``--name value`` / ``--name=value`` pairs from argv."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in names:
+            skip = True
+            continue
+        if any(a.startswith(n + "=") for n in names):
+            continue
+        out.append(a)
+    return out
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    if args.child:
+        run_bench(args)
+        return
+
+    argv = [a for a in sys.argv[1:] if a != "--child"]
+    suffix = f"_scaled{args.scaled}" if args.scaled else ""
+    metric = (f"consensus_resolutions_per_sec_"
+              f"{args.reporters}x{args.events}{suffix}")
+
+    backend, info = _probe_backend(args.probe_timeout)
+    error = None
+    if backend is None:
+        error = f"backend unavailable: {info}"
+    else:
+        line, reason = _run_child(argv, args.bench_timeout)
+        if line is not None:
+            print(line)
+            return
+        error = f"benchmark failed on backend={backend}: {reason}"
+
+    # Degraded path: the headline number is unmeasurable, but the artifact
+    # must still parse and should carry proof the pipeline itself works —
+    # a small CPU smoke run (auto-picks the eigh-gram exact path on CPU).
+    print(f"WARNING: {error}; running CPU fallback smoke", file=sys.stderr)
+    smoke_argv = _strip_flag(argv, "--reporters", "--events", "--repeats",
+                             "--batches", "--storage-dtype", "--scaled",
+                             "--pca-method")
+    smoke_argv += ["--reporters", "256", "--events", "2048",
+                   "--repeats", "2", "--batches", "2",
+                   "--storage-dtype", "", "--pca-method", "auto"]
+    if args.scaled:
+        smoke_argv += ["--scaled", str(max(1, min(args.scaled, 256)))]
+    smoke_line, smoke_reason = _run_child(
+        smoke_argv, min(300.0, args.bench_timeout), env_extra=_CPU_ENV)
+    smoke = None
+    if smoke_line is not None:
+        smoke = json.loads(smoke_line)
+    else:
+        error += f"; cpu smoke also failed: {smoke_reason}"
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": "resolutions/sec",
+        "vs_baseline": 0.0,
+        "error": error,
+        "degraded_cpu_smoke": smoke,
     }))
 
 

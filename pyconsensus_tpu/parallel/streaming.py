@@ -253,14 +253,19 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
                         params: Optional[ConsensusParams] = None,
                         mesh=None, host_id: Optional[int] = None,
                         n_hosts: Optional[int] = None,
-                        allreduce=None) -> dict:
+                        allreduce=None, staging_dir=None) -> dict:
     """Resolve an oracle whose reports matrix never fits on device.
 
     ``reports_src``: numpy array / ``np.memmap`` / path to an ``.npy``
     file (loaded memory-mapped) or a ``.csv`` file (staged incrementally
-    to a temporary ``.npy`` beside it via :func:`..io.csv_to_npy` —
-    chunked parse, so peak host memory stays one row-chunk even for text
-    files bigger than RAM; the staging file is removed after resolution).
+    to a temporary ``.npy`` via :func:`..io.csv_to_npy` — chunked parse,
+    so peak host memory stays one row-chunk even for text files bigger
+    than RAM; the staging file is removed after resolution). The staging
+    file goes to ``staging_dir`` if given, else beside the source CSV —
+    NOT the system temp dir, which is often a RAM-backed tmpfs where a
+    bigger-than-RAM staging file would defeat the out-of-core design —
+    falling back to the system temp dir only when the source directory
+    is not writable.
     Returns the light result dict as host numpy arrays. See the module
     docstring for the pass structure (``executed iterations + 1``) and
     restrictions.
@@ -283,30 +288,46 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     local chips shard its panels).
     """
     staged = None
-    if isinstance(reports_src, (str, bytes)) or hasattr(reports_src,
-                                                        "__fspath__"):
+    is_path = (isinstance(reports_src, (str, bytes))
+               or hasattr(reports_src, "__fspath__"))
+    if is_path:
         import pathlib
         import tempfile
-
-        from ..io import csv_to_npy, load_reports
 
         src_path = pathlib.Path(
             reports_src if not isinstance(reports_src, bytes)
             else reports_src.decode())
         if src_path.suffix == ".csv":
-            # a per-call unique temp file: a fixed name beside the source
-            # would let two concurrent resolutions of the same CSV truncate
-            # each other's staging mid-mmap, and fails for read-only data
-            # directories
-            fd, name = tempfile.mkstemp(suffix=".npy",
-                                        prefix=f"{src_path.stem}-stage-")
+            # a per-call unique temp file (a fixed name would let two
+            # concurrent resolutions of the same CSV truncate each other's
+            # staging mid-mmap), placed on real disk beside the source —
+            # the system temp dir is often RAM-backed tmpfs, where a
+            # bigger-than-RAM staging file would defeat out-of-core — with
+            # a tempdir fallback only for read-only source directories
+            kw = dict(suffix=".npy", prefix=f"{src_path.stem}-stage-")
+            try:
+                fd, name = tempfile.mkstemp(
+                    dir=staging_dir if staging_dir is not None
+                    else src_path.parent, **kw)
+            except OSError:
+                if staging_dir is not None:
+                    raise
+                fd, name = tempfile.mkstemp(**kw)
             os.close(fd)
             staged = pathlib.Path(name)
+    # the unlink must also cover a failure *during* staging (ENOSPC,
+    # malformed CSV row) — especially now that staging lands beside the
+    # user's data instead of in the system temp dir
+    try:
+        if staged is not None:
+            from ..io import csv_to_npy, load_reports
+
             csv_to_npy(src_path, staged)
             reports_src = load_reports(staged, mmap=True)
-        else:
+        elif is_path:
+            from ..io import load_reports
+
             reports_src = load_reports(reports_src, mmap=True)
-    try:
         return _streaming_consensus_impl(reports_src, reputation,
                                          event_bounds, panel_events, params,
                                          mesh, host_id, n_hosts, allreduce)

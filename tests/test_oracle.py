@@ -102,6 +102,114 @@ class TestCanonical:
         assert result["participation"] == pytest.approx(1.0)
 
 
+# Provisional golden vectors, frozen 2026-07-30 from the x64 numpy backend
+# at full printed precision (VERDICT r1 item 2). The reference mount was
+# empty every round so far, so these are NOT reference-derived numbers —
+# they pin the *reconstruction itself*: a regression in ops/numpy_kernels.py
+# now flips a test even when the numpy and jax backends agree on the wrong
+# answer. If /root/reference/ is ever populated, SURVEY.md §8 step 6
+# replaces these with true R-derived vectors.
+GOLDEN = {
+    ("canonical", 1): dict(
+        this_rep=[0.28237569612767888, 0.21762430387232110,
+                  0.28237569612767888, 0.21762430387232112, -0.0, -0.0],
+        smooth_rep=[0.17823756961276790, 0.17176243038723213,
+                    0.17823756961276790, 0.17176243038723213,
+                    0.15000000000000002, 0.15000000000000002],
+        outcomes_final=[1.0, 0.5, 0.5, 0.0],
+        event_certainty=[0.7000000000000001, 0.0, 0.0, 0.7000000000000001],
+        certainty=0.35000000000000003),
+    ("canonical", 5): dict(
+        this_rep=[0.30126300085578023, 0.19873699914421977,
+                  0.30126300085578023, 0.19873699914421980, -0.0, -0.0],
+        smooth_rep=[0.21837130847656355, 0.18321369152343653,
+                    0.21837130847656355, 0.18321369152343650,
+                    0.09841500000000003, 0.09841500000000003],
+        outcomes_final=[1.0, 1.0, 0.0, 0.0],
+        event_certainty=[0.8031700000000001, 0.6199563084765636,
+                         0.6199563084765636, 0.8031700000000001],
+        certainty=0.7115631542382819),
+    ("missing", 1): dict(
+        this_rep=[0.26652951463940622, 0.20980124242454376,
+                  0.20980124242454376, 0.26652951463940622,
+                  0.04733848587209995, -0.0],
+        smooth_rep=[0.17665295146394064, 0.17098012424245440,
+                    0.17098012424245440, 0.17665295146394064,
+                    0.15473384858721001, 0.15000000000000002],
+        outcomes_final=[1.0, 0.5, 0.0, 0.0],
+        event_certainty=[0.8500000000000001, 0.0, 0.6952661514127901,
+                         0.6952661514127901],
+        certainty=0.560133075706395),
+    ("missing", 10): dict(
+        this_rep=[0.33575303704725679, 0.15721344838228046,
+                  0.15721344838228046, 0.33575303704725679,
+                  0.01406702914092549, -0.0],
+        smooth_rep=[0.25756389157837234, 0.17625435048947174,
+                    0.17625435048947174, 0.25756389157837234,
+                    0.07425044251431201, 0.05811307335000003],
+        outcomes_final=[1.0, 1.0, 0.0, 0.0],
+        event_certainty=[0.9418869266500002, 0.5151277831567447,
+                         0.8676364841356882, 0.8676364841356882],
+        certainty=0.7980719195195303),
+    ("scaled", 1): dict(
+        this_rep=[0.24035512601552864, 0.24805623658902839,
+                  0.24699855698679155, 0.25337041478453742,
+                  0.01121966562411400, -0.0],
+        smooth_rep=[0.17403551260155289, 0.17480562365890287,
+                    0.17469985569867919, 0.17533704147845378,
+                    0.15112196656241142, 0.15000000000000002],
+        outcomes_final=[1.0, 0.5, 0.0, 232.99999999999997, 16027.59],
+        event_certainty=[0.6988780334375887, 0.8253001443013209,
+                         0.6988780334375887, 0.6988780334375887,
+                         0.3487353683002321],
+        certainty=0.6541339225828638),
+}
+
+_GOLDEN_INPUTS = {
+    "canonical": (CANONICAL, None),
+    "missing": (MISSING, None),
+    "scaled": (SCALED_REPORTS, SCALED_BOUNDS),
+}
+
+
+@pytest.mark.parametrize("fixture,max_iterations", sorted(GOLDEN))
+class TestGolden:
+    """Frozen-number regression tests over every golden fixture: the numpy
+    backend must reproduce the frozen vectors to float64 round-off, and the
+    jax backend must land on the identical catch-snapped outcomes plus the
+    same reputation to cross-backend tolerance."""
+
+    def test_numpy_matches_frozen(self, fixture, max_iterations):
+        reports, bounds = _GOLDEN_INPUTS[fixture]
+        g = GOLDEN[(fixture, max_iterations)]
+        r = Oracle(reports=reports, event_bounds=bounds, backend="numpy",
+                   max_iterations=max_iterations).consensus()
+        np.testing.assert_allclose(r["agents"]["this_rep"], g["this_rep"],
+                                   rtol=1e-12, atol=1e-14)
+        np.testing.assert_allclose(r["agents"]["smooth_rep"],
+                                   g["smooth_rep"], rtol=1e-12, atol=1e-14)
+        np.testing.assert_allclose(r["events"]["outcomes_final"],
+                                   g["outcomes_final"], rtol=1e-12)
+        np.testing.assert_allclose(r["events"]["certainty"],
+                                   g["event_certainty"], rtol=1e-12,
+                                   atol=1e-14)
+        assert r["certainty"] == pytest.approx(g["certainty"], rel=1e-12)
+
+    def test_jax_matches_frozen(self, fixture, max_iterations):
+        reports, bounds = _GOLDEN_INPUTS[fixture]
+        g = GOLDEN[(fixture, max_iterations)]
+        r = Oracle(reports=reports, event_bounds=bounds, backend="jax",
+                   max_iterations=max_iterations).consensus()
+        out = np.asarray(r["events"]["outcomes_final"])
+        binary = [i for i, b in enumerate(bounds or [None] * out.size)
+                  if not (b and b.get("scaled"))]
+        np.testing.assert_array_equal(
+            out[binary], np.asarray(g["outcomes_final"])[binary])
+        np.testing.assert_allclose(out, g["outcomes_final"], rtol=1e-6)
+        np.testing.assert_allclose(r["agents"]["smooth_rep"],
+                                   g["smooth_rep"], atol=5e-6)
+
+
 class TestMissing:
     def test_filled_no_nan(self):
         result = Oracle(reports=MISSING, max_iterations=10).consensus()
@@ -385,6 +493,11 @@ class TestValidation:
             Oracle(reports=CANONICAL, event_bounds=bounds)
         with pytest.raises(ValueError, match="entries"):
             Oracle(reports=CANONICAL, event_bounds=[None])
+
+    def test_power_mono_ignored_tol_warns(self):
+        with pytest.warns(UserWarning, match="power-mono.*power_tol"):
+            Oracle(reports=CANONICAL, backend="jax",
+                   pca_method="power-mono", power_tol=1e-5)
 
     def test_algorithm_aliases(self):
         o = Oracle(reports=CANONICAL, algorithm="kmeans")

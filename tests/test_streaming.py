@@ -77,6 +77,38 @@ class TestStreamingParity:
         final = out["outcomes_final"]
         assert not np.any(final == 1.0 - truth)
 
+    def test_csv_stages_beside_source(self, rng, tmp_path, monkeypatch):
+        """CSV staging lands in the source's directory (NOT the system temp
+        dir, which may be RAM-backed tmpfs) — or in an explicit
+        ``staging_dir`` — and is removed after resolution."""
+        from pyconsensus_tpu import io as io_mod
+        from pyconsensus_tpu.io import save_reports
+
+        reports, _ = collusion_reports(rng, R=16, E=12, liars=4)
+        src = save_reports(tmp_path / "big.csv", reports)
+        ref = reference_light(reports)
+        staged_at = []
+        real = io_mod.csv_to_npy
+
+        def spy(src_p, dst_p, **kw):
+            staged_at.append(dst_p)
+            return real(src_p, dst_p, **kw)
+
+        monkeypatch.setattr(io_mod, "csv_to_npy", spy)
+        out = streaming_consensus(src, panel_events=5)
+        np.testing.assert_array_equal(out["outcomes_final"],
+                                      ref["outcomes_final"])
+        assert staged_at[0].parent == tmp_path
+        other = tmp_path / "elsewhere"
+        other.mkdir()
+        out = streaming_consensus(src, panel_events=5, staging_dir=other)
+        np.testing.assert_array_equal(out["outcomes_final"],
+                                      ref["outcomes_final"])
+        assert staged_at[1].parent == other
+        # staging files cleaned up in both cases
+        assert list(tmp_path.glob("*-stage-*")) == []
+        assert list(other.glob("*-stage-*")) == []
+
     def test_rejects_unsupported(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
         with pytest.raises(ValueError, match="sztorc"):
