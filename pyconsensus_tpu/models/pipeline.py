@@ -98,6 +98,14 @@ class ConsensusParams(NamedTuple):
     #: them with the sort-based weighted median (O(R * n_scaled) — the gate
     #: only routes here when that is a small fraction of the matrix).
     n_scaled: int = 0
+    #: column-block width for the scaled-event weighted median (bounds the
+    #: single-device sort temporaries to one (R, block) slab); <= 0 runs
+    #: the median unblocked in one full-width pass. The sharded front-ends
+    #: force 0 whenever the mesh shards the event axis, via
+    #: parallel.mesh.effective_median_block — the one place that encodes
+    #: why (GSPMD cannot partition the block loop's dynamic_slice;
+    #: tests/test_hlo_collectives.py pins the collective bound).
+    median_block: int = jk._MEDIAN_BLOCK
 
 
 def _scores_np(filled, rep, p: ConsensusParams):
@@ -247,7 +255,8 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     rep, this_rep, loading, converged, iters = _iterate_jax(filled, old_rep, p)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         present, filled, rep, scaled, p.catch_tolerance,
-        any_scaled=p.any_scaled, has_na=p.has_na)
+        any_scaled=p.any_scaled, has_na=p.has_na,
+        median_block=p.median_block)
     outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
                       if p.any_scaled else outcomes_adjusted)
     extras = jk.certainty_and_bonuses(present, filled, rep, outcomes_adjusted,
@@ -516,7 +525,8 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     rep_dev = jnp.asarray(rep, dtype=filled.dtype)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         present, filled, rep_dev, scaled, p.catch_tolerance,
-        any_scaled=p.any_scaled, has_na=p.has_na)
+        any_scaled=p.any_scaled, has_na=p.has_na,
+        median_block=p.median_block)
     outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
     extras = jk.certainty_and_bonuses(present, filled, rep_dev,
                                       outcomes_adjusted, scaled,

@@ -387,7 +387,8 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
 _MEDIAN_BLOCK = 1024
 
 
-def weighted_median_cols(values, weights, present):
+def weighted_median_cols(values, weights, present,
+                         block_cols: int = _MEDIAN_BLOCK):
     """Per-column weighted median, vectorized over events
     (numpy_kernels.weighted_median, same comparisons and midpoint rule).
 
@@ -398,7 +399,7 @@ def weighted_median_cols(values, weights, present):
     block loop below, as large an allocation as the problem). Returns
     (E,).
 
-    Above ``_MEDIAN_BLOCK`` columns the computation runs as a ``lax.map``
+    Above ``block_cols`` columns the computation runs as a ``lax.map``
     over column blocks: the argsort / take-along-axis / cumsum
     temporaries then peak at one (R, block) slab instead of several full
     (R, E) copies — the full-width form was the single allocation that
@@ -406,25 +407,34 @@ def weighted_median_cols(values, weights, present):
     (measured: 10k x 100k f32 OOMs on a 16 GB chip). The ragged tail is
     one separate direct call (padding the operands would copy them
     whole). Per-column results are bitwise identical either way (each
-    column's math is self-contained)."""
+    column's math is self-contained).
+
+    ``block_cols <= 0`` disables blocking (one direct full-width pass).
+    REQUIRED on a multi-device event-sharded mesh: the block loop's
+    ``dynamic_slice`` over the sharded axis is unpartitionable — GSPMD
+    falls back to all-gathering the full (R, E) matrix onto every device
+    (verified in tests/test_hlo_collectives.py), while the unblocked
+    sort runs along the replicated R axis, fully local to each event
+    shard, and each device's shard already bounds the sort temporaries
+    to (R, E/n_devices)."""
     R, E = values.shape
-    if E > _MEDIAN_BLOCK:
-        n_full = E // _MEDIAN_BLOCK
+    if block_cols > 0 and E > block_cols:
+        n_full = E // block_cols
 
         # index-based map + dynamic_slice: the operands stay in their
         # original layout (a stacked/transposed operand would itself be
         # full (R, E) copies — as much memory as the problem)
         def one_block(i):
             sl = lambda a: lax.dynamic_slice_in_dim(  # noqa: E731
-                a, i * _MEDIAN_BLOCK, _MEDIAN_BLOCK, axis=1)
+                a, i * block_cols, block_cols, axis=1)
             w = weights if weights.ndim == 1 else sl(weights)
             return _weighted_median_cols_block(sl(values), w, sl(present))
 
         blocks = lax.map(one_block, jnp.arange(n_full)).reshape(-1)
-        tail = E - n_full * _MEDIAN_BLOCK
+        tail = E - n_full * block_cols
         if not tail:
             return blocks
-        start = n_full * _MEDIAN_BLOCK
+        start = n_full * block_cols
         tail_med = _weighted_median_cols_block(
             values[:, start:],
             weights if weights.ndim == 1 else weights[:, start:],
@@ -453,11 +463,16 @@ def _weighted_median_cols_block(values, weights, present):
     ge = cw >= 0.5
     idx = jnp.argmax(ge, axis=0)                      # first crossing
     idx = jnp.where(jnp.any(ge, axis=0), idx, R - 1)
-    cols = jnp.arange(values.shape[1])
-    cw_i = cw[idx, cols]
-    v_i = v[idx, cols]
+    # take_along_axis, NOT fancy `a[idx, arange(E)]` indexing: the latter
+    # lowers to a gather whose (E, 2) index tensor the GSPMD partitioner
+    # all-gathers across event shards; a per-column take along the
+    # replicated R axis stays shard-local
+    take_col = lambda a, i: jnp.take_along_axis(  # noqa: E731
+        a, i[None, :], axis=0)[0]
+    cw_i = take_col(cw, idx)
+    v_i = take_col(v, idx)
     nxt = jnp.clip(idx + 1, 0, R - 1)
-    v_n = v[nxt, cols]
+    v_n = take_col(v, nxt)
     # np.isclose(cw_i, 0.5) default tolerances: atol=1e-8, rtol=1e-5
     exact = jnp.abs(cw_i - 0.5) <= (1e-8 + 1e-5 * 0.5)
     has_next = (idx + 1 < R) & jnp.isfinite(v_n)
@@ -587,7 +602,8 @@ def smooth(this_rep, old_rep, alpha):
 
 
 def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
-                     any_scaled: bool = True, has_na: bool = True):
+                     any_scaled: bool = True, has_na: bool = True,
+                     median_block: int = _MEDIAN_BLOCK):
     """Vectorized outcome resolution (numpy_kernels.resolve_outcomes):
     participation-restricted renormalized reputation; weighted mean for binary
     columns, weighted median for scaled; catch-snap binary outcomes.
@@ -604,7 +620,9 @@ def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
     sort — the only O(R log R * E) phase of resolution — is skipped entirely;
     when ``has_na`` is False the participation-restriction reduces to the
     single full-reputation matvec (the mask is all-True), eliding two
-    (R, E) contractions.
+    (R, E) contractions. ``median_block`` is threaded to
+    :func:`weighted_median_cols` (<= 0 disables blocking — mandatory on a
+    multi-device event-sharded mesh, see that docstring).
     """
     acc = smooth_rep.dtype
     full_total = jnp.sum(smooth_rep)
@@ -624,7 +642,8 @@ def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
         tw = jnp.broadcast_to(full_total, (E,))
         means = full_mean
     if any_scaled:
-        medians = weighted_median_cols(reports_filled, smooth_rep, present)
+        medians = weighted_median_cols(reports_filled, smooth_rep, present,
+                                       block_cols=median_block)
         outcomes_raw = jnp.where(tw > 0.0, jnp.where(scaled, medians, means),
                                  means)
     else:

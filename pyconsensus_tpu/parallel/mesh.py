@@ -25,7 +25,24 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "event_sharding", "batch_event_sharding",
-           "replicated", "P", "Mesh", "NamedSharding"]
+           "replicated", "effective_median_block", "P", "Mesh",
+           "NamedSharding"]
+
+
+def effective_median_block(median_block: int, mesh: Optional[Mesh]) -> int:
+    """The ONE place that encodes the blocked-median / GSPMD constraint:
+    when the mesh actually shards the event axis, the blocked weighted
+    median's ``dynamic_slice`` over that axis is unpartitionable — GSPMD
+    falls back to all-gathering the full (R, E) operand onto every device
+    (tests/test_hlo_collectives.py pins the bound) — so the median must
+    run unblocked (0); each device's event shard then bounds the sort
+    temporaries to (R, E/n_event). An unsharded event axis (``event=1``,
+    including pure-batch meshes) keeps the caller's block width: there the
+    blocking is partitionable AND is the only thing bounding the sort
+    temporaries on a single device."""
+    if mesh is not None and mesh.shape.get("event", 1) > 1:
+        return 0
+    return median_block
 
 
 def make_mesh(batch: int = 1, event: Optional[int] = None,

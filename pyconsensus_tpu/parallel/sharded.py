@@ -30,7 +30,8 @@ import numpy as np
 from ..models.pipeline import (JIT_ALGORITHMS, ConsensusParams,
                                consensus_light_jit)
 from ..oracle import Oracle, assemble_result, parse_event_bounds
-from .mesh import Mesh, event_sharding, make_mesh, replicated
+from .mesh import (Mesh, effective_median_block, event_sharding, make_mesh,
+                   replicated)
 
 __all__ = ["sharded_consensus", "ShardedOracle", "PlacedBounds",
            "place_event_bounds"]
@@ -246,6 +247,7 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         # device-resident input: can't cheaply inspect for NaN on host — keep
         # the fill pass unless the caller's params already opted out
         has_na=bool(np.isnan(reports).any()) if is_host else p.has_na,
+        median_block=effective_median_block(p.median_block, mesh),
     )
     p = p._replace(fused_resolution=_use_fused_resolution(
         p, R, E, mesh.devices.size))
@@ -289,7 +291,9 @@ class ShardedOracle(Oracle):
         self.params = self.params._replace(
             pca_method=_pick_pca_method(self.params, self.reports.shape[0],
                                         self.mesh.devices.size),
-            n_scaled=int(np.asarray(self.scaled).sum()))
+            n_scaled=int(np.asarray(self.scaled).sum()),
+            median_block=effective_median_block(self.params.median_block,
+                                                self.mesh))
         self.params = self.params._replace(
             fused_resolution=_use_fused_resolution(
                 self.params, self.reports.shape[0], self.reports.shape[1],

@@ -61,6 +61,7 @@ from ..models.pipeline import ConsensusParams
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 from ..oracle import parse_event_bounds
+from .mesh import effective_median_block
 
 __all__ = ["streaming_consensus"]
 
@@ -93,9 +94,11 @@ def _pass1_panel(panel, fill_rep, weight_rep, scaled, mins, maxs, valid,
     return G, M, jnp.zeros_like(G)
 
 
-@functools.partial(jax.jit, static_argnames=("tolerance", "with_loading"))
+@functools.partial(jax.jit, static_argnames=("tolerance", "with_loading",
+                                             "median_block"))
 def _pass2_panel(panel, fill_rep, score_rep, final_rep, u_over_nAu, scaled,
-                 mins, maxs, tolerance: float, with_loading: bool = True):
+                 mins, maxs, tolerance: float, with_loading: bool = True,
+                 median_block: int = jk._MEDIAN_BLOCK):
     """Per-panel resolution with the final reputation: outcomes, certainty,
     participation columns, per-row NA partials, and this panel's slice of
     the first loading (``A^T u / ||A^T u||`` with ``score_rep``, the
@@ -106,7 +109,7 @@ def _pass2_panel(panel, fill_rep, score_rep, final_rep, u_over_nAu, scaled,
     filled, present = jk.interpolate_masked(rescaled, fill_rep, scaled,
                                             tolerance)
     raw, adjusted = jk.resolve_outcomes(present, filled, final_rep, scaled,
-                                        tolerance)
+                                        tolerance, median_block=median_block)
     final = jk.unscale_outcomes(adjusted, scaled, mins, maxs)
     agree = jnp.where(
         scaled[None, :],
@@ -536,7 +539,8 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     for start, stop, block, sc, mn, mx, _ in panels():
         raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
             block, fill_rep, score_rep, smooth_rep, u_over_nAu, sc, mn, mx,
-            tol, with_loading=p.algorithm == "sztorc")
+            tol, with_loading=p.algorithm == "sztorc",
+            median_block=effective_median_block(p.median_block, mesh))
         width = stop - start
         outcomes_raw[start:stop] = np.asarray(raw)[:width]
         outcomes_adjusted[start:stop] = np.asarray(adjd)[:width]
