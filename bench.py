@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "precision) to prove it. Pass 0 for the "
                          "machine-precision floor")
     ap.add_argument("--max-iterations", type=int, default=1)
+    ap.add_argument("--algorithm", default="sztorc",
+                    choices=["sztorc", "fixed-variance", "ica", "k-means",
+                             "dbscan-jit"],
+                    help="jit algorithm to benchmark (non-default choices "
+                         "suffix the metric name so the headline sztorc "
+                         "series stays pure)")
     ap.add_argument("--scaled", type=int, default=0, metavar="N",
                     help="make the last N events scaled (bounds [-5, 15]); "
                          "default 0 keeps the headline all-binary workload. "
@@ -157,7 +163,7 @@ def run_bench(args) -> None:
     jax.block_until_ready(reports)
 
     params = ConsensusParams(
-        algorithm="sztorc", max_iterations=args.max_iterations,
+        algorithm=args.algorithm, max_iterations=args.max_iterations,
         pca_method=args.pca_method, power_iters=args.power_iters,
         power_tol=args.power_tol, matvec_dtype=args.matvec_dtype,
         storage_dtype=args.storage_dtype, has_na=True)
@@ -255,7 +261,7 @@ def run_bench(args) -> None:
                                        full_outcomes[n_binary:], atol=5e-3)
 
     target_resolutions_per_sec = 1.0   # north star: < 1 s per resolution
-    suffix = f"_scaled{args.scaled}" if args.scaled else ""
+    suffix = _metric_suffix(args)
     print(json.dumps({
         "metric": f"consensus_resolutions_per_sec_{R}x{E}{suffix}",
         "value": round(value, 4),
@@ -265,6 +271,13 @@ def run_bench(args) -> None:
         "backend": jax.default_backend(),
         "n_devices": n_dev,
     }))
+
+
+def _metric_suffix(args) -> str:
+    """Non-default algorithm / scaled-event runs get their own metric name
+    so the driver's headline sztorc series is never mixed with variants."""
+    return ((f"_{args.algorithm}" if args.algorithm != "sztorc" else "")
+            + (f"_scaled{args.scaled}" if args.scaled else ""))
 
 
 def _probe_backend(timeout: float):
@@ -336,9 +349,8 @@ def main() -> None:
         return
 
     argv = [a for a in sys.argv[1:] if a != "--child"]
-    suffix = f"_scaled{args.scaled}" if args.scaled else ""
     metric = (f"consensus_resolutions_per_sec_"
-              f"{args.reporters}x{args.events}{suffix}")
+              f"{args.reporters}x{args.events}{_metric_suffix(args)}")
 
     backend, info = _probe_backend(args.probe_timeout)
     error = None

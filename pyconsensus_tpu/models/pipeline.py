@@ -256,7 +256,7 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         present, filled, rep, scaled, p.catch_tolerance,
         any_scaled=p.any_scaled, has_na=p.has_na,
-        median_block=p.median_block)
+        median_block=p.median_block, n_scaled=p.n_scaled)
     outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
                       if p.any_scaled else outcomes_adjusted)
     extras = jk.certainty_and_bonuses(present, filled, rep, outcomes_adjusted,
@@ -526,7 +526,7 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         present, filled, rep_dev, scaled, p.catch_tolerance,
         any_scaled=p.any_scaled, has_na=p.has_na,
-        median_block=p.median_block)
+        median_block=p.median_block, n_scaled=p.n_scaled)
     outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
     extras = jk.certainty_and_bonuses(present, filled, rep_dev,
                                       outcomes_adjusted, scaled,

@@ -71,6 +71,23 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
     return "power"
 
 
+def _xla_path_n_scaled(p: ConsensusParams, n_events: int, mesh: Mesh) -> int:
+    """The static scaled count the XLA (non-fused) path should carry.
+    Keeping it is a trade: resolve_outcomes can then median a static
+    gather of just the scaled columns (the scaled-heavy latency fix —
+    sort work drops by E/n_scaled), but the jit cache keys on the COUNT,
+    recompiling per distinct value. Keep it exactly when the gather path
+    would actually fire: single-device event axis (a cross-shard gather
+    would move (R, n_scaled) over ICI — the sharded median is local) and
+    a minority of scaled columns; otherwise zero it so the cache keys
+    only on ``any_scaled``."""
+    if (mesh.shape.get("event", 1) == 1
+            and p.median_block > 0          # unblocked mode ignores n_scaled
+            and 0 < p.n_scaled and p.n_scaled * 2 < n_events):
+        return p.n_scaled
+    return 0
+
+
 def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
                           n_events: int, n_devices: int) -> bool:
     """Gate for the NaN-threaded Pallas fast path
@@ -252,10 +269,7 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
     p = p._replace(fused_resolution=_use_fused_resolution(
         p, R, E, mesh.devices.size))
     if not p.fused_resolution:
-        # only the fused gather reads n_scaled; keeping it in the
-        # jit-static params on the XLA path would recompile the whole
-        # pipeline per distinct scaled COUNT instead of per any_scaled
-        p = p._replace(n_scaled=0)
+        p = p._replace(n_scaled=_xla_path_n_scaled(p, E, mesh))
     if reputation is None:
         reputation = _default_reputation_placed(mesh, R)   # cached, on device
         if event_bounds is None:
@@ -299,8 +313,10 @@ class ShardedOracle(Oracle):
                 self.params, self.reports.shape[0], self.reports.shape[1],
                 self.mesh.devices.size))
         if not self.params.fused_resolution:
-            # keep the jit cache keyed on any_scaled, not the scaled count
-            self.params = self.params._replace(n_scaled=0)
+            self.params = self.params._replace(
+                n_scaled=_xla_path_n_scaled(self.params,
+                                            self.reports.shape[1],
+                                            self.mesh))
 
     def place(self):
         """Optionally pin the oracle's inputs on device (event-sharded)

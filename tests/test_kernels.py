@@ -325,6 +325,37 @@ class TestResolveOutcomes:
             np.testing.assert_array_equal(np.asarray(adj_j)[~scaled],
                                           adj_np[~scaled])
 
+    def test_static_scaled_gather_bitwise(self, rng):
+        """The n_scaled static-gather fast path (median on just the scaled
+        columns) must be bitwise identical to the full-width median +
+        select — each column's math is self-contained, so gathering can't
+        change it. Covers NaN columns, blocked and unblocked widths, and
+        the guard cases (n_scaled=0, majority-scaled, median_block=0)
+        falling back to the full path."""
+        for trial in range(3):
+            reports, rep, scaled, mins, maxs = random_reports(rng)
+            rescaled = nk.rescale(reports, scaled, mins, maxs)
+            filled = nk.interpolate(rescaled, rep, scaled, 0.1)
+            present = jnp.asarray(~np.isnan(rescaled))
+            n_sc = int(scaled.sum())
+            if n_sc == 0 or n_sc * 2 >= scaled.size:
+                continue
+            args = (present, jnp.asarray(filled), jnp.asarray(rep),
+                    jnp.asarray(scaled), 0.1)
+            for block in (1024, 2):
+                full = jk.resolve_outcomes(*args, median_block=block)
+                fast = jk.resolve_outcomes(*args, median_block=block,
+                                           n_scaled=n_sc)
+                np.testing.assert_array_equal(np.asarray(fast[0]),
+                                              np.asarray(full[0]))
+                np.testing.assert_array_equal(np.asarray(fast[1]),
+                                              np.asarray(full[1]))
+            # guards: unblocked (sharded) mode must ignore n_scaled
+            a0 = jk.resolve_outcomes(*args, median_block=0)
+            a1 = jk.resolve_outcomes(*args, median_block=0, n_scaled=n_sc)
+            np.testing.assert_array_equal(np.asarray(a0[1]),
+                                          np.asarray(a1[1]))
+
     def test_bonuses_parity(self, rng):
         reports, rep, scaled, mins, maxs = random_reports(rng)
         rescaled = nk.rescale(reports, scaled, mins, maxs)

@@ -69,6 +69,29 @@ class TestShardedParity:
             np.asarray(out["outcomes_final"]),
             unsharded["events"]["outcomes_final"], rtol=1e-8)
 
+    def test_scaled_gather_path_single_device(self, rng):
+        """On a single-device (event=1) mesh the XLA path keeps the static
+        scaled count and medians a gather of just the scaled columns
+        (sharded._xla_path_n_scaled); outcomes must match the full-median
+        Oracle resolution exactly on binary columns and to float tolerance
+        on scaled medians."""
+        reports = make_reports(rng, E=16, na_frac=0.1)
+        bounds = [None] * 13 + [{"scaled": True, "min": 0.0,
+                                 "max": 10.0}] * 3
+        reports[:, 13:] = np.abs(reports[:, 13:]) * 10.0
+        mesh1 = make_mesh(batch=1, event=1)
+        out = sharded_consensus(reports, event_bounds=bounds, mesh=mesh1,
+                                params=ConsensusParams(
+                                    pca_method="eigh-gram"))
+        ref = Oracle(reports=reports, event_bounds=bounds, backend="jax",
+                     pca_method="eigh-gram").consensus()
+        np.testing.assert_array_equal(
+            np.asarray(out["outcomes_adjusted"])[:13],
+            ref["events"]["outcomes_adjusted"][:13])
+        np.testing.assert_allclose(
+            np.asarray(out["outcomes_final"]),
+            ref["events"]["outcomes_final"], rtol=1e-8)
+
     def test_functional_api_device_resident(self, rng, mesh8):
         """sharded_consensus accepts a device array without host round-trip."""
         import jax.numpy as jnp

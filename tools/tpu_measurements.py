@@ -1,0 +1,136 @@
+"""Run the queued on-chip measurement suite and bank the results.
+
+docs/ROADMAP.md lists the measurements that have been waiting on a live
+TPU tunnel (it wedges for hours after any OOM/aborted run — see
+docs/PERFORMANCE.md methodology). This script exists so that the moment
+the tunnel responds, ONE command banks everything in the right order
+(parity/perf first, the OOM-risky scaled-heavy shape LAST, per the
+wedge post-mortem), writing machine-readable results as it goes — a
+mid-suite wedge still leaves everything banked up to that point.
+
+Usage:  python tools/tpu_measurements.py [--out docs/MEASUREMENTS_r02.json]
+
+Each measurement is one fail-soft ``bench.py`` invocation (its parent
+process never imports jax and always emits a JSON line); this runner just
+sequences them — NEVER concurrently, concurrent TPU jobs plus one OOM is
+the documented wedge trigger — and aggregates the JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# bench.py's parent half never imports jax, so importing from it is safe
+# even with a wedged tunnel — and keeps the wedge-critical probe logic
+# (killable subprocess, last-line parse past libtpu banners) in ONE place
+from bench import _probe_backend  # noqa: E402
+
+#: (name, bench.py argv, timeout_s) — ordered: parity/perf first, the
+#: HBM-pressure scaled-heavy shape last (docs/ROADMAP.md items a-d)
+MEASUREMENTS = [
+    # (d) re-confirm the headline after the round-1 late commits + round-2
+    # median/indexing changes
+    ("headline", [], 900),
+    # (a) power-mono vs power-fused A/B on a quiet chip
+    ("power_fused", ["--pca-method", "power-fused"], 900),
+    ("power_mono", ["--pca-method", "power-mono", "--power-iters", "8"],
+     900),
+    # (c) ICA resolution on-chip (eigh-gram spectrum path)
+    ("ica", ["--algorithm", "ica"], 1200),
+    # (b) blocked median at increasing scaled fractions; the >E/8 shape
+    # (XLA path, biggest sort temporaries) is the OOM-riskiest → last
+    ("scaled_1k", ["--scaled", "1000"], 1200),
+    ("scaled_16k", ["--scaled", "16000"], 1800),
+]
+
+
+def probe(timeout: float = 90.0) -> bool:
+    backend, info = _probe_backend(timeout)
+    if backend is None:
+        print(f"probe: {info}")
+    return backend is not None and backend != "cpu"
+
+
+def run_one(name: str, extra_argv: list, timeout: float) -> dict:
+    cmd = [sys.executable, str(ROOT / "bench.py"),
+           "--bench-timeout", str(timeout), *extra_argv]
+    t0 = time.time()
+    try:
+        # the fail-soft parent's worst case is probe (90 s) + child timeout
+        # + CPU smoke (300 s); the margin covers it so the parent always
+        # gets to emit its JSON — but a hard cap still protects the suite
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout + 500)
+    except subprocess.TimeoutExpired:
+        return {"_name": name, "_wall_s": round(time.time() - t0, 1),
+                "error": f"bench.py parent exceeded {timeout + 500:.0f}s "
+                         f"hard cap (should be impossible — fail-soft "
+                         f"parent is bounded)"}
+    parsed = None
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            candidate = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(candidate, dict):
+            parsed = candidate
+            break
+    if parsed is None:
+        parsed = {"error": f"no JSON from bench.py (rc={r.returncode})"}
+    parsed["_name"] = name
+    parsed["_wall_s"] = round(time.time() - t0, 1)
+    if r.stderr:
+        tail = r.stderr.strip().splitlines()[-2:]
+        parsed["_stderr_tail"] = " | ".join(tail)
+    return parsed
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(ROOT / "docs/MEASUREMENTS_r02.json"))
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset of measurement names")
+    args = ap.parse_args()
+    out_path = pathlib.Path(args.out)
+
+    if not probe():
+        print("TPU tunnel not responding — nothing measured (probe rc!=0 "
+              "or timeout; see docs/PERFORMANCE.md wedge notes)")
+        sys.exit(1)
+    print("TPU alive — running suite (sequential; OOM-risky shapes last)")
+
+    only = {s for s in args.only.split(",") if s}
+    results = []
+    for name, argv, timeout in MEASUREMENTS:
+        if only and name not in only:
+            continue
+        print(f"--- {name}: bench.py {' '.join(argv)}", flush=True)
+        res = run_one(name, argv, timeout)
+        results.append(res)
+        # bank after EVERY measurement — a wedge mid-suite keeps the rest
+        out_path.write_text(json.dumps(results, indent=1) + "\n")
+        err = res.get("error")
+        line = (f"    {res.get('metric')}: value={res.get('value')} "
+                f"latency={res.get('latency_s')}s wall={res['_wall_s']}s")
+        print(line + (f" ERROR={err}" if err else ""), flush=True)
+        if err and "unavailable" in str(err):
+            print("tunnel lost mid-suite — stopping (results banked)")
+            break
+    if not results:
+        known = ", ".join(n for n, _, _ in MEASUREMENTS)
+        print(f"nothing measured — no measurement matched {args.only!r} "
+              f"(known: {known}); {out_path} NOT written")
+        sys.exit(1)
+    print(f"wrote {out_path} ({len(results)} measurements)")
+
+
+if __name__ == "__main__":
+    main()
