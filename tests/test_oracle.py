@@ -494,6 +494,19 @@ class TestValidation:
         with pytest.raises(ValueError, match="entries"):
             Oracle(reports=CANONICAL, event_bounds=[None])
 
+    def test_n_scaled_static_wiring(self):
+        """Oracle carries the exact static scaled count only when the
+        gather-median path can fire (scaled strict minority)."""
+        bounds_minor = [None, None, None,
+                        {"scaled": True, "min": 0.0, "max": 10.0}]
+        o = Oracle(reports=CANONICAL, event_bounds=bounds_minor)
+        assert o.params.n_scaled == 1
+        bounds_major = [{"scaled": True, "min": 0.0, "max": 10.0}] * 3 \
+            + [None]
+        o = Oracle(reports=CANONICAL, event_bounds=bounds_major)
+        assert o.params.n_scaled == 0          # majority: full median wins
+        assert Oracle(reports=CANONICAL).params.n_scaled == 0
+
     def test_power_mono_ignored_tol_warns(self):
         with pytest.warns(UserWarning, match="power-mono.*power_tol"):
             Oracle(reports=CANONICAL, backend="jax",
