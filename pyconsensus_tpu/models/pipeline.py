@@ -183,12 +183,13 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
     return result
 
 
-def _scores_jax(filled, rep, p: ConsensusParams):
-    """JAX mirror of ``_scores_np``: ``(adj_scores, loading-or-None)``."""
+def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
+    """JAX mirror of ``_scores_np``: ``(adj_scores, loading-or-None)``.
+    ``v_init`` warm-starts sztorc's power-family PCA (ignored elsewhere)."""
     algo = p.algorithm
     if algo == "sztorc":
         return sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters,
-                                 p.power_tol, p.matvec_dtype)
+                                 p.power_tol, p.matvec_dtype, v_init=v_init)
     if algo == "fixed-variance":
         return fixed_variance_scores_jax(filled, rep, p.variance_threshold,
                                          p.max_components, p.pca_method)
@@ -214,7 +215,11 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
 
     def step(carry, _):
         rep, this_rep_prev, loading_prev, converged, iters = carry
-        adj, loading = _scores_jax(filled, rep, p)
+        # warm start: the previous iteration's loading (zeros on iteration
+        # 1 → cold start inside _power_loop); reputation moves a little per
+        # redistribution step, so the power iteration restarts almost
+        # converged and the early exit saves most of its HBM sweeps
+        adj, loading = _scores_jax(filled, rep, p, v_init=loading_prev)
         if loading is None:
             loading = loading_prev
         this_rep = jk.row_reward_weighted(adj, rep)
@@ -342,11 +347,11 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     full0 = jnp.sum(old_rep)
     mu1 = numer0 + (full0 - tw0) * fill
 
-    def scores_at(rep_k, mu_k):
+    def scores_at(rep_k, mu_k, v_init=None):
         return jk.sztorc_scores_power_fused(
             x, rep_k, p.power_iters, p.power_tol, p.matvec_dtype,
             interpret=interp, fill=fill, mu=mu_k,
-            mono=p.pca_method == "power-mono")
+            mono=p.pca_method == "power-mono", v_init=v_init)
 
     if p.max_iterations <= 1:
         adj, loading = scores_at(old_rep, mu1)
@@ -359,7 +364,10 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
 
         def step(carry, _):
             rep_c, this_prev, loading_prev, conv, it = carry
-            adj, loading = scores_at(rep_c, _masked_mu(x, fill, rep_c))
+            # warm start from the previous iteration's loading (zeros on
+            # iteration 1 → cold start inside _power_loop)
+            adj, loading = scores_at(rep_c, _masked_mu(x, fill, rep_c),
+                                     v_init=loading_prev)
             this_rep = jk.row_reward_weighted(adj, rep_c)
             new_rep = jk.smooth(this_rep, rep_c, p.alpha)
             delta = jnp.max(jnp.abs(new_rep - rep_c))

@@ -41,23 +41,28 @@ def sztorc_scores_np(reports_filled, reputation):
 
 
 def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
-                      power_iters=128, power_tol=0.0, matvec_dtype=""):
+                      power_iters=128, power_tol=0.0, matvec_dtype="",
+                      v_init=None):
     """Direction-fixed first-principal-component scores (jax); returns
     ``(adj_scores, loading)`` like the numpy mirror. On the single-device
     TPU fast path (resolved method ``"power-fused"``) the scores and
     direction-fix contractions fuse into one Pallas HBM sweep
-    (jax_kernels.sztorc_scores_power_fused)."""
+    (jax_kernels.sztorc_scores_power_fused). ``v_init`` warm-starts the
+    power-family methods (the iterative loop passes the previous
+    iteration's loading — see jax_kernels._power_loop); eigh methods
+    ignore it."""
     method = jk.resolve_pca_method(*reports_filled.shape, pca_method)
     if method in ("power-fused", "power-mono"):
         return jk.sztorc_scores_power_fused(
             reports_filled, reputation, power_iters, power_tol, matvec_dtype,
             interpret=jax.default_backend() != "tpu",
-            mono=method == "power-mono")
+            mono=method == "power-mono", v_init=v_init)
     loading, scores = jk.weighted_prin_comp(reports_filled, reputation,
                                             method=method,
                                             power_iters=power_iters,
                                             power_tol=power_tol,
-                                            matvec_dtype=matvec_dtype)
+                                            matvec_dtype=matvec_dtype,
+                                            v_init=v_init)
     return jk.direction_fixed_scores(scores, reports_filled, reputation), loading
 
 

@@ -163,7 +163,8 @@ def _first_pc_eigh_gram(dev, denom, reputation):
     return loading, dev @ loading
 
 
-def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float):
+def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float,
+                v_init=None):
     """Shared power-iteration driver (used by the XLA matvec path below and
     the fused Pallas path in ``pallas_kernels``): deterministic start — one
     implicit-covariance application to the ones vector — then a
@@ -178,12 +179,45 @@ def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float):
     early exit entirely (exactly ``n_iters`` sweeps — the testing
     baseline). The
     dynamic trip count is jit/vmap/GSPMD-compatible (vmapped lanes run
-    until all converge). Returns the unit-norm loading (sign arbitrary).
-    """
+    until all converge). Returns ``(loading, n_sweeps)`` — the unit-norm
+    loading (sign arbitrary) and the number of in-loop covariance
+    applications executed (the start application is not counted; exposed
+    so tests can pin the warm-start sweep savings).
+
+    ``v_init`` (optional) warm-starts the iteration: the iterative Sztorc
+    loop feeds each outer iteration the previous iteration's loading —
+    reputation moves a little per redistribution step, so the dominant
+    eigenvector barely moves and the early exit fires after one or two
+    sweeps instead of a cold handful. A zero/None ``v_init`` falls back to
+    the ones vector, bitwise identical to the cold start (so outer
+    iteration 1, whose scan carry is zeros, is unchanged).
+
+    The warm seed is BLENDED with the ones vector rather than used pure.
+    A pure stale eigenvector is an exact fixed point of ``apply_cov``, so
+    if the top two eigenvalues crossed between outer iterations (e.g.
+    redistribution demoting one of two near-tied collusion clusters) a
+    pure warm start could pass the self-consistency exit while sitting on
+    the now-SECOND eigenvector. Mixing in the ones direction restores the
+    cold start's reachability assumption (<1, v1> != 0): any decisively
+    dominant new direction contaminates the iterate geometrically and the
+    exit cannot fire until it has won; in the genuinely near-tied regime
+    the early exit may still stop between the two, where the directions
+    are statistically interchangeable (and where the exact eigh is itself
+    unstable). Cost: at most a sweep or two over the pure warm start when
+    nothing crossed."""
     no_exit = tol < 0
     tol = max(float(tol), 8.0 * float(jnp.finfo(dtype).eps))
 
-    v0 = apply_cov(jnp.ones((E,), dtype=dtype))
+    if v_init is None:
+        seed = jnp.ones((E,), dtype=dtype)
+    else:
+        v_init = v_init.astype(dtype)
+        n_i = jnp.linalg.norm(v_init)
+        blended = (v_init / jnp.where(n_i > 0.0, n_i, 1.0)
+                   + 0.25 * jnp.ones((E,), dtype=dtype)
+                   / jnp.sqrt(jnp.asarray(E, dtype)))
+        seed = jnp.where(n_i > 0.0, blended, jnp.ones((E,), dtype=dtype))
+    v0 = apply_cov(seed)
     n0 = jnp.linalg.norm(v0)
     v0 = jnp.where(n0 == 0.0,
                    jnp.ones((E,), dtype) / jnp.sqrt(jnp.asarray(E, dtype)),
@@ -204,13 +238,14 @@ def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float):
             done = jnp.abs(jnp.vdot(w, v)) >= 1.0 - tol
         return i + 1, w, done
 
-    _, loading, _ = lax.while_loop(
+    i, loading, _ = lax.while_loop(
         cond, body, (jnp.asarray(0, jnp.int32), v0, jnp.asarray(False)))
-    return loading
+    return loading, i
 
 
 def _first_pc_power(reports_filled, mu, denom, reputation,
-                    n_iters: int = 128, tol: float = 0.0, matvec_dtype=None):
+                    n_iters: int = 128, tol: float = 0.0, matvec_dtype=None,
+                    v_init=None):
     """Matrix-free power iteration (SURVEY.md §7 route a): each step is two
     sharded matvecs, O(R*E), no E×E or R×R matrix. Convergence/early-exit
     semantics in :func:`_power_loop`.
@@ -248,8 +283,8 @@ def _first_pc_power(reports_filled, mu, denom, reputation,
              - mu * jnp.sum(rt))                                    # (E,)
         return y / denom
 
-    loading = _power_loop(apply_cov, reports_filled.shape[1], out_dtype,
-                          n_iters, tol)
+    loading, _ = _power_loop(apply_cov, reports_filled.shape[1], out_dtype,
+                             n_iters, tol, v_init=v_init)
     scores = (jnp.matmul(reports_filled,
                          loading.astype(reports_filled.dtype),
                          preferred_element_type=out_dtype) - mu @ loading)
@@ -287,7 +322,7 @@ def resolve_pca_method(R: int, E: int, method: str) -> str:
 
 def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
                        power_iters: int = 128, power_tol: float = 0.0,
-                       matvec_dtype: str = ""):
+                       matvec_dtype: str = "", v_init=None):
     """First principal component of the reputation-weighted covariance
     (numpy_kernels.weighted_prin_comp). ``method``:
 
@@ -322,7 +357,8 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
         else:
             loading = power_iteration_fused(
                 xmm, mu, denom, reputation, power_iters, power_tol,
-                interpret=jax.default_backend() != "tpu").astype(acc)
+                interpret=jax.default_backend() != "tpu",
+                v_init=v_init).astype(acc)
         # scores = (X - mu) @ loading without materializing the centered
         # matrix: X @ loading is one sweep; mu . loading is a scalar
         scores = (jnp.matmul(reports_filled,
@@ -334,7 +370,8 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
         return _first_pc_power(reports_filled, mu, denom, reputation,
                                power_iters, tol=power_tol,
                                matvec_dtype=(jnp.dtype(matvec_dtype)
-                                             if matvec_dtype else None))
+                                             if matvec_dtype else None),
+                               v_init=v_init)
     dev, denom = _center(reports_filled, reputation)
     if method == "eigh-cov":
         return _first_pc_eigh_cov(dev, denom, reputation)
@@ -512,7 +549,7 @@ _MONO_MAX_ITERS = 16
 def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
                               power_tol: float, matvec_dtype: str = "",
                               interpret: bool = False, fill=None, mu=None,
-                              mono: bool = False):
+                              mono: bool = False, v_init=None):
     """The whole sztorc scoring step on the Pallas fast path: power-iteration
     PCA (one HBM sweep per step, pallas_kernels.apply_weighted_cov) followed
     by the scores + direction-fix contractions in ONE further sweep
@@ -539,7 +576,9 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     :func:`pallas_kernels.power_iteration_mono` — a FIXED trip count with
     no early exit, capped at :data:`_MONO_MAX_ITERS` sweeps so the
     default ``power_iters=128`` budget (sized for the early-exit loop)
-    cannot silently become 128 full HBM sweeps.
+    cannot silently become 128 full HBM sweeps. The mono kernel also
+    IGNORES ``v_init`` (its start vector lives inside the single launch),
+    so the iterative loop's warm start does not apply to it.
     """
     from .pallas_kernels import (power_iteration_fused,
                                  power_iteration_mono, scores_dirfix_pass)
@@ -561,7 +600,8 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     else:
         loading = power_iteration_fused(xmm, mu, denom, reputation,
                                         power_iters, power_tol, fill=fill,
-                                        interpret=interpret).astype(acc)
+                                        interpret=interpret,
+                                        v_init=v_init).astype(acc)
     t, q, c, o = scores_dirfix_pass(xmm, reputation, loading, fill=fill,
                                     interpret=interpret)
     ml = mu @ loading

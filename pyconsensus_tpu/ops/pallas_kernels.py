@@ -574,7 +574,7 @@ def power_iteration_mono(x, mu, rep, n_iters: int, fill=None,
 
 
 def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
-                          fill=None, interpret: bool = False):
+                          fill=None, interpret: bool = False, v_init=None):
     """First principal component via power iteration with the fused
     one-HBM-pass covariance application. Runs the shared convergence driver
     (``jax_kernels._power_loop`` — same start vector, normalization, and
@@ -602,4 +602,4 @@ def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
         return apply_weighted_cov(x, mu, rep, v, fill=fill,
                                   interpret=interpret) / denom
 
-    return _power_loop(apply_cov, E, f32, n_iters, tol)
+    return _power_loop(apply_cov, E, f32, n_iters, tol, v_init=v_init)[0]

@@ -88,3 +88,23 @@ if len(sys.argv) > 3:
           flush=True)
     print("STREAMREP", ",".join(f"{float(v):.6f}"
                                 for v in s_out["smooth_rep"]), flush=True)
+
+    # phase 4: scaled events + power-iteration PCA across processes — the
+    # round-2 sharded-median path (effective_median_block forces the
+    # unblocked, shard-local median; tests/test_hlo_collectives.py bounds
+    # its collectives) running with REAL cross-process gloo collectives,
+    # through the sharded_consensus front-end that applies the gating
+    from pyconsensus_tpu.parallel import sharded_consensus  # noqa: E402
+
+    reports_sc = reports.copy()
+    reports_sc[:, -2:] = np.random.default_rng(42).uniform(0.0, 10.0,
+                                                           (12, 2))
+    bounds = [None] * 14 + [{"scaled": True, "min": 0.0, "max": 10.0}] * 2
+    out_sc = sharded_consensus(
+        reports_sc, event_bounds=bounds, mesh=mesh,
+        params=ConsensusParams(algorithm="sztorc", max_iterations=2,
+                               pca_method="power"))
+    sc_all = multihost_utils.process_allgather(out_sc["outcomes_adjusted"],
+                                               tiled=True)
+    print("SCALED", ",".join(f"{float(v):.10g}" for v in np.ravel(sc_all)),
+          flush=True)

@@ -114,3 +114,20 @@ def test_two_process_global_mesh(tmp_path):
         params=ConsensusParams(algorithm="sztorc", max_iterations=2))
     np.testing.assert_array_equal(s0, local["outcomes_adjusted"])
     np.testing.assert_allclose(sr0, local["smooth_rep"], atol=1e-5)
+
+    # phase 4: scaled events + power PCA with cross-process collectives —
+    # the unblocked sharded median (round 2) must agree across processes
+    # and with a plain single-process resolution of the same matrix
+    sc0, sc1 = (parse("SCALED", o) for o in outputs)
+    np.testing.assert_array_equal(sc0, sc1)
+    reports_sc = reports.copy()
+    reports_sc[:, -2:] = np.random.default_rng(42).uniform(0.0, 10.0,
+                                                           (12, 2))
+    bounds = [None] * 14 + [{"scaled": True, "min": 0.0, "max": 10.0}] * 2
+    ref_sc = Oracle(reports=reports_sc, event_bounds=bounds, backend="jax",
+                    max_iterations=2, pca_method="power").consensus()
+    # binary columns catch-snapped -> exact across process counts
+    np.testing.assert_array_equal(
+        sc0[:14], ref_sc["events"]["outcomes_adjusted"][:14])
+    np.testing.assert_allclose(
+        sc0[14:], ref_sc["events"]["outcomes_adjusted"][14:], atol=1e-6)

@@ -192,6 +192,69 @@ class TestWeightedPrinComp:
             np.testing.assert_allclose(_align_sign(lj[:, c], loadings[:, c]),
                                        loadings[:, c], atol=1e-6)
 
+    def test_power_warm_start(self, rng):
+        """Warm-starting the power loop near the dominant eigenvector must
+        (a) converge to the same loading and (b) use far fewer sweeps than
+        the cold start — the HBM savings the iterative Sztorc loop banks
+        by passing each iteration the previous loading. A zero v_init must
+        be bitwise identical to the cold start (the scan-carry-init
+        contract)."""
+        X = rng.random((12, 40))
+        # planted rank-1 structure -> decisive eigengap, like collusion
+        X[:, :20] += np.outer(rng.random(12) * 2.0, np.ones(20))
+        rep = jnp.asarray(nk.normalize(rng.random(12) + 0.1))
+        Xj = jnp.asarray(X)
+        mu, denom = jk._mu_denom(Xj, rep)
+
+        def apply_cov(v):
+            t = rep * (Xj @ v - mu @ v)
+            return (Xj.T @ t - mu * jnp.sum(t)) / denom
+
+        cold, cold_iters = jk._power_loop(apply_cov, 40, rep.dtype, 128,
+                                          1e-6)
+        warm, warm_iters = jk._power_loop(apply_cov, 40, rep.dtype, 128,
+                                          1e-6, v_init=cold)
+        # both sit within the early-exit band of the true eigenvector
+        # (alignment tol 1e-6 ~ loading error O(1e-4) at this eigengap;
+        # the warm restart only ever tightens it)
+        cov, _ = nk.weighted_cov(X, np.asarray(rep))
+        top = np.linalg.eigh(cov)[1][:, -1]
+        np.testing.assert_allclose(_align_sign(np.asarray(cold), top), top,
+                                   atol=1e-3)
+        np.testing.assert_allclose(_align_sign(np.asarray(warm), top), top,
+                                   atol=1e-3)
+        # the blended seed costs ~1 sweep over a pure warm start (the
+        # crossing-hazard insurance) but must still beat the cold start
+        assert int(warm_iters) <= 3
+        assert int(cold_iters) > int(warm_iters)
+        zero, zero_iters = jk._power_loop(apply_cov, 40, rep.dtype, 128,
+                                          1e-6, v_init=jnp.zeros((40,)))
+        np.testing.assert_array_equal(np.asarray(zero), np.asarray(cold))
+        assert int(zero_iters) == int(cold_iters)
+
+    def test_warm_start_escapes_stale_eigenvector(self):
+        """The eigenvalue-crossing hazard: a PURE warm start from the
+        previous dominant direction is an exact fixed point of the power
+        map, so the self-consistency exit would accept it even after the
+        spectrum crossed and it became the SECOND eigenvector. The blended
+        seed (_power_loop mixes in the ones direction) must escape to the
+        new dominant eigenvector instead."""
+        E = 16
+        # diagonal covariance: dominant axis 0, runner-up axis 1 with a
+        # decisive gap; "stale loading" = exact second eigenvector e1
+        lam = jnp.asarray([4.0, 2.0] + [0.1] * (E - 2))
+
+        def apply_cov(v):
+            return lam * v
+
+        stale = jnp.zeros((E,)).at[1].set(1.0)       # exact fixed point
+        loading, iters = jk._power_loop(apply_cov, E, lam.dtype, 256,
+                                        1e-9, v_init=stale)
+        loading = np.asarray(loading)
+        assert abs(loading[0]) > 0.99, (
+            f"locked onto stale eigenvector: {loading[:3]}, {int(iters)} "
+            f"iters")
+
     def test_gram_matches_cov_method(self, rng):
         X = rng.random((7, 20))
         rep = nk.normalize(rng.random(7) + 0.1)
