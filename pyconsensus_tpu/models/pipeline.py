@@ -492,10 +492,20 @@ consensus_light_jit = jax.jit(_consensus_core_light, static_argnames=("p",))
 
 
 def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
-                      p: ConsensusParams):
+                      p: ConsensusParams, light: bool = False):
     """Hybrid path for hierarchical/DBSCAN: rescale/interpolate/outcomes run
     on device; the irregular clustering step and the tiny O(R) reputation
-    updates run on host against a device-computed R×R distance matrix."""
+    updates run on host against a device-computed R×R distance matrix.
+
+    The filled matrix is never materialized on host in either mode — the
+    clustering functions only read the R×R ``sq_dists`` (computed on
+    device, where an event-sharded input turns the O(R²E) contraction
+    into per-shard partials + one R×R all-reduce) plus the reputation
+    vector. ``light=True`` (the sharded front-end) additionally omits the
+    (R, E) result keys (``_LARGE_RESULT_KEYS``). Single-controller only:
+    the device phases run eagerly, which JAX forbids on multi-process
+    (non-fully-addressable) global arrays — the sharded front-end
+    enforces this."""
     old_rep = jk.normalize(reputation)
     rescaled = jk.rescale(reports, scaled, mins, maxs)
     filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
@@ -506,7 +516,10 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     if p.storage_dtype:
         filled = filled.astype(jnp.dtype(p.storage_dtype))
 
-    filled_host = np.asarray(filled, dtype=np.float64)
+    # shape-only placeholder: with sq_dists supplied, the clustering
+    # functions never touch the matrix itself — a device->host pull +
+    # f64 copy would be 4 GB each at north-star scale
+    filled_host = np.empty((filled.shape[0], 0))
     # the clustering inputs (filled reports, hence distances) are
     # loop-invariant — only reputation changes across iterations
     sq = np.asarray(cl.pairwise_sq_dists_jax(filled), dtype=np.float64)
@@ -554,6 +567,9 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
         "convergence": converged,
     }
     result.update(extras)
+    if light:
+        for key in _LARGE_RESULT_KEYS:
+            result.pop(key)
     return result
 
 

@@ -27,7 +27,8 @@ from typing import NamedTuple, Optional
 import jax
 import numpy as np
 
-from ..models.pipeline import (JIT_ALGORITHMS, ConsensusParams,
+from ..models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
+                               ConsensusParams, _consensus_hybrid,
                                consensus_light_jit)
 from ..oracle import Oracle, assemble_result, parse_event_bounds
 from .mesh import (Mesh, effective_median_block, event_sharding, make_mesh,
@@ -270,6 +271,27 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         p, R, E, mesh.devices.size))
     if not p.fused_resolution:
         p = p._replace(n_scaled=_xla_path_n_scaled(p, E, mesh))
+    if p.algorithm in HYBRID_ALGORITHMS:
+        # hybrid host-clustering path: the device phases run eagerly on the
+        # placed (event-sharded) arrays — GSPMD propagates the sharding
+        # op-by-op, so the O(R²E) distance contraction reduces per-shard
+        # with one R×R all-reduce — and only the R×R distances plus O(R)
+        # vectors ever cross to host (pipeline._consensus_hybrid light
+        # mode). The host merge loop itself is the documented R ceiling
+        # (docs/API.md scale envelope).
+        if jax.process_count() > 1:
+            # eager ops are forbidden on non-fully-addressable global
+            # arrays, and the host merge loop has no cross-process story
+            raise ValueError(
+                "hybrid clustering (hierarchical/dbscan) shards only on "
+                "single-controller meshes: the host-clustering step runs "
+                f"eagerly; use a jit algorithm {JIT_ALGORITHMS} on "
+                "multi-process meshes")
+        if reputation is None:
+            reputation = _default_reputation_placed(mesh, R)
+        placed = _place_inputs(mesh, reports, reputation, scaled, mins,
+                               maxs)
+        return _consensus_hybrid(*placed, p, light=True)
     if reputation is None:
         reputation = _default_reputation_placed(mesh, R)   # cached, on device
         if event_bounds is None:
@@ -294,13 +316,6 @@ class ShardedOracle(Oracle):
         super().__init__(*args, **kwargs)
         if self.backend != "jax":
             raise ValueError("ShardedOracle requires backend='jax'")
-        if self.params.algorithm not in JIT_ALGORITHMS:
-            raise ValueError(
-                "sharded resolution supports the jit algorithms "
-                f"{JIT_ALGORITHMS}: the hybrid host-clustering variants "
-                "(hierarchical/dbscan) need a host step between device "
-                "phases — run them unsharded, or shard over batch via the "
-                "simulator")
         self.mesh = mesh if mesh is not None else make_mesh(batch=1)
         self.params = self.params._replace(
             pca_method=_pick_pca_method(self.params, self.reports.shape[0],
@@ -334,6 +349,10 @@ class ShardedOracle(Oracle):
     def resolve_raw(self):
         placed = _place_inputs(self.mesh, self.reports, self.reputation,
                                self.scaled, self.mins, self.maxs)
+        if self.params.algorithm in HYBRID_ALGORITHMS:
+            # host-clustering hybrid: eager sharded device phases, host
+            # merge loop (see sharded_consensus)
+            return _consensus_hybrid(*placed, self.params, light=True)
         return consensus_light_jit(*placed, self.params)
 
     def consensus(self) -> dict:

@@ -119,6 +119,27 @@ class TestShardedCollectiveCosts:
         assert sorted(sizes.keys()) == sorted(binary.keys())
         assert len(sizes["all-reduce"]) == len(binary["all-reduce"])
 
+    def test_gram_path_one_rxr_allreduce(self, binary_reports):
+        """The eigh-gram strategy (exact path; mandatory for the
+        multi-component fixed-variance/ICA variants) legitimately
+        all-reduces ONE R x R Gram matrix per outer iteration — an
+        algorithmic cost, not a regression (SURVEY.md §7 route b; at the
+        R<=4096 sizes auto picks it, that is <=64 MB over ICI). Pin that
+        it stays exactly one R x R-sized all-reduce and nothing larger."""
+        p = ConsensusParams(algorithm="sztorc", pca_method="eigh-gram",
+                            has_na=False, any_scaled=False, median_block=0)
+        sizes = collective_sizes(compiled_hlo(binary_reports, None, p))
+        big = [n for n in sizes.get("all-reduce", []) if n > 4 * R + 8]
+        assert len(big) <= 1, f"multiple large all-reduces: {sizes}"
+        for n in big:
+            # the R x R Gram block (possibly tuple-fused with O(R) extras)
+            assert n <= R * R + 4 * R + 8, (
+                f"all-reduce of {n} elements exceeds the R x R Gram")
+        for op in ("all-gather", "reduce-scatter", "all-to-all",
+                   "collective-permute"):
+            for n in sizes.get(op, []):
+                assert n <= max(E, R * R), (op, n)
+
     def test_na_power_path(self, binary_reports):
         """NaN interpolation's column stats are event-sharded reductions
         over the replicated R axis — no extra large collectives."""

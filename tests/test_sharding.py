@@ -122,10 +122,40 @@ class TestShardedParity:
                                    unsharded["agents"]["smooth_rep"],
                                    atol=1e-8)
 
-    def test_rejects_hybrid_clustering(self, rng, mesh8):
-        with pytest.raises(ValueError, match="hybrid"):
-            ShardedOracle(reports=make_reports(rng), backend="jax",
-                          algorithm="hierarchical", mesh=mesh8)
+    @pytest.mark.parametrize("algo,kwargs", [
+        ("hierarchical", {"hierarchy_threshold": 1.5}),
+        ("dbscan", {"dbscan_eps": 1.0, "dbscan_min_samples": 2}),
+    ])
+    def test_hybrid_clustering_shards(self, rng, mesh8, algo, kwargs):
+        """Round 2: the hybrid host-clustering variants resolve on the mesh
+        too — device phases (fill, R×R distances, outcomes, bonuses) run
+        event-sharded, only the distances and O(R) vectors cross to host.
+        Must equal the unsharded resolution exactly on outcomes."""
+        reports = make_reports(rng, na_frac=0.1)
+        unsharded = Oracle(reports=reports, backend="jax", algorithm=algo,
+                           max_iterations=2, **kwargs).consensus()
+        sharded = ShardedOracle(reports=reports, backend="jax",
+                                algorithm=algo, mesh=mesh8,
+                                max_iterations=2, **kwargs).consensus()
+        np.testing.assert_array_equal(
+            sharded["events"]["outcomes_final"],
+            unsharded["events"]["outcomes_final"])
+        np.testing.assert_allclose(sharded["agents"]["smooth_rep"],
+                                   unsharded["agents"]["smooth_rep"],
+                                   atol=1e-8)
+        np.testing.assert_allclose(sharded["events"]["certainty"],
+                                   unsharded["events"]["certainty"],
+                                   atol=1e-8)
+        # functional front-end too
+        out = sharded_consensus(
+            reports, mesh=mesh8,
+            params=ConsensusParams(algorithm=algo, max_iterations=2,
+                                   **{k: v for k, v in kwargs.items()}))
+        np.testing.assert_array_equal(
+            np.asarray(out["outcomes_final"]),
+            unsharded["events"]["outcomes_final"])
+
+    def test_rejects_numpy_backend(self, rng, mesh8):
         with pytest.raises(ValueError, match="backend"):
             ShardedOracle(reports=make_reports(rng), backend="numpy",
                           mesh=mesh8)
