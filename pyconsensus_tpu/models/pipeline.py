@@ -506,6 +506,15 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     the device phases run eagerly, which JAX forbids on multi-process
     (non-fully-addressable) global arrays — the sharded front-end
     enforces this."""
+    if light and jax.process_count() > 1:
+        # the guard lives HERE so every front-end (sharded_consensus AND
+        # ShardedOracle) raises the clear error instead of an opaque
+        # non-fully-addressable-array RuntimeError mid-pipeline
+        raise ValueError(
+            "hybrid clustering (hierarchical/dbscan) shards only on "
+            "single-controller meshes: the host-clustering step runs "
+            f"eagerly; use a jit algorithm {JIT_ALGORITHMS} on "
+            "multi-process meshes")
     old_rep = jk.normalize(reputation)
     rescaled = jk.rescale(reports, scaled, mins, maxs)
     filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,

@@ -27,9 +27,8 @@ from typing import NamedTuple, Optional
 import jax
 import numpy as np
 
-from ..models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
-                               ConsensusParams, _consensus_hybrid,
-                               consensus_light_jit)
+from ..models.pipeline import (HYBRID_ALGORITHMS, ConsensusParams,
+                               _consensus_hybrid, consensus_light_jit)
 from ..oracle import Oracle, assemble_result, parse_event_bounds
 from .mesh import (Mesh, effective_median_block, event_sharding, make_mesh,
                    replicated)
@@ -279,14 +278,8 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         # vectors ever cross to host (pipeline._consensus_hybrid light
         # mode). The host merge loop itself is the documented R ceiling
         # (docs/API.md scale envelope).
-        if jax.process_count() > 1:
-            # eager ops are forbidden on non-fully-addressable global
-            # arrays, and the host merge loop has no cross-process story
-            raise ValueError(
-                "hybrid clustering (hierarchical/dbscan) shards only on "
-                "single-controller meshes: the host-clustering step runs "
-                f"eagerly; use a jit algorithm {JIT_ALGORITHMS} on "
-                "multi-process meshes")
+        # multi-process rejection lives inside _consensus_hybrid (light
+        # mode) so ShardedOracle gets it too
         if reputation is None:
             reputation = _default_reputation_placed(mesh, R)
         placed = _place_inputs(mesh, reports, reputation, scaled, mins,

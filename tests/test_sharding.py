@@ -150,7 +150,7 @@ class TestShardedParity:
         out = sharded_consensus(
             reports, mesh=mesh8,
             params=ConsensusParams(algorithm=algo, max_iterations=2,
-                                   **{k: v for k, v in kwargs.items()}))
+                                   **kwargs))
         np.testing.assert_array_equal(
             np.asarray(out["outcomes_final"]),
             unsharded["events"]["outcomes_final"])
