@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import pathlib
 import re as _re
-from typing import Optional
 
 import numpy as np
 

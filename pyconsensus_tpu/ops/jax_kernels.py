@@ -21,8 +21,6 @@ anchored in BASELINE.json). Design rules, per SURVEY.md §7 M0:
 
 from __future__ import annotations
 
-import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
