@@ -49,3 +49,17 @@ out_mesh = streaming_consensus(reports, panel_events=512,
 print("mesh-sharded streaming identical:",
       bool(np.array_equal(out_mesh["outcomes_adjusted"],
                           out["outcomes_adjusted"])))
+
+# --- hybrid clustering on the same mesh (single-controller) -------------
+# device phases (fill, R x R distances, outcomes) shard over events; only
+# the distance matrix + O(R) vectors cross to host for the merge loop.
+# The cut distance scales with the matrix geometry: honest reporters with
+# 10% flip noise sit ~sqrt(2 * 0.1 * 0.9 * E) ~= 27 apart at E=4096,
+# honest-vs-liar ~57 — the cut must separate those bands
+hybrid = ShardedOracle(reports=reports, backend="jax",
+                       algorithm="hierarchical", hierarchy_threshold=40.0,
+                       mesh=mesh).consensus()
+hrep = hybrid["agents"]["smooth_rep"]
+print("hierarchical (sharded): liar reputation share "
+      f"{float(hrep[400:].sum()):.4f} (uniform would be "
+      f"{112 / 512:.4f})")

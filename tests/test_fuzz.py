@@ -48,6 +48,13 @@ def _random_case(rng):
         "alpha": float(rng.uniform(0.05, 0.5)),
         "catch_tolerance": float(rng.uniform(0.05, 0.3)),
     }
+    if kwargs["algorithm"] == "sztorc":
+        # at fuzz shapes "auto" always resolves to eigh-cov, which would
+        # leave the matrix-free strategies — including the warm-started
+        # iterative power loop (max_iterations > 1 + v_init threading) —
+        # entirely unfuzzed against numpy's exact per-iteration eigh
+        kwargs["pca_method"] = str(rng.choice(["auto", "eigh-gram",
+                                               "power"]))
     return reports, bounds, reputation, kwargs, np.asarray(
         [b is not None for b in bounds])
 
