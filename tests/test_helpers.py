@@ -1,0 +1,92 @@
+"""Direct unit tests for small dispatch/placement helpers that the suite
+otherwise only exercises indirectly through the full pipeline — their
+decision tables are load-bearing (PCA strategy routing, device placement
+reuse, sweep-grid layout) and a silent change would surface far away from
+its cause."""
+
+import jax
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.ops import jax_kernels as jk
+from pyconsensus_tpu.parallel import (batch_event_sharding, make_mesh,
+                                      place_event_bounds)
+from pyconsensus_tpu.sim import flat_grid
+
+
+class TestResolvePcaMethod:
+    """The auto/downgrade decision table (jax_kernels.resolve_pca_method):
+    never E×E at scale, never the Pallas interpreter beyond toy sizes."""
+
+    def test_auto_by_shape(self):
+        assert jk.resolve_pca_method(10, 512, "auto") == "eigh-cov"
+        assert jk.resolve_pca_method(100, 5000, "auto") == "eigh-gram"
+        # big R and E: matrix-free (CPU test platform -> power, not the
+        # Pallas interpreter)
+        assert jk.resolve_pca_method(5000, 50_000, "auto") == "power"
+
+    def test_explicit_methods_pass_through(self):
+        for m in ("eigh-cov", "eigh-gram", "power"):
+            assert jk.resolve_pca_method(100, 5000, m) == m
+
+    def test_fused_downgrades_off_tpu_at_size(self):
+        # tiny shapes may run the interpreter (tests); big ones must not
+        assert jk.resolve_pca_method(10, 64, "power-fused") == "power-fused"
+        assert jk.resolve_pca_method(5000, 50_000, "power-fused") == "power"
+        assert jk.resolve_pca_method(5000, 50_000, "power-mono") == "power"
+
+
+class TestPlacedBounds:
+    def test_round_trip_and_counts(self):
+        mesh = make_mesh(batch=1, event=8)
+        E = 32
+        bounds = [None] * 28 + [{"scaled": True, "min": -5.0,
+                                 "max": 15.0}] * 4
+        placed = place_event_bounds(bounds, E, mesh)
+        assert placed.any_scaled is True
+        assert placed.n_scaled == 4
+        np.testing.assert_array_equal(np.asarray(placed.scaled),
+                                      [False] * 28 + [True] * 4)
+        assert np.asarray(placed.mins)[-1] == -5.0
+        assert np.asarray(placed.maxs)[-1] == 15.0
+        # resolving with PlacedBounds equals resolving with the raw list
+        from pyconsensus_tpu.parallel import sharded_consensus
+
+        rng = np.random.default_rng(0)
+        reports = rng.choice([0.0, 1.0], size=(12, E))
+        reports[:, -4:] = rng.uniform(-5.0, 15.0, size=(12, 4))
+        a = sharded_consensus(reports, event_bounds=placed, mesh=mesh)
+        b = sharded_consensus(reports, event_bounds=bounds, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(a["outcomes_final"]),
+                                      np.asarray(b["outcomes_final"]))
+
+    def test_all_binary(self):
+        mesh = make_mesh(batch=1, event=2)
+        placed = place_event_bounds(None, 16, mesh)
+        assert placed.any_scaled is False
+        assert placed.n_scaled == 0
+
+
+class TestBatchEventSharding:
+    def test_spec_axes(self):
+        mesh = make_mesh(batch=2, event=4)
+        sharding = batch_event_sharding(mesh)
+        assert sharding.spec == jax.sharding.PartitionSpec(
+            "batch", None, "event")
+        # a (B, R, E) batch places without error and shards both axes
+        x = jax.device_put(np.zeros((4, 6, 8)), sharding)
+        assert x.sharding.is_equivalent_to(sharding, 3)
+
+
+class TestFlatGrid:
+    def test_layout_is_trial_major(self):
+        lf, var, grid_lf, grid_var = flat_grid([0.1, 0.2], [0.5], 3)
+        np.testing.assert_array_equal(grid_lf,
+                                      [0.1, 0.1, 0.1, 0.2, 0.2, 0.2])
+        np.testing.assert_array_equal(grid_var, [0.5] * 6)
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError):
+            flat_grid([], [0.1], 2)
+        with pytest.raises(ValueError):
+            flat_grid([0.1], [0.1], 0)
