@@ -348,7 +348,7 @@ def main() -> None:
         run_bench(args)
         return
 
-    argv = [a for a in sys.argv[1:] if a != "--child"]
+    argv = sys.argv[1:]   # --child was absent or we'd be in run_bench
     metric = (f"consensus_resolutions_per_sec_"
               f"{args.reporters}x{args.events}{_metric_suffix(args)}")
 

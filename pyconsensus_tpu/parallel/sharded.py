@@ -88,6 +88,25 @@ def _xla_path_n_scaled(p: ConsensusParams, n_events: int, mesh: Mesh) -> int:
     return 0
 
 
+def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
+                            mesh: Mesh) -> ConsensusParams:
+    """The one parameter-resolution sequence every sharded front-end must
+    apply (``p.n_scaled``/``any_scaled``/``has_na`` already set by the
+    caller from its bounds source): PCA strategy for the mesh, median
+    blocking (off when the event axis is sharded), the fused-path gate,
+    and the XLA path's static scaled count. Shared by
+    :func:`sharded_consensus` and :class:`ShardedOracle` so the two
+    front-ends cannot drift."""
+    p = p._replace(
+        pca_method=_pick_pca_method(p, R, mesh.devices.size),
+        median_block=effective_median_block(p.median_block, mesh))
+    p = p._replace(fused_resolution=_use_fused_resolution(
+        p, R, E, mesh.devices.size))
+    if not p.fused_resolution:
+        p = p._replace(n_scaled=_xla_path_n_scaled(p, E, mesh))
+    return p
+
+
 def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
                           n_events: int, n_devices: int) -> bool:
     """Gate for the NaN-threaded Pallas fast path
@@ -259,27 +278,21 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         any_scaled = bool(scaled.any())
         p = p._replace(n_scaled=int(scaled.sum()))
     p = p._replace(
-        pca_method=_pick_pca_method(p, R, mesh.devices.size),
         any_scaled=any_scaled,
         # device-resident input: can't cheaply inspect for NaN on host — keep
         # the fill pass unless the caller's params already opted out
         has_na=bool(np.isnan(reports).any()) if is_host else p.has_na,
-        median_block=effective_median_block(p.median_block, mesh),
     )
-    p = p._replace(fused_resolution=_use_fused_resolution(
-        p, R, E, mesh.devices.size))
-    if not p.fused_resolution:
-        p = p._replace(n_scaled=_xla_path_n_scaled(p, E, mesh))
+    p = _resolve_sharded_params(p, R, E, mesh)
     if p.algorithm in HYBRID_ALGORITHMS:
         # hybrid host-clustering path: the device phases run eagerly on the
         # placed (event-sharded) arrays — GSPMD propagates the sharding
         # op-by-op, so the O(R²E) distance contraction reduces per-shard
         # with one R×R all-reduce — and only the R×R distances plus O(R)
         # vectors ever cross to host (pipeline._consensus_hybrid light
-        # mode). The host merge loop itself is the documented R ceiling
-        # (docs/API.md scale envelope).
-        # multi-process rejection lives inside _consensus_hybrid (light
-        # mode) so ShardedOracle gets it too
+        # mode, which also rejects multi-process meshes for BOTH
+        # front-ends). The host merge loop itself is the documented R
+        # ceiling (docs/API.md scale envelope).
         if reputation is None:
             reputation = _default_reputation_placed(mesh, R)
         placed = _place_inputs(mesh, reports, reputation, scaled, mins,
@@ -310,21 +323,9 @@ class ShardedOracle(Oracle):
         if self.backend != "jax":
             raise ValueError("ShardedOracle requires backend='jax'")
         self.mesh = mesh if mesh is not None else make_mesh(batch=1)
-        self.params = self.params._replace(
-            pca_method=_pick_pca_method(self.params, self.reports.shape[0],
-                                        self.mesh.devices.size),
-            n_scaled=int(np.asarray(self.scaled).sum()),
-            median_block=effective_median_block(self.params.median_block,
-                                                self.mesh))
-        self.params = self.params._replace(
-            fused_resolution=_use_fused_resolution(
-                self.params, self.reports.shape[0], self.reports.shape[1],
-                self.mesh.devices.size))
-        if not self.params.fused_resolution:
-            self.params = self.params._replace(
-                n_scaled=_xla_path_n_scaled(self.params,
-                                            self.reports.shape[1],
-                                            self.mesh))
+        self.params = _resolve_sharded_params(
+            self.params._replace(n_scaled=int(np.asarray(self.scaled).sum())),
+            self.reports.shape[0], self.reports.shape[1], self.mesh)
 
     def place(self):
         """Optionally pin the oracle's inputs on device (event-sharded)
