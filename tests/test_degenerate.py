@@ -35,6 +35,34 @@ def test_degenerate_case(name, backend):
 
 
 @pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_all_nan_scaled_column_gather_path(backend):
+    """An entirely-absent scaled event through the static-gather median
+    (Oracle wires ``n_scaled`` whenever scaled columns are a minority):
+    zero participation weight must fall back to the reputation-weighted
+    fill mean, identically on both backends and equal to the full-width
+    median path."""
+    reports = np.array([[1.0, 0.0, 1.0, np.nan],
+                        [1.0, 0.0, 1.0, np.nan],
+                        [1.0, 0.0, 0.0, np.nan],
+                        [0.0, 1.0, 1.0, np.nan]])
+    bounds = [None, None, None, {"scaled": True, "min": 2.0, "max": 10.0}]
+    o = Oracle(reports=reports, event_bounds=bounds, backend=backend)
+    if backend == "jax":
+        assert o.params.n_scaled == 1     # the gather path is actually on
+    r = o.consensus()
+    out = np.asarray(r["events"]["outcomes_final"], dtype=float)
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out[:3], [1.0, 0.0, 1.0])
+    assert 2.0 <= out[3] <= 10.0
+    if backend == "jax":
+        # bitwise equal to the full-width median (n_scaled=0) resolution
+        full = Oracle(reports=reports, event_bounds=bounds, backend="jax")
+        full.params = full.params._replace(n_scaled=0)
+        np.testing.assert_array_equal(
+            out, np.asarray(full.consensus()["events"]["outcomes_final"]))
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
 def test_unanimous_keeps_reputation(backend):
     """No disagreement direction -> row_reward_weighted's degenerate guard
     returns the prior reputation unchanged (up to the smooth blend)."""

@@ -210,6 +210,73 @@ class TestGolden:
                                    g["smooth_rep"], atol=5e-6)
 
 
+# Per-variant provisional goldens on the canonical matrix (same freeze
+# rationale as GOLDEN above): every algorithm's reconstruction is pinned,
+# not just sztorc's. The four clustering variants coincide here by
+# construction — the canonical 4-vs-2 split is the same partition under
+# k-means(2), dbscan(eps=1), dbscan-jit, and hierarchical(1.5).
+GOLDEN_VARIANTS = {
+    "fixed-variance": dict(
+        kwargs={},
+        smooth_rep=[0.17683595607474986, 0.16912629065008244,
+                    0.17683595607474986, 0.17316404392525017,
+                    0.15201887663758387, 0.15201887663758387],
+        certainty=0.3479811233624162),
+    "ica": dict(
+        kwargs={},
+        smooth_rep=[0.17500002852460511, 0.17499997147539492,
+                    0.17500002852460511, 0.17499997147539495,
+                    0.15000000000000002, 0.15000000000000002],
+        certainty=0.35000000000000003),
+    "k-means": dict(
+        kwargs={"num_clusters": 2},
+        smooth_rep=[0.17000000000000001, 0.17000000000000001,
+                    0.17000000000000001, 0.17000000000000001,
+                    0.16000000000000003, 0.16000000000000003],
+        certainty=0.34),
+    "dbscan-jit": dict(
+        kwargs={"dbscan_eps": 1.0, "dbscan_min_samples": 2},
+        smooth_rep=[0.17000000000000001, 0.17000000000000001,
+                    0.17000000000000001, 0.17000000000000001,
+                    0.16000000000000003, 0.16000000000000003],
+        certainty=0.34),
+    "hierarchical": dict(
+        kwargs={"hierarchy_threshold": 1.5},
+        smooth_rep=[0.17000000000000001, 0.17000000000000001,
+                    0.17000000000000001, 0.17000000000000001,
+                    0.16000000000000003, 0.16000000000000003],
+        certainty=0.34),
+    "dbscan": dict(
+        kwargs={"dbscan_eps": 1.0, "dbscan_min_samples": 2},
+        smooth_rep=[0.17000000000000001, 0.17000000000000001,
+                    0.17000000000000001, 0.17000000000000001,
+                    0.16000000000000003, 0.16000000000000003],
+        certainty=0.34),
+}
+
+
+@pytest.mark.parametrize("algo", sorted(GOLDEN_VARIANTS))
+class TestGoldenVariants:
+    def test_numpy_matches_frozen(self, algo):
+        g = GOLDEN_VARIANTS[algo]
+        r = Oracle(reports=CANONICAL, backend="numpy", algorithm=algo,
+                   **g["kwargs"]).consensus()
+        np.testing.assert_allclose(r["agents"]["smooth_rep"],
+                                   g["smooth_rep"], rtol=1e-12, atol=1e-14)
+        np.testing.assert_array_equal(r["events"]["outcomes_final"],
+                                      [1.0, 0.5, 0.5, 0.0])
+        assert r["certainty"] == pytest.approx(g["certainty"], rel=1e-12)
+
+    def test_jax_matches_frozen(self, algo):
+        g = GOLDEN_VARIANTS[algo]
+        r = Oracle(reports=CANONICAL, backend="jax", algorithm=algo,
+                   **g["kwargs"]).consensus()
+        np.testing.assert_array_equal(
+            np.asarray(r["events"]["outcomes_final"]), [1.0, 0.5, 0.5, 0.0])
+        np.testing.assert_allclose(r["agents"]["smooth_rep"],
+                                   g["smooth_rep"], atol=5e-6)
+
+
 class TestMissing:
     def test_filled_no_nan(self):
         result = Oracle(reports=MISSING, max_iterations=10).consensus()
