@@ -101,13 +101,20 @@ def main() -> None:
     args = ap.parse_args()
     out_path = pathlib.Path(args.out)
 
+    only = {s for s in args.only.split(",") if s}
+    known = {n for n, _, _ in MEASUREMENTS}
+    if only - known:
+        # fail fast on a typo BEFORE burning the probe / any chip time
+        print(f"unknown measurement name(s) {sorted(only - known)}; "
+              f"known: {sorted(known)}")
+        sys.exit(2)
+
     if not probe():
         print("TPU tunnel not responding — nothing measured (probe rc!=0 "
               "or timeout; see docs/PERFORMANCE.md wedge notes)")
         sys.exit(1)
     print("TPU alive — running suite (sequential; OOM-risky shapes last)")
 
-    only = {s for s in args.only.split(",") if s}
     results = []
     for name, argv, timeout in MEASUREMENTS:
         if only and name not in only:
