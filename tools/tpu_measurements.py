@@ -98,6 +98,12 @@ def main() -> None:
     ap.add_argument("--out", default=str(ROOT / "docs/MEASUREMENTS_r02.json"))
     ap.add_argument("--only", default="",
                     help="comma-separated subset of measurement names")
+    ap.add_argument("--force", action="store_true",
+                    help="skip the TPU probe and run on whatever backend "
+                         "comes up (testing the orchestration on CPU)")
+    ap.add_argument("--shape", nargs=2, type=int, metavar=("R", "E"),
+                    help="override reporters/events for every measurement "
+                         "(testing; scaled counts are clamped to E)")
     args = ap.parse_args()
     out_path = pathlib.Path(args.out)
 
@@ -109,16 +115,26 @@ def main() -> None:
               f"known: {sorted(known)}")
         sys.exit(2)
 
-    if not probe():
-        print("TPU tunnel not responding — nothing measured (probe rc!=0 "
-              "or timeout; see docs/PERFORMANCE.md wedge notes)")
-        sys.exit(1)
-    print("TPU alive — running suite (sequential; OOM-risky shapes last)")
+    if not args.force:
+        if not probe():
+            print("TPU tunnel not responding — nothing measured (probe "
+                  "rc!=0 or timeout; see docs/PERFORMANCE.md wedge notes)")
+            sys.exit(1)
+        print("TPU alive — running suite (sequential; OOM-risky shapes "
+              "last)")
 
     results = []
     for name, argv, timeout in MEASUREMENTS:
         if only and name not in only:
             continue
+        if args.shape:
+            R, E = args.shape
+            argv = list(argv)
+            if "--scaled" in argv:
+                i = argv.index("--scaled") + 1
+                argv[i] = str(min(int(argv[i]), E))
+            argv += ["--reporters", str(R), "--events", str(E),
+                     "--repeats", "2", "--batches", "2"]
         print(f"--- {name}: bench.py {' '.join(argv)}", flush=True)
         res = run_one(name, argv, timeout)
         results.append(res)
