@@ -15,6 +15,20 @@ opposite to a numpy f64 run and diverge visibly. This is inherent to blending
 near-degenerate eigenvectors, not a kernel bug; the first component (the
 ``sztorc`` algorithm, the north-star parity target) has a decisive gap and
 matches across precisions.
+
+Second f32 caveat (found by tests/test_f32_mode.py): the ITERATIVE loop
+(``max_iterations > 1``) with power-method PCA carries an
+O(sqrt(E) * eps_f32) loading error per sweep (f32 matvec accumulation —
+the hardware's precision, not a tolerance knob), and the
+reputation-feedback iterations amplify it. On knife-edge matrices —
+events tied so evenly that only the delicate iterative trajectory
+resolves them (the canonical 3-vs-3 example) — an f32 power run can
+leave such an event at the ambiguous 0.5 where the f64 reference (or an
+f32 ``eigh-gram`` run, whose per-iteration loading is exact to
+O(eps_f32)) resolves it. It never flips to the OPPOSITE outcome — the
+noise can fail to break a tie, not invert one (pinned by the f32 test).
+Iterative runs that must reproduce the f64 trajectory on ties should use
+``eigh-gram`` (``auto`` already picks it for R <= 4096) or f64.
 """
 
 from __future__ import annotations

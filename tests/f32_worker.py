@@ -1,0 +1,77 @@
+"""Worker for the f32-mode test (not collected by pytest — launched in a
+fresh process by tests/test_f32_mode.py WITHOUT x64 enabled, the precision
+the real TPU runs at; the main test session is pinned to f64 by
+conftest.py and cannot change precision after jax initializes)."""
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_enable_x64
+
+import numpy as np  # noqa: E402
+
+from pyconsensus_tpu import ALGORITHMS, Oracle  # noqa: E402
+
+CANONICAL = np.array([
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 1.0, 1.0, 0.0],
+    [0.0, 0.0, 1.0, 1.0],
+    [0.0, 0.0, 1.0, 1.0],
+])
+MISSING = CANONICAL.copy()
+MISSING[0, 3] = np.nan
+MISSING[4, 0] = np.nan
+SCALED = np.array([
+    [1.0, 0.5, 0.0, 233.0, 16027.59],
+    [1.0, 0.5, 0.0, 199.0, np.nan],
+    [1.0, 1.0, 0.0, 233.0, 16027.59],
+    [1.0, 0.5, 0.0, 250.0, 0.0],
+    [0.0, 0.5, 1.0, 435.8, 8001.0],
+    [0.0, 0.5, 1.0, 435.8, 19999.0],
+])
+BOUNDS = [None, None, None,
+          {"scaled": True, "min": 0.0, "max": 435.8},
+          {"scaled": True, "min": 0.0, "max": 20000.0}]
+
+out = {}
+for algo in ALGORITHMS:
+    r = Oracle(reports=CANONICAL, backend="jax", algorithm=algo,
+               max_iterations=2).consensus()
+    out[f"canonical/{algo}"] = {
+        "outcomes": np.asarray(r["events"]["outcomes_final"],
+                               dtype=float).tolist(),
+        "smooth_rep": np.asarray(r["agents"]["smooth_rep"],
+                                 dtype=float).tolist(),
+    }
+r = Oracle(reports=MISSING, backend="jax", max_iterations=5).consensus()
+out["missing/sztorc"] = {
+    "outcomes": np.asarray(r["events"]["outcomes_final"],
+                           dtype=float).tolist(),
+    "smooth_rep": np.asarray(r["agents"]["smooth_rep"],
+                             dtype=float).tolist(),
+}
+r = Oracle(reports=SCALED, event_bounds=BOUNDS, backend="jax").consensus()
+out["scaled/sztorc"] = {
+    "outcomes": np.asarray(r["events"]["outcomes_final"],
+                           dtype=float).tolist(),
+    "smooth_rep": np.asarray(r["agents"]["smooth_rep"],
+                             dtype=float).tolist(),
+}
+for pca in ("eigh-gram", "power"):
+    r = Oracle(reports=CANONICAL, backend="jax", max_iterations=5,
+               pca_method=pca).consensus()
+    out[f"canonical-iter5/{pca}"] = {
+        "outcomes": np.asarray(r["events"]["outcomes_final"],
+                               dtype=float).tolist(),
+        "smooth_rep": np.asarray(r["agents"]["smooth_rep"],
+                                 dtype=float).tolist(),
+    }
+print("F32RESULTS " + json.dumps(out))
