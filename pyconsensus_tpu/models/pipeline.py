@@ -288,7 +288,8 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     return result
 
 
-consensus_jit = jax.jit(_consensus_core, static_argnames=("p",))
+consensus_jit = jax.jit(jk.exact_matmuls(_consensus_core),
+                        static_argnames=("p",))
 
 #: keys whose values are (R, E)-sized — everything else is O(R) or O(E)
 _LARGE_RESULT_KEYS = ("original", "rescaled", "filled")
@@ -350,8 +351,7 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     def scores_at(rep_k, mu_k, v_init=None):
         return jk.sztorc_scores_power_fused(
             x, rep_k, p.power_iters, p.power_tol, p.matvec_dtype,
-            interpret=interp, fill=fill, mu=mu_k,
-            mono=p.pca_method == "power-mono", v_init=v_init)
+            interpret=interp, fill=fill, mu=mu_k, v_init=v_init)
 
     if p.max_iterations <= 1:
         adj, loading = scores_at(old_rep, mu1)
@@ -482,13 +482,21 @@ def _consensus_core_light(reports, reputation, scaled, mins, maxs,
     if p.fused_resolution:
         return _consensus_core_fused(reports, reputation, scaled, mins, maxs,
                                      p)
-    result = _consensus_core(reports, reputation, scaled, mins, maxs, p)
+    # the XLA path is the fidelity route (multi-chip, ica, scaled-heavy):
+    # exact f32 matmuls throughout — see jk.exact_matmuls. The fused path
+    # above instead scopes exactness to the outcome/certainty kernel dots
+    # (pallas_kernels._resolve_certainty_kernel): HIGHEST on every MXU
+    # pass measured ~40% off the headline rate for value noise the catch
+    # snap absorbs anyway.
+    result = jk.exact_matmuls(_consensus_core)(reports, reputation, scaled,
+                                               mins, maxs, p)
     for key in _LARGE_RESULT_KEYS:
         result.pop(key)
     return result
 
 
-consensus_light_jit = jax.jit(_consensus_core_light, static_argnames=("p",))
+consensus_light_jit = jax.jit(_consensus_core_light,
+                              static_argnames=("p",))
 
 
 def _consensus_hybrid(reports, reputation, scaled, mins, maxs,

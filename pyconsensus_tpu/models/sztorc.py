@@ -66,11 +66,10 @@ def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
     iteration's loading — see jax_kernels._power_loop); eigh methods
     ignore it."""
     method = jk.resolve_pca_method(*reports_filled.shape, pca_method)
-    if method in ("power-fused", "power-mono"):
+    if method == "power-fused":
         return jk.sztorc_scores_power_fused(
             reports_filled, reputation, power_iters, power_tol, matvec_dtype,
-            interpret=jax.default_backend() != "tpu",
-            mono=method == "power-mono", v_init=v_init)
+            interpret=jax.default_backend() != "tpu", v_init=v_init)
     loading, scores = jk.weighted_prin_comp(reports_filled, reputation,
                                             method=method,
                                             power_iters=power_iters,

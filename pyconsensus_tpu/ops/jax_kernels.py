@@ -46,6 +46,31 @@ __all__ = [
 ]
 
 
+def exact_matmuls(fn):
+    """Trace ``fn`` under ``jax.default_matmul_precision("highest")``.
+
+    TPU's DEFAULT matmul precision multiplies f32 operands in bf16 on the
+    MXU. Measured on v5e: the resolution kernel's reputation-weighted
+    column means came back bf16-quantized (~1e-3 relative error vs the
+    interpreter), which silently degrades every cross-backend value
+    contract (reputation/certainty parity is tested at 5e-6) and can flip
+    a catch-snap within 1e-3 of a boundary. Every contraction in this
+    pipeline is matvec-shaped and HBM-bandwidth-bound — the 3-pass exact
+    f32 MXU mode costs arithmetic the bandwidth already hides — so the
+    pipeline drivers opt into exactness wholesale. Explicitly-lowered
+    bf16 OPERANDS (``matvec_dtype``/``storage_dtype``) still stream at
+    half width: highest precision multiplies the stored bf16 values
+    exactly, which is precisely the "low-precision storage, exact
+    accumulation" contract."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
 def normalize(v: jnp.ndarray) -> jnp.ndarray:
     """``v / sum(v)`` with the zero-sum vector returned unchanged
     (numpy_kernels.normalize)."""
@@ -161,11 +186,28 @@ def _first_pc_eigh_gram(dev, denom, reputation):
     return loading, dev @ loading
 
 
+def _power_seed(E: int, dtype):
+    """Deterministic dense start vector for power iteration: a fixed-key
+    standard-normal draw (NOT the ones vector). The ones vector is EXACTLY
+    orthogonal to the dominant eigenvector whenever that eigenvector's
+    entries sum to zero — which the canonical Truthcoin 6×4 matrix
+    produces (an antisymmetric top loading): power iteration then starts
+    with zero v1 component and must wait for rounding noise to leak one
+    in. In f64 the 128-sweep budget recovers; in f32 on the real chip the
+    alignment early-exit fires while the iterate still sits on the
+    runner-up eigenvector (measured on v5e: outcomes [1, .5, .5, 0]
+    vs numpy's [1, 1, 0, 0]). A fixed-key normal vector is deterministic
+    across runs/backends and has measure-zero probability of orthogonality
+    to any data-derived direction."""
+    return jax.random.normal(jax.random.key(0), (E,), dtype)
+
+
 def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float,
                 v_init=None):
     """Shared power-iteration driver (used by the XLA matvec path below and
     the fused Pallas path in ``pallas_kernels``): deterministic start — one
-    implicit-covariance application to the ones vector — then a
+    implicit-covariance application to the fixed-key :func:`_power_seed`
+    vector — then a
     ``lax.while_loop`` that stops once successive (normalized) iterates
     align to ``|<v_k, v_{k-1}>| >= 1 - max(tol, 8*eps(dtype))``. With a
     strong first-eigenvalue gap (the coordinated-collusion signal PCA
@@ -187,38 +229,39 @@ def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float,
     reputation moves a little per redistribution step, so the dominant
     eigenvector barely moves and the early exit fires after one or two
     sweeps instead of a cold handful. A zero/None ``v_init`` falls back to
-    the ones vector, bitwise identical to the cold start (so outer
+    the cold-start seed, bitwise identical to the cold start (so outer
     iteration 1, whose scan carry is zeros, is unchanged).
 
-    The warm seed is BLENDED with the ones vector rather than used pure.
-    A pure stale eigenvector is an exact fixed point of ``apply_cov``, so
-    if the top two eigenvalues crossed between outer iterations (e.g.
-    redistribution demoting one of two near-tied collusion clusters) a
-    pure warm start could pass the self-consistency exit while sitting on
-    the now-SECOND eigenvector. Mixing in the ones direction restores the
-    cold start's reachability assumption (<1, v1> != 0): any decisively
-    dominant new direction contaminates the iterate geometrically and the
-    exit cannot fire until it has won; in the genuinely near-tied regime
-    the early exit may still stop between the two, where the directions
-    are statistically interchangeable (and where the exact eigh is itself
-    unstable). Cost: at most a sweep or two over the pure warm start when
-    nothing crossed."""
+    The warm seed is BLENDED with the cold-start seed rather than used
+    pure. A pure stale eigenvector is an exact fixed point of
+    ``apply_cov``, so if the top two eigenvalues crossed between outer
+    iterations (e.g. redistribution demoting one of two near-tied
+    collusion clusters) a pure warm start could pass the self-consistency
+    exit while sitting on the now-SECOND eigenvector. Mixing in the dense
+    seed direction restores the cold start's reachability assumption
+    (<seed, v1> != 0): any decisively dominant new direction contaminates
+    the iterate geometrically and the exit cannot fire until it has won;
+    in the genuinely near-tied regime the early exit may still stop
+    between the two, where the directions are statistically
+    interchangeable (and where the exact eigh is itself unstable). Cost:
+    at most a sweep or two over the pure warm start when nothing
+    crossed."""
     no_exit = tol < 0
     tol = max(float(tol), 8.0 * float(jnp.finfo(dtype).eps))
 
+    base = _power_seed(E, dtype)
+    base_unit = base / jnp.linalg.norm(base)
     if v_init is None:
-        seed = jnp.ones((E,), dtype=dtype)
+        seed = base
     else:
         v_init = v_init.astype(dtype)
         n_i = jnp.linalg.norm(v_init)
         blended = (v_init / jnp.where(n_i > 0.0, n_i, 1.0)
-                   + 0.25 * jnp.ones((E,), dtype=dtype)
-                   / jnp.sqrt(jnp.asarray(E, dtype)))
-        seed = jnp.where(n_i > 0.0, blended, jnp.ones((E,), dtype=dtype))
+                   + 0.25 * base_unit)
+        seed = jnp.where(n_i > 0.0, blended, base)
     v0 = apply_cov(seed)
     n0 = jnp.linalg.norm(v0)
-    v0 = jnp.where(n0 == 0.0,
-                   jnp.ones((E,), dtype) / jnp.sqrt(jnp.asarray(E, dtype)),
+    v0 = jnp.where(n0 == 0.0, base_unit,
                    v0 / jnp.where(n0 == 0.0, 1.0, n0))
 
     def cond(state):
@@ -310,7 +353,7 @@ def resolve_pca_method(R: int, E: int, method: str) -> str:
         if jax.default_backend() == "tpu" and fits:
             return "power-fused"
         return "power"
-    if method in ("power-fused", "power-mono"):
+    if method == "power-fused":
         if jax.default_backend() != "tpu" and R * E > (1 << 20):
             return "power"
         if not fits:
@@ -340,23 +383,17 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
     """
     R, E = reports_filled.shape
     method = resolve_pca_method(R, E, method)
-    if method in ("power-fused", "power-mono"):
-        from .pallas_kernels import (power_iteration_fused,
-                                     power_iteration_mono)
+    if method == "power-fused":
+        from .pallas_kernels import power_iteration_fused
 
         acc = reputation.dtype
         mu, denom = _mu_denom(reports_filled, reputation)
         xmm = (reports_filled.astype(jnp.dtype(matvec_dtype))
                if matvec_dtype else reports_filled)
-        if method == "power-mono":
-            loading = power_iteration_mono(
-                xmm, mu, reputation, min(int(power_iters), _MONO_MAX_ITERS),
-                interpret=jax.default_backend() != "tpu").astype(acc)
-        else:
-            loading = power_iteration_fused(
-                xmm, mu, denom, reputation, power_iters, power_tol,
-                interpret=jax.default_backend() != "tpu",
-                v_init=v_init).astype(acc)
+        loading = power_iteration_fused(
+            xmm, mu, denom, reputation, power_iters, power_tol,
+            interpret=jax.default_backend() != "tpu",
+            v_init=v_init).astype(acc)
         # scores = (X - mu) @ loading without materializing the centered
         # matrix: X @ loading is one sweep; mu . loading is a scalar
         scores = (jnp.matmul(reports_filled,
@@ -388,7 +425,7 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
     the scalable exact option here (O(R²) memory, never E×E)."""
     dev, denom = _center(reports_filled, reputation)
     R, E = reports_filled.shape
-    if method in ("auto", "power", "power-fused", "power-mono"):
+    if method in ("auto", "power", "power-fused"):
         method = "eigh-cov" if E <= 1024 else "eigh-gram"
     if method not in ("eigh-cov", "eigh-gram"):
         raise ValueError(f"unknown PCA method: {method!r}")
@@ -537,17 +574,10 @@ def direction_fixed_scores(scores, reports_filled, reputation):
     return jnp.where(ref_ind <= 0.0, set1, -set2)
 
 
-#: sweep cap for the fixed-trip-count "power-mono" kernel: the early-exit
-#: loop typically stops after ~4-6 sweeps, so 16 fixed sweeps converge at
-#: least as far while bounding the cost of the default power_iters=128
-#: budget (which is sized for the early-exit path)
-_MONO_MAX_ITERS = 16
-
-
 def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
                               power_tol: float, matvec_dtype: str = "",
                               interpret: bool = False, fill=None, mu=None,
-                              mono: bool = False, v_init=None):
+                              v_init=None):
     """The whole sztorc scoring step on the Pallas fast path: power-iteration
     PCA (one HBM sweep per step, pallas_kernels.apply_weighted_cov) followed
     by the scores + direction-fix contractions in ONE further sweep
@@ -568,18 +598,11 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     With ``fill`` (and the matching precomputed ``mu``) the input is
     NaN-threaded storage — absent entries NaN, filled values reconstructed
     in-register by the kernels — so the filled matrix never exists in HBM.
-
-    ``mono=True`` (EXPERIMENTAL, ``pca_method="power-mono"``) swaps the
-    per-sweep kernel loop for the single-launch
-    :func:`pallas_kernels.power_iteration_mono` — a FIXED trip count with
-    no early exit, capped at :data:`_MONO_MAX_ITERS` sweeps so the
-    default ``power_iters=128`` budget (sized for the early-exit loop)
-    cannot silently become 128 full HBM sweeps. The mono kernel also
-    IGNORES ``v_init`` (its start vector lives inside the single launch),
-    so the iterative loop's warm start does not apply to it.
+    (A single-launch fixed-trip "power-mono" variant existed through round
+    2; the on-chip A/B measured it 36% slower than this early-exit loop —
+    docs/PERFORMANCE.md — and it was removed.)
     """
-    from .pallas_kernels import (power_iteration_fused,
-                                 power_iteration_mono, scores_dirfix_pass)
+    from .pallas_kernels import power_iteration_fused, scores_dirfix_pass
 
     acc = reputation.dtype
     if fill is None:
@@ -589,17 +612,10 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
         denom = jnp.where(denom == 0.0, 1.0, denom)
     xmm = (reports_filled.astype(jnp.dtype(matvec_dtype)) if matvec_dtype
            else reports_filled)
-    if mono:
-        loading = power_iteration_mono(xmm, mu, reputation,
-                                       min(int(power_iters),
-                                           _MONO_MAX_ITERS),
-                                       fill=fill,
-                                       interpret=interpret).astype(acc)
-    else:
-        loading = power_iteration_fused(xmm, mu, denom, reputation,
-                                        power_iters, power_tol, fill=fill,
-                                        interpret=interpret,
-                                        v_init=v_init).astype(acc)
+    loading = power_iteration_fused(xmm, mu, denom, reputation,
+                                    power_iters, power_tol, fill=fill,
+                                    interpret=interpret,
+                                    v_init=v_init).astype(acc)
     t, q, c, o = scores_dirfix_pass(xmm, reputation, loading, fill=fill,
                                     interpret=interpret)
     ml = mu @ loading

@@ -39,7 +39,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["apply_weighted_cov", "power_iteration_fused",
-           "power_iteration_mono",
            "scores_dirfix_pass", "resolve_certainty_fused"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
@@ -49,8 +48,15 @@ _PANEL_BYTES = 4 * 1024 * 1024
 
 def _panel_rows(n_events: int, itemsize: int,
                 panel_bytes: int = _PANEL_BYTES) -> int:
-    """Rows per panel: ~panel_bytes big, multiple of 8 sublanes, >= 8."""
-    rows = max(1, panel_bytes // max(1, n_events * itemsize))
+    """Rows per panel: ~panel_bytes big, multiple of 8 sublanes, >= 8.
+
+    Sized against the VMEM footprint, not the logical bytes: VMEM tiles
+    pad the lane (event) axis up to 128, so a narrow matrix costs
+    ``roundup(E, 128)`` lanes per row. Without this, E=4 sized panels at
+    262144 rows whose "4 MB" window was physically 128 MB — a measured
+    VMEM OOM on v5e driving pca_method='power-fused' at toy shapes."""
+    lanes = -(-n_events // 128) * 128
+    rows = max(1, panel_bytes // max(1, lanes * itemsize))
     return max(8, (rows // 8) * 8)
 
 
@@ -95,9 +101,7 @@ def _cov_panel_contribution(x_ref, mu_ref, rep_ref, v, *, nan_fill):
     in-register. ``nan_fill=True`` reads NaN-threaded storage: absent
     entries are NaN in ``x`` and ``mu_ref`` row 1 carries ``fill - mu``
     (the centered per-column fill value), so the filled matrix is
-    reconstructed in-register and never exists in HBM. Shared by the
-    per-sweep kernel (:func:`apply_weighted_cov`) and the single-launch
-    power loop (:func:`power_iteration_mono`)."""
+    reconstructed in-register and never exists in HBM."""
     xp = x_ref[:].astype(jnp.float32)
     if nan_fill:
         xc = jnp.where(jnp.isnan(xp), mu_ref[1:2, :], xp - mu_ref[0:1, :])
@@ -324,35 +328,63 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
               + jc * C) < n_events
     fill = fv_ref[0:1, :]
     zero = jnp.zeros((1, C), f32)
-    # all reductions ride the MXU (dot_general against the chunk's
+    # All reductions ride the MXU (dot_general against the chunk's
     # reputation column / a ones vector) — VPU sum() chains measured ~2x
-    # the HBM read this kernel covers
+    # the HBM read this kernel covers. Exactness: Mosaic's DEFAULT dot
+    # precision rounds f32 operands to bf16, which left these weighted
+    # means bf16-quantized (~1e-3 off, measured on v5e) — and this
+    # kernel's outputs ARE the outcome/certainty contract. Per-dot
+    # Precision.HIGHEST fixes that but its 6-pass decomposition measured
+    # ~9 res/s off the headline rate. Instead ``compensated_dot`` runs TWO
+    # DEFAULT passes — bf16(v) and the f32 residual — each of whose
+    # products is EXACT, because every matrix operand below holds
+    # bf16-exact values ({0, 0.5, 1} reports/fills, 0/1 masks) and bf16
+    # products against them need <=17 mantissa bits. Only the vector
+    # operand (reputation / certainty) is continuous, and its
+    # second-order residual (~2^-17 relative) is the only loss. A
+    # fancier one-pass (chunk,3)-stacked variant was tried and measured
+    # WORSE precision — the stacked shape flips the backend onto a
+    # lower-precision path — so the two plain dots stay.
     dn_col = (((0,), (0,)), ((), ()))       # (chunk,1)^T x (chunk,C) -> (1,C)
     dn_row = (((1,), (0,)), ((), ()))       # (chunk,C) x (C,1) -> (chunk,1)
 
-    def col_dot(v, m):
-        return jax.lax.dot_general(v, m, dn_col,
-                                   preferred_element_type=f32)
+    def compensated_dot(v, m, dn):
+        h = v.astype(jnp.bfloat16).astype(f32)
+        return (jax.lax.dot_general(h, m, dn, preferred_element_type=f32)
+                + jax.lax.dot_general(v - h, m, dn,
+                                      preferred_element_type=f32))
 
+    def col_dot(v, m):
+        return compensated_dot(v, m, dn_col)
+
+    # The four column stats need only TWO dot subjects — rep.pres and
+    # rep.xz — because the rest derive exactly:  pcol = sum(rep) - tw,
+    # fmn = numer + fill * pcol  (xf = xz + na*fill elementwise). So the
+    # exact compensated kernel issues the same number of MXU passes the
+    # quantized 4-dot version did. ``tw`` is the directly-computed one
+    # (not derived) because the all-NaN-column fallback tests ``tw > 0``
+    # and the direct products are exact zeros there; pcol faces no such
+    # zero test (it only feeds ``1 - pcol``).
     def stats_body(i, acc):
-        tw, numer, fmn, pcol = acc
+        numer, tw = acc
         sl = pl.ds(i * chunk, chunk)
         xs = x_ref[sl, :].astype(f32)
-        rs = rep_ref[sl, :]
+        rs = rep_ref[sl, :]                            # (chunk, 1)
         na = jnp.isnan(xs)
         naf = (na & col_ok).astype(f32)
         pres = 1.0 - na.astype(f32)
         xz = jnp.where(na, 0.0, xs)
-        xf = jnp.where(na, fill, xs)
+        # 0/1 x 1.0 products are exact in any precision
         narow_ref[sl, :] += jax.lax.dot_general(
             naf, jnp.ones((C, 1), f32), dn_row, preferred_element_type=f32)
-        return (tw + col_dot(rs, pres),
-                numer + col_dot(rs, xz),
-                fmn + col_dot(rs, xf),
-                pcol + col_dot(rs, naf))
+        return (numer + col_dot(rs, xz),
+                tw + col_dot(rs, pres))
 
-    tw, numer, fmn, pcol = jax.lax.fori_loop(
-        0, n_chunks, stats_body, (zero, zero, zero, zero))
+    numer, tw = jax.lax.fori_loop(
+        0, n_chunks, stats_body, (zero, zero))
+    rep_total = jnp.sum(rep_ref[:])
+    pcol = rep_total - tw
+    fmn = numer + fill * pcol
     pcol_ref[:] = pcol
     ft = fv_ref[1:2, :]
     full_mean = fmn / jnp.where(ft == 0.0, 1.0, ft)
@@ -378,6 +410,11 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
         sl = pl.ds(i * chunk, chunk)
         # upcast before isnan — Mosaic rejects the bf16 NaN comparison
         naf = (jnp.isnan(x_ref[sl, :].astype(f32)) & col_ok).astype(f32)
+        # deliberately NOT compensated: certainty's bf16 rounding (~2^-8
+        # relative) enters prow scaled by the NA fraction, so the
+        # participation_rows error is ~1e-4 absolute at 2% NA — not worth
+        # an extra MXU pass per chunk (the means/certainty dots above ARE
+        # exact; they are the result contract)
         prow_ref[sl, :] += jax.lax.dot_general(
             naf, cert_col, dn_row, preferred_element_type=f32)
         return 0
@@ -470,107 +507,6 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
     )(x, rep.astype(f32).reshape(-1, 1), fv)
     return (raw.reshape(E), out.reshape(E), cert.reshape(E), pcol.reshape(E),
             prow.reshape(Rp)[:R], narow.reshape(Rp)[:R])
-
-
-def _power_mono_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *,
-                       nan_fill: bool):
-    """One (iteration, row-panel) grid step of the single-launch power
-    loop. Panel 0 of each iteration finalizes the PREVIOUS iteration's
-    accumulated ``y`` into the new normalized iterate ``v`` (the division
-    by the covariance denominator is dropped — power iteration is
-    scale-invariant and every step renormalizes), then every panel adds
-    its ``D_i^T (rep_i * (D_i v))`` contribution exactly like
-    ``_apply_cov_kernel``. TPU grid steps run sequentially on a core, so
-    the cross-step carry through the constant-indexed ``v``/``y`` blocks
-    is well-defined."""
-    i = pl.program_id(0)
-    j = pl.program_id(1)
-
-    @pl.when((i == 0) & (j == 0))
-    def _():
-        v_ref[:] = jnp.ones_like(v_ref)       # iterate 0: the ones vector
-        y_ref[:] = jnp.zeros_like(y_ref)
-
-    @pl.when((i > 0) & (j == 0))
-    def _():
-        y = y_ref[:]
-        norm = jnp.sqrt(jnp.sum(y * y))
-        # zero-norm guard (degenerate covariance): keep the previous
-        # iterate, matching jax_kernels._power_loop's fallback
-        v_ref[:] = jnp.where(norm == 0.0, v_ref[:],
-                             y / jnp.where(norm == 0.0, 1.0, norm))
-        y_ref[:] = jnp.zeros_like(y_ref)
-
-    y_ref[:] += _cov_panel_contribution(x_ref, mu_ref, rep_ref, v_ref[:],
-                                        nan_fill=nan_fill)
-
-
-@functools.partial(jax.jit, static_argnames=("n_iters", "interpret"))
-def power_iteration_mono(x, mu, rep, n_iters: int, fill=None,
-                         interpret: bool = False):
-    """EXPERIMENTAL (round-2 perf candidate, unmeasured): the whole power
-    loop as ONE ``pallas_call`` with an (iteration × row-panel) grid and
-    VMEM-resident iterate/accumulator, eliminating the per-sweep kernel
-    launches and `lax.while_loop` machinery of
-    :func:`power_iteration_fused`. Fixed trip count (no early exit — the
-    grid is static); the covariance denominator is dropped (power
-    iteration is scale-invariant), so with ``n_iters`` grid iterations
-    this computes the same normalized iterate sequence as the driver
-    path's ``n_iters - 1`` applications after its seeded start. Returns
-    the unit-norm loading (degenerate zero-covariance inputs fall back
-    to the last nonzero iterate, like the driver loop).
-
-    Opt-in via ``pca_method="power-mono"`` (sweep count capped at
-    ``jax_kernels._MONO_MAX_ITERS`` there); never auto-selected — the
-    hypothesis that inter-kernel scheduling bubbles cost ~10 ms per
-    resolution could not be measured on a quiet chip in round 1
-    (docs/ROADMAP.md).
-    """
-    if int(n_iters) < 1:
-        raise ValueError("n_iters must be >= 1 (an empty grid would "
-                         "return uninitialized output memory)")
-    R, E = x.shape
-    nan_fill = fill is not None
-    x, rep, tile_r, mu2 = _prep_cov_inputs(x, mu, rep, fill)
-    Rp = x.shape[0]
-    f32 = jnp.float32
-    n_panels = Rp // tile_r
-    v, y = pl.pallas_call(
-        functools.partial(_power_mono_kernel, nan_fill=nan_fill),
-        grid=(int(n_iters), n_panels),
-        in_specs=[
-            pl.BlockSpec((tile_r, E), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((mu2.shape[0], E), lambda i, j: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile_r, 1), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, E), lambda i, j: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, E), lambda i, j: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, E), f32),   # v (iterate)
-            jax.ShapeDtypeStruct((1, E), f32),   # y (accumulator)
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=4 * int(n_iters) * Rp * E,
-            bytes_accessed=int(n_iters) * Rp * E * x.dtype.itemsize,
-            transcendentals=0),
-        interpret=interpret,
-    )(x, mu2, rep.reshape(-1, 1))
-    y = y.reshape(E)
-    norm = jnp.sqrt(jnp.sum(y * y))
-    # degenerate guard: a zero final accumulator falls back to the last
-    # iterate (itself guarded to stay nonzero back to the ones start)
-    safe = jnp.where(norm == 0.0, 1.0, norm)
-    v = v.reshape(E)
-    vnorm = jnp.linalg.norm(v)
-    v_unit = v / jnp.where(vnorm == 0.0, 1.0, vnorm)
-    return jnp.where(norm == 0.0, v_unit, y / safe)
 
 
 def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,

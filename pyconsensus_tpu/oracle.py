@@ -142,17 +142,13 @@ class Oracle:
     pca_method : str
         JAX PCA strategy: ``auto`` | ``eigh-cov`` | ``eigh-gram`` | ``power``
         | ``power-fused`` (Pallas one-HBM-pass kernel, single-device TPU)
-        | ``power-mono`` (experimental single-launch loop, opt-in only)
         (SURVEY.md §7 "hard parts" — never materialize E×E at scale).
+        (An experimental fixed-trip ``power-mono`` kernel existed through
+        round 2; the on-chip A/B measured it 36% slower than the
+        early-exit loop — docs/PERFORMANCE.md — and it was removed.)
     power_iters, power_tol, matvec_dtype :
         Power-iteration cap, early-exit tolerance (0 = machine-precision
         floor), and optional low-precision matvec storage ("bfloat16").
-        ``power-mono`` runs a FIXED trip count — ``power_iters`` clamped
-        to 16 sweeps and ``power_tol`` ignored (no early exit inside the
-        single kernel launch); a nonzero ``power_tol`` together with
-        ``power-mono`` raises a ``UserWarning``. For a slowly-converging
-        spectrum prefer ``power``/``power-fused``, whose driver loop
-        honors both knobs.
     storage_dtype : str
         Optional compact storage dtype ("bfloat16") for the filled matrix
         through the whole jax pipeline — halves HBM traffic of every
@@ -231,15 +227,6 @@ class Oracle:
                 raise ValueError(f"{name} must be >= 1")
         if dbscan_eps <= 0.0:
             raise ValueError("dbscan_eps must be positive")
-        if pca_method == "power-mono" and float(power_tol) > 0.0:
-            import warnings
-
-            warnings.warn(
-                "pca_method='power-mono' runs a fixed trip count (capped at "
-                "16 sweeps) and ignores power_tol — the requested early-exit "
-                f"tolerance {power_tol} will not be honored; use "
-                "pca_method='power' or 'power-fused' for tolerance-driven "
-                "early exit", UserWarning, stacklevel=2)
 
         self.reputation = rep
         self.backend = backend

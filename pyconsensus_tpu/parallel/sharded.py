@@ -38,8 +38,11 @@ __all__ = ["sharded_consensus", "ShardedOracle", "PlacedBounds",
 
 #: PCA methods that never materialize the E×E covariance and whose
 #: contractions ride the event axis (SURVEY.md §7 "hard parts");
-#: "power-mono" is the experimental single-launch kernel (docs/ROADMAP.md)
-_SHARDABLE_PCA = ("eigh-gram", "power", "power-fused", "power-mono")
+_SHARDABLE_PCA = ("eigh-gram", "power", "power-fused")
+#: every legal pca_method string; anything else fails fast here rather
+#: than silently falling through to the auto pick (the single-device path
+#: raises the same error from weighted_prin_comp)
+_KNOWN_PCA = ("auto", "eigh-cov") + _SHARDABLE_PCA
 #: algorithms needing the full top-k spectrum (first-PC-only power iteration
 #: cannot serve them; the R×R Gram eigh is their scalable exact path)
 _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
@@ -47,15 +50,16 @@ _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
 
 def _pick_pca_method(params: ConsensusParams, n_reporters: int,
                      n_devices: int = 1) -> str:
+    if params.pca_method not in _KNOWN_PCA:
+        raise ValueError(f"unknown PCA method: {params.pca_method!r}; "
+                         f"choose from {_KNOWN_PCA}")
     if params.algorithm in _MULTI_COMPONENT_ALGOS:
         return "eigh-gram"
     if params.pca_method in _SHARDABLE_PCA:
         # the Pallas kernels are black boxes to the GSPMD partitioner — an
-        # explicit "power-fused"/"power-mono" request downgrades to the XLA
-        # matvecs on a multi-device mesh so the event-axis contractions
-        # actually shard
-        if (params.pca_method in ("power-fused", "power-mono")
-                and n_devices > 1):
+        # explicit "power-fused" request downgrades to the XLA matvecs on a
+        # multi-device mesh so the event-axis contractions actually shard
+        if params.pca_method == "power-fused" and n_devices > 1:
             return "power"
         return params.pca_method
     # "auto"/"eigh-cov" on a sharded matrix would build E×E — never do that;
@@ -141,7 +145,7 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     return (n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
-            and params.pca_method in ("power", "power-fused", "power-mono")
+            and params.pca_method in ("power", "power-fused")
             and scaled_ok
             and fused_pca_fits(n_events, itemsize)
             and resolve_kernel_fits(r_padded, itemsize))

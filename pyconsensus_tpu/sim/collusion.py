@@ -166,7 +166,7 @@ class CollusionSimulator:
             dbscan_eps=float(dbscan_eps),
             dbscan_min_samples=int(dbscan_min_samples),
             any_scaled=False, has_na=False)
-        self._batched = jax.jit(jax.vmap(self._trial_fn()))
+        self._batched = jax.jit(jk.exact_matmuls(jax.vmap(self._trial_fn())))
 
     def _trial_fn(self):
         """Subclass hook: the per-trial function ``(key, lf, var) -> metrics``

@@ -33,7 +33,6 @@ class TestResolvePcaMethod:
         # tiny shapes may run the interpreter (tests); big ones must not
         assert jk.resolve_pca_method(10, 64, "power-fused") == "power-fused"
         assert jk.resolve_pca_method(5000, 50_000, "power-fused") == "power"
-        assert jk.resolve_pca_method(5000, 50_000, "power-mono") == "power"
 
 
 class TestPlacedBounds:

@@ -467,73 +467,6 @@ class TestPallasFused:
         np.testing.assert_allclose(_align_sign(s, scores_np), scores_np,
                                    atol=3e-3)
 
-    @pytest.mark.parametrize("with_fill", [False, True])
-    def test_power_mono_matches_driver_loop(self, rng, with_fill):
-        """EXPERIMENTAL single-launch power loop: with k+1 grid iterations
-        it computes the same normalized iterate sequence as the driver
-        path's seeded start + k fixed applications (the dropped
-        denominator is a per-step scale, renormalized away)."""
-        from pyconsensus_tpu.ops.pallas_kernels import (
-            power_iteration_fused, power_iteration_mono)
-        R, E, k = 13, 9, 24
-        X = rng.random((R, E)).astype(np.float32)
-        rep = jnp.asarray(nk.normalize(rng.random(R) + 0.1), jnp.float32)
-        fill = None
-        if with_fill:
-            fill = jnp.asarray(rng.random(E), jnp.float32)
-            X[rng.random((R, E)) < 0.15] = np.nan
-            filled = np.where(np.isnan(X), np.asarray(fill)[None, :], X)
-        else:
-            filled = X
-        mu = jnp.asarray(rep @ filled)
-        denom = 1.0 - jnp.sum(rep ** 2)
-        ref = np.asarray(power_iteration_fused(
-            jnp.asarray(X), mu, denom, rep, n_iters=k, tol=-1.0, fill=fill,
-            interpret=True))
-        mono = np.asarray(power_iteration_mono(
-            jnp.asarray(X), mu, rep, n_iters=k + 1, fill=fill,
-            interpret=True))
-        np.testing.assert_allclose(_align_sign(mono, ref), ref, atol=1e-4)
-
-    def test_power_mono_multi_panel_carry(self, rng, monkeypatch):
-        """The (i>0, j==0) finalize-then-accumulate carry across MULTIPLE
-        row panels (n_panels > 1, forced via a tiny panel budget) must
-        match the single-panel result — the cross-grid-step VMEM state is
-        where a mis-carry would hide."""
-        import pyconsensus_tpu.ops.pallas_kernels as pk
-        R, E, k = 24, 9, 16
-        X = jnp.asarray(rng.random((R, E)), jnp.float32)
-        rep = jnp.asarray(nk.normalize(rng.random(R) + 0.1), jnp.float32)
-        mu = rep @ X
-        single = np.asarray(pk.power_iteration_mono(X, mu, rep, n_iters=k,
-                                                    interpret=True))
-        monkeypatch.setattr(pk, "_PANEL_BYTES", 64)   # 8-row panels -> 3
-        # the panel size is baked in at trace time; without a cache clear
-        # the second call would silently reuse the single-panel program
-        import jax
-
-        jax.clear_caches()
-        multi = np.asarray(pk.power_iteration_mono(X, mu, rep, n_iters=k,
-                                                   interpret=True))
-        assert X.shape[0] // pk._panel_rows(E, 4, pk._PANEL_BYTES) == 3
-        np.testing.assert_allclose(_align_sign(multi, single), single,
-                                   atol=1e-5)
-
-    def test_power_mono_degenerate_and_validation(self, rng):
-        """Zero covariance (identical rows) must not return NaN, and an
-        empty grid is rejected."""
-        from pyconsensus_tpu.ops.pallas_kernels import power_iteration_mono
-        R, E = 8, 6
-        X = jnp.asarray(np.tile(rng.random(E), (R, 1)), jnp.float32)
-        rep = jnp.full((R,), 1.0 / R, jnp.float32)
-        mu = rep @ X
-        out = np.asarray(power_iteration_mono(X, mu, rep, n_iters=4,
-                                              interpret=True))
-        assert np.isfinite(out).all()
-        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
-        with pytest.raises(ValueError, match="n_iters"):
-            power_iteration_mono(X, mu, rep, n_iters=0, interpret=True)
-
     def test_scores_dirfix_pass_contractions(self, rng):
         """The one-sweep contraction outputs equal their two-pass XLA
         definitions: t = X@loading, q = t^T X, c = colsums, o = rep^T X."""

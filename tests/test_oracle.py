@@ -578,11 +578,6 @@ class TestValidation:
         assert o.params.n_scaled == 0          # majority: full median wins
         assert Oracle(reports=CANONICAL).params.n_scaled == 0
 
-    def test_power_mono_ignored_tol_warns(self):
-        with pytest.warns(UserWarning, match="power-mono.*power_tol"):
-            Oracle(reports=CANONICAL, backend="jax",
-                   pca_method="power-mono", power_tol=1e-5)
-
     def test_algorithm_aliases(self):
         o = Oracle(reports=CANONICAL, algorithm="kmeans")
         assert o.params.algorithm == "k-means"

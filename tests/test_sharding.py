@@ -200,31 +200,6 @@ class TestFusedResolution:
             else:
                 np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
 
-    def test_power_mono_matches_power(self, rng):
-        """The experimental single-launch power loop, threaded through the
-        fused pipeline as pca_method='power-mono', must reproduce the
-        per-sweep path's catch-snapped outcomes (enough fixed iterations
-        stands in for the early exit)."""
-        from pyconsensus_tpu.models.pipeline import _consensus_core_fused
-        import jax.numpy as jnp
-        reports = make_reports(rng, R=24, E=7)
-        R, E = reports.shape
-        rep = np.full(R, 1.0 / R)
-        args = (jnp.asarray(reports), jnp.asarray(rep),
-                jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E))
-        base = ConsensusParams(algorithm="sztorc", max_iterations=1,
-                               power_iters=64, power_tol=-1.0,
-                               any_scaled=False, has_na=True,
-                               fused_resolution=True)
-        ref = _consensus_core_fused(*args, base._replace(pca_method="power"))
-        mono = _consensus_core_fused(
-            *args, base._replace(pca_method="power-mono"))
-        np.testing.assert_array_equal(
-            np.asarray(ref["outcomes_adjusted"]),
-            np.asarray(mono["outcomes_adjusted"]))
-        np.testing.assert_allclose(np.asarray(mono["smooth_rep"]),
-                                   np.asarray(ref["smooth_rep"]), atol=1e-5)
-
     def test_matches_xla_light_path_scaled(self, rng):
         """Mixed binary + scaled events: the fused path's gather-and-fix
         median pass must reproduce the XLA light pipeline (same sort-based

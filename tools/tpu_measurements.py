@@ -39,10 +39,9 @@ MEASUREMENTS = [
     # (d) re-confirm the headline after the round-1 late commits + round-2
     # median/indexing changes
     ("headline", [], 900),
-    # (a) power-mono vs power-fused A/B on a quiet chip
+    # (a) the explicit-fused series (the power-mono A/B ran 2026-07-31:
+    # mono measured 36% slower and was deleted — docs/PERFORMANCE.md)
     ("power_fused", ["--pca-method", "power-fused"], 900),
-    ("power_mono", ["--pca-method", "power-mono", "--power-iters", "8"],
-     900),
     # (c) ICA resolution on-chip (eigh-gram spectrum path)
     ("ica", ["--algorithm", "ica"], 1200),
     # (b) blocked median at increasing scaled fractions; the >E/8 shape
