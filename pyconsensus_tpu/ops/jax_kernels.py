@@ -415,17 +415,124 @@ def weighted_prin_comp(reports_filled, reputation, method: str = "auto",
     raise ValueError(f"unknown PCA method: {method!r}")
 
 
+#: reporter count above which multi-component extraction abandons the exact
+#: R×R Gram eigh for matrix-free orthogonal iteration. Measured: at R=10k
+#: XLA's QDWH eigh on the (R, R) Gram allocates dozens of ~300 MB
+#: temporaries and, with the explicitly-centered (R, E) dev matrix also
+#: resident, exhausts a v5e's 16 GB HBM (docs/ROADMAP.md, 2026-07-31).
+_GRAM_EIGH_MAX_R = 4096
+
+#: fixed sweep budget for the multi-component orthogonal iteration; the
+#: eigenvalue-stability early exit below usually stops far sooner
+_ORTH_ITERS = 96
+
+
+def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
+                       n_components: int, n_iters: int = _ORTH_ITERS,
+                       tol: float = 0.0):
+    """Top-``k`` principal subspace of the implicit weighted covariance by
+    blocked orthogonal iteration (subspace/simultaneous power iteration) —
+    the multi-component analogue of :func:`_first_pc_power`. Never
+    materializes the centered matrix, the R×R Gram, or E×E: each sweep is
+    two (R, E)-streaming matmuls against an (E, k) block plus an O(E·k²)
+    thin-QR re-orthonormalization, so it scales to the north-star shape
+    where the Gram eigh OOMs (see :data:`_GRAM_EIGH_MAX_R`).
+
+    Returns ``(loadings (E, k), eigvals (k,), trace)`` — eigenvalues are
+    Rayleigh quotients of the converged block (sorted descending) and
+    ``trace`` is the matrix-free total variance
+    ``(rep·X² - mu²)·1 / denom``, so explained-variance fractions cost no
+    extra (R, E) pass beyond the one ``rep @ X²`` contraction.
+
+    Convergence: stops once every column of successive orthonormal blocks
+    aligns to ``|<q_i, v_i>| >= 1 - tol`` (the Rayleigh quotients
+    stabilize quadratically, long before the vectors — an eigenvalue-only
+    exit returned ~4e-3-off loadings). Columns inside a near-degenerate
+    cluster may never align (the exact eigh is itself unstable there);
+    the fixed ``n_iters`` budget bounds that case. Start block: fixed-key
+    normal (deterministic; measure-zero orthogonality risk — the ones
+    vector is EXACTLY orthogonal to antisymmetric eigenvectors, see
+    :func:`_power_seed`)."""
+    acc = reputation.dtype
+    R, E = reports_filled.shape
+    k = int(n_components)
+    rep = reputation
+
+    def apply_cov_block(V):                      # (E, k) -> (E, k)
+        t = (jnp.matmul(reports_filled, V.astype(reports_filled.dtype),
+                        preferred_element_type=acc)
+             - jnp.ones((R, 1), acc) * (mu @ V)[None, :])      # (R, k)
+        rt = rep[:, None] * t
+        y = (jnp.matmul(reports_filled.T, rt.astype(reports_filled.dtype),
+                        preferred_element_type=acc)
+             - mu[:, None] * jnp.sum(rt, axis=0)[None, :])     # (E, k)
+        return y / denom
+
+    v0 = jax.random.normal(jax.random.key(0), (E, k), acc)
+    V0, _ = jnp.linalg.qr(v0)
+
+    tol = max(float(tol), 8.0 * float(jnp.finfo(acc).eps))
+
+    def cond(state):
+        i, _, done = state
+        return (i < n_iters) & ~done
+
+    def body(state):
+        i, V, _ = state
+        Y = apply_cov_block(V)
+        Q, _ = jnp.linalg.qr(Y)
+        # zero-norm guard (degenerate covariance): qr of a zero block can
+        # produce NaN columns — keep the previous orthonormal block
+        Q = jnp.where(jnp.isfinite(Q), Q, V)
+        align = jnp.abs(jnp.sum(Q * V, axis=0))  # per-column |<q_i, v_i>|
+        done = jnp.min(align) >= 1.0 - tol
+        return i + 1, Q, done
+
+    _, V, _ = lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), V0, jnp.asarray(False)))
+    # one more application for consistent (V, eig) at the final block
+    Y = apply_cov_block(V)
+    eig = jnp.clip(jnp.sum(V * Y, axis=0), 0.0, None)
+    order = jnp.argsort(-eig)
+    eig = eig[order]
+    V = V[:, order]
+    # matrix-free trace: sum_j rep.x²_j - mu_j²  (Σrep = 1 after
+    # normalize). Written as a fused elementwise+column-reduce so XLA
+    # never materializes an (R, E) squared temp the way a matmul operand
+    # would be.
+    col_sq = jnp.sum(reports_filled.astype(acc) ** 2 * rep[:, None], axis=0)
+    trace = jnp.sum(col_sq - mu * mu) / denom
+    return V, eig, jnp.clip(trace, 0.0, None)
+
+
 def weighted_prin_comps(reports_filled, reputation, n_components: int,
                         method: str = "auto"):
     """Top-k components + explained-variance fractions for the
-    ``fixed-variance`` variant (numpy_kernels.weighted_prin_comps). Uses the
-    E×E eigh for small E, else the Gram trick (the full nonzero spectrum lives
-    in the R×R Gram matrix). ``"power"`` is a first-component-only strategy,
-    so multi-component extraction treats it as ``"auto"`` — the Gram path is
-    the scalable exact option here (O(R²) memory, never E×E)."""
-    dev, denom = _center(reports_filled, reputation)
+    ``fixed-variance`` and ``ica`` variants
+    (numpy_kernels.weighted_prin_comps). Uses the E×E eigh for small E,
+    the R×R Gram trick while the eigh fits
+    (R <= :data:`_GRAM_EIGH_MAX_R` — the full nonzero spectrum lives in
+    the Gram matrix), and matrix-free orthogonal iteration beyond that
+    (:func:`_top_pcs_orth_iter` — the Gram eigh's QDWH temporaries OOM a
+    single chip at R=10k). An explicit ``"power"``-family request always
+    takes the orthogonal-iteration path."""
     R, E = reports_filled.shape
-    if method in ("auto", "power", "power-fused"):
+    if method in ("power", "power-fused") or (
+            method == "auto" and E > 1024 and R > _GRAM_EIGH_MAX_R):
+        mu, denom = _mu_denom(reports_filled, reputation)
+        loadings, eig, total = _top_pcs_orth_iter(
+            reports_filled, mu, denom, reputation, n_components)
+        explained = jnp.where(total > 0.0,
+                              eig / jnp.where(total > 0.0, total, 1.0),
+                              jnp.zeros_like(eig))
+        scores = (jnp.matmul(reports_filled,
+                             loadings.astype(reports_filled.dtype),
+                             preferred_element_type=reputation.dtype)
+                  - jnp.ones((R, 1), reputation.dtype)
+                  * (mu @ loadings)[None, :])
+        return loadings, scores, explained
+    dev, denom = _center(reports_filled, reputation)
+    if method == "auto":
         method = "eigh-cov" if E <= 1024 else "eigh-gram"
     if method not in ("eigh-cov", "eigh-gram"):
         raise ValueError(f"unknown PCA method: {method!r}")

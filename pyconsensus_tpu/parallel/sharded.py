@@ -49,12 +49,21 @@ _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
 
 
 def _pick_pca_method(params: ConsensusParams, n_reporters: int,
-                     n_devices: int = 1) -> str:
+                     n_events: int, n_devices: int = 1) -> str:
     if params.pca_method not in _KNOWN_PCA:
         raise ValueError(f"unknown PCA method: {params.pca_method!r}; "
                          f"choose from {_KNOWN_PCA}")
     if params.algorithm in _MULTI_COMPONENT_ALGOS:
-        return "eigh-gram"
+        # mirror weighted_prin_comps' own auto routing: tiny-E exact
+        # eigh-cov, exact Gram eigh while its QDWH temporaries fit,
+        # matrix-free orthogonal iteration beyond (the R=10k Gram eigh
+        # OOMed a v5e — docs/ROADMAP.md 2026-07-31; "power" routes
+        # multi-component extraction to jax_kernels._top_pcs_orth_iter)
+        from ..ops.jax_kernels import _GRAM_EIGH_MAX_R
+
+        if n_events <= 1024:
+            return "eigh-cov"
+        return ("eigh-gram" if n_reporters <= _GRAM_EIGH_MAX_R else "power")
     if params.pca_method in _SHARDABLE_PCA:
         # the Pallas kernels are black boxes to the GSPMD partitioner — an
         # explicit "power-fused" request downgrades to the XLA matvecs on a
@@ -102,7 +111,7 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
     :func:`sharded_consensus` and :class:`ShardedOracle` so the two
     front-ends cannot drift."""
     p = p._replace(
-        pca_method=_pick_pca_method(p, R, mesh.devices.size),
+        pca_method=_pick_pca_method(p, R, E, mesh.devices.size),
         median_block=effective_median_block(p.median_block, mesh))
     p = p._replace(fused_resolution=_use_fused_resolution(
         p, R, E, mesh.devices.size))

@@ -192,6 +192,43 @@ class TestWeightedPrinComp:
             np.testing.assert_allclose(_align_sign(lj[:, c], loadings[:, c]),
                                        loadings[:, c], atol=1e-6)
 
+    def test_orth_iter_matches_eigh(self, rng):
+        """The matrix-free multi-component path (method='power' →
+        _top_pcs_orth_iter — the large-R route where the Gram eigh OOMs a
+        chip) must reproduce the exact eigh's top-k loadings, explained
+        fractions, and scores on a well-separated spectrum."""
+        X = rng.random((40, 24))
+        # plant separated structure so the top-3 spectrum is decisive
+        X[:20] += np.outer(np.ones(20), rng.random(24)) * 2.0
+        X[20:30] -= np.outer(np.ones(10), rng.random(24)) * 1.5
+        rep = nk.normalize(rng.random(40) + 0.1)
+        l_ref, s_ref, e_ref = jk.weighted_prin_comps(jnp.asarray(X),
+                                                     jnp.asarray(rep), 3,
+                                                     method="eigh-gram")
+        l_pw, s_pw, e_pw = jk.weighted_prin_comps(jnp.asarray(X),
+                                                  jnp.asarray(rep), 3,
+                                                  method="power")
+        np.testing.assert_allclose(np.asarray(e_pw), np.asarray(e_ref),
+                                   atol=1e-6)
+        for c in range(3):
+            np.testing.assert_allclose(
+                _align_sign(np.asarray(l_pw)[:, c], np.asarray(l_ref)[:, c]),
+                np.asarray(l_ref)[:, c], atol=1e-5)
+            np.testing.assert_allclose(
+                _align_sign(np.asarray(s_pw)[:, c], np.asarray(s_ref)[:, c]),
+                np.asarray(s_ref)[:, c], atol=1e-5)
+
+    def test_orth_iter_degenerate_zero_cov(self, rng):
+        """Identical rows (zero covariance): finite outputs, zero
+        explained fractions — the qr-of-zeros guard."""
+        X = np.tile(rng.random(12), (16, 1))
+        rep = np.full(16, 1 / 16)
+        l_pw, s_pw, e_pw = jk.weighted_prin_comps(jnp.asarray(X),
+                                                  jnp.asarray(rep), 2,
+                                                  method="power")
+        assert np.isfinite(np.asarray(l_pw)).all()
+        np.testing.assert_allclose(np.asarray(e_pw), 0.0, atol=1e-12)
+
     def test_power_warm_start(self, rng):
         """Warm-starting the power loop near the dominant eigenvector must
         (a) converge to the same loading and (b) use far fewer sweeps than
