@@ -717,7 +717,11 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     else:
         denom = 1.0 - jnp.sum(reputation ** 2)
         denom = jnp.where(denom == 0.0, 1.0, denom)
-    xmm = (reports_filled.astype(jnp.dtype(matvec_dtype)) if matvec_dtype
+    # int8 sentinel storage is already the narrowest encoding — casting it
+    # to a float matvec dtype would destroy the sentinel/lattice
+    xmm = (reports_filled.astype(jnp.dtype(matvec_dtype))
+           if matvec_dtype
+           and not jnp.issubdtype(reports_filled.dtype, jnp.integer)
            else reports_filled)
     loading = power_iteration_fused(xmm, mu, denom, reputation,
                                     power_iters, power_tol, fill=fill,

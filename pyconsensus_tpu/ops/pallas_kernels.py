@@ -49,6 +49,10 @@ _PANEL_BYTES = 4 * 1024 * 1024
 def _panel_rows(n_events: int, itemsize: int,
                 panel_bytes: int = _PANEL_BYTES) -> int:
     """Rows per panel: ~panel_bytes big, multiple of 8 sublanes, >= 8.
+    (8 is below the native sublane tile of sub-32-bit dtypes — (16, 128)
+    for bf16, (32, 128) for int8 — but Mosaic masks sub-tile blocks, and
+    8-row bf16 panels are measured-good on v5e; 16-row panels at E=100k
+    blow the scoped-VMEM limit via the in-register f32 upcast.)
 
     Sized against the VMEM footprint, not the logical bytes: VMEM tiles
     pad the lane (event) axis up to 128, so a narrow matrix costs
@@ -96,17 +100,36 @@ def resolve_kernel_fits(n_reporters: int, itemsize: int) -> bool:
     return _resolve_block_cols(n_reporters, itemsize) is not None
 
 
+def _decode_block(x_ref):
+    """Upcast one storage block to f32 and return ``(values, absent)``.
+
+    Two storage encodings share every kernel (the decode branch is
+    resolved at trace time from the ref dtype):
+
+    - float (f32/bf16): values are the values; absence is NaN;
+    - int8 sentinel: ``stored = round(2 * value)`` in {0, 1, 2} with
+      ``-1`` marking absence — exact for binary/categorical reports
+      ({0, 0.5, 1}), half the HBM bytes of bf16. ``x * 0.5`` decodes
+      exactly in f32; zero-padded rows decode to value 0.0, non-absent,
+      preserving the zero-rep padding contract.
+    """
+    xp = x_ref[:].astype(jnp.float32)
+    if jnp.issubdtype(x_ref.dtype, jnp.integer):
+        return xp * 0.5, xp < 0.0
+    return xp, jnp.isnan(xp)
+
+
 def _cov_panel_contribution(x_ref, mu_ref, rep_ref, v, *, nan_fill):
     """One row panel's ``D_i^T (rep_i * (D_i v))`` contribution, centered
-    in-register. ``nan_fill=True`` reads NaN-threaded storage: absent
-    entries are NaN in ``x`` and ``mu_ref`` row 1 carries ``fill - mu``
-    (the centered per-column fill value), so the filled matrix is
-    reconstructed in-register and never exists in HBM."""
-    xp = x_ref[:].astype(jnp.float32)
+    in-register. ``nan_fill=True`` reads sentinel-threaded storage: absent
+    entries are NaN (float) / -1 (int8) in ``x`` and ``mu_ref`` row 1
+    carries ``fill - mu`` (the centered per-column fill value), so the
+    filled matrix is reconstructed in-register and never exists in HBM."""
+    val, absent = _decode_block(x_ref)
     if nan_fill:
-        xc = jnp.where(jnp.isnan(xp), mu_ref[1:2, :], xp - mu_ref[0:1, :])
+        xc = jnp.where(absent, mu_ref[1:2, :], val - mu_ref[0:1, :])
     else:
-        xc = xp - mu_ref[0:1, :]                           # (T, E) centered
+        xc = val - mu_ref[0:1, :]                          # (T, E) centered
     t = jnp.sum(xc * v, axis=1, keepdims=True)             # (T, 1) = D_i v
     return jnp.sum(xc * (rep_ref[:] * t), axis=0, keepdims=True)
 
@@ -214,9 +237,8 @@ def _scores_dirfix_kernel(x_ref, rep_ref, lf_ref, t_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     f32 = jnp.float32
-    xp = x_ref[:].astype(f32)                              # (T, E)
-    if nan_fill:
-        xp = jnp.where(jnp.isnan(xp), lf_ref[1:2, :], xp)
+    val, absent = _decode_block(x_ref)                     # (T, E)
+    xp = jnp.where(absent, lf_ref[1:2, :], val) if nan_fill else val
     t = jax.lax.dot_general(xp, lf_ref[0:1, :],
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=f32)    # (T, 1)
@@ -368,9 +390,8 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
     def stats_body(i, acc):
         numer, tw = acc
         sl = pl.ds(i * chunk, chunk)
-        xs = x_ref[sl, :].astype(f32)
+        xs, na = _decode_block(x_ref.at[sl, :])
         rs = rep_ref[sl, :]                            # (chunk, 1)
-        na = jnp.isnan(xs)
         naf = (na & col_ok).astype(f32)
         pres = 1.0 - na.astype(f32)
         xz = jnp.where(na, 0.0, xs)
@@ -397,9 +418,9 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
 
     def cert_body(i, cert):
         sl = pl.ds(i * chunk, chunk)
-        xs = x_ref[sl, :].astype(f32)
+        xs, na = _decode_block(x_ref.at[sl, :])
         rs = rep_ref[sl, :]
-        xf = jnp.where(jnp.isnan(xs), fill, xs)
+        xf = jnp.where(na, fill, xs)
         return cert + col_dot(rs, (xf == out).astype(f32))
 
     cert = jax.lax.fori_loop(0, n_chunks, cert_body, zero)
@@ -408,8 +429,9 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
 
     def row_body(i, _):
         sl = pl.ds(i * chunk, chunk)
-        # upcast before isnan — Mosaic rejects the bf16 NaN comparison
-        naf = (jnp.isnan(x_ref[sl, :].astype(f32)) & col_ok).astype(f32)
+        # decode upcasts before the absence test — Mosaic rejects the
+        # bf16 NaN comparison
+        naf = (_decode_block(x_ref.at[sl, :])[1] & col_ok).astype(f32)
         # deliberately NOT compensated: certainty's bf16 rounding (~2^-8
         # relative) enters prow scaled by the NA fraction, so the
         # participation_rows error is ~1e-4 absolute at 2% NA — not worth

@@ -150,10 +150,16 @@ class Oracle:
         Power-iteration cap, early-exit tolerance (0 = machine-precision
         floor), and optional low-precision matvec storage ("bfloat16").
     storage_dtype : str
-        Optional compact storage dtype ("bfloat16") for the filled matrix
-        through the whole jax pipeline — halves HBM traffic of every
-        O(R·E) phase; reductions still accumulate in f32. Binary outcomes
-        stay catch-snap exact; scaled medians round to bf16 resolution.
+        Optional compact storage dtype for the filled matrix through the
+        whole jax pipeline; reductions always accumulate in f32.
+        ``"bfloat16"`` halves HBM traffic of every O(R·E) phase (binary
+        outcomes stay catch-snap exact; scaled medians round to bf16
+        resolution). ``"int8"`` stores ``round(2·value)`` with sentinel
+        -1 for NaN — exact for binary/categorical reports in {0, 0.5, 1}
+        and a further ~13% faster than bf16 at the north-star shape, but
+        only legal on the fused single-device TPU path with no scaled
+        events (clear ``ValueError`` elsewhere); off-lattice values
+        quantize to the nearest half unit.
     verbose : bool
         Print a result summary after ``consensus()`` (reference fidelity).
     """

@@ -115,6 +115,17 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
         median_block=effective_median_block(p.median_block, mesh))
     p = p._replace(fused_resolution=_use_fused_resolution(
         p, R, E, mesh.devices.size))
+    if p.storage_dtype == "int8" and not p.fused_resolution:
+        # int8 must never fall through to the XLA path (it stores the
+        # continuous interpolated fills); fail loudly with the reason the
+        # fused gate closed
+        raise ValueError(
+            "storage_dtype='int8' requires the fused NaN-threaded path "
+            "(single real TPU device, algorithm='sztorc', power-family "
+            "pca_method, binary events, VMEM-fitting shape) — this "
+            f"configuration resolved to the XLA path (mesh devices="
+            f"{mesh.devices.size}, algorithm={p.algorithm!r}, "
+            f"pca_method={p.pca_method!r}); use storage_dtype='bfloat16'")
     if not p.fused_resolution:
         p = p._replace(n_scaled=_xla_path_n_scaled(p, E, mesh))
     return p

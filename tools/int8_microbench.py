@@ -1,0 +1,128 @@
+"""Micro-A/B for int8 vs bf16 NaN-threaded storage in the covariance sweep.
+
+The power-iteration sweep is the pipeline's dominant phase and is purely
+HBM-bandwidth-bound (docs/PERFORMANCE.md "Where the time goes"), so storage
+bytes/entry set its speed. Binary/categorical reports take values in
+{0, 0.5, 1} (+NaN for absence) — exactly representable in an int8 encoding
+``stored = round(2 * value)`` with sentinel ``-1`` for NaN — so an int8
+storage mode halves the sweep's traffic vs bf16 with ZERO quantization
+error on the workload the headline benchmark runs.
+
+This tool times ``apply_weighted_cov`` (the per-sweep kernel) on the same
+matrix in bf16-NaN-threaded vs int8-sentinel storage and checks the
+results agree to f32 accumulation noise. Run it on a quiet chip BEFORE
+wiring int8 into the pipeline — if the kernel doesn't beat bf16 here,
+nothing downstream is worth the complexity.
+
+Usage: python tools/int8_microbench.py [--reporters 10000] [--events 100000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reporters", type=int, default=10_000)
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--na-frac", type=float, default=0.02)
+    ap.add_argument("--iters", type=int, default=30,
+                    help="sweeps per timed run (differential timing: "
+                    "(t(iters) - t(1)) / (iters - 1) cancels dispatch/fetch)")
+    args = ap.parse_args()
+    if args.iters < 2:
+        ap.error("--iters must be >= 2 (differential timing needs two "
+                 "run lengths)")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pyconsensus_tpu.ops.pallas_kernels import apply_weighted_cov
+
+    R, E = args.reporters, args.events
+    interp = jax.default_backend() != "tpu"
+
+    @jax.jit
+    def gen(key):
+        # both encodings built in ONE jit so the f32 intermediates are
+        # freed at return — holding reports/vals/bf16/int8 live at once
+        # OOMed a 16 GB chip at the default shape
+        k1, k2 = jax.random.split(key)
+        codes = jax.random.randint(k1, (R, E), 0, 3).astype(jnp.int8)
+        na = jax.random.bernoulli(k2, args.na_frac, (R, E))
+        x_int8 = jnp.where(na, jnp.int8(-1), codes)
+        x_bf16 = jnp.where(na, jnp.nan,
+                           codes.astype(jnp.bfloat16) * 0.5)
+        return x_bf16, x_int8
+
+    x_bf16, x_int8 = gen(jax.random.key(0))
+    rep = jnp.full((R,), 1.0 / R, dtype=jnp.float32)
+
+    # fill vector + mu as the pipeline computes them (values don't matter
+    # for timing; correctness cross-check uses the same ones for both paths)
+    fill = jnp.full((E,), 0.5, dtype=jnp.float32)
+    filled_mu = jnp.nanmean(x_bf16.astype(jnp.float32), axis=0)
+    v = jnp.ones((E,), dtype=jnp.float32)
+
+    @jax.jit
+    def sweep_n(x, n):
+        def body(i, vv):
+            y = apply_weighted_cov(x, filled_mu, rep, vv, fill=fill,
+                                   interpret=interp)
+            return y / jnp.linalg.norm(y)
+        return jax.lax.fori_loop(0, n, body, v)
+
+    def timed(x, n):
+        out = sweep_n(x, n)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = sweep_n(x, n)
+        # honest completion barrier through the tunnel: fetch a scalar
+        float(np.asarray(out[0]))
+        return time.perf_counter() - t0
+
+    results = {}
+    for name, x in (("bf16", x_bf16), ("int8", x_int8)):
+        try:
+            t1 = timed(x, 1)
+            tn = timed(x, args.iters)
+            per_sweep_ms = (tn - t1) / (args.iters - 1) * 1e3
+            y = np.asarray(sweep_n(x, 4))
+            results[name] = {"per_sweep_ms": round(per_sweep_ms, 3),
+                             "loading_head": [float(f) for f in y[:3]]}
+        except Exception as e:  # compile failure is a result, not a crash
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    if "error" not in results.get("bf16", {}) and \
+       "error" not in results.get("int8", {}):
+        a = np.asarray(sweep_n(x_bf16, 4))
+        b = np.asarray(sweep_n(x_int8, 4))
+        diff = float(np.max(np.abs(a - b)))
+        results["max_loading_diff"] = diff
+        if diff <= 1e-5:
+            results["speedup"] = round(
+                results["bf16"]["per_sweep_ms"]
+                / max(results["int8"]["per_sweep_ms"], 1e-9), 3)
+        else:
+            # never bank a speedup for a kernel that computes the wrong
+            # thing — a large diff means the int8 decode is broken
+            results["error"] = (f"int8 loading disagrees with bf16 by "
+                                f"{diff:.3e} (> 1e-5) — decode broken; "
+                                f"speedup withheld")
+    print(json.dumps(results))
+    if "error" in results or any(
+            isinstance(v, dict) and "error" in v for v in results.values()):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
