@@ -47,6 +47,7 @@ MEASUREMENTS = [
     # (b) blocked median at increasing scaled fractions; the >E/8 shape
     # (XLA path, biggest sort temporaries) is the OOM-riskiest → last
     ("scaled_1k", ["--scaled", "1000"], 1200),
+    ("scaled_4k", ["--scaled", "4000"], 1500),
     ("scaled_16k", ["--scaled", "16000"], 1800),
 ]
 
