@@ -119,32 +119,89 @@ def _decode_block(x_ref):
     return xp, jnp.isnan(xp)
 
 
-def _cov_panel_contribution(x_ref, mu_ref, rep_ref, v, *, nan_fill):
-    """One row panel's ``D_i^T (rep_i * (D_i v))`` contribution, centered
-    in-register. ``nan_fill=True`` reads sentinel-threaded storage: absent
-    entries are NaN (float) / -1 (int8) in ``x`` and ``mu_ref`` row 1
-    carries ``fill - mu`` (the centered per-column fill value), so the
-    filled matrix is reconstructed in-register and never exists in HBM."""
-    val, absent = _decode_block(x_ref)
-    if nan_fill:
-        xc = jnp.where(absent, mu_ref[1:2, :], val - mu_ref[0:1, :])
+def _decode_filled_bf16(x_ref, fill_row, *, nan_fill):
+    """One storage block -> the FILLED panel in bf16 (exact: storage
+    values and catch-snapped fills live on lattices bf16 represents
+    exactly; continuous scaled-column fills round to bf16, which only
+    perturbs the approximation-tolerant loading — scaled outcomes come
+    from the exact gather median downstream)."""
+    bf16 = jnp.bfloat16
+    if jnp.issubdtype(x_ref.dtype, jnp.integer):
+        xp = x_ref[:].astype(bf16)
+        val, absent = xp * 0.5, xp < 0.0
     else:
-        xc = val - mu_ref[0:1, :]                          # (T, E) centered
-    t = jnp.sum(xc * v, axis=1, keepdims=True)             # (T, 1) = D_i v
-    return jnp.sum(xc * (rep_ref[:] * t), axis=0, keepdims=True)
+        xp = x_ref[:].astype(jnp.float32)
+        val, absent = xp.astype(bf16), jnp.isnan(xp)
+    if nan_fill:
+        return jnp.where(absent, fill_row, val)
+    return val
 
 
-def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *, nan_fill):
-    """One row panel: both contractions off a single HBM read of the
-    panel (see :func:`_cov_panel_contribution`)."""
+def _apply_cov_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
+                      nan_fill):
+    """One row panel of the implicit-covariance application, centered
+    MATRIX-FREE:
+
+        t   = X v − (mu·v)              (X = filled panel, reconstructed)
+        rt  = rep ⊙ t
+        y  += X^T rt;   s += Σ rt       (caller finishes y − mu·s)
+
+    Compact storage (bf16/int8) rides the MXU: the first VPU version
+    (in-register centering + elementwise multiply-reduce chains) measured
+    ~2.5x its own HBM read — the same pathology the direction-fix kernel
+    hit. Exactness at DEFAULT dot precision: the filled panel is
+    bf16-exact (storage lattice values / snapped fills), and the
+    continuous vectors are compensated — ``aux_ref`` rows 0..1 carry the
+    bf16 head and residual of ``v`` (row 2 the fill values under
+    ``nan_fill``), and ``rt`` splits the same way in-kernel — so every
+    product is exact and only ~2^-17 second-order residuals are lost,
+    far below the power loop's own exit tolerance.
+
+    f32 storage (the machine-precision parity mode, where values may be
+    arbitrary continuous reals) keeps the exact f32 VPU chain instead —
+    rounding the panel to bf16 for the MXU would silently demote the one
+    mode whose purpose is full precision."""
     i = pl.program_id(0)
+    f32 = jnp.float32
 
     @pl.when(i == 0)
     def _():
         y_ref[:] = jnp.zeros_like(y_ref)
+        s_ref[:] = jnp.zeros_like(s_ref)
 
-    y_ref[:] += _cov_panel_contribution(x_ref, mu_ref, rep_ref, v_ref[:],
-                                        nan_fill=nan_fill)
+    if not (x_ref.dtype == jnp.bfloat16
+            or jnp.issubdtype(x_ref.dtype, jnp.integer)):
+        # exact VPU path on the full-precision values (aux rows are f32
+        # here: [v, 0, fill] — see the caller)
+        val, absent = _decode_block(x_ref)
+        v_full = aux_ref[0:1, :] + aux_ref[1:2, :]
+        if nan_fill:
+            filled = jnp.where(absent, aux_ref[2:3, :], val)
+        else:
+            filled = val
+        t = (jnp.sum(filled * v_full, axis=1, keepdims=True)
+             - muv_ref[0, 0])                                  # (T, 1)
+        rt = rep_ref[:] * t
+        y_ref[:] += jnp.sum(filled * rt, axis=0, keepdims=True)
+        s_ref[:] += jnp.sum(rt)
+        return
+
+    fill_row = aux_ref[2:3, :] if nan_fill else None
+    filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
+    # t2 = [X v_h, X v_l]  (lane contraction, one MXU pass, N=2)
+    t2 = jax.lax.dot_general(filled, aux_ref[0:2, :],
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=f32)       # (T, 2)
+    t = t2[:, 0:1] + t2[:, 1:2] - muv_ref[0, 0]
+    rt = rep_ref[:] * t                                        # (T, 1) f32
+    rt_h = rt.astype(jnp.bfloat16)
+    rt_l = (rt - rt_h.astype(f32)).astype(jnp.bfloat16)
+    dn0 = (((0,), (0,)), ((), ()))
+    y_ref[:] += (jax.lax.dot_general(rt_h, filled, dn0,
+                                     preferred_element_type=f32)
+                 + jax.lax.dot_general(rt_l, filled, dn0,
+                                       preferred_element_type=f32))
+    s_ref[:] += jnp.sum(rt)
 
 
 def _pad_rows(x, rep, tile_r: int):
@@ -158,65 +215,84 @@ def _pad_rows(x, rep, tile_r: int):
     return x, rep
 
 
-def _prep_cov_inputs(x, mu, rep, fill):
-    """Shared input prep for the covariance-application kernels: panel
-    sizing (halved budget under NaN threading), row padding, and the
-    stacked ``[mu; fill - mu]`` operand. Returns
-    ``(x, rep, tile_r, mu2)``."""
+def _prep_cov_inputs(x, rep, fill):
+    """Input prep for the covariance-application kernel: panel sizing
+    (halved budget under NaN threading), row padding. Returns
+    ``(x, rep, tile_r)``."""
     E = x.shape[1]
     nan_fill = fill is not None
     tile_r = _panel_rows(E, x.dtype.itemsize,
                          _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
     x, rep = _pad_rows(x, rep.astype(jnp.float32), tile_r)
-    mu = mu.astype(jnp.float32).reshape(1, E)
-    if nan_fill:
-        # row 0: mu; row 1: fill - mu (the centered value of an absent entry)
-        mu2 = jnp.concatenate([mu, fill.astype(jnp.float32).reshape(1, E)
-                               - mu])
-    else:
-        mu2 = mu
-    return x, rep, tile_r, mu2
+    return x, rep, tile_r
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
-    """``(X - mu)^T (rep * ((X - mu) v))`` in ONE HBM sweep of ``X``.
+    """``(X - mu)^T (rep * ((X - mu) v))`` in ONE HBM sweep of ``X``,
+    centered matrix-free (see :func:`_apply_cov_kernel`):
+
+        y = X^T (rep ⊙ (X v - (mu·v))) - mu Σ(rep ⊙ (X v - (mu·v)))
 
     x : (R, E) filled reports, f32 or bf16 (row count padded internally) —
-        or, with ``fill`` given, NaN-threaded storage (absent entries NaN)
-        whose filled values are reconstructed in-register from the (E,)
-        per-column fill vector, so the filled matrix never exists in HBM.
+        or, with ``fill`` given, sentinel-threaded storage (absent entries
+        NaN / int8 -1) whose filled values are reconstructed in-register
+        from the (E,) per-column fill vector, so the filled matrix never
+        exists in HBM.
     mu : (E,) f32 weighted column means.  rep : (R,) f32.  v : (E,) f32.
     Returns (E,) f32. Caller divides by the unbiased-weight denominator.
     ``interpret=True`` runs the Pallas interpreter (CPU tests).
     """
     R, E = x.shape
     nan_fill = fill is not None
-    x, rep, tile_r, mu2 = _prep_cov_inputs(x, mu, rep, fill)
+    x, rep, tile_r = _prep_cov_inputs(x, rep, fill)
     Rp = x.shape[0]
     f32 = jnp.float32
+    bf16 = jnp.bfloat16
+    mu = mu.astype(f32)
+    v = v.astype(f32)
+    compact = (x.dtype == bf16 or jnp.issubdtype(x.dtype, jnp.integer))
+    if compact:
+        # MXU branch operands: compensated bf16 halves of v (+ fill row)
+        vh = v.astype(bf16)
+        rows = [vh.reshape(1, E),
+                (v - vh.astype(f32)).astype(bf16).reshape(1, E)]
+        if nan_fill:
+            rows.append(fill.astype(bf16).reshape(1, E))
+    else:
+        # exact-f32 VPU branch operands: [v, 0, fill]
+        rows = [v.reshape(1, E), jnp.zeros((1, E), f32)]
+        if nan_fill:
+            rows.append(fill.astype(f32).reshape(1, E))
+    aux = jnp.concatenate(rows)
+    muv = (mu @ v).reshape(1, 1)
     grid = (Rp // tile_r,)
-    y = pl.pallas_call(
+    y, s = pl.pallas_call(
         functools.partial(_apply_cov_kernel, nan_fill=nan_fill),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_r, E), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((mu2.shape[0], E), lambda i: (0, 0),
+            pl.BlockSpec((aux.shape[0], E), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, E), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, E), f32),
+        out_specs=[
+            pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, E), f32),
+            jax.ShapeDtypeStruct((1, 1), f32),
+        ],
         cost_estimate=pl.CostEstimate(
-            flops=4 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            flops=6 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
             transcendentals=0),
         interpret=interpret,
-    )(x, mu2, rep.astype(f32).reshape(-1, 1), v.astype(f32).reshape(1, E))
-    return y.reshape(E)
+    )(x, aux, muv, rep.reshape(-1, 1))
+    return y.reshape(E) - mu * s.reshape(())
 
 
 def _scores_dirfix_kernel(x_ref, rep_ref, lf_ref, t_ref, acc_ref, *,

@@ -4,9 +4,9 @@ Binary/categorical reports take values in {0, 0.5, 1} (+NaN for absence)
 — exactly representable in the int8 encoding ``stored = round(2·value)``
 with sentinel ``-1`` for NaN — so int8 storage halves the HBM traffic of
 every O(R·E) phase vs bf16 with ZERO quantization error on binary
-workloads. The contract mirrors the bf16 storage mode's: outcomes must be
-bit-identical to the full-precision path (here exactly, not merely
-post-catch-snap). Scaled events are rejected (their [0,1]-rescaled values
+workloads. The contract mirrors the bf16 storage mode's: catch-snapped
+outcomes bit-identical to the full-precision path, continuous outputs to
+tight float tolerance. Scaled events are rejected (their [0,1]-rescaled values
 are continuous; a half-unit quantization would change results), as is the
 XLA (non-fused) path (it stores the interpolated fill values, which are
 continuous weighted means).
@@ -74,7 +74,10 @@ class TestKernelDecode:
                                             interpret=True))
         y_i = np.asarray(apply_weighted_cov(x_i, mu, rep, v, fill=fill,
                                             interpret=True))
-        np.testing.assert_allclose(y_i, y_f, rtol=1e-6, atol=1e-7)
+        # int8 takes the MXU branch whose compensated v-split carries a
+        # ~2^-17 second-order residual vs the f32 VPU branch; a broken
+        # decode shows up as O(1) mismatch, not 1e-5
+        np.testing.assert_allclose(y_i, y_f, rtol=3e-5, atol=1e-6)
 
     def test_apply_weighted_cov_dense_int8(self, rng):
         """No-fill (dense) mode must decode int8 too."""
@@ -88,7 +91,7 @@ class TestKernelDecode:
                                             interpret=True))
         y_i = np.asarray(apply_weighted_cov(dense_i, mu_d, rep, v,
                                             interpret=True))
-        np.testing.assert_allclose(y_i, y_f, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(y_i, y_f, rtol=3e-5, atol=1e-6)
 
     def test_scores_dirfix_pass(self, rng):
         x_f, x_i, rep, fill, mu = self._inputs(rng)
@@ -136,7 +139,12 @@ class TestFusedPipelineInt8:
         assert set(out) == set(ref)
         for key in ref:
             a, b = np.asarray(ref[key]), np.asarray(out[key])
-            if key in ("outcomes_raw", "outcomes_adjusted", "outcomes_final",
+            # catch-snapped outputs: bit-exact. outcomes_raw (the
+            # unsnapped means) is continuous: the int8 and f32 paths take
+            # different exact-level accumulation routes through the
+            # covariance kernel (MXU compensated vs VPU), so it is held
+            # to float tolerance like the other continuous outputs.
+            if key in ("outcomes_adjusted", "outcomes_final",
                        "na_row", "iterations", "convergence"):
                 np.testing.assert_array_equal(a, b, err_msg=key)
             elif key == "first_loading":
