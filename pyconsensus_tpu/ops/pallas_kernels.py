@@ -124,14 +124,16 @@ def _decode_filled_bf16(x_ref, fill_row, *, nan_fill):
     values and catch-snapped fills live on lattices bf16 represents
     exactly; continuous scaled-column fills round to bf16, which only
     perturbs the approximation-tolerant loading — scaled outcomes come
-    from the exact gather median downstream)."""
-    bf16 = jnp.bfloat16
-    if jnp.issubdtype(x_ref.dtype, jnp.integer):
-        xp = x_ref[:].astype(bf16)
-        val, absent = xp * 0.5, xp < 0.0
-    else:
-        xp = x_ref[:].astype(jnp.float32)
-        val, absent = xp.astype(bf16), jnp.isnan(xp)
+    from the exact gather median downstream).
+
+    Decodes through :func:`_decode_block` so every comparison (the int8
+    sentinel test, isnan) runs on f32 operands: Mosaic rejects bf16
+    ``arith.cmpf`` outright ("Target does not support this comparison" —
+    BENCH_r02's compile failure was this kernel's old ``bf16 < 0``), and
+    the f32 compare costs nothing against the HBM-bound panel read. The
+    f32->bf16 value cast after decode is exact on the storage lattice."""
+    val32, absent = _decode_block(x_ref)
+    val = val32.astype(jnp.bfloat16)
     if nan_fill:
         return jnp.where(absent, fill_row, val)
     return val
@@ -188,18 +190,28 @@ def _apply_cov_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
 
     fill_row = aux_ref[2:3, :] if nan_fill else None
     filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
+    # These bf16 MXU dots pin precision=DEFAULT *explicitly*: the
+    # compensated operand splits already make every product exact at
+    # DEFAULT, and an ambient jax.default_matmul_precision("highest")
+    # (the XLA path's exact_matmuls wrapper, in scope when power-fused
+    # PCA runs under _consensus_core) otherwise leaks into this trace and
+    # asks Mosaic for an fp32-precision contract on a bf16 lhs — which it
+    # rejects at compile time ("Bad lhs type", the 16k-scaled BENCH rung-0
+    # failure of 2026-07-31).
+    default = jax.lax.Precision.DEFAULT
     # t2 = [X v_h, X v_l]  (lane contraction, one MXU pass, N=2)
     t2 = jax.lax.dot_general(filled, aux_ref[0:2, :],
                              (((1,), (1,)), ((), ())),
+                             precision=default,
                              preferred_element_type=f32)       # (T, 2)
     t = t2[:, 0:1] + t2[:, 1:2] - muv_ref[0, 0]
     rt = rep_ref[:] * t                                        # (T, 1) f32
     rt_h = rt.astype(jnp.bfloat16)
     rt_l = (rt - rt_h.astype(f32)).astype(jnp.bfloat16)
     dn0 = (((0,), (0,)), ((), ()))
-    y_ref[:] += (jax.lax.dot_general(rt_h, filled, dn0,
+    y_ref[:] += (jax.lax.dot_general(rt_h, filled, dn0, precision=default,
                                      preferred_element_type=f32)
-                 + jax.lax.dot_general(rt_l, filled, dn0,
+                 + jax.lax.dot_general(rt_l, filled, dn0, precision=default,
                                        preferred_element_type=f32))
     s_ref[:] += jnp.sum(rt)
 
@@ -265,7 +277,13 @@ def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
         if nan_fill:
             rows.append(fill.astype(f32).reshape(1, E))
     aux = jnp.concatenate(rows)
-    muv = (mu @ v).reshape(1, 1)
+    # HIGHEST precision: this O(E) dot runs outside the kernel at XLA's
+    # default matmul precision (bf16 operand rounding on TPU), which would
+    # inject ~1e-3-relative noise into the centering term that the
+    # compensated in-kernel scheme then can't recover — the one dot is
+    # noise-free for free at this size
+    muv = jnp.dot(mu, v,
+                  precision=jax.lax.Precision.HIGHEST).reshape(1, 1)
     grid = (Rp // tile_r,)
     y, s = pl.pallas_call(
         functools.partial(_apply_cov_kernel, nan_fill=nan_fill),
@@ -480,7 +498,12 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
     numer, tw = jax.lax.fori_loop(
         0, n_chunks, stats_body, (zero, zero))
     rep_total = jnp.sum(rep_ref[:])
-    pcol = rep_total - tw
+    # clamp: rep_total is a VPU sum while tw accumulates per-chunk
+    # compensated MXU dots (different accumulation orders), so fully
+    # present columns can land an ulp either side of pcol==0 — without the
+    # clamp participation_columns = 1 - pcol can exceed 1 and percent_na
+    # go marginally negative on NA-free data
+    pcol = jnp.clip(rep_total - tw, 0.0, rep_total)
     fmn = numer + fill * pcol
     pcol_ref[:] = pcol
     ft = fv_ref[1:2, :]
@@ -537,7 +560,10 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
     sweep (binary events; jax_kernels.resolve_outcomes +
     certainty_and_bonuses semantics on NaN-threaded storage).
 
-    x : (R, E) reports with NaN marking absence (f32 or bf16). When R has
+    x : (R, E) reports in any supported storage encoding — f32/bf16 with
+        NaN marking absence, or int8 sentinel storage
+        (``stored = round(2 * value)`` in {0, 1, 2}, ``-1`` = absent;
+        see :func:`_decode_block`). When R has
         no 8-multiple divisor <= 1024 (_pick_chunk — e.g. a prime reporter
         count) the matrix is zero-padded to the next multiple of 8: padded
         rows are non-NaN with zero reputation, so they contribute exactly

@@ -1,0 +1,163 @@
+"""Mosaic lowering compatibility guards.
+
+BENCH_r02.json's TPU run died at compile time: ``arith.cmpf`` on
+``vector<8x128x2xbf16>`` — "Target does not support this comparison".
+Mosaic (the Pallas TPU compiler) rejects bf16 float comparisons outright;
+the offender was ``_decode_filled_bf16``'s int8 sentinel test running in
+bf16. CPU tests can't catch that (the interpreter happily compares bf16),
+so this test enforces the invariant at the jaxpr level: **no comparison
+primitive inside any Pallas kernel may take bf16 operands** — decode must
+upcast to f32 before any compare.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.ops.pallas_kernels import (apply_weighted_cov,
+                                                resolve_certainty_fused,
+                                                scores_dirfix_pass)
+
+#: comparison primitives (isnan lowers to ne; sign tests to lt/gt)
+_CMP_PRIMS = {"lt", "le", "gt", "ge", "eq", "ne"}
+
+
+def _iter_eqns(jaxpr):
+    """Yield every eqn in ``jaxpr`` and, recursively, in any sub-jaxpr
+    carried in eqn params (pallas_call kernels, scan/cond/while bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    core = jax.extend.core if hasattr(jax.extend, "core") else jax.core
+    if isinstance(v, core.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, core.Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _sub_jaxprs(item)
+
+
+def _assert_no_bf16_compare(closed_jaxpr, ctx):
+    bad = []
+    for eqn in _iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in _CMP_PRIMS:
+            for invar in eqn.invars:
+                aval = getattr(invar, "aval", None)
+                if aval is not None and getattr(aval, "dtype", None) is not None \
+                        and aval.dtype == jnp.bfloat16:
+                    bad.append(f"{eqn.primitive.name} on {aval} in {ctx}")
+    assert not bad, ("Mosaic rejects bf16 arith.cmpf; found bf16 "
+                     "comparisons:\n" + "\n".join(bad))
+
+
+_R, _E = 16, 256
+
+
+def _storage(dtype):
+    rng = np.random.default_rng(0)
+    vals = rng.choice([0.0, 0.5, 1.0, np.nan], size=(_R, _E))
+    if dtype == "int8":
+        enc = np.where(np.isnan(vals), -1, np.round(2 * vals)).astype(np.int8)
+        return jnp.asarray(enc)
+    return jnp.asarray(vals, dtype=dtype)   # NaN entries mark absence
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])
+def test_no_bf16_compare_in_cov_kernel(dtype):
+    x = _storage(dtype)
+    mu = jnp.zeros((_E,), jnp.float32)
+    rep = jnp.full((_R,), 1.0 / _R, jnp.float32)
+    v = jnp.ones((_E,), jnp.float32)
+    fill = jnp.full((_E,), 0.5, jnp.float32)
+    fn = functools.partial(apply_weighted_cov, interpret=True)
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a[:4], fill=a[4]))(
+        x, mu, rep, v, fill)
+    _assert_no_bf16_compare(jaxpr, f"apply_weighted_cov[{dtype}]")
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])
+def test_no_bf16_compare_in_dirfix_kernel(dtype):
+    x = _storage(dtype)
+    rep = jnp.full((_R,), 1.0 / _R, jnp.float32)
+    loading = jnp.ones((_E,), jnp.float32)
+    fill = jnp.full((_E,), 0.5, jnp.float32)
+    fn = functools.partial(scores_dirfix_pass, interpret=True)
+    jaxpr = jax.make_jaxpr(lambda *a: fn(a[0], a[1], a[2], fill=a[3]))(
+        x, rep, loading, fill)
+    _assert_no_bf16_compare(jaxpr, f"scores_dirfix_pass[{dtype}]")
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])
+def test_no_bf16_compare_in_resolve_kernel(dtype):
+    x = _storage(dtype)
+    rep = jnp.full((_R,), 1.0 / _R, jnp.float32)
+    fill = jnp.full((_E,), 0.5, jnp.float32)
+    fn = functools.partial(resolve_certainty_fused, interpret=True)
+    jaxpr = jax.make_jaxpr(
+        lambda *a: fn(a[0], a[1], a[2], a[3], 0.1))(
+        x, rep, fill, jnp.asarray(1.0, jnp.float32))
+    _assert_no_bf16_compare(jaxpr, f"resolve_certainty_fused[{dtype}]")
+
+
+def test_decode_filled_bf16_values_exact():
+    """The post-fix decode (f32 compare, then bf16 cast) must produce the
+    same filled bf16 panel as the storage contract: lattice values exact,
+    absent entries replaced by the fill row."""
+    from pyconsensus_tpu.ops.pallas_kernels import _decode_filled_bf16
+
+    enc = jnp.asarray([[0, 1, 2, -1], [2, -1, 0, 1]], jnp.int8)
+    fill = jnp.asarray([[0.5, 0.5, 1.0, 0.0]], jnp.bfloat16)
+    out = _decode_filled_bf16(enc, fill, nan_fill=True)
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32),
+        [[0.0, 0.5, 1.0, 0.0], [1.0, 0.5, 0.0, 0.5]])
+
+    raw = jnp.asarray([[0.0, jnp.nan], [1.0, 0.5]], jnp.float32)
+    out = _decode_filled_bf16(raw, fill[:, :2], nan_fill=True)
+    np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                  [[0.0, 0.5], [1.0, 0.5]])
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16"])
+def test_no_highest_precision_on_bf16_kernel_dots(dtype):
+    """Second Mosaic rejection mode (16k-scaled BENCH rung-0, 2026-07-31):
+    an ambient jax.default_matmul_precision('highest') — the XLA path's
+    exact_matmuls wrapper — leaking into a Pallas kernel trace asks for an
+    fp32-precision contract on bf16 operands, which Mosaic rejects ("Bad
+    lhs type"). The compact-storage kernel dots are exact-by-compensation
+    at DEFAULT and must pin it explicitly, immune to ambient settings."""
+    x = _storage(dtype)
+    mu = jnp.zeros((_E,), jnp.float32)
+    rep = jnp.full((_R,), 1.0 / _R, jnp.float32)
+    v = jnp.ones((_E,), jnp.float32)
+    fill = jnp.full((_E,), 0.5, jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        jaxpr = jax.make_jaxpr(
+            lambda *a: apply_weighted_cov(*a[:4], fill=a[4], interpret=True))(
+            x, mu, rep, v, fill)
+    bad = []
+    for eqn in _iter_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        prec = eqn.params.get("precision")
+        if prec is None:
+            continue
+        high = jax.lax.Precision.HIGHEST
+        is_high = (prec == high or
+                   (isinstance(prec, tuple) and high in prec))
+        if is_high and any(
+                getattr(getattr(iv, "aval", None), "dtype", None)
+                == jnp.bfloat16 for iv in eqn.invars):
+            bad.append(str(eqn.primitive))
+    assert not bad, ("bf16 kernel dots traced at HIGHEST precision under "
+                     "ambient default_matmul_precision — Mosaic rejects "
+                     f"this at compile time: {bad}")
