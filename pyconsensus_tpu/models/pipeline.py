@@ -88,6 +88,13 @@ class ConsensusParams(NamedTuple):
     #: data provably doesn't need them, which matters at 10k × 100k scale.
     any_scaled: bool = True
     has_na: bool = True
+    #: master switch for the Pallas fast paths (the bench fail-soft
+    #: ladder's pure-XLA rung sets False): with it off the sharded
+    #: front-end never resolves onto power-fused PCA or the fused
+    #: NaN-threaded resolution, so no Pallas kernel is ever traced — the
+    #: recovery route when Mosaic rejects a kernel the gates would
+    #: otherwise pick (BENCH_r02's bf16 cmpf compile failure)
+    allow_fused: bool = True
     #: NaN-threaded fast path for the light pipeline (single-device TPU,
     #: sztorc): the storage matrix keeps NaN where reports are absent and
     #: every Pallas kernel reconstructs filled values in-register from a
@@ -564,6 +571,14 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
             "single-controller meshes: the host-clustering step runs "
             f"eagerly; use a jit algorithm {JIT_ALGORITHMS} on "
             "multi-process meshes")
+    if p.storage_dtype == "int8":
+        # mirror _consensus_core's gate: this path stores the INTERPOLATED
+        # matrix, whose continuous weighted-mean fills an int8 half-unit
+        # lattice would silently corrupt (e.g. a 0.4 fill truncating to 0)
+        raise ValueError(
+            "storage_dtype='int8' is not supported by the hybrid "
+            "clustering path: the interpolated fill values are continuous "
+            "— use storage_dtype='bfloat16'")
     old_rep = jk.normalize(reputation)
     rescaled = jk.rescale(reports, scaled, mins, maxs)
     filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,

@@ -34,6 +34,8 @@ __all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "parse_event_bounds",
 
 ALGORITHMS = tuple(JIT_ALGORITHMS) + tuple(HYBRID_ALGORITHMS)
 BACKENDS = ("numpy", "jax")
+#: legal storage_dtype values ("" = input dtype; see ConsensusParams)
+STORAGE_DTYPES = ("", "float32", "bfloat16", "int8")
 
 #: accepted lowercase spellings -> canonical algorithm name
 _ALGORITHM_ALIASES = {
@@ -233,6 +235,18 @@ class Oracle:
                 raise ValueError(f"{name} must be >= 1")
         if dbscan_eps <= 0.0:
             raise ValueError("dbscan_eps must be positive")
+        if storage_dtype not in STORAGE_DTYPES:
+            raise ValueError(f"unknown storage_dtype {storage_dtype!r}; "
+                             f"choose from {STORAGE_DTYPES}")
+        if storage_dtype == "int8" and algorithm in HYBRID_ALGORITHMS:
+            # the hybrid host-clustering path stores the INTERPOLATED
+            # matrix, whose fill values are continuous weighted means an
+            # int8 half-unit lattice would silently corrupt (0.5-quantized
+            # fills shift distances and outcomes with no error raised)
+            raise ValueError(
+                "storage_dtype='int8' is not supported by the hybrid "
+                f"clustering algorithms ({algorithm!r}): the interpolated "
+                "fill values are continuous — use storage_dtype='bfloat16'")
 
         self.reputation = rep
         self.backend = backend

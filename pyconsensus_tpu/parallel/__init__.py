@@ -11,6 +11,7 @@ from .mesh import (Mesh, NamedSharding, P, batch_event_sharding,
                    event_sharding, make_mesh, replicated)
 from .ring import ring_allreduce, ring_first_pc, ring_gram, ring_matvec
 from .sharded import (PlacedBounds, ShardedOracle, place_event_bounds,
+                      resolve_auto_storage, resolve_params,
                       sharded_consensus)
 from .streaming import streaming_consensus
 
@@ -18,5 +19,6 @@ __all__ = ["make_mesh", "event_sharding", "batch_event_sharding",
            "replicated", "Mesh", "NamedSharding", "P",
            "ShardedOracle", "sharded_consensus", "streaming_consensus",
            "PlacedBounds", "place_event_bounds",
+           "resolve_auto_storage", "resolve_params",
            "ring_allreduce", "ring_gram", "ring_matvec", "ring_first_pc",
            "initialize", "is_distributed", "make_hybrid_mesh", "num_slices"]

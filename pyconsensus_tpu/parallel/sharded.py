@@ -34,7 +34,7 @@ from .mesh import (Mesh, effective_median_block, event_sharding, make_mesh,
                    replicated)
 
 __all__ = ["sharded_consensus", "ShardedOracle", "PlacedBounds",
-           "place_event_bounds"]
+           "place_event_bounds", "resolve_auto_storage", "resolve_params"]
 
 #: PCA methods that never materialize the E×E covariance and whose
 #: contractions ride the event axis (SURVEY.md §7 "hard parts");
@@ -53,6 +53,10 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
     if params.pca_method not in _KNOWN_PCA:
         raise ValueError(f"unknown PCA method: {params.pca_method!r}; "
                          f"choose from {_KNOWN_PCA}")
+    if not params.allow_fused and params.pca_method == "power-fused":
+        # Pallas opt-out (the bench fail-soft ladder's pure-XLA rung):
+        # an explicit fused request downgrades to the XLA matvecs
+        return "power"
     if params.algorithm in _MULTI_COMPONENT_ALGOS:
         # mirror weighted_prin_comps' own auto routing: tiny-E exact
         # eigh-cov, exact Gram eigh while its QDWH temporaries fit,
@@ -79,7 +83,8 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
     # the partitioner).
     if n_reporters <= 4096:
         return "eigh-gram"
-    if n_devices == 1 and jax.default_backend() == "tpu":
+    if (n_devices == 1 and params.allow_fused
+            and jax.default_backend() == "tpu"):
         return "power-fused"
     return "power"
 
@@ -162,13 +167,65 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     # the same next-multiple-of-8 the kernel pads to (a no-op for
     # already-tileable counts)
     r_padded = n_reporters + (-n_reporters) % 8
-    return (n_devices == 1
+    return (params.allow_fused
+            and n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
             and params.pca_method in ("power", "power-fused")
             and scaled_ok
             and fused_pca_fits(n_events, itemsize)
             and resolve_kernel_fits(r_padded, itemsize))
+
+
+def resolve_params(p: ConsensusParams, R: int, E: int,
+                   mesh: Mesh) -> ConsensusParams:
+    """Public view of the sharded parameter resolution: the exact
+    ConsensusParams ``sharded_consensus`` will execute with for this
+    (params, shape, mesh) — resolved PCA method, median blocking, the
+    fused-path gate, the XLA path's static scaled count. The benchmark
+    logs this on every run so a driver-side failure is diagnosable from
+    stderr (BENCH_r02 recorded a Mosaic compile error with no record of
+    which path the gates had picked). Raises exactly when
+    ``sharded_consensus`` would (e.g. int8 off the fused path)."""
+    return _resolve_sharded_params(p, R, E, mesh)
+
+
+def resolve_auto_storage(p: ConsensusParams, R: int, E: int,
+                         mesh: Mesh) -> tuple:
+    """THE ``storage_dtype='auto'`` rule, shared by the benchmark and any
+    front-end that wants it (one source of truth — round 2 kept a mirrored
+    copy in bench.py, and the drift risk was judged the likely cause of
+    works-for-builder/fails-for-driver divergence):
+
+    - **int8** sentinel storage exactly when the int8-parameterized
+      pipeline resolves onto the fused NaN-threaded path (single real TPU
+      device, sztorc, power-family PCA after resolution, VMEM-fitting
+      shape) AND the workload is all-binary — the half-unit int8 lattice
+      is exact there and quarters the f32 HBM traffic;
+    - **bfloat16** otherwise (halves the traffic; catch-snapped binary
+      outcomes stay exact; scaled medians round to bf16 resolution).
+
+    Returns ``(storage_dtype, reason)`` where ``reason`` is a short
+    human-readable explanation for logs.
+    """
+    if p.any_scaled:
+        return "bfloat16", ("scaled events present: int8's half-unit "
+                            "lattice cannot carry continuous rescaled "
+                            "values")
+    trial = p._replace(storage_dtype="int8")
+    trial = trial._replace(
+        pca_method=_pick_pca_method(trial, R, E, mesh.devices.size),
+        median_block=effective_median_block(trial.median_block, mesh))
+    if _use_fused_resolution(trial, R, E, mesh.devices.size):
+        return "int8", (f"all-binary workload on the fused path "
+                        f"(pca_method={trial.pca_method!r}, "
+                        f"n_devices={mesh.devices.size}, "
+                        f"backend={jax.default_backend()!r})")
+    return "bfloat16", (f"fused gate closed (algorithm={p.algorithm!r}, "
+                        f"resolved pca_method={trial.pca_method!r}, "
+                        f"n_devices={mesh.devices.size}, "
+                        f"backend={jax.default_backend()!r}, "
+                        f"allow_fused={p.allow_fused}, R={R}, E={E})")
 
 
 class PlacedBounds(NamedTuple):

@@ -206,3 +206,75 @@ class TestShardedFrontEndGate:
                 jnp.asarray(reports), mesh=make_mesh(),
                 params=ConsensusParams(storage_dtype="int8",
                                        any_scaled=False, has_na=True))
+
+
+class TestHybridAndConstructionGates:
+    """ADVICE r2 (medium): int8 used to fall through to the hybrid
+    clustering path, truncating continuous interpolated fills with a bare
+    astype — silently wrong outcomes. Both the Oracle constructor and the
+    hybrid driver itself must refuse."""
+
+    def test_oracle_rejects_int8_hybrid(self, rng):
+        from pyconsensus_tpu.oracle import Oracle
+
+        reports = make_reports(rng, R=12, E=6)
+        for algo in ("hierarchical", "dbscan"):
+            with pytest.raises(ValueError, match="int8"):
+                Oracle(reports=reports, algorithm=algo, backend="jax",
+                       storage_dtype="int8")
+
+    def test_oracle_rejects_unknown_storage_dtype(self, rng):
+        from pyconsensus_tpu.oracle import Oracle
+
+        reports = make_reports(rng, R=12, E=6)
+        with pytest.raises(ValueError, match="storage_dtype"):
+            Oracle(reports=reports, storage_dtype="float16")
+
+    def test_hybrid_driver_rejects_int8(self, rng):
+        from pyconsensus_tpu.models.pipeline import _consensus_hybrid
+
+        reports = make_reports(rng, R=12, E=6)
+        args = fused_args(reports, np.full(12, 1.0 / 12))
+        with pytest.raises(ValueError, match="int8"):
+            _consensus_hybrid(*args,
+                              ConsensusParams(algorithm="hierarchical",
+                                              storage_dtype="int8"))
+
+
+class TestAutoStorageResolver:
+    """parallel.sharded.resolve_auto_storage is the ONE auto-storage rule
+    (round 2 kept a drifting mirror in bench.py). Contract: whatever it
+    returns must resolve through resolve_params without raising — 'auto'
+    can never produce a configuration the front-end then rejects."""
+
+    @pytest.mark.parametrize("R,E", [(16, 8), (64, 256), (4097, 128),
+                                     (8192, 4096), (10000, 2048)])
+    @pytest.mark.parametrize("algorithm", ["sztorc", "ica", "k-means"])
+    @pytest.mark.parametrize("any_scaled", [False, True])
+    def test_auto_choice_always_resolves(self, R, E, algorithm, any_scaled):
+        from pyconsensus_tpu.parallel import (make_mesh,
+                                              resolve_auto_storage,
+                                              resolve_params)
+
+        mesh = make_mesh()
+        p = ConsensusParams(algorithm=algorithm, any_scaled=any_scaled,
+                            n_scaled=2 if any_scaled else 0, has_na=True)
+        storage, reason = resolve_auto_storage(p, R, E, mesh)
+        assert storage in ("int8", "bfloat16")
+        assert reason
+        resolved = resolve_params(p._replace(storage_dtype=storage),
+                                  R, E, mesh)
+        if storage == "int8":
+            assert resolved.fused_resolution
+            assert not any_scaled
+        # int8 must never be picked off the fused path — resolve_params
+        # raising would have failed the test already
+
+    def test_no_pallas_closes_every_fused_gate(self):
+        from pyconsensus_tpu.parallel import make_mesh, resolve_params
+
+        mesh = make_mesh()
+        p = ConsensusParams(allow_fused=False, any_scaled=False, has_na=True)
+        resolved = resolve_params(p, 10000, 4096, mesh)
+        assert not resolved.fused_resolution
+        assert resolved.pca_method != "power-fused"
