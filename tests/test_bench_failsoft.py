@@ -45,6 +45,10 @@ def test_degraded_path_always_emits_json():
     assert smoke["backend"] == "cpu"
     assert smoke["value"] > 0.0
     assert smoke["metric"].startswith("consensus_resolutions_per_sec_256x")
+    # honesty contract (VERDICT r2 weak #6): a toy-shape smoke inside a
+    # failed artifact must not carry a number that reads as a 97x win
+    assert smoke["vs_baseline"] is None
+    assert "note" in smoke
 
 
 @pytest.mark.slow
@@ -55,7 +59,50 @@ def test_child_runs_real_measurement_on_cpu():
               "--batches", "2", "--storage-dtype", ""])
     assert r.returncode == 0, r.stderr[-2000:]
     payload = json.loads(r.stdout.strip().splitlines()[-1])
-    assert payload["metric"] == "consensus_resolutions_per_sec_64x256"
+    # explicit f32 storage is suffixed out of the headline metric series
+    assert payload["metric"] == "consensus_resolutions_per_sec_64x256_f32"
     assert payload["value"] > 0.0
     assert "error" not in payload
     assert payload["backend"] == "cpu"
+
+
+@pytest.mark.slow
+def test_ladder_degrades_within_backend_before_cpu_smoke():
+    """Round-3 ladder contract: a rung-0 failure must retry WITHIN the
+    device backend (f32 storage, then pure-XLA) instead of zeroing the
+    artifact. Forced here with an int8 storage request the CPU backend's
+    front-end rejects (the fused gate is closed off-TPU) — rung 1 strips
+    the storage override and must succeed, and the JSON must carry the
+    rung tag plus the rung-0 error."""
+    r = _run(["--reporters", "64", "--events", "256", "--repeats", "2",
+              "--batches", "2", "--storage-dtype", "int8"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["value"] > 0.0, payload
+    assert payload["rung"] == "storage-f32"
+    assert len(payload["rung_errors"]) == 1
+    assert "int8" in payload["rung_errors"][0]
+    assert payload["backend"] == "cpu"
+
+
+@pytest.mark.slow
+def test_no_pallas_rung_runs_pure_xla():
+    """--no-pallas must produce a working measurement with every Pallas
+    gate closed (the ladder's last device rung)."""
+    r = _run(["--reporters", "64", "--events", "256", "--repeats", "2",
+              "--batches", "2", "--storage-dtype", "", "--no-pallas"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["value"] > 0.0
+    assert "error" not in payload
+
+
+@pytest.mark.slow
+def test_gate_decisions_logged_on_every_run():
+    """BENCH-GATE lines must reach stderr so a driver-side failure is
+    diagnosable (VERDICT r2 next-round #1)."""
+    r = _run(["--reporters", "64", "--events", "256", "--repeats", "2",
+              "--batches", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "BENCH-GATE: storage_dtype auto ->" in r.stderr
+    assert "BENCH-GATE: resolved storage_dtype=" in r.stderr
