@@ -1,0 +1,112 @@
+"""A/B microbench: weighted-median implementations at scaled-heavy shape.
+
+Legacy baseline (inlined below — this WAS ``_weighted_median_cols_block``
+until round 3): stable argsort + 2x take_along_axis gathers + cumsum.
+Landed implementation (``ops.jax_kernels.weighted_median_cols``): one
+variadic ``lax.sort`` carrying (values, weights) — same stable order
+(num_keys=1 keeps the iota tie-break via stability), no (R, C) gathers.
+Measured 2026-07-31 on v5e at 10k x 4096: legacy 1052-1330 ms, landed
+113-132 ms (~8.7x) — the number cited in docs/PERFORMANCE.md's round-3
+kernel lesson; re-run this tool to reproduce it.
+
+Timing note: fetch a dependent scalar per call — on the tunneled axon
+platform ``block_until_ready`` returns before remote execution finishes.
+
+Usage: PYTHONPATH must include the repo root alongside the axon site dir:
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/median_ab.py
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pyconsensus_tpu.ops.jax_kernels import weighted_median_cols
+
+
+def legacy_argsort_block(values, weights, present):
+    """The pre-round-3 block implementation, kept verbatim as baseline."""
+    if weights.ndim == 1:
+        weights = jnp.broadcast_to(weights[:, None], values.shape)
+    values = values.astype(jnp.promote_types(values.dtype, weights.dtype))
+    R = values.shape[0]
+    big = jnp.where(present, values, jnp.inf)
+    w_raw = jnp.where(present, weights, 0.0)
+    order = jnp.argsort(big, axis=0, stable=True)
+    v = jnp.take_along_axis(big, order, axis=0)
+    w = jnp.take_along_axis(w_raw, order, axis=0)
+    total = jnp.sum(w, axis=0)
+    safe_total = jnp.where(total > 0.0, total, 1.0)
+    cw = jnp.cumsum(w / safe_total[None, :], axis=0)
+    ge = cw >= 0.5
+    idx = jnp.argmax(ge, axis=0)
+    idx = jnp.where(jnp.any(ge, axis=0), idx, R - 1)
+    take_col = lambda a, i: jnp.take_along_axis(a, i[None, :], axis=0)[0]  # noqa: E731
+    cw_i = take_col(cw, idx)
+    v_i = take_col(v, idx)
+    nxt = jnp.clip(idx + 1, 0, R - 1)
+    v_n = take_col(v, nxt)
+    exact = jnp.abs(cw_i - 0.5) <= (1e-8 + 1e-5 * 0.5)
+    has_next = (idx + 1 < R) & jnp.isfinite(v_n)
+    med = jnp.where(exact & has_next, 0.5 * (v_i + v_n), v_i)
+    return jnp.where(total > 0.0, med, 0.5)
+
+
+def legacy_argsort_median(values, weights, present, block_cols=1024):
+    R, E = values.shape
+    if block_cols > 0 and E > block_cols:
+        n_full = E // block_cols
+
+        def one_block(i):
+            sl = lambda a: lax.dynamic_slice_in_dim(  # noqa: E731
+                a, i * block_cols, block_cols, axis=1)
+            w = weights if weights.ndim == 1 else sl(weights)
+            return legacy_argsort_block(sl(values), w, sl(present))
+
+        blocks = lax.map(one_block, jnp.arange(n_full)).reshape(-1)
+        tail = E - n_full * block_cols
+        if not tail:
+            return blocks
+        start = n_full * block_cols
+        return jnp.concatenate([blocks, legacy_argsort_block(
+            values[:, start:],
+            weights if weights.ndim == 1 else weights[:, start:],
+            present[:, start:])])
+    return legacy_argsort_block(values, weights, present)
+
+
+def _time(f, *a):
+    float(np.asarray(f(*a).sum()))            # compile + honest barrier
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(np.asarray(f(*a).sum()))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) * 1e3
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    C = int(sys.argv[2]) if len(sys.argv) > 2 else 4_096
+    k1, k2 = jax.random.split(jax.random.key(0))
+    vals = jax.random.uniform(k1, (R, C))
+    pres = jax.random.bernoulli(k2, 0.98, (R, C))
+    rep = jnp.full((R,), 1.0 / R)
+    for blk in (0, 1024, 2048):
+        cur = jax.jit(lambda v, w, p, b=blk: weighted_median_cols(v, w, p, b))
+        old = jax.jit(lambda v, w, p, b=blk: legacy_argsort_median(v, w, p, b))
+        # equality is checked loosely: crossing selection is ulp-sensitive
+        # to the cumsum lowering across graphs (see the kernel docstring)
+        a = np.asarray(cur(vals, rep, pres))
+        b = np.asarray(old(vals, rep, pres))
+        n_diff = int((a != b).sum())
+        print(f"blk={blk}: legacy argsort+gather {_time(old, vals, rep, pres):.1f} ms"
+              f"  landed variadic-sort {_time(cur, vals, rep, pres):.1f} ms"
+              f"  (value diffs at uniform-weight ties: {n_diff}/{C})")
+
+
+if __name__ == "__main__":
+    main()
