@@ -191,3 +191,69 @@ class TestMedianBlockParity:
             jax.numpy.asarray(present), block_cols=0)
         np.testing.assert_array_equal(np.asarray(blocked),
                                       np.asarray(direct))
+
+
+class TestNorthStarShapeCollectiveCosts:
+    """VERDICT r2 items 3/7: the toy-shape bounds above caught the round-1
+    all-gather bug only after the fact — these compile the REAL north-star
+    shape (10k x 100k over 8 event shards, compile-only, inputs as
+    ShapeDtypeStructs so no 4 GB matrix is ever materialized) and pin the
+    same invariants where they actually matter. GSPMD's partitioning
+    choices are shape-dependent; a sane toy compile does not imply a sane
+    100k-column compile."""
+
+    R_NS, E_NS = 10_000, 100_000
+
+    def _compile(self, params, n_scaled=0):
+        from pyconsensus_tpu.parallel import resolve_params
+        from pyconsensus_tpu.parallel.mesh import (event_sharding,
+                                                   replicated)
+
+        mesh = make_mesh(batch=1, event=N_DEV)
+        e_sh = jax.sharding.NamedSharding(mesh,
+                                          jax.sharding.PartitionSpec("event"))
+        f32 = np.float32
+        args = (
+            jax.ShapeDtypeStruct((self.R_NS, self.E_NS), f32,
+                                 sharding=event_sharding(mesh)),
+            jax.ShapeDtypeStruct((self.R_NS,), f32, sharding=replicated(mesh)),
+            jax.ShapeDtypeStruct((self.E_NS,), bool, sharding=e_sh),
+            jax.ShapeDtypeStruct((self.E_NS,), f32, sharding=e_sh),
+            jax.ShapeDtypeStruct((self.E_NS,), f32, sharding=e_sh),
+        )
+        p = resolve_params(
+            params._replace(any_scaled=n_scaled > 0, n_scaled=n_scaled),
+            self.R_NS, self.E_NS, mesh)
+        assert not p.fused_resolution          # multi-device: XLA path
+        assert p.median_block == 0             # event-sharded: unblocked
+        return consensus_light_jit.lower(*args, p).compile().as_text()
+
+    def _assert_bounded_ns(self, sizes):
+        assert sizes.get("all-reduce"), "not sharded?"
+        biggest_ar = max(sizes["all-reduce"])
+        assert biggest_ar <= 4 * self.R_NS + 8, (
+            f"all-reduce moving {biggest_ar} elements at north-star shape")
+        for op in ("all-gather", "reduce-scatter", "all-to-all",
+                   "collective-permute"):
+            for n in sizes.get(op, []):
+                assert n <= self.E_NS, (op, n)
+
+    @pytest.mark.slow
+    def test_binary_northstar_compile(self):
+        p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                            has_na=True, storage_dtype="bfloat16")
+        self._assert_bounded_ns(collective_sizes(self._compile(p)))
+
+    @pytest.mark.slow
+    def test_scaled16k_northstar_compile(self):
+        """The 16k-scaled 8-chip sharded-median compile (VERDICT r2 item
+        3): each shard medians its local 12.5k columns along the
+        replicated R axis — the sort adds ZERO collectives, at the shape
+        where the single-chip ladder was over budget."""
+        p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                            has_na=True, storage_dtype="bfloat16")
+        sizes = collective_sizes(self._compile(p, n_scaled=16_000))
+        self._assert_bounded_ns(sizes)
+        binary = collective_sizes(self._compile(p))
+        assert sorted(sizes.keys()) == sorted(binary.keys())
+        assert len(sizes["all-reduce"]) == len(binary["all-reduce"])
