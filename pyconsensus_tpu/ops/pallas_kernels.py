@@ -39,7 +39,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["apply_weighted_cov", "power_iteration_fused",
-           "scores_dirfix_pass", "resolve_certainty_fused"]
+           "scores_dirfix_pass", "resolve_certainty_fused",
+           "storage_matvec", "storage_rows_matmat"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
 #: times this (double-buffered input + in-register f32 upcast)
@@ -98,6 +99,54 @@ def resolve_kernel_fits(n_reporters: int, itemsize: int) -> bool:
     Measured failure: R=20k f32 at C=128 blows the 16 MB limit by ~3.5 MB
     (C=64 fits)."""
     return _resolve_block_cols(n_reporters, itemsize) is not None
+
+
+def _compensated_split(v):
+    """Split an f32 vector into (head, residual) bf16 halves such that
+    ``head + residual`` carries ~16 mantissa bits — the operand form of
+    every compensated MXU dot in this module.
+
+    The head passes through ``lax.optimization_barrier`` because XLA's
+    simplifier on the TPU backend otherwise folds the convert chain
+    ``bf16(v - f32(bf16(v)))`` to an all-zero vector under jit (verified
+    on v5e 2026-07-31: eager gives the true residual, jit gives 0.0
+    everywhere) — which silently turned every "compensated" dot built
+    inside a jitted wrapper into a plain bf16-head dot (~2^-9 relative
+    error instead of ~2^-17). The barrier hides the head's provenance
+    from the simplifier; reconstruction error returns to ~2^-18
+    (measured)."""
+    vh = jax.lax.optimization_barrier(v.astype(jnp.bfloat16))
+    vl = (v - vh.astype(jnp.float32)).astype(jnp.bfloat16)
+    return vh, vl
+
+
+
+def _is_compact(x) -> bool:
+    """Whether the storage rides the MXU compact path (bf16 / int8
+    sentinel) vs the exact-f32 VPU path."""
+    return (x.dtype == jnp.bfloat16
+            or jnp.issubdtype(x.dtype, jnp.integer))
+
+
+def _vector_aux(v, fill, compact: bool):
+    """The (2-or-3, E) aux operand shared by every matvec-style kernel
+    (apply_weighted_cov, storage_matvec): compensated bf16 halves of the
+    f32 vector (+ bf16 fill row) on the compact path; ``[v, 0, (fill)]``
+    f32 rows on the exact-f32 path. ONE implementation so a precision or
+    layout fix (e.g. the _compensated_split jit-annihilation guard)
+    cannot be applied to one kernel and silently missed in another."""
+    E = v.shape[0]
+    f32 = jnp.float32
+    if compact:
+        vh, vl = _compensated_split(v)
+        rows = [vh.reshape(1, E), vl.reshape(1, E)]
+        if fill is not None:
+            rows.append(fill.astype(jnp.bfloat16).reshape(1, E))
+    else:
+        rows = [v.reshape(1, E), jnp.zeros((1, E), f32)]
+        if fill is not None:
+            rows.append(fill.astype(f32).reshape(1, E))
+    return jnp.concatenate(rows)
 
 
 def _decode_block(x_ref):
@@ -263,20 +312,8 @@ def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
     bf16 = jnp.bfloat16
     mu = mu.astype(f32)
     v = v.astype(f32)
-    compact = (x.dtype == bf16 or jnp.issubdtype(x.dtype, jnp.integer))
-    if compact:
-        # MXU branch operands: compensated bf16 halves of v (+ fill row)
-        vh = v.astype(bf16)
-        rows = [vh.reshape(1, E),
-                (v - vh.astype(f32)).astype(bf16).reshape(1, E)]
-        if nan_fill:
-            rows.append(fill.astype(bf16).reshape(1, E))
-    else:
-        # exact-f32 VPU branch operands: [v, 0, fill]
-        rows = [v.reshape(1, E), jnp.zeros((1, E), f32)]
-        if nan_fill:
-            rows.append(fill.astype(f32).reshape(1, E))
-    aux = jnp.concatenate(rows)
+    compact = _is_compact(x)
+    aux = _vector_aux(v, fill if nan_fill else None, compact)
     # HIGHEST precision: this O(E) dot runs outside the kernel at XLA's
     # default matmul precision (bf16 operand rounding on TPU), which would
     # inject ~1e-3-relative noise into the centering term that the
@@ -311,6 +348,154 @@ def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
         interpret=interpret,
     )(x, aux, muv, rep.reshape(-1, 1))
     return y.reshape(E) - mu * s.reshape(())
+
+
+def _matvec_kernel(x_ref, aux_ref, t_ref, *, nan_fill):
+    """One row panel of the UNCENTERED storage matvec ``t = filled @ v``
+    (the separable first half of the covariance application — the
+    event-sharded path must ``psum`` the (R,) result across shards before
+    the second contraction can run, so the one-pass fusion of
+    ``_apply_cov_kernel`` is structurally unavailable there). Same
+    compensated-operand exactness scheme: ``aux_ref`` rows 0..1 carry the
+    bf16 head/residual of ``v`` (row 2 the fill row under ``nan_fill``);
+    f32 storage takes the exact VPU chain."""
+    f32 = jnp.float32
+    if not (x_ref.dtype == jnp.bfloat16
+            or jnp.issubdtype(x_ref.dtype, jnp.integer)):
+        val, absent = _decode_block(x_ref)
+        v_full = aux_ref[0:1, :] + aux_ref[1:2, :]
+        filled = jnp.where(absent, aux_ref[2:3, :], val) if nan_fill else val
+        t_ref[:] = jnp.sum(filled * v_full, axis=1, keepdims=True)
+        return
+    fill_row = aux_ref[2:3, :] if nan_fill else None
+    filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
+    t2 = jax.lax.dot_general(filled, aux_ref[0:2, :],
+                             (((1,), (1,)), ((), ())),
+                             precision=jax.lax.Precision.DEFAULT,
+                             preferred_element_type=f32)       # (T, 2)
+    t_ref[:] = t2[:, 0:1] + t2[:, 1:2]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def storage_matvec(x, v, fill=None, interpret: bool = False):
+    """``filled(x) @ v`` in one HBM sweep of the storage matrix, decode
+    in-register (see :func:`_decode_block` for the encodings). Returns the
+    UNCENTERED (R,) f32 product — callers on the event-sharded path
+    ``psum`` it (plus their own ``mu·v`` partial) across shards and
+    finish the centering globally."""
+    R, E = x.shape
+    nan_fill = fill is not None
+    tile_r = _panel_rows(E, x.dtype.itemsize,
+                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    bf16 = jnp.bfloat16
+    v = v.astype(f32)
+    compact = _is_compact(x)
+    aux = _vector_aux(v, fill if nan_fill else None, compact)
+    t = pl.pallas_call(
+        functools.partial(_matvec_kernel, nan_fill=nan_fill),
+        grid=(Rp // tile_r,),
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((aux.shape[0], E), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Rp, 1), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, aux)
+    return t.reshape(Rp)[:R]
+
+
+def _rows_matmat_kernel(x_ref, w_ref, fill_ref, acc_ref, *, nan_fill,
+                        n_rows):
+    """One row panel of ``W @ filled(x)`` for a few (k <= 4) row vectors:
+    the separable second half of the sharded covariance application (and
+    the direction-fix contractions — W = [t, rep, ones] gives q/o/c per
+    event shard in one pass). ``w_ref`` carries the 2k compensated bf16
+    rows [W_head; W_residual] on the compact path (each product against
+    the lattice-exact filled panel is then exact; only the ~2^-17
+    second-order residual is lost), or the k f32 rows on the f32 path
+    (exact VPU chains — the parity mode must not round continuous
+    values)."""
+    i = pl.program_id(0)
+    f32 = jnp.float32
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    if not (x_ref.dtype == jnp.bfloat16
+            or jnp.issubdtype(x_ref.dtype, jnp.integer)):
+        val, absent = _decode_block(x_ref)
+        filled = (jnp.where(absent, fill_ref[0:1, :], val) if nan_fill
+                  else val)
+        for r in range(n_rows):
+            acc_ref[r:r + 1, :] += jnp.sum(
+                w_ref[r:r + 1, :].T * filled, axis=0, keepdims=True)
+        return
+    fill_row = fill_ref[0:1, :] if nan_fill else None
+    filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
+    part = jax.lax.dot_general(w_ref[:], filled,
+                               (((1,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.DEFAULT,
+                               preferred_element_type=f32)   # (2k, E)
+    acc_ref[:] += part[:n_rows, :] + part[n_rows:, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def storage_rows_matmat(x, W, fill=None, interpret: bool = False):
+    """``W @ filled(x)`` for a small stack of row vectors (W: (k, R) f32,
+    k <= 4) in ONE HBM sweep of the storage matrix. Per-event-column
+    results are local to an event shard, so the sharded path needs no
+    collective here. Returns (k, E) f32. Centering is the caller's:
+    ``(W @ filled) - (W @ 1) mu^T`` with local ``mu``."""
+    R, E = x.shape
+    k = W.shape[0]
+    nan_fill = fill is not None
+    tile_r = _panel_rows(E, x.dtype.itemsize,
+                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    bf16 = jnp.bfloat16
+    W = W.astype(f32)
+    if W.shape[1] != Rp:                     # zero-pad the padded rows
+        W = jnp.pad(W, ((0, 0), (0, Rp - W.shape[1])))
+    compact = _is_compact(x)
+    if compact:
+        Wh, Wl = _compensated_split(W)
+        Wop = jnp.concatenate([Wh, Wl])
+    else:
+        Wop = W
+    fill_arr = (fill.astype(bf16 if compact else f32).reshape(1, E)
+                if nan_fill else jnp.zeros((1, E), bf16 if compact else f32))
+    acc = pl.pallas_call(
+        functools.partial(_rows_matmat_kernel, nan_fill=nan_fill, n_rows=k),
+        grid=(Rp // tile_r,),
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Wop.shape[0], tile_r), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, E), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, E), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * k * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, Wop, fill_arr)
+    return acc
 
 
 def _scores_dirfix_kernel(x_ref, rep_ref, lf_ref, t_ref, acc_ref, *,

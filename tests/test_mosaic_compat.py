@@ -161,3 +161,34 @@ def test_no_highest_precision_on_bf16_kernel_dots(dtype):
     assert not bad, ("bf16 kernel dots traced at HIGHEST precision under "
                      "ambient default_matmul_precision — Mosaic rejects "
                      f"this at compile time: {bad}")
+
+
+class TestCompensatedSplit:
+    """Third backend hazard (found 2026-07-31 building the shard_map
+    path): XLA's TPU simplifier folds the compensated-split convert chain
+    ``bf16(v - f32(bf16(v)))`` to an ALL-ZERO vector under jit — eager
+    gives the true residual — silently degrading every 'compensated' MXU
+    dot whose operands were built inside a jitted wrapper to a plain
+    bf16-head dot. pallas_kernels._compensated_split hides the head
+    behind lax.optimization_barrier."""
+
+    def test_jitted_residual_is_alive(self):
+        from pyconsensus_tpu.ops.pallas_kernels import _compensated_split
+
+        v = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal(512).astype(np.float32))
+        vh, vl = jax.jit(_compensated_split)(v)
+        vl = np.asarray(vl, np.float32)
+        assert (vl != 0).mean() > 0.9, (
+            "jitted compensated split lost its residual — the "
+            "optimization_barrier guard is gone or ineffective")
+        recon = np.asarray(vh, np.float32) + vl
+        np.testing.assert_allclose(recon, np.asarray(v), rtol=2e-5)
+
+    def test_split_keeps_its_barrier(self):
+        from pyconsensus_tpu.ops.pallas_kernels import _compensated_split
+
+        v = jnp.ones((16,), jnp.float32)
+        prims = {e.primitive.name
+                 for e in jax.make_jaxpr(_compensated_split)(v).eqns}
+        assert "optimization_barrier" in prims
