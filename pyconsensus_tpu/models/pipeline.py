@@ -95,8 +95,10 @@ class ConsensusParams(NamedTuple):
     #: recovery route when Mosaic rejects a kernel the gates would
     #: otherwise pick (BENCH_r02's bf16 cmpf compile failure)
     allow_fused: bool = True
-    #: NaN-threaded fast path for the light pipeline (single-device TPU,
-    #: sztorc): the storage matrix keeps NaN where reports are absent and
+    #: NaN-threaded fast path for the light pipeline (real TPU, sztorc;
+    #: single-device here, or the shard_map mesh variant in
+    #: parallel.fused_sharded): the storage matrix keeps NaN where
+    #: reports are absent and
     #: every Pallas kernel reconstructs filled values in-register from a
     #: per-column fill vector — the filled matrix and the participation
     #: mask never exist in HBM, and the whole back half (outcomes +
@@ -260,7 +262,7 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     if p.storage_dtype == "int8":
         raise ValueError(
             "storage_dtype='int8' requires the fused NaN-threaded path "
-            "(single-device TPU, binary events): the XLA path stores the "
+            "(TPU, binary events): the XLA path stores the "
             "INTERPOLATED matrix, whose fill values are continuous "
             "weighted means a half-unit int8 lattice would corrupt — use "
             "storage_dtype='bfloat16' here")

@@ -1,0 +1,322 @@
+"""Event-sharded consensus on the FUSED storage kernels (shard_map).
+
+The GSPMD path (``sharded.py``) treats a Pallas kernel as a black box, so
+multi-chip meshes previously fell back to XLA matvecs — paying two HBM
+passes per power sweep on bf16 storage where the single-device fused path
+pays one on int8. This module recovers the kernel path on meshes by
+placing the collectives EXPLICITLY: each shard runs the storage kernels
+on its local (R, E/n) block under :func:`jax.shard_map`, and the (R,)- or
+scalar-sized cross-shard reductions are hand-placed ``psum``\\ s
+(docs/SCALING.md's round-4 lever, pulled into round 3).
+
+What is fundamentally different from the single-device fused path: the
+one-pass covariance application (``apply_weighted_cov``) fuses ``t = Dv``
+and ``y = D^T(rep*t)`` into one HBM sweep, which requires all of ``t``
+locally — but on an event-sharded mesh ``t`` is a cross-shard sum, so the
+sweep necessarily splits into two kernel passes with a 40 KB (R,) psum
+between them (:func:`pallas_kernels.storage_matvec` then
+:func:`pallas_kernels.storage_rows_matmat`). The win over the XLA mesh
+path is therefore NOT pass count (both pay two) but storage bytes: the
+kernels decode int8 sentinel storage in-register, so each pass streams
+1-byte elements instead of the XLA path's bf16 — and the entire back half
+(outcomes + certainty + participation) stays ONE fused kernel sweep per
+shard (its outputs are per-column, hence shard-local).
+
+Scope (gate-enforced by ``sharded._use_fused_resolution``): sztorc,
+power-family PCA, binary events only (the scaled-column gather would
+cross shards), E divisible by the event-axis size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.pipeline import ConsensusParams, _fill_stats, _masked_mu
+from ..ops import jax_kernels as jk
+from .mesh import Mesh
+
+__all__ = ["fused_sharded_consensus"]
+
+
+def _psum(x):
+    return lax.psum(x, "event")
+
+
+def _gnorm(v):
+    """Global L2 norm of an event-sharded vector."""
+    return jnp.sqrt(_psum(jnp.sum(v * v)))
+
+
+def _sharded_power(apply_cov, seed, base_unit, n_iters: int, tol: float,
+                   v_init=None):
+    """jax_kernels._power_loop with every norm / alignment dot promoted to
+    a global (psum) reduction; iterates are the local (E_loc,) slices of
+    the global vector. Semantics mirrored exactly: cold start applies the
+    covariance to the fixed seed slice; warm ``v_init`` is blended with
+    the base direction (see _power_loop's crossing rationale); ``tol < 0``
+    disables the early exit."""
+    dtype = seed.dtype
+    no_exit = tol < 0
+    tol = max(float(tol), 8.0 * float(jnp.finfo(dtype).eps))
+
+    if v_init is None:
+        start = seed
+    else:
+        v_init = v_init.astype(dtype)
+        n_i = _gnorm(v_init)
+        blended = (v_init / jnp.where(n_i > 0.0, n_i, 1.0)
+                   + 0.25 * base_unit)
+        start = jnp.where(n_i > 0.0, blended, seed)
+    v0 = apply_cov(start)
+    n0 = _gnorm(v0)
+    v0 = jnp.where(n0 == 0.0, base_unit, v0 / jnp.where(n0 == 0.0, 1.0, n0))
+
+    def cond(state):
+        i, _, done = state
+        return (i < n_iters) & ~done
+
+    def body(state):
+        i, v, _ = state
+        w = apply_cov(v)
+        n = _gnorm(w)
+        w = jnp.where(n == 0.0, v, w / jnp.where(n == 0.0, 1.0, n))
+        if no_exit:
+            done = jnp.asarray(False)
+        else:
+            done = jnp.abs(_psum(jnp.vdot(w, v))) >= 1.0 - tol
+        return i + 1, w, done
+
+    _, loading, _ = lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), v0, jnp.asarray(False)))
+    return loading
+
+
+def _canon_sign_sharded(v, e_start, E_loc):
+    """jk.canon_sign across shards: flip so the entry of largest |value|
+    (first-index tie-break, globally) is positive."""
+    absr = jnp.abs(v)
+    li = jnp.argmax(absr)
+    lv = absr[li]
+    gmax = lax.pmax(lv, "event")
+    big = jnp.iinfo(jnp.int32).max
+    cand = jnp.where(lv == gmax, e_start + li.astype(jnp.int32), big)
+    gidx = lax.pmin(cand, "event")
+    mine = (gidx >= e_start) & (gidx < e_start + E_loc)
+    local = jnp.clip(gidx - e_start, 0, E_loc - 1)
+    sgn = _psum(jnp.where(mine, jnp.sign(v[local]), 0.0))
+    return v * jnp.where(sgn == 0.0, 1.0, sgn)
+
+
+def _guard_div(vec, total):
+    """normalize()'s zero-sum guard on an already-summed total."""
+    return jnp.where(total == 0.0, vec,
+                     vec / jnp.where(total == 0.0, 1.0, total))
+
+
+def _local_consensus(x_blk, rep, seed, base_unit, p: ConsensusParams,
+                     n_event: int, interpret: bool):
+    """The per-shard body (runs under shard_map): mirrors
+    pipeline._consensus_core_fused with explicit cross-shard psums."""
+    from ..ops.pallas_kernels import (resolve_certainty_fused,
+                                      storage_matvec, storage_rows_matmat)
+
+    R, E_loc = x_blk.shape
+    E_total = E_loc * n_event
+    e_start = (lax.axis_index("event") * E_loc).astype(jnp.int32)
+    old_rep = jk.normalize(rep)
+    acc = old_rep.dtype
+
+    x, fill, tw0, numer0 = _fill_stats(x_blk, old_rep, p.catch_tolerance,
+                                       p.storage_dtype, None)
+    full0 = jnp.sum(old_rep)
+    mu1 = numer0 + (full0 - tw0) * fill            # (E_loc,) local
+
+    def scores_at(rep_k, mu_k, v_init=None):
+        """sztorc_scores_power_fused, shard-aware: two kernel passes per
+        sweep with one (R,)+scalar psum between, then the direction-fix
+        contractions per shard + O(1) psums."""
+        denom = 1.0 - jnp.sum(rep_k ** 2)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+
+        def apply_cov(v_loc):
+            t_part = storage_matvec(x, v_loc, fill=fill,
+                                    interpret=interpret).astype(acc)
+            muv_part = mu_k @ v_loc
+            t, muv = _psum((t_part, muv_part))
+            rt = rep_k * (t - muv)                 # (R,) replicated
+            y = storage_rows_matmat(x, rt[None, :], fill=fill,
+                                    interpret=interpret)[0].astype(acc)
+            return (y - mu_k * jnp.sum(rt)) / denom
+
+        loading = _sharded_power(apply_cov, seed, base_unit,
+                                 p.power_iters, p.power_tol, v_init=v_init)
+        t_part = storage_matvec(x, loading, fill=fill,
+                                interpret=interpret).astype(acc)
+        ml_part = mu_k @ loading
+        t_raw, ml = _psum((t_part, ml_part))
+        W = jnp.stack([t_raw, rep_k.astype(acc), jnp.ones_like(rep_k, acc)])
+        qco = storage_rows_matmat(x, W, fill=fill,
+                                  interpret=interpret).astype(acc)
+        q, o, c = qco[0], qco[1], qco[2]
+        scores = t_raw - ml                        # (R,) replicated
+        qs = q - ml * c                            # scores^T X, local cols
+        a1 = jnp.abs(jnp.min(scores))
+        a2 = jnp.max(scores)
+        set1 = scores + a1
+        set2 = scores - a2
+        sum_s = jnp.sum(scores)
+        s1_tot = sum_s + R * a1
+        s2_tot = sum_s - R * a2
+        new1 = _guard_div(qs + a1 * c, s1_tot)
+        new2 = _guard_div(qs - a2 * c, s2_tot)
+        ref_ind = _psum(jnp.sum((new1 - o) ** 2) - jnp.sum((new2 - o) ** 2))
+        return jnp.where(ref_ind <= 0.0, set1, -set2), loading
+
+    if p.max_iterations <= 1:
+        adj, loading = scores_at(old_rep, mu1)
+        this_rep = jk.row_reward_weighted(adj, old_rep)
+        rep_f = jk.smooth(this_rep, old_rep, p.alpha)
+        converged = (jnp.max(jnp.abs(rep_f - old_rep))
+                     <= p.convergence_tolerance)
+        iters = jnp.asarray(1, dtype=jnp.int32)
+    else:
+        def step(carry, _):
+            rep_c, this_prev, loading_prev, conv, it = carry
+            adj, loading = scores_at(rep_c, _masked_mu(x, fill, rep_c),
+                                     v_init=loading_prev)
+            this_rep = jk.row_reward_weighted(adj, rep_c)
+            new_rep = jk.smooth(this_rep, rep_c, p.alpha)
+            delta = jnp.max(jnp.abs(new_rep - rep_c))
+            rep_out = jnp.where(conv, rep_c, new_rep)
+            this_out = jnp.where(conv, this_prev, this_rep)
+            loading_out = jnp.where(conv, loading_prev, loading)
+            it_out = jnp.where(conv, it, it + 1)
+            conv_out = conv | (delta <= p.convergence_tolerance)
+            return (rep_out, this_out, loading_out, conv_out, it_out), None
+
+        init = (old_rep, old_rep, jnp.zeros((E_loc,), dtype=acc),
+                jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+        (rep_f, this_rep, loading, converged, iters), _ = lax.scan(
+            step, init, None, length=p.max_iterations)
+
+    raw, adjusted, certainty, pcol, prow_part, narow_part = (
+        resolve_certainty_fused(x, rep_f, fill, jnp.sum(rep_f),
+                                float(p.catch_tolerance),
+                                interpret=interpret))
+    raw = raw.astype(acc)
+    adjusted = adjusted.astype(acc)
+    certainty = certainty.astype(acc)
+    prow, narow = _psum((prow_part.astype(acc), narow_part))
+
+    participation_columns = (1.0 - pcol).astype(acc)
+    cert_sum = _psum(jnp.sum(certainty))
+    consensus_reward = _guard_div(certainty, cert_sum)
+    participation_rows = 1.0 - _guard_div(prow, cert_sum)
+    pc_sum = _psum(jnp.sum(participation_columns))
+    percent_na = 1.0 - pc_sum / E_total
+    na_bonus_rows = jk.normalize(participation_rows)
+    reporter_bonus = (na_bonus_rows * percent_na
+                      + rep_f * (1.0 - percent_na))
+    na_bonus_cols = _guard_div(participation_columns, pc_sum)
+    author_bonus = (na_bonus_cols * percent_na
+                    + consensus_reward * (1.0 - percent_na))
+    return {
+        "old_rep": old_rep,
+        "this_rep": this_rep,
+        "smooth_rep": rep_f,
+        "na_row": narow > 0.0,
+        "outcomes_raw": raw,
+        "outcomes_adjusted": adjusted,
+        "outcomes_final": adjusted,            # binary: no rescale
+        "iterations": iters,
+        "convergence": converged,
+        "first_loading": _canon_sign_sharded(loading, e_start, E_loc),
+        "certainty": certainty,
+        "consensus_reward": consensus_reward,
+        "avg_certainty": cert_sum / E_total,
+        "participation_columns": participation_columns,
+        "participation_rows": participation_rows,
+        "percent_na": percent_na,
+        "na_bonus_rows": na_bonus_rows,
+        "reporter_bonus": reporter_bonus,
+        "na_bonus_cols": na_bonus_cols,
+        "author_bonus": author_bonus,
+    }
+
+
+#: result keys that are per-event vectors (stay event-sharded); everything
+#: else is an O(R) replicated vector or a scalar
+_EVENT_KEYS = frozenset([
+    "outcomes_raw", "outcomes_adjusted", "outcomes_final", "certainty",
+    "consensus_reward", "participation_columns", "na_bonus_cols",
+    "author_bonus", "first_loading",
+])
+
+
+@functools.lru_cache(maxsize=16)
+def _seed_placed(mesh: Mesh, E: int, dtype_name: str):
+    """Device-resident event-sharded power seed + unit base direction,
+    cached per (mesh, E, dtype): these are constants, and per-call
+    placement of (E,)-vectors costs ~70-100 ms through the tunneled-TPU
+    link at E=100k (see sharded._default_bounds_placed — same
+    rationale)."""
+    dtype = jnp.dtype(dtype_name)
+    e_shard = NamedSharding(mesh, P("event"))
+    seed = jax.device_put(jk._power_seed(E, dtype), e_shard)
+    base_unit = jax.device_put(seed / jnp.linalg.norm(seed), e_shard)
+    return seed, base_unit
+
+
+@functools.lru_cache(maxsize=32)
+def _build(mesh: Mesh, p: ConsensusParams, interpret: bool):
+    """One jitted shard-mapped executable per (mesh, params, mode)."""
+    n_event = mesh.shape["event"]
+    out_specs = {k: (P("event") if k in _EVENT_KEYS else P())
+                 for k in [
+                     "old_rep", "this_rep", "smooth_rep", "na_row",
+                     "outcomes_raw", "outcomes_adjusted", "outcomes_final",
+                     "iterations", "convergence", "first_loading",
+                     "certainty", "consensus_reward", "avg_certainty",
+                     "participation_columns", "participation_rows",
+                     "percent_na", "na_bonus_rows", "reporter_bonus",
+                     "na_bonus_cols", "author_bonus"]}
+    fn = jax.shard_map(
+        functools.partial(_local_consensus, p=p, n_event=n_event,
+                          interpret=interpret),
+        mesh=mesh,
+        in_specs=(P(None, "event"), P(), P("event"), P("event")),
+        out_specs=out_specs,
+        # replication of the P() outputs is established by explicit psums;
+        # shard_map's static rep-checker cannot see through the Pallas
+        # custom calls, so the check is disabled rather than fought
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def fused_sharded_consensus(reports, reputation, mesh: Mesh,
+                            p: ConsensusParams):
+    """Resolve one large all-binary oracle with the events axis sharded
+    over ``mesh`` ON THE FUSED KERNEL PATH (see module docstring).
+
+    ``reports``/``reputation`` must already be placed
+    (event-sharded / replicated) by the caller (``sharded_consensus``
+    routes here after placement). Returns the light result dict, outputs
+    left on device (event vectors sharded)."""
+    if p.any_scaled:
+        raise ValueError("the sharded fused path is binary-only: scaled "
+                         "columns need a cross-shard gather — use the XLA "
+                         "path (allow_fused=False or pca_method='power')")
+    R, E = reports.shape
+    n_event = mesh.shape["event"]
+    if E % n_event != 0:
+        raise ValueError(f"E={E} not divisible by event axis {n_event}")
+    interpret = jax.default_backend() != "tpu"
+    acc = jnp.asarray(0.0).dtype
+    seed, base_unit = _seed_placed(mesh, E, acc.name)
+    return _build(mesh, p, interpret)(reports, reputation, seed, base_unit)

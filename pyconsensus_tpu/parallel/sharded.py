@@ -69,22 +69,21 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
             return "eigh-cov"
         return ("eigh-gram" if n_reporters <= _GRAM_EIGH_MAX_R else "power")
     if params.pca_method in _SHARDABLE_PCA:
-        # the Pallas kernels are black boxes to the GSPMD partitioner — an
-        # explicit "power-fused" request downgrades to the XLA matvecs on a
-        # multi-device mesh so the event-axis contractions actually shard
-        if params.pca_method == "power-fused" and n_devices > 1:
-            return "power"
+        # "power-fused" on a multi-device mesh now means the shard_map
+        # fused path (parallel.fused_sharded) — kept as-requested here;
+        # _resolve_sharded_params downgrades it to the XLA "power" matvecs
+        # whenever the fused gate turns out closed (a Pallas call inside
+        # plain GSPMD would not shard)
         return params.pca_method
     # "auto"/"eigh-cov" on a sharded matrix would build E×E — never do that;
     # closed-form Gram when R is small enough to eigh, matrix-free otherwise.
-    # On a single real TPU the fused Pallas kernel halves the power-iteration
-    # HBM traffic; the multi-device path stays on XLA matvecs so GSPMD can
-    # shard the event-axis contractions (a Pallas kernel is a black box to
-    # the partitioner).
+    # On TPU the fused kernel path wins at any device count (single device:
+    # one-pass sweeps; meshes: the shard_map path's int8-width passes —
+    # parallel.fused_sharded); the gate below still falls back to XLA
+    # "power" when the fused path can't serve the config.
     if n_reporters <= 4096:
         return "eigh-gram"
-    if (n_devices == 1 and params.allow_fused
-            and jax.default_backend() == "tpu"):
+    if params.allow_fused and jax.default_backend() == "tpu":
         return "power-fused"
     return "power"
 
@@ -119,17 +118,25 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
         pca_method=_pick_pca_method(p, R, E, mesh.devices.size),
         median_block=effective_median_block(p.median_block, mesh))
     p = p._replace(fused_resolution=_use_fused_resolution(
-        p, R, E, mesh.devices.size))
+        p, R, E, mesh.devices.size, mesh.shape.get("event", 1)))
+    if (not p.fused_resolution and p.pca_method == "power-fused"
+            and mesh.devices.size > 1):
+        # fused gate closed on a mesh: a bare Pallas call is a black box
+        # to the GSPMD partitioner, so the event-axis contractions would
+        # not shard — downgrade to the XLA matvecs
+        p = p._replace(pca_method="power")
     if p.storage_dtype == "int8" and not p.fused_resolution:
         # int8 must never fall through to the XLA path (it stores the
         # continuous interpolated fills); fail loudly with the reason the
         # fused gate closed
         raise ValueError(
-            "storage_dtype='int8' requires the fused NaN-threaded path "
-            "(single real TPU device, algorithm='sztorc', power-family "
-            "pca_method, binary events, VMEM-fitting shape) — this "
-            f"configuration resolved to the XLA path (mesh devices="
-            f"{mesh.devices.size}, algorithm={p.algorithm!r}, "
+            "storage_dtype='int8' requires the fused kernel path (real "
+            "TPU backend, algorithm='sztorc', power-family pca_method, "
+            "binary events, VMEM-fitting shape; on an event-sharded mesh "
+            "additionally E divisible by the event axis and no scaled "
+            "events at all) — this configuration resolved to the XLA "
+            f"path (mesh devices={mesh.devices.size}, event axis="
+            f"{mesh.shape.get('event', 1)}, algorithm={p.algorithm!r}, "
             f"pca_method={p.pca_method!r}); use storage_dtype='bfloat16'")
     if not p.fused_resolution:
         p = p._replace(n_scaled=_xla_path_n_scaled(p, E, mesh))
@@ -137,23 +144,30 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
 
 
 def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
-                          n_events: int, n_devices: int) -> bool:
+                          n_events: int, n_devices: int,
+                          n_event_shards: int = None) -> bool:
     """Gate for the NaN-threaded Pallas fast path
-    (``ConsensusParams.fused_resolution``): single real TPU (a Pallas call
-    is a black box to the GSPMD partitioner, so the multi-chip mesh stays
-    on XLA), binary events — or a small statically-counted scaled fraction
-    (``params.n_scaled``, re-resolved exactly by an O(R * n_scaled)
-    gather-and-fix pass after the binary kernel; a scaled-heavy matrix
-    would make that pass rival the fused sweep it rides on, so it takes
-    the XLA path) — the sztorc algorithm scored by power iteration
-    (``params.pca_method`` must already be resolved — an explicit or
-    auto-picked exact eigh must NOT be silently swapped for power
-    iteration), and a shape that fits the kernels' scoped-VMEM budget
-    (out-of-budget shapes take the XLA path — correct, just fewer fused
-    passes). A reporter count with no tileable row-chunk divisor (e.g. a
-    prime R) is handled inside resolve_certainty_fused by zero-rep row
-    padding, so it no longer disqualifies the fast path — the VMEM fit is
-    checked at the padded count."""
+    (``ConsensusParams.fused_resolution``) on a real TPU: the sztorc
+    algorithm scored by power iteration (``params.pca_method`` must
+    already be resolved — an explicit or auto-picked exact eigh must NOT
+    be silently swapped for power iteration), a shape that fits the
+    kernels' scoped-VMEM budget (out-of-budget shapes take the XLA path —
+    correct, just fewer fused passes), and scaled events only as a small
+    statically-counted fraction (``params.n_scaled``, re-resolved exactly
+    by an O(R * n_scaled) gather-and-fix pass after the binary kernel; a
+    scaled-heavy matrix would make that pass rival the fused sweep it
+    rides on, so it takes the XLA path).
+
+    Multi-device meshes route to the shard_map fused path
+    (``parallel.fused_sharded``) since round 3 — there the per-shard
+    VMEM fit is checked at the E/n_devices shard width, events must
+    divide evenly over the axis, and scaled events are excluded outright
+    (the gather-and-fix would cross shards).
+
+    A reporter count with no tileable row-chunk divisor (e.g. a prime R)
+    is handled inside resolve_certainty_fused by zero-rep row padding, so
+    it does not disqualify the fast path — the VMEM fit is checked at the
+    padded count."""
     from ..ops.pallas_kernels import fused_pca_fits, resolve_kernel_fits
 
     # actual matrix itemsize: the storage dtype if set, else the default
@@ -162,18 +176,35 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     itemsize = (jax.numpy.dtype(params.storage_dtype).itemsize
                 if params.storage_dtype
                 else jax.numpy.asarray(0.0).dtype.itemsize)
-    scaled_ok = (not params.any_scaled
-                 or 0 < params.n_scaled <= n_events // 8)
+    # the fused path shards over the EVENT axis only — gate on that
+    # width, not the device count (a batch x event mesh's per-shard
+    # columns are E / event, and a pure-batch multi-device mesh has no
+    # event sharding at all for the kernels to ride)
+    if n_event_shards is None:
+        n_event_shards = n_devices
+    if n_devices > 1 and n_event_shards <= 1:
+        # pure-batch multi-device mesh: the single-device kernel pipeline
+        # under a multi-device GSPMD jit is untested replication — stay
+        # on the XLA path
+        return False
+    if n_event_shards > 1:
+        scaled_ok = not params.any_scaled
+        if n_events % n_event_shards != 0:
+            return False
+        e_local = n_events // n_event_shards
+    else:
+        scaled_ok = (not params.any_scaled
+                     or 0 < params.n_scaled <= n_events // 8)
+        e_local = n_events
     # the same next-multiple-of-8 the kernel pads to (a no-op for
     # already-tileable counts)
     r_padded = n_reporters + (-n_reporters) % 8
     return (params.allow_fused
-            and n_devices == 1
             and jax.default_backend() == "tpu"
             and params.algorithm == "sztorc"
             and params.pca_method in ("power", "power-fused")
             and scaled_ok
-            and fused_pca_fits(n_events, itemsize)
+            and fused_pca_fits(e_local, itemsize)
             and resolve_kernel_fits(r_padded, itemsize))
 
 
@@ -198,10 +229,12 @@ def resolve_auto_storage(p: ConsensusParams, R: int, E: int,
     works-for-builder/fails-for-driver divergence):
 
     - **int8** sentinel storage exactly when the int8-parameterized
-      pipeline resolves onto the fused NaN-threaded path (single real TPU
-      device, sztorc, power-family PCA after resolution, VMEM-fitting
-      shape) AND the workload is all-binary — the half-unit int8 lattice
-      is exact there and quarters the f32 HBM traffic;
+      pipeline resolves onto the fused kernel path (real TPU backend,
+      sztorc, power-family PCA after resolution, VMEM-fitting shape —
+      single device OR an event-sharded mesh with divisible E, via
+      parallel.fused_sharded) AND the workload is all-binary — the
+      half-unit int8 lattice is exact there and quarters the f32 HBM
+      traffic;
     - **bfloat16** otherwise (halves the traffic; catch-snapped binary
       outcomes stay exact; scaled medians round to bf16 resolution).
 
@@ -216,7 +249,8 @@ def resolve_auto_storage(p: ConsensusParams, R: int, E: int,
     trial = trial._replace(
         pca_method=_pick_pca_method(trial, R, E, mesh.devices.size),
         median_block=effective_median_block(trial.median_block, mesh))
-    if _use_fused_resolution(trial, R, E, mesh.devices.size):
+    if _use_fused_resolution(trial, R, E, mesh.devices.size,
+                             mesh.shape.get("event", 1)):
         return "int8", (f"all-binary workload on the fused path "
                         f"(pca_method={trial.pca_method!r}, "
                         f"n_devices={mesh.devices.size}, "
@@ -379,6 +413,19 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         placed = _place_inputs(mesh, reports, reputation, scaled, mins,
                                maxs)
         return _consensus_hybrid(*placed, p, light=True)
+    if p.fused_resolution and mesh.shape.get("event", 1) > 1:
+        # multi-device fused path: explicit shard_map collectives around
+        # the storage kernels (parallel.fused_sharded) — the GSPMD jit
+        # below would treat the Pallas calls as unsharded black boxes
+        from .fused_sharded import fused_sharded_consensus
+
+        if reputation is None:
+            reputation = _default_reputation_placed(mesh, R)
+        reports = _maybe_place_reports(reports, event_sharding(mesh),
+                                       jax.numpy.asarray(0.0).dtype)
+        reputation = _maybe_place(reputation, replicated(mesh),
+                                  jax.numpy.asarray(0.0).dtype)
+        return fused_sharded_consensus(reports, reputation, mesh, p)
     if reputation is None:
         reputation = _default_reputation_placed(mesh, R)   # cached, on device
         if event_bounds is None:
@@ -428,6 +475,12 @@ class ShardedOracle(Oracle):
             # host-clustering hybrid: eager sharded device phases, host
             # merge loop (see sharded_consensus)
             return _consensus_hybrid(*placed, self.params, light=True)
+        if (self.params.fused_resolution
+                and self.mesh.shape.get("event", 1) > 1):
+            from .fused_sharded import fused_sharded_consensus
+
+            return fused_sharded_consensus(placed[0], placed[1], self.mesh,
+                                           self.params)
         return consensus_light_jit(*placed, self.params)
 
     def consensus(self) -> dict:
