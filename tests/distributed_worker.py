@@ -108,3 +108,22 @@ if len(sys.argv) > 3:
                                                tiled=True)
     print("SCALED", ",".join(f"{float(v):.10g}" for v in np.ravel(sc_all)),
           flush=True)
+
+    # phase 5: the shard_map fused-kernel path (round 3) with REAL
+    # cross-process collectives — int8 sentinel storage decoded in-register
+    # per shard, the explicit (R,)/scalar psums crossing the gloo backend
+    from pyconsensus_tpu.parallel.fused_sharded import (  # noqa: E402
+        fused_sharded_consensus)
+
+    params_f = ConsensusParams(algorithm="sztorc", pca_method="power",
+                               power_iters=64, power_tol=0.0,
+                               storage_dtype="int8", any_scaled=False,
+                               has_na=True, fused_resolution=True)
+    out_f = fused_sharded_consensus(x, rep, mesh, params_f)
+    f_all = multihost_utils.process_allgather(out_f["outcomes_adjusted"],
+                                              tiled=True)
+    print("FUSED", ",".join(f"{float(v):g}" for v in np.ravel(f_all)),
+          flush=True)
+    print("FUSEDREP", ",".join(f"{float(v):.6f}"
+                               for v in np.asarray(out_f["smooth_rep"])),
+          flush=True)

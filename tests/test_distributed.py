@@ -131,3 +131,26 @@ def test_two_process_global_mesh(tmp_path):
         sc0[:14], ref_sc["events"]["outcomes_adjusted"][:14])
     np.testing.assert_allclose(
         sc0[14:], ref_sc["events"]["outcomes_adjusted"][14:], atol=1e-6)
+
+    # phase 5: the shard_map fused path (round 3) — int8 kernels per
+    # event shard with explicit psums over REAL cross-process gloo
+    # collectives; outcomes must agree across processes and bit-match the
+    # single-device fused path on the same matrix
+    import jax.numpy as jnp
+
+    from pyconsensus_tpu.models.pipeline import _consensus_core_fused
+    f0, f1 = (parse("FUSED", o) for o in outputs)
+    fr0, fr1 = (parse("FUSEDREP", o) for o in outputs)
+    np.testing.assert_array_equal(f0, f1)
+    np.testing.assert_allclose(fr0, fr1, atol=1e-6)
+    pf = ConsensusParams(algorithm="sztorc", pca_method="power",
+                         power_iters=64, power_tol=0.0,
+                         storage_dtype="int8", any_scaled=False,
+                         has_na=True, fused_resolution=True)
+    local_f = _consensus_core_fused(
+        jnp.asarray(reports), jnp.full((12,), 1.0 / 12.0),
+        jnp.zeros((16,), bool), jnp.zeros((16,)), jnp.ones((16,)), pf)
+    np.testing.assert_array_equal(
+        f0, np.asarray(local_f["outcomes_adjusted"]))
+    np.testing.assert_allclose(fr0, np.asarray(local_f["smooth_rep"]),
+                               atol=1e-5)

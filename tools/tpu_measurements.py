@@ -132,7 +132,26 @@ def main() -> None:
         print("TPU alive — running suite (sequential; OOM-risky shapes "
               "last)")
 
+    # upsert into any existing bank (keyed on _name) rather than replacing
+    # the file wholesale: a --only subset re-run must refresh just its own
+    # entries — round 3 nearly lost six banked measurements to a partial
+    # re-run truncating the file
     results = []
+    if out_path.exists():
+        try:
+            results = [m for m in json.loads(out_path.read_text())
+                       if isinstance(m, dict)]
+        except ValueError:
+            results = []
+
+    def upsert(res):
+        for i, m in enumerate(results):
+            if m.get("_name") == res["_name"]:
+                results[i] = res
+                return
+        results.append(res)
+
+    measured = 0
     for name, argv, timeout in MEASUREMENTS:
         if only and name not in only:
             continue
@@ -146,7 +165,8 @@ def main() -> None:
                      "--repeats", "2", "--batches", "2"]
         print(f"--- {name}: bench.py {' '.join(argv)}", flush=True)
         res = run_one(name, argv, timeout)
-        results.append(res)
+        upsert(res)
+        measured += 1
         # bank after EVERY measurement — a wedge mid-suite keeps the rest
         out_path.write_text(json.dumps(results, indent=1) + "\n")
         err = res.get("error")
@@ -156,12 +176,13 @@ def main() -> None:
         if err and "unavailable" in str(err):
             print("tunnel lost mid-suite — stopping (results banked)")
             break
-    if not results:
+    if not measured:
         known = ", ".join(n for n, _, _ in MEASUREMENTS)
         print(f"nothing measured — no measurement matched {args.only!r} "
               f"(known: {known}); {out_path} NOT written")
         sys.exit(1)
-    print(f"wrote {out_path} ({len(results)} measurements)")
+    print(f"wrote {out_path} ({measured} measured this run, "
+          f"{len(results)} banked)")
 
 
 if __name__ == "__main__":
