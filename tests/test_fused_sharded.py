@@ -226,3 +226,27 @@ class TestBatchEventMeshGate:
         mesh = make_mesh(batch=8, event=1)
         resolved = _resolve_sharded_params(p, 1000, 4096, mesh)
         assert not resolved.fused_resolution
+
+
+class TestShardFusedFuzz:
+    @pytest.mark.parametrize("trial", range(4))
+    def test_random_shapes_and_storage(self, trial):
+        """Randomized parity sweep: shapes, NA fractions, storage dtypes,
+        reputation skews — outcomes must stay bit-identical to the
+        single-device fused path on every draw."""
+        rng = np.random.default_rng(100 + trial)
+        R_f = int(rng.integers(9, 40))
+        E_f = 8 * int(rng.integers(2, 12))       # divisible by the mesh
+        storage = rng.choice(["int8", "bfloat16", ""])
+        na = float(rng.uniform(0.0, 0.3))
+        reports, _ = collusion_reports(rng, R_f, E_f,
+                                       liars=max(2, R_f // 4), na_frac=na)
+        rep = rng.random(R_f) + 0.02
+        rep = rep / rep.sum()
+        p = base_params(storage_dtype=str(storage),
+                        max_iterations=int(rng.integers(1, 4)))
+        sharded, single = run_both(reports, rep, p)
+        np.testing.assert_array_equal(sharded["outcomes_adjusted"],
+                                      single["outcomes_adjusted"])
+        np.testing.assert_allclose(sharded["smooth_rep"],
+                                   single["smooth_rep"], atol=5e-6)
