@@ -23,8 +23,16 @@ kernels decode int8 sentinel storage in-register, so each pass streams
 shard (its outputs are per-column, hence shard-local).
 
 Scope (gate-enforced by ``sharded._use_fused_resolution``): sztorc,
-power-family PCA, binary events only (the scaled-column gather would
-cross shards), E divisible by the event-axis size.
+power-family PCA. Scaled events are handled the same way the
+single-device fused path handles them — a statically-counted gather of
+the scaled columns re-resolved with the exact sort-based weighted
+median — except the gather is SHARD-LOCAL: the event sharding puts every
+column wholly on one shard, so each shard re-resolves the scaled columns
+it owns and no value ever crosses the mesh (round-4, VERDICT r3 item 1).
+A non-divisible event count is closed by padding the matrix with
+present-everywhere constant-0.5 binary columns; every cross-column
+statistic masks the pad columns out exactly (Python-static masking — the
+divisible case compiles to the identical graph as before).
 """
 
 from __future__ import annotations
@@ -118,23 +126,45 @@ def _guard_div(vec, total):
                      vec / jnp.where(total == 0.0, 1.0, total))
 
 
-def _local_consensus(x_blk, rep, seed, base_unit, p: ConsensusParams,
-                     n_event: int, interpret: bool):
+def _local_consensus(x_blk, rep, seed, base_unit, bounds,
+                     p: ConsensusParams, n_event: int, n_valid: int,
+                     interpret: bool):
     """The per-shard body (runs under shard_map): mirrors
-    pipeline._consensus_core_fused with explicit cross-shard psums."""
+    pipeline._consensus_core_fused with explicit cross-shard psums.
+
+    ``bounds`` is ``None`` (all-binary) or the local ``(scaled, mins,
+    maxs)`` event-vector slices. ``n_valid`` is the REAL event count: when
+    the global (padded) width ``E_loc * n_event`` exceeds it, the trailing
+    pad columns (constant 0.5, all present) are masked out of every
+    cross-column statistic — exactly, because the masking zeroes their
+    contributions before any reduction rather than correcting after."""
     from ..ops.pallas_kernels import (resolve_certainty_fused,
                                       storage_matvec, storage_rows_matmat)
 
     R, E_loc = x_blk.shape
-    E_total = E_loc * n_event
     e_start = (lax.axis_index("event") * E_loc).astype(jnp.int32)
     old_rep = jk.normalize(rep)
     acc = old_rep.dtype
+    needs_pad = n_valid < E_loc * n_event          # Python-static
+    valid = ((e_start + jnp.arange(E_loc, dtype=jnp.int32)) < n_valid
+             if needs_pad else None)
 
+    raw_blk = x_blk
+    if p.any_scaled:
+        sc, mn, mx = bounds
+        x_blk = jk.rescale(x_blk, sc, mn, mx)      # NaN stays NaN
     x, fill, tw0, numer0 = _fill_stats(x_blk, old_rep, p.catch_tolerance,
-                                       p.storage_dtype, None)
+                                       p.storage_dtype,
+                                       sc if p.any_scaled else None)
     full0 = jnp.sum(old_rep)
     mu1 = numer0 + (full0 - tw0) * fill            # (E_loc,) local
+    # matvec_dtype: like sztorc_scores_power_fused, the power sweeps and
+    # the scores/direction-fix pass read a narrowed copy of the storage
+    # (int8 sentinel storage is already narrowest — a float cast would
+    # destroy the lattice); the back-half kernel reads full storage
+    xm = (x.astype(jnp.dtype(p.matvec_dtype))
+          if p.matvec_dtype and not jnp.issubdtype(x.dtype, jnp.integer)
+          else x)
 
     def scores_at(rep_k, mu_k, v_init=None):
         """sztorc_scores_power_fused, shard-aware: two kernel passes per
@@ -144,23 +174,34 @@ def _local_consensus(x_blk, rep, seed, base_unit, p: ConsensusParams,
         denom = jnp.where(denom == 0.0, 1.0, denom)
 
         def apply_cov(v_loc):
-            t_part = storage_matvec(x, v_loc, fill=fill,
+            if needs_pad:
+                # zeroing the iterate on pad columns keeps the whole
+                # power iteration EXACTLY blind to them: their t/muv
+                # contributions are 0 * x, and their output entries are
+                # re-zeroed so the invariant holds across sweeps
+                v_loc = jnp.where(valid, v_loc, 0.0)
+            t_part = storage_matvec(xm, v_loc, fill=fill,
                                     interpret=interpret).astype(acc)
             muv_part = mu_k @ v_loc
             t, muv = _psum((t_part, muv_part))
             rt = rep_k * (t - muv)                 # (R,) replicated
-            y = storage_rows_matmat(x, rt[None, :], fill=fill,
+            y = storage_rows_matmat(xm, rt[None, :], fill=fill,
                                     interpret=interpret)[0].astype(acc)
-            return (y - mu_k * jnp.sum(rt)) / denom
+            y = (y - mu_k * jnp.sum(rt)) / denom
+            return jnp.where(valid, y, 0.0) if needs_pad else y
 
         loading = _sharded_power(apply_cov, seed, base_unit,
                                  p.power_iters, p.power_tol, v_init=v_init)
-        t_part = storage_matvec(x, loading, fill=fill,
+        if needs_pad:
+            # the degenerate all-zero-covariance branch of _sharded_power
+            # falls back to base_unit, which is nonzero on pad columns
+            loading = jnp.where(valid, loading, 0.0)
+        t_part = storage_matvec(xm, loading, fill=fill,
                                 interpret=interpret).astype(acc)
         ml_part = mu_k @ loading
         t_raw, ml = _psum((t_part, ml_part))
         W = jnp.stack([t_raw, rep_k.astype(acc), jnp.ones_like(rep_k, acc)])
-        qco = storage_rows_matmat(x, W, fill=fill,
+        qco = storage_rows_matmat(xm, W, fill=fill,
                                   interpret=interpret).astype(acc)
         q, o, c = qco[0], qco[1], qco[2]
         scores = t_raw - ml                        # (R,) replicated
@@ -174,7 +215,10 @@ def _local_consensus(x_blk, rep, seed, base_unit, p: ConsensusParams,
         s2_tot = sum_s - R * a2
         new1 = _guard_div(qs + a1 * c, s1_tot)
         new2 = _guard_div(qs - a2 * c, s2_tot)
-        ref_ind = _psum(jnp.sum((new1 - o) ** 2) - jnp.sum((new2 - o) ** 2))
+        d = (new1 - o) ** 2 - (new2 - o) ** 2
+        if needs_pad:
+            d = jnp.where(valid, d, 0.0)
+        ref_ind = _psum(jnp.sum(d))
         return jnp.where(ref_ind <= 0.0, set1, -set2), loading
 
     if p.max_iterations <= 1:
@@ -208,17 +252,73 @@ def _local_consensus(x_blk, rep, seed, base_unit, p: ConsensusParams,
         resolve_certainty_fused(x, rep_f, fill, jnp.sum(rep_f),
                                 float(p.catch_tolerance),
                                 interpret=interpret))
+    if p.n_scaled:
+        # same barrier as the single-device path: keep the scatter updates
+        # below from being fused into the kernel's output buffers (that
+        # fusion pins (1, E) outputs into scoped VMEM and blows the
+        # kernel's budget at scale — pipeline._consensus_core_fused)
+        raw, adjusted, certainty, pcol, prow_part, narow_part = (
+            lax.optimization_barrier(
+                (raw, adjusted, certainty, pcol, prow_part, narow_part)))
     raw = raw.astype(acc)
     adjusted = adjusted.astype(acc)
     certainty = certainty.astype(acc)
-    prow, narow = _psum((prow_part.astype(acc), narow_part))
+    prow_part = prow_part.astype(acc)
+    outcomes_final = adjusted                      # binary: no rescale
+    if p.n_scaled:
+        # scaled columns, shard-locally: the event sharding places every
+        # column wholly on one shard, so each shard gathers the scaled
+        # columns IT owns and re-resolves them with the exact sort-based
+        # weighted median (pipeline._consensus_core_fused semantics; no
+        # cross-shard value motion). The static gather capacity is the
+        # global count clipped to the shard width; slots beyond this
+        # shard's actual scaled count point at E_loc and are dropped by
+        # the out-of-bounds scatter mode.
+        cap = min(p.n_scaled, E_loc)
+        idx = jnp.nonzero(sc, size=cap, fill_value=E_loc)[0]
+        mvalid = idx < E_loc
+        safe = jnp.clip(idx, 0, E_loc - 1)
+        # gather RAW columns and redo the rescale on the slice (not the
+        # rescaled intermediate: a second consumer flips XLA's buffering
+        # for the kernel operand — see the single-device path's note)
+        xs = jk.rescale(raw_blk[:, safe], sc[safe], mn[safe], mx[safe])
+        if p.storage_dtype:
+            xs = xs.astype(jnp.dtype(p.storage_dtype))  # XLA-path rounding
+        xs = xs.astype(acc)
+        pres = ~jnp.isnan(xs)
+        filled_s = jnp.where(pres, xs, fill[safe].astype(acc)[None, :])
+        med = jk.weighted_median_cols(
+            filled_s, jnp.broadcast_to(rep_f[:, None], filled_s.shape),
+            pres)
+        tw_s = jnp.sum(jnp.where(pres, rep_f[:, None], 0.0), axis=0)
+        out_s = jnp.where(tw_s > 0.0, med, raw[safe])
+        agree_s = jnp.abs(filled_s - out_s[None, :]) <= p.catch_tolerance
+        cert_s = jnp.sum(agree_s * rep_f[:, None], axis=0)
+        # prow used the kernel's binary certainty for these columns; the
+        # correction is shard-local, so apply it BEFORE the psum below
+        # (garbage slots contribute an exactly-zero delta)
+        delta_cert = jnp.where(mvalid, cert_s - certainty[safe], 0.0)
+        prow_part = prow_part + (~pres).astype(acc) @ delta_cert
+        certainty = certainty.at[idx].set(cert_s, mode="drop")
+        raw = raw.at[idx].set(out_s, mode="drop")
+        adjusted = adjusted.at[idx].set(out_s, mode="drop")  # no catch snap
+        outcomes_final = adjusted.at[idx].set(
+            out_s * (mx[safe] - mn[safe]) + mn[safe], mode="drop")
+    if needs_pad:
+        # pad columns: all-present constant 0.5, so the kernel reports
+        # them fully certain and fully participating (and contributes
+        # nothing to prow/narow — they hold no NaN); zero both before any
+        # cross-column reduction
+        certainty = jnp.where(valid, certainty, 0.0)
+        pcol = jnp.where(valid, pcol, 1.0)
+    prow, narow = _psum((prow_part, narow_part))
 
     participation_columns = (1.0 - pcol).astype(acc)
     cert_sum = _psum(jnp.sum(certainty))
     consensus_reward = _guard_div(certainty, cert_sum)
     participation_rows = 1.0 - _guard_div(prow, cert_sum)
     pc_sum = _psum(jnp.sum(participation_columns))
-    percent_na = 1.0 - pc_sum / E_total
+    percent_na = 1.0 - pc_sum / n_valid
     na_bonus_rows = jk.normalize(participation_rows)
     reporter_bonus = (na_bonus_rows * percent_na
                       + rep_f * (1.0 - percent_na))
@@ -232,13 +332,13 @@ def _local_consensus(x_blk, rep, seed, base_unit, p: ConsensusParams,
         "na_row": narow > 0.0,
         "outcomes_raw": raw,
         "outcomes_adjusted": adjusted,
-        "outcomes_final": adjusted,            # binary: no rescale
+        "outcomes_final": outcomes_final,
         "iterations": iters,
         "convergence": converged,
         "first_loading": _canon_sign_sharded(loading, e_start, E_loc),
         "certainty": certainty,
         "consensus_reward": consensus_reward,
-        "avg_certainty": cert_sum / E_total,
+        "avg_certainty": cert_sum / n_valid,
         "participation_columns": participation_columns,
         "participation_rows": participation_rows,
         "percent_na": percent_na,
@@ -259,22 +359,30 @@ _EVENT_KEYS = frozenset([
 
 
 @functools.lru_cache(maxsize=16)
-def _seed_placed(mesh: Mesh, E: int, dtype_name: str):
+def _seed_placed(mesh: Mesh, E: int, pad: int, dtype_name: str):
     """Device-resident event-sharded power seed + unit base direction,
-    cached per (mesh, E, dtype): these are constants, and per-call
+    cached per (mesh, E, pad, dtype): these are constants, and per-call
     placement of (E,)-vectors costs ~70-100 ms through the tunneled-TPU
     link at E=100k (see sharded._default_bounds_placed — same
-    rationale)."""
+    rationale). The seed is ``_power_seed(E)`` — the SAME draw the
+    single-device path uses — zero-extended over the pad columns, so the
+    padded path's cold start is bitwise the unpadded start (and the
+    degenerate-covariance fallback direction is already pad-masked)."""
     dtype = jnp.dtype(dtype_name)
     e_shard = NamedSharding(mesh, P("event"))
-    seed = jax.device_put(jk._power_seed(E, dtype), e_shard)
+    seed = jk._power_seed(E, dtype)
+    if pad:
+        seed = jnp.concatenate([seed, jnp.zeros((pad,), dtype)])
+    seed = jax.device_put(seed, e_shard)
     base_unit = jax.device_put(seed / jnp.linalg.norm(seed), e_shard)
     return seed, base_unit
 
 
 @functools.lru_cache(maxsize=32)
-def _build(mesh: Mesh, p: ConsensusParams, interpret: bool):
-    """One jitted shard-mapped executable per (mesh, params, mode)."""
+def _build(mesh: Mesh, p: ConsensusParams, interpret: bool, n_valid: int,
+           with_bounds: bool):
+    """One jitted shard-mapped executable per (mesh, params, mode, real
+    event count, bounds arity)."""
     n_event = mesh.shape["event"]
     out_specs = {k: (P("event") if k in _EVENT_KEYS else P())
                  for k in [
@@ -285,11 +393,21 @@ def _build(mesh: Mesh, p: ConsensusParams, interpret: bool):
                      "participation_columns", "participation_rows",
                      "percent_na", "na_bonus_rows", "reporter_bonus",
                      "na_bonus_cols", "author_bonus"]}
+    kw = dict(p=p, n_event=n_event, n_valid=n_valid, interpret=interpret)
+    if with_bounds:
+        def body(x_blk, rep, seed, base_unit, sc, mn, mx):
+            return _local_consensus(x_blk, rep, seed, base_unit,
+                                    (sc, mn, mx), **kw)
+        in_specs = (P(None, "event"), P(), P("event"), P("event"),
+                    P("event"), P("event"), P("event"))
+    else:
+        def body(x_blk, rep, seed, base_unit):
+            return _local_consensus(x_blk, rep, seed, base_unit, None, **kw)
+        in_specs = (P(None, "event"), P(), P("event"), P("event"))
     fn = jax.shard_map(
-        functools.partial(_local_consensus, p=p, n_event=n_event,
-                          interpret=interpret),
+        body,
         mesh=mesh,
-        in_specs=(P(None, "event"), P(), P("event"), P("event")),
+        in_specs=in_specs,
         out_specs=out_specs,
         # replication of the P() outputs is established by explicit psums;
         # shard_map's static rep-checker cannot see through the Pallas
@@ -300,23 +418,75 @@ def _build(mesh: Mesh, p: ConsensusParams, interpret: bool):
 
 
 def fused_sharded_consensus(reports, reputation, mesh: Mesh,
-                            p: ConsensusParams):
-    """Resolve one large all-binary oracle with the events axis sharded
-    over ``mesh`` ON THE FUSED KERNEL PATH (see module docstring).
+                            p: ConsensusParams, scaled=None, mins=None,
+                            maxs=None):
+    """Resolve one large oracle with the events axis sharded over ``mesh``
+    ON THE FUSED KERNEL PATH (see module docstring).
 
-    ``reports``/``reputation`` must already be placed
+    ``reports``/``reputation`` (and, for scaled workloads, the
+    ``scaled``/``mins``/``maxs`` event vectors) must already be placed
     (event-sharded / replicated) by the caller (``sharded_consensus``
     routes here after placement). Returns the light result dict, outputs
-    left on device (event vectors sharded)."""
+    left on device (event vectors sharded). A non-divisible event count
+    costs one padded copy of the matrix (masked exactly — see
+    ``_local_consensus``)."""
+    if p.algorithm != "sztorc":
+        raise ValueError(
+            f"the sharded fused path scores with sztorc power iteration "
+            f"only; algorithm={p.algorithm!r} must route through "
+            f"sharded_consensus (which gates on this) instead")
+    if p.pca_method not in ("power", "power-fused"):
+        raise ValueError(
+            f"the sharded fused path requires a power-family pca_method, "
+            f"got {p.pca_method!r} — an exact-eigh request must not be "
+            f"silently swapped for power iteration (use sharded_consensus)")
+    if p.storage_dtype == "int8" and p.any_scaled:
+        raise ValueError(
+            "storage_dtype='int8' supports binary/categorical events only: "
+            "scaled columns rescale to continuous values in [0, 1] that "
+            "the half-unit int8 lattice would corrupt — use "
+            "storage_dtype='bfloat16' for scaled workloads")
     if p.any_scaled:
-        raise ValueError("the sharded fused path is binary-only: scaled "
-                         "columns need a cross-shard gather — use the XLA "
-                         "path (allow_fused=False or pca_method='power')")
+        if scaled is None or mins is None or maxs is None:
+            raise ValueError(
+                "any_scaled=True needs the placed (scaled, mins, maxs) "
+                "event vectors — sharded_consensus passes them through")
+        if p.n_scaled <= 0:
+            raise ValueError(
+                "any_scaled=True needs the static scaled-column count in "
+                "params.n_scaled (sharded_consensus sets it from the "
+                "bounds)")
     R, E = reports.shape
     n_event = mesh.shape["event"]
-    if E % n_event != 0:
-        raise ValueError(f"E={E} not divisible by event axis {n_event}")
+    pad = (-E) % n_event
     interpret = jax.default_backend() != "tpu"
     acc = jnp.asarray(0.0).dtype
-    seed, base_unit = _seed_placed(mesh, E, acc.name)
-    return _build(mesh, p, interpret)(reports, reputation, seed, base_unit)
+    if pad:
+        from .mesh import event_sharding
+
+        e_shard = NamedSharding(mesh, P("event"))
+        reports = jax.device_put(
+            jnp.concatenate(
+                [reports, jnp.full((R, pad), 0.5, reports.dtype)], axis=1),
+            event_sharding(mesh))
+        if p.any_scaled:
+            scaled = jax.device_put(
+                jnp.concatenate([scaled, jnp.zeros((pad,), scaled.dtype)]),
+                e_shard)
+            mins = jax.device_put(
+                jnp.concatenate([mins, jnp.zeros((pad,), mins.dtype)]),
+                e_shard)
+            maxs = jax.device_put(
+                jnp.concatenate([maxs, jnp.ones((pad,), maxs.dtype)]),
+                e_shard)
+    seed, base_unit = _seed_placed(mesh, E, pad, acc.name)
+    if p.any_scaled:
+        out = _build(mesh, p, interpret, E, True)(
+            reports, reputation, seed, base_unit, scaled, mins, maxs)
+    else:
+        out = _build(mesh, p, interpret, E, False)(
+            reports, reputation, seed, base_unit)
+    if pad:
+        out = {k: (v[:E] if k in _EVENT_KEYS else v)
+               for k, v in out.items()}
+    return out

@@ -114,6 +114,15 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
     and the XLA path's static scaled count. Shared by
     :func:`sharded_consensus` and :class:`ShardedOracle` so the two
     front-ends cannot drift."""
+    if p.storage_dtype == "int8" and p.any_scaled:
+        # raise at resolve time, not first-call time, and identically for
+        # every front-end (the pipeline and the mesh fused path repeat
+        # the same check defensively)
+        raise ValueError(
+            "storage_dtype='int8' supports binary/categorical events "
+            "only: scaled columns rescale to continuous values in [0, 1] "
+            "that the half-unit int8 lattice would corrupt — use "
+            "storage_dtype='bfloat16' for scaled workloads")
     p = p._replace(
         pca_method=_pick_pca_method(p, R, E, mesh.devices.size),
         median_block=effective_median_block(p.median_block, mesh))
@@ -132,9 +141,8 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
         raise ValueError(
             "storage_dtype='int8' requires the fused kernel path (real "
             "TPU backend, algorithm='sztorc', power-family pca_method, "
-            "binary events, VMEM-fitting shape; on an event-sharded mesh "
-            "additionally E divisible by the event axis and no scaled "
-            "events at all) — this configuration resolved to the XLA "
+            "VMEM-fitting shape, scaled events at most a small static "
+            "minority) — this configuration resolved to the XLA "
             f"path (mesh devices={mesh.devices.size}, event axis="
             f"{mesh.shape.get('event', 1)}, algorithm={p.algorithm!r}, "
             f"pca_method={p.pca_method!r}); use storage_dtype='bfloat16'")
@@ -159,10 +167,12 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     rides on, so it takes the XLA path).
 
     Multi-device meshes route to the shard_map fused path
-    (``parallel.fused_sharded``) since round 3 — there the per-shard
-    VMEM fit is checked at the E/n_devices shard width, events must
-    divide evenly over the axis, and scaled events are excluded outright
-    (the gather-and-fix would cross shards).
+    (``parallel.fused_sharded``) since round 3. Since round 4 that path
+    serves the same scope as the single-device gate: scaled events as a
+    statically-counted minority (the gather-and-fix is SHARD-LOCAL —
+    event sharding puts every column wholly on one shard) and any event
+    count (a non-divisible E is padded with masked constant columns; the
+    per-shard VMEM fit is checked at the padded shard width).
 
     A reporter count with no tileable row-chunk divisor (e.g. a prime R)
     is handled inside resolve_certainty_fused by zero-rep row padding, so
@@ -187,15 +197,9 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
         # under a multi-device GSPMD jit is untested replication — stay
         # on the XLA path
         return False
-    if n_event_shards > 1:
-        scaled_ok = not params.any_scaled
-        if n_events % n_event_shards != 0:
-            return False
-        e_local = n_events // n_event_shards
-    else:
-        scaled_ok = (not params.any_scaled
-                     or 0 < params.n_scaled <= n_events // 8)
-        e_local = n_events
+    scaled_ok = (not params.any_scaled
+                 or 0 < params.n_scaled <= n_events // 8)
+    e_local = -(-n_events // n_event_shards)   # ceil: the padded width
     # the same next-multiple-of-8 the kernel pads to (a no-op for
     # already-tileable counts)
     r_padded = n_reporters + (-n_reporters) % 8
@@ -208,8 +212,15 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
             and resolve_kernel_fits(r_padded, itemsize))
 
 
-def resolve_params(p: ConsensusParams, R: int, E: int,
-                   mesh: Mesh) -> ConsensusParams:
+#: "no event_bounds argument given" sentinel for resolve_params: an
+#: explicit None means all-binary (like sharded_consensus), while an
+#: omitted argument must keep trusting the caller's pre-set
+#: any_scaled/n_scaled fields (bench.py and the tests pre-resolve them)
+_BOUNDS_UNSET = object()
+
+
+def resolve_params(p: ConsensusParams, R: int, E: int, mesh: Mesh,
+                   event_bounds=_BOUNDS_UNSET) -> ConsensusParams:
     """Public view of the sharded parameter resolution: the exact
     ConsensusParams ``sharded_consensus`` will execute with for this
     (params, shape, mesh) — resolved PCA method, median blocking, the
@@ -217,7 +228,28 @@ def resolve_params(p: ConsensusParams, R: int, E: int,
     logs this on every run so a driver-side failure is diagnosable from
     stderr (BENCH_r02 recorded a Mosaic compile error with no record of
     which path the gates had picked). Raises exactly when
-    ``sharded_consensus`` would (e.g. int8 off the fused path)."""
+    ``sharded_consensus`` would (e.g. int8 off the fused path).
+
+    Pass the same ``event_bounds`` you will pass ``sharded_consensus``
+    (a reference-style list, a :class:`PlacedBounds`, or an explicit None
+    for all-binary) and the bounds-driven ``any_scaled``/``n_scaled``
+    rewrite it performs first is applied here too — without it, a default
+    params object (``any_scaled=True``) resolves pessimistically while
+    the real call would open the fused gate. When the argument is
+    OMITTED, the caller's pre-set ``any_scaled``/``n_scaled`` fields are
+    trusted as-is (the pre-round-4 contract — bench.py pre-resolves
+    them). ``has_na`` is never rewritten (it needs the reports matrix):
+    pre-set it like ``sharded_consensus`` does from the host matrix if
+    the distinction matters."""
+    if event_bounds is None:
+        p = p._replace(any_scaled=False, n_scaled=0)
+    elif isinstance(event_bounds, PlacedBounds):
+        p = p._replace(any_scaled=event_bounds.any_scaled,
+                       n_scaled=event_bounds.n_scaled)
+    elif event_bounds is not _BOUNDS_UNSET:
+        scaled, _, _ = parse_event_bounds(event_bounds, E)
+        p = p._replace(any_scaled=bool(scaled.any()),
+                       n_scaled=int(scaled.sum()))
     return _resolve_sharded_params(p, R, E, mesh)
 
 
@@ -231,7 +263,7 @@ def resolve_auto_storage(p: ConsensusParams, R: int, E: int,
     - **int8** sentinel storage exactly when the int8-parameterized
       pipeline resolves onto the fused kernel path (real TPU backend,
       sztorc, power-family PCA after resolution, VMEM-fitting shape —
-      single device OR an event-sharded mesh with divisible E, via
+      single device OR an event-sharded mesh, any event count, via
       parallel.fused_sharded) AND the workload is all-binary — the
       half-unit int8 lattice is exact there and quarters the f32 HBM
       traffic;
@@ -286,8 +318,7 @@ def place_event_bounds(event_bounds, n_events: int,
     mesh = mesh if mesh is not None else make_mesh(batch=1)
     scaled, mins, maxs = parse_event_bounds(event_bounds, n_events)
     dtype = jnp.asarray(0.0).dtype
-    e_shard = jax.sharding.NamedSharding(mesh,
-                                         jax.sharding.PartitionSpec("event"))
+    _, e_shard = _input_shardings(mesh, n_events)
     return PlacedBounds(
         jax.device_put(jnp.asarray(scaled, dtype=bool), e_shard),
         jax.device_put(jnp.asarray(mins, dtype=dtype), e_shard),
@@ -302,8 +333,7 @@ def _default_bounds_placed(mesh: Mesh, E: int):
     host->device uploads or extra dispatches on every call."""
     jnp = jax.numpy
     dtype = jnp.asarray(0.0).dtype
-    e_shard = jax.sharding.NamedSharding(mesh,
-                                         jax.sharding.PartitionSpec("event"))
+    _, e_shard = _input_shardings(mesh, E)
     scaled = jax.device_put(jnp.zeros((E,), dtype=bool), e_shard)
     mins = jax.device_put(jnp.zeros((E,), dtype=dtype), e_shard)
     maxs = jax.device_put(jnp.ones((E,), dtype=dtype), e_shard)
@@ -316,6 +346,21 @@ def _default_reputation_placed(mesh: Mesh, R: int):
     jnp = jax.numpy
     return jax.device_put(jnp.full((R,), 1.0 / R, dtype=jnp.asarray(0.0).dtype),
                           replicated(mesh))
+
+
+def _input_shardings(mesh: Mesh, E: int):
+    """Placement shardings for the (R, E) matrix and the E-vectors:
+    event-sharded when the event axis divides E; replicated otherwise
+    (``device_put`` cannot express an uneven named sharding — JAX
+    verified round 4). On the replicated fallback the jit programs still
+    run correctly on the mesh (XLA picks intermediate shardings); the
+    fused mesh path instead pads the matrix to a divisible width and
+    re-places it event-sharded, masking the pad columns exactly."""
+    n_event = mesh.shape.get("event", 1)
+    if E % n_event == 0:
+        return event_sharding(mesh), jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("event"))
+    return replicated(mesh), replicated(mesh)
 
 
 def _maybe_place(arr, shard, dtype):
@@ -343,12 +388,11 @@ def _maybe_place_reports(reports, x_shard, dtype):
 def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
     """device_put the pipeline inputs with the event axis sharded: the
     (R, E) matrix and all E-vectors split over "event", the O(R) reputation
-    replicated. Already-placed inputs are passed through untouched."""
+    replicated. Already-placed inputs are passed through untouched. A
+    non-divisible event count places replicated (``_input_shardings``)."""
     jnp = jax.numpy
     dtype = jnp.asarray(0.0).dtype
-    x_shard = event_sharding(mesh)
-    e_shard = jax.sharding.NamedSharding(mesh,
-                                         jax.sharding.PartitionSpec("event"))
+    x_shard, e_shard = _input_shardings(mesh, reports.shape[1])
     r_shard = replicated(mesh)
     return (_maybe_place(reports, x_shard, dtype),
             _maybe_place(reputation, r_shard, dtype),
@@ -421,7 +465,12 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
 
         if reputation is None:
             reputation = _default_reputation_placed(mesh, R)
-        reports = _maybe_place_reports(reports, event_sharding(mesh),
+        if p.any_scaled:
+            placed = _place_inputs(mesh, reports, reputation, scaled,
+                                   mins, maxs)
+            return fused_sharded_consensus(placed[0], placed[1], mesh, p,
+                                           *placed[2:])
+        reports = _maybe_place_reports(reports, _input_shardings(mesh, E)[0],
                                        jax.numpy.asarray(0.0).dtype)
         reputation = _maybe_place(reputation, replicated(mesh),
                                   jax.numpy.asarray(0.0).dtype)
@@ -432,7 +481,8 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
             # everything but the matrix is already placed; skip the
             # per-call device_put round entirely (and the matrix's too when
             # it is already resident with the target sharding)
-            reports = _maybe_place_reports(reports, event_sharding(mesh),
+            reports = _maybe_place_reports(reports,
+                                           _input_shardings(mesh, E)[0],
                                            jax.numpy.asarray(0.0).dtype)
             return consensus_light_jit(reports, reputation, scaled,
                                        mins, maxs, p)
@@ -479,6 +529,10 @@ class ShardedOracle(Oracle):
                 and self.mesh.shape.get("event", 1) > 1):
             from .fused_sharded import fused_sharded_consensus
 
+            if self.params.any_scaled:
+                return fused_sharded_consensus(placed[0], placed[1],
+                                               self.mesh, self.params,
+                                               *placed[2:])
             return fused_sharded_consensus(placed[0], placed[1], self.mesh,
                                            self.params)
         return consensus_light_jit(*placed, self.params)

@@ -88,6 +88,20 @@ class TestShardFusedParity:
         assert sharded["percent_na"] == pytest.approx(0.0, abs=1e-12)
         assert not sharded["na_row"].any()
 
+    def test_matvec_dtype_honored(self, rng):
+        """ADVICE r3: the mesh path must apply ConsensusParams.matvec_dtype
+        like the single-device fused path (narrowed power/scores passes),
+        not silently run full-width."""
+        reports, _ = collusion_reports(rng, R, E, liars=5, na_frac=0.1)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(storage_dtype="", matvec_dtype="bfloat16")
+        sharded, single = run_both(reports, rep, p)
+        np.testing.assert_array_equal(sharded["outcomes_adjusted"],
+                                      single["outcomes_adjusted"])
+        # bf16 matvecs: looser than the f32/f64 parity elsewhere
+        np.testing.assert_allclose(sharded["smooth_rep"],
+                                   single["smooth_rep"], atol=5e-3)
+
     def test_nonuniform_reputation(self, rng):
         reports, _ = collusion_reports(rng, R, E, liars=5, na_frac=0.1)
         rep = rng.random(R) + 0.05
@@ -130,25 +144,198 @@ class TestShardFusedParity:
             np.asarray(single["outcomes_adjusted"]))
 
 
+def scaled_fixture(rng, n_events, scaled_cols, na_frac=0.1):
+    """Mixed binary + scaled reports with bounds vectors: the named
+    columns carry continuous values in [-5, 15]."""
+    reports, _ = collusion_reports(rng, R, n_events, liars=5,
+                                   na_frac=na_frac)
+    scaled = np.zeros(n_events, dtype=bool)
+    scaled[scaled_cols] = True
+    mins = np.where(scaled, -5.0, 0.0)
+    maxs = np.where(scaled, 15.0, 1.0)
+    with np.errstate(invalid="ignore"):
+        reports[:, scaled] = reports[:, scaled] * 20.0 - 5.0
+    return reports, scaled, mins, maxs
+
+
+def run_both_scaled(reports, rep, p, scaled, mins, maxs, n_event=8):
+    mesh = make_mesh(batch=1, event=n_event)
+    placed = _place_inputs(mesh, reports, rep, scaled, mins, maxs)
+    sharded = fused_sharded_consensus(placed[0], placed[1], mesh, p,
+                                      *placed[2:])
+    single = _consensus_core_fused(
+        jnp.asarray(reports), jnp.asarray(rep), jnp.asarray(scaled),
+        jnp.asarray(mins), jnp.asarray(maxs), p)
+    return ({k: np.asarray(v) for k, v in sharded.items()},
+            {k: np.asarray(v) for k, v in single.items()})
+
+
+def assert_scaled_parity(sharded, single, scaled, atol=5e-6):
+    binary = ~scaled
+    # binary outcomes are catch-snapped -> exact; outcomes_raw (pre-snap
+    # weighted means) and scaled medians carry reduction-order float noise
+    for key in ("outcomes_adjusted", "outcomes_final"):
+        np.testing.assert_array_equal(sharded[key][binary],
+                                      single[key][binary], err_msg=key)
+    for key in ("outcomes_raw", "outcomes_adjusted", "outcomes_final"):
+        np.testing.assert_allclose(sharded[key][scaled],
+                                   single[key][scaled], atol=atol,
+                                   err_msg=key)
+    np.testing.assert_allclose(sharded["outcomes_raw"], single["outcomes_raw"],
+                               atol=atol)
+    np.testing.assert_array_equal(sharded["na_row"], single["na_row"])
+    for key in ("this_rep", "smooth_rep", "certainty",
+                "participation_rows", "participation_columns",
+                "reporter_bonus", "author_bonus", "consensus_reward",
+                "percent_na", "avg_certainty"):
+        np.testing.assert_allclose(sharded[key], single[key], atol=atol,
+                                   err_msg=key)
+
+
+class TestShardFusedScaled:
+    """Round-4 gate opening (VERDICT r3 item 1): scaled columns on the
+    mesh fused path, re-resolved shard-locally — parity against the
+    single-device fused path's gather-median."""
+
+    @pytest.mark.parametrize("storage", ["bfloat16", ""])
+    def test_scaled_spread_across_shards(self, rng, storage):
+        cols = [5, 20, 37, 50, 63]          # one per several shards
+        reports, scaled, mins, maxs = scaled_fixture(rng, E, cols)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(any_scaled=True, n_scaled=len(cols),
+                        storage_dtype=storage)
+        sharded, single = run_both_scaled(reports, rep, p, scaled, mins,
+                                          maxs)
+        assert_scaled_parity(sharded, single, scaled)
+
+    def test_scaled_clustered_on_one_shard(self, rng):
+        """All scaled columns on shard 0: the other shards' static gather
+        capacity exceeds their (zero) scaled count — garbage slots must
+        contribute nothing anywhere."""
+        cols = [0, 1, 2, 3]
+        reports, scaled, mins, maxs = scaled_fixture(rng, E, cols)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(any_scaled=True, n_scaled=len(cols),
+                        storage_dtype="bfloat16")
+        sharded, single = run_both_scaled(reports, rep, p, scaled, mins,
+                                          maxs)
+        assert_scaled_parity(sharded, single, scaled)
+
+    def test_scaled_iterative(self, rng):
+        cols = [7, 33, 59]
+        reports, scaled, mins, maxs = scaled_fixture(rng, E, cols)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(any_scaled=True, n_scaled=len(cols),
+                        max_iterations=4, storage_dtype="")
+        sharded, single = run_both_scaled(reports, rep, p, scaled, mins,
+                                          maxs)
+        assert sharded["iterations"] == single["iterations"]
+        assert_scaled_parity(sharded, single, scaled)
+
+
+class TestShardFusedPadding:
+    """Round-4 gate opening: non-divisible event counts served by masked
+    padding — parity against the (unpadded) single-device fused path."""
+
+    @pytest.mark.parametrize("storage", ["int8", "bfloat16", ""])
+    @pytest.mark.parametrize("n_events", [60, 41])
+    def test_nondivisible_binary(self, rng, storage, n_events):
+        # E=41 on the 8-way mesh leaves the last shard ENTIRELY padding
+        reports, _ = collusion_reports(rng, R, n_events, liars=5,
+                                       na_frac=0.15)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(storage_dtype=storage)
+        sharded, single = run_both(reports, rep, p)
+        np.testing.assert_array_equal(sharded["outcomes_adjusted"],
+                                      single["outcomes_adjusted"])
+        np.testing.assert_array_equal(sharded["na_row"], single["na_row"])
+        assert sharded["outcomes_final"].shape == (n_events,)
+        assert sharded["certainty"].shape == (n_events,)
+        for key in ("this_rep", "smooth_rep", "certainty",
+                    "participation_rows", "participation_columns",
+                    "reporter_bonus", "author_bonus", "consensus_reward",
+                    "percent_na", "avg_certainty"):
+            np.testing.assert_allclose(sharded[key], single[key],
+                                       atol=5e-6, err_msg=key)
+
+    def test_nondivisible_iterative(self, rng):
+        reports, _ = collusion_reports(rng, R, 60, liars=5, na_frac=0.1)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(storage_dtype="int8", max_iterations=5)
+        sharded, single = run_both(reports, rep, p)
+        np.testing.assert_array_equal(sharded["outcomes_adjusted"],
+                                      single["outcomes_adjusted"])
+        assert sharded["iterations"] == single["iterations"]
+        np.testing.assert_allclose(sharded["smooth_rep"],
+                                   single["smooth_rep"], atol=5e-6)
+
+    def test_nondivisible_with_scaled(self, rng):
+        """Both gates at once: E=61 (pad 3) with scaled columns,
+        including one in the ragged tail region."""
+        cols = [4, 31, 58]
+        reports, scaled, mins, maxs = scaled_fixture(rng, 61, cols)
+        rep = np.full(R, 1.0 / R)
+        p = base_params(any_scaled=True, n_scaled=len(cols),
+                        storage_dtype="bfloat16")
+        sharded, single = run_both_scaled(reports, rep, p, scaled, mins,
+                                          maxs)
+        assert_scaled_parity(sharded, single, scaled)
+
+
+class TestUnevenPlacement:
+    def test_place_event_bounds_nondivisible(self):
+        """place_event_bounds must survive event counts the mesh cannot
+        divide (replicated fallback, like _place_inputs) — code-review r4
+        found the raw P('event') placement crashing here."""
+        from pyconsensus_tpu.parallel import (make_mesh,
+                                              place_event_bounds,
+                                              sharded_consensus)
+
+        mesh = make_mesh(batch=1, event=8)
+        bounds = [None] * 59 + [{"scaled": True, "min": 0.0, "max": 10.0}] * 2
+        placed = place_event_bounds(bounds, 61, mesh)
+        assert placed.n_scaled == 2 and placed.any_scaled
+        rng = np.random.default_rng(3)
+        reports = rng.choice([0.0, 1.0], size=(16, 61))
+        reports[:, 59:] = rng.random((16, 2)) * 10.0
+        out = sharded_consensus(reports, event_bounds=placed, mesh=mesh)
+        assert np.asarray(out["outcomes_final"]).shape == (61,)
+
+
 class TestShardFusedGates:
-    def test_scaled_rejected(self, rng):
+    def test_scaled_without_bounds_rejected(self, rng):
         reports, _ = collusion_reports(rng, R, E, liars=5)
         mesh = make_mesh(batch=1, event=8)
         placed = _place_inputs(mesh, reports, np.full(R, 1.0 / R),
                                np.zeros(E, bool), np.zeros(E), np.ones(E))
-        with pytest.raises(ValueError, match="binary-only"):
+        with pytest.raises(ValueError, match="event vectors"):
             fused_sharded_consensus(placed[0], placed[1], mesh,
                                     base_params(any_scaled=True, n_scaled=2))
 
-    def test_indivisible_events_rejected(self, rng):
-        # raw (unplaced) arrays: the divisibility check fires before any
-        # placement — placing an uneven shape would already fail in jax
-        reports, _ = collusion_reports(rng, R, 60, liars=5)
+    def test_wrong_algorithm_rejected(self, rng):
+        """Direct callers passing non-sztorc params must fail loudly, not
+        silently get sztorc results (ADVICE r3)."""
+        reports, _ = collusion_reports(rng, R, E, liars=5)
         mesh = make_mesh(batch=1, event=8)
-        with pytest.raises(ValueError, match="divisible"):
-            fused_sharded_consensus(jnp.asarray(reports),
-                                    jnp.full((R,), 1.0 / R), mesh,
-                                    base_params())
+        placed = _place_inputs(mesh, reports, np.full(R, 1.0 / R),
+                               np.zeros(E, bool), np.zeros(E), np.ones(E))
+        with pytest.raises(ValueError, match="sztorc"):
+            fused_sharded_consensus(placed[0], placed[1], mesh,
+                                    base_params(algorithm="ica"))
+        with pytest.raises(ValueError, match="power-family"):
+            fused_sharded_consensus(placed[0], placed[1], mesh,
+                                    base_params(pca_method="eigh-gram"))
+
+    def test_int8_scaled_rejected(self, rng):
+        reports, _ = collusion_reports(rng, R, E, liars=5)
+        mesh = make_mesh(batch=1, event=8)
+        placed = _place_inputs(mesh, reports, np.full(R, 1.0 / R),
+                               np.zeros(E, bool), np.zeros(E), np.ones(E))
+        with pytest.raises(ValueError, match="int8"):
+            fused_sharded_consensus(
+                placed[0], placed[1], mesh,
+                base_params(any_scaled=True, n_scaled=2,
+                            storage_dtype="int8"))
 
     def test_resolver_closes_gate_off_tpu(self):
         """On the CPU test platform the fused gate stays closed (backend
@@ -163,9 +350,10 @@ class TestShardFusedGates:
         assert p.pca_method == "power"
 
     def test_gate_conditions_for_mesh(self, monkeypatch):
-        """With the backend forced to report 'tpu', the multi-device gate
-        must require divisible events and reject scaled configs, and the
-        auto-storage rule must then pick int8 on the mesh."""
+        """With the backend forced to report 'tpu': the round-4 mesh gate
+        serves non-divisible event counts (padding) and scaled minorities
+        (shard-local gather), the auto-storage rule picks int8 on the
+        mesh, and int8 + scaled still refuses loudly."""
         from pyconsensus_tpu.parallel import resolve_auto_storage, sharded
 
         monkeypatch.setattr(sharded.jax, "default_backend", lambda: "tpu")
@@ -180,22 +368,29 @@ class TestShardFusedGates:
             ConsensusParams(algorithm="sztorc", any_scaled=False,
                             has_na=True), 10_000, 4096, mesh)
         assert storage == "int8", why
-        # indivisible E closes the mesh gate — and with int8 storage the
-        # resolver must then REFUSE loudly rather than fall through to
-        # the XLA path (which stores continuous fills)
-        with pytest.raises(ValueError, match="int8"):
-            _resolve_sharded_params(p, 10_000, 4097, mesh)
-        # scaled events close the mesh gate outright (the gather-and-fix
-        # would cross shards) — same loud int8 refusal
+        # indivisible E no longer closes the mesh gate (padding) — int8
+        # stays on the fused path
+        assert _resolve_sharded_params(p, 10_000, 4097,
+                                       mesh).fused_resolution
+        # int8 + scaled is semantically impossible (continuous rescaled
+        # values on a half-unit lattice) — loud refusal at resolve time
         with pytest.raises(ValueError, match="int8"):
             _resolve_sharded_params(
                 p._replace(any_scaled=True, n_scaled=8), 10_000, 4096,
                 mesh)
-        # without int8 the same closures quietly take the XLA path
-        clean = p._replace(storage_dtype="")
-        assert not _resolve_sharded_params(clean, 10_000, 4097,
-                                           mesh).fused_resolution
+        # a scaled MINORITY now rides the fused mesh path (shard-local
+        # gather-median); a scaled-heavy config still takes the XLA path.
+        # bfloat16 storage: the x64 default itemsize (8) legitimately
+        # fails the VMEM fit at R=10k, which would shadow the scaled rule
+        clean = p._replace(storage_dtype="bfloat16")
+        assert _resolve_sharded_params(
+            clean._replace(any_scaled=True, n_scaled=8), 10_000, 4096,
+            mesh).fused_resolution
         assert not _resolve_sharded_params(
+            clean._replace(any_scaled=True, n_scaled=2048), 10_000, 4096,
+            mesh).fused_resolution
+        # ... and non-divisible E composes with the scaled minority
+        assert _resolve_sharded_params(
             clean._replace(any_scaled=True, n_scaled=8), 10_000, 4097,
             mesh).fused_resolution
 
