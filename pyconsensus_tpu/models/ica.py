@@ -37,7 +37,8 @@ from jax import lax
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 
-__all__ = ["ica_scores_np", "ica_scores_jax", "ICA_ITERS"]
+__all__ = ["ica_scores_np", "ica_scores_jax", "ica_scores_storage",
+           "ICA_ITERS"]
 
 ICA_ITERS = 128
 _EPS = 1e-12
@@ -107,8 +108,17 @@ def ica_scores_jax(reports_filled, reputation, max_components, pca_method="auto"
                                           method=pca_method)
     std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
     Z = _canon_signs_jax(scores / std[None, :])
-    R = Z.shape[0]
-    tol = _conv_tol(Z.dtype)
+    w = _fastica_one_unit(Z, _conv_tol(Z.dtype))
+    s = Z @ w
+    return jk.direction_fixed_scores(s, reports_filled, reputation)
+
+
+def _fastica_one_unit(Z, tol):
+    """The shared one-unit FastICA loop on a whitened (R, k) block: same
+    iteration, exit rule, and chaotic fallback as :func:`ica_scores_jax`
+    (from which this was factored for the storage scorer). Returns the
+    unmixing vector ``w`` (k,)."""
+    R, k = Z.shape
     w0 = jnp.zeros((k,), dtype=Z.dtype).at[0].set(1.0)
 
     def cond(state):
@@ -129,6 +139,26 @@ def ica_scores_jax(reports_filled, reputation, max_components, pca_method="auto"
 
     _, w, converged = lax.while_loop(
         cond, body, (jnp.asarray(0, jnp.int32), w0, jnp.asarray(False)))
-    w = jnp.where(converged, w, w0)  # chaotic case: see module docstring
+    return jnp.where(converged, w, w0)   # chaotic case: module docstring
+
+
+def ica_scores_storage(x, fill, mu, reputation, max_components,
+                       interpret=False):
+    """``ica`` scoring straight off sentinel-threaded storage (the fused
+    pipeline's compact encoding): the whitening subspace comes from the
+    storage-kernel orthogonal iteration
+    (jax_kernels.weighted_prin_comps_storage); the FastICA iteration
+    itself runs on the small (R, k) whitened block exactly as
+    :func:`ica_scores_jax`; the final direction fix is one further
+    storage sweep (jax_kernels.multi_dirfix_storage on the single
+    extracted component)."""
+    k = int(min(max_components, min(x.shape) - 1))
+    k = max(k, 1)
+    _, scores, _ = jk.weighted_prin_comps_storage(x, fill, mu, reputation,
+                                                  k, interpret=interpret)
+    std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
+    Z = _canon_signs_jax(scores / std[None, :])
+    w = _fastica_one_unit(Z, _conv_tol(Z.dtype))
     s = Z @ w
-    return jk.direction_fixed_scores(s, reports_filled, reputation)
+    return jk.multi_dirfix_storage(s[:, None], x, fill, mu, reputation,
+                                   interpret=interpret)[:, 0]

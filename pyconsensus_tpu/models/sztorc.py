@@ -43,6 +43,7 @@ from ..ops import numpy_kernels as nk
 __all__ = [
     "sztorc_scores_np", "sztorc_scores_jax",
     "fixed_variance_scores_np", "fixed_variance_scores_jax",
+    "fixed_variance_scores_storage",
 ]
 
 
@@ -115,6 +116,18 @@ def fixed_variance_scores_jax(reports_filled, reputation, variance_threshold,
     loadings, scores, explained = jk.weighted_prin_comps(reports_filled,
                                                          reputation, k,
                                                          method=pca_method)
+    w = _component_weights_jax(explained, variance_threshold)
+
+    def fix_one(scores_c):
+        return jk.direction_fixed_scores(scores_c, reports_filled, reputation)
+
+    adj_all = jax.vmap(fix_one, in_axes=1, out_axes=1)(scores)   # (R, k)
+    return adj_all @ w, loadings[:, 0]
+
+
+def _component_weights_jax(explained, variance_threshold):
+    """JAX mirror of :func:`_component_weights_np` (shared by the XLA and
+    storage scorers — one selection rule)."""
     cum_before = jnp.concatenate([jnp.zeros((1,), explained.dtype),
                                   jnp.cumsum(explained)[:-1]])
     include = cum_before < variance_threshold
@@ -122,10 +135,25 @@ def fixed_variance_scores_jax(reports_filled, reputation, variance_threshold,
     w = explained * include
     total = jnp.sum(w)
     uniform = include / jnp.sum(include)
-    w = jnp.where(total > 0.0, w / jnp.where(total > 0.0, total, 1.0), uniform)
+    return jnp.where(total > 0.0, w / jnp.where(total > 0.0, total, 1.0),
+                     uniform)
 
-    def fix_one(scores_c):
-        return jk.direction_fixed_scores(scores_c, reports_filled, reputation)
 
-    adj_all = jax.vmap(fix_one, in_axes=1, out_axes=1)(scores)   # (R, k)
+def fixed_variance_scores_storage(x, fill, mu, reputation,
+                                  variance_threshold, max_components,
+                                  interpret=False):
+    """``fixed-variance`` scoring straight off sentinel-threaded storage
+    (the fused pipeline's compact encoding, SURVEY.md §2 #10): the top-k
+    subspace by storage-kernel orthogonal iteration
+    (jax_kernels.weighted_prin_comps_storage), then ALL k direction fixes
+    batched into one further storage sweep
+    (jax_kernels.multi_dirfix_storage) — versus the XLA path's k separate
+    (3, R) x (R, E) matmuls. Same selection and combination rules as
+    :func:`fixed_variance_scores_jax`."""
+    k = min(max_components, min(x.shape))
+    loadings, scores, explained = jk.weighted_prin_comps_storage(
+        x, fill, mu, reputation, k, interpret=interpret)
+    w = _component_weights_jax(explained, variance_threshold)
+    adj_all = jk.multi_dirfix_storage(scores, x, fill, mu, reputation,
+                                      interpret=interpret)       # (R, k)
     return adj_all @ w, loadings[:, 0]

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import numpy_kernels as nk
+
 __all__ = [
     "normalize",
     "canon_sign",
@@ -435,9 +437,21 @@ _RITZ_RTOL = 1e-6
 _BULK_FLOOR = 5e-3
 
 
+def _decode_storage(x, fill, acc):
+    """Filled f32/f64 view of sentinel-threaded storage (int8 lattice or
+    NaN-threaded float) — the XLA-side mirror of
+    pallas_kernels._decode_block + fill reconstruction, for the few
+    elementwise passes (column squares, masked means) that are not worth
+    a kernel."""
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return jnp.where(x < 0, fill.astype(acc), x.astype(acc) * 0.5)
+    return jnp.where(jnp.isnan(x), fill.astype(x.dtype), x).astype(acc)
+
+
 def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
                        n_components: int, n_iters: int = _ORTH_ITERS,
-                       tol: float = 0.0):
+                       tol: float = 0.0, fill=None,
+                       interpret: bool = False):
     """Top-``k`` principal subspace of the implicit weighted covariance by
     blocked orthogonal iteration (subspace/simultaneous power iteration) —
     the multi-component analogue of :func:`_first_pc_power`. Never
@@ -473,21 +487,45 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     tests/test_kernels.py::test_orth_iter_matches_eigh at 1e-5).
     Start block: fixed-key normal (deterministic; measure-zero
     orthogonality risk — the ones vector is EXACTLY orthogonal to
-    antisymmetric eigenvectors, see :func:`_power_seed`)."""
+    antisymmetric eigenvectors, see :func:`_power_seed`).
+
+    With ``fill`` given, ``reports_filled`` is sentinel-threaded storage
+    (int8 lattice / NaN-threaded float — the fused pipeline's compact
+    encoding) and both block sweeps run through the Pallas storage
+    kernels (``storage_matmat`` / ``storage_rows_matmat``): each sweep
+    then streams 1-2 bytes per element instead of the XLA matmuls'
+    storage width, and the filled matrix never exists in HBM (round 4,
+    VERDICT r3 item 2)."""
     acc = reputation.dtype
     R, E = reports_filled.shape
     k = int(n_components)
     rep = reputation
+    use_storage = fill is not None
 
-    def apply_cov_block(V):                      # (E, k) -> (E, k)
-        t = (jnp.matmul(reports_filled, V.astype(reports_filled.dtype),
-                        preferred_element_type=acc)
-             - jnp.ones((R, 1), acc) * (mu @ V)[None, :])      # (R, k)
-        rt = rep[:, None] * t
-        y = (jnp.matmul(reports_filled.T, rt.astype(reports_filled.dtype),
-                        preferred_element_type=acc)
-             - mu[:, None] * jnp.sum(rt, axis=0)[None, :])     # (E, k)
-        return y / denom
+    if use_storage:
+        from .pallas_kernels import storage_matmat, storage_rows_matmat
+
+        def apply_cov_block(V):                  # (E, k) -> (E, k)
+            t = (storage_matmat(reports_filled, V.astype(acc), fill=fill,
+                                interpret=interpret).astype(acc)
+                 - jnp.ones((R, 1), acc) * (mu @ V)[None, :])  # (R, k)
+            rt = rep[:, None] * t
+            y = (storage_rows_matmat(reports_filled, rt.T.astype(acc),
+                                     fill=fill,
+                                     interpret=interpret).T.astype(acc)
+                 - mu[:, None] * jnp.sum(rt, axis=0)[None, :])  # (E, k)
+            return y / denom
+    else:
+        def apply_cov_block(V):                  # (E, k) -> (E, k)
+            t = (jnp.matmul(reports_filled, V.astype(reports_filled.dtype),
+                            preferred_element_type=acc)
+                 - jnp.ones((R, 1), acc) * (mu @ V)[None, :])  # (R, k)
+            rt = rep[:, None] * t
+            y = (jnp.matmul(reports_filled.T,
+                            rt.astype(reports_filled.dtype),
+                            preferred_element_type=acc)
+                 - mu[:, None] * jnp.sum(rt, axis=0)[None, :])  # (E, k)
+            return y / denom
 
     v0 = jax.random.normal(jax.random.key(0), (E, k), acc)
     V0, _ = jnp.linalg.qr(v0)
@@ -548,8 +586,11 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     # matrix-free trace: sum_j rep.x²_j - mu_j²  (Σrep = 1 after
     # normalize). Written as a fused elementwise+column-reduce so XLA
     # never materializes an (R, E) squared temp the way a matmul operand
-    # would be.
-    col_sq = jnp.sum(reports_filled.astype(acc) ** 2 * rep[:, None], axis=0)
+    # would be. Storage mode decodes in the same fused pass (a 1-byte
+    # read for int8).
+    vals = (_decode_storage(reports_filled, fill, acc) if use_storage
+            else reports_filled.astype(acc))
+    col_sq = jnp.sum(vals ** 2 * rep[:, None], axis=0)
     trace = jnp.sum(col_sq - mu * mu) / denom
     return V, eig, jnp.clip(trace, 0.0, None)
 
@@ -606,6 +647,75 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
                           jnp.zeros_like(eig))
     scores = dev @ loadings
     return loadings, scores, explained
+
+
+def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
+                                interpret: bool = False):
+    """Top-k components + explained fractions straight off sentinel
+    storage (the fused pipeline's compact encoding): orthogonal iteration
+    with both block sweeps through the Pallas storage kernels, then one
+    more ``storage_matmat`` sweep for the scores. The storage sibling of
+    :func:`weighted_prin_comps`'s orth-iter branch — same convergence
+    rules, same Rayleigh-Ritz rotation (parity pinned by
+    tests/test_kernels.py at the shared tolerance)."""
+    from .pallas_kernels import storage_matmat
+
+    acc = reputation.dtype
+    R, E = x.shape
+    denom = 1.0 - jnp.sum(reputation ** 2)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    loadings, eig, total = _top_pcs_orth_iter(
+        x, mu, denom, reputation, n_components, fill=fill,
+        interpret=interpret)
+    explained = jnp.where(total > 0.0,
+                          eig / jnp.where(total > 0.0, total, 1.0),
+                          jnp.zeros_like(eig))
+    scores = (storage_matmat(x, loadings.astype(acc), fill=fill,
+                             interpret=interpret).astype(acc)
+              - jnp.ones((R, 1), acc) * (mu @ loadings)[None, :])
+    return loadings, scores, explained
+
+
+def multi_dirfix_storage(scores, x, fill, mu, reputation,
+                         interpret: bool = False):
+    """Direction-fixed scores for a whole (R, k) block of component
+    scores in ONE further HBM sweep of the storage matrix — the batched
+    sibling of :func:`direction_fixed_scores` for the fused
+    multi-component path. The stacked matmul collapses like
+    :func:`sztorc_scores_power_fused`'s: with ``q_c = scores_c^T X`` and
+    ``csum = 1^T X`` (one ``storage_rows_matmat`` stack of k+1 rows),
+
+        new1_c = normalize(set1_c) @ X = (q_c + a1_c csum) / sum(set1_c)
+
+    and ``old = rep @ X`` is exactly the weighted column means ``mu``
+    already in hand. Same ``ref_ind <= 0`` tie-break per component.
+    Returns (R, k) direction-fixed scores."""
+    from .pallas_kernels import storage_rows_matmat
+
+    acc = reputation.dtype
+    R, k = scores.shape
+    W = jnp.concatenate([scores.T.astype(acc),
+                         jnp.ones((1, R), acc)])               # (k+1, R)
+    qc = storage_rows_matmat(x, W, fill=fill,
+                             interpret=interpret).astype(acc)  # (k+1, E)
+    q, csum = qc[:k], qc[k]
+    a1 = jnp.abs(jnp.min(scores, axis=0))                      # (k,)
+    a2 = jnp.max(scores, axis=0)
+    set1 = scores + a1[None, :]
+    set2 = scores - a2[None, :]
+    s1_tot = jnp.sum(set1, axis=0)
+    s2_tot = jnp.sum(set2, axis=0)
+
+    def _guard(num, tot):
+        # normalize()'s zero-sum guard applied to the collapsed projection
+        return jnp.where(tot[:, None] == 0.0, num,
+                         num / jnp.where(tot == 0.0, 1.0, tot)[:, None])
+
+    new1 = _guard(q + a1[:, None] * csum[None, :], s1_tot)     # (k, E)
+    new2 = _guard(q - a2[:, None] * csum[None, :], s2_tot)
+    ref_ind = (jnp.sum((new1 - mu[None, :]) ** 2, axis=1)
+               - jnp.sum((new2 - mu[None, :]) ** 2, axis=1))   # (k,)
+    return jnp.where(ref_ind[None, :] <= 0.0, set1, -set2)
 
 
 #: column-block width for the blocked weighted median (see
@@ -697,7 +807,9 @@ def _weighted_median_cols_block(values, weights, present):
     total = jnp.sum(w, axis=0)
     safe_total = jnp.where(total > 0.0, total, 1.0)
     cw = jnp.cumsum(w / safe_total[None, :], axis=0)
-    ge = cw >= 0.5
+    # selection threshold lowered by the tie tolerance, like the numpy
+    # kernel: a true tie one ulp below 0.5 must select the tie index
+    ge = cw >= 0.5 - nk.MEDIAN_TIE_ATOL
     idx = jnp.argmax(ge, axis=0)                      # first crossing
     idx = jnp.where(jnp.any(ge, axis=0), idx, R - 1)
     # take_along_axis, NOT fancy `a[idx, arange(E)]` indexing: the latter
@@ -710,8 +822,10 @@ def _weighted_median_cols_block(values, weights, present):
     v_i = take_col(v, idx)
     nxt = jnp.clip(idx + 1, 0, R - 1)
     v_n = take_col(v, nxt)
-    # np.isclose(cw_i, 0.5) default tolerances: atol=1e-8, rtol=1e-5
-    exact = jnp.abs(cw_i - 0.5) <= (1e-8 + 1e-5 * 0.5)
+    # the shared absolute tie tolerance (numpy_kernels.MEDIAN_TIE_ATOL —
+    # replaces round-3's accidental np.isclose rtol=1e-5; see its sizing
+    # note)
+    exact = jnp.abs(cw_i - 0.5) <= nk.MEDIAN_TIE_ATOL
     has_next = (idx + 1 < R) & jnp.isfinite(v_n)
     med = jnp.where(exact & has_next, 0.5 * (v_i + v_n), v_i)
     return jnp.where(total > 0.0, med, 0.5)

@@ -179,12 +179,31 @@ def weighted_prin_comps(reports_filled: np.ndarray, reputation: np.ndarray,
     return loadings, scores, explained
 
 
+#: absolute tolerance for the weighted median's "cumulative weight hits
+#: 0.5 exactly" midpoint rule. The reference's ``weightedstats`` compares
+#: exactly (``==``), but exact float equality here is backend-fragile:
+#: the normalized cumulative sum is computed by different reduction
+#: orders on numpy vs XLA, so a true tie (e.g. four reporters at weight
+#: 1/4 + two at 1/8... summing to exactly 0.5 in one order) can land one
+#: ulp off 0.5 in the other — and the two backends would then disagree
+#: on an OUTCOME. The epsilon is sized to the reduction noise it must
+#: absorb (R * eps_f64 * 0.5 ~ 1e-12 at R = 10k) and far below any
+#: data-driven near-tie: a cumulative weight 1e-9 from 0.5 without being
+#:  a tie requires a reporter weight that small, whose report cannot
+#: move the median anyway. This REPLACES round-3's accidental
+#: ``np.isclose`` rtol=1e-5 (a semantics choice made by a default
+#: tolerance — VERDICT r3 weak item 2); verify against the real
+#: ``weightedstats`` comparison on first reference contact (SURVEY §8).
+MEDIAN_TIE_ATOL = 1e-9
+
+
 def weighted_median(values: np.ndarray, weights: np.ndarray) -> float:
     """Weighted median by sorted cumulative weight (SURVEY.md §2 #8).
 
     Sort values; find the first value where the cumulative normalized weight
-    reaches 0.5. If the cumulative weight hits 0.5 exactly at a sample, return
-    the midpoint of that value and the next (the standard lower/upper-median
+    reaches 0.5. If the cumulative weight hits 0.5 exactly at a sample
+    (to :data:`MEDIAN_TIE_ATOL` — see its sizing note), return the
+    midpoint of that value and the next (the standard lower/upper-median
     midpoint rule, matching the ``weightedstats`` dependency of the
     reference). Implemented identically (same comparisons, same midpoint rule)
     in the JAX backend so backend outcomes agree bit-identically.
@@ -198,11 +217,13 @@ def weighted_median(values: np.ndarray, weights: np.ndarray) -> float:
     v = values[order]
     w = weights[order] / total
     cw = np.cumsum(w)
-    # first index where cumulative weight >= 0.5
-    idx = int(np.searchsorted(cw, 0.5))
+    # first index where the cumulative weight reaches 0.5 — less the tie
+    # tolerance, so a true tie that lands one ulp BELOW 0.5 still selects
+    # the tie index (and then midpoints) instead of skipping past it
+    idx = int(np.searchsorted(cw, 0.5 - MEDIAN_TIE_ATOL))
     if idx >= len(v):
         idx = len(v) - 1
-    if np.isclose(cw[idx], 0.5) and idx + 1 < len(v):
+    if abs(cw[idx] - 0.5) <= MEDIAN_TIE_ATOL and idx + 1 < len(v):
         return 0.5 * (v[idx] + v[idx + 1])
     return float(v[idx])
 

@@ -40,7 +40,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["apply_weighted_cov", "power_iteration_fused",
            "scores_dirfix_pass", "resolve_certainty_fused",
-           "storage_matvec", "storage_rows_matmat"]
+           "storage_matvec", "storage_rows_matmat", "storage_matmat",
+           "matmat_kernels_fit"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
 #: times this (double-buffered input + in-register f32 upcast)
@@ -414,17 +415,120 @@ def storage_matvec(x, v, fill=None, interpret: bool = False):
     return t.reshape(Rp)[:R]
 
 
+def _matmat_kernel(x_ref, aux_ref, t_ref, *, nan_fill, k):
+    """One row panel of the UNCENTERED storage matmat ``T = filled @ V``
+    for a thin (E, k) block of column vectors — the multi-component
+    analogue of :func:`_matvec_kernel` (orthogonal iteration's first
+    sweep streams k directions at once; k is the component count, <= ~8).
+    ``aux_ref`` carries the compensated bf16 halves as 2k rows
+    [V^T_head; V^T_residual] (+ the fill row under ``nan_fill``) on the
+    compact path, or [V^T; zeros; (fill)] f32 rows on the exact-f32
+    path."""
+    f32 = jnp.float32
+    if not (x_ref.dtype == jnp.bfloat16
+            or jnp.issubdtype(x_ref.dtype, jnp.integer)):
+        val, absent = _decode_block(x_ref)
+        filled = (jnp.where(absent, aux_ref[2 * k:2 * k + 1, :], val)
+                  if nan_fill else val)
+        # one full-block store (a per-column t_ref[:, c:c+1] loop is a
+        # width-1 lane-sliced store Mosaic has rejected patterns like
+        # before — see _rows_matmat_kernel's layout note)
+        cols = [jnp.sum(filled * (aux_ref[c:c + 1, :]
+                                  + aux_ref[k + c:k + c + 1, :]),
+                        axis=1, keepdims=True) for c in range(k)]
+        t_ref[:] = jnp.concatenate(cols, axis=1)
+        return
+    fill_row = aux_ref[2 * k:2 * k + 1, :] if nan_fill else None
+    filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
+    t2 = jax.lax.dot_general(filled, aux_ref[0:2 * k, :],
+                             (((1,), (1,)), ((), ())),
+                             precision=jax.lax.Precision.DEFAULT,
+                             preferred_element_type=f32)       # (T, 2k)
+    t_ref[:] = t2[:, :k] + t2[:, k:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def storage_matmat(x, V, fill=None, interpret: bool = False):
+    """``filled(x) @ V`` for a thin (E, k) block in one HBM sweep of the
+    storage matrix, decode in-register (:func:`_decode_block`). Returns
+    the UNCENTERED (R, k) f32 product; centering is the caller's
+    (``T - 1 (mu @ V)``). The k <= ~8 component-block sibling of
+    :func:`storage_matvec`."""
+    R, E = x.shape
+    k = V.shape[1]
+    nan_fill = fill is not None
+    tile_r = _panel_rows(E, x.dtype.itemsize,
+                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    bf16 = jnp.bfloat16
+    Vt = V.astype(f32).T                                       # (k, E)
+    compact = _is_compact(x)
+    if compact:
+        Vh, Vl = _compensated_split(Vt)
+        rows = [Vh, Vl]
+        if nan_fill:
+            rows.append(fill.astype(bf16).reshape(1, E))
+    else:
+        rows = [Vt, jnp.zeros_like(Vt)]
+        if nan_fill:
+            rows.append(fill.astype(f32).reshape(1, E))
+    aux = jnp.concatenate(rows)
+    t = pl.pallas_call(
+        functools.partial(_matmat_kernel, nan_fill=nan_fill, k=k),
+        grid=(Rp // tile_r,),
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((aux.shape[0], E), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_r, k), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Rp, k), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * k * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, aux)
+    return t[:R]
+
+
+def matmat_kernels_fit(n_events: int, n_components: int,
+                       itemsize: int) -> bool:
+    """Whether the multi-component storage sweeps (storage_matmat +
+    storage_rows_matmat with a (k+1)-row stack) fit scoped VMEM at the
+    minimum 8-row panel: double-buffered block + f32 upcast + the
+    (2k+1, E) aux rows + the (k+1, E) f32 accumulator. The k-row
+    accumulators are what distinguishes this from :func:`fused_pca_fits`."""
+    k = n_components
+    lanes = -(-n_events // 128) * 128
+    est = (8 * lanes * itemsize * 2          # double-buffered panel
+           + 8 * lanes * 4                   # in-register f32 upcast
+           + (2 * k + 1) * lanes * 2         # compensated aux rows (bf16)
+           + (k + 1) * lanes * 4             # rows_matmat accumulator
+           + 2 * lanes * 4)                  # fill/mu working vectors
+    return est <= _VMEM_BUDGET
+
+
 def _rows_matmat_kernel(x_ref, w_ref, fill_ref, acc_ref, *, nan_fill,
                         n_rows):
-    """One row panel of ``W @ filled(x)`` for a few (k <= 4) row vectors:
+    """One row panel of ``W @ filled(x)`` for a few (k <= ~8) row vectors:
     the separable second half of the sharded covariance application (and
     the direction-fix contractions — W = [t, rep, ones] gives q/o/c per
-    event shard in one pass). ``w_ref`` carries the 2k compensated bf16
-    rows [W_head; W_residual] on the compact path (each product against
-    the lattice-exact filled panel is then exact; only the ~2^-17
-    second-order residual is lost), or the k f32 rows on the f32 path
-    (exact VPU chains — the parity mode must not round continuous
-    values)."""
+    event shard in one pass). ``w_ref`` carries the operand TRANSPOSED —
+    a (tile_r, 2k) block of [W_head; W_residual]^T on the compact path
+    (each product against the lattice-exact filled panel is then exact;
+    only the ~2^-17 second-order residual is lost), or (tile_r, k) f32 on
+    the f32 path (exact VPU chains — the parity mode must not round
+    continuous values). The transposed layout is a Mosaic lowering
+    requirement, not a preference: a (2k, tile_r) block has a last dim
+    that is neither 128-divisible nor the full array width, which the
+    TPU lowering rejects outright (first hit on real hardware round 4 —
+    interpret-mode tests cannot see it); (tile_r, 2k) satisfies the
+    (8, 128)-or-full rule because tile_r is a multiple of 8 and 2k IS
+    the full width."""
     i = pl.program_id(0)
     f32 = jnp.float32
 
@@ -439,12 +543,12 @@ def _rows_matmat_kernel(x_ref, w_ref, fill_ref, acc_ref, *, nan_fill,
                   else val)
         for r in range(n_rows):
             acc_ref[r:r + 1, :] += jnp.sum(
-                w_ref[r:r + 1, :].T * filled, axis=0, keepdims=True)
+                w_ref[:, r:r + 1] * filled, axis=0, keepdims=True)
         return
     fill_row = fill_ref[0:1, :] if nan_fill else None
     filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
     part = jax.lax.dot_general(w_ref[:], filled,
-                               (((1,), (0,)), ((), ())),
+                               (((0,), (0,)), ((), ())),
                                precision=jax.lax.Precision.DEFAULT,
                                preferred_element_type=f32)   # (2k, E)
     acc_ref[:] += part[:n_rows, :] + part[n_rows:, :]
@@ -453,7 +557,7 @@ def _rows_matmat_kernel(x_ref, w_ref, fill_ref, acc_ref, *, nan_fill,
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def storage_rows_matmat(x, W, fill=None, interpret: bool = False):
     """``W @ filled(x)`` for a small stack of row vectors (W: (k, R) f32,
-    k <= 4) in ONE HBM sweep of the storage matrix. Per-event-column
+    k <= ~8) in ONE HBM sweep of the storage matrix. Per-event-column
     results are local to an event shard, so the sharded path needs no
     collective here. Returns (k, E) f32. Centering is the caller's:
     ``(W @ filled) - (W @ 1) mu^T`` with local ``mu``."""
@@ -472,9 +576,9 @@ def storage_rows_matmat(x, W, fill=None, interpret: bool = False):
     compact = _is_compact(x)
     if compact:
         Wh, Wl = _compensated_split(W)
-        Wop = jnp.concatenate([Wh, Wl])
+        Wop = jnp.concatenate([Wh, Wl]).T               # (Rp, 2k)
     else:
-        Wop = W
+        Wop = W.T                                       # (Rp, k)
     fill_arr = (fill.astype(bf16 if compact else f32).reshape(1, E)
                 if nan_fill else jnp.zeros((1, E), bf16 if compact else f32))
     acc = pl.pallas_call(
@@ -483,7 +587,7 @@ def storage_rows_matmat(x, W, fill=None, interpret: bool = False):
         in_specs=[
             pl.BlockSpec((tile_r, E), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((Wop.shape[0], tile_r), lambda i: (0, i),
+            pl.BlockSpec((tile_r, Wop.shape[1]), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],

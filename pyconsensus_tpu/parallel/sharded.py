@@ -140,9 +140,10 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
         # fused gate closed
         raise ValueError(
             "storage_dtype='int8' requires the fused kernel path (real "
-            "TPU backend, algorithm='sztorc', power-family pca_method, "
-            "VMEM-fitting shape, scaled events at most a small static "
-            "minority) — this configuration resolved to the XLA "
+            "TPU backend, power-family pca_method, VMEM-fitting shape, "
+            "scaled events at most a small static minority; sztorc on "
+            "any mesh, fixed-variance/ica single-device only) — this "
+            "configuration resolved to the XLA "
             f"path (mesh devices={mesh.devices.size}, event axis="
             f"{mesh.shape.get('event', 1)}, algorithm={p.algorithm!r}, "
             f"pca_method={p.pca_method!r}); use storage_dtype='bfloat16'")
@@ -178,7 +179,8 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     is handled inside resolve_certainty_fused by zero-rep row padding, so
     it does not disqualify the fast path — the VMEM fit is checked at the
     padded count."""
-    from ..ops.pallas_kernels import fused_pca_fits, resolve_kernel_fits
+    from ..ops.pallas_kernels import (fused_pca_fits, matmat_kernels_fit,
+                                      resolve_kernel_fits)
 
     # actual matrix itemsize: the storage dtype if set, else the default
     # compute dtype (8 under jax_enable_x64 — modeling that as 4 would
@@ -200,14 +202,30 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     scaled_ok = (not params.any_scaled
                  or 0 < params.n_scaled <= n_events // 8)
     e_local = -(-n_events // n_event_shards)   # ceil: the padded width
+    # single-device: sztorc plus the multi-component variants (whose
+    # storage-kernel orthogonal iteration arrived in round 4); the
+    # shard_map mesh body scores with sztorc power iteration only
+    if n_event_shards > 1:
+        algo_ok = params.algorithm == "sztorc"
+        multi_fit = True
+    else:
+        algo_ok = params.algorithm in ("sztorc",) + _MULTI_COMPONENT_ALGOS
+        if params.algorithm in _MULTI_COMPONENT_ALGOS:
+            # the k-row accumulators of the matmat sweeps need their own
+            # VMEM fit (k+1 rows: components + the csum row)
+            k = min(params.max_components, n_reporters)
+            multi_fit = matmat_kernels_fit(e_local, k + 1, itemsize)
+        else:
+            multi_fit = True
     # the same next-multiple-of-8 the kernel pads to (a no-op for
     # already-tileable counts)
     r_padded = n_reporters + (-n_reporters) % 8
     return (params.allow_fused
             and jax.default_backend() == "tpu"
-            and params.algorithm == "sztorc"
+            and algo_ok
             and params.pca_method in ("power", "power-fused")
             and scaled_ok
+            and multi_fit
             and fused_pca_fits(e_local, itemsize)
             and resolve_kernel_fits(r_padded, itemsize))
 
@@ -262,11 +280,11 @@ def resolve_auto_storage(p: ConsensusParams, R: int, E: int,
 
     - **int8** sentinel storage exactly when the int8-parameterized
       pipeline resolves onto the fused kernel path (real TPU backend,
-      sztorc, power-family PCA after resolution, VMEM-fitting shape —
-      single device OR an event-sharded mesh, any event count, via
-      parallel.fused_sharded) AND the workload is all-binary — the
-      half-unit int8 lattice is exact there and quarters the f32 HBM
-      traffic;
+      power-family PCA after resolution, VMEM-fitting shape; sztorc on
+      any device count via parallel.fused_sharded, fixed-variance/ica on
+      a single device via the storage orthogonal iteration) AND the
+      workload is all-binary — the half-unit int8 lattice is exact there
+      and quarters the f32 HBM traffic;
     - **bfloat16** otherwise (halves the traffic; catch-snapped binary
       outcomes stay exact; scaled medians round to bf16 resolution).
 

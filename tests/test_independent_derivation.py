@@ -127,14 +127,15 @@ def _scores(filled, rep, algorithm, variance_threshold, max_components):
 
 def _weighted_median(pairs):
     """Sorted-cumulative-weight median with the lower/upper midpoint rule
-    on an exact 0.5 hit (SURVEY.md §2 #8)."""
+    on an exact 0.5 hit — the shared MEDIAN_TIE_ATOL rule (round 4
+    unified the kernels on this absolute epsilon; SURVEY.md §2 #8)."""
     pairs = sorted(pairs, key=lambda p: p[0])
     total = sum(w for _, w in pairs)
     cum = 0.0
     for idx, (v, w) in enumerate(pairs):
         cum += w / total
-        if cum >= 0.5 - 1e-12:
-            if abs(cum - 0.5) < 1e-9 and idx + 1 < len(pairs):
+        if cum >= 0.5 - 1e-9:
+            if abs(cum - 0.5) <= 1e-9 and idx + 1 < len(pairs):
                 return 0.5 * (v + pairs[idx + 1][0])
             return v
     return pairs[-1][0]

@@ -218,6 +218,59 @@ class TestWeightedPrinComp:
                 _align_sign(np.asarray(s_pw)[:, c], np.asarray(s_ref)[:, c]),
                 np.asarray(s_ref)[:, c], atol=1e-5)
 
+    def test_orth_iter_storage_matches_inmemory(self, rng):
+        """The storage-kernel orthogonal iteration (round 4: NaN-threaded
+        sentinel storage swept by storage_matmat/storage_rows_matmat) must
+        reproduce the in-memory orth-iter path on the equivalent filled
+        matrix — identical convergence rules, so f64 storage in interpret
+        mode agrees tightly."""
+        from pyconsensus_tpu.models.pipeline import _fill_stats
+
+        X = rng.random((40, 24))
+        X[:20] += np.outer(np.ones(20), rng.random(24)) * 2.0
+        X[20:30] -= np.outer(np.ones(10), rng.random(24)) * 1.5
+        X[rng.random((40, 24)) < 0.15] = np.nan
+        rep = jnp.asarray(nk.normalize(rng.random(40) + 0.1))
+        x, fill, _, _ = _fill_stats(jnp.asarray(X), rep, 0.1, "", None)
+        filled = jnp.where(jnp.isnan(x), fill[None, :], x)
+        mu = rep @ filled
+        l_ref, s_ref, e_ref = jk.weighted_prin_comps(filled, rep, 3,
+                                                     method="power")
+        l_st, s_st, e_st = jk.weighted_prin_comps_storage(
+            x, fill, mu, rep, 3, interpret=True)
+        np.testing.assert_allclose(np.asarray(e_st), np.asarray(e_ref),
+                                   atol=1e-6)
+        for c in range(3):
+            np.testing.assert_allclose(
+                _align_sign(np.asarray(l_st)[:, c], np.asarray(l_ref)[:, c]),
+                np.asarray(l_ref)[:, c], atol=1e-5)
+            np.testing.assert_allclose(
+                _align_sign(np.asarray(s_st)[:, c], np.asarray(s_ref)[:, c]),
+                np.asarray(s_ref)[:, c], atol=1e-5)
+
+    def test_multi_dirfix_storage_matches_per_component(self, rng):
+        """The batched one-sweep direction fix must reproduce
+        direction_fixed_scores applied per component on the filled
+        matrix (same collapsed algebra as the sztorc fused pass, same
+        tie-break)."""
+        from pyconsensus_tpu.models.pipeline import _fill_stats
+
+        X = rng.random((24, 16))
+        X[rng.random((24, 16)) < 0.1] = np.nan
+        rep = jnp.asarray(nk.normalize(rng.random(24) + 0.1))
+        x, fill, _, _ = _fill_stats(jnp.asarray(X), rep, 0.1, "", None)
+        filled = jnp.where(jnp.isnan(x), fill[None, :], x)
+        mu = rep @ filled
+        _, scores, _ = jk.weighted_prin_comps(filled, rep, 3,
+                                              method="eigh-gram")
+        batched = jk.multi_dirfix_storage(scores, x, fill, mu, rep,
+                                          interpret=True)
+        for c in range(3):
+            ref = jk.direction_fixed_scores(scores[:, c], filled, rep)
+            np.testing.assert_allclose(np.asarray(batched)[:, c],
+                                       np.asarray(ref), atol=1e-9,
+                                       err_msg=f"component {c}")
+
     def test_orth_iter_degenerate_zero_cov(self, rng):
         """Identical rows (zero covariance): finite outputs, zero
         explained fractions — the qr-of-zeros guard."""

@@ -243,6 +243,86 @@ class TestFusedResolution:
             else:
                 np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
 
+    @pytest.mark.parametrize("algorithm", ["fixed-variance", "ica"])
+    @pytest.mark.parametrize("max_iterations", [1, 3])
+    def test_multi_component_matches_xla(self, rng, algorithm,
+                                         max_iterations):
+        """Round 4 (VERDICT r3 item 2): ica and fixed-variance on the
+        fused NaN-threaded path — storage-kernel orthogonal iteration +
+        one-sweep batched direction fix — must reproduce the XLA light
+        pipeline key-for-key (same convergence rules, same component
+        selection, same FastICA loop)."""
+        from pyconsensus_tpu.models.pipeline import (_consensus_core_fused,
+                                                     _consensus_core_light)
+        import jax.numpy as jnp
+        reports = make_reports(rng, R=24, E=16, na_frac=0.1)
+        R, E = reports.shape
+        rep = np.full(R, 1.0 / R)
+        args = (jnp.asarray(reports), jnp.asarray(rep),
+                jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E))
+        base = ConsensusParams(algorithm=algorithm,
+                               max_iterations=max_iterations,
+                               pca_method="power", any_scaled=False,
+                               has_na=True)
+        ref = _consensus_core_light(*args, base)
+        fused = _consensus_core_fused(
+            *args, base._replace(fused_resolution=True))
+        assert set(fused) == set(ref)
+        assert ("first_loading" in fused) == (algorithm != "ica")
+        for key in ref:
+            a, b = np.asarray(ref[key]), np.asarray(fused[key])
+            if key in ("outcomes_adjusted", "outcomes_final", "na_row",
+                       "iterations", "convergence"):
+                np.testing.assert_array_equal(a, b, err_msg=key)
+            elif key == "first_loading":
+                np.testing.assert_allclose(np.abs(a), np.abs(b), atol=2e-3,
+                                           err_msg=key)
+            else:
+                np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+    @pytest.mark.parametrize("algorithm", ["fixed-variance", "ica"])
+    def test_multi_component_int8_storage(self, rng, algorithm):
+        """int8 sentinel storage through the multi-component fused path:
+        exact on binary lattices, so catch-snapped outcomes match the
+        full-precision fused run exactly."""
+        from pyconsensus_tpu.models.pipeline import _consensus_core_fused
+        import jax.numpy as jnp
+        reports = make_reports(rng, R=24, E=16, na_frac=0.15)
+        R, E = reports.shape
+        rep = np.full(R, 1.0 / R)
+        args = (jnp.asarray(reports), jnp.asarray(rep),
+                jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E))
+        base = ConsensusParams(algorithm=algorithm, pca_method="power",
+                               any_scaled=False, has_na=True,
+                               fused_resolution=True)
+        full = _consensus_core_fused(*args, base)
+        int8 = _consensus_core_fused(*args,
+                                     base._replace(storage_dtype="int8"))
+        np.testing.assert_array_equal(
+            np.asarray(full["outcomes_adjusted"]),
+            np.asarray(int8["outcomes_adjusted"]))
+        np.testing.assert_allclose(np.asarray(full["smooth_rep"]),
+                                   np.asarray(int8["smooth_rep"]),
+                                   atol=5e-6)
+
+    def test_multi_component_gate(self, monkeypatch):
+        """The single-device fused gate admits ica/fixed-variance (with
+        the matmat-kernel VMEM fit); the mesh gate stays sztorc-only."""
+        import pyconsensus_tpu.parallel.sharded as sh
+        monkeypatch.setattr(sh.jax, "default_backend", lambda: "tpu")
+        for algo in ("ica", "fixed-variance"):
+            p = ConsensusParams(algorithm=algo, any_scaled=False,
+                                pca_method="power",
+                                storage_dtype="bfloat16")
+            assert sh._use_fused_resolution(p, 10_000, 100_000, 1), algo
+            assert not sh._use_fused_resolution(p, 10_000, 100_000, 8), algo
+            # auto-storage picks int8 for the all-binary single-device case
+            mesh1 = make_mesh(batch=1, event=1)
+            storage, why = sh.resolve_auto_storage(
+                ConsensusParams(algorithm=algo, any_scaled=False,
+                                has_na=True), 10_000, 100_000, mesh1)
+            assert storage == "int8", why
+
     def test_gate_scaled_fraction(self, monkeypatch):
         """On TPU the gate admits a small static scaled fraction and rejects
         scaled-heavy matrices (and any_scaled without a count)."""

@@ -1,0 +1,158 @@
+"""Same-session cross-commit A/B of the headline benchmark.
+
+Chip throughput drifts ~15% between sessions (docs/PERFORMANCE.md
+methodology: "only same-session A/Bs are meaningful"), which left the
+round-2 -> round-3 headline delta (27.2 -> 23.1 res/s) unresolved: drift
+or regression? This harness settles such questions the only valid way —
+running the pinned commit and HEAD **interleaved in one session on the
+same chip**, so drift hits both sides equally and the ratio isolates the
+code change (VERDICT r3 item 3).
+
+Mechanics: a detached ``git worktree`` of the base commit under
+``.ab/<sha>`` (inside the repo, gitignored); bench.py invoked
+alternately base/HEAD/base/HEAD... with identical arguments (each
+invocation is bench.py's own fail-soft parent — killable probe, bounded
+children, always one JSON line); medians + the HEAD/base ratio are
+printed and appended to the measurements file.
+
+Usage:
+    python tools/ab_commits.py --base <commit> [--pairs 2] \
+        [--out docs/MEASUREMENTS_r04.json] [-- <bench.py args...>]
+
+Interpretation: the chip also drifts *within* a session on the minutes
+scale, so treat ratios within ~5% as parity; the interleaving exists so
+a real regression shows up as a CONSISTENT per-pair gap, which the
+per-pair ratios printed below make visible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", required=True,
+                    help="commit-ish to A/B against HEAD (e.g. the prior "
+                         "round's bench commit)")
+    ap.add_argument("--pairs", type=int, default=2,
+                    help="interleaved (base, head) bench pairs")
+    ap.add_argument("--out", default="docs/MEASUREMENTS_r04.json",
+                    help="measurements JSON to append the A/B entries to")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-bench --bench-timeout (the hard cap per "
+                         "invocation is 3x this + 500 s, matching "
+                         "tools/tpu_measurements.py's ladder math)")
+    ap.add_argument("--keep-worktree", action="store_true",
+                    help="leave .ab/<sha> in place for inspection")
+    ap.add_argument("bench_args", nargs="*",
+                    help="extra bench.py arguments (after --)")
+    return ap.parse_args(argv)
+
+
+def _git(*args: str) -> str:
+    return subprocess.run(["git", *args], cwd=ROOT, check=True,
+                          capture_output=True, text=True).stdout.strip()
+
+
+def make_worktree(commit: str) -> pathlib.Path:
+    sha = _git("rev-parse", "--short", commit)
+    path = ROOT / ".ab" / sha
+    if not path.exists():
+        path.parent.mkdir(exist_ok=True)
+        _git("worktree", "add", "--detach", str(path), commit)
+    return path
+
+
+def drop_worktree(path: pathlib.Path) -> None:
+    subprocess.run(["git", "worktree", "remove", "--force", str(path)],
+                   cwd=ROOT, capture_output=True, text=True)
+
+
+def run_bench(tree: pathlib.Path, bench_args: list, timeout: float) -> dict:
+    """One bench.py invocation from ``tree``; returns its JSON line (or an
+    error dict — bench.py's fail-soft parent always prints one)."""
+    cmd = [sys.executable, str(tree / "bench.py"),
+           "--bench-timeout", str(timeout), *bench_args]
+    hard_cap = 3 * timeout + 500
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, cwd=tree, capture_output=True, text=True,
+                           timeout=hard_cap)
+    except subprocess.TimeoutExpired:
+        return {"value": 0.0, "error": f"hard cap {hard_cap:.0f}s expired"}
+    line = next((ln for ln in reversed(r.stdout.splitlines())
+                 if ln.lstrip().startswith("{")), None)
+    if line is None:
+        return {"value": 0.0, "error": f"no JSON line (rc={r.returncode}); "
+                                       f"stderr tail: {r.stderr[-400:]}"}
+    out = json.loads(line)
+    out["_wall_s"] = round(time.time() - t0, 1)
+    return out
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    head_sha = _git("rev-parse", "--short", "HEAD")
+    base_sha = _git("rev-parse", "--short", args.base)
+    if _git("status", "--porcelain"):
+        print("note: working tree dirty — HEAD side includes uncommitted "
+              "changes", file=sys.stderr)
+    tree = make_worktree(args.base)
+    print(f"A/B: base={base_sha} (worktree {tree.relative_to(ROOT)}) vs "
+          f"HEAD={head_sha} + working tree, {args.pairs} interleaved pairs",
+          flush=True)
+    results = {"base": [], "head": []}
+    try:
+        for i in range(args.pairs):
+            for side, t in (("base", tree), ("head", ROOT)):
+                r = run_bench(t, args.bench_args, args.timeout)
+                results[side].append(r)
+                print(f"pair {i + 1} {side}: value={r.get('value')} "
+                      f"({r.get('error', 'ok')}, wall {r.get('_wall_s')}s)",
+                      flush=True)
+    finally:
+        if not args.keep_worktree:
+            drop_worktree(tree)
+
+    base_vals = [r["value"] for r in results["base"] if r.get("value")]
+    head_vals = [r["value"] for r in results["head"] if r.get("value")]
+    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else 0.0  # noqa: E731
+    ratio = (med(head_vals) / med(base_vals)) if base_vals and head_vals \
+        and med(base_vals) > 0 else None
+    per_pair = [round(h["value"] / b["value"], 4)
+                for b, h in zip(results["base"], results["head"])
+                if b.get("value") and h.get("value")]
+    verdict = {
+        "_name": f"ab_{base_sha}_vs_{head_sha}",
+        "base_commit": base_sha,
+        "head_commit": head_sha,
+        "bench_args": args.bench_args,
+        "base_values": base_vals,
+        "head_values": head_vals,
+        "median_base": med(base_vals),
+        "median_head": med(head_vals),
+        "head_over_base": round(ratio, 4) if ratio else None,
+        "per_pair_ratios": per_pair,
+        "runs": results,
+    }
+    print(json.dumps({k: v for k, v in verdict.items() if k != "runs"},
+                     indent=1))
+    out_path = ROOT / args.out
+    existing = json.loads(out_path.read_text()) if out_path.exists() else []
+    existing.append(verdict)
+    out_path.parent.mkdir(exist_ok=True)
+    out_path.write_text(json.dumps(existing, indent=1) + "\n")
+    print(f"appended to {args.out}")
+    return 0 if ratio else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
