@@ -124,27 +124,30 @@ class ConsensusParams(NamedTuple):
 
 
 def _scores_np(filled, rep, p: ConsensusParams):
-    """Returns ``(adj_scores, loading-or-None)``; PCA paths surface their
-    first loading so the pipeline never re-decomposes just for reporting."""
+    """Returns ``(adj_scores, loading-or-None, ica_converged-or-None)``;
+    PCA paths surface their first loading so the pipeline never
+    re-decomposes just for reporting; the third slot carries ica's
+    chaotic-fallback observability flag (VERDICT r3 item 7)."""
     algo = p.algorithm
     if algo == "sztorc":
-        return sztorc_scores_np(filled, rep)
+        return (*sztorc_scores_np(filled, rep), None)
     if algo == "fixed-variance":
-        return fixed_variance_scores_np(filled, rep, p.variance_threshold,
-                                        p.max_components)
+        return (*fixed_variance_scores_np(filled, rep, p.variance_threshold,
+                                          p.max_components), None)
     if algo == "ica":
-        return ica_scores_np(filled, rep, p.max_components), None
+        adj, conv = ica_scores_np(filled, rep, p.max_components)
+        return adj, None, conv
     if algo == "k-means":
-        return cl.kmeans_conformity_np(filled, rep, p.num_clusters), None
+        return cl.kmeans_conformity_np(filled, rep, p.num_clusters), None, None
     if algo == "dbscan-jit":
         return cl.dbscan_jit_conformity_np(filled, rep, p.dbscan_eps,
-                                           p.dbscan_min_samples), None
+                                           p.dbscan_min_samples), None, None
     if algo == "hierarchical":
         return cl.hierarchical_conformity(filled, rep,
-                                          p.hierarchy_threshold), None
+                                          p.hierarchy_threshold), None, None
     if algo == "dbscan":
         return cl.dbscan_conformity(filled, rep, p.dbscan_eps,
-                                    p.dbscan_min_samples), None
+                                    p.dbscan_min_samples), None, None
     raise ValueError(f"unknown algorithm: {algo!r}")
 
 
@@ -160,10 +163,11 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
     rep = old_rep
     this_rep = old_rep
     loading = None
+    ica_converged = None
     converged = False
     iterations = 0
     for _ in range(max(p.max_iterations, 1)):
-        adj, loading = _scores_np(filled, rep, p)
+        adj, loading, ica_converged = _scores_np(filled, rep, p)
         this_rep = nk.row_reward_weighted(adj, rep)
         new_rep = nk.smooth(this_rep, rep, p.alpha)
         delta = float(np.max(np.abs(new_rep - rep)))
@@ -195,64 +199,78 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
     result.update(extras)
     if loading is not None:
         result["first_loading"] = nk.canon_sign(loading)
+    if p.algorithm == "ica":
+        result["ica_converged"] = bool(ica_converged)
     return result
 
 
 def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
-    """JAX mirror of ``_scores_np``: ``(adj_scores, loading-or-None)``.
+    """JAX mirror of ``_scores_np``:
+    ``(adj_scores, loading-or-None, ica_converged-or-None)``.
     ``v_init`` warm-starts sztorc's power-family PCA (ignored elsewhere)."""
     algo = p.algorithm
     if algo == "sztorc":
-        return sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters,
-                                 p.power_tol, p.matvec_dtype, v_init=v_init)
+        return (*sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters,
+                                   p.power_tol, p.matvec_dtype,
+                                   v_init=v_init), None)
     if algo == "fixed-variance":
-        return fixed_variance_scores_jax(filled, rep, p.variance_threshold,
-                                         p.max_components, p.pca_method)
+        return (*fixed_variance_scores_jax(filled, rep, p.variance_threshold,
+                                           p.max_components, p.pca_method),
+                None)
     if algo == "ica":
-        return ica_scores_jax(filled, rep, p.max_components, p.pca_method), None
+        adj, conv = ica_scores_jax(filled, rep, p.max_components,
+                                   p.pca_method)
+        return adj, None, conv
     if algo == "k-means":
-        return cl.kmeans_conformity_jax(filled, rep, p.num_clusters), None
+        return cl.kmeans_conformity_jax(filled, rep, p.num_clusters), None, None
     if algo == "dbscan-jit":
         return cl.dbscan_jit_conformity_jax(filled, rep, p.dbscan_eps,
-                                            p.dbscan_min_samples), None
+                                            p.dbscan_min_samples), None, None
     raise ValueError(f"algorithm {algo!r} is not jit-compatible "
                      f"(hybrid algorithms: {HYBRID_ALGORITHMS})")
 
 
 def _iterate_jax(filled, old_rep, p: ConsensusParams):
     """Iterative Sztorc reputation redistribution as a ``lax.scan``
-    (SURVEY.md §7 M2). Carry: (rep, this_rep, converged, iterations). A step
-    whose starting state is already converged applies no update — the numpy
-    backend's ``break`` expressed with static shapes."""
+    (SURVEY.md §7 M2). Carry: (rep, this_rep, converged, iterations,
+    ica_converged). A step whose starting state is already converged
+    applies no update — the numpy backend's ``break`` expressed with
+    static shapes."""
 
     has_loading = p.algorithm in ("sztorc", "fixed-variance")
     E = filled.shape[1]
 
     def step(carry, _):
-        rep, this_rep_prev, loading_prev, converged, iters = carry
+        rep, this_rep_prev, loading_prev, ica_prev, converged, iters = carry
         # warm start: the previous iteration's loading (zeros on iteration
         # 1 → cold start inside _power_loop); reputation moves a little per
         # redistribution step, so the power iteration restarts almost
         # converged and the early exit saves most of its HBM sweeps
-        adj, loading = _scores_jax(filled, rep, p, v_init=loading_prev)
+        adj, loading, ica_c = _scores_jax(filled, rep, p, v_init=loading_prev)
         if loading is None:
             loading = loading_prev
+        if ica_c is None:
+            ica_c = ica_prev
         this_rep = jk.row_reward_weighted(adj, rep)
         new_rep = jk.smooth(this_rep, rep, p.alpha)
         delta = jnp.max(jnp.abs(new_rep - rep))
         rep_out = jnp.where(converged, rep, new_rep)
         this_out = jnp.where(converged, this_rep_prev, this_rep)
         loading_out = jnp.where(converged, loading_prev, loading)
+        ica_out = jnp.where(converged, ica_prev, ica_c)
         iters_out = jnp.where(converged, iters, iters + 1)
         conv_out = converged | (delta <= p.convergence_tolerance)
-        return (rep_out, this_out, loading_out, conv_out, iters_out), None
+        return (rep_out, this_out, loading_out, ica_out, conv_out,
+                iters_out), None
 
     n = max(p.max_iterations, 1)
     init = (old_rep, old_rep, jnp.zeros((E,), dtype=old_rep.dtype),
-            jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
-    (rep, this_rep, loading, converged, iters), _ = lax.scan(
+            jnp.asarray(True), jnp.asarray(False),
+            jnp.asarray(0, dtype=jnp.int32))
+    (rep, this_rep, loading, ica_conv, converged, iters), _ = lax.scan(
         step, init, None, length=n)
-    return rep, this_rep, (loading if has_loading else None), converged, iters
+    return (rep, this_rep, (loading if has_loading else None), converged,
+            iters, ica_conv)
 
 
 def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
@@ -279,7 +297,8 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
         # compactly (one (R, E) buffer) and let every later phase sweep
         # half the bytes; `present` is the only memory of where NaNs were
         filled = filled.astype(jnp.dtype(p.storage_dtype))
-    rep, this_rep, loading, converged, iters = _iterate_jax(filled, old_rep, p)
+    rep, this_rep, loading, converged, iters, ica_conv = _iterate_jax(
+        filled, old_rep, p)
     outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
         present, filled, rep, scaled, p.catch_tolerance,
         any_scaled=p.any_scaled, has_na=p.has_na,
@@ -307,6 +326,8 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     result.update(extras)
     if loading is not None:
         result["first_loading"] = jk.canon_sign(loading)
+    if p.algorithm == "ica":
+        result["ica_converged"] = ica_conv
     return result
 
 
@@ -398,9 +419,9 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
 
     if p.algorithm == "sztorc":
         def scores_at(rep_k, mu_k, v_init=None):
-            return jk.sztorc_scores_power_fused(
+            return (*jk.sztorc_scores_power_fused(
                 x, rep_k, p.power_iters, p.power_tol, p.matvec_dtype,
-                interpret=interp, fill=fill, mu=mu_k, v_init=v_init)
+                interpret=interp, fill=fill, mu=mu_k, v_init=v_init), None)
     elif p.algorithm in ("fixed-variance", "ica"):
         # round-4 (VERDICT r3 item 2): the multi-component variants score
         # straight off the sentinel storage via the storage-kernel
@@ -416,14 +437,15 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
               else x)
         if p.algorithm == "fixed-variance":
             def scores_at(rep_k, mu_k, v_init=None):
-                return fixed_variance_scores_storage(
+                return (*fixed_variance_scores_storage(
                     xm, fill, mu_k, rep_k, p.variance_threshold,
-                    p.max_components, interpret=interp)
+                    p.max_components, interpret=interp), None)
         else:
             def scores_at(rep_k, mu_k, v_init=None):
-                return ica_scores_storage(xm, fill, mu_k, rep_k,
-                                          p.max_components,
-                                          interpret=interp), None
+                adj, conv = ica_scores_storage(xm, fill, mu_k, rep_k,
+                                               p.max_components,
+                                               interpret=interp)
+                return adj, None, conv
     else:
         raise ValueError(
             f"the fused pipeline scores sztorc/fixed-variance/ica only, "
@@ -431,36 +453,43 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     E = x.shape[1]
 
     if p.max_iterations <= 1:
-        adj, loading = scores_at(old_rep, mu1)
+        adj, loading, ica_conv = scores_at(old_rep, mu1)
         if loading is None:                      # ica: no loading to report
             loading = jnp.zeros((E,), dtype=acc)
+        if ica_conv is None:
+            ica_conv = jnp.asarray(True)
         this_rep = jk.row_reward_weighted(adj, old_rep)
         rep = jk.smooth(this_rep, old_rep, p.alpha)
         converged = jnp.max(jnp.abs(rep - old_rep)) <= p.convergence_tolerance
         iters = jnp.asarray(1, dtype=jnp.int32)
     else:
         def step(carry, _):
-            rep_c, this_prev, loading_prev, conv, it = carry
+            rep_c, this_prev, loading_prev, ica_prev, conv, it = carry
             # warm start from the previous iteration's loading (zeros on
             # iteration 1 → cold start inside _power_loop; the
             # multi-component scorers ignore it)
-            adj, loading = scores_at(rep_c, _masked_mu(x, fill, rep_c),
-                                     v_init=loading_prev)
+            adj, loading, ica_c = scores_at(rep_c, _masked_mu(x, fill, rep_c),
+                                            v_init=loading_prev)
             if loading is None:
                 loading = loading_prev
+            if ica_c is None:
+                ica_c = ica_prev
             this_rep = jk.row_reward_weighted(adj, rep_c)
             new_rep = jk.smooth(this_rep, rep_c, p.alpha)
             delta = jnp.max(jnp.abs(new_rep - rep_c))
             rep_out = jnp.where(conv, rep_c, new_rep)
             this_out = jnp.where(conv, this_prev, this_rep)
             loading_out = jnp.where(conv, loading_prev, loading)
+            ica_out = jnp.where(conv, ica_prev, ica_c)
             it_out = jnp.where(conv, it, it + 1)
             conv_out = conv | (delta <= p.convergence_tolerance)
-            return (rep_out, this_out, loading_out, conv_out, it_out), None
+            return (rep_out, this_out, loading_out, ica_out, conv_out,
+                    it_out), None
 
         init = (old_rep, old_rep, jnp.zeros((E,), dtype=acc),
-                jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
-        (rep, this_rep, loading, converged, iters), _ = lax.scan(
+                jnp.asarray(True), jnp.asarray(False),
+                jnp.asarray(0, dtype=jnp.int32))
+        (rep, this_rep, loading, ica_conv, converged, iters), _ = lax.scan(
             step, init, None, length=p.max_iterations)
 
     raw, adjusted, certainty, pcol, prow, narow = resolve_certainty_fused(
@@ -551,6 +580,8 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     }
     if p.algorithm != "ica":                 # ica reports no loading
         result["first_loading"] = jk.canon_sign(loading)
+    else:
+        result["ica_converged"] = ica_conv
     return result
 
 

@@ -807,9 +807,17 @@ def _weighted_median_cols_block(values, weights, present):
     total = jnp.sum(w, axis=0)
     safe_total = jnp.where(total > 0.0, total, 1.0)
     cw = jnp.cumsum(w / safe_total[None, :], axis=0)
+    # the shared tie tolerance, floored at what THIS dtype's arithmetic
+    # can resolve: under f32 (TPU default) a true tie's cumulative weight
+    # lands up to ~ulp(0.5)=6e-8 off, so the f64-sized 1e-9 window would
+    # collapse to exact equality and diverge from the (always-f64) numpy
+    # kernel on genuine ties (code-review r4, numerically verified at
+    # 12 uniform reporters). 32*eps: f64 -> 1e-9 floor binds (matches
+    # numpy bitwise); f32 -> 3.8e-6, around the pre-round-4 band.
+    tie_atol = max(nk.MEDIAN_TIE_ATOL, 32.0 * float(jnp.finfo(cw.dtype).eps))
     # selection threshold lowered by the tie tolerance, like the numpy
     # kernel: a true tie one ulp below 0.5 must select the tie index
-    ge = cw >= 0.5 - nk.MEDIAN_TIE_ATOL
+    ge = cw >= 0.5 - tie_atol
     idx = jnp.argmax(ge, axis=0)                      # first crossing
     idx = jnp.where(jnp.any(ge, axis=0), idx, R - 1)
     # take_along_axis, NOT fancy `a[idx, arange(E)]` indexing: the latter
@@ -822,10 +830,10 @@ def _weighted_median_cols_block(values, weights, present):
     v_i = take_col(v, idx)
     nxt = jnp.clip(idx + 1, 0, R - 1)
     v_n = take_col(v, nxt)
-    # the shared absolute tie tolerance (numpy_kernels.MEDIAN_TIE_ATOL —
-    # replaces round-3's accidental np.isclose rtol=1e-5; see its sizing
-    # note)
-    exact = jnp.abs(cw_i - 0.5) <= nk.MEDIAN_TIE_ATOL
+    # the shared absolute tie tolerance (numpy_kernels.MEDIAN_TIE_ATOL,
+    # dtype-floored above — replaces round-3's accidental np.isclose
+    # rtol=1e-5; see the sizing notes)
+    exact = jnp.abs(cw_i - 0.5) <= tie_atol
     has_next = (idx + 1 < R) & jnp.isfinite(v_n)
     med = jnp.where(exact & has_next, 0.5 * (v_i + v_n), v_i)
     return jnp.where(total > 0.0, med, 0.5)

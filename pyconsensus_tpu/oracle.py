@@ -105,6 +105,11 @@ def assemble_result(raw: dict) -> dict:
             result[key] = raw[key]
     if "first_loading" in raw:
         result["events"]["adj_first_loadings"] = raw["first_loading"]
+    if "ica_converged" in raw:
+        # ica's chaotic-fallback observability flag (False = the scoring
+        # fell back to the first whitened component — models/ica.py's
+        # convergence contract); rebuild addition, no reference analogue
+        result["ica_converged"] = bool(raw["ica_converged"])
     return result
 
 

@@ -82,7 +82,7 @@ def _trial_metrics(key, liar_fraction, variance, *, n_reporters: int,
     # dense binary reports: rescale/interpolate are identities, so the trial
     # goes straight into the iterative scoring loop
     rep0 = jnp.full((n_reporters,), 1.0 / n_reporters, dtype=dtype)
-    rep, _, _, converged, iters = _iterate_jax(reports, rep0, p)
+    rep, _, _, converged, iters, _ = _iterate_jax(reports, rep0, p)
     scaled = jnp.zeros((n_events,), dtype=bool)
     _, outcomes_adj = jk.resolve_outcomes(None, reports, rep, scaled,
                                           p.catch_tolerance, any_scaled=False,
@@ -236,7 +236,7 @@ def _trial_rounds(key, liar_fraction, variance, *, n_rounds: int,
     def round_step(rep, k):
         reports, truth = _reports_for_round(k, liar, variance, n_reporters,
                                             n_events, collude)
-        new_rep, _, _, _, _ = _iterate_jax(reports, rep, p)
+        new_rep, _, _, _, _, _ = _iterate_jax(reports, rep, p)
         _, outcomes_adj = jk.resolve_outcomes(None, reports, new_rep, scaled,
                                               p.catch_tolerance,
                                               any_scaled=False, has_na=False)

@@ -124,7 +124,12 @@ def main(argv=None) -> int:
 
     base_vals = [r["value"] for r in results["base"] if r.get("value")]
     head_vals = [r["value"] for r in results["head"] if r.get("value")]
-    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else 0.0  # noqa: E731
+
+    def med(xs):
+        if not xs:
+            return 0.0
+        s, n = sorted(xs), len(xs)
+        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
     ratio = (med(head_vals) / med(base_vals)) if base_vals and head_vals \
         and med(base_vals) > 0 else None
     per_pair = [round(h["value"] / b["value"], 4)
