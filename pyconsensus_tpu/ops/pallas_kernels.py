@@ -162,11 +162,28 @@ def _decode_block(x_ref):
       ({0, 0.5, 1}), half the HBM bytes of bf16. ``x * 0.5`` decodes
       exactly in f32; zero-padded rows decode to value 0.0, non-absent,
       preserving the zero-rep padding contract.
-    """
-    xp = x_ref[:].astype(jnp.float32)
+
+    Comparison legality (the round-3 BENCH_r02 crash class, extended
+    round 4 by an on-chip probe): Mosaic rejects BOTH bf16 ``cmpf`` and
+    i8 ``cmpi`` ("Target does not support this comparison"); i32 ``cmpi``
+    and f32 ``cmpf`` are the legal forms. The int8 sentinel test
+    compares on the f32 value image this function materializes anyway —
+    a second (i32) image purely for the compare would be added work."""
     if jnp.issubdtype(x_ref.dtype, jnp.integer):
+        xp = x_ref[:].astype(jnp.float32)
         return xp * 0.5, xp < 0.0
+    xp = x_ref[:].astype(jnp.float32)
     return xp, jnp.isnan(xp)
+
+
+def _absent_only(x_view):
+    """Just the absence mask of a storage block — for passes that never
+    touch the values (the resolve kernel's row-NA accumulation): int8
+    skips the float convert (i32-upcast integer compare — i8 cmpi is
+    Mosaic-rejected); float storage pays only the isnan upcast."""
+    if jnp.issubdtype(x_view.dtype, jnp.integer):
+        return x_view[:].astype(jnp.int32) < 0
+    return jnp.isnan(x_view[:].astype(jnp.float32))
 
 
 def _decode_filled_bf16(x_ref, fill_row, *, nan_fill):
@@ -176,94 +193,82 @@ def _decode_filled_bf16(x_ref, fill_row, *, nan_fill):
     perturbs the approximation-tolerant loading — scaled outcomes come
     from the exact gather median downstream).
 
-    Decodes through :func:`_decode_block` so every comparison (the int8
-    sentinel test, isnan) runs on f32 operands: Mosaic rejects bf16
+    (Used by the separable matvec/matmat storage kernels — the power
+    sweep's apply_weighted_cov decodes through :func:`_decode_block`
+    instead. Decode cost was round 4's FIRST regression hypothesis and
+    was ruled out — the real cost was the MXU-dot kernel form, see
+    docs/PERFORMANCE.md r4 — but the one-convert form below is kept: it
+    is no slower and carries no comparison at all.) The int8 path
+    converts the raw lattice STRAIGHT to bf16 (exact on {-1, 0, 1, 2};
+    halving is bf16-exact) and separates the sentinel by min/max
+    arithmetic; the bf16 path passes values through untouched. The one
+    remaining f32 operand is bf16 isnan's upcast: Mosaic rejects bf16
     ``arith.cmpf`` outright ("Target does not support this comparison" —
-    BENCH_r02's compile failure was this kernel's old ``bf16 < 0``), and
-    the f32 compare costs nothing against the HBM-bound panel read. The
-    f32->bf16 value cast after decode is exact on the storage lattice."""
-    val32, absent = _decode_block(x_ref)
-    val = val32.astype(jnp.bfloat16)
+    BENCH_r02's compile failure was this kernel's old ``bf16 < 0``) and
+    i8 ``cmpi`` likewise (on-chip probe); i32 ``cmpi`` and f32 ``cmpf``
+    are the legal forms."""
+    bf16 = jnp.bfloat16
+    if jnp.issubdtype(x_ref.dtype, jnp.integer):
+        # ONE i8->bf16 convert and NO comparison at all: the sentinel -1
+        # decodes to -0.5, so min/max arithmetic separates it —
+        # max(val, 0) zeroes the sentinel lane, and -2*min(val, 0) is an
+        # exact {0, 1} mask that injects the fill. All values exact on
+        # the bf16 lattice (probed legal on v5e; both compare forms cost
+        # a second full-width convert: i8 cmpi is Mosaic-rejected and
+        # i32/f32 compares need their own upcast image).
+        val = x_ref[:].astype(bf16) * bf16(0.5)
+        if nan_fill:
+            mask = jnp.minimum(val, bf16(0)) * bf16(-2)
+            return jnp.maximum(val, bf16(0)) + mask * fill_row
+        return val
+    if x_ref.dtype == bf16:
+        val = x_ref[:]
+        absent = jnp.isnan(x_ref[:].astype(jnp.float32))
+    else:
+        val32, absent = _decode_block(x_ref)
+        val = val32.astype(bf16)
     if nan_fill:
         return jnp.where(absent, fill_row, val)
     return val
 
 
-def _apply_cov_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
-                      nan_fill):
-    """One row panel of the implicit-covariance application, centered
-    MATRIX-FREE:
+def _cov_panel_contribution(x_ref, mu_ref, rep_ref, v, *, nan_fill):
+    """One row panel's ``D_i^T (rep_i * (D_i v))`` contribution, centered
+    in-register on the VPU. ``nan_fill=True`` reads sentinel-threaded
+    storage: absent entries are NaN (float) / -1 (int8) in ``x`` and
+    ``mu_ref`` row 1 carries ``fill - mu`` (the centered per-column fill
+    value), so the filled matrix is reconstructed in-register and never
+    exists in HBM.
 
-        t   = X v − (mu·v)              (X = filled panel, reconstructed)
-        rt  = rep ⊙ t
-        y  += X^T rt;   s += Σ rt       (caller finishes y − mu·s)
+    This is deliberately the VPU elementwise form, NOT an MXU dot
+    (round-4 forensics, docs/PERFORMANCE.md): the power sweep's
+    contractions have tiny non-MXU-shaped minor dims (N=1..2 against
+    8-row panels), and the "compensated bf16 MXU dots" rewrite that
+    replaced this form late in round 2 measured **7.6 ms/sweep vs this
+    form's 4.4** at the north-star shape on v5e — the entire r2→r3
+    headline regression. The f32 chain is also exact per-product (no
+    compensation machinery needed), and every comparison runs on the f32
+    value image (Mosaic rejects bf16 ``cmpf`` / i8 ``cmpi``)."""
+    val, absent = _decode_block(x_ref)
+    if nan_fill:
+        xc = jnp.where(absent, mu_ref[1:2, :], val - mu_ref[0:1, :])
+    else:
+        xc = val - mu_ref[0:1, :]                          # (T, E) centered
+    t = jnp.sum(xc * v, axis=1, keepdims=True)             # (T, 1) = D_i v
+    return jnp.sum(xc * (rep_ref[:] * t), axis=0, keepdims=True)
 
-    Compact storage (bf16/int8) rides the MXU: the first VPU version
-    (in-register centering + elementwise multiply-reduce chains) measured
-    ~2.5x its own HBM read — the same pathology the direction-fix kernel
-    hit. Exactness at DEFAULT dot precision: the filled panel is
-    bf16-exact (storage lattice values / snapped fills), and the
-    continuous vectors are compensated — ``aux_ref`` rows 0..1 carry the
-    bf16 head and residual of ``v`` (row 2 the fill values under
-    ``nan_fill``), and ``rt`` splits the same way in-kernel — so every
-    product is exact and only ~2^-17 second-order residuals are lost,
-    far below the power loop's own exit tolerance.
 
-    f32 storage (the machine-precision parity mode, where values may be
-    arbitrary continuous reals) keeps the exact f32 VPU chain instead —
-    rounding the panel to bf16 for the MXU would silently demote the one
-    mode whose purpose is full precision."""
+def _apply_cov_kernel(x_ref, mu_ref, rep_ref, v_ref, y_ref, *, nan_fill):
+    """One row panel: both contractions off a single HBM read of the
+    panel (see :func:`_cov_panel_contribution`)."""
     i = pl.program_id(0)
-    f32 = jnp.float32
 
     @pl.when(i == 0)
     def _():
         y_ref[:] = jnp.zeros_like(y_ref)
-        s_ref[:] = jnp.zeros_like(s_ref)
 
-    if not (x_ref.dtype == jnp.bfloat16
-            or jnp.issubdtype(x_ref.dtype, jnp.integer)):
-        # exact VPU path on the full-precision values (aux rows are f32
-        # here: [v, 0, fill] — see the caller)
-        val, absent = _decode_block(x_ref)
-        v_full = aux_ref[0:1, :] + aux_ref[1:2, :]
-        if nan_fill:
-            filled = jnp.where(absent, aux_ref[2:3, :], val)
-        else:
-            filled = val
-        t = (jnp.sum(filled * v_full, axis=1, keepdims=True)
-             - muv_ref[0, 0])                                  # (T, 1)
-        rt = rep_ref[:] * t
-        y_ref[:] += jnp.sum(filled * rt, axis=0, keepdims=True)
-        s_ref[:] += jnp.sum(rt)
-        return
-
-    fill_row = aux_ref[2:3, :] if nan_fill else None
-    filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
-    # These bf16 MXU dots pin precision=DEFAULT *explicitly*: the
-    # compensated operand splits already make every product exact at
-    # DEFAULT, and an ambient jax.default_matmul_precision("highest")
-    # (the XLA path's exact_matmuls wrapper, in scope when power-fused
-    # PCA runs under _consensus_core) otherwise leaks into this trace and
-    # asks Mosaic for an fp32-precision contract on a bf16 lhs — which it
-    # rejects at compile time ("Bad lhs type", the 16k-scaled BENCH rung-0
-    # failure of 2026-07-31).
-    default = jax.lax.Precision.DEFAULT
-    # t2 = [X v_h, X v_l]  (lane contraction, one MXU pass, N=2)
-    t2 = jax.lax.dot_general(filled, aux_ref[0:2, :],
-                             (((1,), (1,)), ((), ())),
-                             precision=default,
-                             preferred_element_type=f32)       # (T, 2)
-    t = t2[:, 0:1] + t2[:, 1:2] - muv_ref[0, 0]
-    rt = rep_ref[:] * t                                        # (T, 1) f32
-    rt_h = rt.astype(jnp.bfloat16)
-    rt_l = (rt - rt_h.astype(f32)).astype(jnp.bfloat16)
-    dn0 = (((0,), (0,)), ((), ()))
-    y_ref[:] += (jax.lax.dot_general(rt_h, filled, dn0, precision=default,
-                                     preferred_element_type=f32)
-                 + jax.lax.dot_general(rt_l, filled, dn0, precision=default,
-                                       preferred_element_type=f32))
-    s_ref[:] += jnp.sum(rt)
+    y_ref[:] += _cov_panel_contribution(x_ref, mu_ref, rep_ref, v_ref[:],
+                                        nan_fill=nan_fill)
 
 
 def _pad_rows(x, rep, tile_r: int):
@@ -277,24 +282,31 @@ def _pad_rows(x, rep, tile_r: int):
     return x, rep
 
 
-def _prep_cov_inputs(x, rep, fill):
-    """Input prep for the covariance-application kernel: panel sizing
-    (halved budget under NaN threading), row padding. Returns
-    ``(x, rep, tile_r)``."""
+def _prep_cov_inputs(x, mu, rep, fill):
+    """Shared input prep for the covariance-application kernel: panel
+    sizing (halved budget under NaN threading), row padding, and the
+    stacked ``[mu; fill - mu]`` operand. Returns
+    ``(x, rep, tile_r, mu2)``."""
     E = x.shape[1]
     nan_fill = fill is not None
     tile_r = _panel_rows(E, x.dtype.itemsize,
                          _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
     x, rep = _pad_rows(x, rep.astype(jnp.float32), tile_r)
-    return x, rep, tile_r
+    mu = mu.astype(jnp.float32).reshape(1, E)
+    if nan_fill:
+        # row 0: mu; row 1: fill - mu (the centered value of an absent entry)
+        mu2 = jnp.concatenate([mu, fill.astype(jnp.float32).reshape(1, E)
+                               - mu])
+    else:
+        mu2 = mu
+    return x, rep, tile_r, mu2
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
     """``(X - mu)^T (rep * ((X - mu) v))`` in ONE HBM sweep of ``X``,
-    centered matrix-free (see :func:`_apply_cov_kernel`):
-
-        y = X^T (rep ⊙ (X v - (mu·v))) - mu Σ(rep ⊙ (X v - (mu·v)))
+    centered in-register on the VPU (see :func:`_cov_panel_contribution`
+    for why this is NOT an MXU-dot kernel).
 
     x : (R, E) filled reports, f32 or bf16 (row count padded internally) —
         or, with ``fill`` given, sentinel-threaded storage (absent entries
@@ -307,48 +319,31 @@ def apply_weighted_cov(x, mu, rep, v, fill=None, interpret: bool = False):
     """
     R, E = x.shape
     nan_fill = fill is not None
-    x, rep, tile_r = _prep_cov_inputs(x, rep, fill)
+    x, rep, tile_r, mu2 = _prep_cov_inputs(x, mu, rep, fill)
     Rp = x.shape[0]
     f32 = jnp.float32
-    bf16 = jnp.bfloat16
-    mu = mu.astype(f32)
-    v = v.astype(f32)
-    compact = _is_compact(x)
-    aux = _vector_aux(v, fill if nan_fill else None, compact)
-    # HIGHEST precision: this O(E) dot runs outside the kernel at XLA's
-    # default matmul precision (bf16 operand rounding on TPU), which would
-    # inject ~1e-3-relative noise into the centering term that the
-    # compensated in-kernel scheme then can't recover — the one dot is
-    # noise-free for free at this size
-    muv = jnp.dot(mu, v,
-                  precision=jax.lax.Precision.HIGHEST).reshape(1, 1)
     grid = (Rp // tile_r,)
-    y, s = pl.pallas_call(
+    y = pl.pallas_call(
         functools.partial(_apply_cov_kernel, nan_fill=nan_fill),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_r, E), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((aux.shape[0], E), lambda i: (0, 0),
+            pl.BlockSpec((mu2.shape[0], E), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
             pl.BlockSpec((1, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, E), f32),
-            jax.ShapeDtypeStruct((1, 1), f32),
-        ],
+        out_specs=pl.BlockSpec((1, E), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, E), f32),
         cost_estimate=pl.CostEstimate(
-            flops=6 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            flops=4 * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
             transcendentals=0),
         interpret=interpret,
-    )(x, aux, muv, rep.reshape(-1, 1))
-    return y.reshape(E) - mu * s.reshape(())
+    )(x, mu2, rep.astype(f32).reshape(-1, 1), v.astype(f32).reshape(1, E))
+    return y.reshape(E)
 
 
 def _matvec_kernel(x_ref, aux_ref, t_ref, *, nan_fill):
@@ -817,9 +812,9 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
 
     def row_body(i, _):
         sl = pl.ds(i * chunk, chunk)
-        # decode upcasts before the absence test — Mosaic rejects the
-        # bf16 NaN comparison
-        naf = (_decode_block(x_ref.at[sl, :])[1] & col_ok).astype(f32)
+        # absence only — no value decode (int8: raw integer compare;
+        # float: isnan on the f32 upcast, since Mosaic rejects bf16 cmpf)
+        naf = (_absent_only(x_ref.at[sl, :]) & col_ok).astype(f32)
         # deliberately NOT compensated: certainty's bf16 rounding (~2^-8
         # relative) enters prow scaled by the NA fraction, so the
         # participation_rows error is ~1e-4 absolute at 2% NA — not worth
