@@ -323,7 +323,14 @@ def _metric_suffix(args) -> str:
     ``--no-pallas`` explicitly, so a degraded rung's JSON carries a
     distinct metric name — a consumer aggregating by ``metric`` can never
     bank a recovery-rung rate into the headline series (the ``rung`` tag
-    is belt-and-braces on top)."""
+    is belt-and-braces on top).
+
+    Series-continuity note (ADVICE r3): the ``_f32`` suffix exists since
+    round 3 — explicit ``--storage-dtype ''``/``float32`` runs in
+    BENCH_r01/r02-era artifacts carry the UNSUFFIXED headline metric
+    name; cross-round aggregations of the f32 series must treat the
+    pre-r3 unsuffixed entries as its continuation (the r1/r2 banked
+    entries are left as written — artifacts are immutable)."""
     return ((f"_{args.algorithm}" if args.algorithm != "sztorc" else "")
             + (f"_scaled{args.scaled}" if args.scaled else "")
             + ("_f32" if args.storage_dtype in ("", "float32") else "")

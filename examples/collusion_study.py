@@ -5,12 +5,11 @@ trials in ONE batched XLA call, then runs the repeated-game variant
 (reputation carried across rounds) and writes plots if matplotlib is
 available.
 
-Run:  python examples/collusion_study.py [out_dir]
+Run (after `pip install -e .` at the repo root):  python examples/collusion_study.py [out_dir]
 """
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pyconsensus_tpu.sim import CollusionSimulator, RoundsSimulator
 

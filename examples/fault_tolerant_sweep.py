@@ -11,13 +11,12 @@ Part 2 — streaming a CSV that "doesn't fit": reports land in a .csv,
 ``streaming_consensus`` stages it to .npy in row chunks and resolves
 panel by panel — peak memory is one chunk/panel, never the matrix.
 
-Run:  python examples/fault_tolerant_sweep.py [workdir]
+Run (after `pip install -e .` at the repo root):  python examples/fault_tolerant_sweep.py [workdir]
 """
 import os
 import sys
 import tempfile
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -1,14 +1,11 @@
 """Large-scale resolution: event sharding across a device mesh, and
 out-of-core streaming for matrices bigger than device memory.
 
-Run:  python examples/large_scale.py
+Run (after `pip install -e .` at the repo root):  python examples/large_scale.py
 (On a machine without accelerators, prefix with
  XLA_FLAGS=--xla_force_host_platform_device_count=8 to simulate a mesh.)
 """
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

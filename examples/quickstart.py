@@ -1,11 +1,8 @@
 """Quickstart: resolve oracles with the reference-compatible API.
 
-Run:  python examples/quickstart.py
+Run (after `pip install -e .` at the repo root):  python examples/quickstart.py
 """
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -533,11 +533,11 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     tol = max(float(tol), 8.0 * float(jnp.finfo(acc).eps))
 
     def cond(state):
-        i, _, _, done = state
+        i, _, _, _, done = state
         return (i < n_iters) & ~done
 
     def body(state):
-        i, V, eig_prev, _ = state
+        i, V, eig_prev, stable_prev, _ = state
         Y = apply_cov_block(V)
         eig = jnp.sum(V * Y, axis=0)             # per-column Ritz values
         Q, _ = jnp.linalg.qr(Y)
@@ -549,20 +549,26 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
         # stability alone is NOT vector convergence (values converge
         # quadratically — a 1e-6-stable Ritz value can sit on a 1e-3-off
         # vector), so any column carrying real spectrum mass must align.
-        # A column is exempt when its Ritz value is both stable and under
-        # _BULK_FLOOR of the dominant one — the noise-bulk directions
+        # A column is exempt when its Ritz value has been stable for TWO
+        # consecutive sweeps (ADVICE r3: a small-but-real component just
+        # under the floor can show one accidentally-stable sweep while
+        # the subspace is still rotating into it; two in a row means the
+        # rotation has actually stopped feeding it) and sits under
+        # _BULK_FLOOR of the dominant value — the noise-bulk directions
         # whose vectors are statistically interchangeable and whose
         # explained fractions round to zero.
         lead = jnp.maximum(jnp.max(jnp.abs(eig)), jnp.finfo(acc).tiny)
         ritz_stable = jnp.abs(eig - eig_prev) <= _RITZ_RTOL * lead
         negligible = jnp.abs(eig) <= _BULK_FLOOR * lead
-        done_col = (align >= 1.0 - tol) | (ritz_stable & negligible)
+        done_col = (align >= 1.0 - tol) | (ritz_stable & stable_prev
+                                           & negligible)
         done = jnp.min(done_col.astype(acc)) > 0.0
-        return i + 1, Q, eig, done
+        return i + 1, Q, eig, ritz_stable, done
 
-    _, V, _, _ = lax.while_loop(
+    _, V, _, _, _ = lax.while_loop(
         cond, body, (jnp.asarray(0, jnp.int32), V0,
-                     jnp.full((k,), jnp.inf, acc), jnp.asarray(False)))
+                     jnp.full((k,), jnp.inf, acc),
+                     jnp.zeros((k,), bool), jnp.asarray(False)))
     # Rayleigh-Ritz: one more application, then rotate the block onto the
     # eigenbasis of the projected covariance — optimal approximations
     # within span(V), and the step that makes the Ritz-stability exit

@@ -130,8 +130,9 @@ def _is_compact(x) -> bool:
 
 
 def _vector_aux(v, fill, compact: bool):
-    """The (2-or-3, E) aux operand shared by every matvec-style kernel
-    (apply_weighted_cov, storage_matvec): compensated bf16 halves of the
+    """The (2-or-3, E) aux operand of the separable storage kernels
+    (storage_matvec — NOT apply_weighted_cov, whose VPU form reads the
+    plain f32 vector; round 4): compensated bf16 halves of the
     f32 vector (+ bf16 fill row) on the compact path; ``[v, 0, (fill)]``
     f32 rows on the exact-f32 path. ONE implementation so a precision or
     layout fix (e.g. the _compensated_split jit-annihilation guard)
