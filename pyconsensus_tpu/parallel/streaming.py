@@ -28,13 +28,29 @@ certainty, and NA participation — all column-local given the reputation —
 with the per-row ``na @ certainty`` partials accumulated panel by panel.
 
 Host memory holds only E-vectors (fill, certainty, outcomes, ...); device
-memory holds one panel plus three R x R accumulators. Algorithms:
-``"sztorc"`` (above) and ``"k-means"`` (out-of-core Lloyd — host-resident
-(k, E) centroids, two passes per Lloyd iteration; conformity = cluster
-reputation mass, the in-memory variant's rule; cross-panel accumulation
-order differs, so agreement is to accumulation precision — bit-exact in
-the x64 test harness, float-noise-level on an f32 device). Iterative
-redistribution (``max_iterations > 1``)
+memory holds one panel plus three R x R accumulators. Algorithms (round 4
+extended streaming to the full algorithm table minus dbscan-jit):
+
+- ``"sztorc"`` — as above;
+- ``"fixed-variance"`` / ``"ica"`` — the full nonzero covariance spectrum
+  already lives in the SAME Gram accumulator G (the eigh-gram route,
+  streamed): top-k scores are ``M (U / ||A^T u_c||)``, explained
+  fractions come from G's eigenvalues, per-component direction fixes run
+  through the same S-based closed form, and ica's whitening/FastICA loop
+  operates on the small (R, k) score block — no extra pass over the
+  source beyond sztorc's;
+- ``"hierarchical"`` / ``"dbscan"`` — the host-clustering hybrids: the
+  R x R squared-distance matrix derives from S alone
+  (``S_ii - 2 S_ij + S_jj``), so ONE pass accumulates it and every
+  redistribution iteration is host-side clustering arithmetic
+  (pipeline._consensus_hybrid semantics, fill-pinned distances);
+- ``"k-means"`` (out-of-core Lloyd — host-resident
+  (k, E) centroids, two passes per Lloyd iteration; conformity = cluster
+  reputation mass, the in-memory variant's rule; cross-panel accumulation
+  order differs, so agreement is to accumulation precision — bit-exact in
+  the x64 test harness, float-noise-level on an f32 device).
+
+Iterative redistribution (``max_iterations > 1``)
 costs one accumulation pass per executed iteration, because G and M
 follow the iterating reputation; S and the interpolate fill are pinned to
 the initial reputation (reference semantics) and computed once.
@@ -66,16 +82,19 @@ from .mesh import effective_median_block
 __all__ = ["streaming_consensus"]
 
 
-@functools.partial(jax.jit, static_argnames=("tolerance", "with_s"))
+@functools.partial(jax.jit, static_argnames=("tolerance", "with_s",
+                                             "with_gm"))
 def _pass1_panel(panel, fill_rep, weight_rep, scaled, mins, maxs, valid,
-                 tolerance: float, with_s: bool):
+                 tolerance: float, with_s: bool, with_gm: bool = True):
     """One event panel -> (G, M[, S]) contributions.
 
     ``fill_rep`` is the INITIAL reputation (interpolate fills are computed
     once, reference semantics); ``weight_rep`` is the current iteration's
     reputation (weighted means and the Gram weighting follow it).
     ``S = F F^T`` depends only on the filled matrix, which is fixed across
-    iterations — ``with_s`` skips it after the first accumulation pass.
+    iterations — ``with_s`` skips it after the first accumulation pass;
+    ``with_gm=False`` (the hybrid-clustering pass, which only needs S)
+    skips the centering and the two spectrum contractions instead.
     ``valid`` masks the zero-padded tail of the last panel out of every
     cross-panel accumulator."""
     acc = weight_rep.dtype
@@ -83,11 +102,14 @@ def _pass1_panel(panel, fill_rep, weight_rep, scaled, mins, maxs, valid,
     filled, present = jk.interpolate_masked(rescaled, fill_rep, scaled,
                                             tolerance)
     F = jnp.where(valid[None, :], filled, 0.0)
-    mu = weight_rep @ F                             # (P,), zero on padding
-    D = jnp.where(valid[None, :], F - mu[None, :], 0.0)
-    A = D * jnp.sqrt(jnp.clip(weight_rep, 0.0, None))[:, None]
-    G = jnp.matmul(A, A.T, preferred_element_type=acc)
-    M = jnp.matmul(D, A.T, preferred_element_type=acc)
+    if with_gm:
+        mu = weight_rep @ F                         # (P,), zero on padding
+        D = jnp.where(valid[None, :], F - mu[None, :], 0.0)
+        A = D * jnp.sqrt(jnp.clip(weight_rep, 0.0, None))[:, None]
+        G = jnp.matmul(A, A.T, preferred_element_type=acc)
+        M = jnp.matmul(D, A.T, preferred_element_type=acc)
+    else:
+        G = M = jnp.zeros((panel.shape[0], panel.shape[0]), dtype=acc)
     if with_s:
         S = jnp.matmul(F, F.T, preferred_element_type=acc)
         return G, M, S
@@ -280,7 +302,8 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     R×R accumulators come back replicated). ``panel_events`` is rounded
     up to a multiple of the mesh's event-axis size.
 
-    ``n_hosts > 1``: multi-host out-of-core (sztorc only) — each host
+    ``n_hosts > 1``: multi-host out-of-core (every algorithm except
+    k-means — the others reduce to R×R statistics) — each host
     streams only panels ``host_id::n_hosts`` (``host_id`` defaults to
     ``jax.process_index()``), the R×R sufficient statistics all-reduce
     across hosts once per iteration, and the disjoint per-panel output
@@ -346,17 +369,26 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         raise ValueError(f"reports must be 2-D, got {reports_src.shape}")
     R, E = reports_src.shape
     p = params if params is not None else ConsensusParams()
-    if p.algorithm not in ("sztorc", "k-means"):
-        raise ValueError("streaming_consensus supports algorithm='sztorc' "
-                         "or 'k-means'")
+    if p.algorithm not in ("sztorc", "k-means", "ica", "fixed-variance",
+                           "hierarchical", "dbscan"):
+        raise ValueError(
+            "streaming_consensus supports algorithm='sztorc', "
+            "'fixed-variance', 'ica', 'k-means', 'hierarchical', or "
+            "'dbscan' (round 4 extended it beyond sztorc/k-means: the "
+            "multi-component spectrum comes from the same R x R Gram "
+            "accumulator, and the hybrid clustering distance matrix "
+            "derives from the S = F F^T accumulator)")
     P = int(panel_events)
     if P < 1:
         raise ValueError("panel_events must be >= 1")
     multi = n_hosts is not None and int(n_hosts) > 1
     if multi:
-        if p.algorithm != "sztorc":
-            raise ValueError("multi-host streaming supports "
-                             "algorithm='sztorc'")
+        if p.algorithm == "k-means":
+            raise ValueError(
+                "multi-host streaming does not support 'k-means' (its "
+                "Lloyd passes would need per-iteration distance "
+                "collectives); every other algorithm multi-hosts via the "
+                "R x R statistic allreduce")
         if host_id is None:
             host_id = jax.process_index()
         host_id, n_hosts = int(host_id), int(n_hosts)
@@ -458,10 +490,77 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     this_rep = fill_rep
     S = None
     kmeans_seeds = None
+    sq_dists = None
+    ica_converged = None
     converged = False
     iterations = 0
     score_rep = fill_rep
     u_over_nAu = jnp.zeros((R,), dtype=dtype)
+
+    def dirfix_S(scores, rep_ref):
+        """direction_fixed_scores in closed form over the S = F F^T
+        accumulator: ``||w^T F - rep^T F||^2 = (w-rep)^T S (w-rep)`` —
+        same normalize guard, tie-break, and non-negative winning
+        orientation."""
+        set1 = scores + jnp.abs(jnp.min(scores))
+        set2 = scores - jnp.max(scores)
+
+        def sq_dist_to_old(w):
+            d = w - rep_ref
+            return d @ S @ d
+
+        ref_ind = (sq_dist_to_old(jk.normalize(set1))
+                   - sq_dist_to_old(jk.normalize(set2)))
+        return jnp.where(ref_ind <= 0.0, set1, -set2)
+
+    def accumulate_stats(weight_rep, with_s, with_gm=True):
+        """One pass over the source: (G, M[, S]) with the given Gram
+        weighting, allreduced across hosts when multi-host.
+        ``with_gm=False`` accumulates S only (the hybrid-clustering
+        pass — the spectrum contractions would be discarded)."""
+        G = jnp.zeros((R, R), dtype=dtype)
+        M = jnp.zeros((R, R), dtype=dtype)
+        S_acc = jnp.zeros((R, R), dtype=dtype) if with_s else None
+        for _, _, block, sc, mn, mx, valid in panels():
+            dG, dM, dS = _pass1_panel(block, fill_rep, weight_rep, sc, mn,
+                                      mx, valid, tol, with_s, with_gm)
+            if with_gm:
+                G, M = G + dG, M + dM
+            if with_s:
+                S_acc = S_acc + dS
+        if allreduce is not None:
+            # sum the R x R partials across hosts in ONE stacked
+            # collective (each allreduce is a blocking DCN round-trip);
+            # every host then runs the identical eigh/score/
+            # redistribution arithmetic
+            stats = ([G, M] if with_gm else []) + ([S_acc] if with_s
+                                                  else [])
+            reduced = allreduce(jnp.stack(stats))
+            if with_gm:
+                G, M = reduced[0], reduced[1]
+            if with_s:
+                S_acc = reduced[-1]
+        return G, M, S_acc
+
+    def top_components(G, M, rep_ref, k):
+        """Top-k loadings' scores + explained fractions off the Gram
+        accumulator (the full nonzero covariance spectrum lives in G —
+        jax_kernels.weighted_prin_comps' eigh-gram route, streamed).
+        Returns ``(scores (R, k), explained (k,), U (R, k), nAu (k,))``."""
+        denom = 1.0 - jnp.sum(rep_ref ** 2)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        eigvals, eigvecs = jnp.linalg.eigh(G / denom)
+        lam = jnp.clip(eigvals[::-1][:k], 0.0, None)
+        U = eigvecs[:, ::-1][:, :k]                       # (R, k)
+        # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
+        nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0, None))
+        scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
+        total = jnp.sum(jnp.clip(eigvals, 0.0, None))
+        explained = jnp.where(total > 0.0,
+                              lam / jnp.where(total > 0.0, total, 1.0),
+                              jnp.zeros_like(lam))
+        return scores, explained, U, nAu
+
     for _ in range(max(p.max_iterations, 1)):
         if p.algorithm == "k-means":
             from ..models.clustering import KMEANS_ITERS
@@ -472,48 +571,64 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
             adj = _streaming_kmeans_conformity(
                 panels, fill_rep, rep_k, kmeans_seeds, P,
                 p.num_clusters, KMEANS_ITERS, tol, dtype)
+        elif p.algorithm in ("hierarchical", "dbscan"):
+            from ..models import clustering as cl
+
+            if sq_dists is None:
+                # the clustering inputs are fill-pinned, so ONE pass over
+                # the source serves every redistribution iteration: the
+                # R x R squared distances derive from S alone —
+                # ||f_i - f_j||^2 = S_ii - 2 S_ij + S_jj
+                _, _, S = accumulate_stats(fill_rep, True, with_gm=False)
+                d = jnp.diag(S)
+                sq_dists = np.asarray(
+                    jnp.clip(d[:, None] - 2.0 * S + d[None, :], 0.0, None),
+                    dtype=np.float64)
+            placeholder = np.empty((R, 0))
+            rep_host = np.asarray(rep_k, dtype=np.float64)
+            if p.algorithm == "hierarchical":
+                adj = cl.hierarchical_conformity(
+                    placeholder, rep_host, p.hierarchy_threshold,
+                    sq_dists=sq_dists)
+            else:
+                adj = cl.dbscan_conformity(
+                    placeholder, rep_host, p.dbscan_eps,
+                    p.dbscan_min_samples, sq_dists=sq_dists)
+            adj = jnp.asarray(adj, dtype=dtype)
         else:
-            G = jnp.zeros((R, R), dtype=dtype)
-            M = jnp.zeros((R, R), dtype=dtype)
-            with_s = S is None
-            S_acc = jnp.zeros((R, R), dtype=dtype) if with_s else None
-            for _, _, block, sc, mn, mx, valid in panels():
-                dG, dM, dS = _pass1_panel(block, fill_rep, rep_k, sc, mn,
-                                          mx, valid, tol, with_s)
-                G, M = G + dG, M + dM
-                if with_s:
-                    S_acc = S_acc + dS
-            if allreduce is not None:
-                # sum the R x R partials across hosts in ONE stacked
-                # collective (each allreduce is a blocking DCN
-                # round-trip); every host then runs the identical
-                # eigh/score/redistribution arithmetic
-                stats = [G, M] + ([S_acc] if with_s else [])
-                reduced = allreduce(jnp.stack(stats))
-                G, M = reduced[0], reduced[1]
-                if with_s:
-                    S_acc = reduced[2]
-            if with_s:
+            G, M, S_acc = accumulate_stats(rep_k, S is None)
+            if S is None:
                 S = S_acc
+            if p.algorithm == "sztorc":
+                # k=1 of the shared eigh-gram scorer (eigvecs[:, -1] is
+                # exactly U[:, 0])
+                scores_k, _, U, nAu = top_components(G, M, rep_k, 1)
+                u_over_nAu = U[:, 0] / jnp.where(nAu[0] == 0.0, 1.0,
+                                                 nAu[0])
+                adj = dirfix_S(scores_k[:, 0], rep_k)
+            elif p.algorithm == "fixed-variance":
+                from ..models.sztorc import _component_weights_jax
 
-            denom = 1.0 - jnp.sum(rep_k ** 2)
-            denom = jnp.where(denom == 0.0, 1.0, denom)
-            _, eigvecs = jnp.linalg.eigh(G / denom)
-            u = eigvecs[:, -1]
-            nAu = jnp.sqrt(jnp.clip(u @ G @ u, 0.0, None))
-            u_over_nAu = u / jnp.where(nAu == 0.0, 1.0, nAu)
-            scores = M @ u_over_nAu
+                k = int(min(p.max_components, min(R, E)))
+                scores, explained, U, nAu = top_components(G, M, rep_k, k)
+                w = _component_weights_jax(explained, p.variance_threshold)
+                adj = jnp.zeros((R,), dtype=dtype)
+                for c in range(k):
+                    adj = adj + w[c] * dirfix_S(scores[:, c], rep_k)
+                u_over_nAu = U[:, 0] / jnp.where(nAu[0] == 0.0, 1.0,
+                                                 nAu[0])
+            else:                            # ica
+                from ..models.ica import (_EPS, _canon_signs_jax,
+                                          _conv_tol, _fastica_one_unit)
 
-            set1 = scores + jnp.abs(jnp.min(scores))
-            set2 = scores - jnp.max(scores)
-
-            def sq_dist_to_old(w, rep_ref=rep_k):
-                d = w - rep_ref
-                return d @ S @ d
-
-            ref_ind = (sq_dist_to_old(jk.normalize(set1))
-                       - sq_dist_to_old(jk.normalize(set2)))
-            adj = jnp.where(ref_ind <= 0.0, set1, -set2)
+                k = max(1, int(min(p.max_components, min(R, E) - 1)))
+                scores, _, _, _ = top_components(G, M, rep_k, k)
+                std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS,
+                                        None))
+                Z = _canon_signs_jax(scores / std[None, :])
+                w_ica, conv = _fastica_one_unit(Z, _conv_tol(Z.dtype))
+                ica_converged = bool(conv)
+                adj = dirfix_S(Z @ w_ica, rep_k)
         this_rep = jk.row_reward_weighted(adj, rep_k)
         new_rep = jk.smooth(this_rep, rep_k, p.alpha)
         delta = float(jnp.max(jnp.abs(new_rep - rep_k)))
@@ -539,7 +654,8 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     for start, stop, block, sc, mn, mx, _ in panels():
         raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
             block, fill_rep, score_rep, smooth_rep, u_over_nAu, sc, mn, mx,
-            tol, with_loading=p.algorithm == "sztorc",
+            tol,
+            with_loading=p.algorithm in ("sztorc", "fixed-variance"),
             median_block=effective_median_block(p.median_block, mesh))
         width = stop - start
         outcomes_raw[start:stop] = np.asarray(raw)[:width]
@@ -566,7 +682,10 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         prow, na_count = r_stack
     first_loading = nk.canon_sign(first_loading)
     result_extra = ({"first_loading": first_loading}
-                    if p.algorithm == "sztorc" else {})
+                    if p.algorithm in ("sztorc", "fixed-variance") else {})
+    if p.algorithm == "ica":
+        # the chaotic-fallback observability flag, like every other path
+        result_extra["ica_converged"] = bool(ica_converged)
 
     # ---- finalize the bonus accounting (numpy_kernels semantics) --------
     total_cert = certainty.sum()

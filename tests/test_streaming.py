@@ -113,9 +113,76 @@ class TestStreamingParity:
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
         with pytest.raises(ValueError, match="sztorc"):
             streaming_consensus(
-                reports, params=ConsensusParams(algorithm="hierarchical"))
+                reports, params=ConsensusParams(algorithm="dbscan-jit"))
         with pytest.raises(ValueError, match="panel_events"):
             streaming_consensus(reports, panel_events=0)
+
+    @pytest.mark.parametrize("algorithm", ["fixed-variance", "ica"])
+    @pytest.mark.parametrize("panel_events,max_iterations",
+                             [(5, 1), (64, 3)])
+    def test_multi_component_matches_in_memory(self, rng, algorithm,
+                                               panel_events,
+                                               max_iterations):
+        """Round 4 (VERDICT r3 item 4): ica / fixed-variance out-of-core
+        — the top-k spectrum streamed off the Gram accumulator must
+        reproduce the in-memory eigh-gram route (identical math, panel-
+        accumulated; x64 makes the comparison tight)."""
+        import jax.numpy as jnp
+        reports, _ = collusion_reports(rng, R=18, E=23, liars=5,
+                                       na_frac=0.1)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm=algorithm, pca_method="eigh-gram",
+                            max_iterations=max_iterations,
+                            any_scaled=False, has_na=True)
+        ref = _consensus_core_light(
+            jnp.asarray(reports), jnp.full((R,), 1.0 / R),
+            jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E), p)
+        out = streaming_consensus(reports, panel_events=panel_events,
+                                  params=p)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]),
+                                   atol=1e-8)
+        np.testing.assert_allclose(out["certainty"],
+                                   np.asarray(ref["certainty"]), atol=1e-8)
+        assert out["iterations"] == int(ref["iterations"])
+        if algorithm == "ica":
+            assert "ica_converged" in out
+            assert "first_loading" not in out
+        else:
+            np.testing.assert_allclose(
+                np.abs(out["first_loading"]),
+                np.abs(np.asarray(ref["first_loading"])), atol=1e-7)
+
+    @pytest.mark.parametrize("algorithm", ["hierarchical", "dbscan"])
+    def test_hybrid_clustering_matches_in_memory(self, rng, algorithm):
+        """Hybrid clustering out-of-core: the R x R distance matrix
+        derived from the streamed S accumulator must reproduce the
+        in-memory hybrid path (same host clustering, fill-pinned
+        distances)."""
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.models.pipeline import _consensus_hybrid
+        reports, _ = collusion_reports(rng, R=14, E=19, liars=4,
+                                       na_frac=0.1)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm=algorithm, max_iterations=2,
+                            any_scaled=False, has_na=True)
+        ref = _consensus_hybrid(
+            jnp.asarray(reports), jnp.full((R,), 1.0 / R),
+            jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E), p,
+            light=True)
+        out = streaming_consensus(reports, panel_events=6, params=p)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]),
+                                   atol=1e-8)
+        np.testing.assert_allclose(out["participation_rows"],
+                                   np.asarray(ref["participation_rows"]),
+                                   atol=1e-8)
+        assert out["iterations"] == int(ref["iterations"])
 
     @pytest.mark.parametrize("panel_events", [4, 64])
     def test_kmeans_matches_in_memory(self, rng, panel_events):
@@ -168,11 +235,16 @@ class TestStreamingParity:
         np.testing.assert_allclose(sharded["certainty"],
                                    plain["certainty"], atol=1e-9)
 
-    def test_multi_host_split_matches_single(self, rng):
+    @pytest.mark.parametrize("algorithm", ["sztorc", "ica",
+                                           "fixed-variance",
+                                           "hierarchical"])
+    def test_multi_host_split_matches_single(self, rng, algorithm):
         """Two 'hosts' (threads with a rendezvous-sum allreduce) each
         stream half the panels; the reduced result must equal the
         single-host resolution bit-for-bit on snapped outcomes. The same
-        wiring runs across real OS processes in test_distributed.py."""
+        wiring runs across real OS processes in test_distributed.py.
+        Round 4: every algorithm whose scoring reduces to R x R
+        statistics multi-hosts the same way, not just sztorc."""
         import threading
 
         bar = threading.Barrier(2)
@@ -193,7 +265,7 @@ class TestStreamingParity:
 
         reports, _ = collusion_reports(rng, R=16, E=23, liars=4,
                                        na_frac=0.1)
-        p = ConsensusParams(algorithm="sztorc", max_iterations=3)
+        p = ConsensusParams(algorithm=algorithm, max_iterations=3)
         plain = streaming_consensus(reports, panel_events=4, params=p)
 
         results = {}
@@ -226,7 +298,7 @@ class TestStreamingParity:
 
     def test_multi_host_validation(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
-        with pytest.raises(ValueError, match="sztorc"):
+        with pytest.raises(ValueError, match="k-means"):
             streaming_consensus(reports,
                                 params=ConsensusParams(algorithm="k-means"),
                                 host_id=0, n_hosts=2)
