@@ -22,6 +22,7 @@ Three drivers:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -612,6 +613,64 @@ consensus_light_jit = jax.jit(_consensus_core_light,
                               static_argnames=("p",))
 
 
+@functools.partial(jax.jit, static_argnames=("tolerance", "storage_dtype"))
+def _hybrid_prep_jit(reports, reputation, scaled, mins, maxs,
+                     tolerance: float, storage_dtype: str):
+    """Hybrid path device phase A (jitted so it runs on single-controller
+    AND multi-process global arrays alike): fill + the R×R squared
+    distances. An event-sharded input turns the O(R²E) contraction into
+    per-shard partials + one R×R all-reduce. The compact storage cast
+    happens in here too — eager casts on multi-process global arrays
+    raise."""
+    old_rep = jk.normalize(reputation)
+    rescaled = jk.rescale(reports, scaled, mins, maxs)
+    filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
+                                            tolerance)
+    sq = cl.pairwise_sq_dists_jax(filled)
+    # host clustering runs on f64 regardless; the device-side outcome and
+    # bonus phases honor the compact storage dtype like the jit path
+    # (mask threading makes the cast safe — NaN lives in `present`)
+    if storage_dtype:
+        filled = filled.astype(jnp.dtype(storage_dtype))
+    return old_rep, rescaled, filled, present, sq
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _hybrid_finish_jit(filled, present, rep_dev, scaled, mins,
+                       maxs, p: ConsensusParams):
+    """Hybrid path device phase B (jitted — see ``_hybrid_prep_jit``):
+    outcome resolution + certainty/bonuses with the host-clustered final
+    reputation. ``present`` is the only memory of where the NaNs were —
+    the raw reports are never re-read."""
+    outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
+        present, filled, rep_dev, scaled, p.catch_tolerance,
+        any_scaled=p.any_scaled, has_na=p.has_na,
+        median_block=p.median_block, n_scaled=p.n_scaled)
+    outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins,
+                                         maxs)
+    extras = jk.certainty_and_bonuses(present, filled, rep_dev,
+                                      outcomes_adjusted, scaled,
+                                      p.catch_tolerance)
+    result = {
+        "outcomes_raw": outcomes_raw,
+        "outcomes_adjusted": outcomes_adjusted,
+        "outcomes_final": outcomes_final,
+        "na_row": jk.row_any(~present, rep_dev.dtype),
+    }
+    result.update(extras)
+    return result
+
+
+@functools.lru_cache(maxsize=16)
+def _replicate_pair_jit(shard):
+    """Cached jitted reshard pinning BOTH hybrid host inputs (the R×R
+    distances and the reputation) replicated — GSPMD is otherwise free to
+    leave either output device-sharded, and ``addressable_data(0)`` on a
+    sharded array would hand each process a partial copy. One compile per
+    sharding (a fresh lambda per call would retrace every resolution)."""
+    return jax.jit(lambda a, b: (a, b), out_shardings=(shard, shard))
+
+
 def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
                       p: ConsensusParams, light: bool = False):
     """Hybrid path for hierarchical/DBSCAN: rescale/interpolate/outcomes run
@@ -623,19 +682,14 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     device, where an event-sharded input turns the O(R²E) contraction
     into per-shard partials + one R×R all-reduce) plus the reputation
     vector. ``light=True`` (the sharded front-end) additionally omits the
-    (R, E) result keys (``_LARGE_RESULT_KEYS``). Single-controller only:
-    the device phases run eagerly, which JAX forbids on multi-process
-    (non-fully-addressable) global arrays — the sharded front-end
-    enforces this."""
-    if light and jax.process_count() > 1:
-        # the guard lives HERE so every front-end (sharded_consensus AND
-        # ShardedOracle) raises the clear error instead of an opaque
-        # non-fully-addressable-array RuntimeError mid-pipeline
-        raise ValueError(
-            "hybrid clustering (hierarchical/dbscan) shards only on "
-            "single-controller meshes: the host-clustering step runs "
-            f"eagerly; use a jit algorithm {JIT_ALGORITHMS} on "
-            "multi-process meshes")
+    (R, E) result keys (``_LARGE_RESULT_KEYS``).
+
+    Multi-process meshes work since round 4 (VERDICT r3 item 9): the
+    device phases are jitted (eager ops on non-fully-addressable global
+    arrays raise), the R×R distances are jit-replicated so every process
+    reads an identical local copy, and each process runs the identical
+    deterministic host clustering — labels need no broadcast because
+    every controller derives the same ones from the same bits."""
     if p.storage_dtype == "int8":
         # mirror _consensus_core's gate: this path stores the INTERPOLATED
         # matrix, whose continuous weighted-mean fills an int8 half-unit
@@ -644,15 +698,28 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
             "storage_dtype='int8' is not supported by the hybrid "
             "clustering path: the interpolated fill values are continuous "
             "— use storage_dtype='bfloat16'")
-    old_rep = jk.normalize(reputation)
-    rescaled = jk.rescale(reports, scaled, mins, maxs)
-    filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
-                                            p.catch_tolerance)
-    # host clustering runs on f64 regardless; the device-side outcome and
-    # bonus phases honor the compact storage dtype like the jit path
-    # (mask threading makes the cast safe — NaN locations live in `present`)
-    if p.storage_dtype:
-        filled = filled.astype(jnp.dtype(p.storage_dtype))
+    # multi-process when the inputs are non-fully-addressable global
+    # arrays (NOT process_count() alone: a plain Oracle call with local
+    # arrays inside a distributed runtime must keep the single-controller
+    # flow — local arrays have no mesh to reshard over)
+    multiproc = not getattr(reports, "is_fully_addressable", True)
+    old_rep, rescaled, filled, present, sq_dev = _hybrid_prep_jit(
+        reports, reputation, scaled, mins, maxs, p.catch_tolerance,
+        p.storage_dtype)
+    repl = None
+    if multiproc:
+        # pin the R×R distances AND the reputation replicated (a jitted
+        # reshard — a collective when GSPMD left either sharded) and read
+        # the process-local copies; replicas are bitwise identical, so
+        # every process's host clustering below is too
+        repl = jax.sharding.NamedSharding(reports.sharding.mesh,
+                                          jax.sharding.PartitionSpec())
+        sq_dev, old_rep_r = _replicate_pair_jit(repl)(sq_dev, old_rep)
+        sq = np.asarray(sq_dev.addressable_data(0), dtype=np.float64)
+        rep = np.asarray(old_rep_r.addressable_data(0), dtype=np.float64)
+    else:
+        sq = np.asarray(sq_dev, dtype=np.float64)
+        rep = np.asarray(old_rep, dtype=np.float64)
 
     # shape-only placeholder: with sq_dists supplied, the clustering
     # functions never touch the matrix itself — a device->host pull +
@@ -660,8 +727,6 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     filled_host = np.empty((filled.shape[0], 0))
     # the clustering inputs (filled reports, hence distances) are
     # loop-invariant — only reputation changes across iterations
-    sq = np.asarray(cl.pairwise_sq_dists_jax(filled), dtype=np.float64)
-    rep = np.asarray(old_rep, dtype=np.float64)
     this_rep = rep
     converged = False
     iterations = 0
@@ -681,30 +746,25 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
             converged = True
             break
 
-    rep_dev = jnp.asarray(rep, dtype=filled.dtype)
-    outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
-        present, filled, rep_dev, scaled, p.catch_tolerance,
-        any_scaled=p.any_scaled, has_na=p.has_na,
-        median_block=p.median_block, n_scaled=p.n_scaled)
-    outcomes_final = jk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
-    extras = jk.certainty_and_bonuses(present, filled, rep_dev,
-                                      outcomes_adjusted, scaled,
-                                      p.catch_tolerance)
+    dtype = jnp.asarray(0.0).dtype
+    if multiproc:
+        rep_dev = jax.device_put(jnp.asarray(rep, dtype=dtype), repl)
+        this_dev = jax.device_put(jnp.asarray(this_rep, dtype=dtype), repl)
+    else:
+        rep_dev = jnp.asarray(rep, dtype=dtype)
+        this_dev = jnp.asarray(this_rep, dtype=dtype)
     result = {
         "original": reports,
         "rescaled": rescaled,
         "filled": filled,
         "old_rep": old_rep,
-        "this_rep": jnp.asarray(this_rep, dtype=filled.dtype),
+        "this_rep": this_dev,
         "smooth_rep": rep_dev,
-        "na_row": jk.row_any(jnp.isnan(reports), old_rep.dtype),
-        "outcomes_raw": outcomes_raw,
-        "outcomes_adjusted": outcomes_adjusted,
-        "outcomes_final": outcomes_final,
         "iterations": iterations,
         "convergence": converged,
     }
-    result.update(extras)
+    result.update(_hybrid_finish_jit(filled, present, rep_dev,
+                                     scaled, mins, maxs, p))
     if light:
         for key in _LARGE_RESULT_KEYS:
             result.pop(key)

@@ -462,14 +462,15 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
     )
     p = _resolve_sharded_params(p, R, E, mesh)
     if p.algorithm in HYBRID_ALGORITHMS:
-        # hybrid host-clustering path: the device phases run eagerly on the
-        # placed (event-sharded) arrays — GSPMD propagates the sharding
-        # op-by-op, so the O(R²E) distance contraction reduces per-shard
-        # with one R×R all-reduce — and only the R×R distances plus O(R)
-        # vectors ever cross to host (pipeline._consensus_hybrid light
-        # mode, which also rejects multi-process meshes for BOTH
-        # front-ends). The host merge loop itself is the documented R
-        # ceiling (docs/API.md scale envelope).
+        # hybrid host-clustering path: the device phases run JITTED on
+        # the placed (event-sharded) arrays — GSPMD turns the O(R²E)
+        # distance contraction into per-shard partials + one R×R
+        # all-reduce — and only the R×R distances plus O(R) vectors ever
+        # cross to host (pipeline._consensus_hybrid light mode; since
+        # round 4 this includes multi-process meshes — every controller
+        # clusters an identical replicated distance copy). The host
+        # merge loop itself is the documented R ceiling (docs/API.md
+        # scale envelope).
         if reputation is None:
             reputation = _default_reputation_placed(mesh, R)
         placed = _place_inputs(mesh, reports, reputation, scaled, mins,

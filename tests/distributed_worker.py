@@ -127,3 +127,20 @@ if len(sys.argv) > 3:
     print("FUSEDREP", ",".join(f"{float(v):.6f}"
                                for v in np.asarray(out_f["smooth_rep"])),
           flush=True)
+
+    # phase 6 (round 4): the hybrid host-clustering path on a
+    # MULTI-PROCESS mesh — jitted device phases, the R x R distances
+    # replicated across both controllers, each clustering the identical
+    # local copy (no broadcast needed; pipeline._consensus_hybrid)
+    out_h = sharded_consensus(
+        reports, mesh=mesh,
+        params=ConsensusParams(algorithm="hierarchical",
+                               max_iterations=2))
+    h_all = multihost_utils.process_allgather(out_h["outcomes_adjusted"],
+                                              tiled=True)
+    print("HYBRID", ",".join(f"{float(v):g}" for v in np.ravel(h_all)),
+          flush=True)
+    print("HYBRIDREP", ",".join(f"{float(v):.6f}"
+                                for v in np.asarray(
+                                    out_h["smooth_rep"].addressable_data(0))),
+          flush=True)

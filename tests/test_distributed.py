@@ -154,3 +154,17 @@ def test_two_process_global_mesh(tmp_path):
         f0, np.asarray(local_f["outcomes_adjusted"]))
     np.testing.assert_allclose(fr0, np.asarray(local_f["smooth_rep"]),
                                atol=1e-5)
+
+    # phase 6 (round 4): hybrid host-clustering on the multi-process
+    # mesh — identical across processes (each clusters the same
+    # replicated distance copy) and equal to the single-process hybrid
+    h0, h1 = (parse("HYBRID", o) for o in outputs)
+    hr0, hr1 = (parse("HYBRIDREP", o) for o in outputs)
+    np.testing.assert_array_equal(h0, h1)
+    np.testing.assert_allclose(hr0, hr1, atol=1e-6)
+    ref_h = Oracle(reports=reports, backend="jax", max_iterations=2,
+                   algorithm="hierarchical").consensus()
+    np.testing.assert_array_equal(h0,
+                                  ref_h["events"]["outcomes_adjusted"])
+    np.testing.assert_allclose(hr0, ref_h["agents"]["smooth_rep"],
+                               atol=1e-5)
