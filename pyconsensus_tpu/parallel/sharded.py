@@ -46,6 +46,12 @@ _KNOWN_PCA = ("auto", "eigh-cov") + _SHARDABLE_PCA
 #: algorithms needing the full top-k spectrum (first-PC-only power iteration
 #: cannot serve them; the R×R Gram eigh is their scalable exact path)
 _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
+#: event-width ceiling for the multi-component FUSED storage path —
+#: measured round 4: the storage-kernel orth-iter beats XLA bf16 at
+#: 8192×32768 and loses at 10000×100000 (see _use_fused_resolution);
+#: 65536 = the power of two nearest the midpoint of the two measured
+#: endpoints (66384), refine with a finer sweep
+_MULTI_FUSED_MAX_E = 65536
 
 
 def _pick_pca_method(params: ConsensusParams, n_reporters: int,
@@ -142,7 +148,8 @@ def _resolve_sharded_params(p: ConsensusParams, R: int, E: int,
             "storage_dtype='int8' requires the fused kernel path (real "
             "TPU backend, power-family pca_method, VMEM-fitting shape, "
             "scaled events at most a small static minority; sztorc on "
-            "any mesh, fixed-variance/ica single-device only) — this "
+            "any mesh, fixed-variance/ica single-device AND event width "
+            "<= _MULTI_FUSED_MAX_E) — this "
             "configuration resolved to the XLA "
             f"path (mesh devices={mesh.devices.size}, event axis="
             f"{mesh.shape.get('event', 1)}, algorithm={p.algorithm!r}, "
@@ -212,9 +219,17 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
         algo_ok = params.algorithm in ("sztorc",) + _MULTI_COMPONENT_ALGOS
         if params.algorithm in _MULTI_COMPONENT_ALGOS:
             # the k-row accumulators of the matmat sweeps need their own
-            # VMEM fit (k+1 rows: components + the csum row)
+            # VMEM fit (k+1 rows: components + the csum row) — and a
+            # measured WIDTH ceiling: the storage-kernel orth-iter wins
+            # at moderate event widths (int8 199 ms vs XLA bf16 237 at
+            # 8192x32768) but LOSES at north-star width (same-session
+            # interleaved A/B at 10000x100000: fused 8.90 res/s vs XLA
+            # 9.96 — the k-row accumulators shrink the row panels and
+            # per-panel overhead swamps the byte savings). Gate at the
+            # midpoint pending a finer sweep.
             k = min(params.max_components, n_reporters)
-            multi_fit = matmat_kernels_fit(e_local, k + 1, itemsize)
+            multi_fit = (matmat_kernels_fit(e_local, k + 1, itemsize)
+                         and e_local <= _MULTI_FUSED_MAX_E)
         else:
             multi_fit = True
     # the same next-multiple-of-8 the kernel pads to (a no-op for
@@ -282,7 +297,8 @@ def resolve_auto_storage(p: ConsensusParams, R: int, E: int,
       pipeline resolves onto the fused kernel path (real TPU backend,
       power-family PCA after resolution, VMEM-fitting shape; sztorc on
       any device count via parallel.fused_sharded, fixed-variance/ica on
-      a single device via the storage orthogonal iteration) AND the
+      a single device within the _MULTI_FUSED_MAX_E width ceiling via
+      the storage orthogonal iteration) AND the
       workload is all-binary — the half-unit int8 lattice is exact there
       and quarters the f32 HBM traffic;
     - **bfloat16** otherwise (halves the traffic; catch-snapped binary

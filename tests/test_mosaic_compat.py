@@ -46,17 +46,25 @@ def _sub_jaxprs(v):
             yield from _sub_jaxprs(item)
 
 
+#: operand dtypes whose comparisons Mosaic rejects ("Target does not
+#: support this comparison"): bf16 cmpf (BENCH_r02's crash) and — probed
+#: on v5e in round 4 — i8 cmpi as well; i32 cmpi and f32 cmpf are the
+#: legal forms
+_ILLEGAL_CMP_DTYPES = (jnp.bfloat16, jnp.int8)
+
+
 def _assert_no_bf16_compare(closed_jaxpr, ctx):
     bad = []
     for eqn in _iter_eqns(closed_jaxpr.jaxpr):
         if eqn.primitive.name in _CMP_PRIMS:
             for invar in eqn.invars:
                 aval = getattr(invar, "aval", None)
-                if aval is not None and getattr(aval, "dtype", None) is not None \
-                        and aval.dtype == jnp.bfloat16:
+                dt = getattr(aval, "dtype", None)
+                if dt is not None and any(dt == d
+                                          for d in _ILLEGAL_CMP_DTYPES):
                     bad.append(f"{eqn.primitive.name} on {aval} in {ctx}")
-    assert not bad, ("Mosaic rejects bf16 arith.cmpf; found bf16 "
-                     "comparisons:\n" + "\n".join(bad))
+    assert not bad, ("Mosaic rejects bf16 arith.cmpf and i8 cmpi; found "
+                     "illegal comparisons:\n" + "\n".join(bad))
 
 
 _R, _E = 16, 256
@@ -94,6 +102,30 @@ def test_no_bf16_compare_in_dirfix_kernel(dtype):
     jaxpr = jax.make_jaxpr(lambda *a: fn(a[0], a[1], a[2], fill=a[3]))(
         x, rep, loading, fill)
     _assert_no_bf16_compare(jaxpr, f"scores_dirfix_pass[{dtype}]")
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])
+def test_no_illegal_compare_in_storage_kernels(dtype):
+    """The separable storage kernels (mesh + multi-component paths) carry
+    the same comparison-legality invariant — including the i8 cmpi class
+    that first hit real hardware in round 4 (interpret-mode tests cannot
+    see Mosaic rejections, so the jaxpr guard is the regression pin)."""
+    from pyconsensus_tpu.ops.pallas_kernels import (storage_matmat,
+                                                    storage_matvec,
+                                                    storage_rows_matmat)
+
+    x = _storage(dtype)
+    fill = jnp.full((_E,), 0.5, jnp.float32)
+    v = jnp.ones((_E,), jnp.float32)
+    V = jnp.ones((_E, 3), jnp.float32)
+    W = jnp.ones((4, _R), jnp.float32)
+    for name, fn, args in (
+            ("storage_matvec", storage_matvec, (x, v)),
+            ("storage_matmat", storage_matmat, (x, V)),
+            ("storage_rows_matmat", storage_rows_matmat, (x, W))):
+        jaxpr = jax.make_jaxpr(
+            functools.partial(fn, fill=fill, interpret=True))(*args)
+        _assert_no_bf16_compare(jaxpr, f"{name}[{dtype}]")
 
 
 @pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])

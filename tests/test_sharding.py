@@ -306,22 +306,31 @@ class TestFusedResolution:
                                    atol=5e-6)
 
     def test_multi_component_gate(self, monkeypatch):
-        """The single-device fused gate admits ica/fixed-variance (with
-        the matmat-kernel VMEM fit); the mesh gate stays sztorc-only."""
+        """The single-device fused gate admits ica/fixed-variance up to
+        the measured event-width ceiling (with the matmat-kernel VMEM
+        fit); beyond it the XLA path wins (round-4 A/B) and the gate
+        closes; the mesh gate stays sztorc-only."""
         import pyconsensus_tpu.parallel.sharded as sh
         monkeypatch.setattr(sh.jax, "default_backend", lambda: "tpu")
         for algo in ("ica", "fixed-variance"):
             p = ConsensusParams(algorithm=algo, any_scaled=False,
                                 pca_method="power",
                                 storage_dtype="bfloat16")
-            assert sh._use_fused_resolution(p, 10_000, 100_000, 1), algo
-            assert not sh._use_fused_resolution(p, 10_000, 100_000, 8), algo
-            # auto-storage picks int8 for the all-binary single-device case
+            assert sh._use_fused_resolution(p, 10_000, 32_768, 1), algo
+            # north-star width: measured slower than XLA — gate closed
+            assert not sh._use_fused_resolution(p, 10_000, 100_000, 1), algo
+            assert not sh._use_fused_resolution(p, 10_000, 32_768, 8), algo
+            # auto-storage picks int8 for the all-binary single-device
+            # case within the width ceiling, bfloat16 (XLA) beyond it
             mesh1 = make_mesh(batch=1, event=1)
             storage, why = sh.resolve_auto_storage(
                 ConsensusParams(algorithm=algo, any_scaled=False,
-                                has_na=True), 10_000, 100_000, mesh1)
+                                has_na=True), 10_000, 32_768, mesh1)
             assert storage == "int8", why
+            storage, why = sh.resolve_auto_storage(
+                ConsensusParams(algorithm=algo, any_scaled=False,
+                                has_na=True), 10_000, 100_000, mesh1)
+            assert storage == "bfloat16", why
 
     def test_gate_scaled_fraction(self, monkeypatch):
         """On TPU the gate admits a small static scaled fraction and rejects
