@@ -288,12 +288,7 @@ def main(argv: Optional[Sequence[str]] = None,
                      '{"scaled": ..., "min": ..., "max": ...} object)')
     if args.panel_events < 1:
         ap.error("--panel-events must be >= 1")
-    # reject EXPLICIT options --stream cannot honor (rather than silently
-    # overriding them); an unset --iterations defaults per mode below
-    if args.stream and args.algorithm == "dbscan-jit":
-        ap.error("--stream resolves out-of-core with every algorithm "
-                 "except dbscan-jit (see streaming_consensus); drop the "
-                 "conflicting --algorithm flag or --stream")
+    # an unset --iterations defaults per mode below
     if args.iterations is None:
         # streaming pays one full pass over the file per iteration — default
         # to the cheap single-iteration resolution there

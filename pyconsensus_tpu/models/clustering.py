@@ -197,17 +197,22 @@ def _dbscan_jit_labels_np(d2: np.ndarray, eps: float,
     return out.astype(np.int64)
 
 
-def dbscan_jit_conformity_np(reports_filled, reputation, eps, min_samples):
+def dbscan_jit_conformity_np(reports_filled, reputation, eps, min_samples,
+                             sq_dists=None):
     """``dbscan-jit`` conformity, numpy backend (parity anchor for
-    :func:`dbscan_jit_conformity_jax`)."""
-    X = np.asarray(reports_filled, dtype=np.float64)
+    :func:`dbscan_jit_conformity_jax`). ``sq_dists`` may supply the R×R
+    squared distances (e.g. the streaming path's S-derived matrix) —
+    the reports matrix is then never touched."""
     rep = np.asarray(reputation, dtype=np.float64)
-    labels = _dbscan_jit_labels_np(_pairwise_sq_dists_np(X), float(eps),
-                                   int(min_samples))
+    d2 = (np.asarray(sq_dists, dtype=np.float64) if sq_dists is not None
+          else _pairwise_sq_dists_np(
+              np.asarray(reports_filled, dtype=np.float64)))
+    labels = _dbscan_jit_labels_np(d2, float(eps), int(min_samples))
     return _cluster_mass(labels, rep)
 
 
-def dbscan_jit_conformity_jax(reports_filled, reputation, eps, min_samples):
+def dbscan_jit_conformity_jax(reports_filled, reputation, eps, min_samples,
+                              sq_dists=None):
     """Fully on-device DBSCAN conformity (SURVEY.md §7 M3 stretch: the
     jit-compatible DBSCAN variant).
 
@@ -226,10 +231,12 @@ def dbscan_jit_conformity_jax(reports_filled, reputation, eps, min_samples):
     Monte-Carlo simulator, unlike the hybrid host DBSCAN.
     """
     acc = reputation.dtype
-    X = reports_filled.astype(acc)
     rep = reputation
-    R = X.shape[0]
-    d2 = pairwise_sq_dists_jax(X)
+    R = reports_filled.shape[0]
+    # sq_dists (e.g. the streaming path's S-derived matrix) makes the
+    # reports operand dead — the caller may pass a (R, 0) placeholder
+    d2 = (sq_dists if sq_dists is not None
+          else pairwise_sq_dists_jax(reports_filled.astype(acc)))
     nbr = d2 <= eps * eps
     core = jnp.sum(nbr, axis=1) >= min_samples
     adj = nbr & core[None, :] & core[:, None]

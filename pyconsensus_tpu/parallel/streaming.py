@@ -29,7 +29,7 @@ with the per-row ``na @ certainty`` partials accumulated panel by panel.
 
 Host memory holds only E-vectors (fill, certainty, outcomes, ...); device
 memory holds one panel plus three R x R accumulators. Algorithms (round 4
-extended streaming to the full algorithm table minus dbscan-jit):
+extended streaming to the FULL algorithm table):
 
 - ``"sztorc"`` — as above;
 - ``"fixed-variance"`` / ``"ica"`` — the full nonzero covariance spectrum
@@ -39,11 +39,12 @@ extended streaming to the full algorithm table minus dbscan-jit):
   through the same S-based closed form, and ica's whitening/FastICA loop
   operates on the small (R, k) score block — no extra pass over the
   source beyond sztorc's;
-- ``"hierarchical"`` / ``"dbscan"`` — the host-clustering hybrids: the
-  R x R squared-distance matrix derives from S alone
+- ``"hierarchical"`` / ``"dbscan"`` / ``"dbscan-jit"`` — the clustering
+  variants: the R x R squared-distance matrix derives from S alone
   (``S_ii - 2 S_ij + S_jj``), so ONE pass accumulates it and every
-  redistribution iteration is host-side clustering arithmetic
-  (pipeline._consensus_hybrid semantics, fill-pinned distances);
+  redistribution iteration is clustering arithmetic — host-side for the
+  hybrids (pipeline._consensus_hybrid semantics, fill-pinned
+  distances), fully on-device for dbscan-jit;
 - ``"k-means"`` (out-of-core Lloyd — host-resident
   (k, E) centroids, two passes per Lloyd iteration; conformity = cluster
   reputation mass, the in-memory variant's rule; cross-panel accumulation
@@ -370,14 +371,13 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     R, E = reports_src.shape
     p = params if params is not None else ConsensusParams()
     if p.algorithm not in ("sztorc", "k-means", "ica", "fixed-variance",
-                           "hierarchical", "dbscan"):
+                           "hierarchical", "dbscan", "dbscan-jit"):
         raise ValueError(
-            "streaming_consensus supports algorithm='sztorc', "
-            "'fixed-variance', 'ica', 'k-means', 'hierarchical', or "
-            "'dbscan' (round 4 extended it beyond sztorc/k-means: the "
-            "multi-component spectrum comes from the same R x R Gram "
-            "accumulator, and the hybrid clustering distance matrix "
-            "derives from the S = F F^T accumulator)")
+            f"streaming_consensus: unknown algorithm {p.algorithm!r} "
+            "(every algorithm streams since round 4: the multi-component "
+            "spectrum comes from the same R x R Gram accumulator, and "
+            "the clustering distance matrices derive from the S = F F^T "
+            "accumulator)")
     P = int(panel_events)
     if P < 1:
         raise ValueError("panel_events must be >= 1")
@@ -571,7 +571,7 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
             adj = _streaming_kmeans_conformity(
                 panels, fill_rep, rep_k, kmeans_seeds, P,
                 p.num_clusters, KMEANS_ITERS, tol, dtype)
-        elif p.algorithm in ("hierarchical", "dbscan"):
+        elif p.algorithm in ("hierarchical", "dbscan", "dbscan-jit"):
             from ..models import clustering as cl
 
             if sq_dists is None:
@@ -581,20 +581,28 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
                 # ||f_i - f_j||^2 = S_ii - 2 S_ij + S_jj
                 _, _, S = accumulate_stats(fill_rep, True, with_gm=False)
                 d = jnp.diag(S)
-                sq_dists = np.asarray(
-                    jnp.clip(d[:, None] - 2.0 * S + d[None, :], 0.0, None),
-                    dtype=np.float64)
-            placeholder = np.empty((R, 0))
-            rep_host = np.asarray(rep_k, dtype=np.float64)
-            if p.algorithm == "hierarchical":
-                adj = cl.hierarchical_conformity(
-                    placeholder, rep_host, p.hierarchy_threshold,
-                    sq_dists=sq_dists)
-            else:
-                adj = cl.dbscan_conformity(
-                    placeholder, rep_host, p.dbscan_eps,
+                sq_dists = jnp.clip(d[:, None] - 2.0 * S + d[None, :],
+                                    0.0, None)
+                if p.algorithm != "dbscan-jit":   # host clustering input
+                    sq_dists = np.asarray(sq_dists, dtype=np.float64)
+            if p.algorithm == "dbscan-jit":
+                # fully on-device clustering against the streamed
+                # distances — the (R, 0) placeholder is never touched
+                adj = cl.dbscan_jit_conformity_jax(
+                    jnp.zeros((R, 0), dtype=dtype), rep_k, p.dbscan_eps,
                     p.dbscan_min_samples, sq_dists=sq_dists)
-            adj = jnp.asarray(adj, dtype=dtype)
+            else:
+                placeholder = np.empty((R, 0))
+                rep_host = np.asarray(rep_k, dtype=np.float64)
+                if p.algorithm == "hierarchical":
+                    adj = cl.hierarchical_conformity(
+                        placeholder, rep_host, p.hierarchy_threshold,
+                        sq_dists=sq_dists)
+                else:
+                    adj = cl.dbscan_conformity(
+                        placeholder, rep_host, p.dbscan_eps,
+                        p.dbscan_min_samples, sq_dists=sq_dists)
+                adj = jnp.asarray(adj, dtype=dtype)
         else:
             G, M, S_acc = accumulate_stats(rep_k, S is None)
             if S is None:

@@ -111,11 +111,32 @@ class TestStreamingParity:
 
     def test_rejects_unsupported(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
-        with pytest.raises(ValueError, match="sztorc"):
+        with pytest.raises(ValueError, match="unknown algorithm"):
             streaming_consensus(
-                reports, params=ConsensusParams(algorithm="dbscan-jit"))
+                reports, params=ConsensusParams(algorithm="nonsense"))
         with pytest.raises(ValueError, match="panel_events"):
             streaming_consensus(reports, panel_events=0)
+
+    def test_dbscan_jit_matches_in_memory(self, rng):
+        """dbscan-jit streams too (round 4 completed the table): the
+        on-device clustering runs against the S-derived distances."""
+        import jax.numpy as jnp
+        reports, _ = collusion_reports(rng, R=14, E=19, liars=4,
+                                       na_frac=0.1)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm="dbscan-jit", dbscan_eps=1.0,
+                            max_iterations=2, any_scaled=False,
+                            has_na=True)
+        ref = _consensus_core_light(
+            jnp.asarray(reports), jnp.full((R,), 1.0 / R),
+            jnp.zeros(E, dtype=bool), jnp.zeros(E), jnp.ones(E), p)
+        out = streaming_consensus(reports, panel_events=6, params=p)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]),
+                                   atol=1e-8)
+        assert out["iterations"] == int(ref["iterations"])
 
     @pytest.mark.parametrize("algorithm", ["fixed-variance", "ica"])
     @pytest.mark.parametrize("panel_events,max_iterations",
