@@ -231,12 +231,22 @@ def dbscan_jit_conformity_jax(reports_filled, reputation, eps, min_samples,
     Monte-Carlo simulator, unlike the hybrid host DBSCAN.
     """
     acc = reputation.dtype
-    rep = reputation
-    R = reports_filled.shape[0]
     # sq_dists (e.g. the streaming path's S-derived matrix) makes the
     # reports operand dead — the caller may pass a (R, 0) placeholder
     d2 = (sq_dists if sq_dists is not None
           else pairwise_sq_dists_jax(reports_filled.astype(acc)))
+    return dbscan_jit_same_matrix_jax(d2, eps, min_samples, acc) @ reputation
+
+
+def dbscan_jit_same_matrix_jax(d2, eps, min_samples, dtype):
+    """The reputation-independent half of
+    :func:`dbscan_jit_conformity_jax`: label propagation over the
+    precomputed R×R squared distances, returned as the same-cluster
+    matrix. Factored so callers that iterate reputation against FIXED
+    distances (the streaming path's fill-pinned S-derived matrix) can
+    cluster ONCE and pay one ``same @ rep`` matvec per redistribution
+    iteration instead of a full O(R² log R) propagation."""
+    R = d2.shape[0]
     nbr = d2 <= eps * eps
     core = jnp.sum(nbr, axis=1) >= min_samples
     adj = nbr & core[None, :] & core[:, None]
@@ -262,9 +272,8 @@ def dbscan_jit_conformity_jax(reports_filled, reputation, eps, min_samples,
     is_border = (~core) & (border_label < R)
     final = jnp.where(core, labels,
                       jnp.where(is_border, border_label, idx))
-    # conformity via the R x R same-label matmul (one MXU contraction)
-    same = (final[:, None] == final[None, :]).astype(acc)
-    return same @ rep
+    # the R x R same-label matrix: conformity is one MXU matvec against it
+    return (final[:, None] == final[None, :]).astype(dtype)
 
 
 def dbscan_conformity(reports_filled, reputation, eps, min_samples,

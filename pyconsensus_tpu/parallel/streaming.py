@@ -491,6 +491,7 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     S = None
     kmeans_seeds = None
     sq_dists = None
+    dbscan_same = None
     ica_converged = None
     converged = False
     iterations = 0
@@ -586,11 +587,18 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
                 if p.algorithm != "dbscan-jit":   # host clustering input
                     sq_dists = np.asarray(sq_dists, dtype=np.float64)
             if p.algorithm == "dbscan-jit":
-                # fully on-device clustering against the streamed
-                # distances — the (R, 0) placeholder is never touched
-                adj = cl.dbscan_jit_conformity_jax(
-                    jnp.zeros((R, 0), dtype=dtype), rep_k, p.dbscan_eps,
-                    p.dbscan_min_samples, sq_dists=sq_dists)
+                # fully on-device: the label propagation is
+                # reputation-independent, so cluster ONCE against the
+                # fill-pinned distances and pay one matvec per iteration
+                if dbscan_same is None:
+                    dbscan_same = jax.jit(cl.dbscan_jit_same_matrix_jax,
+                                          static_argnames=(
+                                              "eps", "min_samples",
+                                              "dtype"))(
+                        sq_dists, eps=float(p.dbscan_eps),
+                        min_samples=int(p.dbscan_min_samples),
+                        dtype=dtype)
+                adj = dbscan_same @ rep_k
             else:
                 placeholder = np.empty((R, 0))
                 rep_host = np.asarray(rep_k, dtype=np.float64)

@@ -445,3 +445,45 @@ class TestShardFusedFuzz:
                                       single["outcomes_adjusted"])
         np.testing.assert_allclose(sharded["smooth_rep"],
                                    single["smooth_rep"], atol=5e-6)
+
+    @pytest.mark.parametrize("trial", range(4))
+    def test_random_nondivisible_and_scaled(self, trial):
+        """Round-4 gate fuzz: ARBITRARY event counts (any pad width,
+        including entirely-padded trailing shards) composed with random
+        scaled-column minorities at random positions — parity against
+        the single-device fused path on every draw."""
+        rng = np.random.default_rng(500 + trial)
+        R_f = int(rng.integers(9, 40))
+        E_f = int(rng.integers(17, 95))          # arbitrary width
+        n_sc = int(rng.integers(0, max(1, E_f // 8)))
+        na = float(rng.uniform(0.0, 0.25))
+        reports, _ = collusion_reports(rng, R_f, E_f,
+                                       liars=max(2, R_f // 4), na_frac=na)
+        rep = rng.random(R_f) + 0.02
+        rep = rep / rep.sum()
+        if n_sc:
+            cols = rng.choice(E_f, size=n_sc, replace=False)
+            scaled = np.zeros(E_f, bool)
+            scaled[cols] = True
+            mins = np.where(scaled, -5.0, 0.0)
+            maxs = np.where(scaled, 15.0, 1.0)
+            with np.errstate(invalid="ignore"):
+                reports[:, scaled] = reports[:, scaled] * 20.0 - 5.0
+            p = base_params(any_scaled=True, n_scaled=n_sc,
+                            storage_dtype=str(rng.choice(["bfloat16", ""])),
+                            max_iterations=int(rng.integers(1, 3)))
+            sharded, single = run_both_scaled(reports, rep, p, scaled,
+                                              mins, maxs)
+            # random draws sit slightly above the curated fixtures'
+            # 5e-6 band (different psum orders through the power loop) —
+            # binary outcomes stay exact inside assert_scaled_parity
+            assert_scaled_parity(sharded, single, scaled, atol=5e-5)
+        else:
+            p = base_params(
+                storage_dtype=str(rng.choice(["int8", "bfloat16", ""])),
+                max_iterations=int(rng.integers(1, 3)))
+            sharded, single = run_both(reports, rep, p)
+            np.testing.assert_array_equal(sharded["outcomes_adjusted"],
+                                          single["outcomes_adjusted"])
+            np.testing.assert_allclose(sharded["smooth_rep"],
+                                       single["smooth_rep"], atol=5e-5)

@@ -117,6 +117,31 @@ class TestStreamingParity:
         with pytest.raises(ValueError, match="panel_events"):
             streaming_consensus(reports, panel_events=0)
 
+    def test_dbscan_jit_sq_dists_parity(self, rng):
+        """Both dbscan-jit backends must produce identical conformity
+        whether they compute distances themselves or receive them
+        precomputed (the streaming path's contract)."""
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.models import clustering as cl
+
+        X = rng.random((12, 7))
+        rep = np.full(12, 1.0 / 12)
+        sq = cl._pairwise_sq_dists_np(X)
+        direct_np = cl.dbscan_jit_conformity_np(X, rep, 0.8, 2)
+        given_np = cl.dbscan_jit_conformity_np(np.empty((12, 0)), rep,
+                                               0.8, 2, sq_dists=sq)
+        np.testing.assert_array_equal(direct_np, given_np)
+        direct_j = cl.dbscan_jit_conformity_jax(jnp.asarray(X),
+                                                jnp.asarray(rep), 0.8, 2)
+        given_j = cl.dbscan_jit_conformity_jax(
+            jnp.zeros((12, 0)), jnp.asarray(rep), 0.8, 2,
+            sq_dists=jnp.asarray(sq))
+        np.testing.assert_allclose(np.asarray(direct_j),
+                                   np.asarray(given_j), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(given_j), given_np,
+                                   atol=1e-9)
+
     def test_dbscan_jit_matches_in_memory(self, rng):
         """dbscan-jit streams too (round 4 completed the table): the
         on-device clustering runs against the S-derived distances."""
