@@ -30,6 +30,23 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_xla_map_regions():
+    """Release compiled executables between test MODULES.
+
+    The full suite compiles thousands of XLA programs into one process;
+    each holds mmap'd regions, and by ~92% of the suite the process sits
+    at the kernel's default ``vm.max_map_count`` (65530) — the next
+    native allocation then SEGFAULTS inside an XLA worker thread (first
+    hit in round 4 when the suite grew past ~550 tests; the crash landed
+    in whatever test compiled next, masquerading as a threading bug in
+    the sweep). Clearing per module keeps the count bounded (~40k peak)
+    at the cost of cross-module recompiles, which are rare — modules
+    share few (shape, params) keys."""
+    yield
+    jax.clear_caches()
+
+
 def collusion_reports(rng, R, E, liars, flip_rate=0.1, na_frac=0.0):
     """Shared synthetic-report builder: an honest majority reporting truth
     with per-entry flip noise, a block of coordinated liars reporting
