@@ -49,7 +49,10 @@ extended streaming to the FULL algorithm table):
   (k, E) centroids, two passes per Lloyd iteration; conformity = cluster
   reputation mass, the in-memory variant's rule; cross-panel accumulation
   order differs, so agreement is to accumulation precision — bit-exact in
-  the x64 test harness, float-noise-level on an f32 device).
+  the x64 test harness, float-noise-level on an f32 device). Multi-host,
+  the centroids stay event-local (each host owns the slices of its own
+  panels) and only the (R, k) distance accumulator crosses hosts, once
+  per Lloyd assignment pass.
 
 Iterative redistribution (``max_iterations > 1``)
 costs one accumulation pass per executed iteration, because G and M
@@ -193,7 +196,10 @@ def _streaming_kmeans_seeds(panels, fill_rep, E, R, k: int, tol: float):
 
     k = int(min(k, R))
     seeds = jnp.asarray(cl._seed_indices(R, k))
-    centroids = np.empty((k, E))
+    # zeros, not empty: under multi-host each host fills only its own
+    # panels' slices; the others stay zero and are never read (assignment
+    # and update passes touch only local slices)
+    centroids = np.zeros((k, E))
     for start, stop, block, sc, mn, mx, valid in panels():
         rows = _fill_rows_panel(block, fill_rep, seeds, sc, mn, mx, tol)
         centroids[:, start:stop] = np.asarray(rows)[:, :stop - start]
@@ -202,14 +208,23 @@ def _streaming_kmeans_seeds(panels, fill_rep, E, R, k: int, tol: float):
 
 def _streaming_kmeans_conformity(panels, fill_rep, rep, seed_centroids,
                                  P, k: int,
-                                 n_iters: int, tol: float, dtype):
+                                 n_iters: int, tol: float, dtype,
+                                 allreduce=None):
     """Out-of-core Lloyd following clustering.kmeans_conformity_np's
     rules (summation order differs across panels — agreement is to
     accumulation precision): evenly-spaced-row seeding, reputation-weighted centroid updates (empty
     clusters keep their centroid, zero-reputation clusters fall back to
     the plain mean), final assignment against the final centroids. Two
     passes over the source per Lloyd iteration plus one final assignment
-    pass; centroids live on host as a (k, E) array."""
+    pass; centroids live on host as a (k, E) array.
+
+    Multi-host (``allreduce`` given): centroids stay EVENT-LOCAL — every
+    centroid slice derives solely from the panels of the host that owns
+    them (seed rows, update numerators, and the keep-old fallback are all
+    per-event), so the only cross-host state is the (R, k) squared-
+    distance accumulator, summed once per assignment pass. Labels, the
+    global cluster weights/counts, and the returned conformity are then
+    identical on every host."""
     R = rep.shape[0]
     k = int(min(k, R))
     centroids = seed_centroids.copy()
@@ -224,6 +239,8 @@ def _streaming_kmeans_conformity(panels, fill_rep, rep, seed_centroids,
                        ((0, 0), (0, P - (stop - start)))), dtype=dtype)
             d2 += np.asarray(_kmeans_assign_panel(
                 block, fill_rep, cent, valid, sc, mn, mx, tol))
+        if allreduce is not None:     # disjoint event partials -> full d2
+            d2 = np.asarray(allreduce(d2), dtype=float)
         return np.argmin(d2, axis=1)
 
     for _ in range(n_iters):
@@ -303,13 +320,14 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
     R×R accumulators come back replicated). ``panel_events`` is rounded
     up to a multiple of the mesh's event-axis size.
 
-    ``n_hosts > 1``: multi-host out-of-core (every algorithm except
-    k-means — the others reduce to R×R statistics) — each host
+    ``n_hosts > 1``: multi-host out-of-core (every algorithm) — each host
     streams only panels ``host_id::n_hosts`` (``host_id`` defaults to
     ``jax.process_index()``), the R×R sufficient statistics all-reduce
-    across hosts once per iteration, and the disjoint per-panel output
-    slices sum-reduce at the end, so every host returns the identical
-    full result. ``allreduce`` defaults to a
+    across hosts once per iteration (k-means instead all-reduces its
+    (R, k) distance accumulator once per Lloyd assignment pass — its
+    centroid slices are event-local and never leave the owning host),
+    and the disjoint per-panel output slices sum-reduce at the end, so
+    every host returns the identical full result. ``allreduce`` defaults to a
     ``jax.distributed``/``process_allgather`` sum; pass a custom
     callable for other transports. Composes with ``mesh`` (each host's
     local chips shard its panels).
@@ -383,12 +401,6 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         raise ValueError("panel_events must be >= 1")
     multi = n_hosts is not None and int(n_hosts) > 1
     if multi:
-        if p.algorithm == "k-means":
-            raise ValueError(
-                "multi-host streaming does not support 'k-means' (its "
-                "Lloyd passes would need per-iteration distance "
-                "collectives); every other algorithm multi-hosts via the "
-                "R x R statistic allreduce")
         if host_id is None:
             host_id = jax.process_index()
         host_id, n_hosts = int(host_id), int(n_hosts)
@@ -571,7 +583,8 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
                     panels, fill_rep, E, R, p.num_clusters, tol)
             adj = _streaming_kmeans_conformity(
                 panels, fill_rep, rep_k, kmeans_seeds, P,
-                p.num_clusters, KMEANS_ITERS, tol, dtype)
+                p.num_clusters, KMEANS_ITERS, tol, dtype,
+                allreduce=allreduce)
         elif p.algorithm in ("hierarchical", "dbscan", "dbscan-jit"):
             from ..models import clustering as cl
 

@@ -144,3 +144,19 @@ if len(sys.argv) > 3:
                                 for v in np.asarray(
                                     out_h["smooth_rep"].addressable_data(0))),
           flush=True)
+
+    # phase 7 (round 4): multi-host out-of-core k-means — the one
+    # streaming variant whose cross-host state is NOT an R x R statistic:
+    # centroid slices stay event-local on the owning host, and the (R, k)
+    # distance accumulator all-reduces once per Lloyd assignment pass
+    # over the real gloo backend
+    k_out = streaming_consensus(
+        reports, panel_events=3,
+        params=ConsensusParams(algorithm="k-means", num_clusters=3,
+                               max_iterations=2),
+        n_hosts=2)
+    print("KMEANS", ",".join(f"{float(v):g}"
+                             for v in k_out["outcomes_adjusted"]),
+          flush=True)
+    print("KMEANSREP", ",".join(f"{float(v):.6f}"
+                                for v in k_out["smooth_rep"]), flush=True)

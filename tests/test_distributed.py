@@ -168,3 +168,18 @@ def test_two_process_global_mesh(tmp_path):
                                   ref_h["events"]["outcomes_adjusted"])
     np.testing.assert_allclose(hr0, ref_h["agents"]["smooth_rep"],
                                atol=1e-5)
+
+    # phase 7 (round 4): multi-host streamed k-means — event-local
+    # centroids with the (R, k) distance allreduce riding real gloo;
+    # identical across processes and equal to a single-process streamed
+    # run of the same matrix
+    k0, k1 = (parse("KMEANS", o) for o in outputs)
+    kr0, kr1 = (parse("KMEANSREP", o) for o in outputs)
+    np.testing.assert_array_equal(k0, k1)
+    np.testing.assert_allclose(kr0, kr1, atol=1e-6)
+    local_k = streaming_consensus(
+        reports, panel_events=3,
+        params=ConsensusParams(algorithm="k-means", num_clusters=3,
+                               max_iterations=2))
+    np.testing.assert_array_equal(k0, local_k["outcomes_adjusted"])
+    np.testing.assert_allclose(kr0, local_k["smooth_rep"], atol=1e-5)

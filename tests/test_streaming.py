@@ -283,14 +283,16 @@ class TestStreamingParity:
 
     @pytest.mark.parametrize("algorithm", ["sztorc", "ica",
                                            "fixed-variance",
-                                           "hierarchical"])
+                                           "hierarchical", "dbscan-jit",
+                                           "k-means"])
     def test_multi_host_split_matches_single(self, rng, algorithm):
         """Two 'hosts' (threads with a rendezvous-sum allreduce) each
         stream half the panels; the reduced result must equal the
         single-host resolution bit-for-bit on snapped outcomes. The same
         wiring runs across real OS processes in test_distributed.py.
-        Round 4: every algorithm whose scoring reduces to R x R
-        statistics multi-hosts the same way, not just sztorc."""
+        Round 4: every algorithm multi-hosts — the R x R statistic
+        variants via the stacked accumulator allreduce, k-means via its
+        (R, k) distance allreduce with event-local centroids."""
         import threading
 
         bar = threading.Barrier(2)
@@ -344,10 +346,6 @@ class TestStreamingParity:
 
     def test_multi_host_validation(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
-        with pytest.raises(ValueError, match="k-means"):
-            streaming_consensus(reports,
-                                params=ConsensusParams(algorithm="k-means"),
-                                host_id=0, n_hosts=2)
         with pytest.raises(ValueError, match="host_id"):
             streaming_consensus(reports, host_id=5, n_hosts=2)
         # default allreduce requires n_hosts == jax.process_count()
