@@ -281,21 +281,16 @@ class TestStreamingParity:
         np.testing.assert_allclose(sharded["certainty"],
                                    plain["certainty"], atol=1e-9)
 
-    @pytest.mark.parametrize("algorithm", ["sztorc", "ica",
-                                           "fixed-variance",
-                                           "hierarchical", "dbscan-jit",
-                                           "k-means"])
-    def test_multi_host_split_matches_single(self, rng, algorithm):
-        """Two 'hosts' (threads with a rendezvous-sum allreduce) each
-        stream half the panels; the reduced result must equal the
-        single-host resolution bit-for-bit on snapped outcomes. The same
-        wiring runs across real OS processes in test_distributed.py.
-        Round 4: every algorithm multi-hosts — the R x R statistic
-        variants via the stacked accumulator allreduce, k-means via its
-        (R, k) distance allreduce with event-local centroids."""
+    @staticmethod
+    def _run_multihost(reports, params, n_hosts, panel_events):
+        """Resolve on ``n_hosts`` threads with a rendezvous-sum allreduce;
+        returns ``{host_id: result}``. The barrier carries a timeout so a
+        host that skips a collective (the regression these tests guard
+        against) raises BrokenBarrierError into every peer instead of
+        deadlocking the suite."""
         import threading
 
-        bar = threading.Barrier(2)
+        bar = threading.Barrier(n_hosts, timeout=60)
         contrib = {}
         summed = {}
 
@@ -304,17 +299,12 @@ class TestStreamingParity:
                 contrib[i] = np.asarray(x)
                 bar.wait()
                 if i == 0:
-                    summed["v"] = contrib[0] + contrib[1]
+                    summed["v"] = sum(contrib[j] for j in range(n_hosts))
                 bar.wait()
                 out = summed["v"]
-                bar.wait()          # both read before the next round
+                bar.wait()          # all read before the next round
                 return out
             return allreduce
-
-        reports, _ = collusion_reports(rng, R=16, E=23, liars=4,
-                                       na_frac=0.1)
-        p = ConsensusParams(algorithm=algorithm, max_iterations=3)
-        plain = streaming_consensus(reports, panel_events=4, params=p)
 
         results = {}
         errors = []
@@ -322,27 +312,67 @@ class TestStreamingParity:
         def host(i):
             try:
                 results[i] = streaming_consensus(
-                    reports, panel_events=4, params=p, host_id=i,
-                    n_hosts=2, allreduce=make_allreduce(i))
+                    reports, panel_events=panel_events, params=params,
+                    host_id=i, n_hosts=n_hosts,
+                    allreduce=make_allreduce(i))
             except Exception as exc:       # surface thread failures
                 errors.append(exc)
                 bar.abort()
 
-        threads = [threading.Thread(target=host, args=(i,)) for i in (0, 1)]
+        threads = [threading.Thread(target=host, args=(i,), daemon=True)
+                   for i in range(n_hosts)]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=120)
         assert not errors, errors
-        for i in (0, 1):
-            np.testing.assert_array_equal(results[i]["outcomes_adjusted"],
+        assert not any(t.is_alive() for t in threads), "host thread hung"
+        return results
+
+    @staticmethod
+    def _assert_multihost_parity(results, plain):
+        for res in results.values():
+            np.testing.assert_array_equal(res["outcomes_adjusted"],
                                           plain["outcomes_adjusted"])
-            np.testing.assert_allclose(results[i]["smooth_rep"],
+            np.testing.assert_allclose(res["smooth_rep"],
                                        plain["smooth_rep"], atol=1e-9)
-            np.testing.assert_allclose(results[i]["participation_rows"],
+            np.testing.assert_allclose(res["participation_rows"],
                                        plain["participation_rows"],
                                        atol=1e-9)
-            assert results[i]["iterations"] == plain["iterations"]
+            assert res["iterations"] == plain["iterations"]
+
+    @pytest.mark.parametrize("algorithm", ["sztorc", "ica",
+                                           "fixed-variance",
+                                           "hierarchical", "dbscan-jit",
+                                           "k-means"])
+    def test_multi_host_split_matches_single(self, rng, algorithm):
+        """Two 'hosts' each stream half the panels; the reduced result
+        must equal the single-host resolution bit-for-bit on snapped
+        outcomes. The same wiring runs across real OS processes in
+        test_distributed.py. Round 4: every algorithm multi-hosts — the
+        R x R statistic variants via the stacked accumulator allreduce,
+        k-means via its (R, k) distance allreduce with event-local
+        centroids."""
+        reports, _ = collusion_reports(rng, R=16, E=23, liars=4,
+                                       na_frac=0.1)
+        p = ConsensusParams(algorithm=algorithm, max_iterations=3)
+        plain = streaming_consensus(reports, panel_events=4, params=p)
+        results = self._run_multihost(reports, p, n_hosts=2,
+                                      panel_events=4)
+        self._assert_multihost_parity(results, plain)
+
+    @pytest.mark.parametrize("algorithm", ["sztorc", "k-means"])
+    def test_more_hosts_than_panels(self, rng, algorithm):
+        """A host whose round-robin slice is EMPTY (3 hosts, 2 panels)
+        must still join every collective in lock-step with zero
+        contributions — the fragile case for any per-panel early-out."""
+        reports, _ = collusion_reports(rng, R=12, E=23, liars=3,
+                                       na_frac=0.1)
+        p = ConsensusParams(algorithm=algorithm, max_iterations=2)
+        plain = streaming_consensus(reports, panel_events=16, params=p)
+        results = self._run_multihost(reports, p, n_hosts=3,
+                                      panel_events=16)
+        self._assert_multihost_parity(results, plain)
 
     def test_multi_host_validation(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
