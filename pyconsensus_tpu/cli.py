@@ -170,14 +170,19 @@ def _run_streaming(args, bounds) -> None:
     from .parallel import streaming_consensus
     from .utils import trace
 
+    multi = args.hosts is not None and args.hosts > 1
     print(f"=== Streaming resolution of {args.file} "
           f"({args.panel_events} events/panel, "
-          f"{args.iterations} iteration(s)) ===")
+          f"{args.iterations} iteration(s)"
+          + (f", host {args.host_id}/{args.hosts}" if multi else "")
+          + ") ===")
     with trace(args.profile):
         out = streaming_consensus(
             args.file, event_bounds=bounds, panel_events=args.panel_events,
             params=ConsensusParams(algorithm=args.algorithm,
-                                   max_iterations=args.iterations))
+                                   max_iterations=args.iterations),
+            host_id=args.host_id if multi else None,
+            n_hosts=args.hosts if multi else None)
     if args.profile:
         print(f"  profiler trace written to {args.profile}")
     rep = out["smooth_rep"]
@@ -239,6 +244,21 @@ def main(argv: Optional[Sequence[str]] = None,
                          "is staged to .npy in row chunks)")
     ap.add_argument("--panel-events", type=int, default=8192,
                     help="with --stream: events per streamed panel")
+    ap.add_argument("--coordinator", metavar="ADDR",
+                    help="with --stream: join a MULTI-HOST streamed "
+                         "resolution — the coordinator's host:port (the "
+                         "same value on every host); run the same command "
+                         "on each host with its own --host-id. Each host "
+                         "streams its round-robin share of the event "
+                         "panels and the sufficient statistics all-reduce "
+                         "across hosts (parallel.streaming_consensus "
+                         "n_hosts semantics)")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="with --coordinator: total number of hosts in "
+                         "the launch")
+    ap.add_argument("--host-id", type=int, default=None,
+                    help="with --coordinator: this host's id in "
+                         "[0, --hosts)")
     ap.add_argument("--algorithm", default="sztorc", choices=ALGORITHMS)
     ap.add_argument("--backend", default="jax", choices=BACKENDS)
     ap.add_argument("--iterations", type=int, default=None,
@@ -271,6 +291,21 @@ def main(argv: Optional[Sequence[str]] = None,
 
     if args.stream and not args.file:
         ap.error("--stream requires --file")
+    multihost = (args.coordinator is not None or args.hosts is not None
+                 or args.host_id is not None)
+    if multihost:
+        if (args.coordinator is None or args.hosts is None
+                or args.host_id is None):
+            ap.error("--coordinator, --hosts, and --host-id must be "
+                     "given together")
+        if not args.stream:
+            ap.error("--coordinator requires --stream (multi-host "
+                     "resolution is the out-of-core deployment)")
+        if args.hosts < 2:
+            ap.error("--hosts must be >= 2 (a single host needs no "
+                     "coordinator)")
+        if not 0 <= args.host_id < args.hosts:
+            ap.error(f"--host-id {args.host_id} not in [0, {args.hosts})")
     if args.bounds and not args.file:
         ap.error("--bounds requires --file")
     file_bounds = None
@@ -288,6 +323,21 @@ def main(argv: Optional[Sequence[str]] = None,
                      '{"scaled": ..., "min": ..., "max": ...} object)')
     if args.panel_events < 1:
         ap.error("--panel-events must be >= 1")
+    if multihost:
+        # joined only after EVERY local validation above (including this
+        # host's copy of the reports file): a host that ap.error-exits
+        # after connecting would leave its peers wedged in their first
+        # collective. Must still precede the first backend-initializing
+        # jax call; raises (rather than degrading to an isolated
+        # single-host run) on a misconfigured launch
+        import os
+
+        if not os.path.isfile(args.file):
+            ap.error(f"--file: {args.file} is not a readable file")
+        from .parallel import initialize
+
+        initialize(coordinator_address=args.coordinator,
+                   num_processes=args.hosts, process_id=args.host_id)
     # an unset --iterations defaults per mode below
     if args.iterations is None:
         # streaming pays one full pass over the file per iteration — default

@@ -47,6 +47,32 @@ def _bound_xla_map_regions():
     jax.clear_caches()
 
 
+def free_port() -> int:
+    """An OS-assigned free TCP port for multi-process coordinator tests."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def worker_env() -> dict:
+    """Subprocess environment for multi-process distributed tests: forced
+    CPU platform with 2 virtual devices, gloo cross-process collectives,
+    and x64 to match this conftest. Set before the interpreter starts —
+    a sitecustomize hook may pre-import jax against the real accelerator
+    otherwise."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+        "JAX_ENABLE_X64": "1",
+    })
+    return env
+
+
 def collusion_reports(rng, R, E, liars, flip_rate=0.1, na_frac=0.0):
     """Shared synthetic-report builder: an honest majority reporting truth
     with per-entry flip noise, a block of coordinated liars reporting

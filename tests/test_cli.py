@@ -85,6 +85,91 @@ class TestCli:
             main(["--file", "/nonexistent/x.npy", "--stream"])
         assert "--stream" in capsys.readouterr().err
 
+    def test_stream_multihost_flags_validation(self, tmp_path, rng):
+        """--coordinator/--hosts/--host-id must come together, with
+        --stream, hosts >= 2, and host-id in range."""
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        base = ["--file", path, "--stream"]
+        for bad in ([*base, "--hosts", "2"],
+                    [*base, "--coordinator", "localhost:1"],
+                    [*base, "--coordinator", "localhost:1", "--hosts", "2"],
+                    ["--file", path, "--coordinator", "localhost:1",
+                     "--hosts", "2", "--host-id", "0"],     # no --stream
+                    [*base, "--coordinator", "localhost:1", "--hosts", "1",
+                     "--host-id", "0"],
+                    [*base, "--coordinator", "localhost:1", "--hosts", "2",
+                     "--host-id", "2"]):
+            with pytest.raises(SystemExit):
+                main(bad)
+
+    def test_stream_multihost_two_processes(self, tmp_path, rng):
+        """The real CLI deployment story: the same command on two OS
+        processes (each with its own --host-id) joins one distributed
+        runtime via --coordinator, splits the panels, and both print the
+        identical resolution — equal to a single-host --stream run.
+        Compared NUMERICALLY (the snapped outcome counts exactly, the
+        printed reputations at the cross-process tolerance the repo uses
+        elsewhere), never as raw text — logging noise and sub-print-digit
+        summation drift must not flake this."""
+        import re
+        import subprocess
+        import sys
+
+        from conftest import collusion_reports, free_port, worker_env
+        from pyconsensus_tpu.io import save_reports
+
+        reports, _ = collusion_reports(rng, R=14, E=21, liars=4,
+                                       na_frac=0.1)
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        port = free_port()
+        env = worker_env()
+        cmd = [sys.executable, "-m", "pyconsensus_tpu", "--file", path,
+               "--stream", "--panel-events", "6", "--iterations", "2"]
+
+        procs = [subprocess.Popen(
+            cmd + ["--coordinator", f"localhost:{port}", "--hosts", "2",
+                   "--host-id", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        outs = []
+        try:
+            for proc in procs:
+                out, _ = proc.communicate(timeout=180)
+                outs.append(out)
+        finally:
+            for proc in procs:       # never leak a peer blocked in a
+                if proc.poll() is None:  # cross-process collective
+                    proc.kill()
+        for proc, out in zip(procs, outs):
+            assert proc.returncode == 0, f"host failed:\n{out}"
+        assert "host 0/2" in outs[0] and "host 1/2" in outs[1]
+
+        single = subprocess.run(cmd, capture_output=True, text=True,
+                                env=env, timeout=180)
+        assert single.returncode == 0, single.stdout + single.stderr
+
+        def summary(text):
+            """(outcome-count line, {reporter: (smooth_rep, bonus)})."""
+            counts = re.search(r"outcomes 0/0\.5/1: (\d+/\d+/\d+)", text)
+            assert counts, text
+            rows = {int(m[0]): (float(m[1]), float(m[2])) for m in
+                    re.findall(r"^\s+(\d+)\s+([\d.e+-]+)\s+([\d.e+-]+)\s*$",
+                               text, re.M)}
+            assert len(rows) == 8, text          # the top-8 table
+            return counts.group(1), rows
+
+        c_single, rows_single = summary(single.stdout)
+        for out in outs:
+            c_host, rows_host = summary(out)
+            assert c_host == c_single            # snapped outcomes: exact
+            assert rows_host.keys() == rows_single.keys()
+            for rid, (rep, bonus) in rows_host.items():
+                np.testing.assert_allclose(
+                    (rep, bonus), rows_single[rid], atol=1e-5)
+
     def test_stream_csv_file(self, capsys, tmp_path, rng):
         """--stream on a .csv source stages in row chunks and resolves."""
         from conftest import collusion_reports

@@ -5,44 +5,22 @@ collectives (gloo CPU backend). This is the multi-host validation story —
 the same wiring a real ICI/DCN deployment uses, minus the hardware
 (SURVEY.md §5 distributed-communication row)."""
 
-import os
 import pathlib
-import socket
 import subprocess
 import sys
 
 import numpy as np
 import pytest
 
+from conftest import free_port, worker_env
 from pyconsensus_tpu import Oracle
 
 _WORKER = pathlib.Path(__file__).resolve().parent / "distributed_worker.py"
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
-def _worker_env() -> dict:
-    env = dict(os.environ)
-    env.update({
-        # must be set before the interpreter starts: a sitecustomize hook
-        # may pre-import jax against the real accelerator otherwise
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "PALLAS_AXON_POOL_IPS": "",
-        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
-        # match conftest's x64 so the parity asserts compare f64 to f64
-        "JAX_ENABLE_X64": "1",
-    })
-    return env
-
-
 def test_two_process_global_mesh(tmp_path):
-    port = _free_port()
-    env = _worker_env()
+    port = free_port()
+    env = worker_env()
     ckdir = tmp_path / "sweep-ck"
     procs = [subprocess.Popen([sys.executable, str(_WORKER), str(i),
                                str(port), str(ckdir)],
