@@ -65,10 +65,21 @@ def _print_table(title: str, headers: Sequence[str], rows) -> None:
 def _run_demo(name: str, reports, bounds, args) -> None:
     from .utils import trace
 
-    print(f"=== {name} ===")
-    oracle = Oracle(reports=reports, event_bounds=bounds,
-                    algorithm=args.algorithm, backend=args.backend,
-                    max_iterations=args.iterations, verbose=args.verbose)
+    if getattr(args, "shard", False):
+        from .parallel import ShardedOracle, make_mesh
+
+        mesh = make_mesh(batch=1)            # every local device on "event"
+        print(f"=== {name} (events sharded over "
+              f"{mesh.devices.size} device(s)) ===")
+        oracle = ShardedOracle(reports=reports, event_bounds=bounds,
+                               algorithm=args.algorithm, backend="jax",
+                               mesh=mesh, max_iterations=args.iterations,
+                               verbose=args.verbose)
+    else:
+        print(f"=== {name} ===")
+        oracle = Oracle(reports=reports, event_bounds=bounds,
+                        algorithm=args.algorithm, backend=args.backend,
+                        max_iterations=args.iterations, verbose=args.verbose)
     with trace(args.profile):
         result = oracle.consensus()
     if args.profile:
@@ -171,16 +182,29 @@ def _run_streaming(args, bounds) -> None:
     from .utils import trace
 
     multi = args.hosts is not None and args.hosts > 1
+    mesh = None
+    if args.shard:
+        import jax
+
+        from .parallel import make_mesh
+
+        # each host's OWN devices shard its round-robin panels (the
+        # streaming_consensus mesh contract) — a global multi-process
+        # mesh would put different hosts' different panels behind
+        # cross-process collectives and deadlock
+        mesh = make_mesh(batch=1, devices=jax.local_devices())
     print(f"=== Streaming resolution of {args.file} "
           f"({args.panel_events} events/panel, "
           f"{args.iterations} iteration(s)"
           + (f", host {args.host_id}/{args.hosts}" if multi else "")
+          + (f", {mesh.devices.size} device(s)" if mesh is not None else "")
           + ") ===")
     with trace(args.profile):
         out = streaming_consensus(
             args.file, event_bounds=bounds, panel_events=args.panel_events,
             params=ConsensusParams(algorithm=args.algorithm,
                                    max_iterations=args.iterations),
+            mesh=mesh,
             host_id=args.host_id if multi else None,
             n_hosts=args.hosts if multi else None)
     if args.profile:
@@ -242,6 +266,13 @@ def main(argv: Optional[Sequence[str]] = None,
                          "passes over event panels; for matrices larger "
                          "than device memory; .npy is memory-mapped, .csv "
                          "is staged to .npy in row chunks)")
+    ap.add_argument("--shard", action="store_true",
+                    help="resolve with events sharded over EVERY local "
+                         "device (ShardedOracle / GSPMD mesh; "
+                         "backend=jax only). Composes with --stream: "
+                         "each streamed panel is placed event-sharded so "
+                         "the out-of-core path uses every chip's HBM "
+                         "bandwidth")
     ap.add_argument("--panel-events", type=int, default=8192,
                     help="with --stream: events per streamed panel")
     ap.add_argument("--coordinator", metavar="ADDR",
@@ -291,6 +322,13 @@ def main(argv: Optional[Sequence[str]] = None,
 
     if args.stream and not args.file:
         ap.error("--stream requires --file")
+    if args.shard:
+        if args.backend != "jax":
+            ap.error("--shard requires --backend jax (the mesh path is "
+                     "GSPMD)")
+        if args.simulate:
+            ap.error("--shard does not apply to --simulate (the sweep is "
+                     "vmap-batched, not event-sharded)")
     multihost = (args.coordinator is not None or args.hosts is not None
                  or args.host_id is not None)
     if multihost:

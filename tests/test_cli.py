@@ -85,6 +85,41 @@ class TestCli:
             main(["--file", "/nonexistent/x.npy", "--stream"])
         assert "--stream" in capsys.readouterr().err
 
+    def test_shard_example(self, capsys):
+        """--shard resolves the demo on the full local-device mesh and
+        prints the identical tables to the single-device run (the tiny
+        x64 example is far inside the %.6f print resolution)."""
+        assert main(["--example"]) == 0
+        plain = capsys.readouterr().out
+        assert main(["--example", "--shard"]) == 0
+        sharded = capsys.readouterr().out
+        assert "sharded over 8 device(s)" in sharded
+
+        def tables(text):  # everything from the Reporters table down
+            lines = text.splitlines()
+            return lines[lines.index("Reporters"):]
+
+        assert tables(sharded) == tables(plain)
+
+    def test_shard_stream(self, capsys, tmp_path, rng):
+        """--shard composes with --stream: panels are event-sharded."""
+        from conftest import collusion_reports
+        from pyconsensus_tpu.io import save_reports
+        reports, _ = collusion_reports(rng, R=12, E=20, liars=3,
+                                       na_frac=0.1)
+        path = str(save_reports(tmp_path / "r.npy", reports))
+        assert main(["--file", path, "--stream", "--shard",
+                     "--panel-events", "8"]) == 0
+        out = capsys.readouterr().out
+        assert "8 device(s)" in out
+        assert "outcomes 0/0.5/1" in out
+
+    def test_shard_validation(self):
+        with pytest.raises(SystemExit):
+            main(["--example", "--shard", "--backend", "numpy"])
+        with pytest.raises(SystemExit):
+            main(["--simulate", "--shard"])
+
     def test_stream_multihost_flags_validation(self, tmp_path, rng):
         """--coordinator/--hosts/--host-id must come together, with
         --stream, hosts >= 2, and host-id in range."""
@@ -126,8 +161,12 @@ class TestCli:
         path = str(save_reports(tmp_path / "r.npy", reports))
         port = free_port()
         env = worker_env()
+        # --shard included: each host's LOCAL 2-device mesh shards its
+        # own round-robin panels (the composition that must NOT build a
+        # global multi-process mesh)
         cmd = [sys.executable, "-m", "pyconsensus_tpu", "--file", path,
-               "--stream", "--panel-events", "6", "--iterations", "2"]
+               "--stream", "--panel-events", "6", "--iterations", "2",
+               "--shard"]
 
         procs = [subprocess.Popen(
             cmd + ["--coordinator", f"localhost:{port}", "--hosts", "2",
