@@ -120,16 +120,28 @@ def _run_simulation(args) -> None:
 
     # the simulator is always the vmap-batched jax pipeline — --backend
     # applies to the demo runs only
+    mesh = None
+    mesh_note = ""
+    if args.shard:
+        import jax
+
+        from .parallel import make_mesh
+
+        # trials sharded over every local device (pure data parallelism)
+        mesh = make_mesh(batch=len(jax.local_devices()), event=1,
+                         devices=jax.local_devices())
+        mesh_note = f", trials over {mesh.devices.size} device(s)"
     lf = [0.0, 0.1, 0.2, 0.3, 0.4]
     var = [0.0, 0.1, 0.2]
     if args.rounds > 1:
         print(f"=== Monte-Carlo repeated-game sweep ({args.rounds} rounds, "
-              f"{args.trials} trials/cell, reputation carried) ===")
+              f"{args.trials} trials/cell, reputation carried"
+              f"{mesh_note}) ===")
         sim = RoundsSimulator(n_rounds=args.rounds,
                               n_reporters=args.reporters,
                               n_events=args.events,
                               max_iterations=args.iterations,
-                              algorithm=args.algorithm)
+                              algorithm=args.algorithm, mesh=mesh)
         res = _traced_sweep(sim, lf, var, args)
         headers = ["liar_frac"] + [f"round {r}" for r in (1, args.rounds)]
         for metric, title in (("correct_rate", "Correct-outcome rate "
@@ -152,11 +164,12 @@ def _run_simulation(args) -> None:
             print(f"round-trajectory plot written to {args.plot}")
         return
     print(f"=== Monte-Carlo collusion sweep "
-          f"({args.trials} trials/cell, batched jax pipeline) ===")
+          f"({args.trials} trials/cell, batched jax pipeline"
+          f"{mesh_note}) ===")
     sim = CollusionSimulator(n_reporters=args.reporters,
                              n_events=args.events,
                              max_iterations=args.iterations,
-                             algorithm=args.algorithm)
+                             algorithm=args.algorithm, mesh=mesh)
     res = _traced_sweep(sim, lf, var, args)
     headers = ["liar_frac"] + [f"var={v:g}" for v in var]
     rows = []
@@ -267,12 +280,11 @@ def main(argv: Optional[Sequence[str]] = None,
                          "than device memory; .npy is memory-mapped, .csv "
                          "is staged to .npy in row chunks)")
     ap.add_argument("--shard", action="store_true",
-                    help="resolve with events sharded over EVERY local "
-                         "device (ShardedOracle / GSPMD mesh; "
-                         "backend=jax only). Composes with --stream: "
-                         "each streamed panel is placed event-sharded so "
-                         "the out-of-core path uses every chip's HBM "
-                         "bandwidth")
+                    help="use EVERY local device (backend=jax only): "
+                         "demo/--file resolutions shard events over the "
+                         "mesh (ShardedOracle), --stream places each "
+                         "panel event-sharded, and --simulate shards the "
+                         "Monte-Carlo trial axis (pure data parallelism)")
     ap.add_argument("--panel-events", type=int, default=8192,
                     help="with --stream: events per streamed panel")
     ap.add_argument("--coordinator", metavar="ADDR",
@@ -322,13 +334,8 @@ def main(argv: Optional[Sequence[str]] = None,
 
     if args.stream and not args.file:
         ap.error("--stream requires --file")
-    if args.shard:
-        if args.backend != "jax":
-            ap.error("--shard requires --backend jax (the mesh path is "
-                     "GSPMD)")
-        if args.simulate:
-            ap.error("--shard does not apply to --simulate (the sweep is "
-                     "vmap-batched, not event-sharded)")
+    if args.shard and args.backend != "jax":
+        ap.error("--shard requires --backend jax (the mesh path is GSPMD)")
     multihost = (args.coordinator is not None or args.hosts is not None
                  or args.host_id is not None)
     if multihost:

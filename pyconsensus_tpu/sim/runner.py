@@ -41,7 +41,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .collusion import CollusionSimulator, _fold_keys, flat_grid
+from .collusion import CollusionSimulator, flat_grid
 
 __all__ = ["CheckpointedSweep"]
 
@@ -184,14 +184,13 @@ class CheckpointedSweep:
                 if not self._chunk_path(c).exists()]
 
     def _run_chunk(self, c: int) -> None:
-        import jax.numpy as jnp
-
         lo = c * self.trials_per_chunk
         hi = min(lo + self.trials_per_chunk, self.total)
-        keys = _fold_keys(self.seed, np.arange(lo, hi))
-        out = self.sim._batched(keys, jnp.asarray(self._grid_lf[lo:hi]),
-                                jnp.asarray(self._grid_var[lo:hi]))
-        host = {k: np.asarray(v) for k, v in out.items()}
+        # the shared dispatch point: a meshed simulator shards each
+        # chunk's trial axis exactly like a monolithic run() would
+        host = self.sim._dispatch(self.seed, np.arange(lo, hi),
+                                  self._grid_lf[lo:hi],
+                                  self._grid_var[lo:hi])
         self._write_atomic(self._chunk_path(c),
                            lambda t: np.savez(t, **host), suffix=".tmp.npz")
 

@@ -117,8 +117,14 @@ class TestCli:
     def test_shard_validation(self):
         with pytest.raises(SystemExit):
             main(["--example", "--shard", "--backend", "numpy"])
-        with pytest.raises(SystemExit):
-            main(["--simulate", "--shard"])
+
+    def test_shard_simulate(self, capsys):
+        """--simulate --shard: the MC trial axis rides the local mesh."""
+        assert main(["--simulate", "--shard", "--trials", "6",
+                     "--reporters", "8", "--events", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "trials over 8 device(s)" in out
+        assert "Correct-outcome rate" in out
 
     def test_stream_multihost_flags_validation(self, tmp_path, rng):
         """--coordinator/--hosts/--host-id must come together, with

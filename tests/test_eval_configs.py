@@ -160,3 +160,38 @@ class TestConfig5MonteCarlo10k:
         # heavy collusion degrades capture resistance monotonically-ish
         assert (res["mean"]["liar_rep_share"][4] >=
                 res["mean"]["liar_rep_share"][1]).all()
+
+    @pytest.mark.parametrize("n_trials", [5, 16])
+    def test_mesh_sweep_bit_identical(self, n_trials):
+        """The trial axis sharded over the 8-device mesh (SURVEY §7
+        replicate-and-vmap per chip) must reproduce the single-device
+        sweep BIT-identically — including the padded non-divisible
+        trial count (2 x 2 x 5 = 20 -> pad to 24)."""
+        from pyconsensus_tpu.parallel import make_mesh
+
+        kw = dict(n_reporters=10, n_events=6, max_iterations=2,
+                  power_iters=16)
+        lf, var = [0.0, 0.3], [0.0, 0.1]
+        plain = CollusionSimulator(**kw).run(lf, var, n_trials, seed=3)
+        meshed = CollusionSimulator(
+            mesh=make_mesh(batch=8, event=1), **kw).run(
+                lf, var, n_trials, seed=3)
+        for k in ("correct_rate", "liar_rep_share", "capture_rate"):
+            if k in plain:
+                np.testing.assert_array_equal(plain[k], meshed[k])
+
+    def test_mesh_rounds_sweep_bit_identical(self):
+        """RoundsSimulator's per-round trajectory metrics (trailing
+        axes) survive the trial-axis sharding + padding unchanged."""
+        from pyconsensus_tpu.parallel import make_mesh
+        from pyconsensus_tpu.sim import RoundsSimulator
+
+        kw = dict(n_rounds=3, n_reporters=10, n_events=6,
+                  max_iterations=1, power_iters=16)
+        lf, var = [0.0, 0.3], [0.1]
+        plain = RoundsSimulator(**kw).run(lf, var, 5, seed=1)
+        meshed = RoundsSimulator(
+            mesh=make_mesh(batch=8, event=1), **kw).run(lf, var, 5, seed=1)
+        np.testing.assert_array_equal(plain["liar_rep_share"],
+                                      meshed["liar_rep_share"])
+        assert plain["liar_rep_share"].shape[-1] == 3    # rounds axis

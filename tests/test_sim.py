@@ -200,6 +200,25 @@ class TestCheckpointedSweep:
             np.testing.assert_array_equal(got["mean"][key],
                                           mono["mean"][key], err_msg=key)
 
+    def test_meshed_simulator_matches_monolithic(self, tmp_path):
+        """A mesh= simulator inside CheckpointedSweep shards every
+        chunk's trial axis (the shared _dispatch point) and stays
+        bit-identical to a single-device monolithic run — chunk widths
+        here are non-multiples of the 8 devices, exercising the pad."""
+        from pyconsensus_tpu.parallel import make_mesh
+        from pyconsensus_tpu.sim import CheckpointedSweep
+        mono = self._sim().run(self.LF, self.VAR, self.T, seed=3)
+        meshed = CollusionSimulator(n_reporters=10, n_events=6,
+                                    max_iterations=2,
+                                    mesh=make_mesh(batch=8, event=1))
+        sweep = CheckpointedSweep(meshed, self.LF, self.VAR, self.T,
+                                  seed=3, checkpoint_dir=tmp_path / "ck",
+                                  trials_per_chunk=5)
+        assert sweep.run(host_id=0, n_hosts=1) == sweep.n_chunks
+        got = sweep.gather()
+        for key in ("correct_rate", "capture_rate", "liar_rep_share"):
+            np.testing.assert_array_equal(got[key], mono[key], err_msg=key)
+
     def test_crash_resume(self, tmp_path):
         from pyconsensus_tpu.sim import CheckpointedSweep
         sim = self._sim()
