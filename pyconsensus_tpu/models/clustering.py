@@ -39,6 +39,44 @@ __all__ = [
 
 KMEANS_ITERS = 32
 
+#: Shared DBSCAN neighborhood boundary band (same decision pattern as
+#: ``ops.numpy_kernels.MEDIAN_TIE_ATOL``): membership is decided against
+#: ``eps^2 + DBSCAN_D2_ATOL * max(1, max(d2))`` instead of the bare
+#: ``eps^2``. Rationale: squared distances come from the Gram expansion
+#: ``|x|^2 + |y|^2 - 2 x.y`` (the only form available to the streaming
+#: path's S-derived matrices), whose cancellation is inexact when rows
+#: share non-dyadic NA-fill values — numpy BLAS and XLA round it
+#: differently at the last ulp. The {0, 0.5, 1} report lattice places
+#: true distances EXACTLY on the boundary (one flipped event at the
+#: default eps=0.5 gives d2 = 0.25 = eps^2), so a bare comparison lets
+#: backends disagree on membership and diverge whole-cluster (found by
+#: the round-4 300-seed fuzz, rng seed 2120; regression-pinned in
+#: tests/test_fuzz.py). The band moves the knife edge off the lattice's
+#: concentration points: 1e-6 x the matrix scale covers f64 last-ulp
+#: differences by ~7 orders of magnitude and typical f32 Gram error at
+#: row norms up to ~1e3. The band is additionally CAPPED at
+#: ``DBSCAN_D2_RTOL_CAP * eps^2`` so it stays a tie-breaker and never a
+#: semantic radius change: max(d2) grows with the event count, and an
+#: uncapped band would widen a small user-supplied eps materially (e.g.
+#: eps=0.05 over E=1000 events: band 1e-3 vs eps^2=2.5e-3 -> +18%
+#: radius). The lattice only concentrates true distances ON eps^2 when
+#: eps^2 is itself at lattice scale (the 0.25-spaced levels), so for
+#: small eps the capped band still covers every realizable tie while
+#: widening the radius at most 0.05%. (A first-contact SURVEY.md §8
+#: item records that the reference's comparison is believed exact.)
+DBSCAN_D2_ATOL = 1e-6
+DBSCAN_D2_RTOL_CAP = 1e-3
+
+
+def _d2_threshold(d2, eps, xp=np):
+    """The single source of truth for the banded membership threshold —
+    both backends MUST share this expression or the parity the band buys
+    is lost. ``initial`` guards the zero-reporter (0, 0) matrix."""
+    e2 = eps * eps
+    return e2 + xp.minimum(
+        DBSCAN_D2_ATOL * xp.maximum(1.0, xp.max(d2, initial=0.0)),
+        DBSCAN_D2_RTOL_CAP * e2)
+
 
 def _seed_indices(n_rows: int, k: int) -> np.ndarray:
     """Deterministic seeding: k evenly spaced reporter rows."""
@@ -177,7 +215,7 @@ def _dbscan_jit_labels_np(d2: np.ndarray, eps: float,
     (min-label) assignment of border points reachable from two clusters,
     where sklearn's answer depends on scan order."""
     R = d2.shape[0]
-    nbr = d2 <= eps * eps                       # includes self
+    nbr = d2 <= _d2_threshold(d2, eps)          # includes self
     core = nbr.sum(axis=1) >= min_samples
     adj = nbr & core[None, :] & core[:, None]
     labels = np.where(core, np.arange(R), R)
@@ -247,7 +285,7 @@ def dbscan_jit_same_matrix_jax(d2, eps, min_samples, dtype):
     cluster ONCE and pay one ``same @ rep`` matvec per redistribution
     iteration instead of a full O(R² log R) propagation."""
     R = d2.shape[0]
-    nbr = d2 <= eps * eps
+    nbr = d2 <= _d2_threshold(d2, eps, xp=jnp)
     core = jnp.sum(nbr, axis=1) >= min_samples
     adj = nbr & core[None, :] & core[:, None]
     idx = jnp.arange(R)
@@ -291,12 +329,17 @@ def dbscan_conformity(reports_filled, reputation, eps, min_samples,
     rep = np.asarray(reputation, dtype=np.float64)
     if sq_dists is None:
         sq_dists = _pairwise_sq_dists_np(X)
-    d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
-    labels = _native.dbscan_labels(d, eps, min_samples)
+    d2 = np.asarray(sq_dists, dtype=np.float64)
+    d = np.sqrt(d2)
+    # same eps^2 boundary band as the jit variant (see DBSCAN_D2_ATOL):
+    # the device- and host-computed distance matrices differ at the last
+    # ulp exactly where the report lattice concentrates true distances
+    eps_eff = float(np.sqrt(_d2_threshold(d2, float(eps))))
+    labels = _native.dbscan_labels(d, eps_eff, min_samples)
     if labels is None:
         from sklearn.cluster import DBSCAN
 
-        labels = DBSCAN(eps=eps, min_samples=min_samples,
+        labels = DBSCAN(eps=eps_eff, min_samples=min_samples,
                         metric="precomputed").fit(d).labels_
     # noise -> unique singleton labels
     labels = labels.astype(np.int64)

@@ -104,6 +104,47 @@ def test_invariants_hold(seed):
         np.asarray(results["jax"]["events"]["outcomes_final"]))
 
 
+def test_dbscan_eps_boundary_backend_parity():
+    """Round-4 300-seed fuzz find (rng seed 2120): the {0, 0.5, 1} report
+    lattice places reporter-pair distances EXACTLY on the default eps^2
+    boundary (one flipped event at eps=0.5 -> d2 = 0.25), where the Gram
+    expansion's inexact cancellation over shared NA-fill values let numpy
+    BLAS and XLA disagree on neighborhood membership — whole clusters
+    then diverged (max smooth_rep gap 0.021 before the fix). Pinned by
+    the shared boundary band ``clustering.DBSCAN_D2_ATOL``; this replays
+    the found case plus a minimal engineered boundary matrix."""
+    rng = np.random.default_rng(2120)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    assert kwargs["algorithm"] == "dbscan-jit"  # the found configuration
+    got = {}
+    for backend in ("numpy", "jax"):
+        got[backend] = Oracle(reports=reports, event_bounds=bounds,
+                              reputation=reputation, backend=backend,
+                              **kwargs).consensus()
+    np.testing.assert_allclose(
+        np.asarray(got["jax"]["agents"]["smooth_rep"], dtype=float),
+        np.asarray(got["numpy"]["agents"]["smooth_rep"], dtype=float),
+        atol=5e-6)
+    # minimal construction: a non-dyadic shared fill (NA in both rows of
+    # one column) plus exactly one half-step disagreement puts the pair's
+    # true squared distance exactly on eps^2 = 0.25
+    reports = np.array([[0.0, 1.0, np.nan, 1.0],
+                        [0.5, 1.0, np.nan, 1.0],
+                        [0.0, 1.0, 1.0, 1.0],
+                        [0.0, 0.0, 0.0, 0.0],
+                        [1.0, 1.0, 1.0, 0.5]])
+    rep = np.array([0.3, 0.1, 0.35, 0.15, 0.1])
+    got = {}
+    for backend in ("numpy", "jax"):
+        got[backend] = Oracle(reports=reports, reputation=rep,
+                              algorithm="dbscan-jit",
+                              backend=backend).consensus()
+    np.testing.assert_allclose(
+        np.asarray(got["jax"]["agents"]["smooth_rep"], dtype=float),
+        np.asarray(got["numpy"]["agents"]["smooth_rep"], dtype=float),
+        atol=5e-6)
+
+
 from pyconsensus_tpu.models.pipeline import JIT_ALGORITHMS  # noqa: E402
 
 #: k-means excluded: its deterministic evenly-spaced-ROW centroid seeding
