@@ -59,10 +59,17 @@ def _random_case(rng):
         [b is not None for b in bounds])
 
 
-@pytest.mark.parametrize("seed", range(N_CASES))
-def test_invariants_hold(seed):
-    rng = np.random.default_rng(1000 + seed)
-    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+def _check_invariants(reports, bounds, reputation, kwargs, scaled):
+    """Resolve on both backends and assert the full invariant set — the
+    single source of truth shared by the jit and hybrid fuzz sweeps:
+    simplex reputation, snapped outcomes on {0, 0.5, 1}, scaled outcomes
+    inside their bounds, participation/certainty ranges, bit-identical
+    cross-backend snapped outcomes, smooth_rep within the uniform 5e-6
+    cross-backend tolerance (ICA's convergence-or-fallback contract in
+    models/ica.py makes even its iterated nonlinear fixed point
+    reproducible — chaotic cases fall back to the first whitened
+    component instead of returning a wandering iterate), and jax
+    determinism on re-resolution."""
     results = {}
     for backend in ("numpy", "jax"):
         r = Oracle(reports=reports, event_bounds=bounds,
@@ -87,10 +94,6 @@ def test_invariants_hold(seed):
         np.asarray(results["numpy"]["events"]["outcomes_final"])[~scaled],
         np.asarray(results["jax"]["events"]["outcomes_final"])[~scaled],
         err_msg=str(kwargs))
-    # one tolerance for every algorithm: ICA's convergence-or-fallback
-    # contract (models/ica.py) makes even its iterated nonlinear fixed
-    # point reproducible across backends — chaotic cases fall back to the
-    # first whitened component instead of returning a wandering iterate
     np.testing.assert_allclose(
         np.asarray(results["jax"]["agents"]["smooth_rep"], dtype=float),
         np.asarray(results["numpy"]["agents"]["smooth_rep"], dtype=float),
@@ -102,6 +105,30 @@ def test_invariants_hold(seed):
     np.testing.assert_array_equal(
         np.asarray(again["events"]["outcomes_final"]),
         np.asarray(results["jax"]["events"]["outcomes_final"]))
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_invariants_hold(seed):
+    rng = np.random.default_rng(1000 + seed)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    _check_invariants(reports, bounds, reputation, kwargs, scaled)
+
+
+@pytest.mark.parametrize("algorithm", ("hierarchical", "dbscan"))
+@pytest.mark.parametrize("seed", range(6))
+def test_hybrid_invariants_hold(seed, algorithm):
+    """The invariant sweep for the HYBRID algorithms, which
+    :func:`_random_case` never samples (its draw covers the jit table
+    only — the host clustering paths are orders slower, so they get a
+    small dedicated seed set instead of a share of every fuzz case).
+    The hybrid paths are the most plausible source of nondeterminism or
+    bounds drift (host scipy linkage / native-or-sklearn DBSCAN), so
+    they run the identical full invariant set."""
+    rng = np.random.default_rng(4000 + seed)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    kwargs.pop("pca_method", None)
+    kwargs["algorithm"] = algorithm
+    _check_invariants(reports, bounds, reputation, kwargs, scaled)
 
 
 def test_dbscan_eps_boundary_backend_parity():
