@@ -284,8 +284,11 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
             "storage_dtype='int8' requires the fused NaN-threaded path "
             "(TPU, binary events): the XLA path stores the "
             "INTERPOLATED matrix, whose fill values are continuous "
-            "weighted means a half-unit int8 lattice would corrupt — use "
-            "storage_dtype='bfloat16' here")
+            "weighted means a half-unit int8 lattice would corrupt — "
+            "resolve through parallel.ShardedOracle / sharded_consensus "
+            "with a power-family pca_method ('power'/'power-fused'; "
+            "'auto' picks exact eigh below R=4096, which also closes "
+            "the fused gate), or use storage_dtype='bfloat16' here")
     old_rep = jk.normalize(reputation)
     rescaled = jk.rescale(reports, scaled, mins, maxs) if p.any_scaled else reports
     if p.has_na:

@@ -164,9 +164,15 @@ class Oracle:
         resolution). ``"int8"`` stores ``round(2·value)`` with sentinel
         -1 for NaN — exact for binary/categorical reports in {0, 0.5, 1}
         and a further ~13% faster than bf16 at the north-star shape, but
-        only legal on the fused single-device TPU path with no scaled
-        events (clear ``ValueError`` elsewhere); off-lattice values
-        quantize to the nearest half unit.
+        only legal on the fused NaN-threaded TPU path with no scaled
+        events, which the SHARDED front-ends resolve
+        (``parallel.ShardedOracle`` / ``parallel.sharded_consensus``,
+        single-device meshes included — with a power-family
+        ``pca_method``: ``"auto"`` picks exact eigh below R=4096, which
+        closes the fused gate) — this plain ``Oracle`` always runs the
+        full-fidelity XLA core, which materializes the continuous
+        interpolated fills, so it raises a clear ``ValueError`` for
+        int8; off-lattice values quantize to the nearest half unit.
     verbose : bool
         Print a result summary after ``consensus()`` (reference fidelity).
     """

@@ -64,12 +64,14 @@ def _check_invariants(reports, bounds, reputation, kwargs, scaled):
     single source of truth shared by the jit and hybrid fuzz sweeps:
     simplex reputation, snapped outcomes on {0, 0.5, 1}, scaled outcomes
     inside their bounds, participation/certainty ranges, bit-identical
-    cross-backend snapped outcomes, smooth_rep within the uniform 5e-6
-    cross-backend tolerance (ICA's convergence-or-fallback contract in
-    models/ica.py makes even its iterated nonlinear fixed point
-    reproducible — chaotic cases fall back to the first whitened
-    component instead of returning a wandering iterate), and jax
-    determinism on re-resolution."""
+    cross-backend snapped outcomes, smooth_rep within a tiered
+    cross-backend tolerance — 5e-6 for every configuration except
+    iterated ``pca_method="power"``, which gets 2e-3 (see the rationale
+    at the tolerance below; ICA stays at 5e-6 because its
+    convergence-or-fallback contract in models/ica.py makes even its
+    iterated nonlinear fixed point reproducible — chaotic cases fall
+    back to the first whitened component instead of returning a
+    wandering iterate), and jax determinism on re-resolution."""
     results = {}
     for backend in ("numpy", "jax"):
         r = Oracle(reports=reports, event_bounds=bounds,
@@ -94,10 +96,19 @@ def _check_invariants(reports, bounds, reputation, kwargs, scaled):
         np.asarray(results["numpy"]["events"]["outcomes_final"])[~scaled],
         np.asarray(results["jax"]["events"]["outcomes_final"])[~scaled],
         err_msg=str(kwargs))
+    # iterated power-vs-eigh needs a looser reputation tolerance: the
+    # numpy anchor always scores with the exact eigendecomposition, while
+    # pca_method="power" carries per-iteration truncation error that the
+    # redistribution loop amplifies on unlucky eigengaps (documented in
+    # models/sztorc.py; round-4 600-seed fuzz measured gaps to 1.7e-4 at
+    # max_iterations=3 with snapped outcomes still bit-identical)
+    rep_atol = (2e-3 if (kwargs.get("pca_method") == "power"
+                         and kwargs.get("max_iterations", 1) > 1)
+                else 5e-6)
     np.testing.assert_allclose(
         np.asarray(results["jax"]["agents"]["smooth_rep"], dtype=float),
         np.asarray(results["numpy"]["agents"]["smooth_rep"], dtype=float),
-        atol=5e-6, err_msg=str(kwargs))
+        atol=rep_atol, err_msg=str(kwargs))
     # determinism: resolving again reproduces the jax result exactly
     again = Oracle(reports=reports, event_bounds=bounds,
                    reputation=reputation, backend="jax",
@@ -111,6 +122,19 @@ def _check_invariants(reports, bounds, reputation, kwargs, scaled):
 def test_invariants_hold(seed):
     rng = np.random.default_rng(1000 + seed)
     reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    _check_invariants(reports, bounds, reputation, kwargs, scaled)
+
+
+@pytest.mark.parametrize("seed", (1478, 1539))
+def test_iterated_power_truncation_seeds(seed):
+    """Round-4 600-seed fuzz finds: iterated power-vs-eigh reputation
+    drift on unlucky eigengaps (1.7e-4 at max_iterations=3 — see the
+    tiered ``rep_atol`` in :func:`_check_invariants`). Snapped outcomes
+    stayed bit-identical on both seeds; these replays pin that and the
+    loosened-but-bounded reputation contract."""
+    rng = np.random.default_rng(1000 + seed)
+    reports, bounds, reputation, kwargs, scaled = _random_case(rng)
+    assert kwargs["pca_method"] == "power" and kwargs["max_iterations"] > 1
     _check_invariants(reports, bounds, reputation, kwargs, scaled)
 
 
