@@ -503,12 +503,30 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     use_storage = fill is not None
 
     if use_storage:
-        from .pallas_kernels import storage_matmat, storage_rows_matmat
+        from .pallas_kernels import (matmat_tile_rows, storage_matmat,
+                                     storage_rows_matmat, _pad_rows)
+
+        # pad once, OUTSIDE the sweep loop (the same hoist
+        # power_iteration_fused applies, and for the same reason): the
+        # kernels' internal _pad_rows then no-ops instead of copying the
+        # whole storage matrix on EVERY sweep when R is not a panel
+        # multiple. Measured 2026-08-01 (ica, int8, interleaved A/Bs):
+        # R=10000 at E=16384 ran 29.5 res/s vs 38+ for every
+        # panel-divisible neighbor (9984/10240), and the anomalous clean
+        # tie at E=49152 was exactly the width whose tile (40) divides
+        # 10000 — the per-sweep repad WAS the "fused loses at large E"
+        # effect that round 4 mis-attributed to width and gated with
+        # _MULTI_FUSED_MAX_E. Zero-padded rows carry zero reputation, so
+        # both contractions are unchanged (module padding contract).
+        tile_r = matmat_tile_rows(E, reports_filled.dtype.itemsize,
+                                  nan_fill=True)
+        reports_filled, rep = _pad_rows(reports_filled, rep, tile_r)
+        Rp = reports_filled.shape[0]
 
         def apply_cov_block(V):                  # (E, k) -> (E, k)
             t = (storage_matmat(reports_filled, V.astype(acc), fill=fill,
                                 interpret=interpret).astype(acc)
-                 - jnp.ones((R, 1), acc) * (mu @ V)[None, :])  # (R, k)
+                 - jnp.ones((Rp, 1), acc) * (mu @ V)[None, :])  # (Rp, k)
             rt = rep[:, None] * t
             y = (storage_rows_matmat(reports_filled, rt.T.astype(acc),
                                      fill=fill,

@@ -41,7 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["apply_weighted_cov", "power_iteration_fused",
            "scores_dirfix_pass", "resolve_certainty_fused",
            "storage_matvec", "storage_rows_matmat", "storage_matmat",
-           "matmat_kernels_fit"]
+           "matmat_kernels_fit", "matmat_tile_rows"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
 #: times this (double-buffered input + in-register f32 upcast)
@@ -290,8 +290,7 @@ def _prep_cov_inputs(x, mu, rep, fill):
     ``(x, rep, tile_r, mu2)``."""
     E = x.shape[1]
     nan_fill = fill is not None
-    tile_r = _panel_rows(E, x.dtype.itemsize,
-                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    tile_r = matmat_tile_rows(E, x.dtype.itemsize, nan_fill)
     x, rep = _pad_rows(x, rep.astype(jnp.float32), tile_r)
     mu = mu.astype(jnp.float32).reshape(1, E)
     if nan_fill:
@@ -382,8 +381,7 @@ def storage_matvec(x, v, fill=None, interpret: bool = False):
     finish the centering globally."""
     R, E = x.shape
     nan_fill = fill is not None
-    tile_r = _panel_rows(E, x.dtype.itemsize,
-                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    tile_r = matmat_tile_rows(E, x.dtype.itemsize, nan_fill)
     x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
     Rp = x.shape[0]
     f32 = jnp.float32
@@ -443,6 +441,18 @@ def _matmat_kernel(x_ref, aux_ref, t_ref, *, nan_fill, k):
     t_ref[:] = t2[:, :k] + t2[:, k:]
 
 
+def matmat_tile_rows(n_events: int, itemsize: int, nan_fill: bool) -> int:
+    """The row-panel size the matmat storage kernels
+    (:func:`storage_matmat` / :func:`storage_rows_matmat`) will tile with
+    — exposed so sweep LOOPS can pad the matrix ONCE up front (the
+    kernels' internal ``_pad_rows`` then no-ops) instead of paying a full
+    (R, E) HBM pad copy on every sweep when R is not a panel multiple
+    (the hoist ``power_iteration_fused`` applies; measured ~25-35%
+    end-to-end on ica at panel-indivisible R, 2026-08-01)."""
+    return _panel_rows(n_events, itemsize,
+                       _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def storage_matmat(x, V, fill=None, interpret: bool = False):
     """``filled(x) @ V`` for a thin (E, k) block in one HBM sweep of the
@@ -453,8 +463,7 @@ def storage_matmat(x, V, fill=None, interpret: bool = False):
     R, E = x.shape
     k = V.shape[1]
     nan_fill = fill is not None
-    tile_r = _panel_rows(E, x.dtype.itemsize,
-                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    tile_r = matmat_tile_rows(E, x.dtype.itemsize, nan_fill)
     x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
     Rp = x.shape[0]
     f32 = jnp.float32
@@ -560,8 +569,7 @@ def storage_rows_matmat(x, W, fill=None, interpret: bool = False):
     R, E = x.shape
     k = W.shape[0]
     nan_fill = fill is not None
-    tile_r = _panel_rows(E, x.dtype.itemsize,
-                         _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    tile_r = matmat_tile_rows(E, x.dtype.itemsize, nan_fill)
     x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
     Rp = x.shape[0]
     f32 = jnp.float32
@@ -938,9 +946,7 @@ def power_iteration_fused(x, mu, denom, rep, n_iters: int, tol: float,
     # pad once, outside the convergence loop — apply_weighted_cov's own pad
     # then no-ops, instead of copying the matrix on every sweep when R is
     # not a panel multiple
-    tile_r = _panel_rows(E, x.dtype.itemsize,
-                         _PANEL_BYTES // 2 if fill is not None
-                         else _PANEL_BYTES)
+    tile_r = matmat_tile_rows(E, x.dtype.itemsize, fill is not None)
     x, rep = _pad_rows(x, rep.astype(f32), tile_r)
 
     def apply_cov(v):

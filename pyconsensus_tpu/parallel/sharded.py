@@ -46,11 +46,20 @@ _KNOWN_PCA = ("auto", "eigh-cov") + _SHARDABLE_PCA
 #: algorithms needing the full top-k spectrum (first-PC-only power iteration
 #: cannot serve them; the R×R Gram eigh is their scalable exact path)
 _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
-#: event-width ceiling for the multi-component FUSED storage path —
-#: measured round 4: the storage-kernel orth-iter beats XLA bf16 at
-#: 8192×32768 and loses at 10000×100000 (see _use_fused_resolution);
-#: 65536 = the power of two nearest the midpoint of the two measured
-#: endpoints (66384), refine with a finer sweep
+#: event-width ceiling for the multi-component FUSED storage path.
+#: Re-measured 2026-08-01 with a 10-shape interleaved sweep (banked as
+#: "multi_fused_crossover" in docs/MEASUREMENTS_r04.json): the round-4
+#: "loses at large E" attribution was CONFOUNDED — the big deficits
+#: (-24..-37% at R=10000, E=16384..65536) were a full (R, E) HBM repad
+#: on EVERY orth-iter sweep whenever R was not a row-panel multiple
+#: (10000 % tile != 0 at those widths; the anomalous clean tie at
+#: E=49152 was exactly the width whose tile divides 10000). The repad is
+#: now hoisted out of the sweep loop (jax_kernels._top_pcs_orth_iter),
+#: and post-hoist R=10000 measures +4% at E=16384, tie at 32768, -5% at
+#: 65536, ~-10% at 100000 (genuine: the k-row accumulators shrink the
+#: row panels and per-panel overhead swamps the byte savings at extreme
+#: width). 65536 keeps fused within noise of XLA everywhere it is
+#: allowed and routes the one genuine loss to the XLA path.
 _MULTI_FUSED_MAX_E = 65536
 
 
@@ -220,13 +229,10 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
         if params.algorithm in _MULTI_COMPONENT_ALGOS:
             # the k-row accumulators of the matmat sweeps need their own
             # VMEM fit (k+1 rows: components + the csum row) — and a
-            # measured WIDTH ceiling: the storage-kernel orth-iter wins
-            # at moderate event widths (int8 199 ms vs XLA bf16 237 at
-            # 8192x32768) but LOSES at north-star width (same-session
-            # interleaved A/B at 10000x100000: fused 8.90 res/s vs XLA
-            # 9.96 — the k-row accumulators shrink the row panels and
-            # per-panel overhead swamps the byte savings). Gate at the
-            # midpoint pending a finer sweep.
+            # measured WIDTH ceiling (rationale + the corrected
+            # attribution at _MULTI_FUSED_MAX_E: the apparent large-E
+            # losses were a per-sweep repad, hoisted 2026-08-01; only
+            # the north-star width remains a genuine XLA win).
             k = min(params.max_components, n_reporters)
             multi_fit = (matmat_kernels_fit(e_local, k + 1, itemsize)
                          and e_local <= _MULTI_FUSED_MAX_E)
