@@ -66,7 +66,7 @@ def _check_invariants(reports, bounds, reputation, kwargs, scaled):
     inside their bounds, participation/certainty ranges, bit-identical
     cross-backend snapped outcomes, smooth_rep within a tiered
     cross-backend tolerance — 5e-6 for every configuration except
-    iterated ``pca_method="power"``, which gets 2e-3 (see the rationale
+    iterated ``pca_method="power"``, which gets 5e-3 (see the rationale
     at the tolerance below; ICA stays at 5e-6 because its
     convergence-or-fallback contract in models/ica.py makes even its
     iterated nonlinear fixed point reproducible — chaotic cases fall
@@ -100,9 +100,11 @@ def _check_invariants(reports, bounds, reputation, kwargs, scaled):
     # numpy anchor always scores with the exact eigendecomposition, while
     # pca_method="power" carries per-iteration truncation error that the
     # redistribution loop amplifies on unlucky eigengaps (documented in
-    # models/sztorc.py; round-4 600-seed fuzz measured gaps to 1.7e-4 at
-    # max_iterations=3 with snapped outcomes still bit-identical)
-    rep_atol = (2e-3 if (kwargs.get("pca_method") == "power"
+    # models/sztorc.py). The round-4 1000-seed fuzz measured a drift TAIL
+    # of 1.7e-4 (seed 1539), then 1.76e-3 (seed 1616) — snapped outcomes
+    # stayed bit-identical in every case, which is the hard contract;
+    # the reputation bound carries ~3x headroom over the worst tail
+    rep_atol = (5e-3 if (kwargs.get("pca_method") == "power"
                          and kwargs.get("max_iterations", 1) > 1)
                 else 5e-6)
     np.testing.assert_allclose(
@@ -125,12 +127,13 @@ def test_invariants_hold(seed):
     _check_invariants(reports, bounds, reputation, kwargs, scaled)
 
 
-@pytest.mark.parametrize("seed", (1478, 1539))
+@pytest.mark.parametrize("seed", (1478, 1539, 1616))
 def test_iterated_power_truncation_seeds(seed):
-    """Round-4 600-seed fuzz finds: iterated power-vs-eigh reputation
-    drift on unlucky eigengaps (1.7e-4 at max_iterations=3 — see the
-    tiered ``rep_atol`` in :func:`_check_invariants`). Snapped outcomes
-    stayed bit-identical on both seeds; these replays pin that and the
+    """Round-4 1000-seed fuzz finds: iterated power-vs-eigh reputation
+    drift on unlucky eigengaps (tail: 1.7e-4 at seed 1539, 1.76e-3 at
+    seed 1616 — see the tiered ``rep_atol`` in
+    :func:`_check_invariants`). Snapped outcomes stayed bit-identical on
+    every found seed; these replays pin that and the
     loosened-but-bounded reputation contract."""
     rng = np.random.default_rng(1000 + seed)
     reports, bounds, reputation, kwargs, scaled = _random_case(rng)
