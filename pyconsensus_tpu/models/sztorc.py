@@ -9,8 +9,10 @@ pins f64 on both sides and asserts this).
 Precision caveat (SURVEY.md §7 "PCA sign ambiguity", observed on TPU f32):
 the ``fixed-variance`` variant direction-fixes *every* component, and for
 minor (near-degenerate) components the two candidate orientations are almost
-equidistant from the current consensus — the ``ref_ind <= 0`` tie-break is
-then decided by float noise, so a TPU f32 run can orient a minor component
+equidistant from the current consensus — outside the exact-tie band
+(``numpy_kernels.DIRFIX_TIE_ATOL``, which resolves EXACT ties
+sign-canonically) the choice is decided by float noise, so a TPU f32 run
+can orient a minor component
 opposite to a numpy f64 run and diverge visibly. This is inherent to blending
 near-degenerate eigenvectors, not a kernel bug; the first component (the
 ``sztorc`` algorithm, the north-star parity target) has a decisive gap and

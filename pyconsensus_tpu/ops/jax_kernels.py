@@ -81,10 +81,19 @@ def normalize(v: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(total == 0.0, v, v / safe)
 
 
+def canon_sign_factor(v: jnp.ndarray) -> jnp.ndarray:
+    """The scalar +-1 factor canon_sign would multiply by (first-argmax
+    tie-break, zero sign -> +1) — shared by every direction-fix decision
+    site so the tie-break convention cannot drift between them; exposed
+    separately because the fused forms must apply the same factor to
+    quantities LINEAR in the scores (qs) as well."""
+    s = jnp.sign(v[jnp.argmax(jnp.abs(v))])
+    return jnp.where(s == 0.0, 1.0, s)
+
+
 def canon_sign(v: jnp.ndarray) -> jnp.ndarray:
     """JAX mirror of numpy_kernels.canon_sign (identical tie-break)."""
-    s = jnp.sign(v[jnp.argmax(jnp.abs(v))])
-    return v * jnp.where(s == 0.0, 1.0, s)
+    return v * canon_sign_factor(v)
 
 
 def catch(x: jnp.ndarray, tolerance) -> jnp.ndarray:
@@ -712,12 +721,18 @@ def multi_dirfix_storage(scores, x, fill, mu, reputation,
         new1_c = normalize(set1_c) @ X = (q_c + a1_c csum) / sum(set1_c)
 
     and ``old = rep @ X`` is exactly the weighted column means ``mu``
-    already in hand. Same ``ref_ind <= 0`` tie-break per component.
+    already in hand. Same sign-canonical banded tie-break per component
+    (numpy_kernels.DIRFIX_TIE_ATOL).
     Returns (R, k) direction-fixed scores."""
     from .pallas_kernels import storage_rows_matmat
 
     acc = reputation.dtype
     R, k = scores.shape
+    # per-column sign canonicalization (numpy_kernels
+    # .direction_fixed_scores rationale: a banded tie's winner must not
+    # depend on the eigensolver's arbitrary sign); vmapped so the
+    # tie-break convention is canon_sign_factor's by construction
+    scores = scores * jax.vmap(canon_sign_factor, in_axes=1)(scores)[None, :]
     W = jnp.concatenate([scores.T.astype(acc),
                          jnp.ones((1, R), acc)])               # (k+1, R)
     qc = storage_rows_matmat(x, W, fill=fill,
@@ -737,9 +752,10 @@ def multi_dirfix_storage(scores, x, fill, mu, reputation,
 
     new1 = _guard(q + a1[:, None] * csum[None, :], s1_tot)     # (k, E)
     new2 = _guard(q - a2[:, None] * csum[None, :], s2_tot)
-    ref_ind = (jnp.sum((new1 - mu[None, :]) ** 2, axis=1)
-               - jnp.sum((new2 - mu[None, :]) ** 2, axis=1))   # (k,)
-    return jnp.where(ref_ind[None, :] <= 0.0, set1, -set2)
+    d1 = jnp.sum((new1 - mu[None, :]) ** 2, axis=1)            # (k,)
+    d2 = jnp.sum((new2 - mu[None, :]) ** 2, axis=1)
+    set1_wins = d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2)
+    return jnp.where(set1_wins[None, :], set1, -set2)
 
 
 #: column-block width for the blocked weighted median (see
@@ -865,24 +881,32 @@ def _weighted_median_cols_block(values, weights, present):
 
 def direction_fixed_scores(scores, reports_filled, reputation):
     """PCA sign/direction fix (numpy_kernels.direction_fixed_scores). Runs
-    inside the jitted graph; the ``ref_ind <= 0`` tie-break is identical to the
+    inside the jitted graph; the sign-canonical banded tie-break
+    (numpy_kernels.DIRFIX_TIE_ATOL) is identical to the
     numpy kernel so both backends pick the same orientation.
 
     The three candidate-outcome projections are stacked into one (3, R) x
     (R, E) matmul so the matrix is swept once, not three times — at
     north-star scale each avoided sweep is a multi-GB HBM pass."""
     acc = scores.dtype
+    # sign-canonicalize before building candidates: at a banded tie
+    # "pick set1" is not sign-invariant (numpy_kernels
+    # .direction_fixed_scores has the full rationale)
+    scores = canon_sign(scores)
     set1 = scores + jnp.abs(jnp.min(scores))
     set2 = scores - jnp.max(scores)
     W = jnp.stack([reputation.astype(acc), normalize(set1), normalize(set2)])
     M = jnp.matmul(W.astype(reports_filled.dtype), reports_filled,
                    preferred_element_type=acc)
     old, new1, new2 = M[0], M[1], M[2]
-    ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
+    d1 = jnp.sum((new1 - old) ** 2)
+    d2 = jnp.sum((new2 - old) ** 2)
     # the winning orientation in non-negative form (numpy_kernels
     # .direction_fixed_scores: -set2, an exact no-op through normalize for
-    # one component, simplex-safe for blends)
-    return jnp.where(ref_ind <= 0.0, set1, -set2)
+    # one component, simplex-safe for blends); banded tie per
+    # nk.DIRFIX_TIE_ATOL
+    return jnp.where(d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2),
+                     set1, -set2)
 
 
 def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
@@ -903,7 +927,8 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
         set1^T X = scores^T X + |min scores| * colsum(X)   (set2 analogous)
 
     so the stacked (3, R) x (R, E) direction-fix matmul collapses to O(E)
-    arithmetic on the pass outputs. Same ``ref_ind <= 0`` tie-break.
+    arithmetic on the pass outputs. Same sign-canonical banded tie-break
+    (numpy_kernels.DIRFIX_TIE_ATOL).
     Returns ``(adj_scores (R,), loading (E,))`` in the reputation dtype.
 
     With ``fill`` (and the matching precomputed ``mu``) the input is
@@ -936,6 +961,11 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     ml = mu @ loading
     scores = t.astype(acc) - ml
     qs = q.astype(acc) - ml * c.astype(acc)        # scores^T X
+    # sign-canonicalize scores (and qs, linear in them) before the
+    # candidates — see numpy_kernels.direction_fixed_scores
+    sgn = canon_sign_factor(scores)
+    scores = scores * sgn
+    qs = qs * sgn
     a1 = jnp.abs(jnp.min(scores))
     a2 = jnp.max(scores)
     set1 = scores + a1
@@ -952,9 +982,12 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     new2 = jnp.where(s2_tot == 0.0, set2X,
                      set2X / jnp.where(s2_tot == 0.0, 1.0, s2_tot))
     old = o.astype(acc)
-    ref_ind = jnp.sum((new1 - old) ** 2) - jnp.sum((new2 - old) ** 2)
+    d1 = jnp.sum((new1 - old) ** 2)
+    d2 = jnp.sum((new2 - old) ** 2)
     # non-negative winning orientation, as in direction_fixed_scores
-    return jnp.where(ref_ind <= 0.0, set1, -set2), loading
+    # (banded tie per nk.DIRFIX_TIE_ATOL)
+    return jnp.where(d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2),
+                     set1, -set2), loading
 
 
 def row_reward_weighted(adj_scores, reputation):

@@ -196,6 +196,24 @@ def weighted_prin_comps(reports_filled: np.ndarray, reputation: np.ndarray,
 #: ``weightedstats`` comparison on first reference contact (SURVEY §8).
 MEDIAN_TIE_ATOL = 1e-9
 
+#: Direction-fix tie band (same decision pattern as MEDIAN_TIE_ATOL and
+#: ``models.clustering.DBSCAN_D2_ATOL``): ``set1`` wins when
+#: ``d1 - d2 <= DIRFIX_TIE_ATOL * (d1 + d2)`` instead of the bare
+#: ``d1 - d2 <= 0``. Rationale: on symmetric report matrices the two
+#: candidate orientations are EXACTLY equidistant from the current
+#: consensus (the lattice concentrates ``ref_ind`` on 0), and backends
+#: computing the distances through different-but-exact algebra (eigh-cov
+#: vs eigh-gram vs the fused projected form) land on opposite sides of 0
+#: by one ulp — flipping the orientation WHOLESALE (round-4 fuzz seed
+#: 1989: smooth_rep reversed 0.58, outcomes 0.85 vs 0.10). A 1e-9
+#: relative band is ~7 orders above f64 ulp noise while only rebinding
+#: decisions that are semantically arbitrary. f32 runs can still compute
+#: a true tie ~1e-7 off zero (outside the band) — that residual falls
+#: under the documented f32 envelope (docs/PERFORMANCE.md), while the
+#: x64 parity suite is exact. All six decision sites (numpy, jax
+#: single/multi/fused, shard_map mesh, streaming) share this rule.
+DIRFIX_TIE_ATOL = 1e-9
+
 
 def weighted_median(values: np.ndarray, weights: np.ndarray) -> float:
     """Weighted median by sorted cumulative weight (SURVEY.md §2 #8).
@@ -235,7 +253,8 @@ def direction_fixed_scores(scores: np.ndarray, reports_filled: np.ndarray,
     Candidate orientations ``set1 = scores + |min(scores)|`` and
     ``set2 = scores - max(scores)`` imply two outcome vectors; whichever lies
     closer (squared distance) to the current reputation-weighted outcomes
-    ``old = rep^T X`` wins. Ties (``ref_ind <= 0``) go to ``set1``.
+    ``old = rep^T X`` wins. Ties — banded by :data:`DIRFIX_TIE_ATOL`,
+    see its sizing note — go to ``set1``.
 
     The chosen orientation is returned in its NON-NEGATIVE form: when
     ``set2`` (entrywise <= 0) wins, ``-set2 = max(scores) - scores`` is
@@ -245,14 +264,24 @@ def direction_fixed_scores(scores: np.ndarray, reports_filled: np.ndarray,
     on the reputation simplex — a mixed-sign blend of raw set1/set2
     vectors can otherwise produce negative reputation entries.
     """
-    s = np.asarray(scores, dtype=np.float64)
+    # canonicalize the eigensolver's arbitrary sign BEFORE building the
+    # candidates: when the two orientations are exactly equidistant (the
+    # DIRFIX_TIE_ATOL band), "pick set1" is not sign-invariant — set1
+    # built from -scores is the OTHER orientation — so without this a
+    # tie's winner depends on which sign the backend's eigensolver
+    # happened to return (round-4 fuzz seed 1989: numpy eigh-cov and the
+    # jax Gram path returned opposite signs on a symmetric matrix and
+    # resolved opposite outcomes). Away from the band the winner is
+    # sign-invariant, so this changes nothing.
+    s = canon_sign(np.asarray(scores, dtype=np.float64))
     set1 = s + np.abs(np.min(s))
     set2 = s - np.max(s)
     old = reputation @ reports_filled
     new1 = normalize(set1) @ reports_filled
     new2 = normalize(set2) @ reports_filled
-    ref_ind = np.sum((new1 - old) ** 2) - np.sum((new2 - old) ** 2)
-    return set1 if ref_ind <= 0.0 else -set2
+    d1 = np.sum((new1 - old) ** 2)
+    d2 = np.sum((new2 - old) ** 2)
+    return set1 if d1 - d2 <= DIRFIX_TIE_ATOL * (d1 + d2) else -set2
 
 
 def row_reward_weighted(adj_scores: np.ndarray, reputation: np.ndarray) -> np.ndarray:

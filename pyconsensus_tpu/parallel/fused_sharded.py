@@ -46,6 +46,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.pipeline import ConsensusParams, _fill_stats, _masked_mu
 from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
 from .mesh import Mesh
 
 __all__ = ["fused_sharded_consensus"]
@@ -206,6 +207,12 @@ def _local_consensus(x_blk, rep, seed, base_unit, bounds,
         q, o, c = qco[0], qco[1], qco[2]
         scores = t_raw - ml                        # (R,) replicated
         qs = q - ml * c                            # scores^T X, local cols
+        # sign-canonicalize scores (+ qs, linear in them) before the
+        # candidates — identical on every shard since scores is
+        # replicated (nk.DIRFIX_TIE_ATOL rationale in numpy_kernels)
+        sgn = jk.canon_sign_factor(scores)
+        scores = scores * sgn
+        qs = qs * sgn
         a1 = jnp.abs(jnp.min(scores))
         a2 = jnp.max(scores)
         set1 = scores + a1
@@ -216,10 +223,15 @@ def _local_consensus(x_blk, rep, seed, base_unit, bounds,
         new1 = _guard_div(qs + a1 * c, s1_tot)
         new2 = _guard_div(qs - a2 * c, s2_tot)
         d = (new1 - o) ** 2 - (new2 - o) ** 2
+        t = (new1 - o) ** 2 + (new2 - o) ** 2
         if needs_pad:
             d = jnp.where(valid, d, 0.0)
-        ref_ind = _psum(jnp.sum(d))
-        return jnp.where(ref_ind <= 0.0, set1, -set2), loading
+            t = jnp.where(valid, t, 0.0)
+        # one stacked psum carries both the decision value and the tie
+        # band's scale (nk.DIRFIX_TIE_ATOL — identical rule on every path)
+        dt = _psum(jnp.stack([jnp.sum(d), jnp.sum(t)]))
+        set1_wins = dt[0] <= nk.DIRFIX_TIE_ATOL * dt[1]
+        return jnp.where(set1_wins, set1, -set2), loading
 
     if p.max_iterations <= 1:
         adj, loading = scores_at(old_rep, mu1)

@@ -515,6 +515,7 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         accumulator: ``||w^T F - rep^T F||^2 = (w-rep)^T S (w-rep)`` —
         same normalize guard, tie-break, and non-negative winning
         orientation."""
+        scores = jk.canon_sign(scores)
         set1 = scores + jnp.abs(jnp.min(scores))
         set2 = scores - jnp.max(scores)
 
@@ -522,9 +523,12 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
             d = w - rep_ref
             return d @ S @ d
 
-        ref_ind = (sq_dist_to_old(jk.normalize(set1))
-                   - sq_dist_to_old(jk.normalize(set2)))
-        return jnp.where(ref_ind <= 0.0, set1, -set2)
+        d1 = sq_dist_to_old(jk.normalize(set1))
+        d2 = sq_dist_to_old(jk.normalize(set2))
+        # banded tie, identical rule to every other decision site
+        # (ops.numpy_kernels.DIRFIX_TIE_ATOL — see its sizing note)
+        return jnp.where(d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2),
+                         set1, -set2)
 
     def accumulate_stats(weight_rep, with_s, with_gm=True):
         """One pass over the source: (G, M[, S]) with the given Gram

@@ -55,8 +55,20 @@ def _norm(v):
 def _dirfix(scores, filled, rep):
     """nonconformity: pick the orientation whose implied outcomes sit
     closer to the current reputation-weighted outcomes; return it in
-    non-negative form (SURVEY.md §2 #5)."""
+    non-negative form (SURVEY.md §2 #5). Ties follow the round-4 rule
+    (SURVEY.md §8 item 9): scores are sign-canonicalized first (at an
+    exact tie "pick set1" is not sign-invariant) and the comparison is
+    banded by DIRFIX_TIE_ATOL — re-derived here from the spec, not
+    shared with the implementation."""
     R, E = len(filled), len(filled[0])
+    # canon_sign re-derived: flip so the largest-|value| entry (first
+    # index on ties) is positive
+    besti, bestv = 0, 0.0
+    for i, s in enumerate(scores):
+        if abs(s) > bestv:
+            besti, bestv = i, abs(s)
+    sgn = 1.0 if scores[besti] >= 0.0 else -1.0
+    scores = [s * sgn for s in scores]
     set1 = [s + abs(min(scores)) for s in scores]
     set2 = [s - max(scores) for s in scores]
     old = [sum(rep[i] * filled[i][j] for i in range(R)) for j in range(E)]
@@ -65,7 +77,7 @@ def _dirfix(scores, filled, rep):
     new2 = [sum(n2w[i] * filled[i][j] for i in range(R)) for j in range(E)]
     d1 = sum((new1[j] - old[j]) ** 2 for j in range(E))
     d2 = sum((new2[j] - old[j]) ** 2 for j in range(E))
-    if d1 - d2 <= 0.0:
+    if d1 - d2 <= 1e-9 * (d1 + d2):
         return set1
     return [-s for s in set2]
 
