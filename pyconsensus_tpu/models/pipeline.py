@@ -422,10 +422,33 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     mu1 = numer0 + (full0 - tw0) * fill
 
     if p.algorithm == "sztorc":
+        # pad-hoist (pallas_kernels.matmat_tile_rows' contract): row-pad
+        # the storage ONCE here instead of letting BOTH fused kernels
+        # re-pad it — a full (R, E) HBM copy each — on every outer
+        # redistribution iteration when R is not a panel multiple. On
+        # the fill path the power-sweep and dirfix kernels share one
+        # tile (both size against the halved NaN-threading budget), so a
+        # single pad serves both; zero rows with zero reputation are
+        # exact no-ops in every contraction (sztorc_scores_power_fused's
+        # n_rows note).
+        from ..ops.pallas_kernels import matmat_tile_rows
+
+        R_true = x.shape[0]
+        # the matvec-dtype narrowing is hoisted with the pad: done per
+        # call it is another full (R, E) copy per iteration. The back
+        # half and _masked_mu keep reading the uncast x, exactly as the
+        # per-call cast behaved.
+        xs = jk.matvec_narrow(x, p.matvec_dtype)
+        row_pad = (-R_true) % matmat_tile_rows(
+            x.shape[1], jnp.dtype(xs.dtype).itemsize, True)
+        xp = jnp.pad(xs, ((0, row_pad), (0, 0))) if row_pad else xs
+
         def scores_at(rep_k, mu_k, v_init=None):
+            rep_p = jnp.pad(rep_k, (0, row_pad)) if row_pad else rep_k
             return (*jk.sztorc_scores_power_fused(
-                x, rep_k, p.power_iters, p.power_tol, p.matvec_dtype,
-                interpret=interp, fill=fill, mu=mu_k, v_init=v_init), None)
+                xp, rep_p, p.power_iters, p.power_tol, "",
+                interpret=interp, fill=fill, mu=mu_k, v_init=v_init,
+                n_rows=R_true), None)
     elif p.algorithm in ("fixed-variance", "ica"):
         # round-4 (VERDICT r3 item 2): the multi-component variants score
         # straight off the sentinel storage via the storage-kernel
@@ -436,9 +459,7 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
         from .ica import ica_scores_storage
         from .sztorc import fixed_variance_scores_storage
 
-        xm = (x.astype(jnp.dtype(p.matvec_dtype))
-              if p.matvec_dtype and not jnp.issubdtype(x.dtype, jnp.integer)
-              else x)
+        xm = jk.matvec_narrow(x, p.matvec_dtype)
         if p.algorithm == "fixed-variance":
             def scores_at(rep_k, mu_k, v_init=None):
                 return (*fixed_variance_scores_storage(

@@ -21,6 +21,8 @@ anchored in BASELINE.json). Design rules, per SURVEY.md §7 M0:
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 import jax
 import jax.numpy as jnp
@@ -909,10 +911,21 @@ def direction_fixed_scores(scores, reports_filled, reputation):
                      set1, -set2)
 
 
+def matvec_narrow(x, matvec_dtype: str):
+    """Apply the matvec-dtype narrowing cast to a storage matrix — unless
+    the storage is integer (int8 sentinel storage is already the
+    narrowest encoding; casting it to a float dtype would destroy the
+    sentinel/lattice). The ONE copy of the rule shared by the fused
+    pipeline's hoisted cast and the per-call fallbacks here."""
+    if matvec_dtype and not jnp.issubdtype(x.dtype, jnp.integer):
+        return x.astype(jnp.dtype(matvec_dtype))
+    return x
+
+
 def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
                               power_tol: float, matvec_dtype: str = "",
                               interpret: bool = False, fill=None, mu=None,
-                              v_init=None):
+                              v_init=None, n_rows: Optional[int] = None):
     """The whole sztorc scoring step on the Pallas fast path: power-iteration
     PCA (one HBM sweep per step, pallas_kernels.apply_weighted_cov) followed
     by the scores + direction-fix contractions in ONE further sweep
@@ -937,6 +950,20 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     (A single-launch fixed-trip "power-mono" variant existed through round
     2; the on-chip A/B measured it 36% slower than this early-exit loop —
     docs/PERFORMANCE.md — and it was removed.)
+
+    ``n_rows``: pre-padded-input contract (the iterated-pipeline pad
+    hoist, same rationale as pallas_kernels.matmat_tile_rows' note): the
+    caller passes ``reports_filled`` and ``reputation`` already row-padded
+    to the kernels' panel tile — the kernels' internal ``_pad_rows`` then
+    no-op instead of copying the whole matrix through HBM on EVERY outer
+    redistribution iteration — and ``n_rows`` is the TRUE reporter count.
+    The pad rows (zero storage values, zero reputation) contribute exactly
+    zero to every row contraction (q, c, o and the power sweeps all weight
+    by reputation or multiply the zero values), but their raw projections
+    ``t`` are garbage (``-mu.loading`` after centering), so the scores are
+    sliced back to ``n_rows`` BEFORE the direction-fix statistics.
+    Returns (n_rows,)-sized scores. Default None: unpadded input, R from
+    the matrix.
     """
     from .pallas_kernels import power_iteration_fused, scores_dirfix_pass
 
@@ -946,18 +973,15 @@ def sztorc_scores_power_fused(reports_filled, reputation, power_iters: int,
     else:
         denom = 1.0 - jnp.sum(reputation ** 2)
         denom = jnp.where(denom == 0.0, 1.0, denom)
-    # int8 sentinel storage is already the narrowest encoding — casting it
-    # to a float matvec dtype would destroy the sentinel/lattice
-    xmm = (reports_filled.astype(jnp.dtype(matvec_dtype))
-           if matvec_dtype
-           and not jnp.issubdtype(reports_filled.dtype, jnp.integer)
-           else reports_filled)
+    xmm = matvec_narrow(reports_filled, matvec_dtype)
     loading = power_iteration_fused(xmm, mu, denom, reputation,
                                     power_iters, power_tol, fill=fill,
                                     interpret=interpret,
                                     v_init=v_init).astype(acc)
     t, q, c, o = scores_dirfix_pass(xmm, reputation, loading, fill=fill,
                                     interpret=interpret)
+    if n_rows is not None:
+        t = t[:n_rows]           # drop the pad rows' garbage projections
     ml = mu @ loading
     scores = t.astype(acc) - ml
     qs = q.astype(acc) - ml * c.astype(acc)        # scores^T X
@@ -1028,11 +1052,17 @@ def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
     multi-device event-sharded mesh, see that docstring).
 
     ``n_scaled`` (static; 0 = unknown): the EXACT number of scaled events.
-    When known, single-device (``median_block > 0``), and a minority of
-    columns (< E/2), the median runs on a static gather of just the scaled
-    columns instead of all E — the sort phase, resolution's only
+    When known, single-device (``median_block > 0``), and below E (any
+    binary column at all), the median runs on a static gather of just the
+    scaled columns instead of all E — the sort phase, resolution's only
     O(R log R * E) cost, shrinks by E/n_scaled (25x at the scaled-heavy
-    bench shape of 4k scaled x 100k events). Not used on the sharded path:
+    bench shape of 4k scaled x 100k events). The gather pays one
+    O(R * n_scaled) copy, strictly cheaper per column than the multi-pass
+    sort it saves, so it fires for scaled MAJORITIES too (round-4
+    same-session A/B at 60k of 100k scaled: 1.54 s -> 1.01 s blocking,
+    0.69 -> 1.10 res/s); only the all-scaled
+    case (n_scaled == E) runs full-width, where a gather is a pure copy
+    of the whole matrix. Not used on the sharded path:
     a cross-shard column gather would move (R, n_scaled) over ICI, while
     the per-shard full median moves nothing. A WRONG count silently
     corrupts outcomes (the gather pads/truncates) — callers must pass the
@@ -1057,7 +1087,7 @@ def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
         tw = jnp.broadcast_to(full_total, (E,))
         means = full_mean
     if any_scaled:
-        if 0 < n_scaled and n_scaled * 2 < E and median_block > 0:
+        if 0 < n_scaled < E and median_block > 0:
             idx = jnp.nonzero(scaled, size=n_scaled)[0]
             med_s = weighted_median_cols(
                 jnp.take(reports_filled, idx, axis=1), smooth_rep,

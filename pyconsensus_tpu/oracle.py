@@ -264,12 +264,14 @@ class Oracle:
         self.verbose = verbose
         # static scaled count for the jax path's gather-median fast path
         # (resolve_outcomes(n_scaled=...): median only the scaled columns).
-        # Only set when the gather would fire (scaled minority) — the count
-        # is a jit-static param, so carrying it uselessly would fragment
-        # the compile cache across scaled counts for nothing.
+        # Only set when the gather would fire (any binary column at all —
+        # round 4 opened the gate to scaled majorities; see
+        # resolve_outcomes' sizing note) — the count is a jit-static
+        # param, so carrying it uselessly would fragment the compile
+        # cache across scaled counts for nothing.
         n_sc = int(scaled.sum())
         self.params = ConsensusParams(
-            n_scaled=n_sc if 0 < n_sc * 2 < n_events else 0,
+            n_scaled=n_sc if 0 < n_sc < n_events else 0,
             any_scaled=bool(scaled.any()),
             has_na=bool(np.isnan(self.reports).any()),
             algorithm=algorithm,

@@ -160,12 +160,9 @@ def _local_consensus(x_blk, rep, seed, base_unit, bounds,
     full0 = jnp.sum(old_rep)
     mu1 = numer0 + (full0 - tw0) * fill            # (E_loc,) local
     # matvec_dtype: like sztorc_scores_power_fused, the power sweeps and
-    # the scores/direction-fix pass read a narrowed copy of the storage
-    # (int8 sentinel storage is already narrowest — a float cast would
-    # destroy the lattice); the back-half kernel reads full storage
-    xm = (x.astype(jnp.dtype(p.matvec_dtype))
-          if p.matvec_dtype and not jnp.issubdtype(x.dtype, jnp.integer)
-          else x)
+    # the scores/direction-fix pass read a narrowed copy of the storage;
+    # the back-half kernel reads full storage
+    xm = jk.matvec_narrow(x, p.matvec_dtype)
 
     def scores_at(rep_k, mu_k, v_init=None):
         """sztorc_scores_power_fused, shard-aware: two kernel passes per

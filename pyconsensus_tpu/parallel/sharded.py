@@ -111,11 +111,13 @@ def _xla_path_n_scaled(p: ConsensusParams, n_events: int, mesh: Mesh) -> int:
     recompiling per distinct value. Keep it exactly when the gather path
     would actually fire: single-device event axis (a cross-shard gather
     would move (R, n_scaled) over ICI — the sharded median is local) and
-    a minority of scaled columns; otherwise zero it so the cache keys
-    only on ``any_scaled``."""
+    at least one binary column (all-scaled makes the gather a pure
+    whole-matrix copy; round 4 opened the gate to scaled majorities —
+    see resolve_outcomes' sizing note); otherwise zero it so the cache
+    keys only on ``any_scaled``."""
     if (mesh.shape.get("event", 1) == 1
             and p.median_block > 0          # unblocked mode ignores n_scaled
-            and 0 < p.n_scaled and p.n_scaled * 2 < n_events):
+            and 0 < p.n_scaled < n_events):
         return p.n_scaled
     return 0
 

@@ -482,16 +482,22 @@ class TestResolveOutcomes:
         """The n_scaled static-gather fast path (median on just the scaled
         columns) must be bitwise identical to the full-width median +
         select — each column's math is self-contained, so gathering can't
-        change it. Covers NaN columns, blocked and unblocked widths, and
-        the guard cases (n_scaled=0, majority-scaled, median_block=0)
+        change it. Covers NaN columns, blocked and unblocked widths,
+        scaled MAJORITIES (round 4 opened the gate to any n_scaled < E),
+        and the guard cases (n_scaled=0, all-scaled, median_block=0)
         falling back to the full path."""
-        for trial in range(3):
+        for trial in range(4):
             reports, rep, scaled, mins, maxs = random_reports(rng)
+            if trial == 3:
+                # force a scaled MAJORITY with one binary holdout: the
+                # widest gather the gate now admits
+                scaled = np.ones_like(scaled)
+                scaled[0] = False   # binary bounds are [0,1] -> identity rescale
             rescaled = nk.rescale(reports, scaled, mins, maxs)
             filled = nk.interpolate(rescaled, rep, scaled, 0.1)
             present = jnp.asarray(~np.isnan(rescaled))
             n_sc = int(scaled.sum())
-            if n_sc == 0 or n_sc * 2 >= scaled.size:
+            if n_sc == 0 or n_sc == scaled.size:
                 continue
             args = (present, jnp.asarray(filled), jnp.asarray(rep),
                     jnp.asarray(scaled), 0.1)

@@ -623,8 +623,10 @@ class TestValidation:
             Oracle(reports=CANONICAL, event_bounds=[None])
 
     def test_n_scaled_static_wiring(self):
-        """Oracle carries the exact static scaled count only when the
-        gather-median path can fire (scaled strict minority)."""
+        """Oracle carries the exact static scaled count whenever the
+        gather-median path can fire (any binary column at all — round 4
+        opened the gate to scaled majorities); all-scaled and all-binary
+        carry 0 (the gather would be a whole-matrix copy / is unused)."""
         bounds_minor = [None, None, None,
                         {"scaled": True, "min": 0.0, "max": 10.0}]
         o = Oracle(reports=CANONICAL, event_bounds=bounds_minor)
@@ -632,7 +634,10 @@ class TestValidation:
         bounds_major = [{"scaled": True, "min": 0.0, "max": 10.0}] * 3 \
             + [None]
         o = Oracle(reports=CANONICAL, event_bounds=bounds_major)
-        assert o.params.n_scaled == 0          # majority: full median wins
+        assert o.params.n_scaled == 3          # majority: gather still wins
+        bounds_all = [{"scaled": True, "min": 0.0, "max": 10.0}] * 4
+        o = Oracle(reports=CANONICAL, event_bounds=bounds_all)
+        assert o.params.n_scaled == 0          # all-scaled: nothing to skip
         assert Oracle(reports=CANONICAL).params.n_scaled == 0
 
     def test_algorithm_aliases(self):
