@@ -156,7 +156,7 @@ def _fastica_one_unit(Z, tol):
 
 
 def ica_scores_storage(x, fill, mu, reputation, max_components,
-                       interpret=False):
+                       interpret=False, n_rows=None):
     """``ica`` scoring straight off sentinel-threaded storage (the fused
     pipeline's compact encoding): the whitening subspace comes from the
     storage-kernel orthogonal iteration
@@ -164,11 +164,19 @@ def ica_scores_storage(x, fill, mu, reputation, max_components,
     itself runs on the small (R, k) whitened block exactly as
     :func:`ica_scores_jax`; the final direction fix is one further
     storage sweep (jax_kernels.multi_dirfix_storage on the single
-    extracted component). Returns ``(adj_scores, converged)``."""
-    k = int(min(max_components, min(x.shape) - 1))
+    extracted component). Returns ``(adj_scores, converged)``.
+
+    ``n_rows``: pre-padded-input contract
+    (jax_kernels.sztorc_scores_power_fused) — the TRUE reporter count
+    when ``x``/``reputation`` arrive row-padded; it sizes the component
+    count and the whitened block so pad rows never enter the FastICA
+    statistics."""
+    R_true = x.shape[0] if n_rows is None else n_rows
+    k = int(min(max_components, min(R_true, x.shape[1]) - 1))
     k = max(k, 1)
     _, scores, _ = jk.weighted_prin_comps_storage(x, fill, mu, reputation,
-                                                  k, interpret=interpret)
+                                                  k, interpret=interpret,
+                                                  n_rows=n_rows)
     std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
     Z = _canon_signs_jax(scores / std[None, :])
     w, converged = _fastica_one_unit(Z, _conv_tol(Z.dtype))

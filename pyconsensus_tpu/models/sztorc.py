@@ -143,7 +143,7 @@ def _component_weights_jax(explained, variance_threshold):
 
 def fixed_variance_scores_storage(x, fill, mu, reputation,
                                   variance_threshold, max_components,
-                                  interpret=False):
+                                  interpret=False, n_rows=None):
     """``fixed-variance`` scoring straight off sentinel-threaded storage
     (the fused pipeline's compact encoding, SURVEY.md §2 #10): the top-k
     subspace by storage-kernel orthogonal iteration
@@ -151,10 +151,16 @@ def fixed_variance_scores_storage(x, fill, mu, reputation,
     batched into one further storage sweep
     (jax_kernels.multi_dirfix_storage) — versus the XLA path's k separate
     (3, R) x (R, E) matmuls. Same selection and combination rules as
-    :func:`fixed_variance_scores_jax`."""
-    k = min(max_components, min(x.shape))
+    :func:`fixed_variance_scores_jax`.
+
+    ``n_rows``: pre-padded-input contract
+    (jax_kernels.sztorc_scores_power_fused) — the TRUE reporter count
+    when ``x``/``reputation`` arrive row-padded; it sizes the component
+    count and the sliced scores."""
+    R_true = x.shape[0] if n_rows is None else n_rows
+    k = min(max_components, min(R_true, x.shape[1]))
     loadings, scores, explained = jk.weighted_prin_comps_storage(
-        x, fill, mu, reputation, k, interpret=interpret)
+        x, fill, mu, reputation, k, interpret=interpret, n_rows=n_rows)
     w = _component_weights_jax(explained, variance_threshold)
     adj_all = jk.multi_dirfix_storage(scores, x, fill, mu, reputation,
                                       interpret=interpret)       # (R, k)

@@ -685,14 +685,22 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
 
 
 def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
-                                interpret: bool = False):
+                                interpret: bool = False,
+                                n_rows: Optional[int] = None):
     """Top-k components + explained fractions straight off sentinel
     storage (the fused pipeline's compact encoding): orthogonal iteration
     with both block sweeps through the Pallas storage kernels, then one
     more ``storage_matmat`` sweep for the scores. The storage sibling of
     :func:`weighted_prin_comps`'s orth-iter branch — same convergence
     rules, same Rayleigh-Ritz rotation (parity pinned by
-    tests/test_kernels.py at the shared tolerance)."""
+    tests/test_kernels.py at the shared tolerance).
+
+    ``n_rows``: pre-padded-input contract, exactly as
+    :func:`sztorc_scores_power_fused`'s — ``x``/``reputation`` arrive
+    row-padded to the storage-kernel tile (so per-call re-pads no-op
+    inside the iterated pipeline) and the returned scores are sliced
+    back to the TRUE reporter count (pad rows' raw projections are
+    ``-mu.loadings`` garbage after centering)."""
     from .pallas_kernels import storage_matmat
 
     acc = reputation.dtype
@@ -708,6 +716,8 @@ def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
     scores = (storage_matmat(x, loadings.astype(acc), fill=fill,
                              interpret=interpret).astype(acc)
               - jnp.ones((R, 1), acc) * (mu @ loadings)[None, :])
+    if n_rows is not None:
+        scores = scores[:n_rows]
     return loadings, scores, explained
 
 
@@ -725,7 +735,17 @@ def multi_dirfix_storage(scores, x, fill, mu, reputation,
     and ``old = rep @ X`` is exactly the weighted column means ``mu``
     already in hand. Same sign-canonical banded tie-break per component
     (numpy_kernels.DIRFIX_TIE_ATOL).
-    Returns (R, k) direction-fixed scores."""
+
+    ``x`` may arrive ROW-PADDED past ``scores`` (the iterated-pipeline
+    pad hoist — :func:`sztorc_scores_power_fused`'s ``n_rows`` contract):
+    ``scores`` always has the TRUE reporter count, and
+    ``storage_rows_matmat`` zero-pads the stacked ``[scores; ones]``
+    operand up to the matrix's padded rows — zero weights against the
+    pad rows' zero storage values, so every contraction (including the
+    ones-row column sums) is exactly the unpadded result. Do not replace
+    that zero-pad with a shape assertion, and size any future row
+    contraction here from ``scores``, not ``x``.
+    Returns (R, k) direction-fixed scores, R = scores' row count."""
     from .pallas_kernels import storage_rows_matmat
 
     acc = reputation.dtype
@@ -1027,6 +1047,24 @@ def smooth(this_rep, old_rep, alpha):
     return alpha * this_rep + (1.0 - alpha) * old_rep
 
 
+def gather_median_pays(n_scaled: int, n_events: int) -> bool:
+    """Whether the static-gather median (sort only the scaled columns)
+    beats the full-width sort — the ONE copy of the gate shared by
+    :func:`resolve_outcomes`, ``Oracle``'s params wiring, and the sharded
+    front-end's ``_xla_path_n_scaled``.
+
+    The gather pays one O(R*n_scaled) copy to skip the multi-pass sort of
+    the binary columns, so it wins for any minority AND for majorities
+    (round-4 A/B at 60% scaled: 1.54 s -> 1.01 s blocking). Sizing of the
+    9/10 cutoff: per-column costs measured on v5e at 10k x 100k put the
+    full-width sort at ~14 us/col and gather+sort at ~14.5 us/col, so the
+    break-even sits near n_scaled/E ~ 0.93-0.97; 0.9 keeps a margin, and
+    also bounds the degenerate tail where a near-whole-matrix copy (plus
+    a per-count jit recompile — n_scaled is a static cache key) would buy
+    the sort of a handful of columns."""
+    return 0 < n_scaled and n_scaled * 10 <= n_events * 9
+
+
 def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
                      any_scaled: bool = True, has_na: bool = True,
                      median_block: int = _MEDIAN_BLOCK,
@@ -1052,17 +1090,16 @@ def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
     multi-device event-sharded mesh, see that docstring).
 
     ``n_scaled`` (static; 0 = unknown): the EXACT number of scaled events.
-    When known, single-device (``median_block > 0``), and below E (any
-    binary column at all), the median runs on a static gather of just the
+    When known, single-device (``median_block > 0``), and within
+    :func:`gather_median_pays`' envelope (up to 90% of columns — sizing
+    note there), the median runs on a static gather of just the
     scaled columns instead of all E — the sort phase, resolution's only
     O(R log R * E) cost, shrinks by E/n_scaled (25x at the scaled-heavy
-    bench shape of 4k scaled x 100k events). The gather pays one
-    O(R * n_scaled) copy, strictly cheaper per column than the multi-pass
-    sort it saves, so it fires for scaled MAJORITIES too (round-4
-    same-session A/B at 60k of 100k scaled: 1.54 s -> 1.01 s blocking,
-    0.69 -> 1.10 res/s); only the all-scaled
-    case (n_scaled == E) runs full-width, where a gather is a pure copy
-    of the whole matrix. Not used on the sharded path:
+    bench shape of 4k scaled x 100k events), and scaled MAJORITIES win
+    too (round-4 same-session A/B at 60k of 100k scaled: 1.54 -> 1.01 s
+    blocking, 0.69 -> 1.10 res/s). Near-all-scaled and all-scaled
+    matrices run full-width (the gather would copy ~the whole matrix to
+    skip a handful of sorted columns). Not used on the sharded path:
     a cross-shard column gather would move (R, n_scaled) over ICI, while
     the per-shard full median moves nothing. A WRONG count silently
     corrupts outcomes (the gather pads/truncates) — callers must pass the
@@ -1087,7 +1124,7 @@ def resolve_outcomes(present, reports_filled, smooth_rep, scaled, tolerance,
         tw = jnp.broadcast_to(full_total, (E,))
         means = full_mean
     if any_scaled:
-        if 0 < n_scaled < E and median_block > 0:
+        if gather_median_pays(n_scaled, E) and median_block > 0:
             idx = jnp.nonzero(scaled, size=n_scaled)[0]
             med_s = weighted_median_cols(
                 jnp.take(reports_filled, idx, axis=1), smooth_rep,

@@ -28,6 +28,7 @@ import numpy as np
 
 from .models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
                               ConsensusParams, consensus_jax, consensus_np)
+from .ops import jax_kernels as jk
 
 __all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "parse_event_bounds",
            "assemble_result"]
@@ -263,15 +264,15 @@ class Oracle:
         self.backend = backend
         self.verbose = verbose
         # static scaled count for the jax path's gather-median fast path
-        # (resolve_outcomes(n_scaled=...): median only the scaled columns).
-        # Only set when the gather would fire (any binary column at all —
-        # round 4 opened the gate to scaled majorities; see
-        # resolve_outcomes' sizing note) — the count is a jit-static
-        # param, so carrying it uselessly would fragment the compile
-        # cache across scaled counts for nothing.
+        # (resolve_outcomes(n_scaled=...): median only the scaled columns;
+        # round 4 opened the gate to scaled majorities within the shared
+        # gather_median_pays envelope). Only set when the gather would
+        # fire — the count is a jit-static param, so carrying it
+        # uselessly would fragment the compile cache across scaled
+        # counts for nothing.
         n_sc = int(scaled.sum())
         self.params = ConsensusParams(
-            n_scaled=n_sc if 0 < n_sc < n_events else 0,
+            n_scaled=n_sc if jk.gather_median_pays(n_sc, n_events) else 0,
             any_scaled=bool(scaled.any()),
             has_na=bool(np.isnan(self.reports).any()),
             algorithm=algorithm,

@@ -27,6 +27,7 @@ from typing import NamedTuple, Optional
 import jax
 import numpy as np
 
+from ..ops import jax_kernels as jk
 from ..models.pipeline import (HYBRID_ALGORITHMS, ConsensusParams,
                                _consensus_hybrid, consensus_light_jit)
 from ..oracle import Oracle, assemble_result, parse_event_bounds
@@ -73,7 +74,24 @@ def _pick_pca_method(params: ConsensusParams, n_reporters: int,
         # an explicit fused request downgrades to the XLA matvecs
         return "power"
     if params.algorithm in _MULTI_COMPONENT_ALGOS:
-        # mirror weighted_prin_comps' own auto routing: tiny-E exact
+        if params.pca_method in ("power", "power-fused"):
+            # honor an explicit matrix-free request, exactly as
+            # weighted_prin_comps does ("an explicit power-family request
+            # always takes the orthogonal-iteration path") — it is also
+            # the only resolution that can open the multi-component fused
+            # gate (int8 storage at small R was impossible before this)
+            return "power"
+        if params.pca_method in ("eigh-cov", "eigh-gram"):
+            # ... and an explicit EXACT request is honored symmetrically
+            # (weighted_prin_comps accepts either at any shape): silently
+            # swapping a requested eigh for iterative power would change
+            # the numerics the caller pinned, the same defect class in
+            # the other direction. The caller owns the memory consequence
+            # (E x E for eigh-cov, the R x R QDWH temporaries for
+            # eigh-gram — the auto rules below exist to dodge exactly
+            # those at scale).
+            return params.pca_method
+        # "auto": mirror weighted_prin_comps' own routing: tiny-E exact
         # eigh-cov, exact Gram eigh while its QDWH temporaries fit,
         # matrix-free orthogonal iteration beyond (the R=10k Gram eigh
         # OOMed a v5e — docs/ROADMAP.md 2026-07-31; "power" routes
@@ -111,13 +129,13 @@ def _xla_path_n_scaled(p: ConsensusParams, n_events: int, mesh: Mesh) -> int:
     recompiling per distinct value. Keep it exactly when the gather path
     would actually fire: single-device event axis (a cross-shard gather
     would move (R, n_scaled) over ICI — the sharded median is local) and
-    at least one binary column (all-scaled makes the gather a pure
-    whole-matrix copy; round 4 opened the gate to scaled majorities —
-    see resolve_outcomes' sizing note); otherwise zero it so the cache
-    keys only on ``any_scaled``."""
+    within the shared ``jax_kernels.gather_median_pays`` envelope (up to
+    90% scaled — round 4 opened the gate to majorities; sizing note
+    there); otherwise zero it so the cache keys only on
+    ``any_scaled``."""
     if (mesh.shape.get("event", 1) == 1
             and p.median_block > 0          # unblocked mode ignores n_scaled
-            and 0 < p.n_scaled < n_events):
+            and jk.gather_median_pays(p.n_scaled, n_events)):
         return p.n_scaled
     return 0
 

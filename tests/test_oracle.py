@@ -624,9 +624,10 @@ class TestValidation:
 
     def test_n_scaled_static_wiring(self):
         """Oracle carries the exact static scaled count whenever the
-        gather-median path can fire (any binary column at all — round 4
-        opened the gate to scaled majorities); all-scaled and all-binary
-        carry 0 (the gather would be a whole-matrix copy / is unused)."""
+        gather-median path can fire (the shared gather_median_pays
+        envelope, up to 90% scaled — round 4 opened the gate to
+        majorities); near-all-scaled and all-binary carry 0 (a gather of
+        ~the whole matrix buys nothing / is unused)."""
         bounds_minor = [None, None, None,
                         {"scaled": True, "min": 0.0, "max": 10.0}]
         o = Oracle(reports=CANONICAL, event_bounds=bounds_minor)
@@ -639,6 +640,13 @@ class TestValidation:
         o = Oracle(reports=CANONICAL, event_bounds=bounds_all)
         assert o.params.n_scaled == 0          # all-scaled: nothing to skip
         assert Oracle(reports=CANONICAL).params.n_scaled == 0
+        # above the 90% envelope (10 of 11): the gather would copy ~the
+        # whole matrix and fragment the jit cache per count — full-width
+        reports_11 = np.tile(CANONICAL[:, :1], (1, 11))
+        bounds_tail = [{"scaled": True, "min": 0.0, "max": 10.0}] * 10 \
+            + [None]
+        o = Oracle(reports=reports_11, event_bounds=bounds_tail)
+        assert o.params.n_scaled == 0
 
     def test_algorithm_aliases(self):
         o = Oracle(reports=CANONICAL, algorithm="kmeans")

@@ -392,6 +392,29 @@ class TestFusedResolution:
             p._replace(any_scaled=True), 10_000, 100_000, 1)
         assert not _use_fused_resolution(p, 10_000, 100_000, 8)
 
+    def test_multi_component_explicit_power_honored(self):
+        """An explicit power-family request on ica/fixed-variance resolves
+        to 'power' even where auto routing would pick the exact Gram eigh
+        (R <= _GRAM_EIGH_MAX_R) — matching weighted_prin_comps' own rule
+        and keeping the multi-component fused gate (int8 storage at small
+        R) reachable. Auto still routes small R to the exact eigh."""
+        from pyconsensus_tpu.parallel.sharded import _pick_pca_method
+        p = ConsensusParams(algorithm="ica", any_scaled=False)
+        for req in ("power", "power-fused"):
+            got = _pick_pca_method(p._replace(pca_method=req), 1003, 4096)
+            assert got == "power", (req, got)
+        # ... and explicit EXACT requests are honored symmetrically, even
+        # where auto would route to power (R > _GRAM_EIGH_MAX_R) or away
+        # from eigh-cov (E > 1024)
+        assert _pick_pca_method(p._replace(pca_method="eigh-gram"),
+                                5000, 4096) == "eigh-gram"
+        assert _pick_pca_method(p._replace(pca_method="eigh-cov"),
+                                1003, 4096) == "eigh-cov"
+        assert _pick_pca_method(p._replace(pca_method="auto"),
+                                1003, 4096) == "eigh-gram"
+        assert _pick_pca_method(p._replace(pca_method="auto"),
+                                1003, 512) == "eigh-cov"
+
     def test_vmem_fit_models(self):
         """The scoped-VMEM fit models encode the measured compile failures:
         E=200k f32 and R=20k f32-at-C=128 blow the 16 MB limit; the bench
