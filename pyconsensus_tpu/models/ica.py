@@ -110,18 +110,31 @@ def _canon_signs_jax(Z):
     return Z * signs[None, :]
 
 
-def ica_scores_jax(reports_filled, reputation, max_components, pca_method="auto"):
-    """JAX mirror of :func:`ica_scores_np`: ``(adj_scores, converged)``
-    with a traced bool flag (False = the chaotic-case fallback fired)."""
-    k = int(min(max_components, min(reports_filled.shape) - 1))
-    k = max(k, 1)
-    _, scores, _ = jk.weighted_prin_comps(reports_filled, reputation, k,
-                                          method=pca_method)
+def ica_k(n_reporters: int, n_events: int, max_components: int) -> int:
+    """The whitening-subspace width ``ica`` extracts from — one copy of
+    the sizing rule, shared by every scorer variant and by the iterated
+    pipeline's warm-start carry (whose static shape must match)."""
+    return max(int(min(max_components, min(n_reporters, n_events) - 1)), 1)
+
+
+def ica_scores_jax(reports_filled, reputation, max_components,
+                   pca_method="auto", v_init=None):
+    """JAX mirror of :func:`ica_scores_np`:
+    ``(adj_scores, converged, loadings)`` — a traced bool flag (False =
+    the chaotic-case fallback fired) plus the (E, k) whitening-subspace
+    block, returned so the iterative pipeline can feed it back as
+    ``v_init`` (jax_kernels.weighted_prin_comps' warm start; eigh
+    methods ignore it and return their closed-form block)."""
+    k = ica_k(*reports_filled.shape, max_components)
+    loadings, scores, _ = jk.weighted_prin_comps(reports_filled, reputation,
+                                                 k, method=pca_method,
+                                                 v_init=v_init)
     std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
     Z = _canon_signs_jax(scores / std[None, :])
     w, converged = _fastica_one_unit(Z, _conv_tol(Z.dtype))
     s = Z @ w
-    return jk.direction_fixed_scores(s, reports_filled, reputation), converged
+    return (jk.direction_fixed_scores(s, reports_filled, reputation),
+            converged, loadings)
 
 
 def _fastica_one_unit(Z, tol):
@@ -156,7 +169,7 @@ def _fastica_one_unit(Z, tol):
 
 
 def ica_scores_storage(x, fill, mu, reputation, max_components,
-                       interpret=False, n_rows=None):
+                       interpret=False, n_rows=None, v_init=None):
     """``ica`` scoring straight off sentinel-threaded storage (the fused
     pipeline's compact encoding): the whitening subspace comes from the
     storage-kernel orthogonal iteration
@@ -164,7 +177,9 @@ def ica_scores_storage(x, fill, mu, reputation, max_components,
     itself runs on the small (R, k) whitened block exactly as
     :func:`ica_scores_jax`; the final direction fix is one further
     storage sweep (jax_kernels.multi_dirfix_storage on the single
-    extracted component). Returns ``(adj_scores, converged)``.
+    extracted component). Returns ``(adj_scores, converged, loadings)``
+    — the (E, k) block is the iterative pipeline's warm-start carry
+    (``v_init``, the orth-iter blend rule).
 
     ``n_rows``: pre-padded-input contract
     (jax_kernels.sztorc_scores_power_fused) — the TRUE reporter count
@@ -172,15 +187,14 @@ def ica_scores_storage(x, fill, mu, reputation, max_components,
     count and the whitened block so pad rows never enter the FastICA
     statistics."""
     R_true = x.shape[0] if n_rows is None else n_rows
-    k = int(min(max_components, min(R_true, x.shape[1]) - 1))
-    k = max(k, 1)
-    _, scores, _ = jk.weighted_prin_comps_storage(x, fill, mu, reputation,
-                                                  k, interpret=interpret,
-                                                  n_rows=n_rows)
+    k = ica_k(R_true, x.shape[1], max_components)
+    loadings, scores, _ = jk.weighted_prin_comps_storage(
+        x, fill, mu, reputation, k, interpret=interpret, n_rows=n_rows,
+        v_init=v_init)
     std = jnp.sqrt(jnp.clip(jnp.var(scores, axis=0), _EPS, None))
     Z = _canon_signs_jax(scores / std[None, :])
     w, converged = _fastica_one_unit(Z, _conv_tol(Z.dtype))
     s = Z @ w
     adj = jk.multi_dirfix_storage(s[:, None], x, fill, mu, reputation,
                                   interpret=interpret)[:, 0]
-    return adj, converged
+    return adj, converged, loadings

@@ -208,19 +208,30 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
 def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
     """JAX mirror of ``_scores_np``:
     ``(adj_scores, loading-or-None, ica_converged-or-None)``.
-    ``v_init`` warm-starts sztorc's power-family PCA (ignored elsewhere)."""
+    ``v_init`` warm-starts sztorc's power-family PCA. The multi-component
+    scorers deliberately do NOT warm-start on this path: the fused
+    storage pipeline's subspace warm start measured +97% on iterated
+    FIXED-VARIANCE (docs/MEASUREMENTS_r04.json — ica is excluded there
+    too: FastICA chaotically amplifies the warm basis shift), but the
+    SAME warm start on this XLA path measured an 8x per-iteration
+    REGRESSION at 10000x100000 (ica, 2.39 -> 0.29 res/s at
+    max_iterations=5, same session) — the warm-started orthogonal
+    iteration stops exiting early under this path's HIGHEST-precision
+    matmuls. Until that is understood, the XLA path runs the
+    multi-component extraction cold each iteration, as it always has."""
     algo = p.algorithm
     if algo == "sztorc":
         return (*sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters,
                                    p.power_tol, p.matvec_dtype,
                                    v_init=v_init), None)
     if algo == "fixed-variance":
-        return (*fixed_variance_scores_jax(filled, rep, p.variance_threshold,
-                                           p.max_components, p.pca_method),
-                None)
+        adj, loadings = fixed_variance_scores_jax(
+            filled, rep, p.variance_threshold, p.max_components,
+            p.pca_method)
+        return adj, loadings[:, 0], None
     if algo == "ica":
-        adj, conv = ica_scores_jax(filled, rep, p.max_components,
-                                   p.pca_method)
+        adj, conv, _ = ica_scores_jax(filled, rep, p.max_components,
+                                      p.pca_method)
         return adj, None, conv
     if algo == "k-means":
         return cl.kmeans_conformity_jax(filled, rep, p.num_clusters), None, None
@@ -229,6 +240,22 @@ def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
                                             p.dbscan_min_samples), None, None
     raise ValueError(f"algorithm {algo!r} is not jit-compatible "
                      f"(hybrid algorithms: {HYBRID_ALGORITHMS})")
+
+
+def _subspace_carry_shape(p: ConsensusParams, R: int, E: int):
+    """Static shape of the warm-start carry the fused scan threads
+    between redistribution iterations: sztorc's (E,) loading, or
+    fixed-variance's (E, k) subspace block (k from the scorer's shared
+    sizing rule — the carry must match what it returns). ica also gets
+    (E,): it runs its whitening cold every iteration (see the fused
+    scores_at note), so there is nothing to carry. None for the
+    clustering variants."""
+    if p.algorithm == "fixed-variance":
+        from .sztorc import fixed_variance_k
+        return (E, fixed_variance_k(R, E, p.max_components))
+    if p.algorithm in ("sztorc", "ica"):
+        return (E,)
+    return None
 
 
 def _iterate_jax(filled, old_rep, p: ConsensusParams):
@@ -240,13 +267,15 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
 
     has_loading = p.algorithm in ("sztorc", "fixed-variance")
     E = filled.shape[1]
+    carry_shape = (E,)
 
     def step(carry, _):
         rep, this_rep_prev, loading_prev, ica_prev, converged, iters = carry
         # warm start: the previous iteration's loading (zeros on iteration
-        # 1 → cold start inside _power_loop); reputation moves a little per
-        # redistribution step, so the power iteration restarts almost
-        # converged and the early exit saves most of its HBM sweeps
+        # 1 → cold start inside _power_loop); reputation moves a little
+        # per redistribution step, so the power iteration restarts almost
+        # converged and the early exit saves most of its HBM sweeps.
+        # Multi-component scorers run cold — see _scores_jax's note.
         adj, loading, ica_c = _scores_jax(filled, rep, p, v_init=loading_prev)
         if loading is None:
             loading = loading_prev
@@ -265,7 +294,7 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
                 iters_out), None
 
     n = max(p.max_iterations, 1)
-    init = (old_rep, old_rep, jnp.zeros((E,), dtype=old_rep.dtype),
+    init = (old_rep, old_rep, jnp.zeros(carry_shape, dtype=old_rep.dtype),
             jnp.asarray(True), jnp.asarray(False),
             jnp.asarray(0, dtype=jnp.int32))
     (rep, this_rep, loading, ica_conv, converged, iters), _ = lax.scan(
@@ -467,14 +496,25 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
                 return (*fixed_variance_scores_storage(
                     xp, fill, mu_k, _rep_pad(rep_k), p.variance_threshold,
                     p.max_components, interpret=interp,
-                    n_rows=R_true), None)
+                    n_rows=R_true, v_init=v_init), None)
         else:
             def scores_at(rep_k, mu_k, v_init=None):
-                adj, conv = ica_scores_storage(xp, fill, mu_k,
-                                               _rep_pad(rep_k),
-                                               p.max_components,
-                                               interpret=interp,
-                                               n_rows=R_true)
+                # ica deliberately runs its whitening COLD each iteration
+                # (no v_init, no subspace carried — the (E,) carry stays
+                # zeros): the warm-started subspace lands the
+                # near-degenerate bulk columns in a different basis than
+                # the cold start's, and FastICA amplifies that
+                # chaotically (the module-documented ICA sensitivity) —
+                # measured 58% of this_rep entries beyond the 2e-3
+                # fused-vs-XLA parity tolerance at max_iterations=3.
+                # fixed-variance keeps the warm start: its
+                # variance-weighted combination is continuous in the
+                # subspace (parity-green, ~2x on iterated runs).
+                adj, conv, _ = ica_scores_storage(xp, fill, mu_k,
+                                                  _rep_pad(rep_k),
+                                                  p.max_components,
+                                                  interpret=interp,
+                                                  n_rows=R_true)
                 return adj, None, conv
     E = x.shape[1]
 
@@ -491,12 +531,12 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
     else:
         def step(carry, _):
             rep_c, this_prev, loading_prev, ica_prev, conv, it = carry
-            # warm start from the previous iteration's loading (zeros on
-            # iteration 1 → cold start inside _power_loop; the
-            # multi-component scorers ignore it)
+            # warm start from the previous iteration's loading/subspace
+            # (zeros on iteration 1 → cold start inside _power_loop /
+            # the orth-iter blend)
             adj, loading, ica_c = scores_at(rep_c, _masked_mu(x, fill, rep_c),
                                             v_init=loading_prev)
-            if loading is None:
+            if loading is None:                  # ica: keep the zeros carry
                 loading = loading_prev
             if ica_c is None:
                 ica_c = ica_prev
@@ -512,11 +552,14 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
             return (rep_out, this_out, loading_out, ica_out, conv_out,
                     it_out), None
 
-        init = (old_rep, old_rep, jnp.zeros((E,), dtype=acc),
+        init = (old_rep, old_rep,
+                jnp.zeros(_subspace_carry_shape(p, R_true, E), dtype=acc),
                 jnp.asarray(True), jnp.asarray(False),
                 jnp.asarray(0, dtype=jnp.int32))
         (rep, this_rep, loading, ica_conv, converged, iters), _ = lax.scan(
             step, init, None, length=p.max_iterations)
+    if loading.ndim == 2:
+        loading = loading[:, 0]        # reported first loading (non-ica)
 
     raw, adjusted, certainty, pcol, prow, narow = resolve_certainty_fused(
         x, rep, fill, jnp.sum(rep), float(p.catch_tolerance),

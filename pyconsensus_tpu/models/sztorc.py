@@ -110,21 +110,35 @@ def fixed_variance_scores_np(reports_filled, reputation, variance_threshold,
     return adj, loadings[:, 0]
 
 
+def fixed_variance_k(n_reporters: int, n_events: int,
+                     max_components: int) -> int:
+    """The component count ``fixed-variance`` extracts — one copy of the
+    sizing rule, shared by every scorer variant and by the iterated
+    pipeline's warm-start carry (whose static shape must match)."""
+    return int(min(max_components, min(n_reporters, n_events)))
+
+
 def fixed_variance_scores_jax(reports_filled, reputation, variance_threshold,
-                              max_components, pca_method="auto"):
+                              max_components, pca_method="auto",
+                              v_init=None):
     """JAX mirror of :func:`fixed_variance_scores_np`; the data-dependent
-    component selection stays in-graph as a mask (static component count)."""
-    k = min(max_components, min(reports_filled.shape))
+    component selection stays in-graph as a mask (static component count).
+    Returns ``(adj_scores, loadings)`` with the FULL (E, k) block — the
+    iterative pipeline feeds it back as ``v_init``
+    (jax_kernels.weighted_prin_comps' orth-iter warm start; eigh methods
+    ignore it), and reports column 0 as ``first_loading``."""
+    k = fixed_variance_k(*reports_filled.shape, max_components)
     loadings, scores, explained = jk.weighted_prin_comps(reports_filled,
                                                          reputation, k,
-                                                         method=pca_method)
+                                                         method=pca_method,
+                                                         v_init=v_init)
     w = _component_weights_jax(explained, variance_threshold)
 
     def fix_one(scores_c):
         return jk.direction_fixed_scores(scores_c, reports_filled, reputation)
 
     adj_all = jax.vmap(fix_one, in_axes=1, out_axes=1)(scores)   # (R, k)
-    return adj_all @ w, loadings[:, 0]
+    return adj_all @ w, loadings
 
 
 def _component_weights_jax(explained, variance_threshold):
@@ -143,7 +157,8 @@ def _component_weights_jax(explained, variance_threshold):
 
 def fixed_variance_scores_storage(x, fill, mu, reputation,
                                   variance_threshold, max_components,
-                                  interpret=False, n_rows=None):
+                                  interpret=False, n_rows=None,
+                                  v_init=None):
     """``fixed-variance`` scoring straight off sentinel-threaded storage
     (the fused pipeline's compact encoding, SURVEY.md §2 #10): the top-k
     subspace by storage-kernel orthogonal iteration
@@ -156,12 +171,15 @@ def fixed_variance_scores_storage(x, fill, mu, reputation,
     ``n_rows``: pre-padded-input contract
     (jax_kernels.sztorc_scores_power_fused) — the TRUE reporter count
     when ``x``/``reputation`` arrive row-padded; it sizes the component
-    count and the sliced scores."""
+    count and the sliced scores. Returns ``(adj_scores, loadings)`` with
+    the FULL (E, k) block, like :func:`fixed_variance_scores_jax` (the
+    pipeline's warm-start carry)."""
     R_true = x.shape[0] if n_rows is None else n_rows
-    k = min(max_components, min(R_true, x.shape[1]))
+    k = fixed_variance_k(R_true, x.shape[1], max_components)
     loadings, scores, explained = jk.weighted_prin_comps_storage(
-        x, fill, mu, reputation, k, interpret=interpret, n_rows=n_rows)
+        x, fill, mu, reputation, k, interpret=interpret, n_rows=n_rows,
+        v_init=v_init)
     w = _component_weights_jax(explained, variance_threshold)
     adj_all = jk.multi_dirfix_storage(scores, x, fill, mu, reputation,
                                       interpret=interpret)       # (R, k)
-    return adj_all @ w, loadings[:, 0]
+    return adj_all @ w, loadings

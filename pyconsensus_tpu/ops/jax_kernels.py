@@ -462,7 +462,7 @@ def _decode_storage(x, fill, acc):
 def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
                        n_components: int, n_iters: int = _ORTH_ITERS,
                        tol: float = 0.0, fill=None,
-                       interpret: bool = False):
+                       interpret: bool = False, v_init=None):
     """Top-``k`` principal subspace of the implicit weighted covariance by
     blocked orthogonal iteration (subspace/simultaneous power iteration) —
     the multi-component analogue of :func:`_first_pc_power`. Never
@@ -499,6 +499,19 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     Start block: fixed-key normal (deterministic; measure-zero
     orthogonality risk — the ones vector is EXACTLY orthogonal to
     antisymmetric eigenvectors, see :func:`_power_seed`).
+
+    ``v_init`` (optional, (E, k)) warm-starts the subspace — the
+    iterative pipeline feeds each outer redistribution iteration the
+    previous iteration's converged block, so the loop re-enters almost
+    aligned and the exit fires after a sweep or two instead of a cold
+    handful (each saved sweep is TWO HBM passes here). Same reachability
+    blend as :func:`_power_loop`'s single-vector warm start and for the
+    same reason: a stale block is an exactly invariant subspace of
+    ``apply_cov_block``, so a pure warm start could pass the alignment
+    exit while a newly-risen direction sits outside the span; mixing in
+    the cold random block keeps every direction reachable. An all-zero
+    ``v_init`` (outer iteration 1's scan carry) falls back to the cold
+    start bitwise.
 
     With ``fill`` given, ``reports_filled`` is sentinel-threaded storage
     (int8 lattice / NaN-threaded float — the fused pipeline's compact
@@ -558,6 +571,15 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
 
     v0 = jax.random.normal(jax.random.key(0), (E, k), acc)
     V0, _ = jnp.linalg.qr(v0)
+    if v_init is not None:
+        ni = jnp.linalg.norm(v_init)
+        # columns of a real v_init are unit (a converged orthonormal
+        # block); 0.25 mirrors _power_loop's cold-seed blend weight
+        blended = (v_init.astype(acc) / jnp.where(ni > 0.0, ni, 1.0)
+                   * jnp.sqrt(jnp.asarray(float(k), acc)) + 0.25 * V0)
+        Qw, _ = jnp.linalg.qr(blended)
+        Qw = jnp.where(jnp.isfinite(Qw), Qw, V0)
+        V0 = jnp.where(ni > 0.0, Qw, V0)
 
     tol = max(float(tol), 8.0 * float(jnp.finfo(acc).eps))
 
@@ -631,7 +653,7 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
 
 
 def weighted_prin_comps(reports_filled, reputation, n_components: int,
-                        method: str = "auto"):
+                        method: str = "auto", v_init=None):
     """Top-k components + explained-variance fractions for the
     ``fixed-variance`` and ``ica`` variants
     (numpy_kernels.weighted_prin_comps). Uses the E×E eigh for small E,
@@ -640,13 +662,16 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
     the Gram matrix), and matrix-free orthogonal iteration beyond that
     (:func:`_top_pcs_orth_iter` — the Gram eigh's QDWH temporaries OOM a
     single chip at R=10k). An explicit ``"power"``-family request always
-    takes the orthogonal-iteration path."""
+    takes the orthogonal-iteration path. ``v_init`` warm-starts the
+    orthogonal iteration (:func:`_top_pcs_orth_iter`'s blend rule);
+    closed-form eigh methods ignore it."""
     R, E = reports_filled.shape
     if method in ("power", "power-fused") or (
             method == "auto" and E > 1024 and R > _GRAM_EIGH_MAX_R):
         mu, denom = _mu_denom(reports_filled, reputation)
         loadings, eig, total = _top_pcs_orth_iter(
-            reports_filled, mu, denom, reputation, n_components)
+            reports_filled, mu, denom, reputation, n_components,
+            v_init=v_init)
         explained = jnp.where(total > 0.0,
                               eig / jnp.where(total > 0.0, total, 1.0),
                               jnp.zeros_like(eig))
@@ -686,7 +711,7 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
 
 def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
                                 interpret: bool = False,
-                                n_rows: Optional[int] = None):
+                                n_rows: Optional[int] = None, v_init=None):
     """Top-k components + explained fractions straight off sentinel
     storage (the fused pipeline's compact encoding): orthogonal iteration
     with both block sweeps through the Pallas storage kernels, then one
@@ -709,7 +734,7 @@ def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
     denom = jnp.where(denom == 0.0, 1.0, denom)
     loadings, eig, total = _top_pcs_orth_iter(
         x, mu, denom, reputation, n_components, fill=fill,
-        interpret=interpret)
+        interpret=interpret, v_init=v_init)
     explained = jnp.where(total > 0.0,
                           eig / jnp.where(total > 0.0, total, 1.0),
                           jnp.zeros_like(eig))
