@@ -34,8 +34,9 @@ from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 from . import clustering as cl
 from .ica import ica_scores_jax, ica_scores_np
-from .sztorc import (fixed_variance_scores_jax, fixed_variance_scores_np,
-                     sztorc_scores_jax, sztorc_scores_np)
+from .sztorc import (fixed_variance_k, fixed_variance_scores_jax,
+                     fixed_variance_scores_np, sztorc_scores_jax,
+                     sztorc_scores_np)
 
 __all__ = ["ConsensusParams", "consensus_np", "consensus_jax", "JIT_ALGORITHMS"]
 
@@ -208,27 +209,26 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
 def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
     """JAX mirror of ``_scores_np``:
     ``(adj_scores, loading-or-None, ica_converged-or-None)``.
-    ``v_init`` warm-starts sztorc's power-family PCA. The multi-component
-    scorers deliberately do NOT warm-start on this path: the fused
-    storage pipeline's subspace warm start measured +97% on iterated
-    FIXED-VARIANCE (docs/MEASUREMENTS_r04.json — ica is excluded there
-    too: FastICA chaotically amplifies the warm basis shift), but the
-    SAME warm start on this XLA path measured an 8x per-iteration
-    REGRESSION at 10000x100000 (ica, 2.39 -> 0.29 res/s at
-    max_iterations=5, same session) — the warm-started orthogonal
-    iteration stops exiting early under this path's HIGHEST-precision
-    matmuls. Until that is understood, the XLA path runs the
-    multi-component extraction cold each iteration, as it always has."""
+    ``v_init`` warm-starts the power-family PCA of sztorc (its (E,)
+    loading) and fixed-variance (its FULL (E, k) subspace block, which
+    the loading slot then carries — the caller slices column 0 for
+    reporting). ica deliberately runs COLD each iteration on every
+    path: a warm-started whitening subspace lands the near-degenerate
+    bulk columns in a different basis than a cold start's, and FastICA
+    amplifies that chaotically beyond the parity tolerances (measured —
+    see the fused pipeline's scores_at note). It measured +61% on
+    iterated ica at 10000x100000 before being rejected on those
+    semantics, so the fuel is known if the basis sensitivity is ever
+    tamed."""
     algo = p.algorithm
     if algo == "sztorc":
         return (*sztorc_scores_jax(filled, rep, p.pca_method, p.power_iters,
                                    p.power_tol, p.matvec_dtype,
                                    v_init=v_init), None)
     if algo == "fixed-variance":
-        adj, loadings = fixed_variance_scores_jax(
+        return (*fixed_variance_scores_jax(
             filled, rep, p.variance_threshold, p.max_components,
-            p.pca_method)
-        return adj, loadings[:, 0], None
+            p.pca_method, v_init=v_init), None)
     if algo == "ica":
         adj, conv, _ = ica_scores_jax(filled, rep, p.max_components,
                                       p.pca_method)
@@ -243,19 +243,31 @@ def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
 
 
 def _subspace_carry_shape(p: ConsensusParams, R: int, E: int):
-    """Static shape of the warm-start carry the fused scan threads
-    between redistribution iterations: sztorc's (E,) loading, or
-    fixed-variance's (E, k) subspace block (k from the scorer's shared
-    sizing rule — the carry must match what it returns). ica also gets
-    (E,): it runs its whitening cold every iteration (see the fused
-    scores_at note), so there is nothing to carry. None for the
-    clustering variants."""
+    """Static shape of the warm-start carry BOTH redistribution scans
+    (XLA `_iterate_jax` and the fused pipeline's) thread between
+    iterations: sztorc's (E,) loading, or fixed-variance's (E, k)
+    subspace block (k from the scorer's shared sizing rule — the carry
+    must match what it returns; ``R`` must be the TRUE reporter count,
+    not a padded one). ica also gets (E,): it runs its whitening cold
+    every iteration (see _scores_jax's note), so there is nothing to
+    carry. None for the clustering variants."""
     if p.algorithm == "fixed-variance":
-        from .sztorc import fixed_variance_k
         return (E, fixed_variance_k(R, E, p.max_components))
     if p.algorithm in ("sztorc", "ica"):
         return (E,)
     return None
+
+
+def _reported_loading(p: ConsensusParams, loading):
+    """The (E,) loading the result dict reports, extracted from the scan
+    carry: fixed-variance carries its full (E, k) block for the warm
+    start and reports column 0 (the first principal loading, like its
+    numpy mirror); every other carry is already (E,). Keyed on the
+    algorithm, NOT on array rank — a future 2-D carry must opt in here
+    explicitly."""
+    if p.algorithm == "fixed-variance":
+        return loading[:, 0]
+    return loading
 
 
 def _iterate_jax(filled, old_rep, p: ConsensusParams):
@@ -266,16 +278,17 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
     static shapes."""
 
     has_loading = p.algorithm in ("sztorc", "fixed-variance")
-    E = filled.shape[1]
-    carry_shape = (E,)
+    R, E = filled.shape
+    carry_shape = _subspace_carry_shape(p, R, E) or (E,)
 
     def step(carry, _):
         rep, this_rep_prev, loading_prev, ica_prev, converged, iters = carry
-        # warm start: the previous iteration's loading (zeros on iteration
-        # 1 → cold start inside _power_loop); reputation moves a little
-        # per redistribution step, so the power iteration restarts almost
-        # converged and the early exit saves most of its HBM sweeps.
-        # Multi-component scorers run cold — see _scores_jax's note.
+        # warm start: the previous iteration's loading/subspace (zeros on
+        # iteration 1 → cold start inside _power_loop / the orth-iter
+        # blend); reputation moves a little per redistribution step, so
+        # the power-family iteration restarts almost converged and the
+        # early exit saves most of its HBM sweeps. ica runs cold — see
+        # _scores_jax's note.
         adj, loading, ica_c = _scores_jax(filled, rep, p, v_init=loading_prev)
         if loading is None:
             loading = loading_prev
@@ -299,6 +312,7 @@ def _iterate_jax(filled, old_rep, p: ConsensusParams):
             jnp.asarray(0, dtype=jnp.int32))
     (rep, this_rep, loading, ica_conv, converged, iters), _ = lax.scan(
         step, init, None, length=n)
+    loading = _reported_loading(p, loading)
     return (rep, this_rep, (loading if has_loading else None), converged,
             iters, ica_conv)
 
@@ -558,8 +572,7 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
                 jnp.asarray(0, dtype=jnp.int32))
         (rep, this_rep, loading, ica_conv, converged, iters), _ = lax.scan(
             step, init, None, length=p.max_iterations)
-    if loading.ndim == 2:
-        loading = loading[:, 0]        # reported first loading (non-ica)
+    loading = _reported_loading(p, loading)
 
     raw, adjusted, certainty, pcol, prow, narow = resolve_certainty_fused(
         x, rep, fill, jnp.sum(rep), float(p.catch_tolerance),
