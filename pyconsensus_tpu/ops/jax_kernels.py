@@ -527,7 +527,9 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     use_storage = fill is not None
 
     if use_storage:
-        from .pallas_kernels import (matmat_tile_rows, storage_matmat,
+        from .pallas_kernels import (apply_weighted_cov_block,
+                                     cov_block_kernel_fits,
+                                     matmat_tile_rows, storage_matmat,
                                      storage_rows_matmat, _pad_rows)
 
         # pad once, OUTSIDE the sweep loop (the same hoist
@@ -547,16 +549,25 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
         reports_filled, rep = _pad_rows(reports_filled, rep, tile_r)
         Rp = reports_filled.shape[0]
 
-        def apply_cov_block(V):                  # (E, k) -> (E, k)
-            t = (storage_matmat(reports_filled, V.astype(acc), fill=fill,
-                                interpret=interpret).astype(acc)
-                 - jnp.ones((Rp, 1), acc) * (mu @ V)[None, :])  # (Rp, k)
-            rt = rep[:, None] * t
-            y = (storage_rows_matmat(reports_filled, rt.T.astype(acc),
-                                     fill=fill,
-                                     interpret=interpret).T.astype(acc)
-                 - mu[:, None] * jnp.sum(rt, axis=0)[None, :])  # (E, k)
-            return y / denom
+        if cov_block_kernel_fits(E, k, reports_filled.dtype.itemsize):
+            # one-pass block kernel: both contractions off a single HBM
+            # read per sweep (apply_weighted_cov_block) — the separable
+            # pair below reads the matrix twice per sweep
+            def apply_cov_block(V):              # (E, k) -> (E, k)
+                return apply_weighted_cov_block(
+                    reports_filled, mu, rep, V.astype(acc), fill=fill,
+                    interpret=interpret).astype(acc) / denom
+        else:
+            def apply_cov_block(V):              # (E, k) -> (E, k)
+                t = (storage_matmat(reports_filled, V.astype(acc), fill=fill,
+                                    interpret=interpret).astype(acc)
+                     - jnp.ones((Rp, 1), acc) * (mu @ V)[None, :])  # (Rp, k)
+                rt = rep[:, None] * t
+                y = (storage_rows_matmat(reports_filled, rt.T.astype(acc),
+                                         fill=fill,
+                                         interpret=interpret).T.astype(acc)
+                     - mu[:, None] * jnp.sum(rt, axis=0)[None, :])  # (E, k)
+                return y / denom
     else:
         def apply_cov_block(V):                  # (E, k) -> (E, k)
             t = (jnp.matmul(reports_filled, V.astype(reports_filled.dtype),

@@ -38,10 +38,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["apply_weighted_cov", "power_iteration_fused",
+__all__ = ["apply_weighted_cov", "apply_weighted_cov_block",
+           "power_iteration_fused",
            "scores_dirfix_pass", "resolve_certainty_fused",
            "storage_matvec", "storage_rows_matmat", "storage_matmat",
-           "matmat_kernels_fit", "matmat_tile_rows"]
+           "matmat_kernels_fit", "matmat_tile_rows",
+           "cov_block_kernel_fits"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
 #: times this (double-buffered input + in-register f32 upcast)
@@ -146,6 +148,29 @@ def _vector_aux(v, fill, compact: bool):
             rows.append(fill.astype(jnp.bfloat16).reshape(1, E))
     else:
         rows = [v.reshape(1, E), jnp.zeros((1, E), f32)]
+        if fill is not None:
+            rows.append(fill.astype(f32).reshape(1, E))
+    return jnp.concatenate(rows)
+
+
+def _matrix_aux(V, fill, compact: bool):
+    """The ``(2k(+1), E)`` aux operand of the BLOCK storage kernels
+    (storage_matmat and apply_weighted_cov_block): compensated bf16
+    head/residual rows of ``V^T`` (+ bf16 fill row) on the compact path;
+    ``[V^T; zeros; (fill)]`` f32 rows on the exact-f32 path. The k-column
+    sibling of :func:`_vector_aux`, and one implementation for the same
+    reason — a precision or layout fix must not be applied to one block
+    kernel and silently missed in the other."""
+    E = V.shape[0]
+    f32 = jnp.float32
+    Vt = V.astype(f32).T                                   # (k, E)
+    if compact:
+        Vh, Vl = _compensated_split(Vt)
+        rows = [Vh, Vl]
+        if fill is not None:
+            rows.append(fill.astype(jnp.bfloat16).reshape(1, E))
+    else:
+        rows = [Vt, jnp.zeros_like(Vt)]
         if fill is not None:
             rows.append(fill.astype(f32).reshape(1, E))
     return jnp.concatenate(rows)
@@ -467,19 +492,7 @@ def storage_matmat(x, V, fill=None, interpret: bool = False):
     x, _ = _pad_rows(x, jnp.zeros((R,), jnp.float32), tile_r)
     Rp = x.shape[0]
     f32 = jnp.float32
-    bf16 = jnp.bfloat16
-    Vt = V.astype(f32).T                                       # (k, E)
-    compact = _is_compact(x)
-    if compact:
-        Vh, Vl = _compensated_split(Vt)
-        rows = [Vh, Vl]
-        if nan_fill:
-            rows.append(fill.astype(bf16).reshape(1, E))
-    else:
-        rows = [Vt, jnp.zeros_like(Vt)]
-        if nan_fill:
-            rows.append(fill.astype(f32).reshape(1, E))
-    aux = jnp.concatenate(rows)
+    aux = _matrix_aux(V, fill if nan_fill else None, _is_compact(x))
     t = pl.pallas_call(
         functools.partial(_matmat_kernel, nan_fill=nan_fill, k=k),
         grid=(Rp // tile_r,),
@@ -498,6 +511,140 @@ def storage_matmat(x, V, fill=None, interpret: bool = False):
         interpret=interpret,
     )(x, aux)
     return t[:R]
+
+
+def _cov_block_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
+                      nan_fill, k):
+    """One row panel of the BLOCK covariance application — both
+    contractions of ``(X - 1 mu^T)^T (rep * ((X - 1 mu^T) V))`` off a
+    single HBM read of the panel, the k-column sibling of
+    :func:`_apply_cov_kernel` (which stays VPU for its N=1 shapes; k >= 2
+    makes the stacked MXU dots win, like the dirfix kernel's).
+
+    Algebra identical to the separable two-sweep form the orth-iter used
+    before (storage_matmat then storage_rows_matmat): raw ``t = X V``
+    per panel (compensated aux operand), centered in-register with the
+    precomputed ``mu . V`` row, then the second contraction against the
+    SAME resident panel with an in-kernel compensated split of
+    ``rep * t`` — the caller finishes ``- mu (x) sum(rep * t)`` exactly
+    like the separable caller did. ``s_ref`` accumulates that (1, k)
+    column-sum. The in-kernel split is plain arithmetic Mosaic compiles
+    as written (the XLA-simplifier annihilation that motivated
+    ``_compensated_split``'s barrier is an HLO-pass hazard; the
+    orth-iter-vs-eigh parity test would see the 2^-9 head-only error if
+    a Mosaic fold ever appeared)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        y_ref[:] = jnp.zeros_like(y_ref)
+        s_ref[:] = jnp.zeros_like(s_ref)
+
+    f32 = jnp.float32
+    bf16 = jnp.bfloat16
+    if not (x_ref.dtype == bf16
+            or jnp.issubdtype(x_ref.dtype, jnp.integer)):
+        # exact-f32 VPU path (parity mode; big-E f32 is gated out by
+        # cov_block_kernel_fits before it can reach here)
+        val, absent = _decode_block(x_ref)
+        filled = (jnp.where(absent, aux_ref[2 * k:2 * k + 1, :], val)
+                  if nan_fill else val)
+        cols = [jnp.sum(filled * (aux_ref[c:c + 1, :]
+                                  + aux_ref[k + c:k + c + 1, :]),
+                        axis=1, keepdims=True) for c in range(k)]
+        tc = jnp.concatenate(cols, axis=1) - muv_ref[:]    # (T, k)
+        rt = rep_ref[:] * tc
+        s_ref[:] += jnp.sum(rt, axis=0, keepdims=True)
+        rows = [jnp.sum(filled * rt[:, c:c + 1], axis=0, keepdims=True)
+                for c in range(k)]
+        y_ref[:] += jnp.concatenate(rows, axis=0)
+        return
+    fill_row = aux_ref[2 * k:2 * k + 1, :] if nan_fill else None
+    filled = _decode_filled_bf16(x_ref, fill_row, nan_fill=nan_fill)
+    t2 = jax.lax.dot_general(filled, aux_ref[0:2 * k, :],
+                             (((1,), (1,)), ((), ())),
+                             precision=jax.lax.Precision.DEFAULT,
+                             preferred_element_type=f32)   # (T, 2k)
+    tc = t2[:, :k] + t2[:, k:] - muv_ref[:]                # (T, k) f32
+    rt = rep_ref[:] * tc
+    s_ref[:] += jnp.sum(rt, axis=0, keepdims=True)
+    h = rt.astype(bf16)
+    low = (rt - h.astype(f32)).astype(bf16)
+    w = jnp.concatenate([h, low], axis=1)                  # (T, 2k) bf16
+    part = jax.lax.dot_general(w, filled, (((0,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.DEFAULT,
+                               preferred_element_type=f32)  # (2k, E)
+    y_ref[:] += part[:k, :] + part[k:, :]
+
+
+def cov_block_kernel_fits(n_events: int, n_components: int,
+                          itemsize: int) -> bool:
+    """Whether :func:`apply_weighted_cov_block` fits scoped VMEM at its
+    tile: double-buffered storage panel + the bf16 decode image + the
+    (k, E) f32 accumulator + the (2k+1, E) compensated aux rows + the
+    per-panel (T, 2k) working operands. f32 storage carries an f32 decode
+    image and f32 aux instead — at north-star width that is what pushes
+    it over, so f32 big-E takes the separable two-sweep form."""
+    k = n_components
+    lanes = -(-n_events // 128) * 128
+    tile = matmat_tile_rows(n_events, itemsize, True)
+    elem = 4 if itemsize == 4 else 2                  # decode/aux width
+    est = (tile * lanes * itemsize * 2                # double-buffered panel
+           + tile * lanes * elem                      # decoded filled image
+           + k * lanes * 4                            # y accumulator
+           + (2 * k + 1) * lanes * elem               # aux rows
+           + 2 * lanes * 4                            # mu/fill working rows
+           + tile * 2 * k * 8)                        # t/rt/w panels
+    return est <= _VMEM_BUDGET
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def apply_weighted_cov_block(x, mu, rep, V, fill=None,
+                             interpret: bool = False):
+    """``(X - 1 mu^T)^T (rep * ((X - 1 mu^T) V))`` for a thin (E, k)
+    block in ONE HBM sweep of the storage matrix — halves the orth-iter
+    sweep traffic versus the separable storage_matmat +
+    storage_rows_matmat pair (single-device only: the event-sharded path
+    needs a psum between the two contractions, exactly like the
+    single-vector :func:`apply_weighted_cov`'s note). Returns (E, k) f32;
+    caller divides by the unbiased-weight denominator. Callers must
+    check :func:`cov_block_kernel_fits` first."""
+    R, E = x.shape
+    k = V.shape[1]
+    nan_fill = fill is not None
+    tile_r = matmat_tile_rows(E, x.dtype.itemsize, nan_fill)
+    x, rep = _pad_rows(x, rep.astype(jnp.float32), tile_r)
+    Rp = x.shape[0]
+    f32 = jnp.float32
+    aux = _matrix_aux(V, fill if nan_fill else None, _is_compact(x))
+    muv = (mu.astype(f32) @ V.astype(f32)).reshape(1, k)
+    y, s = pl.pallas_call(
+        functools.partial(_cov_block_kernel, nan_fill=nan_fill, k=k),
+        grid=(Rp // tile_r,),
+        in_specs=[
+            pl.BlockSpec((tile_r, E), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((aux.shape[0], E), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, E), f32),
+            jax.ShapeDtypeStruct((1, k), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * k * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, aux, muv, rep.reshape(-1, 1))
+    y = y - s.reshape(k, 1) * mu.astype(f32)[None, :]  # - mu (x) sum(rep*t)
+    return y.T
 
 
 def matmat_kernels_fit(n_events: int, n_components: int,

@@ -59,8 +59,12 @@ _MULTI_COMPONENT_ALGOS = ("fixed-variance", "ica")
 #: and post-hoist R=10000 measures +4% at E=16384, tie at 32768, -5% at
 #: 65536, ~-10% at 100000 (genuine: the k-row accumulators shrink the
 #: row panels and per-panel overhead swamps the byte savings at extreme
-#: width). 65536 keeps fused within noise of XLA everywhere it is
-#: allowed and routes the one genuine loss to the XLA path.
+#: width). Late round 4 the ONE-PASS block covariance kernel
+#: (pallas_kernels.apply_weighted_cov_block — both contractions off a
+#: single HBM read per sweep) made fused win at EVERY measured width,
+#: so this ceiling now bounds only the separable two-sweep FALLBACK
+#: (taken when cov_block_kernel_fits says the one-pass kernel's VMEM
+#: footprint doesn't fit — e.g. f32 storage at 100k).
 _MULTI_FUSED_MAX_E = 65536
 
 
@@ -215,7 +219,8 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     is handled inside resolve_certainty_fused by zero-rep row padding, so
     it does not disqualify the fast path — the VMEM fit is checked at the
     padded count."""
-    from ..ops.pallas_kernels import (fused_pca_fits, matmat_kernels_fit,
+    from ..ops.pallas_kernels import (cov_block_kernel_fits, fused_pca_fits,
+                                      matmat_kernels_fit,
                                       resolve_kernel_fits)
 
     # actual matrix itemsize: the storage dtype if set, else the default
@@ -247,15 +252,25 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
     else:
         algo_ok = params.algorithm in ("sztorc",) + _MULTI_COMPONENT_ALGOS
         if params.algorithm in _MULTI_COMPONENT_ALGOS:
-            # the k-row accumulators of the matmat sweeps need their own
-            # VMEM fit (k+1 rows: components + the csum row) — and a
-            # measured WIDTH ceiling (rationale + the corrected
-            # attribution at _MULTI_FUSED_MAX_E: the apparent large-E
-            # losses were a per-sweep repad, hoisted 2026-08-01; only
-            # the north-star width remains a genuine XLA win).
+            # one-pass block covariance kernel (apply_weighted_cov_block,
+            # late round 4): where it fits, the fused path wins at EVERY
+            # measured width — including the north-star 100k that the
+            # separable two-sweep form lost (ica 11.2 vs XLA 9.9 res/s;
+            # 16384: 57 vs 38) — so no width ceiling applies on that
+            # arm. The separable SWEEP fallback keeps the measured
+            # _MULTI_FUSED_MAX_E ceiling (its per-panel overhead swamps
+            # the byte savings at extreme width). The k+1-row
+            # matmat_kernels_fit is required on BOTH arms: the scores
+            # sweep (storage_matmat) and the batched dirfix
+            # (storage_rows_matmat, k+1 row stack) run unconditionally
+            # on this path regardless of which covariance form the
+            # orth-iter picked. k upper-bounds both algorithms' shared
+            # sizing rules; the fit models shrink monotonically in k,
+            # so the bound is conservative.
             k = min(params.max_components, n_reporters)
             multi_fit = (matmat_kernels_fit(e_local, k + 1, itemsize)
-                         and e_local <= _MULTI_FUSED_MAX_E)
+                         and (cov_block_kernel_fits(e_local, k, itemsize)
+                              or e_local <= _MULTI_FUSED_MAX_E))
         else:
             multi_fit = True
     # the same next-multiple-of-8 the kernel pads to (a no-op for

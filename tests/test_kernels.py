@@ -548,6 +548,41 @@ class TestPallasFused:
         out = np.asarray(apply_weighted_cov(X, mu, rep, v, interpret=True))
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
+    def test_apply_weighted_cov_block_matches_reference(self, rng):
+        """The one-pass BLOCK covariance kernel equals the dense centered
+        application across all three storage encodings, including the
+        NaN/sentinel-threaded forms — the k-column sibling of the test
+        above (same algebra as the separable storage_matmat +
+        storage_rows_matmat pair it replaces on the single-device
+        orth-iter path)."""
+        from pyconsensus_tpu.ops.pallas_kernels import (
+            apply_weighted_cov_block, cov_block_kernel_fits)
+        R, E, k = 13, 9, 3      # deliberately not panel multiples
+        assert cov_block_kernel_fits(E, k, 1)
+        reports = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+        na = rng.random((R, E)) < 0.15
+        rep = nk.normalize(rng.random(R) + 0.1)
+        fill_np = rng.random(E)
+        filled = np.where(na, fill_np[None, :], reports)
+        mu = filled.T @ rep
+        V = rng.standard_normal((E, k))
+        dev = filled - mu[None, :]
+        ref = dev.T @ (rep[:, None] * (dev @ V))
+        for enc, x in (
+                ("int8", jnp.asarray(np.where(na, -1, np.round(reports * 2)),
+                                     jnp.int8)),
+                ("bf16", jnp.asarray(np.where(na, np.nan, reports),
+                                     jnp.bfloat16)),
+                ("f32", jnp.asarray(np.where(na, np.nan, reports),
+                                    jnp.float32))):
+            out = np.asarray(apply_weighted_cov_block(
+                x, jnp.asarray(mu), jnp.asarray(rep), jnp.asarray(V),
+                fill=jnp.asarray(fill_np), interpret=True))
+            tol = 1e-5 if enc == "f32" else 5e-3
+            np.testing.assert_allclose(out, ref, rtol=0,
+                                       atol=tol * np.max(np.abs(ref)),
+                                       err_msg=enc)
+
     def test_power_fused_loading_matches_eigh(self, rng):
         X = rng.random((12, 8))
         rep = nk.normalize(rng.random(12) + 0.1)

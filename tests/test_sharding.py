@@ -306,10 +306,13 @@ class TestFusedResolution:
                                    atol=5e-6)
 
     def test_multi_component_gate(self, monkeypatch):
-        """The single-device fused gate admits ica/fixed-variance up to
-        the measured event-width ceiling (with the matmat-kernel VMEM
-        fit); beyond it the XLA path wins (round-4 A/B) and the gate
-        closes; the mesh gate stays sztorc-only."""
+        """The single-device fused gate admits ica/fixed-variance wherever
+        the ONE-PASS block covariance kernel fits (no width ceiling —
+        with that kernel the fused path beat XLA at every measured width
+        including the north-star 100k); the separable two-sweep fallback
+        keeps the measured _MULTI_FUSED_MAX_E ceiling, so f32 storage at
+        100k (one-pass does not fit f32's wider decode/aux) stays on the
+        XLA path. The mesh gate stays sztorc-only."""
         import pyconsensus_tpu.parallel.sharded as sh
         monkeypatch.setattr(sh.jax, "default_backend", lambda: "tpu")
         for algo in ("ica", "fixed-variance"):
@@ -317,20 +320,32 @@ class TestFusedResolution:
                                 pca_method="power",
                                 storage_dtype="bfloat16")
             assert sh._use_fused_resolution(p, 10_000, 32_768, 1), algo
-            # north-star width: measured slower than XLA — gate closed
-            assert not sh._use_fused_resolution(p, 10_000, 100_000, 1), algo
+            # north-star width: open since the one-pass block kernel
+            assert sh._use_fused_resolution(p, 10_000, 100_000, 1), algo
+            # f32 storage at 100k: one-pass unfit, separable over the
+            # ceiling -> XLA path
+            assert not sh._use_fused_resolution(
+                p._replace(storage_dtype="float32"), 10_000, 100_000,
+                1), algo
+            # mid-band width where the one-pass covariance kernel fits
+            # but the scores/dirfix sweeps' (k+1)-row matmat does NOT
+            # (code-review r4 find): those sweeps run unconditionally on
+            # the fused path, so the gate must stay closed
+            from pyconsensus_tpu.ops.pallas_kernels import (
+                cov_block_kernel_fits, matmat_kernels_fit)
+            E_mid = 140_000
+            assert cov_block_kernel_fits(E_mid, 5, 2)
+            assert not matmat_kernels_fit(E_mid, 6, 2)
+            assert not sh._use_fused_resolution(p, 10_000, E_mid, 1), algo
             assert not sh._use_fused_resolution(p, 10_000, 32_768, 8), algo
             # auto-storage picks int8 for the all-binary single-device
-            # case within the width ceiling, bfloat16 (XLA) beyond it
+            # case at every fused-served width, including 100k now
             mesh1 = make_mesh(batch=1, event=1)
-            storage, why = sh.resolve_auto_storage(
-                ConsensusParams(algorithm=algo, any_scaled=False,
-                                has_na=True), 10_000, 32_768, mesh1)
-            assert storage == "int8", why
-            storage, why = sh.resolve_auto_storage(
-                ConsensusParams(algorithm=algo, any_scaled=False,
-                                has_na=True), 10_000, 100_000, mesh1)
-            assert storage == "bfloat16", why
+            for E in (32_768, 100_000):
+                storage, why = sh.resolve_auto_storage(
+                    ConsensusParams(algorithm=algo, any_scaled=False,
+                                    has_na=True), 10_000, E, mesh1)
+                assert storage == "int8", (E, why)
 
     def test_gate_scaled_fraction(self, monkeypatch):
         """On TPU the gate admits a small static scaled fraction and rejects
