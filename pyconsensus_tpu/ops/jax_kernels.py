@@ -471,11 +471,15 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     thin-QR re-orthonormalization, so it scales to the north-star shape
     where the Gram eigh OOMs (see :data:`_GRAM_EIGH_MAX_R`).
 
-    Returns ``(loadings (E, k), eigvals (k,), trace)`` — eigenvalues are
-    Ritz values of the converged block (sorted descending) and
-    ``trace`` is the matrix-free total variance
-    ``(rep·X² - mu²)·1 / denom``, so explained-variance fractions cost no
-    extra (R, E) pass beyond the one ``rep @ X²`` contraction.
+    Returns ``(loadings (E, k), eigvals (k,), trace, scores-or-None)`` —
+    eigenvalues are Ritz values of the converged block (sorted
+    descending), ``trace`` is the matrix-free total variance
+    ``(rep·X² - mu²)·1 / denom`` (so explained-variance fractions cost
+    no extra (R, E) pass beyond the one ``rep @ X²`` contraction), and
+    ``scores`` is the centered (R, k) score block FOLDED out of the
+    final Rayleigh-Ritz application on the one-pass-kernel storage path
+    (None on the XLA and separable-fallback paths, whose callers compute
+    scores with their own sweep).
 
     Convergence (re-tuned round 3; each saved sweep is two HBM passes of
     the matrix): a column counts as settled when successive orthonormal
@@ -553,11 +557,20 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
             # one-pass block kernel: both contractions off a single HBM
             # read per sweep (apply_weighted_cov_block) — the separable
             # pair below reads the matrix twice per sweep
-            def apply_cov_block(V):              # (E, k) -> (E, k)
-                return apply_weighted_cov_block(
+            def apply_cov_block_t(V):    # (E, k) -> ((E, k), (R, k))
+                y, t = apply_weighted_cov_block(
                     reports_filled, mu, rep, V.astype(acc), fill=fill,
-                    interpret=interpret).astype(acc) / denom
+                    interpret=interpret, emit_t=True)
+                return y.astype(acc) / denom, t.astype(acc)
+
+            def apply_cov_block(V):              # (E, k) -> (E, k)
+                y, _ = apply_weighted_cov_block(
+                    reports_filled, mu, rep, V.astype(acc), fill=fill,
+                    interpret=interpret)
+                return y.astype(acc) / denom
         else:
+            apply_cov_block_t = None
+
             def apply_cov_block(V):              # (E, k) -> (E, k)
                 t = (storage_matmat(reports_filled, V.astype(acc), fill=fill,
                                     interpret=interpret).astype(acc)
@@ -569,6 +582,8 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
                      - mu[:, None] * jnp.sum(rt, axis=0)[None, :])  # (E, k)
                 return y / denom
     else:
+        apply_cov_block_t = None
+
         def apply_cov_block(V):                  # (E, k) -> (E, k)
             t = (jnp.matmul(reports_filled, V.astype(reports_filled.dtype),
                             preferred_element_type=acc)
@@ -635,7 +650,13 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     # eigenbasis of the projected covariance — optimal approximations
     # within span(V), and the step that makes the Ritz-stability exit
     # accurate (see docstring)
-    Y = apply_cov_block(V)
+    if apply_cov_block_t is not None:
+        # the final application's per-row projections rotate into the
+        # component scores below — the caller's separate scores sweep
+        # (a whole extra HBM read) is then unnecessary
+        Y, t_c = apply_cov_block_t(V)
+    else:
+        Y, t_c = apply_cov_block(V), None
     M = V.T @ Y
     M = 0.5 * (M + M.T)                          # symmetrize roundoff
     ritz, W = jnp.linalg.eigh(M)                 # ascending
@@ -651,6 +672,13 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
     eig = jnp.where(ok, jnp.clip(ritz[::-1], 0.0, None),
                     jnp.clip(raw[order], 0.0, None))
     V = jnp.where(ok, (V @ W)[:, ::-1], V[:, order])
+    if t_c is not None:
+        # scores of the ROTATED block, by linearity: (X - 1 mu^T)(V W)
+        # = t_c W (same fallback ordering as V); sliced back to the
+        # caller's row count (this function may have padded internally)
+        scores = jnp.where(ok, (t_c @ W)[:, ::-1], t_c[:, order])[:R]
+    else:
+        scores = None
     # matrix-free trace: sum_j rep.x²_j - mu_j²  (Σrep = 1 after
     # normalize). Written as a fused elementwise+column-reduce so XLA
     # never materializes an (R, E) squared temp the way a matmul operand
@@ -660,7 +688,7 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
             else reports_filled.astype(acc))
     col_sq = jnp.sum(vals ** 2 * rep[:, None], axis=0)
     trace = jnp.sum(col_sq - mu * mu) / denom
-    return V, eig, jnp.clip(trace, 0.0, None)
+    return V, eig, jnp.clip(trace, 0.0, None), scores
 
 
 def weighted_prin_comps(reports_filled, reputation, n_components: int,
@@ -680,7 +708,7 @@ def weighted_prin_comps(reports_filled, reputation, n_components: int,
     if method in ("power", "power-fused") or (
             method == "auto" and E > 1024 and R > _GRAM_EIGH_MAX_R):
         mu, denom = _mu_denom(reports_filled, reputation)
-        loadings, eig, total = _top_pcs_orth_iter(
+        loadings, eig, total, _ = _top_pcs_orth_iter(
             reports_filled, mu, denom, reputation, n_components,
             v_init=v_init)
         explained = jnp.where(total > 0.0,
@@ -725,11 +753,12 @@ def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
                                 n_rows: Optional[int] = None, v_init=None):
     """Top-k components + explained fractions straight off sentinel
     storage (the fused pipeline's compact encoding): orthogonal iteration
-    with both block sweeps through the Pallas storage kernels, then one
-    more ``storage_matmat`` sweep for the scores. The storage sibling of
-    :func:`weighted_prin_comps`'s orth-iter branch — same convergence
-    rules, same Rayleigh-Ritz rotation (parity pinned by
-    tests/test_kernels.py at the shared tolerance).
+    through the Pallas storage kernels, with the scores folded out of
+    the final Rayleigh-Ritz application on the one-pass-kernel path (one
+    further ``storage_matmat`` sweep only on the separable fallback).
+    The storage sibling of :func:`weighted_prin_comps`'s orth-iter
+    branch — same convergence rules, same Rayleigh-Ritz rotation (parity
+    pinned by tests/test_kernels.py at the shared tolerance).
 
     ``n_rows``: pre-padded-input contract, exactly as
     :func:`sztorc_scores_power_fused`'s — ``x``/``reputation`` arrive
@@ -743,15 +772,19 @@ def weighted_prin_comps_storage(x, fill, mu, reputation, n_components: int,
     R, E = x.shape
     denom = 1.0 - jnp.sum(reputation ** 2)
     denom = jnp.where(denom == 0.0, 1.0, denom)
-    loadings, eig, total = _top_pcs_orth_iter(
+    loadings, eig, total, scores = _top_pcs_orth_iter(
         x, mu, denom, reputation, n_components, fill=fill,
         interpret=interpret, v_init=v_init)
     explained = jnp.where(total > 0.0,
                           eig / jnp.where(total > 0.0, total, 1.0),
                           jnp.zeros_like(eig))
-    scores = (storage_matmat(x, loadings.astype(acc), fill=fill,
-                             interpret=interpret).astype(acc)
-              - jnp.ones((R, 1), acc) * (mu @ loadings)[None, :])
+    if scores is None:
+        # separable-covariance fallback: one further storage sweep for
+        # the scores (the one-pass kernel folds them into its final
+        # Rayleigh-Ritz application instead)
+        scores = (storage_matmat(x, loadings.astype(acc), fill=fill,
+                                 interpret=interpret).astype(acc)
+                  - jnp.ones((R, 1), acc) * (mu @ loadings)[None, :])
     if n_rows is not None:
         scores = scores[:n_rows]
     return loadings, scores, explained

@@ -513,8 +513,8 @@ def storage_matmat(x, V, fill=None, interpret: bool = False):
     return t[:R]
 
 
-def _cov_block_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
-                      nan_fill, k):
+def _cov_block_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref,
+                      *t_refs, nan_fill, k, emit_t):
     """One row panel of the BLOCK covariance application — both
     contractions of ``(X - 1 mu^T)^T (rep * ((X - 1 mu^T) V))`` off a
     single HBM read of the panel, the k-column sibling of
@@ -528,11 +528,17 @@ def _cov_block_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
     SAME resident panel with an in-kernel compensated split of
     ``rep * t`` — the caller finishes ``- mu (x) sum(rep * t)`` exactly
     like the separable caller did. ``s_ref`` accumulates that (1, k)
-    column-sum. The in-kernel split is plain arithmetic Mosaic compiles
-    as written (the XLA-simplifier annihilation that motivated
-    ``_compensated_split``'s barrier is an HLO-pass hazard; the
-    orth-iter-vs-eigh parity test would see the 2^-9 head-only error if
-    a Mosaic fold ever appeared)."""
+    column-sum. Under ``emit_t`` a third output ref stores the centered
+    per-row projections — requested ONLY for the final Rayleigh-Ritz
+    application, where the caller rotates them into the component
+    scores, eliminating the whole separate scores sweep (the loop's
+    sweeps skip the output entirely: a Pallas output cannot be
+    dead-code-eliminated by XLA, so an always-on t would pay an
+    (Rp, k) HBM write per sweep for nothing). The in-kernel split is
+    plain arithmetic Mosaic compiles as written (the XLA-simplifier
+    annihilation that motivated ``_compensated_split``'s barrier is an
+    HLO-pass hazard; the orth-iter-vs-eigh parity test would see the
+    2^-9 head-only error if a Mosaic fold ever appeared)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -553,6 +559,8 @@ def _cov_block_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
                                   + aux_ref[k + c:k + c + 1, :]),
                         axis=1, keepdims=True) for c in range(k)]
         tc = jnp.concatenate(cols, axis=1) - muv_ref[:]    # (T, k)
+        if emit_t:
+            t_refs[0][:] = tc
         rt = rep_ref[:] * tc
         s_ref[:] += jnp.sum(rt, axis=0, keepdims=True)
         rows = [jnp.sum(filled * rt[:, c:c + 1], axis=0, keepdims=True)
@@ -566,6 +574,8 @@ def _cov_block_kernel(x_ref, aux_ref, muv_ref, rep_ref, y_ref, s_ref, *,
                              precision=jax.lax.Precision.DEFAULT,
                              preferred_element_type=f32)   # (T, 2k)
     tc = t2[:, :k] + t2[:, k:] - muv_ref[:]                # (T, k) f32
+    if emit_t:
+        t_refs[0][:] = tc
     rt = rep_ref[:] * tc
     s_ref[:] += jnp.sum(rt, axis=0, keepdims=True)
     h = rt.astype(bf16)
@@ -582,9 +592,11 @@ def cov_block_kernel_fits(n_events: int, n_components: int,
     """Whether :func:`apply_weighted_cov_block` fits scoped VMEM at its
     tile: double-buffered storage panel + the bf16 decode image + the
     (k, E) f32 accumulator + the (2k+1, E) compensated aux rows + the
-    per-panel (T, 2k) working operands. f32 storage carries an f32 decode
-    image and f32 aux instead — at north-star width that is what pushes
-    it over, so f32 big-E takes the separable two-sweep form."""
+    per-panel (T, 2k) working operands + the emit_t (T, k) output window
+    (modeled double-buffered, and at its worst case: the final
+    Rayleigh-Ritz application requests it). f32 storage carries an f32
+    decode image and f32 aux instead — at north-star width that is what
+    pushes it over, so f32 big-E takes the separable two-sweep form."""
     k = n_components
     lanes = -(-n_events // 128) * 128
     tile = matmat_tile_rows(n_events, itemsize, True)
@@ -594,21 +606,29 @@ def cov_block_kernel_fits(n_events: int, n_components: int,
            + k * lanes * 4                            # y accumulator
            + (2 * k + 1) * lanes * elem               # aux rows
            + 2 * lanes * 4                            # mu/fill working rows
-           + tile * 2 * k * 8)                        # t/rt/w panels
+           + tile * 2 * k * 8                         # t/rt/w panels
+           + tile * k * 4 * 2)                        # emit_t output window
     return est <= _VMEM_BUDGET
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "emit_t"))
 def apply_weighted_cov_block(x, mu, rep, V, fill=None,
-                             interpret: bool = False):
+                             interpret: bool = False, emit_t: bool = False):
     """``(X - 1 mu^T)^T (rep * ((X - 1 mu^T) V))`` for a thin (E, k)
     block in ONE HBM sweep of the storage matrix — halves the orth-iter
     sweep traffic versus the separable storage_matmat +
     storage_rows_matmat pair (single-device only: the event-sharded path
     needs a psum between the two contractions, exactly like the
-    single-vector :func:`apply_weighted_cov`'s note). Returns (E, k) f32;
-    caller divides by the unbiased-weight denominator. Callers must
-    check :func:`cov_block_kernel_fits` first."""
+    single-vector :func:`apply_weighted_cov`'s note). Returns
+    ``(y (E, k), t)`` f32 — the covariance application (caller divides
+    by the unbiased-weight denominator) and, under ``emit_t``, the
+    CENTERED per-row projections ``(X - 1 mu^T) V`` of the same call,
+    sliced back to the input row count (``t`` is None otherwise — the
+    orth-iter loop's sweeps must not pay the per-sweep (Rp, k) HBM
+    write, which XLA cannot dead-code-eliminate from a pallas_call; the
+    final Rayleigh-Ritz application requests it and rotates it into the
+    component scores, eliminating the separate scores sweep). Callers
+    must check :func:`cov_block_kernel_fits` first."""
     R, E = x.shape
     k = V.shape[1]
     nan_fill = fill is not None
@@ -618,8 +638,21 @@ def apply_weighted_cov_block(x, mu, rep, V, fill=None,
     f32 = jnp.float32
     aux = _matrix_aux(V, fill if nan_fill else None, _is_compact(x))
     muv = (mu.astype(f32) @ V.astype(f32)).reshape(1, k)
-    y, s = pl.pallas_call(
-        functools.partial(_cov_block_kernel, nan_fill=nan_fill, k=k),
+    out_specs = [
+        pl.BlockSpec((k, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((k, E), f32),
+        jax.ShapeDtypeStruct((1, k), f32),
+    ]
+    if emit_t:
+        out_specs.append(pl.BlockSpec((tile_r, k), lambda i: (i, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((Rp, k), f32))
+    out = pl.pallas_call(
+        functools.partial(_cov_block_kernel, nan_fill=nan_fill, k=k,
+                          emit_t=emit_t),
         grid=(Rp // tile_r,),
         in_specs=[
             pl.BlockSpec((tile_r, E), lambda i: (i, 0),
@@ -630,21 +663,18 @@ def apply_weighted_cov_block(x, mu, rep, V, fill=None,
             pl.BlockSpec((tile_r, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((k, E), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((k, E), f32),
-            jax.ShapeDtypeStruct((1, k), f32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         cost_estimate=pl.CostEstimate(
-            flops=4 * k * Rp * E, bytes_accessed=Rp * E * x.dtype.itemsize,
+            flops=4 * k * Rp * E,
+            bytes_accessed=(Rp * E * x.dtype.itemsize
+                            + (Rp * k * 4 if emit_t else 0)),
             transcendentals=0),
         interpret=interpret,
     )(x, aux, muv, rep.reshape(-1, 1))
+    y, s = out[0], out[1]
     y = y - s.reshape(k, 1) * mu.astype(f32)[None, :]  # - mu (x) sum(rep*t)
-    return y.T
+    return y.T, (out[2][:R] if emit_t else None)
 
 
 def matmat_kernels_fit(n_events: int, n_components: int,

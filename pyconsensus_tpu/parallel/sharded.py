@@ -260,13 +260,14 @@ def _use_fused_resolution(params: ConsensusParams, n_reporters: int,
             # arm. The separable SWEEP fallback keeps the measured
             # _MULTI_FUSED_MAX_E ceiling (its per-panel overhead swamps
             # the byte savings at extreme width). The k+1-row
-            # matmat_kernels_fit is required on BOTH arms: the scores
-            # sweep (storage_matmat) and the batched dirfix
-            # (storage_rows_matmat, k+1 row stack) run unconditionally
-            # on this path regardless of which covariance form the
-            # orth-iter picked. k upper-bounds both algorithms' shared
-            # sizing rules; the fit models shrink monotonically in k,
-            # so the bound is conservative.
+            # matmat_kernels_fit is required on BOTH arms: the batched
+            # dirfix (storage_rows_matmat, k+1 row stack) runs
+            # unconditionally on this path, and the separable arm's
+            # scores sweep (storage_matmat) shares the same model (the
+            # one-pass arm folds scores into its final application
+            # instead). k upper-bounds both algorithms' shared sizing
+            # rules; the fit models shrink monotonically in k, so the
+            # bound is conservative.
             k = min(params.max_components, n_reporters)
             multi_fit = (matmat_kernels_fit(e_local, k + 1, itemsize)
                          and (cov_block_kernel_fits(e_local, k, itemsize)

@@ -568,6 +568,7 @@ class TestPallasFused:
         V = rng.standard_normal((E, k))
         dev = filled - mu[None, :]
         ref = dev.T @ (rep[:, None] * (dev @ V))
+        t_ref = dev @ V
         for enc, x in (
                 ("int8", jnp.asarray(np.where(na, -1, np.round(reports * 2)),
                                      jnp.int8)),
@@ -575,13 +576,21 @@ class TestPallasFused:
                                      jnp.bfloat16)),
                 ("f32", jnp.asarray(np.where(na, np.nan, reports),
                                     jnp.float32))):
-            out = np.asarray(apply_weighted_cov_block(
+            out, none_t = apply_weighted_cov_block(
                 x, jnp.asarray(mu), jnp.asarray(rep), jnp.asarray(V),
-                fill=jnp.asarray(fill_np), interpret=True))
+                fill=jnp.asarray(fill_np), interpret=True)
+            assert none_t is None          # emit_t off: no t output paid
+            out, t = apply_weighted_cov_block(
+                x, jnp.asarray(mu), jnp.asarray(rep), jnp.asarray(V),
+                fill=jnp.asarray(fill_np), interpret=True, emit_t=True)
             tol = 1e-5 if enc == "f32" else 5e-3
-            np.testing.assert_allclose(out, ref, rtol=0,
+            np.testing.assert_allclose(np.asarray(out), ref, rtol=0,
                                        atol=tol * np.max(np.abs(ref)),
                                        err_msg=enc)
+            # the folded per-row projections equal (X - 1 mu^T) V
+            np.testing.assert_allclose(np.asarray(t), t_ref, rtol=0,
+                                       atol=tol * np.max(np.abs(t_ref)),
+                                       err_msg=enc + " t")
 
     def test_power_fused_loading_matches_eigh(self, rng):
         X = rng.random((12, 8))
