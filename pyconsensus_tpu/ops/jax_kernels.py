@@ -604,8 +604,11 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
         blended = (v_init.astype(acc) / jnp.where(ni > 0.0, ni, 1.0)
                    * jnp.sqrt(jnp.asarray(float(k), acc)) + 0.25 * V0)
         Qw, _ = jnp.linalg.qr(blended)
-        Qw = jnp.where(jnp.isfinite(Qw), Qw, V0)
-        V0 = jnp.where(ni > 0.0, Qw, V0)
+        # whole-block fallback: an elementwise V0 substitution into a
+        # partially non-finite QR result would leave a non-orthonormal
+        # block (rank loss poisons columns, not entries), and the first
+        # sweep's alignment/Ritz exit statistics would run on it
+        V0 = jnp.where(jnp.isfinite(Qw).all() & (ni > 0.0), Qw, V0)
 
     tol = max(float(tol), 8.0 * float(jnp.finfo(acc).eps))
 

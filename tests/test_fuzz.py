@@ -215,6 +215,43 @@ def test_dbscan_eps_boundary_backend_parity():
         atol=5e-6)
 
 
+def test_hierarchical_threshold_boundary_backend_parity():
+    """Round-5 (VERDICT r4 item 7): the linkage-cut analogue of the DBSCAN
+    boundary case above. The {0, 0.5, 1} lattice realizes merge heights
+    exactly on round thresholds (one half-step disagreement -> first merge
+    at height 0.5), and the two backends reach the cut through different
+    arithmetic (device f32 Gram expansion vs host f64 direct distances), so
+    an exact ``<= t`` comparison could resolve the boundary merge on
+    opposite sides and diverge whole-cluster. Pinned by the shared
+    ``clustering._linkage_threshold`` band; the engineered matrix reuses
+    the DBSCAN case's non-dyadic shared-NA fill so the device and host
+    distances genuinely differ at the last ulp."""
+    from pyconsensus_tpu.models import clustering as cl
+
+    reports = np.array([[0.0, 1.0, np.nan, 1.0],
+                        [0.5, 1.0, np.nan, 1.0],
+                        [0.0, 1.0, 1.0, 1.0],
+                        [0.0, 0.0, 0.0, 0.0],
+                        [1.0, 1.0, 1.0, 0.5]])
+    rep = np.array([0.3, 0.1, 0.35, 0.15, 0.1])
+    # the pair (0, 1) sits at exact height 0.5; the cut is exactly there
+    got = {}
+    for backend in ("numpy", "jax"):
+        got[backend] = Oracle(reports=reports, reputation=rep,
+                              algorithm="hierarchical",
+                              hierarchy_threshold=0.5,
+                              backend=backend).consensus()
+    np.testing.assert_allclose(
+        np.asarray(got["jax"]["agents"]["smooth_rep"], dtype=float),
+        np.asarray(got["numpy"]["agents"]["smooth_rep"], dtype=float),
+        atol=5e-6)
+    # the band must actually admit the boundary merge: rows 0 and 1 share
+    # one cluster (conformity mass 0.4), whichever backend computed d
+    X = np.where(np.isnan(reports), 0.0, reports)
+    conf = cl.hierarchical_conformity(X, rep, 0.5)
+    assert conf[0] == conf[1] and conf[0] >= 0.4 - 1e-12
+
+
 from pyconsensus_tpu.models.pipeline import JIT_ALGORITHMS  # noqa: E402
 
 #: k-means excluded: its deterministic evenly-spaced-ROW centroid seeding
