@@ -20,9 +20,11 @@ Public surface:
 """
 
 from .ledger import ReputationLedger
+from .models.pipeline import decode_reports, encode_reports
 from .oracle import ALGORITHMS, BACKENDS, Oracle
 from .sweep import compare_algorithms, disagreement_matrix
 
 __version__ = "0.1.0"
 __all__ = ["Oracle", "ReputationLedger", "ALGORITHMS", "BACKENDS",
-           "compare_algorithms", "disagreement_matrix", "__version__"]
+           "compare_algorithms", "disagreement_matrix",
+           "encode_reports", "decode_reports", "__version__"]

@@ -202,6 +202,18 @@ class Oracle:
                  verbose: bool = False):
         if reports is None:
             raise ValueError("reports matrix is required")
+        if np.asarray(reports).dtype == np.int8:
+            from .models.pipeline import decode_reports, looks_encoded
+
+            if looks_encoded(reports):
+                # pre-encoded sentinel storage (encode_reports:
+                # round(2*value), -1 = NaN) — decode to the float form so
+                # every backend/algorithm below behaves identically; the
+                # bandwidth-sensitive encoded fast path is
+                # sharded_consensus. Raw {0, 1} int8 vote matrices (no -1,
+                # no 2) keep their pre-round-5 meaning via the plain
+                # float cast below (looks_encoded's ambiguity note).
+                reports = decode_reports(np.asarray(reports))
         self.reports = np.asarray(reports, dtype=np.float64)
         if self.reports.ndim != 2:
             raise ValueError(f"reports must be 2-D (reporters × events), "

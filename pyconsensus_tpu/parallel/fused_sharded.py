@@ -156,7 +156,8 @@ def _local_consensus(x_blk, rep, seed, base_unit, bounds,
         x_blk = jk.rescale(x_blk, sc, mn, mx)      # NaN stays NaN
     x, fill, tw0, numer0 = _fill_stats(x_blk, old_rep, p.catch_tolerance,
                                        p.storage_dtype,
-                                       sc if p.any_scaled else None)
+                                       sc if p.any_scaled else None,
+                                       interpret=interpret)
     full0 = jnp.sum(old_rep)
     mu1 = numer0 + (full0 - tw0) * fill            # (E_loc,) local
     # matvec_dtype: like sztorc_scores_power_fused, the power sweeps and

@@ -456,8 +456,13 @@ def _maybe_place(arr, shard, dtype):
     return jax.device_put(jax.numpy.asarray(arr, dtype=dtype), shard)
 
 
-# back-compat alias used by callers/tests
+# back-compat alias used by callers/tests; pre-encoded int8 sentinel
+# storage (models.pipeline.encode_reports) keeps its dtype — casting it
+# to the compute dtype would both destroy the 4x bandwidth win and turn
+# the -1 NaN sentinel into a live value
 def _maybe_place_reports(reports, x_shard, dtype):
+    if getattr(reports, "dtype", None) == jax.numpy.int8:
+        dtype = jax.numpy.dtype("int8")
     return _maybe_place(reports, x_shard, dtype)
 
 
@@ -470,7 +475,7 @@ def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
     dtype = jnp.asarray(0.0).dtype
     x_shard, e_shard = _input_shardings(mesh, reports.shape[1])
     r_shard = replicated(mesh)
-    return (_maybe_place(reports, x_shard, dtype),
+    return (_maybe_place_reports(reports, x_shard, dtype),
             _maybe_place(reputation, r_shard, dtype),
             _maybe_place(scaled, e_shard, jnp.dtype(bool)),
             _maybe_place(mins, e_shard, dtype),
@@ -512,13 +517,22 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         scaled, mins, maxs = parse_event_bounds(event_bounds, E)
         any_scaled = bool(scaled.any())
         p = p._replace(n_scaled=int(scaled.sum()))
-    p = p._replace(
-        any_scaled=any_scaled,
-        # device-resident input: can't cheaply inspect for NaN on host — keep
-        # the fill pass unless the caller's params already opted out
-        has_na=bool(np.isnan(reports).any()) if is_host else p.has_na,
-    )
+    if is_host and reports.dtype == np.int8:
+        has_na = bool((reports < 0).any())       # sentinel form: -1 is NaN
+    elif is_host:
+        has_na = bool(np.isnan(reports).any())
+    else:
+        # device-resident input: can't cheaply inspect for NaN on host —
+        # keep the fill pass unless the caller's params already opted out
+        has_na = p.has_na
+    p = p._replace(any_scaled=any_scaled, has_na=has_na)
     p = _resolve_sharded_params(p, R, E, mesh)
+    if getattr(reports, "dtype", None) == np.int8 and \
+            p.storage_dtype != "int8":
+        raise ValueError(
+            "pre-encoded int8 sentinel reports require "
+            "storage_dtype='int8' (models.pipeline.encode_reports "
+            f"convention); resolved storage_dtype={p.storage_dtype!r}")
     if p.algorithm in HYBRID_ALGORITHMS:
         # hybrid host-clustering path: the device phases run JITTED on
         # the placed (event-sharded) arrays — GSPMD turns the O(R²E)

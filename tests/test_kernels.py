@@ -592,6 +592,28 @@ class TestPallasFused:
                                        atol=tol * np.max(np.abs(t_ref)),
                                        err_msg=enc + " t")
 
+    def test_fill_stats_pass_matches_xla(self, rng):
+        """The round-5 fill-stats kernel (opt-in via
+        PYCONSENSUS_FILL_STATS_KERNEL=1 after losing its on-chip A/Bs —
+        docs/PERFORMANCE.md r5) must agree with the production XLA
+        reduction so it stays re-testable on future hardware."""
+        from pyconsensus_tpu.ops.pallas_kernels import (
+            fill_stats_kernel_fits, fill_stats_pass)
+        R, E = 13, 9            # deliberately not panel multiples
+        assert fill_stats_kernel_fits(E, 1)
+        reports = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+        na = rng.random((R, E)) < 0.2
+        rep = nk.normalize(rng.random(R) + 0.1)
+        x = jnp.asarray(np.where(na, -1, np.round(reports * 2)), jnp.int8)
+        tw, numer = fill_stats_pass(x, jnp.asarray(rep, jnp.float32),
+                                    interpret=True)
+        w = np.where(na, 0.0, rep[:, None])
+        np.testing.assert_allclose(np.asarray(tw), w.sum(axis=0),
+                                   rtol=0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(numer),
+                                   (np.where(na, 0.0, reports) * w
+                                    ).sum(axis=0), rtol=0, atol=1e-6)
+
     def test_power_fused_loading_matches_eigh(self, rng):
         X = rng.random((12, 8))
         rep = nk.normalize(rng.random(12) + 0.1)

@@ -1,0 +1,162 @@
+"""Round-5 experiment (VERDICT r4 item 9): re-test the rejected iterated-ica
+warm start under the OUTCOME contract.
+
+Round 4 measured +61% on iterated ica from threading the previous
+iteration's whitening subspace into the orth-iter (the sztorc /
+fixed-variance warm-start rule) and REJECTED it on reputation-drift
+grounds: 58% of ``this_rep`` entries moved beyond the 2e-3 fused-vs-XLA
+parity tolerance at max_iterations=3 (the documented FastICA basis
+sensitivity). But snapped *outcomes* were never recorded — and the fuzz
+already grants iterated power the weaker contract "snapped outcomes
+exact, reputation tail unbounded". This script measures exactly that:
+
+for a fuzz-style corpus of iterated-ica cases, with the warm start OFF
+(production default) and ON (``pipeline._ICA_WARM_START``), record
+
+- snapped-outcome equality cold-vs-warm on the XLA path,
+- snapped-outcome equality warm-XLA vs warm-FUSED (the parity the round-4
+  rejection was measured against),
+- warm-vs-cold smooth_rep drift (context, not a criterion).
+
+Decision rule (written into MEASUREMENTS_r05): ADOPT iff zero outcome
+flips in BOTH comparisons across the corpus; otherwise the rejection
+stands with outcome-level evidence this time.
+
+The flag is flipped in-process via the module global; ``jax.clear_caches``
+runs after every flip because the jit cache is keyed on (shapes, params)
+and would otherwise replay traces from the other setting.
+
+Usage: python tools/ica_warm_outcome_experiment.py [--seeds 120] [--out -]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# CPU is the right backend here: the contract is a semantics question and
+# the corpus is hundreds of small jit cases (tunnel dispatch would dwarf
+# them); the on-chip perf side is bench.py --algorithm ica.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np  # noqa: E402
+
+
+def _case(rng):
+    R = int(rng.integers(16, 48))
+    E = int(rng.integers(12, 40))
+    reports = rng.choice([0.0, 0.5, 1.0], size=(R, E),
+                         p=[0.35, 0.15, 0.5]).astype(np.float64)
+    if rng.random() < 0.7:
+        na = rng.random((R, E)) < rng.uniform(0.02, 0.2)
+        reports[na] = np.nan
+    rep = rng.dirichlet(np.ones(R)) if rng.random() < 0.5 else None
+    mi = int(rng.choice([3, 5]))
+    return reports, rep, mi
+
+
+def run_corpus(n_seeds: int) -> dict:
+    import jax
+
+    # match the CPU test suite's x64 anchor environment — the round-4
+    # rejection measurements were against the same anchor
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from pyconsensus_tpu.models import pipeline
+    from pyconsensus_tpu.models.pipeline import (ConsensusParams,
+                                                 _consensus_core_fused,
+                                                 consensus_jax)
+
+    def resolve_xla(reports, rep, mi):
+        R, E = reports.shape
+        if rep is None:
+            rep = np.full(R, 1.0 / R)
+        p = ConsensusParams(algorithm="ica", max_iterations=mi,
+                            pca_method="power", any_scaled=False,
+                            has_na=bool(np.isnan(reports).any()))
+        out = consensus_jax(reports, rep, np.zeros(E, bool), np.zeros(E),
+                            np.ones(E), p)
+        return (np.asarray(out["outcomes_adjusted"]),
+                np.asarray(out["smooth_rep"]))
+
+    def resolve_fused(reports, rep, mi):
+        R, E = reports.shape
+        if rep is None:
+            rep = np.full(R, 1.0 / R)
+        p = ConsensusParams(algorithm="ica", max_iterations=mi,
+                            pca_method="power", any_scaled=False,
+                            has_na=True, fused_resolution=True)
+        out = _consensus_core_fused(
+            jnp.asarray(reports, jnp.float64), jnp.asarray(rep),
+            jnp.zeros(E, bool), jnp.zeros(E), jnp.ones(E), p)
+        return (np.asarray(out["outcomes_adjusted"]),
+                np.asarray(out["smooth_rep"]))
+
+    results = {"n_seeds": n_seeds, "outcome_flips_cold_vs_warm_xla": 0,
+               "outcome_flips_warm_xla_vs_warm_fused": 0,
+               "flip_seeds": [], "max_rep_drift_warm_vs_cold": 0.0,
+               "mean_rep_drift_warm_vs_cold": 0.0}
+    drifts = []
+    for seed in range(n_seeds):
+        rng = np.random.default_rng(7000 + seed)
+        reports, rep, mi = _case(rng)
+
+        pipeline._ICA_WARM_START = False
+        jax.clear_caches()
+        cold_out, cold_rep = resolve_xla(reports, rep, mi)
+
+        pipeline._ICA_WARM_START = True
+        jax.clear_caches()
+        warm_out, warm_rep = resolve_xla(reports, rep, mi)
+        warm_f_out, _ = resolve_fused(reports, rep, mi)
+        pipeline._ICA_WARM_START = False
+        jax.clear_caches()
+
+        flips_cw = int((cold_out != warm_out).sum())
+        flips_xf = int((warm_out != warm_f_out).sum())
+        if flips_cw:
+            results["outcome_flips_cold_vs_warm_xla"] += flips_cw
+        if flips_xf:
+            results["outcome_flips_warm_xla_vs_warm_fused"] += flips_xf
+        if flips_cw or flips_xf:
+            results["flip_seeds"].append(
+                {"seed": 7000 + seed, "shape": list(reports.shape),
+                 "mi": mi, "cold_vs_warm": flips_cw,
+                 "xla_vs_fused": flips_xf})
+        drifts.append(float(np.max(np.abs(warm_rep - cold_rep))))
+    results["max_rep_drift_warm_vs_cold"] = max(drifts)
+    results["mean_rep_drift_warm_vs_cold"] = float(np.mean(drifts))
+    results["adopt"] = (results["outcome_flips_cold_vs_warm_xla"] == 0
+                        and results["outcome_flips_warm_xla_vs_warm_fused"]
+                        == 0)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=120)
+    ap.add_argument("--out", default="-")
+    args = ap.parse_args()
+    res = run_corpus(args.seeds)
+    line = json.dumps(res, indent=1)
+    if args.out == "-":
+        print(line)
+    else:
+        pathlib.Path(args.out).write_text(line + "\n")
+        print(f"wrote {args.out}")
+    print(f"DECISION: {'ADOPT' if res['adopt'] else 'REJECTION STANDS'} "
+          f"(cold-vs-warm flips={res['outcome_flips_cold_vs_warm_xla']}, "
+          f"xla-vs-fused flips="
+          f"{res['outcome_flips_warm_xla_vs_warm_fused']}, "
+          f"max rep drift={res['max_rep_drift_warm_vs_cold']:.3g})")
+
+
+if __name__ == "__main__":
+    main()
