@@ -1,0 +1,91 @@
+"""Worker for the FOUR-process distributed test (round 5, VERDICT r4
+item 8) — launched as ``python distributed_worker4.py <process_id> <port>``
+by tests/test_distributed.py. Each of the four OS processes contributes 2
+virtual CPU devices to one 8-device global mesh.
+
+Lean phase set (the 2-process worker keeps the broad coverage; this one
+targets what only appears at >2 hosts):
+
+1. event-sharded resolution over the 8-device mesh — rendezvous and
+   cross-process collectives at 4 processes;
+2. multi-host out-of-core streaming with an ODD panel split (3 panels
+   over 4 hosts) — host 3 owns ZERO panels and must still enter every
+   all-reduce in lockstep;
+3. multi-host streamed k-means — the (R, k) distance accumulator
+   all-reduces once per Lloyd pass, including from the zero-panel host.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+process_id, port = int(sys.argv[1]), sys.argv[2]
+
+from pyconsensus_tpu.parallel import initialize  # noqa: E402
+
+initialize(coordinator_address=f"localhost:{port}", num_processes=4,
+           process_id=process_id)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from pyconsensus_tpu.models.pipeline import (ConsensusParams,  # noqa: E402
+                                             consensus_light_jit)
+from pyconsensus_tpu.parallel import (make_mesh,  # noqa: E402
+                                      streaming_consensus)
+
+assert jax.process_count() == 4, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+# the same deterministic matrix on every process
+rng = np.random.default_rng(0)
+truth = rng.choice([0.0, 1.0], size=16)
+reports = np.tile(truth, (12, 1))
+reports[:9] = np.abs(reports[:9] - (rng.random((9, 16)) < 0.1))
+reports[9:] = 1.0 - truth
+
+mesh = make_mesh(batch=1, event=8)
+x = jax.device_put(jnp.asarray(reports), NamedSharding(mesh, P(None, "event")))
+rep = jax.device_put(jnp.full((12,), 1.0 / 12.0), NamedSharding(mesh, P()))
+sc = jax.device_put(jnp.zeros((16,), bool), NamedSharding(mesh, P("event")))
+mn = jax.device_put(jnp.zeros((16,)), NamedSharding(mesh, P("event")))
+mx = jax.device_put(jnp.ones((16,)), NamedSharding(mesh, P("event")))
+params = ConsensusParams(algorithm="sztorc", max_iterations=2,
+                         pca_method="eigh-gram")
+out = consensus_light_jit(x, rep, sc, mn, mx, params)
+
+outcomes = multihost_utils.process_allgather(out["outcomes_adjusted"],
+                                             tiled=True)
+smooth = np.asarray(out["smooth_rep"])
+print("RESULT", ",".join(f"{float(v):g}" for v in np.ravel(outcomes)),
+      flush=True)
+print("REP", ",".join(f"{float(v):.6f}" for v in smooth), flush=True)
+
+# phase 2: odd split — ceil(16/6) = 3 panels round-robin over 4 hosts:
+# hosts 0..2 stream one panel each, host 3 streams NONE and must still
+# hit every per-iteration all-reduce (zero local statistics, full result)
+s_out = streaming_consensus(
+    reports, panel_events=6,
+    params=ConsensusParams(algorithm="sztorc", max_iterations=2),
+    n_hosts=4)
+print("STREAM", ",".join(f"{float(v):g}"
+                         for v in s_out["outcomes_adjusted"]), flush=True)
+print("STREAMREP", ",".join(f"{float(v):.6f}"
+                            for v in s_out["smooth_rep"]), flush=True)
+
+# phase 3: streamed k-means on the same odd/zero-panel split — the
+# (R, k) distance accumulator all-reduces once per Lloyd assignment
+# pass; centroid slices stay event-local on their owning hosts
+k_out = streaming_consensus(
+    reports, panel_events=6,
+    params=ConsensusParams(algorithm="k-means", num_clusters=3,
+                           max_iterations=2),
+    n_hosts=4)
+print("KMEANS", ",".join(f"{float(v):g}"
+                         for v in k_out["outcomes_adjusted"]), flush=True)
+print("KMEANSREP", ",".join(f"{float(v):.6f}"
+                            for v in k_out["smooth_rep"]), flush=True)

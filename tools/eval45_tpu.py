@@ -1,0 +1,129 @@
+"""Round-5 (VERDICT r4 item 4): first on-chip numbers for eval configs 4
+and 5 (BASELINE.json:10-11) — the clustering variants that don't fit
+bench.py (hybrid host paths) and the Monte-Carlo collusion sweep.
+
+Banked to docs/MEASUREMENTS_r05.json with the suite's keyed-upsert
+convention. The jit clustering variants (k-means / dbscan-jit) at the
+bench shape are bench.py modes, run via
+``tools/tpu_measurements.py --only kmeans,dbscan_jit``.
+
+Usage: python tools/eval45_tpu.py [--stage sweep,hybrid]
+           [--out docs/MEASUREMENTS_r05.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+import numpy as np  # noqa: E402
+
+
+def _bank(out_path: pathlib.Path, entry: dict) -> None:
+    results = []
+    if out_path.exists():
+        try:
+            results = [m for m in json.loads(out_path.read_text())
+                       if isinstance(m, dict)]
+        except ValueError:
+            results = []
+    for i, m in enumerate(results):
+        if m.get("_name") == entry["_name"]:
+            results[i] = entry
+            break
+    else:
+        results.append(entry)
+    out_path.write_text(json.dumps(results, indent=1) + "\n")
+    print(f"banked {entry['_name']} -> {out_path}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", default="sweep,hybrid")
+    ap.add_argument("--out", default=str(ROOT / "docs/MEASUREMENTS_r05.json"))
+    args = ap.parse_args()
+    stages = set(args.stage.split(","))
+    out_path = pathlib.Path(args.out)
+
+    import jax
+
+    backend = jax.default_backend()
+    print(f"backend: {backend}", flush=True)
+
+    if "sweep" in stages:
+        # config 5: (liar_fraction x variance x seed) grid, 10k trials,
+        # one batched XLA program; scalar-only egress. Shape mirrors eval
+        # config 1's 50 x 25 oracle (the reference simulator's scale).
+        from pyconsensus_tpu.sim import CollusionSimulator
+
+        sim = CollusionSimulator(n_reporters=50, n_events=25,
+                                 max_iterations=1, pca_method="power")
+        lfs, variances, n_trials = [0.0, 0.1, 0.2, 0.3, 0.4], [0.05, 0.1], \
+            1000
+        n_total = len(lfs) * len(variances) * n_trials
+        t0 = time.time()
+        sim.run(lfs, variances, n_trials, seed=0)       # compile + run
+        t_cold = time.time() - t0
+        t0 = time.time()
+        out = sim.run(lfs, variances, n_trials, seed=1)
+        t_warm = time.time() - t0
+        _bank(out_path, {
+            "_name": "mc_sweep_10k_trials",
+            "backend": backend,
+            "oracle_shape": [50, 25], "n_trials": n_total,
+            "grid": {"liar_fractions": lfs, "variances": variances,
+                     "trials_per_cell": n_trials},
+            "cold_s": round(t_cold, 3), "warm_s": round(t_warm, 3),
+            "trials_per_sec_warm": round(n_total / t_warm, 1),
+            "correct_rate_at_0": float(out["mean"]["correct_rate"][0, 0]),
+            "_note": "eval config 5 on chip: 10k-trial collusion sweep "
+                     "as ONE vmapped XLA dispatch (warm = steady-state "
+                     "throughput; cold includes compile)"})
+
+    if "hybrid" in stages:
+        # config 4's hybrid variants: device kernels for fill + R x R
+        # distances, host C++ NN-chain / DBSCAN for the merge loop
+        from pyconsensus_tpu.models.pipeline import ConsensusParams
+        from pyconsensus_tpu.parallel import make_mesh, sharded_consensus
+
+        mesh = make_mesh(batch=1, event=len(jax.devices()))
+        rng = np.random.default_rng(0)
+        R, E = 4096, 32768
+        r = rng.random((R, E), dtype=np.float32)
+        reports = np.where(r < 0.45, 0.0,
+                           np.where(r < 0.95, 1.0, 0.5)).astype(np.float32)
+        reports[rng.random((R, E)) < 0.02] = np.nan
+        for algo, kw in (("hierarchical", {"hierarchy_threshold": 1.5}),
+                         ("dbscan", {"dbscan_eps": 1.0})):
+            p = ConsensusParams(algorithm=algo, has_na=True, **kw)
+            t0 = time.time()
+            out = sharded_consensus(reports, mesh=mesh, params=p)
+            outc = np.asarray(out["outcomes_adjusted"])
+            t_cold = time.time() - t0
+            t0 = time.time()
+            out = sharded_consensus(reports, mesh=mesh, params=p)
+            outc = np.asarray(out["outcomes_adjusted"])
+            t_warm = time.time() - t0
+            ok = bool(np.isin(outc, [0.0, 0.5, 1.0]).all())
+            _bank(out_path, {
+                "_name": f"hybrid_{algo}_{R}x{E}",
+                "backend": backend, "shape": [R, E],
+                "cold_s": round(t_cold, 3),
+                "latency_s": round(t_warm, 3),
+                "outcomes_snapped": ok,
+                "_note": "eval config 4 on chip: hybrid variant — device "
+                         "fill + R x R Gram distances, host native "
+                         "clustering; warm latency is the honest "
+                         "number (cold includes compile)"})
+            assert ok
+
+    print("eval45 complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,11 +50,23 @@ MEASUREMENTS = [
     # the pure-XLA recovery rung (bench --no-pallas): the rate the ladder
     # falls back to if Mosaic ever rejects every kernel again
     ("no_pallas_xla", ["--no-pallas", "--storage-dtype", ""], 1200),
+    # round 5 (VERDICT r4 item 4): eval config 4's jit clustering
+    # variants on chip at the bench shape (hierarchical and the MC sweep
+    # are in tools/eval45_tpu.py — hybrid/host phases don't fit bench.py)
+    ("kmeans", ["--algorithm", "k-means"], 1500),
+    ("dbscan_jit", ["--algorithm", "dbscan-jit"], 1500),
     # (b) blocked median at increasing scaled fractions; the >E/8 shape
     # (XLA path, biggest sort temporaries) is the OOM-riskiest → last
     ("scaled_1k", ["--scaled", "1000"], 1200),
     ("scaled_4k", ["--scaled", "4000"], 1500),
     ("scaled_16k", ["--scaled", "16000"], 1800),
+    # round 5 (VERDICT r4 item 5): the scaled-MAJORITY ladder through and
+    # past the 90% gather_median_pays cap — 80k rides the gather, 95k is
+    # the first measurement of the full-width fallback the cap reverts
+    # to. Biggest sort temporaries of the whole suite → very last.
+    ("scaled_60k", ["--scaled", "60000"], 1800),
+    ("scaled_80k", ["--scaled", "80000"], 1800),
+    ("scaled_95k", ["--scaled", "95000"], 2400),
 ]
 
 
