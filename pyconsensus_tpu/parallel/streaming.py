@@ -85,6 +85,58 @@ from .mesh import effective_median_block
 
 __all__ = ["streaming_consensus"]
 
+#: R above which the streamed spectrum comes from orthogonal iteration on
+#: the explicit Gram accumulator instead of ``jnp.linalg.eigh`` — the
+#: same R<=4096 rule as jax_kernels.resolve_pca_method's Gram-eigh route.
+#: First hardware contact (round 5): QDWH eigh at R=10000 allocated
+#: dozens of ~300 MB triangular-solve temporaries and OOM'd the v5e HBM,
+#: while one orth-iter sweep is a single 4R^2-byte matmul.
+STREAM_EIGH_MAX_R = 4096
+
+
+def _sym_topk(Gd, k: int, n_iters: int = 96, tol: float = 1e-7):
+    """Top-``k`` eigenpairs of an explicit symmetric PSD matrix by
+    blocked orthogonal iteration + final Rayleigh-Ritz rotation (the
+    jax_kernels._top_pcs_orth_iter recipe, for a matrix that is already
+    materialized): deterministic fixed-key start block, per-column
+    alignment exit, ``eigh`` of the k x k projected matrix to rotate the
+    converged block onto its eigenvector approximations. Returns
+    ``(eigvals (k,) descending clipped, V (R, k))``."""
+    R = Gd.shape[0]
+    dtype = Gd.dtype
+    v0 = jax.random.normal(jax.random.key(0), (R, k), dtype)
+    V0, _ = jnp.linalg.qr(v0)
+
+    def cond(state):
+        i, _, done = state
+        return (i < n_iters) & ~done
+
+    def body(state):
+        i, V, _ = state
+        Q, _ = jnp.linalg.qr(Gd @ V)
+        # degenerate-spectrum guard: QR of a ZERO product block yields
+        # NaN columns — keep the previous orthonormal block. A
+        # non-finite Gd must still fail loudly (the poison below), not
+        # exit spuriously with the random start block.
+        Q = jnp.where(jnp.isfinite(Q), Q, V)
+        align = jnp.abs(jnp.sum(Q * V, axis=0))
+        return i + 1, Q, jnp.all(align >= 1.0 - tol)
+
+    _, V, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), V0, jnp.asarray(False)))
+    H = V.T @ (Gd @ V)                          # (k, k) projected matrix
+    hvals, W = jnp.linalg.eigh((H + H.T) * 0.5)
+    order = jnp.argsort(hvals)[::-1]
+    lam = jnp.clip(hvals[order], 0.0, None)
+    V = V @ W[:, order]
+    # loud-failure parity with the eigh branch: a non-finite accumulator
+    # must surface as NaN outputs, not as a silently "converged" random
+    # subspace (the in-loop guard above would otherwise mask it)
+    gd_finite = jnp.all(jnp.isfinite(Gd))
+    poison = jnp.asarray(jnp.nan, dtype)
+    return (jnp.where(gd_finite, lam, poison),
+            jnp.where(gd_finite, V, poison))
+
 
 @functools.partial(jax.jit, static_argnames=("tolerance", "with_s",
                                              "with_gm"))
@@ -563,16 +615,32 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         """Top-k loadings' scores + explained fractions off the Gram
         accumulator (the full nonzero covariance spectrum lives in G —
         jax_kernels.weighted_prin_comps' eigh-gram route, streamed).
-        Returns ``(scores (R, k), explained (k,), U (R, k), nAu (k,))``."""
+        Returns ``(scores (R, k), explained (k,), U (R, k), nAu (k,))``.
+
+        Above ``STREAM_EIGH_MAX_R`` reporters the top-k subspace comes
+        from blocked orthogonal iteration on the explicit symmetric
+        accumulator instead of ``jnp.linalg.eigh`` — round-5 first
+        hardware contact (VERDICT r4 item 1 precedent confirmed): the
+        QDWH eigh's triangular-solve temporaries at R=10000 exceeded the
+        chip's HBM (dozens of ~300 MB buffers), while an orth-iter sweep
+        is one 4R² byte matmul. The threshold mirrors
+        ``jax_kernels.resolve_pca_method``'s R<=4096 Gram-eigh rule; the
+        total variance uses ``trace(G)/denom`` (= the full eigvalue sum)
+        so explained fractions need no full spectrum."""
         denom = 1.0 - jnp.sum(rep_ref ** 2)
         denom = jnp.where(denom == 0.0, 1.0, denom)
-        eigvals, eigvecs = jnp.linalg.eigh(G / denom)
-        lam = jnp.clip(eigvals[::-1][:k], 0.0, None)
-        U = eigvecs[:, ::-1][:, :k]                       # (R, k)
+        Gd = G / denom
+        if R <= STREAM_EIGH_MAX_R:
+            eigvals, eigvecs = jnp.linalg.eigh(Gd)
+            lam = jnp.clip(eigvals[::-1][:k], 0.0, None)
+            U = eigvecs[:, ::-1][:, :k]                   # (R, k)
+            total = jnp.sum(jnp.clip(eigvals, 0.0, None))
+        else:
+            lam, U = _sym_topk(Gd, k)
+            total = jnp.clip(jnp.trace(Gd), 0.0, None)
         # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
         nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0, None))
         scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
-        total = jnp.sum(jnp.clip(eigvals, 0.0, None))
         explained = jnp.where(total > 0.0,
                               lam / jnp.where(total > 0.0, total, 1.0),
                               jnp.zeros_like(lam))

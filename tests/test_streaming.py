@@ -52,6 +52,42 @@ class TestStreamingParity:
             np.abs(out["first_loading"]), np.abs(ref["first_loading"]),
             atol=1e-8)
 
+    @pytest.mark.parametrize("algorithm", ["sztorc", "fixed-variance",
+                                           "ica"])
+    def test_orth_iter_spectrum_above_eigh_cap(self, rng, algorithm,
+                                               monkeypatch):
+        """Round-5 first-hardware-contact fix: above STREAM_EIGH_MAX_R
+        the streamed spectrum comes from orthogonal iteration on the
+        explicit Gram accumulator (QDWH eigh's temporaries OOM'd the v5e
+        HBM at R=10000). Forcing the cap below R here exercises that
+        route and requires the same snapped outcomes as the in-memory
+        pipeline (loadings agree to orth-iter tolerance, outcomes snap
+        exactly)."""
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.parallel import streaming as st
+        monkeypatch.setattr(st, "STREAM_EIGH_MAX_R", 4)
+        reports, _ = collusion_reports(rng, R=18, E=23, liars=5,
+                                       na_frac=0.1)
+        R, E = reports.shape
+        p = ConsensusParams(algorithm=algorithm, max_iterations=1,
+                            pca_method="eigh-gram", any_scaled=False,
+                            has_na=True)
+        ref = _consensus_core_light(jnp.asarray(reports),
+                                    jnp.full((R,), 1.0 / R),
+                                    jnp.zeros(E, bool), jnp.zeros(E),
+                                    jnp.ones(E), p)
+        out = streaming_consensus(reports, panel_events=7, params=p)
+        np.testing.assert_array_equal(out["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+        # ica amplifies the orth-iter's ~1e-7 subspace tolerance through
+        # FastICA (the module-documented sensitivity); outcomes snap
+        # exactly either way
+        np.testing.assert_allclose(out["smooth_rep"],
+                                   np.asarray(ref["smooth_rep"]),
+                                   atol=5e-5 if algorithm == "ica"
+                                   else 5e-6)
+
     def test_scaled_events(self, rng):
         reports, _ = collusion_reports(rng, R=12, E=10, liars=3)
         reports[:, 8:] = rng.uniform(0.0, 50.0, size=(12, 2))
