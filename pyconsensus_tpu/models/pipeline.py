@@ -53,12 +53,17 @@ HYBRID_ALGORITHMS = ("hierarchical", "dbscan")
 #: shifts ica's near-degenerate bulk columns and FastICA amplifies the
 #: shift chaotically (58% of this_rep entries beyond the 2e-3
 #: fused-vs-XLA parity tolerance at max_iterations=3, MEASUREMENTS_r04),
-#: so round 4 rejected the measured +61%. Round 5 re-tests under the
+#: so round 4 rejected the measured +61%. Round 5 RE-TESTED under the
 #: OUTCOME contract (snapped outcomes exact, reputation tail unbounded —
-#: the contract the fuzz already grants iterated power):
-#: tools/ica_warm_outcome_experiment.py flips this via the environment
-#: variable PYCONSENSUS_ICA_WARM_START=1 and records the decision in
-#: MEASUREMENTS_r05. Read once at import; not a public API.
+#: the contract the fuzz grants iterated power) and the REJECTION STANDS
+#: on strictly stronger grounds: 6 snapped-outcome flips cold-vs-warm
+#: across the 120-seed corpus, all at max_iterations=5
+#: (tools/ica_warm_outcome_experiment.py, MEASUREMENTS_r05
+#: ica_warm_start_outcome_contract) — the warm start changes ANSWERS,
+#: not just the reputation tail. Warm-XLA vs warm-fused stayed at zero
+#: flips, so the gate remains sound for future re-tests if FastICA's
+#: basis sensitivity is ever tamed. Read once at import; not a public
+#: API.
 _ICA_WARM_START = os.environ.get("PYCONSENSUS_ICA_WARM_START", "0") == "1"
 
 

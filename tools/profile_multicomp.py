@@ -1,0 +1,120 @@
+"""Round-5 (VERDICT r4 item 2): where does multi-component time go at the
+north-star width?
+
+Differential chain timing (docs/PERFORMANCE.md methodology) of the
+fixed-variance storage path at 10k x 100k int8 pre-encoded: the orth-iter
+at a FORCED sweep count vs the production Ritz-exit loop pins both the
+per-sweep cost and the effective sweep count; the full pipeline row says
+what everything around the spectrum costs. Each per-sweep row prints
+next to its HBM byte roofline AND its VPU-compute estimate — the one-pass
+block kernel does ~2(k+1) fused mul-adds per element, so at k ~ 6 the
+sweep is compute-bound, not bandwidth-bound, and the roofline argument
+for the sztorc gap does not transfer.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pyconsensus_tpu.models.pipeline import (ConsensusParams,
+                                             _consensus_core_fused,
+                                             _fill_stats, encode_reports)
+from pyconsensus_tpu.models.sztorc import fixed_variance_scores_storage
+from pyconsensus_tpu.ops.jax_kernels import _top_pcs_orth_iter
+from bench import generate_reports_device
+
+R, E = 10_000, 100_000
+HBM_GBPS = 819e9
+
+gen = jax.jit(generate_reports_device, static_argnums=(1, 2))
+reports_f32 = gen(jax.random.key(0), R, E, 0.02, 0.1, 0.05)
+enc = jax.jit(encode_reports)(reports_f32)
+jax.block_until_ready(enc)
+rep0 = jnp.full((R,), 1.0 / R)
+scaled = jnp.zeros((E,), bool)
+zeros = jnp.zeros((E,))
+ones = jnp.ones((E,))
+
+prep = jax.jit(lambda x, r: _fill_stats(x, r, 0.1, "int8"))
+x_s, fill_s, tw_s, numer_s = prep(enc, rep0)
+mu1 = numer_s + (1.0 - tw_s) * fill_s
+denom = 1.0 - jnp.sum(rep0 ** 2)
+jax.block_until_ready(x_s)
+
+from pyconsensus_tpu.models.sztorc import fixed_variance_k  # noqa: E402
+
+k = fixed_variance_k(R, E, 5)
+print(f"shape {R}x{E}, int8 pre-encoded, fixed-variance k={k}", flush=True)
+
+
+def timeit(fn, *args, n=8, pick=None):
+    pick = pick or (lambda o: o)
+    float(np.asarray(pick(fn(*args))))
+    t0 = time.perf_counter()
+    float(np.asarray(pick(fn(*args))))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = [pick(fn(*args)) for _ in range(n + 1)]
+    float(np.asarray(jnp.stack(outs).sum()))
+    tN = time.perf_counter() - t0
+    return (tN - t1) / n
+
+
+def orth_at(n_iters):
+    @jax.jit
+    def f(x, mu, dn, rep, fill):
+        loadings, eig, total, scores = _top_pcs_orth_iter(
+            x, mu, dn, rep, k, n_iters=n_iters, fill=fill)
+        out = jnp.sum(loadings) + jnp.sum(eig)
+        if scores is not None:
+            out = out + jnp.sum(scores)
+        return out
+    return f
+
+
+t1 = timeit(orth_at(1), x_s, mu1, denom, rep0, fill_s)
+t3 = timeit(orth_at(3), x_s, mu1, denom, rep0, fill_s)
+t_full_orth = timeit(orth_at(64), x_s, mu1, denom, rep0, fill_s)
+per_sweep = (t3 - t1) / 2
+n_sweeps = 1 + (t_full_orth - t1) / per_sweep if per_sweep > 0 else float("nan")
+
+roof_ms = R * E / HBM_GBPS * 1e3
+print(f"orth-iter 1 sweep:  {t1 * 1e3:8.2f} ms (incl. dispatch+QR+Ritz)",
+      flush=True)
+print(f"per extra sweep:    {per_sweep * 1e3:8.2f} ms  "
+      f"(HBM roofline {roof_ms:.2f} ms -> {roof_ms / per_sweep / 10:.0f}% "
+      f"of peak; ~{2 * (k + 1)} VPU mul-adds/elem)", flush=True)
+print(f"ritz-exit loop:     {t_full_orth * 1e3:8.2f} ms  "
+      f"(~{n_sweeps:.1f} effective sweeps)", flush=True)
+
+
+@jax.jit
+def fv_scores(x, fill, mu, rep):
+    adj, loadings, _ = fixed_variance_scores_storage(x, fill, mu, rep, 0.9, 5)
+    return jnp.sum(adj) + jnp.sum(loadings)
+
+
+t_scores = timeit(fv_scores, x_s, fill_s, mu1, rep0)
+print(f"fv scores total:    {t_scores * 1e3:8.2f} ms  "
+      f"(spectrum + variance combination + multi-dirfix)", flush=True)
+
+P = ConsensusParams(algorithm="fixed-variance", max_iterations=1,
+                    pca_method="power", storage_dtype="int8",
+                    any_scaled=False, has_na=True, fused_resolution=True)
+
+
+@jax.jit
+def fv_full(x, rep, scaled, zeros, ones):
+    return _consensus_core_fused(x, rep, scaled, zeros, ones, P)
+
+
+t_full = timeit(fv_full, enc, rep0, scaled, zeros, ones,
+                pick=lambda o: o["avg_certainty"])
+print(f"FULL fixed-variance:{t_full * 1e3:8.2f} ms  "
+      f"(back half = {1e3 * (t_full - t_scores):.2f} ms beyond scores)",
+      flush=True)
