@@ -616,6 +616,17 @@ def _top_pcs_orth_iter(reports_filled, mu, denom, reputation,
         i, _, _, _, done = state
         return (i < n_iters) & ~done
 
+    # Orthonormalization stays HOUSEHOLDER ``jnp.linalg.qr`` — measured
+    # ~2 ms/sweep at (100000, 5) on v5e, as expensive as the storage
+    # sweep itself, and a CholeskyQR2 replacement (two MXU-shaped k x k
+    # Grams + triangular solves) was tried round 5 and measured
+    # CATASTROPHIC: 12.0 -> 1.96 res/s end-to-end. Mechanism: CholQR2's
+    # stability needs kappa(Y)^2 * eps < 1, and Y = C V carries the
+    # near-degenerate bulk's full condition number, so the
+    # orthonormalization noise re-rotated the bulk every sweep and the
+    # alignment/Ritz exit never fired — the loop burned its whole
+    # 96-sweep budget (MEASUREMENTS_r05 cholqr2_ab). The QR cost is the
+    # price of a numerically robust exit.
     def body(state):
         i, V, eig_prev, stable_prev, _ = state
         Y = apply_cov_block(V)

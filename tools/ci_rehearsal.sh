@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Round-5 (VERDICT r4 item 6): rehearse .github/workflows/ci.yml locally.
+# This environment has no network, so the one step that cannot be
+# rehearsed is the dependency FETCH — a plain venv gets a .pth into the
+# SESSION environment's site-packages (see below: the session
+# interpreter is itself a venv, so --system-site-packages would link to
+# the bare base python) and the editable install runs --no-deps
+# --no-build-isolation against the baked-in jax/flax/pytest stack.
+# Everything else follows ci.yml verbatim: editable install, the full
+# suite on the 8-virtual-device CPU mesh, the example smokes against the
+# INSTALLED package, both CLI entry points, and the bench JSON contract.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VENV=/tmp/ci-rehearsal-venv
+rm -rf "$VENV"
+python -m venv "$VENV"
+PY="$VENV/bin/python"
+# the session interpreter is ITSELF a venv, so --system-site-packages
+# would link the rehearsal venv to the bare base python (no jax, no
+# setuptools); a .pth into the session env's site-packages exposes the
+# baked-in dependency stack instead
+SITE=$("$PY" -c "import site; print(site.getsitepackages()[0])")
+python - "$SITE" <<'PYEOF'
+import site, sys, pathlib
+pathlib.Path(sys.argv[1], "_session_env.pth").write_text(
+    site.getsitepackages()[0] + "\n")
+PYEOF
+
+echo "=== Install (pip install -e ., --no-deps: no network) ==="
+"$PY" -m pip install -e . --no-deps --no-build-isolation --quiet
+"$PY" -c "import pyconsensus_tpu; print('installed', pyconsensus_tpu.__version__, pyconsensus_tpu.__file__)"
+
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+export XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+echo "=== Test suite (8-virtual-device CPU mesh) ==="
+"$PY" -m pytest tests/ -q --durations=15
+
+echo "=== Example smoke runs (installed package) ==="
+"$PY" examples/quickstart.py
+"$PY" examples/fault_tolerant_sweep.py /tmp/ci-rehearsal-sweep
+
+echo "=== CLI entry points ==="
+"$PY" -m pyconsensus_tpu --example
+"$PY" -m pyconsensus --example --missing --scaled
+# the console scripts ci.yml's install creates
+"$VENV/bin/pyconsensus-tpu" --example >/dev/null && echo "console script OK"
+
+echo "=== bench.py JSON contract (tiny shape, CPU) ==="
+"$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
+  --bench-timeout 300 | tail -1 | "$PY" -c \
+  "import json,sys; d=json.load(sys.stdin); print('bench JSON ok:', d['metric'])"
+
+echo "=== CI rehearsal GREEN ==="

@@ -77,25 +77,50 @@ def orth_at(n_iters):
     return f
 
 
-t1 = timeit(orth_at(1), x_s, mu1, denom, rep0, fill_s)
-t3 = timeit(orth_at(3), x_s, mu1, denom, rep0, fill_s)
-t_full_orth = timeit(orth_at(64), x_s, mu1, denom, rep0, fill_s)
-per_sweep = (t3 - t1) / 2
-n_sweeps = 1 + (t_full_orth - t1) / per_sweep if per_sweep > 0 else float("nan")
+# NOTE on estimator validity (code-review r5): the loop's Ritz/alignment
+# early exit applies at ANY n_iters cap, so a marginal between two caps
+# is only a true per-sweep cost when BOTH caps sit below the natural
+# exit point (~16 here); tiny caps (1-4) also compile pathologically in
+# isolation (the stats-chain effect — docs/PERFORMANCE.md r5). Hence
+# (t12 - t8)/4: both forced, both real-sized.
+t8 = timeit(orth_at(8), x_s, mu1, denom, rep0, fill_s)
+t12 = timeit(orth_at(12), x_s, mu1, denom, rep0, fill_s)
+t_full_orth = timeit(orth_at(96), x_s, mu1, denom, rep0, fill_s)
+per_sweep = (t12 - t8) / 4
+n_sweeps = 8 + (t_full_orth - t8) / per_sweep if per_sweep > 0 else float(
+    "nan")
 
 roof_ms = R * E / HBM_GBPS * 1e3
-print(f"orth-iter 1 sweep:  {t1 * 1e3:8.2f} ms (incl. dispatch+QR+Ritz)",
+print(f"orth-iter n=8/12:   {t8 * 1e3:8.2f} / {t12 * 1e3:.2f} ms "
+      f"(both below the exit point: forced sweeps)", flush=True)
+print(f"per sweep (12-8)/4: {per_sweep * 1e3:8.2f} ms  "
+      f"(HBM roofline {roof_ms:.2f} ms; ~{2 * (k + 1)} VPU mul-adds/elem)",
       flush=True)
-print(f"per extra sweep:    {per_sweep * 1e3:8.2f} ms  "
-      f"(HBM roofline {roof_ms:.2f} ms -> {roof_ms / per_sweep / 10:.0f}% "
-      f"of peak; ~{2 * (k + 1)} VPU mul-adds/elem)", flush=True)
 print(f"ritz-exit loop:     {t_full_orth * 1e3:8.2f} ms  "
-      f"(~{n_sweeps:.1f} effective sweeps)", flush=True)
+      f"(~{n_sweeps:.1f} effective sweeps of the 96 budget)", flush=True)
+
+# does the budget buy SUBSPACE convergence (not just per-column churn
+# inside the statistically-interchangeable bulk — code-review r5)?
+# Compare an 8-sweep cap against the production exit by principal
+# angles between the spans, and by the explained-variance vector.
+cap8 = jax.jit(lambda x, mu, dn, rep, fill: _top_pcs_orth_iter(
+    x, mu, dn, rep, k, n_iters=8, fill=fill)[:2])
+prod = jax.jit(lambda x, mu, dn, rep, fill: _top_pcs_orth_iter(
+    x, mu, dn, rep, k, fill=fill)[:2])
+l8, e8 = (np.asarray(v) for v in cap8(x_s, mu1, denom, rep0, fill_s))
+lp, ep = (np.asarray(v) for v in prod(x_s, mu1, denom, rep0, fill_s))
+cosines = np.clip(np.linalg.svd(l8.T @ lp, compute_uv=False), -1.0, 1.0)
+max_angle = float(np.degrees(np.arccos(cosines.min())))
+print(f"8-cap vs production: max principal angle {max_angle:.3f} deg, "
+      f"eigval max rel gap "
+      f"{np.max(np.abs(e8 - ep)) / max(np.max(np.abs(ep)), 1e-30):.2e}, "
+      f"per-column |loading| gap "
+      f"{np.max(np.abs(np.abs(l8) - np.abs(lp))):.2e}", flush=True)
 
 
 @jax.jit
 def fv_scores(x, fill, mu, rep):
-    adj, loadings, _ = fixed_variance_scores_storage(x, fill, mu, rep, 0.9, 5)
+    adj, loadings = fixed_variance_scores_storage(x, fill, mu, rep, 0.9, 5)
     return jnp.sum(adj) + jnp.sum(loadings)
 
 
