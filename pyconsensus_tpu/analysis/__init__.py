@@ -1,0 +1,34 @@
+"""consensus-lint — JAX/TPU-aware static analysis for pyconsensus_tpu.
+
+Two layers (docs/STATIC_ANALYSIS.md):
+
+- **Layer 1 (AST lint, :mod:`.rules`)**: a rule engine over the package's
+  own source with JAX/TPU-specific rules — host-device syncs inside
+  jit-traced code, Python control flow on traced values, PRNG key reuse,
+  f64 literals in f32/bf16 kernels, weak-scalar dtype promotion — plus a
+  few generic hygiene rules (mutable defaults, bare except, unused
+  imports).
+- **Layer 2 (traced contracts, :mod:`.contracts`)**: the jitted/sharded
+  entry points are lowered to optimized HLO on the 8-virtual-device CPU
+  mesh and checked against declared contracts (``contracts.json``): exact
+  collective inventories (generalizing tests/test_hlo_collectives.py into
+  reusable infrastructure), no f64 ops, no host callbacks, and a
+  retrace-count budget via jit cache stats.
+
+Findings carry rule IDs, file:line and severity; a checked-in baseline
+(``baseline.json``, :mod:`.baseline`) lets the tree stay green while CI
+fails on *new* violations. CLI: ``python -m pyconsensus_tpu.analysis`` or
+the ``consensus-lint`` console script.
+"""
+
+from .baseline import load_baseline, match_baseline, save_baseline
+from .findings import Finding, fingerprints
+from .rules import RULES, lint_file, lint_paths
+from .contracts import (collective_sizes, f64_ops, host_callbacks,
+                        load_contracts, run_contracts)
+
+__all__ = [
+    "Finding", "fingerprints", "RULES", "lint_file", "lint_paths",
+    "collective_sizes", "f64_ops", "host_callbacks", "load_contracts",
+    "run_contracts", "load_baseline", "save_baseline", "match_baseline",
+]

@@ -1,0 +1,5 @@
+"""``python -m pyconsensus_tpu.analysis`` — the consensus-lint CLI."""
+
+from .cli import main
+
+main()

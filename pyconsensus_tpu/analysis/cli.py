@@ -1,0 +1,178 @@
+"""consensus-lint CLI.
+
+Usage (also ``python -m pyconsensus_tpu.analysis``):
+
+    consensus-lint                      # Layer 1 over the package
+    consensus-lint --strict             # Layer 1 + traced contracts; CI gate
+    consensus-lint path/to/file.py      # explicit targets
+    consensus-lint --update-baseline    # accept the current tree
+    consensus-lint --list-rules
+
+Exit codes: 0 = no non-baselined findings (and, under --strict, no stale
+baseline entries); 1 = new findings; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from .baseline import (default_baseline_path, load_baseline, match_baseline,
+                       save_baseline)
+from .findings import Finding, fingerprints
+from .rules import RULES, lint_paths
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="consensus-lint",
+        description="JAX/TPU-aware static analysis for pyconsensus_tpu "
+                    "(AST rules + traced HLO contracts)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "installed pyconsensus_tpu package)")
+    ap.add_argument("--strict", action="store_true",
+                    help="run the traced contracts too and fail on stale "
+                         "baseline entries (the CI gate)")
+    ap.add_argument("--contracts", action="store_true",
+                    help="run Layer 2 traced contracts (implied by "
+                         "--strict)")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip Layer 2 even under --strict")
+    ap.add_argument("--contract", action="append", default=None,
+                    metavar="NAME", help="run only this contract "
+                                         "(repeatable)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline file (default: "
+                         f"{default_baseline_path()})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to accept the current tree "
+                         "(keeps existing reasons)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None, metavar="CL101,CL203",
+                    help="comma-separated rule subset for Layer 1")
+    ap.add_argument("--list-rules", action="store_true")
+    return ap
+
+
+def _list_rules() -> str:
+    from .contracts import CONTRACT_RULES
+
+    lines = ["Layer 1 (AST rules):"]
+    for rid, (sev, desc) in sorted(RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
+    lines.append("Layer 2 (traced contracts):")
+    for rid, (sev, desc) in sorted(CONTRACT_RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
+    return "\n".join(lines)
+
+
+def run(argv: Optional[List[str]] = None, stdout=None) -> int:
+    out = stdout or sys.stdout
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        print(_list_rules(), file=out)
+        return 0
+
+    t0 = time.monotonic()
+    select = (set(s.strip() for s in args.select.split(",") if s.strip())
+              if args.select else None)
+    findings: List[Finding] = lint_paths(args.paths or None, select=select)
+
+    run_contracts_layer = (args.strict or args.contracts
+                           or args.contract) and not args.no_contracts
+    if run_contracts_layer:
+        from .contracts import ensure_cpu_devices, run_contracts
+
+        ensure_cpu_devices()
+        findings.extend(run_contracts(names=args.contract))
+
+    if args.update_baseline:
+        # preserve accepted entries this run could not have reproduced:
+        # contract findings when Layer 2 did not run, and Layer-1 findings
+        # in files outside a path-/rule-restricted scope — otherwise a
+        # partial update would silently delete accepted decisions and the
+        # next full --strict run would fail on them as "new"
+        from .rules import scan_targets
+
+        scanned = {rel for _, rel in scan_targets(args.paths or None)}
+
+        def preserve(entry):
+            if entry["path"].startswith("contract:"):
+                return not run_contracts_layer
+            if entry["path"] not in scanned:
+                return True
+            return bool(select) and entry["rule"] not in select
+
+        doc = save_baseline(findings, path=args.baseline, preserve=preserve)
+        print(f"baseline updated: {len(doc['findings'])} finding(s) "
+              f"accepted -> {args.baseline or default_baseline_path()}",
+              file=out)
+        return 0
+
+    baseline = ({"version": 1, "findings": []} if args.no_baseline
+                else load_baseline(args.baseline))
+    new, matched, stale = match_baseline(findings, baseline)
+    if stale:
+        # scope the stale check like the updater's preserve(): an entry a
+        # path-/rule-restricted or contracts-off run could not have
+        # reproduced is out of scope, not stale — only a run that COULD
+        # observe it and didn't may fail on it
+        from .rules import scan_targets
+
+        scanned = {rel for _, rel in scan_targets(args.paths or None)}
+        by_fp = {e["fingerprint"]: e for e in baseline.get("findings", [])}
+
+        def in_scope(fp):
+            e = by_fp.get(fp)
+            if e is None:
+                return True
+            if e["path"].startswith("contract:"):
+                return run_contracts_layer
+            return e["path"] in scanned and (
+                not select or e["rule"] in select)
+
+        stale = [fp for fp in stale if in_scope(fp)]
+
+    if args.format == "json":
+        payload = {
+            "new": [vars(f) | {"fingerprint": fp}
+                    for f, fp in zip(new, fingerprints(new))],
+            "baselined": len(matched),
+            "stale_baseline": stale,
+            "elapsed_s": round(time.monotonic() - t0, 2),
+        }
+        print(json.dumps(payload, indent=2), file=out)
+    else:
+        for f in new:
+            print(f.render(), file=out)
+        if stale and args.strict:
+            for fp in stale:
+                print(f"stale baseline entry (fix landed? remove it): {fp}",
+                      file=out)
+        n_err = sum(1 for f in new if f.severity == "error")
+        n_warn = len(new) - n_err
+        print(f"consensus-lint: {n_err} error(s), {n_warn} warning(s) "
+              f"({len(matched)} baselined"
+              + (f", {len(stale)} stale baseline entr"
+                 + ("y" if len(stale) == 1 else "ies") if stale else "")
+              + f") in {time.monotonic() - t0:.1f}s", file=out)
+
+    if new:
+        return 1
+    if stale and args.strict:
+        return 1
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

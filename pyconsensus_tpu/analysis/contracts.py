@@ -1,0 +1,506 @@
+"""Layer 2: traced contracts over the compiled entry points.
+
+Each contract (declared in ``contracts.json``) names a *builder* — a
+function that lowers one real jitted/sharded entry point to optimized
+(post-GSPMD-partitioning) HLO on the CPU mesh — and a set of assertions
+over that artifact:
+
+- **collective inventory** (rule CL301): every collective instruction's
+  operand element count is bounded; generalizes
+  tests/test_hlo_collectives.py's helpers into reusable infrastructure
+  (that test now consumes this module). Budget expressions are evaluated
+  with ``R``/``E``/``n_dev`` bound to the contract's shape.
+- **no f64 ops** (CL302): no ``f64[``/``c128[`` shapes in the HLO. Checked
+  only when ``jax_enable_x64`` is OFF — under x64 (the pytest
+  environment) every array is legitimately f64, so the check is SKIPPED
+  there (silently: a skip notice would itself be a non-baselined
+  finding). The authoritative f64 gate is the fresh-process CI run,
+  where x64 is off.
+- **no host callbacks** (CL303): no python-callback custom-calls,
+  infeed/outfeed, or host sends — a host round-trip inside a traced path
+  stalls the device pipeline.
+- **retrace budget** (CL304): calling an entry point twice with identical
+  (shape, dtype, params) must not grow the jit cache — a retrace on a
+  steady-state serving path is a silent multi-second stall.
+
+A builder that raises reports CL300 (contract-trace-failure): the entry
+point could not even be traced — e.g. a host sync seeded into a jitted
+path raises ``TracerArrayConversionError`` here, which is exactly the
+signal wanted.
+
+Run under ``JAX_PLATFORMS=cpu`` with 8 virtual devices
+(``ensure_cpu_devices`` arranges both when nothing has initialized a
+backend yet — the CLI calls it; pytest's conftest already does the same).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .findings import Finding
+
+CONTRACT_RULES = {
+    "CL300": ("error", "entry point failed to trace/compile"),
+    "CL301": ("error", "collective inventory violates the declared budget"),
+    "CL302": ("error", "f64/c128 op in compiled HLO"),
+    "CL303": ("error", "host callback / infeed / outfeed in compiled HLO"),
+    "CL304": ("error", "jit cache grew on an identical re-call "
+                       "(retrace budget exceeded)"),
+}
+
+_DEFAULT_CONTRACTS = pathlib.Path(__file__).with_name("contracts.json")
+
+# -- HLO text analysis (the reusable core of tests/test_hlo_collectives) --
+
+COLLECTIVE_RE = re.compile(
+    r"= ([^=]*?) (all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?:-start)?\(")
+_DIMS_RE = re.compile(r"\[([0-9,]*)\]")
+
+_HOST_CALLBACK_RE = re.compile(
+    r"custom-call.*(callback|xla_python|py_func)|"
+    r"\b(infeed|outfeed|send-to-host|recv-from-host)\b")
+
+_F64_RE = re.compile(r"\b(f64|c128)\[")
+
+
+# dtype token = letters, a digit, then optional alphanumerics: matches
+# f32/bf16/u32/c128 AND fp8 names (f8e4m3fn), but NOT annotation tokens
+# like `devices=[8]` that carry no digit before the bracket
+_TYPED_DIMS_RE = re.compile(r"\b(pred|[a-z]+[0-9][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def collective_inventory(hlo_text: str) -> List[tuple]:
+    """``[(op_kind, dtypes, elems), ...]`` for every collective
+    instruction in compiled HLO — one entry per instruction,
+    tuple-shaped outputs summed (the tuple is one fused collective's
+    payload) with the union of their dtypes. ``dtypes`` is a frozenset
+    of HLO type names (``f32``, ``u32``, …), letting budgets distinguish
+    DATA partials from PRNG-bit/index assemblies."""
+    out: List[tuple] = []
+    for line in hlo_text.splitlines():
+        m = COLLECTIVE_RE.search(line.strip())
+        if m:
+            shape, op = m.group(1), m.group(2)
+            elems, dtypes = 0, set()
+            for dt, dims in _TYPED_DIMS_RE.findall(shape):
+                dtypes.add(dt)
+                elems += (int(np.prod([int(d) for d in dims.split(",")]))
+                          if dims else 1)
+            out.append((op, frozenset(dtypes), elems))
+    return out
+
+
+def collective_sizes(hlo_text: str) -> Dict[str, List[int]]:
+    """{op_kind: [operand element counts]} — the dtype-blind view
+    (tests/test_hlo_collectives.py's original helper, kept as API)."""
+    out: Dict[str, List[int]] = {}
+    for op, _, elems in collective_inventory(hlo_text):
+        out.setdefault(op, []).append(elems)
+    return out
+
+
+def _is_float_payload(dtypes) -> bool:
+    return any(dt.startswith(("f", "bf", "c")) for dt in dtypes)
+
+
+def f64_ops(hlo_text: str) -> List[str]:
+    """HLO lines computing in f64/c128 (ignores metadata-only mentions)."""
+    return [ln.strip() for ln in hlo_text.splitlines()
+            if _F64_RE.search(ln.split("metadata=")[0])]
+
+
+def host_callbacks(hlo_text: str) -> List[str]:
+    """HLO lines that re-enter the host mid-graph."""
+    return [ln.strip() for ln in hlo_text.splitlines()
+            if _HOST_CALLBACK_RE.search(ln)]
+
+
+def check_collective_budget(inventory: List[tuple], budget: dict,
+                            env: dict) -> List[str]:
+    """Violation messages for one compiled artifact against a declared
+    budget. ``inventory`` is :func:`collective_inventory`'s output.
+    Budget fields (expressions may use R, E, n_dev):
+
+    - ``forbid_collectives``: no collective of any kind may appear;
+    - ``require_all_reduce``: the path must actually be sharded;
+    - ``all_reduce_max``: per-all-reduce operand element bound for
+      FLOAT-payload all-reduces (the data partials the scaling claim is
+      about), except…
+    - ``large_all_reduces`` / ``large_all_reduce_max``: …this many may
+      exceed it up to the large bound (the Gram path's one R x R
+      reduction);
+    - ``other_max``: bound for every other collective kind AND for
+      integer-only all-reduces (PRNG-bit / index assemblies — GSPMD
+      sometimes expresses an all-gather as a sum-all-reduce of u32
+      bits, same bytes on the wire);
+    - ``matrix_backstop``: absolute bound for anything (defaults to
+      ``R * E // (2 * n_dev)`` — half a matrix shard).
+    """
+    def ev(expr):
+        ns = dict(env, max=max, min=min)
+        return int(eval(str(expr), {"__builtins__": {}}, ns))
+
+    out: List[str] = []
+    if budget.get("forbid_collectives"):
+        if inventory:
+            counts: Dict[str, int] = {}
+            for op, _, _ in inventory:
+                counts[op] = counts.get(op, 0) + 1
+            out.append(f"expected a collective-free program, found {counts}")
+        return out
+    float_ars = [n for op, dt, n in inventory
+                 if op == "all-reduce" and _is_float_payload(dt)]
+    all_ars = [n for op, _, n in inventory if op == "all-reduce"]
+    if budget.get("require_all_reduce", True) and not all_ars:
+        out.append("no all-reduce at all: path is not actually sharded")
+    if "all_reduce_max" in budget and float_ars:
+        bound = ev(budget["all_reduce_max"])
+        n_large = int(budget.get("large_all_reduces", 0))
+        large_bound = ev(budget.get("large_all_reduce_max", 0))
+        big = sorted((n for n in float_ars if n > bound), reverse=True)
+        if len(big) > n_large:
+            out.append(
+                f"{len(big)} float all-reduce(s) exceed {bound} elements "
+                f"(largest {big[0]}; {n_large} large ones allowed) — "
+                f"per-sweep collectives should carry only (R,) partials")
+        for n in big[:n_large]:
+            if n > large_bound:
+                out.append(f"large all-reduce of {n} elements exceeds "
+                           f"the {large_bound} bound")
+    if "other_max" in budget:
+        bound = ev(budget["other_max"])
+        for op, dt, n in inventory:
+            if op == "all-reduce" and _is_float_payload(dt):
+                continue
+            if n > bound:
+                out.append(f"{op} ({'/'.join(sorted(dt))}) moving {n} "
+                           f"elements (> {bound}): a sharded operand is "
+                           f"being re-assembled")
+    backstop = ev(budget.get(
+        "matrix_backstop", "R * E // (2 * n_dev) if n_dev > 1 else R * E"))
+    if backstop > 0:
+        for op, dt, n in inventory:
+            if n >= backstop:
+                out.append(f"{op} moving {n} elements — matrix-sized "
+                           f"collective (backstop {backstop})")
+    return out
+
+
+# -- environment ----------------------------------------------------------
+
+N_DEV = 8
+
+
+def ensure_cpu_devices(n: int = N_DEV) -> None:
+    """Force the CPU platform with ``n`` virtual devices — must run before
+    jax initializes a backend (the CLI path). Safe no-op when a suitable
+    backend already exists (pytest's conftest)."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+# -- builders -------------------------------------------------------------
+# Each returns compiled HLO text, or a list[Finding] for dynamic checks.
+
+
+def _shape(spec: dict):
+    sh = spec.get("shape", {})
+    return int(sh.get("R", 32)), int(sh.get("E", 2048))
+
+
+def _params(spec: dict, **overrides):
+    from ..models.pipeline import ConsensusParams
+
+    kw = dict(spec.get("params", {}))
+    kw.update(overrides)
+    return ConsensusParams(**kw)
+
+
+def _acc_dtype():
+    import jax.numpy as jnp
+
+    return jnp.asarray(0.0).dtype
+
+
+def _builder_pipeline_sharded(spec: dict) -> str:
+    """consensus_light_jit on the event-sharded mesh, params resolved
+    through the REAL front-end logic (resolve_params /
+    effective_median_block), inputs as ShapeDtypeStructs — nothing
+    (R, E)-sized is materialized."""
+    import jax
+
+    from ..models.pipeline import consensus_light_jit
+    from ..parallel import make_mesh, resolve_params
+    from ..parallel.mesh import event_sharding, replicated
+
+    R, E = _shape(spec)
+    mesh_spec = spec.get("mesh", {"batch": 1, "event": N_DEV})
+    mesh = make_mesh(**mesh_spec)
+    n_scaled = int(spec.get("shape", {}).get("n_scaled", 0))
+    p = _params(spec, any_scaled=n_scaled > 0, n_scaled=n_scaled)
+    p = resolve_params(p, R, E, mesh)
+    dt = _acc_dtype()
+    e_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("event"))
+    args = (
+        jax.ShapeDtypeStruct((R, E), dt, sharding=event_sharding(mesh)),
+        jax.ShapeDtypeStruct((R,), dt, sharding=replicated(mesh)),
+        jax.ShapeDtypeStruct((E,), bool, sharding=e_sh),
+        jax.ShapeDtypeStruct((E,), dt, sharding=e_sh),
+        jax.ShapeDtypeStruct((E,), dt, sharding=e_sh),
+    )
+    return consensus_light_jit.lower(*args, p).compile().as_text()
+
+
+def _builder_pipeline_single(spec: dict) -> str:
+    """Single-device light pipeline: the serving fast path must stay
+    collective- and callback-free."""
+    import jax
+
+    from ..models.pipeline import consensus_light_jit
+
+    R, E = _shape(spec)
+    p = _params(spec)
+    dt = _acc_dtype()
+    args = (jax.ShapeDtypeStruct((R, E), dt),
+            jax.ShapeDtypeStruct((R,), dt),
+            jax.ShapeDtypeStruct((E,), bool),
+            jax.ShapeDtypeStruct((E,), dt),
+            jax.ShapeDtypeStruct((E,), dt))
+    return consensus_light_jit.lower(*args, p).compile().as_text()
+
+
+def _builder_fused_sharded(spec: dict) -> str:
+    """The shard_map fused-kernel executable (parallel.fused_sharded) —
+    explicit psum collectives around the Pallas storage kernels
+    (interpret mode off-TPU, so the kernels lower to plain XLA ops)."""
+    import jax
+
+    from ..parallel import make_mesh
+    from ..parallel.fused_sharded import _build, _seed_placed
+    from ..parallel.mesh import event_sharding, replicated
+
+    R, E = _shape(spec)
+    mesh = make_mesh(**spec.get("mesh", {"batch": 1, "event": N_DEV}))
+    p = _params(spec, fused_resolution=True)
+    dt = _acc_dtype()
+    interpret = jax.default_backend() != "tpu"
+    seed, base_unit = _seed_placed(mesh, E, 0, dt.name)
+    fn = _build(mesh, p, interpret, E, False)
+    args = (jax.ShapeDtypeStruct((R, E), dt, sharding=event_sharding(mesh)),
+            jax.ShapeDtypeStruct((R,), dt, sharding=replicated(mesh)))
+    return fn.lower(*args, seed, base_unit).compile().as_text()
+
+
+def _builder_collusion_vmap(spec: dict) -> str:
+    """The Monte-Carlo simulator's batched trial program: pure data
+    parallelism — zero collectives, everything on device."""
+    import jax.numpy as jnp
+
+    from ..sim.collusion import CollusionSimulator, _fold_keys
+
+    R, E = _shape(spec)
+    n = int(spec.get("shape", {}).get("n_trials", 8))
+    sim = CollusionSimulator(n_reporters=R, n_events=E,
+                             **spec.get("simulator", {}))
+    keys = _fold_keys(0, np.arange(n))
+    lf = jnp.full((n,), 0.2, _acc_dtype())
+    var = jnp.full((n,), 0.1, _acc_dtype())
+    return sim._batched.lower(keys, lf, var).compile().as_text()
+
+
+def _builder_streaming_panel(spec: dict) -> str:
+    """The out-of-core path's per-panel accumulation kernel
+    (streaming._pass1_panel): one panel in, R x R sufficient statistics
+    out — no collectives on a single device, no host re-entry."""
+    import jax
+
+    from ..parallel.streaming import _pass1_panel
+
+    R, E = _shape(spec)
+    dt = _acc_dtype()
+    args = (jax.ShapeDtypeStruct((R, E), dt),      # panel
+            jax.ShapeDtypeStruct((R,), dt),        # fill_rep
+            jax.ShapeDtypeStruct((R,), dt),        # weight_rep
+            jax.ShapeDtypeStruct((E,), bool),      # scaled
+            jax.ShapeDtypeStruct((E,), dt),        # mins
+            jax.ShapeDtypeStruct((E,), dt),        # maxs
+            jax.ShapeDtypeStruct((E,), bool))      # valid
+    return _pass1_panel.lower(*args, tolerance=0.1,
+                              with_s=True).compile().as_text()
+
+
+def _builder_kmeans_single(spec: dict) -> str:
+    """models.clustering's jit-compatible k-means conformity scorer."""
+    import functools
+
+    import jax
+
+    from ..models import clustering as cl
+
+    R, E = _shape(spec)
+    dt = _acc_dtype()
+    fn = jax.jit(functools.partial(cl.kmeans_conformity_jax,
+                                   num_clusters=2))
+    return fn.lower(jax.ShapeDtypeStruct((R, E), dt),
+                    jax.ShapeDtypeStruct((R,), dt)).compile().as_text()
+
+
+def _builder_sztorc_scores(spec: dict) -> str:
+    """models.sztorc's power-method scorer, jitted standalone."""
+    import jax
+
+    from ..models.sztorc import sztorc_scores_jax
+
+    R, E = _shape(spec)
+    dt = _acc_dtype()
+    fn = jax.jit(lambda reports, rep: sztorc_scores_jax(
+        reports, rep, pca_method="power"))
+    return fn.lower(jax.ShapeDtypeStruct((R, E), dt),
+                    jax.ShapeDtypeStruct((R,), dt)).compile().as_text()
+
+
+def _builder_retrace_pipeline(spec: dict) -> List[Finding]:
+    """Dynamic check: two identical consensus_light_jit calls must share
+    one cache entry (budget = allowed growth across BOTH calls; identical
+    re-calls growing the cache means params/shape hashing broke)."""
+    import jax.numpy as jnp
+
+    from ..models.pipeline import consensus_light_jit
+
+    R, E = _shape(spec)
+    budget = int(spec.get("retrace_budget", 1))
+    p = _params(spec)
+    dt = _acc_dtype()
+    rng = np.random.default_rng(0)
+    args = (jnp.asarray(rng.choice([0.0, 1.0], size=(R, E)), dt),
+            jnp.full((R,), 1.0 / R, dt), jnp.zeros((E,), bool),
+            jnp.zeros((E,), dt), jnp.ones((E,), dt))
+    before = consensus_light_jit._cache_size()
+    consensus_light_jit(*args, p)
+    mid = consensus_light_jit._cache_size()
+    consensus_light_jit(*args, p)
+    after = consensus_light_jit._cache_size()
+    findings = []
+    if after - mid > 0:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"identical re-call retraced: cache grew "
+                    f"{mid} -> {after}", severity="error",
+            snippet=f"{spec['name']}:recall"))
+    if after - before > budget:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"two calls grew the jit cache by "
+                    f"{after - before} (> budget {budget})",
+            severity="error", snippet=f"{spec['name']}:budget"))
+    return findings
+
+
+BUILDERS: Dict[str, Callable] = {
+    "pipeline_sharded": _builder_pipeline_sharded,
+    "pipeline_single": _builder_pipeline_single,
+    "fused_sharded": _builder_fused_sharded,
+    "collusion_vmap": _builder_collusion_vmap,
+    "streaming_panel": _builder_streaming_panel,
+    "kmeans_single": _builder_kmeans_single,
+    "sztorc_scores": _builder_sztorc_scores,
+    "retrace_pipeline": _builder_retrace_pipeline,
+}
+
+
+# -- driver ---------------------------------------------------------------
+
+
+def load_contracts(path=None) -> List[dict]:
+    p = pathlib.Path(path) if path else _DEFAULT_CONTRACTS
+    return json.loads(p.read_text())["contracts"]
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def check_artifact(name: str, hlo_text: str, spec: dict) -> List[Finding]:
+    """All text-level checks for one compiled artifact (pure — unit
+    testable on crafted HLO strings)."""
+    R, E = _shape(spec)
+    mesh_spec = spec.get("mesh") or {}
+    env = {"R": R, "E": E,
+           "n_dev": int(mesh_spec.get("batch", 1))
+           * int(mesh_spec.get("event", 1)) if mesh_spec else 1}
+    path = f"contract:{name}"
+    out: List[Finding] = []
+    if "budget" in spec:
+        inventory = collective_inventory(hlo_text)
+        for msg in check_collective_budget(inventory, spec["budget"], env):
+            out.append(Finding(rule="CL301", path=path, line=0,
+                               message=msg, severity="error",
+                               snippet=f"{name}:collectives"))
+    if spec.get("forbid_f64", True) and not _x64_enabled():
+        bad = f64_ops(hlo_text)
+        if bad:
+            out.append(Finding(
+                rule="CL302", path=path, line=0,
+                message=f"{len(bad)} f64/c128 op(s) in compiled HLO "
+                        f"(first: {bad[0][:120]})", severity="error",
+                snippet=f"{name}:f64"))
+    if spec.get("forbid_host_callbacks", True):
+        bad = host_callbacks(hlo_text)
+        if bad:
+            out.append(Finding(
+                rule="CL303", path=path, line=0,
+                message=f"{len(bad)} host re-entry op(s) in compiled HLO "
+                        f"(first: {bad[0][:120]})", severity="error",
+                snippet=f"{name}:callback"))
+    return out
+
+
+def run_contracts(names: Optional[List[str]] = None,
+                  contracts_path=None) -> List[Finding]:
+    """Compile every declared contract's entry point and check it.
+    Returns findings (empty = all contracts hold)."""
+    out: List[Finding] = []
+    for spec in load_contracts(contracts_path):
+        name = spec["name"]
+        if names and name not in names:
+            continue
+        builder = BUILDERS.get(spec["builder"])
+        if builder is None:
+            out.append(Finding(
+                rule="CL300", path=f"contract:{name}", line=0,
+                message=f"unknown builder {spec['builder']!r}",
+                severity="error", snippet=f"{name}:builder"))
+            continue
+        try:
+            artifact = builder(spec)
+        except Exception as e:                    # noqa - reported, not raised
+            out.append(Finding(
+                rule="CL300", path=f"contract:{name}", line=0,
+                message=f"entry point failed to trace/compile: "
+                        f"{type(e).__name__}: {str(e)[:300]}",
+                severity="error", snippet=f"{name}:trace"))
+            continue
+        if isinstance(artifact, list):            # dynamic check findings
+            out.extend(artifact)
+        else:
+            out.extend(check_artifact(name, artifact, spec))
+    return out

@@ -1,0 +1,572 @@
+"""Layer 1: the AST rule engine (JAX/TPU-specific lint).
+
+Traced-context detection is static and conservative. A function is
+*traced* when any of these hold:
+
+- decorated with a trace wrapper (``@jax.jit``, ``@jax.vmap``,
+  ``@functools.partial(jax.jit, ...)``, ``shard_map``, ``pallas_call``,
+  ``checkpoint``/``remat``, ``grad``);
+- its NAME is passed to a trace wrapper anywhere in the module
+  (``jax.jit(fn)``, ``lax.scan(step, ...)``, ``jax.vmap(body)``), or to
+  ``functools.partial`` whose result feeds one;
+- it is a ``def`` nested inside a traced function;
+- a traced function in the same module calls it by name (transitive
+  closure — cross-module calls are Layer 2's job: tracing the real entry
+  points catches what this static pass cannot see);
+- the module opts in wholesale with a ``consensus-lint: traced-module``
+  comment (the ops kernel modules), or the ``def`` line carries a
+  ``consensus-lint: traced`` comment marker.
+
+A ``consensus-lint: host`` comment marker on the ``def`` line opts a
+function back out; a ``consensus-lint: disable=CL101,CL102`` (or bare
+``noqa``) comment on the finding's line suppresses it in place.
+
+Rule IDs are stable and documented in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+#: rule ID -> (severity, one-line description)
+RULES = {
+    "CL101": ("error", "host-device sync call inside a jit-traced context "
+                       "(block_until_ready / .item() / np.asarray / "
+                       "jax.device_get)"),
+    "CL102": ("error", "Python if/while branching on a traced value "
+                       "(use lax.cond / jnp.where / lax.while_loop)"),
+    "CL103": ("error", "jax.random key passed to more than one sampling "
+                       "call without an intervening split"),
+    "CL104": ("error", "float64 literal or dtype in a kernel documented "
+                       "f32/bf16 (traced context)"),
+    "CL105": ("warning", "jnp.where whose branches are both weak Python "
+                         "scalars — promotes to the default float dtype "
+                         "(f64 on x64 hosts)"),
+    "CL201": ("warning", "mutable default argument"),
+    "CL202": ("warning", "bare except clause"),
+    "CL203": ("warning", "unused module-level import"),
+}
+
+#: callables that trace their function argument into an XLA graph
+_TRACE_WRAPPERS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "shard_map",
+    "pallas_call", "custom_jvp", "custom_vjp", "associative_scan",
+}
+
+#: jnp/lax calls that return HOST values (static under trace) — legal in
+#: Python control flow inside traced code
+_STATIC_SAFE_CALLS = {
+    "issubdtype", "result_type", "promote_types", "finfo", "iinfo",
+    "dtype", "can_cast", "isdtype", "ndim", "shape",
+}
+
+#: jax.random functions that CONSUME a key (reuse is the bug); the rest
+#: (split/fold_in/key construction) derive fresh keys
+_KEY_DERIVERS = {
+    "split", "fold_in", "key", "PRNGKey", "key_data", "wrap_key_data",
+    "clone", "key_impl",
+}
+
+#: np-rooted converter calls that force a device->host transfer when the
+#: operand is traced
+_NP_SYNC_CALLS = {"asarray", "array", "asanyarray", "ascontiguousarray"}
+
+#: attribute calls that synchronize with the device regardless of root
+_ATTR_SYNC_CALLS = {"item", "block_until_ready", "tolist"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``jax.random.bernoulli`` -> that string; None for non-trivial roots."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Aliases:
+    """Canonicalize the module's import aliases: jnp -> jax.numpy, ..."""
+
+    def __init__(self, tree: ast.Module):
+        self.map: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.map[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.map[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def canon(self, dotted: Optional[str]) -> Optional[str]:
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        head = self.map.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+
+def _line_directives(text: str) -> Dict[int, Set[str]]:
+    """{lineno: set of suppressed rule IDs} ('*' = all) from
+    ``# consensus-lint: disable=...`` / ``# noqa`` comments."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if "#" not in line:
+            continue
+        comment = line[line.index("#"):]
+        if "consensus-lint:" in comment and "disable=" in comment:
+            ids = comment.split("disable=", 1)[1]
+            out[i] = {s.strip() for s in ids.replace(";", ",").split(",")
+                      if s.strip()}
+        elif "# noqa" in comment:
+            out[i] = {"*"}
+    return out
+
+
+def _in_comment(line: str, needle: str) -> bool:
+    """True when ``needle`` appears inside the line's COMMENT part — a
+    mention in a docstring or string literal is not a directive."""
+    idx = line.find("#")
+    return idx >= 0 and needle in line[idx:]
+
+
+def _def_markers(text: str) -> Tuple[Set[int], Set[int]]:
+    """Line numbers carrying explicit traced / host function markers."""
+    traced, host = set(), set()
+    for i, line in enumerate(text.splitlines(), 1):
+        if (_in_comment(line, "consensus-lint: traced")
+                and "traced-module" not in line):
+            traced.add(i)
+        if _in_comment(line, "consensus-lint: host"):
+            host.add(i)
+    return traced, host
+
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _Module:
+    """Per-module analysis state: alias map, function table, traced set."""
+
+    def __init__(self, path: str, text: str, tree: ast.Module):
+        self.path = path
+        self.text = text
+        self.tree = tree
+        self.aliases = _Aliases(tree)
+        self.traced_module = any(
+            _in_comment(line, "consensus-lint: traced-module")
+            for line in text.splitlines()[:40])
+        self.marker_traced, self.marker_host = _def_markers(text)
+        self.funcs: List[ast.AST] = [n for n in ast.walk(tree)
+                                     if isinstance(n, _FuncNode)]
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.traced: Set[ast.AST] = set()
+        self._compute_traced()
+
+    # -- traced-context closure ------------------------------------------
+
+    def _is_wrapper(self, func_expr: ast.AST) -> bool:
+        dotted = self.aliases.canon(_dotted(func_expr))
+        return bool(dotted) and dotted.split(".")[-1] in _TRACE_WRAPPERS
+
+    def _traced_root_names(self) -> Set[str]:
+        """Function NAMES passed to a trace wrapper (or via partial)."""
+        roots: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_wrap = self._is_wrapper(node.func)
+            dotted = self.aliases.canon(_dotted(node.func)) or ""
+            is_partial = dotted.split(".")[-1] == "partial"
+            if is_partial and node.args:
+                # partial(jax.jit, ...) -> remaining Name args are traced;
+                # partial(fn, ...) whose result is handed to a wrapper is
+                # resolved conservatively: treat the partial'd fn as traced
+                # only when SOME wrapper call exists in the module — the
+                # cheap over-approximation would flood host code, so
+                # instead only partial(<wrapper>, fn) counts here.
+                if self._is_wrapper(node.args[0]):
+                    is_wrap = True
+            if not is_wrap:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Attribute):
+                    roots.add(arg.attr)       # self._fn / module.fn
+                else:
+                    # collect Names recursively: composition like
+                    # jax.jit(exact_matmuls(_consensus_core)) traces the
+                    # inner function too
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            roots.add(sub.id)
+        return roots
+
+    def _calls_in(self, fn: ast.AST) -> Set[str]:
+        """Callables a function references: direct calls, self/cls method
+        calls, and function NAMES passed as call arguments (wrapper
+        composition like ``jax.jit(exact_matmuls(_consensus_core))`` —
+        a function handed around inside traced code ends up traced)."""
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                names.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                root = _dotted(node.func.value)
+                if root in ("self", "cls"):
+                    names.add(node.func.attr)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+        return names
+
+    def _compute_traced(self) -> None:
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.funcs:
+            by_name.setdefault(fn.name, []).append(fn)
+        roots = self._traced_root_names()
+        for fn in self.funcs:
+            if fn.lineno in self.marker_host:
+                continue
+            if (self.traced_module or fn.name in roots
+                    or fn.lineno in self.marker_traced
+                    or self._has_trace_decorator(fn)):
+                self.traced.add(fn)
+        # nested defs + same-module call closure
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                for child in ast.walk(fn):
+                    if (isinstance(child, _FuncNode) and child is not fn
+                            and child not in self.traced
+                            and child.lineno not in self.marker_host):
+                        self.traced.add(child)
+                        changed = True
+                for callee in self._calls_in(fn):
+                    for target in by_name.get(callee, []):
+                        if (target not in self.traced
+                                and target.lineno not in self.marker_host):
+                            self.traced.add(target)
+                            changed = True
+
+    def _has_trace_decorator(self, fn: ast.AST) -> bool:
+        for dec in fn.decorator_list:
+            expr = dec.func if isinstance(dec, ast.Call) else dec
+            if self._is_wrapper(expr):
+                return True
+            if isinstance(dec, ast.Call):
+                dotted = self.aliases.canon(_dotted(dec.func)) or ""
+                if dotted.split(".")[-1] == "partial" and dec.args \
+                        and self._is_wrapper(dec.args[0]):
+                    return True
+        return False
+
+    def enclosing_traced(self, node: ast.AST) -> bool:
+        cur = node
+        while cur is not None:
+            if isinstance(cur, _FuncNode):
+                return cur in self.traced
+            cur = self._parents.get(cur)
+        return False
+
+
+# -- individual rules -----------------------------------------------------
+
+
+def _walk_scope(fn: ast.AST):
+    """Walk ``fn``'s body WITHOUT descending into nested ``def``s — each
+    function is its own rule scope (nested defs are visited by their own
+    pass), so findings are never double-reported. Lambdas stay in scope."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FuncNode):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _srcline(mod: _Module, node: ast.AST) -> str:
+    lines = mod.text.splitlines()
+    i = getattr(node, "lineno", 0)
+    return lines[i - 1].strip() if 0 < i <= len(lines) else ""
+
+
+def _mk(mod: _Module, node: ast.AST, rule: str, message: str) -> Finding:
+    sev = RULES[rule][0]
+    return Finding(rule=rule, path=mod.path,
+                   line=getattr(node, "lineno", 0), message=message,
+                   severity=sev, snippet=_srcline(mod, node))
+
+
+def _rule_host_sync(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            parts = dotted.split(".")
+            if dotted == "jax.device_get" or (
+                    parts[0] == "numpy" and parts[-1] in _NP_SYNC_CALLS):
+                yield _mk(mod, node, "CL101",
+                          f"'{dotted}' forces a device sync / host "
+                          f"round-trip inside traced function "
+                          f"'{fn.name}'")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ATTR_SYNC_CALLS
+                    and not dotted.startswith(("numpy.", "jax.numpy."))):
+                yield _mk(mod, node, "CL101",
+                          f"'.{node.func.attr}()' synchronizes with the "
+                          f"device inside traced function '{fn.name}'")
+
+
+def _has_traced_value_call(mod: _Module, expr: ast.AST) -> Optional[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if dotted.startswith(("jax.numpy.", "jax.lax.", "jax.random.")) \
+                    and dotted.split(".")[-1] not in _STATIC_SAFE_CALLS:
+                return dotted
+    return None
+
+
+def _rule_traced_branch(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        for node in _walk_scope(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                hit = _has_traced_value_call(mod, node.test)
+                if hit:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield _mk(mod, node, "CL102",
+                              f"Python '{kind}' on traced value "
+                              f"('{hit}') in '{fn.name}' — use lax.cond"
+                              f"/jnp.where/lax.while_loop")
+
+
+def _assigned_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def _rule_key_reuse(mod: _Module) -> Iterable[Finding]:
+    # scoped per function (module-level reuse is vanishingly rare here)
+    for fn in mod.funcs:
+        uses: Dict[str, List[ast.Call]] = {}
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if not dotted.startswith("jax.random."):
+                continue
+            name = dotted.split(".")[-1]
+            if name in _KEY_DERIVERS:
+                continue
+            key_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "key"), None)
+            if isinstance(key_arg, ast.Name):
+                uses.setdefault(key_arg.id, []).append(node)
+        if not uses:
+            continue
+        reassigned = _assigned_names(fn)
+        for key_name, sites in uses.items():
+            if len(sites) > 1 and key_name not in reassigned:
+                for site in sites[1:]:
+                    yield _mk(mod, site, "CL103",
+                              f"PRNG key '{key_name}' consumed by "
+                              f"multiple jax.random draws in '{fn.name}' "
+                              f"— split it first")
+
+
+def _rule_f64_in_kernel(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        for node in _walk_scope(fn):
+            if isinstance(node, ast.Attribute):
+                dotted = mod.aliases.canon(_dotted(node)) or ""
+                if dotted in ("jax.numpy.float64", "numpy.float64",
+                              "jax.numpy.complex128"):
+                    yield _mk(mod, node, "CL104",
+                              f"'{dotted}' inside traced function "
+                              f"'{fn.name}' (kernels are f32/bf16)")
+            elif (isinstance(node, ast.Constant)
+                    and node.value == "float64"):
+                yield _mk(mod, node, "CL104",
+                          f"dtype string 'float64' inside traced "
+                          f"function '{fn.name}'")
+
+
+def _rule_weak_where(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call) or len(node.args) != 3:
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if dotted != "jax.numpy.where":
+                continue
+            a, b = node.args[1], node.args[2]
+            if (isinstance(a, ast.Constant) and isinstance(b, ast.Constant)
+                    and isinstance(a.value, float)
+                    and isinstance(b.value, float)):
+                yield _mk(mod, node, "CL105",
+                          f"both branches of jnp.where in '{fn.name}' "
+                          f"are weak Python floats — anchor one to an "
+                          f"array dtype or the result promotes to the "
+                          f"default float (f64 on x64 hosts)")
+
+
+def _rule_mutable_default(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.funcs:
+        for default in list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                yield _mk(mod, default, "CL201",
+                          f"mutable default argument in '{fn.name}'")
+
+
+def _rule_bare_except(mod: _Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield _mk(mod, node, "CL202",
+                      "bare 'except:' swallows KeyboardInterrupt/"
+                      "SystemExit — name the exception")
+
+
+def _rule_unused_import(mod: _Module) -> Iterable[Finding]:
+    if pathlib.PurePath(mod.path).name == "__init__.py":
+        return                        # re-export surface
+    bound: Dict[str, ast.AST] = {}
+    for node in mod.tree.body:        # module level only
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound[a.asname or a.name.split(".")[0]] = node
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    bound[a.asname or a.name] = node
+        elif isinstance(node, ast.Try):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Import):
+                    for a in sub.names:
+                        bound[a.asname or a.name.split(".")[0]] = sub
+                elif isinstance(sub, ast.ImportFrom) \
+                        and sub.module != "__future__":
+                    for a in sub.names:
+                        if a.name != "*":
+                            bound[a.asname or a.name] = sub
+    if not bound:
+        return
+    used: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)      # __all__ strings, doctest refs
+    for name, node in bound.items():
+        if name not in used:
+            yield _mk(mod, node, "CL203",
+                      f"import '{name}' is never used")
+
+
+_ALL_RULES = (
+    _rule_host_sync, _rule_traced_branch, _rule_key_reuse,
+    _rule_f64_in_kernel, _rule_weak_where, _rule_mutable_default,
+    _rule_bare_except, _rule_unused_import,
+)
+
+
+# -- driver ---------------------------------------------------------------
+
+
+def lint_file(path, rel_path: Optional[str] = None,
+              select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source file; returns findings sorted by line."""
+    p = pathlib.Path(path)
+    text = p.read_text(encoding="utf-8")
+    rel = rel_path if rel_path is not None else p.name
+    try:
+        tree = ast.parse(text, filename=str(p))
+    except SyntaxError as e:
+        return [Finding(rule="CL000", path=rel, line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}", severity="error",
+                        snippet="")]
+    mod = _Module(rel, text, tree)
+    directives = _line_directives(text)
+    out: List[Finding] = []
+    for rule_fn in _ALL_RULES:
+        for f in rule_fn(mod):
+            if select and f.rule not in select:
+                continue
+            suppressed = directives.get(f.line, set())
+            if "*" in suppressed or f.rule in suppressed:
+                continue
+            out.append(f)
+    return sorted(out, key=lambda f: (f.line, f.rule))
+
+
+def default_scan_root() -> pathlib.Path:
+    """The package's parent directory — paths are reported relative to it
+    (``pyconsensus_tpu/...``), stable across checkouts and installs."""
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def default_paths() -> List[pathlib.Path]:
+    return [pathlib.Path(__file__).resolve().parents[1]]
+
+
+def scan_targets(paths=None, root: Optional[pathlib.Path] = None
+                 ) -> List[Tuple[pathlib.Path, str]]:
+    """Resolve ``paths`` (files or directories, default: the installed
+    pyconsensus_tpu package) to ``[(file, repo-relative posix path)]`` —
+    the scope a run actually covers, which the baseline updater uses to
+    preserve accepted entries OUTSIDE a restricted run's scope."""
+    root = root or default_scan_root()
+    targets = [pathlib.Path(p) for p in (paths or default_paths())]
+    files: List[pathlib.Path] = []
+    for t in targets:
+        if t.is_dir():
+            files.extend(sorted(t.rglob("*.py")))
+        elif t.suffix == ".py":
+            files.append(t)
+    out = []
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.name
+        out.append((f, rel))
+    return out
+
+
+def lint_paths(paths=None, root: Optional[pathlib.Path] = None,
+               select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint ``paths`` (files or directories, default: the installed
+    pyconsensus_tpu package). Findings are sorted by (path, line)."""
+    out: List[Finding] = []
+    for f, rel in scan_targets(paths, root):
+        out.extend(lint_file(f, rel_path=rel, select=select))
+    return sorted(out, key=lambda x: (x.path, x.line, x.rule))

@@ -21,6 +21,11 @@ anchored in BASELINE.json). Design rules, per SURVEY.md §7 M0:
 
 from __future__ import annotations
 
+# consensus-lint: traced-module — every function here is device
+# kernel code compiled into jitted callers; host-sync calls and
+# f64 literals are lint errors throughout (docs/STATIC_ANALYSIS.md)
+
+
 from typing import Optional
 
 
@@ -99,8 +104,13 @@ def canon_sign(v: jnp.ndarray) -> jnp.ndarray:
 
 
 def catch(x: jnp.ndarray, tolerance) -> jnp.ndarray:
-    """Snap toward {0, 0.5, 1} (numpy_kernels.catch)."""
-    return jnp.where(x < 0.5 - tolerance, 0.0, jnp.where(x > 0.5 + tolerance, 1.0, 0.5))
+    """Snap toward {0, 0.5, 1} (numpy_kernels.catch). The 0.5 branch is
+    anchored to ``x.dtype``: an all-weak-scalar ``jnp.where`` promotes to
+    the DEFAULT float dtype, which silently widened f32 inputs to f64 on
+    x64 hosts (consensus-lint CL104's bug class)."""
+    return jnp.where(x < 0.5 - tolerance, 0.0,
+                     jnp.where(x > 0.5 + tolerance, 1.0,
+                               jnp.asarray(0.5, x.dtype)))
 
 
 def row_any(mask, dtype):

@@ -29,6 +29,11 @@ contribute exactly 0 to ``y`` (t on a zero row is finite, and rep=0 zeroes
 the second contraction). :func:`_pad_rows` does this.
 """
 
+# consensus-lint: traced-module — every function here is device
+# kernel code compiled into jitted callers; host-sync calls and
+# f64 literals are lint errors throughout (docs/STATIC_ANALYSIS.md)
+
+
 from __future__ import annotations
 
 import functools
@@ -1071,8 +1076,13 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
     full_mean = fmn / jnp.where(ft == 0.0, 1.0, ft)
     means = jnp.where(tw > 0.0,
                       numer / jnp.where(tw > 0.0, tw, 1.0), full_mean)
+    # the inner where's branches must anchor to f32: two weak Python
+    # scalars promote to the DEFAULT float dtype, which under an x64
+    # host (the CPU interpret test environment) is f64 — a dtype this
+    # kernel's output refs reject (consensus-lint CL104's bug class)
     out = jnp.where(means < 0.5 - tolerance, 0.0,
-                    jnp.where(means > 0.5 + tolerance, 1.0, 0.5))
+                    jnp.where(means > 0.5 + tolerance, 1.0,
+                              jnp.asarray(0.5, f32)))
     raw_ref[:] = means
     out_ref[:] = out
 

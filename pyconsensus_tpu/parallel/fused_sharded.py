@@ -48,6 +48,7 @@ from ..models.pipeline import ConsensusParams, _fill_stats, _masked_mu
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 from .mesh import Mesh
+from .ring import shard_map
 
 __all__ = ["fused_sharded_consensus"]
 
@@ -414,16 +415,13 @@ def _build(mesh: Mesh, p: ConsensusParams, interpret: bool, n_valid: int,
         def body(x_blk, rep, seed, base_unit):
             return _local_consensus(x_blk, rep, seed, base_unit, None, **kw)
         in_specs = (P(None, "event"), P(), P("event"), P("event"))
-    fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        # replication of the P() outputs is established by explicit psums;
-        # shard_map's static rep-checker cannot see through the Pallas
-        # custom calls, so the check is disabled rather than fought
-        check_vma=False,
-    )
+    # replication of the P() outputs is established by explicit psums;
+    # shard_map's static rep-checker cannot see through the Pallas
+    # custom calls, so the check is disabled rather than fought (the
+    # ring module's wrapper also papers over the jax.shard_map /
+    # jax.experimental.shard_map location and check_vma/check_rep
+    # spelling differences across jax versions)
+    fn = shard_map(body, mesh, in_specs, out_specs)
     return jax.jit(fn)
 
 

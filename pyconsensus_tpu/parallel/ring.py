@@ -31,9 +31,6 @@ TPU-native surface.
 
 from __future__ import annotations
 
-from functools import partial
-
-import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -57,6 +54,16 @@ def shard_map(f, mesh, in_specs, out_specs):
 __all__ = ["ring_allreduce", "ring_gram", "ring_first_pc", "ring_matvec"]
 
 
+def _axis_size(axis_name) -> int:
+    """Static mesh-axis extent inside shard_map — ``lax.axis_size`` where
+    the jax version has it, else the core axis-env lookup it wraps."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax._src import core
+
+    return int(core.axis_frame(axis_name))
+
+
 def _ring_perm(n: int):
     """Neighbor permutation i -> i+1 (mod n): one hop around the ICI ring."""
     return [(i, (i + 1) % n) for i in range(n)]
@@ -74,7 +81,7 @@ def ring_allreduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     Must run inside ``shard_map`` with ``axis_name`` bound. ``x`` is padded
     up to a multiple of n on the leading axis internally.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return x
     idx = lax.axis_index(axis_name)

@@ -1,0 +1,422 @@
+"""consensus-lint's own tests: a fixture corpus of minimal snippets that
+must (and must NOT) trigger each Layer-1 rule, text-level checks of the
+Layer-2 contract machinery on crafted HLO, the CLI's exit-code/baseline
+workflow, and the shipped-baseline-matches-tree invariant."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from pyconsensus_tpu.analysis import (Finding, fingerprints, lint_paths,
+                                      load_baseline, match_baseline)
+from pyconsensus_tpu.analysis.baseline import save_baseline
+from pyconsensus_tpu.analysis.cli import run as cli_run
+from pyconsensus_tpu.analysis.contracts import (check_artifact,
+                                                check_collective_budget,
+                                                collective_inventory,
+                                                collective_sizes, f64_ops,
+                                                host_callbacks,
+                                                load_contracts, run_contracts)
+from pyconsensus_tpu.analysis.rules import RULES, lint_file
+
+# ---------------------------------------------------------------- Layer 1
+
+#: per rule: (snippet that MUST trigger it, snippet that must NOT)
+CORPUS = {
+    "CL101": (
+        """
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+        """,
+        """
+        import numpy as np
+        def host(x):
+            return np.asarray(x)
+        """,
+    ),
+    "CL102": (
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def g(x):
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return x
+            return jnp.where(jnp.any(x > 0), x, -x)
+        """,
+    ),
+    "CL103": (
+        """
+        import jax
+        def bad(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """,
+        """
+        import jax
+        def good(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.normal(k1, (3,)) + jax.random.uniform(k2, (3,))
+        def loop(key):
+            for _ in range(3):
+                key, sub = jax.random.split(key)
+                x = jax.random.normal(sub, (3,))
+            return x
+        """,
+    ),
+    "CL104": (
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float64)
+        """,
+        """
+        import numpy as np
+        def reference(x):
+            return np.asarray(x, dtype=np.float64)
+        """,
+    ),
+    "CL105": (
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return jnp.where(x > 0, 1.0, 0.5)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def g(x):
+            return jnp.where(x > 0, 1.0, jnp.asarray(0.5, x.dtype))
+        """,
+    ),
+    "CL201": (
+        "def f(a, b=[]):\n    return a\n",
+        "def f(a, b=()):\n    return a\n",
+    ),
+    "CL202": (
+        "def f(a):\n    try:\n        return a\n    except:\n        pass\n",
+        "def f(a):\n    try:\n        return a\n    except ValueError:\n"
+        "        pass\n",
+    ),
+    "CL203": (
+        "import os\nX = 1\n",
+        "import os\nX = os.sep\n",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_rule_triggers_and_stays_silent(rule, tmp_path):
+    pos_src, neg_src = CORPUS[rule]
+    pos = tmp_path / "pos.py"
+    pos.write_text(textwrap.dedent(pos_src))
+    neg = tmp_path / "neg.py"
+    neg.write_text(textwrap.dedent(neg_src))
+    assert rule in {f.rule for f in lint_file(pos, rel_path="pos.py")}, (
+        f"{rule} did not fire on its positive snippet")
+    assert rule not in {f.rule for f in lint_file(neg, rel_path="neg.py")}, (
+        f"{rule} fired on its negative snippet")
+
+
+def test_suppression_comment(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text(textwrap.dedent("""
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            return np.asarray(x)  # consensus-lint: disable=CL101
+        """))
+    assert lint_file(p, rel_path="s.py") == []
+
+
+def test_traced_module_pragma(tmp_path):
+    p = tmp_path / "k.py"
+    p.write_text(textwrap.dedent("""
+        # consensus-lint: traced-module
+        import numpy as np
+        def plain_function(x):
+            return np.asarray(x)
+        def host_helper(x):  # consensus-lint: host
+            return np.asarray(x)
+        """))
+    rules = [f.rule for f in lint_file(p, rel_path="k.py")]
+    assert rules == ["CL101"], rules        # only the unmarked function
+
+
+def test_composition_closure(tmp_path):
+    """jax.jit(wrap(fn)) and lax.scan(step, ...) both mark fn traced."""
+    p = tmp_path / "c.py"
+    p.write_text(textwrap.dedent("""
+        import jax, numpy as np
+        from jax import lax
+        def wrap(f):
+            return f
+        def core(x):
+            return np.asarray(x)
+        def step(carry, _):
+            return np.asarray(carry), None
+        core_jit = jax.jit(wrap(core))
+        def driver(xs):
+            return lax.scan(step, 0.0, xs)
+        """))
+    found = {f.message.split("'")[3] for f in lint_file(p, rel_path="c.py")
+             if f.rule == "CL101"}
+    assert found == {"core", "step"}
+
+
+def test_fingerprints_stable_across_line_shifts(tmp_path):
+    src = textwrap.dedent("""
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+        """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    fp1 = fingerprints(lint_file(p, rel_path="m.py"))
+    p.write_text("# a new comment line\n# another\n" + src)
+    fp2 = fingerprints(lint_file(p, rel_path="m.py"))
+    assert fp1 == fp2
+
+
+def test_every_rule_has_corpus_coverage():
+    assert set(CORPUS) == set(RULES)
+
+
+# ------------------------------------------------------- baseline workflow
+
+def test_shipped_baseline_exactly_matches_tree():
+    """The checked-in baseline accepts the CURRENT tree exactly: no new
+    findings (CI would be red) and no stale Layer-1 entries (the file
+    rotted). Accepted ``contract:*`` entries are out of scope here — this
+    test runs Layer 1 only, so it cannot observe them; the full check is
+    `consensus-lint --strict` in tools/ci_rehearsal.sh."""
+    baseline = load_baseline()
+    findings = lint_paths()
+    new, matched, stale = match_baseline(findings, baseline)
+    assert new == [], ("tree has non-baselined findings:\n"
+                       + "\n".join(f.render() for f in new))
+    contract_fps = {e["fingerprint"] for e in baseline.get("findings", [])
+                    if e["path"].startswith("contract:")}
+    stale = [fp for fp in stale if fp not in contract_fps]
+    assert stale == [], f"baseline entries no longer match the tree: {stale}"
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = Finding(rule="CL201", path="x.py", line=3, message="m",
+                severity="warning", snippet="def f(a, b=[]):")
+    bl = tmp_path / "bl.json"
+    save_baseline([f], path=bl, reason="test rationale")
+    doc = json.loads(bl.read_text())
+    assert doc["findings"][0]["reason"] == "test rationale"
+    new, matched, stale = match_baseline([f], load_baseline(bl))
+    assert (new, len(matched), stale) == ([], 1, [])
+    # a DIFFERENT finding is new; the old entry goes stale
+    g = Finding(rule="CL202", path="x.py", line=9, message="m2",
+                severity="warning", snippet="except:")
+    new, matched, stale = match_baseline([g], load_baseline(bl))
+    assert len(new) == 1 and matched == [] and len(stale) == 1
+
+
+# -------------------------------------------------- Layer 2 text checkers
+
+_SHARDED_BUDGET = {"require_all_reduce": True, "all_reduce_max": "4*R + 8",
+                   "other_max": "E"}
+_ENV = {"R": 32, "E": 2048, "n_dev": 8}
+
+
+def test_collective_inventory_parses_tuples_and_dtypes():
+    hlo = "\n".join([
+        "  %ar = f32[32]{0} all-reduce(f32[32]{0} %p)",
+        "  %t = (f32[32]{0}, f32[8]{0}) all-reduce(f32[32] %a, f32[8] %b)",
+        "  %bits = u32[2048]{0} all-reduce(u32[2048]{0} %x)",
+        "  %ag = f32[2048]{0} all-gather(f32[256]{0} %y)",
+    ])
+    inv = collective_inventory(hlo)
+    assert (("all-reduce", frozenset({"f32"}), 32) in inv)
+    assert (("all-reduce", frozenset({"f32"}), 40) in inv)   # tuple summed
+    assert (("all-reduce", frozenset({"u32"}), 2048) in inv)
+    assert collective_sizes(hlo)["all-gather"] == [2048]
+
+
+def test_inventory_handles_fp8_and_annotation_tokens():
+    """fp8 dtype names must be counted (a silent 0-element inventory
+    would wave a matrix-sized collective through every budget), and
+    digit-free annotation tokens like devices=[8] must NOT be."""
+    hlo = ("  %ag = f8e4m3fn[32,2048]{1,0} all-gather("
+           "f8e4m3fn[32,256]{1,0} %x), sharding={devices=[8]0,1,2,3,4,5,6,7}")
+    inv = collective_inventory(hlo)
+    assert inv == [("all-gather", frozenset({"f8e4m3fn"}), 32 * 2048)]
+    out = check_collective_budget(inv, _SHARDED_BUDGET, _ENV)
+    assert any("matrix-sized" in v or "all-gather" in v for v in out)
+
+
+def test_budget_passes_the_contract_shape():
+    hlo = ("  %ar = f32[32]{0} all-reduce(f32[32]{0} %p)\n"
+           "  %bits = u32[2048]{0} all-reduce(u32[2048]{0} %x)\n"
+           "  %ag = f32[2048]{0} all-gather(f32[256]{0} %y)")
+    assert check_collective_budget(collective_inventory(hlo),
+                                   _SHARDED_BUDGET, _ENV) == []
+
+
+def test_budget_flags_seeded_violations():
+    matrix = "  %ag = f32[32,2048]{1,0} all-gather(f32[32,256]{1,0} %x)"
+    out = check_collective_budget(collective_inventory(matrix),
+                                  dict(_SHARDED_BUDGET,
+                                       require_all_reduce=False), _ENV)
+    assert any("all-gather" in v for v in out)
+    fat_ar = "  %ar = f32[2048]{0} all-reduce(f32[2048]{0} %p)"
+    out = check_collective_budget(collective_inventory(fat_ar),
+                                  _SHARDED_BUDGET, _ENV)
+    assert any("float all-reduce" in v for v in out)
+    out = check_collective_budget([], {"forbid_collectives": True}, _ENV)
+    assert out == []
+    out = check_collective_budget(
+        collective_inventory(fat_ar), {"forbid_collectives": True}, _ENV)
+    assert any("collective-free" in v for v in out)
+
+
+def test_f64_and_callback_detectors():
+    hlo = ("  %m = f64[32]{0} multiply(f64[32]{0} %a, f64[32]{0} %b)\n"
+           "  %cc = f32[2]{0} custom-call(f32[2]{0} %x), "
+           "custom_call_target=\"xla_python_cpu_callback\"\n"
+           "  %ok = f32[2]{0} add(f32[2]{0} %x, f32[2]{0} %y)")
+    assert len(f64_ops(hlo)) == 1
+    assert len(host_callbacks(hlo)) == 1
+    assert f64_ops("  %ok = f32[2] add(f32[2] %x, f32[2] %y)") == []
+
+
+def test_check_artifact_reports_findings():
+    spec = {"name": "t", "shape": {"R": 32, "E": 2048},
+            "mesh": {"batch": 1, "event": 8},
+            "budget": dict(_SHARDED_BUDGET)}
+    bad = "  %ar = f32[65536]{0} all-reduce(f32[65536]{0} %p)"
+    rules = {f.rule for f in check_artifact("t", bad, spec)}
+    assert "CL301" in rules
+    cb = ("  %ar = f32[32]{0} all-reduce(f32[32]{0} %p)\n"
+          "  %cc = f32[2]{0} custom-call(f32[2]{0} %x), "
+          "custom_call_target=\"xla_python_cpu_callback\"")
+    rules = {f.rule for f in check_artifact("t", cb, spec)}
+    assert "CL303" in rules
+
+
+# ------------------------------------------------------ Layer 2 live runs
+
+def test_declared_contracts_are_wellformed():
+    names = [c["name"] for c in load_contracts()]
+    assert len(names) == len(set(names))
+    from pyconsensus_tpu.analysis.contracts import BUILDERS
+    for c in load_contracts():
+        assert c["builder"] in BUILDERS, c["name"]
+
+
+def test_single_device_contract_holds_live():
+    """One cheap end-to-end contract run in-process (the full set runs in
+    CI via `consensus-lint --strict`)."""
+    assert run_contracts(names=["pipeline-single-device"]) == []
+
+
+def test_retrace_contract_holds_live():
+    assert run_contracts(names=["pipeline-retrace-budget"]) == []
+
+
+# ---------------------------------------------------------------- CLI
+
+def test_cli_exit_codes_and_baseline_update(tmp_path, capsys):
+    src = tmp_path / "mod.py"
+    src.write_text(textwrap.dedent("""
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+        """))
+    bl = tmp_path / "bl.json"
+    # new finding, empty baseline -> exit 1
+    assert cli_run([str(src), "--baseline", str(bl)]) == 1
+    # accept it -> exit 0 afterwards
+    assert cli_run([str(src), "--baseline", str(bl),
+                    "--update-baseline"]) == 0
+    assert cli_run([str(src), "--baseline", str(bl)]) == 0
+    # fix the code -> stale entry fails only --strict (without contracts)
+    src.write_text("X = 1\n")
+    assert cli_run([str(src), "--baseline", str(bl)]) == 0
+    assert cli_run([str(src), "--baseline", str(bl), "--strict",
+                    "--no-contracts"]) == 1
+    out = capsys.readouterr().out
+    assert "stale baseline" in out
+
+
+def test_update_baseline_preserves_out_of_scope_entries(tmp_path):
+    """A path-restricted or contracts-off --update-baseline run must not
+    delete accepted entries it could not have reproduced."""
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f(a, b=[]):\n    return a\n")
+    bl = tmp_path / "bl.json"
+    # seed the baseline with an accepted contract finding + a finding in
+    # ANOTHER file, each with a rationale
+    bl.write_text(json.dumps({"version": 1, "findings": [
+        {"fingerprint": "CL301:contract:x:deadbeef", "rule": "CL301",
+         "path": "contract:x", "message": "m", "reason": "accepted: gram"},
+        {"fingerprint": "CL202:other.py:cafebabe", "rule": "CL202",
+         "path": "other.py", "message": "m", "reason": "accepted: legacy"},
+    ]}))
+    assert cli_run([str(mod), "--baseline", str(bl),
+                    "--update-baseline"]) == 0
+    kept = {e["fingerprint"]: e for e in json.loads(bl.read_text())["findings"]}
+    assert "CL301:contract:x:deadbeef" in kept          # contracts didn't run
+    assert "CL202:other.py:cafebabe" in kept            # file not in scope
+    assert kept["CL301:contract:x:deadbeef"]["reason"] == "accepted: gram"
+    assert any(e.startswith("CL201:mod.py:") for e in kept)  # new accept
+
+
+def test_strict_stale_is_scoped_to_the_run(tmp_path):
+    """Out-of-scope baseline entries (other files, contract findings when
+    Layer 2 didn't run) are not 'stale' — only a run that could have
+    reproduced an entry may fail on its absence."""
+    mod = tmp_path / "mod.py"
+    mod.write_text("X = 1\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "findings": [
+        {"fingerprint": "CL301:contract:x:deadbeef", "rule": "CL301",
+         "path": "contract:x", "message": "m", "reason": "accepted"},
+        {"fingerprint": "CL202:other.py:cafebabe", "rule": "CL202",
+         "path": "other.py", "message": "m", "reason": "accepted"},
+    ]}))
+    assert cli_run([str(mod), "--baseline", str(bl), "--strict",
+                    "--no-contracts"]) == 0
+
+
+def test_cli_list_rules(capsys):
+    assert cli_run(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in list(RULES) + ["CL300", "CL301", "CL302", "CL303", "CL304"]:
+        assert rid in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    src = tmp_path / "mod.py"
+    src.write_text("def f(a, b=[]):\n    return a\n")
+    rc = cli_run([str(src), "--format", "json", "--no-baseline"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["new"][0]["rule"] == "CL201"
+    assert "fingerprint" in payload["new"][0]

@@ -10,7 +10,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 from conftest import free_port, worker_env
 from pyconsensus_tpu import Oracle

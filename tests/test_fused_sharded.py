@@ -6,7 +6,6 @@ patterns, iteration counts, and mesh widths, on the 8-virtual-device CPU
 mesh with the Pallas kernels in interpret mode."""
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 

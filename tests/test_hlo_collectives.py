@@ -4,21 +4,25 @@ costs (docs/PERFORMANCE.md "Scaling design"; VERDICT r1 item 5).
 The scaling claim is: on an event-sharded mesh, per-sweep all-reduces move
 only (R,)-sized partials, and no collective ever carries an O(R x E) or
 R x R operand. These tests compile the real jitted pipeline on the virtual
-8-device CPU mesh, parse the optimized (post-GSPMD-partitioning) HLO, and
-bound every collective's operand size — a regression that re-introduces a
-matrix-sized collective fails here rather than silently degrading the
-multi-chip path. This caught a real one: the blocked weighted median's
-``dynamic_slice`` over the sharded event axis made GSPMD all-gather the
-full (R, E) matrix onto every device (fixed by ``median_block=0`` on
-multi-device meshes plus take_along_axis indexing in the median block).
+8-device CPU mesh and check the optimized (post-GSPMD-partitioning) HLO
+against the SAME declared budgets the ``consensus-lint`` traced-contract
+layer enforces in CI (``pyconsensus_tpu.analysis.contracts`` +
+``contracts.json`` — the single source of truth for collective
+inventories; this file's original private helpers became that module).
+This caught a real one: the blocked weighted median's ``dynamic_slice``
+over the sharded event axis made GSPMD all-gather the full (R, E) matrix
+onto every device (fixed by ``median_block=0`` on multi-device meshes
+plus take_along_axis indexing in the median block).
 """
-
-import re
 
 import jax
 import numpy as np
 import pytest
 
+from pyconsensus_tpu.analysis.contracts import (check_collective_budget,
+                                                collective_inventory,
+                                                collective_sizes,
+                                                load_contracts)
 from pyconsensus_tpu.models.pipeline import (ConsensusParams,
                                              consensus_light_jit)
 from pyconsensus_tpu.oracle import parse_event_bounds
@@ -29,26 +33,17 @@ R, E = 32, 2048
 N_DEV = 8
 N_SCALED = 256
 
-_COLLECTIVE_RE = re.compile(
-    r"= ([^=]*?) (all-reduce|all-gather|reduce-scatter|all-to-all|"
-    r"collective-permute)(?:-start)?\(")
-_DIMS_RE = re.compile(r"\[([0-9,]*)\]")
+#: the lint subsystem's declared budgets, keyed by contract name — the
+#: tests below assert against THESE, so a budget edit and a pipeline
+#: regression both surface here and in `consensus-lint --strict` alike
+_CONTRACTS = {c["name"]: c for c in load_contracts()}
 
 
-def collective_sizes(hlo_text):
-    """{op_kind: [operand element counts]} for every collective instruction
-    in the compiled HLO (tuple-shaped outputs are summed — the tuple is one
-    fused collective's payload)."""
-    out = {}
-    for line in hlo_text.splitlines():
-        m = _COLLECTIVE_RE.search(line.strip())
-        if m:
-            shape, op = m.group(1), m.group(2)
-            elems = sum(
-                int(np.prod([int(d) for d in dims.split(",")])) if dims else 1
-                for dims in _DIMS_RE.findall(shape))
-            out.setdefault(op, []).append(elems)
-    return out
+def _check(hlo_text, contract_name, R_=R, E_=E, n_dev=N_DEV):
+    budget = _CONTRACTS[contract_name]["budget"]
+    env = {"R": R_, "E": E_, "n_dev": n_dev}
+    return check_collective_budget(collective_inventory(hlo_text), budget,
+                                   env)
 
 
 def compiled_hlo(reports, bounds, params):
@@ -65,37 +60,26 @@ def binary_reports(request):
     return rng.choice([0.0, 1.0], size=(R, E))
 
 
-def assert_bounded(sizes):
-    """The invariants every sharded compilation must satisfy."""
-    # sanity: the path is actually sharded — sweeps DO all-reduce partials
-    assert sizes.get("all-reduce"), "no all-reduce at all: not sharded?"
-    # per-sweep reductions move (R,)-sized partials (+ fused scalars);
-    # anything R x R (Gram) or (R, E/n_dev) (matrix shard) is a regression
-    biggest_ar = max(sizes["all-reduce"])
-    assert biggest_ar <= 4 * R + 8, (
-        f"all-reduce moving {biggest_ar} elements — the per-sweep "
-        f"collective should carry only (R,)={R} partials")
-    # the one admitted large gather is the final (E,) loading; index or
-    # matrix gathers above that are a partitioning regression
-    for op in ("all-gather", "reduce-scatter", "all-to-all",
-               "collective-permute"):
-        for n in sizes.get(op, []):
-            assert n <= E, (
-                f"{op} moving {n} elements (> E={E}): an event-sharded "
-                f"operand is being re-assembled across the mesh")
-    # absolute backstop: nothing within 2x of one matrix shard
-    shard_elems = R * E // N_DEV
-    for op, ns in sizes.items():
-        assert max(ns) < shard_elems // 2, (
-            f"{op} moving {max(ns)} elements — matrix-sized collective")
+class TestSharedHelpers:
+    def test_sizes_view_matches_inventory(self, binary_reports):
+        """collective_sizes is the dtype-blind projection of
+        collective_inventory — same instructions, same element counts."""
+        p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                            has_na=False, any_scaled=False, median_block=0)
+        hlo = compiled_hlo(binary_reports, None, p)
+        inv = collective_inventory(hlo)
+        sizes = collective_sizes(hlo)
+        assert sorted(n for _, _, n in inv) == sorted(
+            n for ns in sizes.values() for n in ns)
+        assert inv, "sharded compile must contain collectives"
 
 
 class TestShardedCollectiveCosts:
     def test_binary_power_path(self, binary_reports):
         p = ConsensusParams(algorithm="sztorc", pca_method="power",
                             has_na=False, any_scaled=False, median_block=0)
-        sizes = collective_sizes(compiled_hlo(binary_reports, None, p))
-        assert_bounded(sizes)
+        hlo = compiled_hlo(binary_reports, None, p)
+        assert _check(hlo, "pipeline-binary-power-sharded") == []
 
     def test_scaled_power_path(self, binary_reports):
         """The scaled-event resolution (weighted median) must not change the
@@ -109,9 +93,10 @@ class TestShardedCollectiveCosts:
                   + [{"scaled": True, "min": 0.0, "max": 50.0}] * N_SCALED)
         p = ConsensusParams(algorithm="sztorc", pca_method="power",
                             has_na=False, any_scaled=True, median_block=0)
-        sizes = collective_sizes(compiled_hlo(reports, bounds, p))
-        assert_bounded(sizes)
+        hlo = compiled_hlo(reports, bounds, p)
+        assert _check(hlo, "pipeline-scaled-power-sharded") == []
         # scaled resolution adds NO collectives beyond the binary path's
+        sizes = collective_sizes(hlo)
         binary = collective_sizes(compiled_hlo(
             binary_reports, None,
             ConsensusParams(algorithm="sztorc", pca_method="power",
@@ -124,21 +109,13 @@ class TestShardedCollectiveCosts:
         multi-component fixed-variance/ICA variants) legitimately
         all-reduces ONE R x R Gram matrix per outer iteration — an
         algorithmic cost, not a regression (SURVEY.md §7 route b; at the
-        R<=4096 sizes auto picks it, that is <=64 MB over ICI). Pin that
-        it stays exactly one R x R-sized all-reduce and nothing larger."""
+        R<=4096 sizes auto picks it, that is <=64 MB over ICI). The
+        declared gram contract pins it to exactly one R x R-sized
+        all-reduce and nothing larger."""
         p = ConsensusParams(algorithm="sztorc", pca_method="eigh-gram",
                             has_na=False, any_scaled=False, median_block=0)
-        sizes = collective_sizes(compiled_hlo(binary_reports, None, p))
-        big = [n for n in sizes.get("all-reduce", []) if n > 4 * R + 8]
-        assert len(big) <= 1, f"multiple large all-reduces: {sizes}"
-        for n in big:
-            # the R x R Gram block (possibly tuple-fused with O(R) extras)
-            assert n <= R * R + 4 * R + 8, (
-                f"all-reduce of {n} elements exceeds the R x R Gram")
-        for op in ("all-gather", "reduce-scatter", "all-to-all",
-                   "collective-permute"):
-            for n in sizes.get(op, []):
-                assert n <= max(E, R * R), (op, n)
+        hlo = compiled_hlo(binary_reports, None, p)
+        assert _check(hlo, "pipeline-gram-sharded") == []
 
     def test_na_power_path(self, binary_reports):
         """NaN interpolation's column stats are event-sharded reductions
@@ -148,8 +125,16 @@ class TestShardedCollectiveCosts:
         reports[rng.random((R, E)) < 0.05] = np.nan
         p = ConsensusParams(algorithm="sztorc", pca_method="power",
                             has_na=True, any_scaled=False, median_block=0)
-        sizes = collective_sizes(compiled_hlo(reports, None, p))
-        assert_bounded(sizes)
+        hlo = compiled_hlo(reports, None, p)
+        assert _check(hlo, "pipeline-na-power-sharded") == []
+
+    def test_budget_rejects_matrix_collective(self, binary_reports):
+        """The shared checker actually rejects a seeded violation: the
+        binary budget must flag a crafted matrix-sized all-gather (the
+        infrastructure is only trustworthy if it can fail)."""
+        fake = f"  %ag = f32[{R},{E}]{{1,0}} all-gather(f32[{R},256] %x)"
+        violations = _check(fake, "pipeline-binary-power-sharded")
+        assert any("all-gather" in v for v in violations)
 
 
 class TestEffectiveMedianBlock:
@@ -200,7 +185,8 @@ class TestNorthStarShapeCollectiveCosts:
     ShapeDtypeStructs so no 4 GB matrix is ever materialized) and pin the
     same invariants where they actually matter. GSPMD's partitioning
     choices are shape-dependent; a sane toy compile does not imply a sane
-    100k-column compile."""
+    100k-column compile. The BUDGETS are the lint subsystem's declared
+    ones — only the (R, E) environment differs."""
 
     R_NS, E_NS = 10_000, 100_000
 
@@ -228,21 +214,15 @@ class TestNorthStarShapeCollectiveCosts:
         assert p.median_block == 0             # event-sharded: unblocked
         return consensus_light_jit.lower(*args, p).compile().as_text()
 
-    def _assert_bounded_ns(self, sizes):
-        assert sizes.get("all-reduce"), "not sharded?"
-        biggest_ar = max(sizes["all-reduce"])
-        assert biggest_ar <= 4 * self.R_NS + 8, (
-            f"all-reduce moving {biggest_ar} elements at north-star shape")
-        for op in ("all-gather", "reduce-scatter", "all-to-all",
-                   "collective-permute"):
-            for n in sizes.get(op, []):
-                assert n <= self.E_NS, (op, n)
+    def _assert_bounded_ns(self, hlo):
+        assert _check(hlo, "pipeline-binary-power-sharded",
+                      R_=self.R_NS, E_=self.E_NS) == []
 
     @pytest.mark.slow
     def test_binary_northstar_compile(self):
         p = ConsensusParams(algorithm="sztorc", pca_method="power",
                             has_na=True, storage_dtype="bfloat16")
-        self._assert_bounded_ns(collective_sizes(self._compile(p)))
+        self._assert_bounded_ns(self._compile(p))
 
     @pytest.mark.slow
     def test_scaled16k_northstar_compile(self):
@@ -252,8 +232,9 @@ class TestNorthStarShapeCollectiveCosts:
         where the single-chip ladder was over budget."""
         p = ConsensusParams(algorithm="sztorc", pca_method="power",
                             has_na=True, storage_dtype="bfloat16")
-        sizes = collective_sizes(self._compile(p, n_scaled=16_000))
-        self._assert_bounded_ns(sizes)
+        hlo = self._compile(p, n_scaled=16_000)
+        self._assert_bounded_ns(hlo)
+        sizes = collective_sizes(hlo)
         binary = collective_sizes(self._compile(p))
         assert sorted(sizes.keys()) == sorted(binary.keys())
         assert len(sizes["all-reduce"]) == len(binary["all-reduce"])
