@@ -1,6 +1,6 @@
 """consensus-lint — JAX/TPU-aware static analysis for pyconsensus_tpu.
 
-Two layers (docs/STATIC_ANALYSIS.md):
+Three layers (docs/STATIC_ANALYSIS.md):
 
 - **Layer 1 (AST lint, :mod:`.rules`)**: a rule engine over the package's
   own source with JAX/TPU-specific rules — host-device syncs inside
@@ -14,6 +14,15 @@ Two layers (docs/STATIC_ANALYSIS.md):
   collective inventories (generalizing tests/test_hlo_collectives.py into
   reusable infrastructure), no f64 ops, no host callbacks, and a
   retrace-count budget via jit cache stats.
+- **Layer 3 (whole-program deadlock analysis)**: :mod:`.dataflow` is an
+  interprocedural host-divergence taint pass (CL401-404) — package-wide
+  call graph + flow-sensitive def-use chains from divergent sources
+  (``process_index``, clocks, env, host RNG) to program-shaping sinks
+  (traced branches, jit static args, shard_map specs, mesh construction,
+  collective operands); :mod:`.schedule` (CL410-413) walks the jaxprs of
+  the hand-written-collective entry points and verifies cond-branch
+  collective balance, ``ppermute`` bijectivity per mesh axis, and axis
+  binding under ``shard_map``.
 
 Findings carry rule IDs, file:line and severity; a checked-in baseline
 (``baseline.json``, :mod:`.baseline`) lets the tree stay green while CI
@@ -22,13 +31,19 @@ the ``consensus-lint`` console script.
 """
 
 from .baseline import load_baseline, match_baseline, save_baseline
+from .dataflow import DATAFLOW_RULES, analyze_paths
 from .findings import Finding, fingerprints
 from .rules import RULES, lint_file, lint_paths
 from .contracts import (collective_sizes, f64_ops, host_callbacks,
                         load_contracts, run_contracts)
+from .schedule import (SCHEDULE_RULES, check_schedule, extract_schedule,
+                       run_schedules)
 
 __all__ = [
     "Finding", "fingerprints", "RULES", "lint_file", "lint_paths",
+    "DATAFLOW_RULES", "analyze_paths",
+    "SCHEDULE_RULES", "check_schedule", "extract_schedule",
+    "run_schedules",
     "collective_sizes", "f64_ops", "host_callbacks", "load_contracts",
     "run_contracts", "load_baseline", "save_baseline", "match_baseline",
 ]

@@ -2,8 +2,10 @@
 
 Usage (also ``python -m pyconsensus_tpu.analysis``):
 
-    consensus-lint                      # Layer 1 over the package
-    consensus-lint --strict             # Layer 1 + traced contracts; CI gate
+    consensus-lint                      # Layers 1 + 3a over the package
+    consensus-lint --strict             # + traced contracts (Layer 2) and
+                                        #   collective schedules (Layer 3b);
+                                        #   the CI gate
     consensus-lint path/to/file.py      # explicit targets
     consensus-lint --update-baseline    # accept the current tree
     consensus-lint --list-rules
@@ -22,6 +24,7 @@ from typing import List, Optional
 
 from .baseline import (default_baseline_path, load_baseline, match_baseline,
                        save_baseline)
+from .dataflow import DATAFLOW_RULES
 from .findings import Finding, fingerprints
 from .rules import RULES, lint_paths
 
@@ -35,16 +38,21 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="files/directories to lint (default: the "
                          "installed pyconsensus_tpu package)")
     ap.add_argument("--strict", action="store_true",
-                    help="run the traced contracts too and fail on stale "
-                         "baseline entries (the CI gate)")
+                    help="run the traced contracts and collective "
+                         "schedules too and fail on stale baseline "
+                         "entries (the CI gate)")
     ap.add_argument("--contracts", action="store_true",
-                    help="run Layer 2 traced contracts (implied by "
-                         "--strict)")
+                    help="run Layer 2 traced contracts + Layer 3b "
+                         "collective schedules (implied by --strict)")
     ap.add_argument("--no-contracts", action="store_true",
-                    help="skip Layer 2 even under --strict")
+                    help="skip the traced layers (2 and 3b) even under "
+                         "--strict")
     ap.add_argument("--contract", action="append", default=None,
                     metavar="NAME", help="run only this contract "
                                          "(repeatable)")
+    ap.add_argument("--no-dataflow", action="store_true",
+                    help="skip the Layer 3a interprocedural "
+                         "host-divergence taint analysis")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default: "
                          f"{default_baseline_path()})")
@@ -62,12 +70,20 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _list_rules() -> str:
     from .contracts import CONTRACT_RULES
+    from .dataflow import DATAFLOW_RULES
+    from .schedule import SCHEDULE_RULES
 
     lines = ["Layer 1 (AST rules):"]
     for rid, (sev, desc) in sorted(RULES.items()):
         lines.append(f"  {rid} [{sev:7s}] {desc}")
     lines.append("Layer 2 (traced contracts):")
     for rid, (sev, desc) in sorted(CONTRACT_RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
+    lines.append("Layer 3a (interprocedural host-divergence taint):")
+    for rid, (sev, desc) in sorted(DATAFLOW_RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
+    lines.append("Layer 3b (collective schedules):")
+    for rid, (sev, desc) in sorted(SCHEDULE_RULES.items()):
         lines.append(f"  {rid} [{sev:7s}] {desc}")
     return "\n".join(lines)
 
@@ -84,13 +100,31 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
               if args.select else None)
     findings: List[Finding] = lint_paths(args.paths or None, select=select)
 
+    # skip the interprocedural fixpoint entirely when --select excludes
+    # every CL40x rule (it would only discard its own findings)
+    if not args.no_dataflow and (select is None
+                                 or select & DATAFLOW_RULES.keys()):
+        from .dataflow import analyze_paths
+
+        findings.extend(analyze_paths(args.paths or None, select=select))
+
     run_contracts_layer = (args.strict or args.contracts
                            or args.contract) and not args.no_contracts
     if run_contracts_layer:
         from .contracts import ensure_cpu_devices, run_contracts
+        from .schedule import run_schedules
 
         ensure_cpu_devices()
         findings.extend(run_contracts(names=args.contract))
+        # Layer 3b rides the traced gate: the schedule targets need jax
+        # + the virtual device mesh, same environment as the contracts.
+        # --contract NAME runs are contract-focused; schedules are
+        # skipped there so their findings stay out of scope
+        run_schedules_layer = not args.contract
+        if run_schedules_layer:
+            findings.extend(run_schedules())
+    else:
+        run_schedules_layer = False
 
     if args.update_baseline:
         # preserve accepted entries this run could not have reproduced:
@@ -105,6 +139,10 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
         def preserve(entry):
             if entry["path"].startswith("contract:"):
                 return not run_contracts_layer
+            if entry["path"].startswith("schedule:"):
+                return not run_schedules_layer
+            if entry["rule"] in DATAFLOW_RULES and args.no_dataflow:
+                return True
             if entry["path"] not in scanned:
                 return True
             return bool(select) and entry["rule"] not in select
@@ -134,6 +172,10 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
                 return True
             if e["path"].startswith("contract:"):
                 return run_contracts_layer
+            if e["path"].startswith("schedule:"):
+                return run_schedules_layer
+            if e["rule"] in DATAFLOW_RULES and args.no_dataflow:
+                return False
             return e["path"] in scanned and (
                 not select or e["rule"] in select)
 

@@ -1,0 +1,713 @@
+"""Layer 3a: interprocedural host-divergence taint analysis (CL401-404).
+
+The deadliest bug class in a multi-host SPMD fleet is *host divergence*:
+every process must trace, compile, and issue the SAME program — the same
+mesh, the same specs, the same collective sequence. A value that differs
+between processes (``jax.process_index()``, a wall clock, an environment
+variable, an unseeded host RNG) is harmless while it only selects
+per-host *data* (which panels to stream, which Monte-Carlo chunks to
+compute), but the moment it reaches anything that shapes the *program* —
+a Python branch around traced/collective code, a jit static argument, a
+``shard_map`` spec, mesh construction, a collective operand (a divergent
+trace-time constant bakes a different program into each host's
+executable) — the fleet can hang with no error, each host blocked inside
+a collective its peers never issued.
+
+PR 1's Layer 1 is intra-file and syntactic; Layer 2 compiles one
+process's program. Neither can see a ``process_index()`` read in one
+module flow through three call frames into a traced branch in another.
+This pass can: it builds a package-wide call graph, runs a small
+flow-sensitive abstract interpreter over every function body (gen/kill
+def-use taint with joins at control-flow merges, loop bodies iterated
+twice), and propagates taint through calls and returns to a fixpoint.
+
+Model:
+
+- **Sources** — calls/reads that may differ between processes:
+  ``jax.process_index``/``process_count``, ``jax.local_devices``/
+  ``local_device_count``, ``time.*`` clocks, ``os.environ``/``getenv``,
+  host RNG (``numpy.random.*``, stdlib ``random.*``), process identity
+  (``os.getpid``, ``socket.gethostname``, ``uuid.*``), plus any function
+  whose ``def`` line carries a ``# consensus-lint: host-divergent``
+  marker (the ``parallel/distributed.py`` slice-topology queries opt in
+  this way).
+- **Propagation** — assignment, tuple unpacking, arithmetic, subscripts
+  (a divergent *index* taints the selection), calls: a resolved callee's
+  parameters are tainted at the call site (summaries re-run to
+  fixpoint), its call expression is tainted when the callee derives
+  taint from a source (``returns_taint``) or passes a tainted parameter
+  through to its return (``propagates_params``); an UNRESOLVED call
+  with a tainted argument is conservatively tainted.
+- **Sanitizers** — ``multihost_utils.broadcast_one_to_all`` /
+  ``process_allgather`` / ``sync_global_devices``: gathering or
+  broadcasting a per-host value is exactly how divergence is *meant* to
+  be resolved, so their results are clean (and feeding them divergent
+  operands is the intended use, not a CL404).
+- **Sinks** —
+  - CL401: a Python ``if``/``while`` test in a function that is traced
+    or (transitively) trace-shaping — branches taken differently per
+    host issue different programs. A branch one of whose arms is ONLY
+    ``raise`` statements is exempt: the surviving hosts all take the
+    same arm, and failing fast beats deadlocking — that is the
+    validation idiom (``if not 0 <= host_id < n_hosts: raise``).
+  - CL402: trace-structural arguments — ``shard_map`` in/out specs,
+    ``pallas_call`` grids, jit ``static_argnums``/shardings,
+    ``PartitionSpec``/``NamedSharding`` construction.
+  - CL403: mesh construction (``Mesh``, ``make_mesh``,
+    ``make_hybrid_mesh``, ``create_device_mesh``).
+  - CL404: collective operands/parameters (``lax.psum``, ``ppermute``,
+    ``all_gather``, …) — a host-divergent trace-time constant compiles
+    a different program per host.
+
+Per-host data selection that stays data (round-robin panel/chunk
+assignment feeding independent work, guarded by raise-only validation)
+produces no findings by construction — ``tests/test_analysis.py``'s
+no-trigger corpus pins exactly that.
+
+Known approximations (all conservative or documented): module-level
+constants are host context (an env read at import time is an explicit
+'read once per process' statement; its uses are not re-tainted);
+attribute stores (``self.x = …``) taint only the root name locally;
+nested ``def``s see the enclosing function's final taint state for
+their free variables.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .rules import (_dotted, _in_comment, _line_directives, _Module,
+                    scan_targets)
+
+#: rule ID -> (severity, one-line description)
+DATAFLOW_RULES = {
+    "CL401": ("error", "host-divergent value reaches a Python branch in "
+                       "traced / trace-shaping code (hosts may issue "
+                       "different collective sequences)"),
+    "CL402": ("error", "host-divergent value reaches a trace-structural "
+                       "argument (jit static arg / shard_map specs / "
+                       "pallas grid / sharding construction)"),
+    "CL403": ("error", "host-divergent value reaches device-mesh "
+                       "construction (hosts may build different meshes)"),
+    "CL404": ("error", "host-divergent value reaches a collective operand "
+                       "or parameter (a divergent trace-time constant "
+                       "compiles a different program per host)"),
+}
+
+#: canonical dotted-name prefixes whose call results differ per process
+_SOURCE_PREFIXES = (
+    "jax.process_index", "jax.process_count",
+    "jax.local_devices", "jax.local_device_count",
+    "time.", "os.environ", "os.getenv", "os.getpid", "os.uname",
+    "socket.gethostname", "socket.getfqdn",
+    "numpy.random.", "random.",
+    "uuid.uuid",
+)
+
+#: canonical name tails whose results are host-CONSISTENT by
+#: construction: cross-process broadcast/gather is how divergence is
+#: legitimately resolved, so these cut taint (and are not CL404 sinks —
+#: feeding them per-host values is their purpose)
+_SANITIZER_TAILS = (
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+    "host_local_array_to_global_array", "global_array_to_host_local_array",
+)
+
+#: collective calls (CL404 sinks): last dotted component under a jax root
+_COLLECTIVE_TAILS = {
+    "psum", "pmax", "pmin", "pmean", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "axis_index", "psum_scatter",
+}
+
+#: mesh-construction calls (CL403 sinks)
+_MESH_TAILS = {"Mesh", "make_mesh", "make_hybrid_mesh",
+               "create_device_mesh", "AbstractMesh"}
+
+#: sharding/spec construction (CL402 sinks)
+_SPEC_TAILS = {"PartitionSpec", "NamedSharding", "GridSpec", "BlockSpec"}
+
+#: structural keywords of trace-wrapper calls (CL402 sinks): a divergent
+#: value here shapes the traced program itself
+_STRUCTURAL_KWARGS = {
+    "in_specs", "out_specs", "mesh", "grid", "grid_spec", "static_argnums",
+    "static_argnames", "in_shardings", "out_shardings", "donate_argnums",
+    "donate_argnames", "axis_name", "axis_size", "device", "backend",
+    "devices",
+}
+
+#: wrappers whose CALL makes the enclosing function trace-shaping
+_TRACE_CALL_TAILS = {
+    "jit", "pjit", "vmap", "pmap", "shard_map", "pallas_call", "scan",
+    "while_loop", "fori_loop", "cond", "switch", "checkpoint", "remat",
+    "grad", "value_and_grad", "lower", "eval_shape", "make_jaxpr",
+}
+
+
+def _src_line(mod: _Module, node: ast.AST) -> str:
+    lines = mod.text.splitlines()
+    i = getattr(node, "lineno", 0)
+    return lines[i - 1] if 0 < i <= len(lines) else ""
+
+
+def _module_name(rel: str) -> str:
+    """``pyconsensus_tpu/parallel/ring.py`` -> dotted module name."""
+    p = pathlib.PurePosixPath(rel)
+    parts = list(p.with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _FuncInfo:
+    """Per-function interprocedural summary (grown monotonically)."""
+
+    def __init__(self, modname: str, mod: _Module, fn: ast.AST):
+        self.modname = modname
+        self.mod = mod
+        self.fn = fn
+        self.qual = f"{modname}.{fn.name}"
+        args = fn.args
+        self.params: List[str] = (
+            [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+            + [a.arg for a in args.kwonlyargs])
+        #: param name -> origin description, tainted by some call site
+        self.tainted_params: Dict[str, str] = {}
+        #: body derives taint from a SOURCE and can return it
+        self.returns_taint: Optional[str] = None
+        #: a tainted parameter can flow through to the return value
+        self.propagates_params: bool = False
+        #: traced / builds meshes / issues collectives / calls trace
+        #: wrappers, directly or transitively — the CL401 relevance bit
+        self.trace_shaping: bool = False
+        self.marker_divergent: bool = _in_comment(
+            _src_line(mod, fn), "consensus-lint: host-divergent")
+
+
+class _Package:
+    """Whole-scan state: module table, function table, import resolution,
+    and the enclosing-scope taint snapshots for nested defs."""
+
+    def __init__(self, files: List[Tuple[pathlib.Path, str]]):
+        self.mods: Dict[str, _Module] = {}          # rel path -> _Module
+        self.modname_of: Dict[str, str] = {}
+        self.infos: List[_FuncInfo] = []            # every def, in order
+        self.by_qual: Dict[str, _FuncInfo] = {}     # first def wins
+        self.by_node: Dict[ast.AST, _FuncInfo] = {}
+        #: nested def node -> joined taint state of its enclosing scope
+        self.enclosing_state: Dict[ast.AST, Dict[str, str]] = {}
+        for f, rel in files:
+            try:
+                text = f.read_text(encoding="utf-8")
+                tree = ast.parse(text, filename=str(f))
+            except (OSError, SyntaxError):
+                continue
+            mod = _Module(rel, text, tree)
+            self.mods[rel] = mod
+            self.modname_of[rel] = _module_name(rel)
+        for rel, mod in self.mods.items():
+            modname = self.modname_of[rel]
+            for fn in mod.funcs:
+                info = _FuncInfo(modname, mod, fn)
+                self.infos.append(info)
+                self.by_qual.setdefault(info.qual, info)
+                self.by_node[fn] = info
+        self.scopes: Dict[str, Dict[str, _FuncInfo]] = {}
+        self._build_scopes()
+
+    def _build_scopes(self) -> None:
+        for rel, mod in self.mods.items():
+            modname = self.modname_of[rel]
+            scope: Dict[str, _FuncInfo] = {}
+            for fn in mod.funcs:
+                scope.setdefault(fn.name, self.by_node[fn])
+            pkg_parts = modname.split(".")
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ImportFrom):
+                    continue
+                if node.level:                       # relative import
+                    base = pkg_parts[:-node.level] if node.level <= len(
+                        pkg_parts) else []
+                    target = ".".join(base + (node.module.split(".")
+                                              if node.module else []))
+                else:
+                    target = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    callee = self.by_qual.get(f"{target}.{a.name}")
+                    if callee is not None:
+                        scope[a.asname or a.name] = callee
+            self.scopes[rel] = scope
+
+    def resolve(self, mod: _Module, call_func: ast.AST
+                ) -> Optional[_FuncInfo]:
+        """Map a call's func expression to a known scanned function."""
+        scope = self.scopes.get(mod.path, {})
+        if isinstance(call_func, ast.Name):
+            return scope.get(call_func.id)
+        if isinstance(call_func, ast.Attribute):
+            root = _dotted(call_func.value)
+            if root in ("self", "cls"):              # same-module method
+                return scope.get(call_func.attr)
+            dotted = mod.aliases.canon(_dotted(call_func))
+            if dotted:
+                return self.by_qual.get(dotted)
+        return None
+
+    def note_enclosing(self, child: ast.AST, state: Dict[str, str]) -> bool:
+        prev = self.enclosing_state.get(child, {})
+        nxt = dict(prev)
+        for k, v in state.items():
+            nxt.setdefault(k, v)
+        if nxt != prev:
+            self.enclosing_state[child] = nxt
+            return True
+        return False
+
+
+# -- taint classification of names/calls -----------------------------------
+
+
+def _canon(mod: _Module, node: ast.AST) -> str:
+    return mod.aliases.canon(_dotted(node)) or ""
+
+
+def _source_call(mod: _Module, node: ast.Call) -> Optional[str]:
+    dotted = _canon(mod, node.func)
+    for pref in _SOURCE_PREFIXES:
+        if dotted == pref.rstrip(".") or dotted.startswith(pref):
+            return dotted
+    return None
+
+
+def _source_read(mod: _Module, node: ast.AST) -> Optional[str]:
+    """Non-call sources: the ``os.environ`` mapping itself."""
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        if _canon(mod, node) == "os.environ":
+            return "os.environ"
+    return None
+
+
+def _call_tail(mod: _Module, node: ast.Call) -> str:
+    dotted = _canon(mod, node.func)
+    return dotted.split(".")[-1] if dotted else ""
+
+
+def _is_sanitizer(mod: _Module, node: ast.Call) -> bool:
+    return _call_tail(mod, node) in _SANITIZER_TAILS
+
+
+def _is_collective_call(mod: _Module, node: ast.Call) -> bool:
+    dotted = _canon(mod, node.func)
+    if not dotted:
+        return False
+    tail = dotted.split(".")[-1]
+    return tail in _COLLECTIVE_TAILS and (
+        dotted.startswith(("jax.", "lax.")) or "." not in dotted)
+
+
+def _raise_only(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and all(isinstance(s, ast.Raise) for s in stmts)
+
+
+# -- the per-function abstract interpreter ---------------------------------
+
+
+class _Analyzer:
+    """Flow-sensitive taint walk of one function body.
+
+    State is ``{name: origin-description}``; statements execute in
+    order, branches fork and join, loop bodies run twice (enough for the
+    loop-carried flows this package contains)."""
+
+    def __init__(self, pkg: _Package, info: _FuncInfo,
+                 findings: Optional[List[Finding]] = None,
+                 directives: Optional[Dict[int, Set[str]]] = None,
+                 synthetic: bool = False):
+        self.pkg = pkg
+        self.info = info
+        self.mod = info.mod
+        self.findings = findings            # None = summary-only pass
+        self.directives = directives or {}
+        #: the propagates-params probe runs with every param tainted by a
+        #: FAKE origin — it must not write that taint into real summaries
+        self.synthetic = synthetic
+        self.returned_taint: Optional[str] = None
+        self.changed = False
+
+    # ---- expression taint -------------------------------------------------
+
+    def eval(self, node: Optional[ast.AST], state: Dict[str, str]
+             ) -> Optional[str]:
+        """Origin description when ``node``'s value may be
+        host-divergent, else None."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            return state.get(node.id)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, state)
+        if isinstance(node, ast.Attribute):
+            return _source_read(self.mod, node) or self.eval(node.value,
+                                                             state)
+        if isinstance(node, ast.Subscript):
+            return (self.eval(node.value, state)
+                    or self.eval(node.slice, state))
+        if isinstance(node, ast.NamedExpr):
+            org = self.eval(node.value, state)
+            self._assign_target(node.target, org, state)
+            return org
+        if isinstance(node, ast.Lambda):
+            # lambdas are the dominant idiom for cond/shard_map arms —
+            # walk the body at the definition site (its sinks fire, its
+            # captured taint propagates out); the lambda's own params
+            # shadow enclosing names
+            inner = dict(state)
+            a = node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                inner.pop(p.arg, None)
+            return self.eval(node.body, inner)
+        if isinstance(node, (ast.Constant, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            return None
+        for child in ast.iter_child_nodes(node):
+            org = self.eval(child, state)
+            if org:
+                return org
+        return None
+
+    def _eval_call(self, node: ast.Call, state: Dict[str, str]
+                   ) -> Optional[str]:
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        arg_origins = [self.eval(a, state) for a in args]
+        tainted_arg = next((o for o in arg_origins if o), None)
+        if not isinstance(node.func, (ast.Name, ast.Attribute)):
+            # curried call like shard_map(...)(x): evaluate the inner
+            # call expression too (its sinks, its taint)
+            tainted_arg = self.eval(node.func, state) or tainted_arg
+        elif isinstance(node.func, ast.Attribute):
+            # method call: the receiver's taint flows through the result
+            # (rng.integers(...) with rng = np.random.default_rng(), or
+            # the chained np.random.default_rng().integers(...) form)
+            tainted_arg = self.eval(node.func.value, state) or tainted_arg
+
+        if _is_sanitizer(self.mod, node):
+            return None                     # host-consistent by contract
+        src = _source_call(self.mod, node)
+        if src:
+            self._note_shaping(node)
+            return f"{src}() at {self.mod.path}:{node.lineno}"
+
+        if self.findings is not None:
+            self._check_call_sinks(node, args, arg_origins, state)
+        self._note_shaping(node)
+
+        callee = self.pkg.resolve(self.mod, node.func)
+        if callee is not None:
+            if callee.marker_divergent:
+                return (f"{callee.fn.name}() [marker: host-divergent] "
+                        f"at {self.mod.path}:{node.lineno}")
+            self._bind_params(callee, node, arg_origins)
+            if callee.returns_taint:
+                return f"{callee.fn.name}() <- {callee.returns_taint}"
+            if callee.propagates_params and tainted_arg:
+                return tainted_arg
+            return None
+        return tainted_arg                  # unresolved: pass through
+
+    def _bind_params(self, callee: _FuncInfo, node: ast.Call,
+                     arg_origins) -> None:
+        if self.synthetic:
+            return
+        # method call: the receiver occupies the first parameter slot, so
+        # positional arguments shift by one (self.helper(tainted) must
+        # taint 'idx', not 'self')
+        shift = int(isinstance(node.func, ast.Attribute)
+                    and bool(callee.params)
+                    and callee.params[0] in ("self", "cls"))
+        for pos, (a, org) in enumerate(zip(node.args, arg_origins)):
+            if isinstance(a, ast.Starred):
+                break
+            if org and pos + shift < len(callee.params):
+                name = callee.params[pos + shift]
+                if name not in callee.tainted_params:
+                    callee.tainted_params[name] = org
+                    self.changed = True
+        for kw, org in zip(node.keywords,
+                           arg_origins[len(node.args):]):
+            if kw.arg and org and kw.arg in callee.params \
+                    and kw.arg not in callee.tainted_params:
+                callee.tainted_params[kw.arg] = org
+                self.changed = True
+
+    def _note_shaping(self, node: ast.Call) -> None:
+        """Mark the enclosing function trace-shaping when this call
+        traces, builds meshes/specs, or issues collectives."""
+        if self.info.trace_shaping:
+            return
+        tail = _call_tail(self.mod, node)
+        shaping = (tail in _TRACE_CALL_TAILS or tail in _MESH_TAILS
+                   or tail in _SPEC_TAILS
+                   or _is_collective_call(self.mod, node))
+        if not shaping:
+            callee = self.pkg.resolve(self.mod, node.func)
+            shaping = callee is not None and callee.trace_shaping
+        if shaping:
+            self.info.trace_shaping = True
+            self.changed = True
+
+    # ---- sinks ------------------------------------------------------------
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        sup = self.directives.get(line, set())
+        if "*" in sup or rule in sup:
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.path, line=line, message=message,
+            severity=DATAFLOW_RULES[rule][0],
+            snippet=_src_line(self.mod, node).strip()))
+
+    def _check_call_sinks(self, node: ast.Call, args, arg_origins,
+                          state: Dict[str, str]) -> None:
+        tail = _call_tail(self.mod, node)
+        fname = self.info.fn.name
+        org = next((o for o in arg_origins if o), None)
+        if org:
+            if tail in _MESH_TAILS:
+                self._emit(node, "CL403",
+                           f"mesh construction '{tail}(...)' in "
+                           f"'{fname}' consumes a host-divergent value "
+                           f"({org}) — hosts may build different meshes "
+                           f"and compile different programs")
+            elif tail in _SPEC_TAILS:
+                self._emit(node, "CL402",
+                           f"sharding/spec construction '{tail}(...)' in "
+                           f"'{fname}' consumes a host-divergent value "
+                           f"({org})")
+            elif _is_collective_call(self.mod, node):
+                self._emit(node, "CL404",
+                           f"collective '{tail}' in '{fname}' consumes a "
+                           f"host-divergent value ({org}) — a divergent "
+                           f"trace-time constant compiles a different "
+                           f"program on each host")
+        if tail in _TRACE_CALL_TAILS:
+            for kw in node.keywords:
+                if kw.arg in _STRUCTURAL_KWARGS:
+                    korg = self.eval(kw.value, state)
+                    if korg:
+                        self._emit(
+                            node, "CL402",
+                            f"trace-structural argument '{kw.arg}=' of "
+                            f"'{tail}' in '{fname}' is host-divergent "
+                            f"({korg}) — hosts trace different programs")
+
+    def _branch_sink(self, node: ast.AST, state: Dict[str, str]) -> None:
+        # the test is evaluated in EVERY pass — its side effects (walrus
+        # assignments, call-site param binding) belong to the summaries
+        # too, not just the findings pass
+        org = self.eval(node.test, state)
+        if self.findings is None or not org:
+            return
+        # only traced or trace-shaping functions can turn a divergent
+        # branch into divergent programs/schedules
+        if not (self.info.fn in self.mod.traced or self.info.trace_shaping):
+            return
+        # fail-fast exemption: when one arm only raises, every SURVIVING
+        # host took the same arm — no divergent continuation (and a
+        # crashed host is a loud error, not a silent hang)
+        body = getattr(node, "body", [])
+        orelse = getattr(node, "orelse", [])
+        if _raise_only(body) or (orelse and _raise_only(orelse)):
+            return
+        kind = "if" if isinstance(node, ast.If) else "while"
+        self._emit(node, "CL401",
+                   f"Python '{kind}' in '{self.info.fn.name}' branches on "
+                   f"a host-divergent value ({org}) in traced/"
+                   f"trace-shaping code — hosts may issue different "
+                   f"collective sequences (fail fast with raise, "
+                   f"broadcast the value, or restructure)")
+
+    # ---- statement execution ---------------------------------------------
+
+    def _assign_target(self, target: ast.AST, origin: Optional[str],
+                       state: Dict[str, str]) -> None:
+        if isinstance(target, ast.Name):
+            if origin:
+                state[target.id] = origin
+            else:
+                state.pop(target.id, None)       # kill: clean redefinition
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_target(
+                    elt.value if isinstance(elt, ast.Starred) else elt,
+                    origin, state)
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            # container/attribute store: taint the ROOT name (a[i] = bad
+            # makes a suspect); never kill on clean stores
+            root = target
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            if isinstance(root, ast.Name) and origin:
+                state[root.id] = origin
+
+    def exec_block(self, stmts: Iterable[ast.stmt],
+                   state: Dict[str, str]) -> Dict[str, str]:
+        for st in stmts:
+            state = self.exec_stmt(st, state)
+        return state
+
+    def exec_stmt(self, st: ast.stmt, state: Dict[str, str]
+                  ) -> Dict[str, str]:
+        if isinstance(st, ast.Assign):
+            org = self.eval(st.value, state)
+            for t in st.targets:
+                self._assign_target(t, org, state)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._assign_target(st.target, self.eval(st.value, state),
+                                    state)
+        elif isinstance(st, ast.AugAssign):
+            org = self.eval(st.value, state) or self.eval(st.target, state)
+            self._assign_target(st.target, org, state)
+        elif isinstance(st, ast.If):
+            self._branch_sink(st, state)
+            s1 = self.exec_block(st.body, dict(state))
+            s2 = self.exec_block(st.orelse, dict(state))
+            state = _join(s1, s2)
+        elif isinstance(st, ast.While):
+            self._branch_sink(st, state)
+            once = self.exec_block(st.body, dict(state))
+            twice = self.exec_block(st.body, dict(once))
+            state = self.exec_block(st.orelse, _join(state,
+                                                     _join(once, twice)))
+        elif isinstance(st, ast.For):
+            org = self.eval(st.iter, state)
+            body_state = dict(state)
+            self._assign_target(st.target, org, body_state)
+            once = self.exec_block(st.body, body_state)
+            again = dict(once)
+            self._assign_target(st.target, org, again)
+            twice = self.exec_block(st.body, again)
+            state = self.exec_block(st.orelse, _join(state,
+                                                     _join(once, twice)))
+        elif isinstance(st, ast.Try):
+            merged = _join(state, self.exec_block(st.body, dict(state)))
+            for h in st.handlers:
+                hstate = dict(merged)
+                if h.name:
+                    hstate.pop(h.name, None)
+                merged = _join(merged, self.exec_block(h.body, hstate))
+            merged = self.exec_block(st.orelse, merged)
+            state = self.exec_block(st.finalbody, merged)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                org = self.eval(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._assign_target(item.optional_vars, org, state)
+            state = self.exec_block(st.body, state)
+        elif isinstance(st, ast.Return):
+            org = self.eval(st.value, state)
+            if org and not self.returned_taint:
+                self.returned_taint = org
+        elif isinstance(st, ast.Expr):
+            self.eval(st.value, state)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # snapshot the enclosing taint for the nested def's free vars
+            if not self.synthetic:
+                self.changed |= self.pkg.note_enclosing(st, state)
+        elif isinstance(st, ast.Raise):
+            self.eval(st.exc, state)
+        elif isinstance(st, ast.Assert):
+            self.eval(st.test, state)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    state.pop(t.id, None)
+        return state
+
+    # ---- drivers ----------------------------------------------------------
+
+    def initial_state(self) -> Dict[str, str]:
+        return _join(dict(self.info.tainted_params),
+                     self.pkg.enclosing_state.get(self.info.fn, {}))
+
+    def run(self) -> None:
+        state = self.exec_block(self.info.fn.body, self.initial_state())
+        del state
+        if self.returned_taint and not self.info.returns_taint:
+            # param pass-through is the propagates_params bit; only
+            # source-derived returns set returns_taint (otherwise every
+            # caller of e.g. normalize() would see taint on clean args)
+            if self.returned_taint not in set(
+                    self.info.tainted_params.values()):
+                self.info.returns_taint = self.returned_taint
+                self.changed = True
+
+
+def _join(a: Dict[str, str], b: Dict[str, str]) -> Dict[str, str]:
+    out = dict(a)
+    for k, v in b.items():
+        out.setdefault(k, v)
+    return out
+
+
+def _compute_propagates(pkg: _Package, info: _FuncInfo) -> bool:
+    """Does a tainted parameter reach this function's return value?
+    One synthetic summary run with every parameter tainted."""
+    probe = _Analyzer(pkg, info, synthetic=True)
+    state = {p: "param" for p in info.params}
+    try:
+        probe.exec_block(info.fn.body, state)
+    except RecursionError:                            # pragma: no cover
+        return True
+    return probe.returned_taint is not None
+
+
+# -- public driver ---------------------------------------------------------
+
+
+def analyze_paths(paths=None, root=None,
+                  select: Optional[Set[str]] = None) -> List[Finding]:
+    """Run the Layer 3a taint analysis over ``paths`` (default: the
+    installed package). The call graph covers exactly the scanned files —
+    linting one file analyzes that file's flows only. Findings are
+    sorted by (path, line, rule); ``# consensus-lint: disable=CL40x`` /
+    ``# noqa`` line directives suppress in place."""
+    files = scan_targets(paths, root)
+    pkg = _Package(files)
+
+    # grow summaries (propagates_params / returns_taint / tainted_params
+    # / trace_shaping / nested-def scopes) to a fixpoint; findings are
+    # discarded in these passes. propagates_params is INSIDE the loop:
+    # a pass-through chain whose caller is defined before its callee
+    # only converges on the second round (definition order must not
+    # decide whether a flow is seen).
+    for _ in range(8):
+        changed = False
+        for info in pkg.infos:
+            if not info.propagates_params \
+                    and _compute_propagates(pkg, info):
+                info.propagates_params = True
+                changed = True
+            a = _Analyzer(pkg, info)
+            a.run()
+            changed |= a.changed
+        if not changed:
+            break
+
+    findings: List[Finding] = []
+    directives = {rel: _line_directives(mod.text)
+                  for rel, mod in pkg.mods.items()}
+    for info in pkg.infos:
+        _Analyzer(pkg, info, findings=findings,
+                  directives=directives.get(info.mod.path, {})).run()
+    if select is not None:
+        findings = [f for f in findings if f.rule in select]
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.message), f)
+    return sorted(uniq.values(), key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,384 @@
+"""Layer 3b: collective-schedule extraction + deadlock detection (CL41x).
+
+Layer 2 inspects post-GSPMD HLO — the right artifact for *budgets*, but
+a single process's compiled program cannot show the *structural* hangs:
+a ``lax.cond`` whose branches issue different collective sequences (the
+branch not taken compiles fine; the fleet hangs the first time predicates
+diverge), a hand-written ``ppermute`` whose permutation is not a
+bijection on its mesh axis (some device waits for a message nobody
+sends — the ring modules build perms in Python, one typo hangs
+silently), or a collective naming an axis no enclosing ``shard_map``
+binds. These live in the *jaxpr*, before partitioning, where the
+branch/loop structure is still explicit — so this layer traces the real
+entry points with ``jax.make_jaxpr`` (cheap: abstract evaluation, no
+compile) and walks the jaxpr tree:
+
+- **CL411** — every ``lax.cond``/``switch``: all branches must issue the
+  IDENTICAL collective sequence (op kind + axes, in order). Under SPMD a
+  replicated predicate makes an imbalance latent, not safe: the first
+  divergent predicate (a NaN on one host, CL401's divergent values)
+  deadlocks the fleet inside the longer branch.
+- **CL412** — every ``ppermute``: the permutation must be a bijection on
+  the full axis index set (each index exactly once as source and as
+  destination, all in range). jax accepts partial perms (missing
+  receivers get zeros), but in a hand-written ring a non-total perm is
+  a dropped hop — and duplicate sources/destinations hang outright.
+- **CL413** — every collective's axis names must be bound by an
+  enclosing ``shard_map`` (or the target's declared axis environment).
+
+``while_loop`` bodies are walked recursively: the body is one fixed
+jaxpr, so its per-iteration collective sequence is structurally
+identical by construction once nested conds are balanced (checked) and
+the predicate is replicated (divergent predicates are Layer 3a's CL401
+and shard_map's vma check); the cond jaxpr is walked too.
+
+Targets (``SCHEDULES``) are the real hand-written-collective entry
+points: the ring primitives (``ring_allreduce`` under ``shard_map``,
+``ring_gram``, ``ring_matvec``, ``ring_first_pc``), the fused
+shard_map executable (binary and NA variants), the streaming panel
+kernel (collective-free; walked so a regression that introduces an
+unbound or malformed collective is caught), and the GSPMD light
+pipeline (its jaxpr carries no explicit collectives — GSPMD inserts
+them post-partitioning, which Layer 2 budgets — but its ``cond``
+structure is balance-checked here).
+
+A target that fails to trace reports **CL410** (same contract as Layer
+2's CL300: the trace failure IS the signal).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .contracts import N_DEV
+from .findings import Finding
+
+SCHEDULE_RULES = {
+    "CL410": ("error", "schedule target failed to trace"),
+    "CL411": ("error", "lax.cond/switch branches issue different "
+                       "collective sequences (deadlock on divergent "
+                       "predicates)"),
+    "CL412": ("error", "ppermute permutation is not a bijection on its "
+                       "mesh axis (some device hangs waiting for a "
+                       "message nobody sends)"),
+    "CL413": ("error", "collective uses an axis name not bound by an "
+                       "enclosing shard_map / declared axis environment"),
+}
+
+#: jaxpr primitives that move data across a named mesh axis
+_COLLECTIVE_PRIMS = {
+    "psum", "pmax", "pmin", "pmean", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "psum2",
+    "all_gather_invariant",
+}
+#: primitives that only QUERY the axis (no communication): axis-binding
+#: checked, but not part of the schedule (imbalance across branches is
+#: harmless)
+_AXIS_QUERY_PRIMS = {"axis_index", "axis_size"}
+
+
+def _axis_names(params: dict) -> Tuple[str, ...]:
+    """Normalize a collective eqn's axis parameter (``axes=('event',)``
+    for psum/pmax, ``axis_name='event'`` or a tuple for
+    ppermute/all_gather) to a tuple of names."""
+    axes = params.get("axes", params.get("axis_name", ()))
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _sub_jaxprs(params: dict):
+    """Every (key, jaxpr) nested in an eqn's params — covers cond
+    branches, while cond/body, scan/pjit/shard_map/custom_* bodies —
+    without depending on any one primitive's param spelling."""
+    import jax.core as core
+
+    ClosedJaxpr = core.ClosedJaxpr
+    Jaxpr = core.Jaxpr
+    for key, val in params.items():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            if isinstance(v, ClosedJaxpr):
+                yield key, v.jaxpr
+            elif isinstance(v, Jaxpr):
+                yield key, v
+
+
+def _mesh_axis_sizes(params: dict) -> Dict[str, int]:
+    """Axis name -> size from a shard_map eqn's mesh param (shaped like
+    ``Mesh``/``AbstractMesh``: a ``.shape`` mapping)."""
+    mesh = params.get("mesh")
+    shape = getattr(mesh, "shape", None)
+    if shape is None:
+        return {}
+    try:
+        return {str(k): int(v) for k, v in dict(shape).items()}
+    except (TypeError, ValueError):                 # pragma: no cover
+        return {}
+
+
+def _check_perm(perm, size: Optional[int]) -> Optional[str]:
+    """Why ``perm`` is not a bijection on a ``size``-element axis
+    (None = fine)."""
+    try:
+        pairs = [(int(s), int(d)) for s, d in perm]
+    except (TypeError, ValueError):
+        return f"malformed perm {perm!r}"
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    if len(set(srcs)) != len(srcs):
+        return f"duplicate source indices in perm {pairs}"
+    if len(set(dsts)) != len(dsts):
+        return (f"duplicate destination indices in perm {pairs} — two "
+                f"messages race into one device, one is never received")
+    if size is not None:
+        bad = [i for i in srcs + dsts if not 0 <= i < size]
+        if bad:
+            return (f"perm indices {sorted(set(bad))} out of range for "
+                    f"axis of size {size}")
+        if pairs and len(pairs) != size:
+            missing = sorted(set(range(size)) - set(srcs))
+            return (f"perm covers {len(pairs)} of {size} axis indices "
+                    f"(e.g. missing sources {missing[:4]}) — a dropped "
+                    f"ring hop: the uncovered devices receive zeros "
+                    f"instead of data")
+    return None
+
+
+def extract_schedule(jaxpr, bound_axes: Dict[str, int],
+                     findings: List[str], where: str = ""
+                     ) -> List[Tuple[str, Tuple[str, ...]]]:
+    """Walk ``jaxpr`` in execution order; return its collective sequence
+    ``[(prim, axes), ...]`` and append violation messages to
+    ``findings``. ``bound_axes`` maps available axis names to sizes."""
+    seq: List[Tuple[str, Tuple[str, ...]]] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        params = eqn.params
+        if name in _COLLECTIVE_PRIMS or name in _AXIS_QUERY_PRIMS:
+            axes = _axis_names(params)
+            for ax in axes:
+                if ax not in bound_axes:
+                    findings.append(
+                        f"CL413:{where}'{name}' names axis '{ax}' which "
+                        f"no enclosing shard_map binds (bound: "
+                        f"{sorted(bound_axes) or 'none'})")
+            if name == "ppermute":
+                for ax in axes or (None,):
+                    why = _check_perm(params.get("perm", ()),
+                                      bound_axes.get(ax))
+                    if why:
+                        findings.append(f"CL412:{where}ppermute on axis "
+                                        f"{ax!r}: {why}")
+            if name in _COLLECTIVE_PRIMS:
+                seq.append((name, axes))
+            continue
+        if name in ("cond", "switch"):
+            branches = [j for k, j in _sub_jaxprs(params)
+                        if k == "branches"]
+            branch_seqs = [extract_schedule(b, bound_axes, findings,
+                                            f"{where}cond>")
+                          for b in branches]
+            if branch_seqs and any(s != branch_seqs[0]
+                                   for s in branch_seqs[1:]):
+                pretty = [" -> ".join(f"{p}{list(a)}" for p, a in s)
+                          or "(none)" for s in branch_seqs]
+                findings.append(
+                    f"CL411:{where}lax.cond branches issue different "
+                    f"collective sequences: " + " VS ".join(pretty))
+            if branch_seqs:
+                seq.extend(branch_seqs[0])
+            continue
+        if name == "shard_map":
+            inner_axes = dict(bound_axes)
+            inner_axes.update(_mesh_axis_sizes(params))
+            for _, sub in _sub_jaxprs(params):
+                seq.extend(extract_schedule(sub, inner_axes, findings,
+                                            f"{where}shard_map>"))
+            continue
+        # generic recursion: while (cond_jaxpr + body_jaxpr), scan, pjit,
+        # remat, custom_jvp/vjp, closed_call, ... — walk every nested
+        # jaxpr once, in param order
+        for _, sub in _sub_jaxprs(params):
+            seq.extend(extract_schedule(sub, bound_axes, findings,
+                                        f"{where}{name}>"))
+    return seq
+
+
+# -- targets ---------------------------------------------------------------
+# Meshes are sized by contracts.N_DEV — the device count
+# ensure_cpu_devices actually provisions before this layer runs.
+
+
+def _mesh8():
+    from ..parallel import make_mesh
+
+    return make_mesh(batch=1, event=N_DEV)
+
+
+def _t_ring_allreduce():
+    """ring_allreduce under shard_map, standalone (the primitive the
+    other ring entry points compose)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring import ring_allreduce, shard_map
+
+    mesh = _mesh8()
+    f = shard_map(lambda x: ring_allreduce(x, "event"), mesh,
+                  P(None, "event"), P())
+    return jax.make_jaxpr(f)(jnp.ones((6, 2 * N_DEV))), {}
+
+
+def _t_ring_gram():
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.ring import ring_gram
+
+    mesh = _mesh8()
+    return jax.make_jaxpr(
+        lambda a: ring_gram(a, mesh))(jnp.ones((6, 4 * N_DEV))), {}
+
+
+def _t_ring_matvec():
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.ring import ring_matvec
+
+    mesh = _mesh8()
+    E = 4 * N_DEV
+    return jax.make_jaxpr(
+        lambda a, v: ring_matvec(a, v, mesh))(
+            jnp.ones((6, E)), jnp.ones((E,))), {}
+
+
+def _t_ring_first_pc():
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.ring import ring_first_pc
+
+    mesh = _mesh8()
+    R, E = 6, 4 * N_DEV
+    return jax.make_jaxpr(
+        lambda x, rep: ring_first_pc(x, rep, mesh))(
+            jnp.ones((R, E)), jnp.full((R,), 1.0 / R)), {}
+
+
+def _fused_jaxpr(has_na: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.pipeline import ConsensusParams
+    from ..parallel.fused_sharded import _build, _seed_placed
+
+    mesh = _mesh8()
+    R, E = 8, 32 * N_DEV
+    p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                        has_na=has_na, any_scaled=False, median_block=0,
+                        fused_resolution=True)
+    dt = jnp.asarray(0.0).dtype
+    seed, base_unit = _seed_placed(mesh, E, 0, dt.name)
+    fn = _build(mesh, p, True, E, False)
+    return jax.make_jaxpr(fn)(
+        jnp.ones((R, E), dt), jnp.full((R,), 1.0 / R, dt), seed,
+        base_unit), {}
+
+
+def _t_fused_sharded():
+    return _fused_jaxpr(has_na=False)
+
+
+def _t_fused_sharded_na():
+    return _fused_jaxpr(has_na=True)
+
+
+def _t_streaming_panel():
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.streaming import _pass1_panel
+
+    R, E = 6, 64
+    dt = jnp.asarray(0.0).dtype
+    return jax.make_jaxpr(
+        lambda *a: _pass1_panel(*a, tolerance=0.1, with_s=True))(
+            jnp.ones((R, E), dt), jnp.full((R,), 1.0 / R, dt),
+            jnp.full((R,), 1.0 / R, dt), jnp.zeros((E,), bool),
+            jnp.zeros((E,), dt), jnp.ones((E,), dt),
+            jnp.ones((E,), bool)), {}
+
+
+def _t_pipeline_light():
+    """The GSPMD light pipeline: no explicit collectives in its jaxpr
+    (Layer 2 budgets the post-partitioning ones), but every lax.cond in
+    the traced pipeline gets branch-balance checked."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.pipeline import ConsensusParams, consensus_light_jit
+
+    R, E = 8, 64
+    p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                        has_na=True, any_scaled=False)
+    dt = jnp.asarray(0.0).dtype
+    return jax.make_jaxpr(
+        lambda *a: consensus_light_jit(*a, p))(
+            jnp.ones((R, E), dt), jnp.full((R,), 1.0 / R, dt),
+            jnp.zeros((E,), bool), jnp.zeros((E,), dt),
+            jnp.ones((E,), dt)), {}
+
+
+#: name -> builder returning ``(closed_jaxpr, extra_axis_env)`` —
+#: ``extra_axis_env`` maps axis names the target assumes pre-bound
+#: (empty for real entry points: shard_map binds everything)
+SCHEDULES: Dict[str, Callable] = {
+    "ring-allreduce": _t_ring_allreduce,
+    "ring-gram": _t_ring_gram,
+    "ring-matvec": _t_ring_matvec,
+    "ring-first-pc": _t_ring_first_pc,
+    "fused-sharded": _t_fused_sharded,
+    "fused-sharded-na": _t_fused_sharded_na,
+    "streaming-pass1": _t_streaming_panel,
+    "pipeline-light": _t_pipeline_light,
+}
+
+
+def check_schedule(name: str, jaxpr, axis_env: Optional[Dict[str, int]]
+                   = None) -> List[Finding]:
+    """Walk one target's jaxpr; findings carry ``schedule:<name>`` paths
+    (baselined like contract findings). Pure given a jaxpr — unit
+    testable on crafted functions."""
+    core_jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    msgs: List[str] = []
+    extract_schedule(core_jaxpr, dict(axis_env or {}), msgs)
+    out = []
+    for m in msgs:
+        rule, _, detail = m.partition(":")
+        out.append(Finding(
+            rule=rule, path=f"schedule:{name}", line=0, message=detail,
+            severity=SCHEDULE_RULES[rule][0], snippet=f"{name}:{rule}"))
+    return out
+
+
+def run_schedules(names: Optional[List[str]] = None) -> List[Finding]:
+    """Trace every declared schedule target and check it. Returns
+    findings (empty = every schedule is deadlock-clean)."""
+    out: List[Finding] = []
+    for name, builder in SCHEDULES.items():
+        if names and name not in names:
+            continue
+        try:
+            jaxpr, axis_env = builder()
+        except Exception as e:            # noqa - reported, not raised
+            out.append(Finding(
+                rule="CL410", path=f"schedule:{name}", line=0,
+                message=f"schedule target failed to trace: "
+                        f"{type(e).__name__}: {str(e)[:300]}",
+                severity="error", snippet=f"{name}:trace"))
+            continue
+        out.extend(check_schedule(name, jaxpr, axis_env))
+    return out

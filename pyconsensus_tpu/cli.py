@@ -127,9 +127,12 @@ def _run_simulation(args) -> None:
 
         from .parallel import make_mesh
 
-        # trials sharded over every local device (pure data parallelism)
-        mesh = make_mesh(batch=len(jax.local_devices()), event=1,
-                         devices=jax.local_devices())
+        # trials sharded over every local device (pure data parallelism).
+        # CL403 pragma: this CLI is a single-controller demo — the mesh
+        # is DELIBERATELY per-process (local devices only, no
+        # cross-process collectives to diverge from)
+        mesh = make_mesh(batch=len(jax.local_devices()),  # consensus-lint: disable=CL403
+                         event=1, devices=jax.local_devices())
         mesh_note = f", trials over {mesh.devices.size} device(s)"
     lf = [0.0, 0.1, 0.2, 0.3, 0.4]
     var = [0.0, 0.1, 0.2]
@@ -204,8 +207,9 @@ def _run_streaming(args, bounds) -> None:
         # each host's OWN devices shard its round-robin panels (the
         # streaming_consensus mesh contract) — a global multi-process
         # mesh would put different hosts' different panels behind
-        # cross-process collectives and deadlock
-        mesh = make_mesh(batch=1, devices=jax.local_devices())
+        # cross-process collectives and deadlock. CL403 pragma: the
+        # per-host LOCAL mesh is that contract, not a divergence bug
+        mesh = make_mesh(batch=1, devices=jax.local_devices())  # consensus-lint: disable=CL403
     print(f"=== Streaming resolution of {args.file} "
           f"({args.panel_events} events/panel, "
           f"{args.iterations} iteration(s)"

@@ -66,6 +66,17 @@ HYBRID_ALGORITHMS = ("hierarchical", "dbscan")
 #: API.
 _ICA_WARM_START = os.environ.get("PYCONSENSUS_ICA_WARM_START", "0") == "1"
 
+#: re-test gate for the round-5 fill-stats Pallas kernel (see the
+#: measured-winner note in ``_fill_stats``). Read ONCE at import, like
+#: ``_ICA_WARM_START`` above: the previous per-trace ``os.environ``
+#: read inside jit-traced ``_fill_stats`` was a Layer-3 CL401 — a
+#: host-divergent env var would have compiled a different program on
+#: each host of a fleet (and an env mutation between calls could
+#: disagree with the jit cache). Import-time reads state "read once per
+#: process" explicitly; launchers must set the env before import.
+_FILL_STATS_KERNEL = os.environ.get(
+    "PYCONSENSUS_FILL_STATS_KERNEL", "0") == "1"
+
 
 class ConsensusParams(NamedTuple):
     """Static (hashable) consensus configuration — the Oracle's tuning knobs
@@ -465,7 +476,7 @@ def _fill_stats(reports, reputation, tolerance: float, storage_dtype: str,
         # docs/PERFORMANCE.md r5). The kernel stays available for
         # re-testing via PYCONSENSUS_FILL_STATS_KERNEL=1; the default is
         # the form the chip favors.
-        if (os.environ.get("PYCONSENSUS_FILL_STATS_KERNEL", "0") == "1"):
+        if _FILL_STATS_KERNEL:
             from ..ops.pallas_kernels import (fill_stats_kernel_fits,
                                               fill_stats_pass)
 
@@ -528,12 +539,51 @@ def looks_encoded(arr) -> bool:
     vote matrices (legal before round 5 — asarray cast them to floats)
     instead of silently reinterpreting every int8 input: a raw binary
     matrix and an encoded one are only ambiguous when the encoded matrix
-    contains no NaN and no 1.0 vote at all (every value in {0.0, 0.5} —
-    pathological; such a matrix must be passed as floats, or through
-    ``sharded_consensus`` where ``storage_dtype='int8'`` makes the
-    encoding an explicit contract rather than a dtype guess)."""
+    contains no NaN and no 1.0 vote at all (every value in {0.0, 0.5}).
+    ``Oracle``'s explicit ``encoded=`` flag resolves the ambiguity as a
+    stated contract; with the flag unset (``None``), the ambiguous case
+    falls to the raw reading WITH a ``warnings.warn`` (see
+    :func:`resolve_encoded`)."""
     a = np.asarray(arr)
     return bool((a < 0).any() or (a > 1).any())
+
+
+def resolve_encoded(arr, encoded=None) -> bool:
+    """Decide whether an int8 ``arr`` is sentinel-encoded.
+
+    ``encoded=True``/``False`` is an explicit caller contract (validated
+    against the matrix: claiming raw over out-of-lattice values, or
+    encoded over values past the lattice top, raises). ``encoded=None``
+    keeps the :func:`looks_encoded` heuristic, but the AMBIGUOUS case —
+    every value in {0, 1}, readable as raw binary votes or as an encoded
+    all-{0.0, 0.5} matrix — now warns instead of silently picking the
+    raw reading, telling the caller to pin the meaning with the flag."""
+    a = np.asarray(arr)
+    if encoded is not None:
+        if encoded and (a > 2).any():
+            raise ValueError(
+                "encoded=True but the int8 matrix holds values > 2 — "
+                "not the round(2*value)/-1 sentinel lattice "
+                "(encode_reports)")
+        if not encoded and ((a < 0).any() or (a > 1).any()):
+            raise ValueError(
+                "encoded=False but the int8 matrix holds values outside "
+                "{0, 1} — raw binary votes cannot contain "
+                f"{sorted(set(a[(a < 0) | (a > 1)].tolist()))[:4]}; pass "
+                "encoded=True (or fix the matrix)")
+        return bool(encoded)
+    if looks_encoded(a):
+        return True
+    import warnings
+
+    warnings.warn(
+        "int8 reports matrix with every value in {0, 1} is ambiguous: "
+        "reading it as RAW binary votes (the pre-round-5 meaning). If "
+        "this matrix came from encode_reports (no NaN, no 1.0 vote — "
+        "its 1 bytes mean 0.5), that reading is WRONG — pass "
+        "encoded=True/False to make the intent explicit and silence "
+        "this warning.", stacklevel=3)
+    return False
 
 
 def decode_reports(encoded):
@@ -614,8 +664,15 @@ def _consensus_core_fused(reports, reputation, scaled, mins, maxs,
 
     R_true = x.shape[0]
     xs = jk.matvec_narrow(x, p.matvec_dtype)
+    # has_fill=True literally: _fill_stats always returns a fill vector
+    # on this path (the former `fill is not None` was constant-True dead
+    # logic). Every storage kernel downstream decodes against fill, so
+    # the tile budget is sized for the halved NaN-threading capacity
+    # even for has_na=False workloads — threading a no-fill fast path
+    # through the kernels would save tile headroom, not passes, and is
+    # not worth the second kernel variant.
     row_pad = (-R_true) % matmat_tile_rows(
-        x.shape[1], jnp.dtype(xs.dtype).itemsize, fill is not None)
+        x.shape[1], jnp.dtype(xs.dtype).itemsize, True)
     xp = jnp.pad(xs, ((0, row_pad), (0, 0))) if row_pad else xs
 
     def _rep_pad(rep_k):

@@ -174,6 +174,15 @@ class Oracle:
         full-fidelity XLA core, which materializes the continuous
         interpolated fills, so it raises a clear ``ValueError`` for
         int8; off-lattice values quantize to the nearest half unit.
+    encoded : bool or None
+        Whether an int8 ``reports`` matrix is ``encode_reports`` sentinel
+        storage (``round(2·value)``, -1 = NaN) rather than raw {0, 1}
+        votes. ``None`` (default) keeps the ``looks_encoded`` heuristic —
+        which is provably right whenever a -1 or 2 appears, and now
+        *warns* on the ambiguous all-{0, 1} case instead of silently
+        reading it as raw. ``True``/``False`` state the contract
+        explicitly (validated against the matrix) and silence the
+        warning. Ignored for non-int8 inputs (``True`` raises).
     verbose : bool
         Print a result summary after ``consensus()`` (reference fidelity).
     """
@@ -199,21 +208,27 @@ class Oracle:
                  power_tol: float = 0.0,
                  matvec_dtype: str = "",
                  storage_dtype: str = "",
+                 encoded: Optional[bool] = None,
                  verbose: bool = False):
         if reports is None:
             raise ValueError("reports matrix is required")
         if np.asarray(reports).dtype == np.int8:
-            from .models.pipeline import decode_reports, looks_encoded
+            from .models.pipeline import decode_reports, resolve_encoded
 
-            if looks_encoded(reports):
+            if resolve_encoded(reports, encoded):
                 # pre-encoded sentinel storage (encode_reports:
                 # round(2*value), -1 = NaN) — decode to the float form so
                 # every backend/algorithm below behaves identically; the
                 # bandwidth-sensitive encoded fast path is
-                # sharded_consensus. Raw {0, 1} int8 vote matrices (no -1,
-                # no 2) keep their pre-round-5 meaning via the plain
-                # float cast below (looks_encoded's ambiguity note).
+                # sharded_consensus. Raw {0, 1} int8 vote matrices keep
+                # their pre-round-5 meaning via the plain float cast
+                # below; the AMBIGUOUS case (all values in {0, 1},
+                # encoded= left None) warns — see resolve_encoded.
                 reports = decode_reports(np.asarray(reports))
+        elif encoded:
+            raise ValueError(
+                "encoded=True requires an int8 sentinel matrix "
+                f"(encode_reports), got dtype {np.asarray(reports).dtype}")
         self.reports = np.asarray(reports, dtype=np.float64)
         if self.reports.ndim != 2:
             raise ValueError(f"reports must be 2-D (reporters × events), "

@@ -90,13 +90,17 @@ def is_distributed() -> bool:
     return jax.process_count() > 1
 
 
-def _slice_index(device) -> int:
+def _slice_index(device) -> int:  # consensus-lint: host-divergent
     # TPU devices expose slice_index on multi-slice (Megascale/DCN)
-    # topologies; everything else is one slice
+    # topologies; everything else is one slice. Marked host-divergent for
+    # the Layer 3 taint pass: slice attributes come from the
+    # process-local runtime, so every flow into mesh/branch structure
+    # gets audited (consumers that rely on the globally-synchronized
+    # jax.devices() order pragma their use with that justification).
     return getattr(device, "slice_index", 0)
 
 
-def num_slices(devices: Optional[Sequence] = None) -> int:
+def num_slices(devices: Optional[Sequence] = None) -> int:  # consensus-lint: host-divergent
     devices = devices if devices is not None else jax.devices()
     return len({_slice_index(d) for d in devices})
 
@@ -112,7 +116,13 @@ def make_hybrid_mesh(batch: Optional[int] = None,
     """
     devices = list(devices if devices is not None else jax.devices())
     slices = sorted({_slice_index(d) for d in devices})
-    if len(slices) <= 1:
+    # CL401/CL403 pragmas below: the grid derives solely from the
+    # GLOBALLY-SYNCHRONIZED jax.devices() list (same order and slice
+    # attributes on every process — the runtime broadcasts the topology
+    # at initialize()), so every host computes the identical mesh; the
+    # host-divergent marker on _slice_index exists to audit flows like
+    # this one, and this is the audited-consistent case.
+    if len(slices) <= 1:  # consensus-lint: disable=CL401
         return make_mesh(batch=batch or 1, devices=devices)
 
     by_slice = [[d for d in devices if _slice_index(d) == s] for s in slices]
@@ -133,4 +143,4 @@ def make_hybrid_mesh(batch: Optional[int] = None,
     # grid rows = batch groups; each row's event neighbors are same-slice
     grid = np.asarray([g[i * (per // sub):(i + 1) * (per // sub)]
                        for g in by_slice for i in range(sub)])
-    return Mesh(grid, ("batch", "event"))
+    return Mesh(grid, ("batch", "event"))  # consensus-lint: disable=CL403

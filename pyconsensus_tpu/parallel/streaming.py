@@ -115,10 +115,14 @@ def _sym_topk(Gd, k: int, n_iters: int = 96, tol: float = 1e-7):
         i, V, _ = state
         Q, _ = jnp.linalg.qr(Gd @ V)
         # degenerate-spectrum guard: QR of a ZERO product block yields
-        # NaN columns — keep the previous orthonormal block. A
-        # non-finite Gd must still fail loudly (the poison below), not
-        # exit spuriously with the random start block.
-        Q = jnp.where(jnp.isfinite(Q), Q, V)
+        # NaN columns — keep the previous orthonormal block, WHOLE-BLOCK
+        # (the jax_kernels._top_pcs_orth_iter form): an elementwise
+        # substitution would splice finite Q entries into V's columns,
+        # handing a non-orthonormal mixed block to the alignment exit
+        # (rank loss poisons whole columns, and |sum(Q*V)| >= 1-tol on a
+        # mixed block can fire spuriously). A non-finite Gd must still
+        # fail loudly (the poison below), not exit with the start block.
+        Q = jnp.where(jnp.isfinite(Q).all(), Q, V)
         align = jnp.abs(jnp.sum(Q * V, axis=0))
         return i + 1, Q, jnp.all(align >= 1.0 - tol)
 
@@ -641,6 +645,19 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
         nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0, None))
         scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
+        # explained-variance discrepancy bound across the
+        # STREAM_EIGH_MAX_R switch: below the cap, lam and total come
+        # from the SAME eigh, so the fractions equal the in-memory
+        # eigh-gram route exactly. Above it, lam are Rayleigh-Ritz
+        # values of the converged orth-iter block — each lam_c lies in
+        # [eig_c - r_c, eig_c] with r_c the block residual, and the
+        # per-column alignment exit at 1 - tol (tol = 1e-7) bounds the
+        # principal angle by sqrt(2*tol), hence r_c <= 2*tol*eig_1 —
+        # while total = trace(Gd) is the exact full eigenvalue sum. Each
+        # fraction is therefore UNDER-estimated by at most
+        # 2*tol*eig_1/total ~ 2e-7: orders of magnitude below the
+        # variance_threshold granularity fixed-variance cuts on, so the
+        # component count never flips across the switch.
         explained = jnp.where(total > 0.0,
                               lam / jnp.where(total > 0.0, total, 1.0),
                               jnp.zeros_like(lam))

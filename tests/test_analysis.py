@@ -1,7 +1,10 @@
 """consensus-lint's own tests: a fixture corpus of minimal snippets that
 must (and must NOT) trigger each Layer-1 rule, text-level checks of the
-Layer-2 contract machinery on crafted HLO, the CLI's exit-code/baseline
-workflow, and the shipped-baseline-matches-tree invariant."""
+Layer-2 contract machinery on crafted HLO, a trigger/no-trigger corpus
+for the Layer-3a interprocedural taint rules (CL401-404), seeded-jaxpr
+checks of the Layer-3b schedule rules (CL411-413), the CLI's
+exit-code/baseline workflow, and the shipped-baseline-matches-tree
+invariant."""
 
 import json
 import pathlib
@@ -9,8 +12,9 @@ import textwrap
 
 import pytest
 
-from pyconsensus_tpu.analysis import (Finding, fingerprints, lint_paths,
-                                      load_baseline, match_baseline)
+from pyconsensus_tpu.analysis import (Finding, analyze_paths, fingerprints,
+                                      lint_paths, load_baseline,
+                                      match_baseline)
 from pyconsensus_tpu.analysis.baseline import save_baseline
 from pyconsensus_tpu.analysis.cli import run as cli_run
 from pyconsensus_tpu.analysis.contracts import (check_artifact,
@@ -19,7 +23,11 @@ from pyconsensus_tpu.analysis.contracts import (check_artifact,
                                                 collective_sizes, f64_ops,
                                                 host_callbacks,
                                                 load_contracts, run_contracts)
+from pyconsensus_tpu.analysis.dataflow import DATAFLOW_RULES
 from pyconsensus_tpu.analysis.rules import RULES, lint_file
+from pyconsensus_tpu.analysis.schedule import (SCHEDULE_RULES, _check_perm,
+                                               check_schedule,
+                                               run_schedules)
 
 # ---------------------------------------------------------------- Layer 1
 
@@ -202,22 +210,386 @@ def test_every_rule_has_corpus_coverage():
     assert set(CORPUS) == set(RULES)
 
 
+# ----------------------------------------------- Layer 3a: taint corpus
+
+#: per CL400-rule: (snippet that MUST trigger it, snippet that must NOT).
+#: The no-trigger snippets pin the legitimacy carve-outs: raise-only
+#: validation guards, per-host DATA selection feeding independent work,
+#: and the multihost broadcast/allgather sanitizers.
+TAINT_CORPUS = {
+    "CL401": (
+        """
+        import time
+        import jax
+        @jax.jit
+        def traced(x):
+            if time.time() > 5:
+                return x
+            return -x
+        """,
+        """
+        import jax
+        from jax import lax
+        def clean_roundrobin(chunks, n_hosts, run_chunk):
+            host = jax.process_index()
+            if not 0 <= host < n_hosts:
+                raise ValueError("bad host")
+            done = 0
+            for c in chunks:
+                if c % n_hosts == host:
+                    run_chunk(c)
+                    done += 1
+            return done
+        def sanitized(x, threshold):
+            from jax.experimental.multihost_utils import broadcast_one_to_all
+            import time
+            seed = broadcast_one_to_all(time.time_ns())
+            if seed > threshold:
+                return lax.psum(x, "event")
+            return x
+        """,
+    ),
+    "CL402": (
+        """
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        def spec_from_host(mesh, f, x):
+            k = int(np.random.default_rng().integers(0, 2))
+            specs = [P(None), P("event")][k]
+            return shard_map(f, mesh=mesh, in_specs=specs,
+                             out_specs=P())(x)
+        """,
+        """
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        def spec_static(mesh, f, x):
+            return shard_map(f, mesh=mesh, in_specs=P(None, "event"),
+                             out_specs=P())(x)
+        """,
+    ),
+    "CL403": (
+        """
+        import os
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        def mesh_from_env():
+            b = int(os.environ.get("NB", "1"))
+            grid = np.array(jax.devices()).reshape(b, -1)
+            return Mesh(grid, ("batch", "event"))
+        """,
+        """
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        def mesh_global(batch):
+            grid = np.array(jax.devices()).reshape(batch, -1)
+            return Mesh(grid, ("batch", "event"))
+        """,
+    ),
+    "CL404": (
+        """
+        import jax
+        from jax import lax
+        def scaled_psum(x):
+            n = jax.process_count()
+            return lax.psum(x * n, "event")
+        """,
+        """
+        import jax
+        from jax import lax
+        def plain_psum(x):
+            return lax.psum(x, "event")
+        def gathered(x):
+            from jax.experimental.multihost_utils import process_allgather
+            import time
+            return process_allgather(time.monotonic() * x)
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(TAINT_CORPUS))
+def test_taint_rule_triggers_and_stays_silent(rule, tmp_path):
+    pos_src, neg_src = TAINT_CORPUS[rule]
+    pos = tmp_path / "pos.py"
+    pos.write_text(textwrap.dedent(pos_src))
+    neg = tmp_path / "neg.py"
+    neg.write_text(textwrap.dedent(neg_src))
+    assert rule in {f.rule for f in analyze_paths([pos])}, (
+        f"{rule} did not fire on its positive snippet")
+    assert rule not in {f.rule for f in analyze_paths([neg])}, (
+        f"{rule} fired on its negative snippet")
+
+
+def test_every_taint_rule_has_corpus_coverage():
+    assert set(TAINT_CORPUS) == set(DATAFLOW_RULES)
+
+
+def test_taint_flows_interprocedurally(tmp_path):
+    """The signature Layer-3a case PR 1 could not see: the source read,
+    the propagating helper, and the sink live in three different
+    functions across two modules."""
+    (tmp_path / "ident.py").write_text(textwrap.dedent("""
+        import jax
+        def who_am_i():
+            return jax.process_index()
+        def offset(base):
+            return base + who_am_i()
+        """))
+    sink = tmp_path / "sink.py"
+    sink.write_text(textwrap.dedent("""
+        from jax import lax
+        from ident import offset
+        def emit(x):
+            return lax.ppermute(x, "event", [(0, offset(1))])
+        """))
+    found = analyze_paths([tmp_path])
+    assert "CL404" in {f.rule for f in found}
+    # the origin chain names the whole flow, three frames deep
+    msg = next(f for f in found if f.rule == "CL404").message
+    assert "offset()" in msg and "process_index" in msg
+    # restricting the scan to the sink file alone drops the callee from
+    # the call graph; an unresolved call with CLEAN arguments is clean
+    # (the documented scope contract: the graph covers scanned files)
+    assert analyze_paths([sink]) == []
+
+
+def test_taint_sees_lambda_bodies(tmp_path):
+    """Lambdas are the dominant idiom for cond arms — a sink inside one
+    must fire (review catch: the first engine skipped lambda bodies),
+    and the lambda's own params must not leak enclosing taint."""
+    p = tmp_path / "lam.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+        def f(x):
+            return lax.cond(x.sum() > 0,
+                            lambda v: lax.psum(v * jax.process_count(),
+                                               "event"),
+                            lambda v: v, x)
+        def clean(x):
+            n = jax.process_count()
+            g = lambda v: lax.psum(v, "event")   # n NOT captured
+            return g(x)
+        """))
+    findings = analyze_paths([p])
+    assert {f.rule for f in findings} == {"CL404"}
+    assert all(f.line <= 9 for f in findings)    # none in clean()
+
+
+def test_taint_flows_through_method_calls(tmp_path):
+    """self.helper(tainted) must taint the parameter AFTER the implicit
+    receiver (review catch: positional binding off by one landed the
+    taint on 'self' and dropped the flow)."""
+    p = tmp_path / "meth.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+        class Runner:
+            def helper(self, x, idx):
+                return lax.psum(x * idx, "event")
+            def go(self, x):
+                return self.helper(x, jax.process_index())
+        """))
+    assert {f.rule for f in analyze_paths([p])} == {"CL404"}
+
+
+def test_taint_is_definition_order_independent(tmp_path):
+    """Two review catches: (a) a param-pass-through chain whose CALLER
+    is defined before its callee must still propagate (propagates_params
+    now converges inside the fixpoint loop); (b) taint introduced by a
+    walrus inside an `if` TEST must reach the summaries (the test is
+    evaluated in every pass, not just the findings pass)."""
+    p = tmp_path / "order.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+        def use(x):
+            return lax.psum(outer(x, jax.process_index()), "event")
+        def outer(v, i):
+            return inner(v, i)
+        def inner(v, i):
+            return v * i
+        """))
+    assert {f.rule for f in analyze_paths([p])} == {"CL404"}
+    q = tmp_path / "walrus.py"
+    q.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+        def get():
+            if (n := jax.process_index()) > 0:
+                pass
+            return n
+        def use(x):
+            return lax.psum(x * get(), "event")
+        """))
+    assert "CL404" in {f.rule for f in analyze_paths([q])}
+
+
+def test_taint_marker_and_suppression(tmp_path):
+    """`# consensus-lint: host-divergent` turns a function's return into
+    a source; `# consensus-lint: disable=CL403` silences the sink line."""
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        def topology_query(d):  # consensus-lint: host-divergent
+            return getattr(d, "slice_index", 0)
+        def build():
+            devs = [d for d in jax.devices() if topology_query(d) == 0]
+            return Mesh(np.array(devs), ("event",))
+        """))
+    assert {f.rule for f in analyze_paths([p])} == {"CL403"}
+    src = p.read_text().replace(
+        'return Mesh(np.array(devs), ("event",))',
+        'return Mesh(np.array(devs), ("event",))'
+        '  # consensus-lint: disable=CL403')
+    p.write_text(src)
+    assert analyze_paths([p]) == []
+
+
+# ------------------------------------------- Layer 3b: schedule checks
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+
+    from pyconsensus_tpu.parallel import make_mesh
+    assert len(jax.devices()) == 8
+    return make_mesh(batch=1, event=8)
+
+
+def _sm_jaxpr(body, mesh, in_spec, out_spec):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from pyconsensus_tpu.parallel.ring import shard_map
+    f = shard_map(body, mesh, in_spec or P(None, "event"),
+                  out_spec or P(None, "event"))
+    return jax.make_jaxpr(f)(jnp.ones((4, 8)))
+
+
+def test_schedule_flags_unbalanced_cond(mesh8):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def unbal(x):
+        return lax.cond(jnp.sum(x) > 0,
+                        lambda v: lax.psum(v, "event"), lambda v: v, x)
+
+    found = check_schedule("t", _sm_jaxpr(unbal, mesh8, None, None))
+    assert [f.rule for f in found] == ["CL411"]
+    assert "different collective sequences" in found[0].message
+
+    def balanced(x):
+        return lax.cond(jnp.sum(x) > 0,
+                        lambda v: lax.psum(v, "event"),
+                        lambda v: lax.psum(2.0 * v, "event"), x)
+
+    assert check_schedule("t", _sm_jaxpr(balanced, mesh8, None, None)) == []
+
+
+def test_schedule_flags_non_bijective_ppermute(mesh8):
+    from jax import lax
+
+    def partial_perm(x):                 # a dropped ring hop
+        return lax.ppermute(x, "event", [(0, 1)])
+
+    found = check_schedule("t", _sm_jaxpr(partial_perm, mesh8, None, None))
+    assert [f.rule for f in found] == ["CL412"]
+
+    def full_ring(x):
+        return lax.ppermute(x, "event",
+                            [(i, (i + 1) % 8) for i in range(8)])
+
+    assert check_schedule("t", _sm_jaxpr(full_ring, mesh8, None, None)) == []
+
+
+def test_check_perm_unit_cases():
+    ring = [(i, (i + 1) % 8) for i in range(8)]
+    assert _check_perm(ring, 8) is None
+    assert "duplicate destination" in _check_perm([(0, 1), (1, 1)], 8)
+    assert "duplicate source" in _check_perm([(0, 1), (0, 2)], 8)
+    assert "out of range" in _check_perm([(0, 9)], 8)
+    assert "covers" in _check_perm(ring[:-1], 8)
+    assert _check_perm(ring, None) is None       # unknown axis size
+
+
+def test_schedule_flags_unbound_axis():
+    import jax
+    from jax import lax
+
+    jaxpr = jax.make_jaxpr(lambda x: lax.psum(x, "ghost"),
+                           axis_env=[("ghost", 8)])(1.0)
+    found = check_schedule("t", jaxpr, {"event": 8})
+    assert [f.rule for f in found] == ["CL413"]
+    assert "ghost" in found[0].message
+    assert check_schedule("t", jaxpr, {"event": 8, "ghost": 8}) == []
+
+
+def test_schedule_walks_while_loops(mesh8):
+    """Collectives inside while bodies are part of the schedule: the
+    bijection/binding checks reach them (a malformed perm in a ring
+    LOOP is exactly the ring_allreduce bug class)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def looped(x):
+        def body(c):
+            i, v = c
+            return i + 1, lax.ppermute(v, "event", [(0, 1)])
+        _, out = lax.while_loop(lambda c: c[0] < 3, body,
+                                (jnp.asarray(0), x))
+        return out
+
+    found = check_schedule("t", _sm_jaxpr(looped, mesh8, None, None))
+    assert [f.rule for f in found] == ["CL412"]
+
+
+def test_real_schedules_are_clean():
+    """Every declared schedule target (ring primitives, fused shard_map
+    executable, streaming panel, light pipeline) traces and passes —
+    the live half of the CI gate, mirrored here so a deadlocking edit
+    fails fast in pytest too."""
+    assert run_schedules() == []
+
+
+def test_ring_schedule_shape():
+    """ring_gram's extracted schedule IS the documented two-phase ring:
+    ppermute-only (reduce-scatter + all-gather loops), every hop on the
+    event axis, no hidden psum fallback."""
+    from pyconsensus_tpu.analysis.schedule import (SCHEDULES,
+                                                   extract_schedule)
+
+    jaxpr, env = SCHEDULES["ring-gram"]()
+    msgs = []
+    seq = extract_schedule(jaxpr.jaxpr, dict(env), msgs)
+    assert msgs == []
+    assert [op for op, _ in seq] == ["ppermute", "ppermute"]
+    assert all(axes == ("event",) for _, axes in seq)
+
+
 # ------------------------------------------------------- baseline workflow
 
 def test_shipped_baseline_exactly_matches_tree():
     """The checked-in baseline accepts the CURRENT tree exactly: no new
-    findings (CI would be red) and no stale Layer-1 entries (the file
-    rotted). Accepted ``contract:*`` entries are out of scope here — this
-    test runs Layer 1 only, so it cannot observe them; the full check is
+    findings (CI would be red) and no stale static entries (the file
+    rotted). Covers Layer 1 AND the Layer-3a taint pass; accepted
+    ``contract:*`` / ``schedule:*`` entries are out of scope here — the
+    traced layers don't run in this test; the full check is
     `consensus-lint --strict` in tools/ci_rehearsal.sh."""
     baseline = load_baseline()
-    findings = lint_paths()
+    findings = lint_paths() + analyze_paths()
     new, matched, stale = match_baseline(findings, baseline)
     assert new == [], ("tree has non-baselined findings:\n"
                        + "\n".join(f.render() for f in new))
-    contract_fps = {e["fingerprint"] for e in baseline.get("findings", [])
-                    if e["path"].startswith("contract:")}
-    stale = [fp for fp in stale if fp not in contract_fps]
+    traced_fps = {e["fingerprint"] for e in baseline.get("findings", [])
+                  if e["path"].startswith(("contract:", "schedule:"))}
+    stale = [fp for fp in stale if fp not in traced_fps]
     assert stale == [], f"baseline entries no longer match the tree: {stale}"
 
 
@@ -420,3 +792,42 @@ def test_cli_json_format(tmp_path, capsys):
     assert rc == 1
     assert payload["new"][0]["rule"] == "CL201"
     assert "fingerprint" in payload["new"][0]
+
+
+def test_cli_exit_codes_on_seeded_divergence(tmp_path, capsys):
+    """The acceptance seed: a host-divergent value reaching a traced
+    branch must fail the default run (Layer 3a rides every lint run),
+    and --no-dataflow must wave the same file through."""
+    src = tmp_path / "div.py"
+    src.write_text(textwrap.dedent("""
+        import time
+        import jax
+        @jax.jit
+        def f(x):
+            if time.monotonic() > 0:
+                return x
+            return -x
+        """))
+    assert cli_run([str(src), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "CL401" in out
+    assert cli_run([str(src), "--no-baseline", "--no-dataflow"]) == 0
+
+
+def test_cli_select_covers_taint_rules(tmp_path):
+    src = tmp_path / "div.py"
+    src.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+        def f(x):
+            return lax.psum(x * jax.process_index(), "event")
+        """))
+    assert cli_run([str(src), "--no-baseline", "--select", "CL404"]) == 1
+    assert cli_run([str(src), "--no-baseline", "--select", "CL401"]) == 0
+
+
+def test_cli_list_rules_includes_layer3(capsys):
+    assert cli_run(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in list(DATAFLOW_RULES) + list(SCHEDULE_RULES):
+        assert rid in out

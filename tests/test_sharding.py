@@ -379,7 +379,9 @@ class TestFusedResolution:
         must behave exactly like the same matrix passed as floats — the
         encoded interpretation only engages when the matrix provably is
         encoded (code-review r5 find: unconditional dtype-sniffing would
-        have silently halved every raw int8 '1' vote to 0.5)."""
+        have silently halved every raw int8 '1' vote to 0.5). Since the
+        heuristic CANNOT prove this case, deciding it now warns; the
+        explicit ``encoded=False`` contract is silent."""
         from pyconsensus_tpu import Oracle
         from pyconsensus_tpu.models.pipeline import looks_encoded
         rng = np.random.default_rng(3)
@@ -390,13 +392,41 @@ class TestFusedResolution:
         for backend in ("numpy", "jax"):
             a = Oracle(reports=raw.astype(np.float64),
                        backend=backend).consensus()
-            b = Oracle(reports=raw, backend=backend).consensus()
+            with pytest.warns(UserWarning, match="ambiguous"):
+                b = Oracle(reports=raw, backend=backend).consensus()
             np.testing.assert_array_equal(
                 np.asarray(a["events"]["outcomes_final"], dtype=float),
                 np.asarray(b["events"]["outcomes_final"], dtype=float))
             np.testing.assert_array_equal(
                 np.asarray(a["agents"]["smooth_rep"], dtype=float),
                 np.asarray(b["agents"]["smooth_rep"], dtype=float))
+
+    def test_oracle_encoded_flag_contract(self):
+        """``Oracle(encoded=...)`` pins the int8 reading explicitly: both
+        values run silently, mismatched claims raise, and the flag is
+        validated against the matrix (satellite of the Layer-3 PR)."""
+        import warnings
+
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu import Oracle
+        from pyconsensus_tpu.models.pipeline import encode_reports
+        rng = np.random.default_rng(7)
+        raw = (rng.random((10, 8)) < 0.5).astype(np.int8)
+        src = np.where(rng.random((10, 8)) < 0.15, np.nan,
+                       raw.astype(np.float64))
+        enc = np.asarray(encode_reports(jnp.asarray(src)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")          # no warning allowed
+            o_raw = Oracle(reports=raw, encoded=False)
+            o_enc = Oracle(reports=enc, encoded=True)
+        np.testing.assert_array_equal(o_raw.reports,
+                                      raw.astype(np.float64))
+        assert np.array_equal(np.isnan(o_enc.reports), np.isnan(src))
+        with pytest.raises(ValueError, match="outside"):
+            Oracle(reports=enc, encoded=False)      # sentinel != raw
+        with pytest.raises(ValueError, match="int8"):
+            Oracle(reports=src, encoded=True)       # float can't be enc
 
     def test_pre_encoded_placement_preserves_dtype(self):
         """The sharded front-end's report placement must not cast the

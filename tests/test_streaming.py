@@ -145,6 +145,63 @@ class TestStreamingParity:
         assert list(tmp_path.glob("*-stage-*")) == []
         assert list(other.glob("*-stage-*")) == []
 
+    def test_sym_topk_whole_block_nan_fallback(self, monkeypatch):
+        """Regression (Layer-3 PR satellite): _sym_topk's degenerate-QR
+        guard must fall back WHOLE-BLOCK — ``jnp.isfinite(Q).all()`` —
+        like jax_kernels._top_pcs_orth_iter. The old elementwise
+        ``where(isfinite(Q), Q, V)`` spliced finite Q entries into V's
+        columns, handing a NON-orthonormal mixed block to the alignment
+        exit. Simulated here by making every in-loop QR return one NaN
+        column (the TPU rank-loss shape): the fallback must keep the
+        block exactly orthonormal, which the mixed block is not."""
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.parallel import streaming as st
+
+        real_qr = jnp.linalg.qr
+        calls = []
+
+        def poisoned_qr(a, *args, **kw):
+            out = real_qr(a, *args, **kw)
+            calls.append(1)
+            if len(calls) == 1:          # the start-block QR stays clean
+                return out
+            q, r = out
+            return q.at[:, -1].set(jnp.nan), r
+
+        monkeypatch.setattr(jnp.linalg, "qr", poisoned_qr)
+        rng = np.random.default_rng(11)
+        u = rng.standard_normal(12)
+        Gd = jnp.asarray(np.outer(u, u))             # rank-1 PSD
+        lam, V = st._sym_topk(Gd, 3)
+        lam, V = np.asarray(lam), np.asarray(V)
+        assert np.isfinite(lam).all() and np.isfinite(V).all()
+        # the whole-block guarantee: the returned block is orthonormal
+        np.testing.assert_allclose(V.T @ V, np.eye(3), atol=1e-6)
+        assert (lam >= 0).all()
+
+    def test_sym_topk_matches_eigh_and_poisons_nonfinite(self):
+        """Unmocked behavior: top-k eigenpairs of an explicit PSD matrix
+        match eigh, and a non-finite accumulator poisons the outputs
+        loudly instead of 'converging' on the random start block."""
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.parallel import streaming as st
+
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((10, 6))
+        Gd = jnp.asarray(A @ A.T)                    # rank 6 PSD
+        lam, V = st._sym_topk(Gd, 3)
+        ref_vals = np.linalg.eigvalsh(np.asarray(Gd))[::-1][:3]
+        np.testing.assert_allclose(np.asarray(lam), ref_vals,
+                                   rtol=1e-5, atol=1e-8)
+        GV = np.asarray(Gd) @ np.asarray(V)
+        np.testing.assert_allclose(GV, np.asarray(V) * np.asarray(lam),
+                                   atol=1e-4 * ref_vals[0])
+        lam_bad, V_bad = st._sym_topk(Gd.at[0, 0].set(jnp.nan), 2)
+        assert np.isnan(np.asarray(lam_bad)).all()
+        assert np.isnan(np.asarray(V_bad)).all()
+
     def test_rejects_unsupported(self, rng):
         reports, _ = collusion_reports(rng, R=8, E=6, liars=2)
         with pytest.raises(ValueError, match="unknown algorithm"):

@@ -35,11 +35,14 @@ export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
 export XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-echo "=== Lint (consensus-lint: AST rules + traced contracts) ==="
-# Layer 1 (JAX/TPU AST rules) over the package + Layer 2 (collective
+echo "=== Lint (consensus-lint: AST rules + contracts + deadlock pass) ==="
+# Layer 1 (JAX/TPU AST rules) + Layer 3a (interprocedural host-
+# divergence taint, CL401-404) over the package, Layer 2 (collective
 # inventory / f64 / host-callback / retrace contracts, compiled on the
-# 8-virtual-device CPU mesh). Fails on any non-baselined finding or
-# stale baseline entry; see docs/STATIC_ANALYSIS.md.
+# 8-virtual-device CPU mesh) and Layer 3b (collective-schedule deadlock
+# detection over the ring/fused/pipeline jaxprs, CL410-413). Fails on
+# any non-baselined finding or stale baseline entry; see
+# docs/STATIC_ANALYSIS.md.
 "$PY" -m pyconsensus_tpu.analysis --strict
 "$VENV/bin/consensus-lint" --list-rules >/dev/null && echo "console script consensus-lint OK"
 
