@@ -16,9 +16,13 @@ Public surface:
   checkpoint/resume (SURVEY.md §5).
 - :mod:`pyconsensus_tpu.io` — report-matrix IO: npy/csv on host (native
   multithreaded CSV parser), event-sharded loading straight onto a mesh.
+- :mod:`pyconsensus_tpu.obs` — the observability subsystem: span tracer,
+  metrics registry (Prometheus text exposition + JSONL sinks), and JAX
+  compile/retrace observability (docs/OBSERVABILITY.md).
 - :mod:`pyconsensus_tpu.utils` — phase timers and profiler hooks.
 """
 
+from . import obs
 from .ledger import ReputationLedger
 from .models.pipeline import decode_reports, encode_reports
 from .oracle import ALGORITHMS, BACKENDS, Oracle
@@ -27,4 +31,4 @@ from .sweep import compare_algorithms, disagreement_matrix
 __version__ = "0.1.0"
 __all__ = ["Oracle", "ReputationLedger", "ALGORITHMS", "BACKENDS",
            "compare_algorithms", "disagreement_matrix",
-           "encode_reports", "decode_reports", "__version__"]
+           "encode_reports", "decode_reports", "obs", "__version__"]

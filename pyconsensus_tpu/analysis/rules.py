@@ -49,6 +49,13 @@ RULES = {
     "CL201": ("warning", "mutable default argument"),
     "CL202": ("warning", "bare except clause"),
     "CL203": ("warning", "unused module-level import"),
+    "CL501": ("error", "obs span/metric emission inside a jit-traced or "
+                       "shard_map context (telemetry is host-side only: "
+                       "in traced code it runs once per TRACE, and span "
+                       "exit is a host sync)"),
+    "CL502": ("error", "host wall-clock timer (time.*) or PhaseTimer "
+                       "inside a jit-traced context (measures tracing, "
+                       "not execution)"),
 }
 
 #: callables that trace their function argument into an XLA graph
@@ -78,6 +85,48 @@ _NP_SYNC_CALLS = {"asarray", "array", "asanyarray", "ascontiguousarray"}
 
 #: attribute calls that synchronize with the device regardless of root
 _ATTR_SYNC_CALLS = {"item", "block_until_ready", "tolist"}
+
+#: the obs package's emission API (CL501 sources). Kept to EMISSION
+#: entry points — registration-only helpers would be equally wrong in
+#: traced code, but emission is what actually corrupts measurements.
+_OBS_API = {
+    "span", "observe", "current_span", "counter", "gauge", "histogram",
+    "value", "events", "report", "render_prom", "reset", "write_jsonl",
+    "write_prom", "read_jsonl", "span_tree", "instrument_jit",
+    "install_compile_monitor",
+}
+
+#: metric-object methods (CL501 when the receiver was built from an obs
+#: call in the same scope)
+_OBS_EMIT_METHODS = {"inc", "set", "observe", "set_attr"}
+
+#: host wall-clock reads (CL502): under trace these stamp TRACE time into
+#: whatever consumes them, and the jit cache makes later calls not even
+#: re-run them
+_TIME_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+    "time.monotonic_ns",
+}
+
+
+def _is_obs_dotted(dotted: Optional[str]) -> bool:
+    """Whether a canonicalized dotted call path roots in the obs package:
+    ``obs.span`` / ``obs.TRACER.span`` (from-import of the module, any
+    relative depth strips to 'obs'), ``pyconsensus_tpu.obs.*``, or a name
+    imported from the obs module (canon maps it to ``obs.<name>`` /
+    ``pyconsensus_tpu.obs.<name>``)."""
+    if not dotted:
+        return False
+    parts = dotted.split(".")
+    if "obs" not in parts[:2]:
+        return False
+    if parts[0] == "obs" or (parts[0] == "pyconsensus_tpu"
+                             and parts[1] == "obs"):
+        leaf = parts[-1]
+        return leaf in _OBS_API or leaf in _OBS_EMIT_METHODS or (
+            len(parts) > (2 if parts[0] == "obs" else 3))
+    return False
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -492,10 +541,69 @@ def _rule_unused_import(mod: _Module) -> Iterable[Finding]:
                       f"import '{name}' is never used")
 
 
+def _obs_handle_names(mod: _Module, fn: ast.AST) -> Set[str]:
+    """Names in ``fn`` assigned from an obs-rooted call — metric handles
+    (``residual = obs.histogram(...)``) whose later ``.observe()`` /
+    ``.inc()`` is still an obs emission."""
+    out: Set[str] = set()
+    for node in _walk_scope(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_obs_dotted(mod.aliases.canon(_dotted(node.value.func))):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _rule_obs_in_traced(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        handles = _obs_handle_names(mod, fn)
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if _is_obs_dotted(dotted):
+                yield _mk(mod, node, "CL501",
+                          f"'{dotted}' emits telemetry inside traced "
+                          f"function '{fn.name}' — spans/metrics run "
+                          f"once per trace there and span exit is a "
+                          f"host sync; emit from the host caller")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _OBS_EMIT_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in handles):
+                yield _mk(mod, node, "CL501",
+                          f"'.{node.func.attr}()' on obs metric handle "
+                          f"'{node.func.value.id}' inside traced "
+                          f"function '{fn.name}' — emit from the host "
+                          f"caller")
+
+
+def _rule_host_timer_in_traced(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if dotted in _TIME_CALLS:
+                yield _mk(mod, node, "CL502",
+                          f"'{dotted}' inside traced function "
+                          f"'{fn.name}' stamps TRACE time into the "
+                          f"graph (and never re-runs on cached calls) — "
+                          f"time on the host, or use obs spans around "
+                          f"the dispatch")
+            elif dotted.split(".")[-1] == "PhaseTimer":
+                yield _mk(mod, node, "CL502",
+                          f"PhaseTimer constructed inside traced "
+                          f"function '{fn.name}' — phase timing is "
+                          f"host-side only")
+
+
 _ALL_RULES = (
     _rule_host_sync, _rule_traced_branch, _rule_key_reuse,
     _rule_f64_in_kernel, _rule_weak_where, _rule_mutable_default,
-    _rule_bare_except, _rule_unused_import,
+    _rule_bare_except, _rule_unused_import, _rule_obs_in_traced,
+    _rule_host_timer_in_traced,
 )
 
 

@@ -273,6 +273,19 @@ def main(argv: Optional[Sequence[str]] = None,
                     help="write a jax.profiler trace of each resolution "
                          "(demo, --file, --stream, or --simulate sweep) "
                          "to DIR (open with TensorBoard / Perfetto)")
+    ap.add_argument("--metrics-out", metavar="PATH",
+                    help="write the run's metrics registry (convergence "
+                         "iterations, phase durations, jit retraces, "
+                         "NA-fill and collective counters — see "
+                         "docs/OBSERVABILITY.md) as Prometheus text "
+                         "exposition to PATH on exit")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="write the run's span tree (one JSON object per "
+                         "line; reconstruct with "
+                         "pyconsensus_tpu.obs.span_tree) to PATH on exit")
+    ap.add_argument("--obs-report", action="store_true",
+                    help="print the human-readable span tree after the "
+                         "run")
     ap.add_argument("--bounds", metavar="PATH",
                     help="with --file: JSON event-bounds sidecar — a list "
                          "with one entry per event, null for binary or "
@@ -335,6 +348,14 @@ def main(argv: Optional[Sequence[str]] = None,
     if not (args.example or args.missing or args.scaled or args.simulate
             or args.file):
         args.example = True  # default demo, like the reference CLI
+
+    if args.metrics_out or args.trace_out or args.obs_report:
+        from . import obs
+
+        # the jax.monitoring feed catches compiles the per-entry jit
+        # wrappers can't see; installed before the first resolution so
+        # warm-up compiles are counted too
+        obs.install_compile_monitor()
 
     if args.stream and not args.file:
         ap.error("--stream requires --file")
@@ -423,6 +444,24 @@ def main(argv: Optional[Sequence[str]] = None,
                   SCALED_BOUNDS, args)
     if args.simulate:
         _run_simulation(args)
+    if args.metrics_out or args.trace_out or args.obs_report:
+        from . import obs
+
+        if args.metrics_out:
+            obs.write_prom(args.metrics_out, obs.REGISTRY)
+            print(f"metrics written to {args.metrics_out} "
+                  f"(Prometheus text exposition)")
+        if args.trace_out:
+            n = obs.write_jsonl(
+                args.trace_out, obs.events(),
+                meta={"prog": prog,
+                      "argv": list(argv if argv is not None
+                                   else sys.argv[1:])})
+            print(f"span trace written to {args.trace_out} "
+                  f"({n} JSONL record(s))")
+        if args.obs_report:
+            print("\n=== Span tree (slowest roots first) ===")
+            print(obs.report())
     return 0
 
 

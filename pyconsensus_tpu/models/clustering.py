@@ -209,24 +209,27 @@ def hierarchical_conformity(reports_filled, reputation, threshold,
     available, with a scipy fallback — both implement scipy
     ``linkage(method="average")`` + ``fcluster(criterion="distance")``
     semantics and produce identical partitions (tests/test_native.py)."""
-    from .. import _native
+    from .. import _native, obs
 
     X = np.asarray(reports_filled, dtype=np.float64)
     rep = np.asarray(reputation, dtype=np.float64)
     if X.shape[0] == 1:
         return rep.copy()
-    if sq_dists is None:
-        sq_dists = _pairwise_sq_dists_np(X)
-    d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
-    np.fill_diagonal(d, 0.0)
-    t_eff = _linkage_threshold(d, threshold)
-    labels = _native.avg_linkage_labels(d, t_eff)
-    if labels is None:
-        from scipy.cluster.hierarchy import fcluster, linkage
-        from scipy.spatial.distance import squareform
+    with obs.span("clustering.hierarchical", reporters=rep.shape[0]) as sp:
+        if sq_dists is None:
+            sq_dists = _pairwise_sq_dists_np(X)
+        d = np.sqrt(np.asarray(sq_dists, dtype=np.float64))
+        np.fill_diagonal(d, 0.0)
+        t_eff = _linkage_threshold(d, threshold)
+        labels = _native.avg_linkage_labels(d, t_eff)
+        sp.set_attr("native", labels is not None)
+        if labels is None:
+            from scipy.cluster.hierarchy import fcluster, linkage
+            from scipy.spatial.distance import squareform
 
-        Z = linkage(squareform(d, checks=False), method="average")
-        labels = fcluster(Z, t=t_eff, criterion="distance")
+            Z = linkage(squareform(d, checks=False), method="average")
+            labels = fcluster(Z, t=t_eff, criterion="distance")
+        sp.set_attr("clusters", int(len(np.unique(labels))))
     return _cluster_mass(labels, rep)
 
 
@@ -348,30 +351,34 @@ def dbscan_conformity(reports_filled, reputation, eps, min_samples,
     The BFS cluster expansion runs in the native C++ runtime
     (native/cluster.cpp) when available, with an sklearn fallback — both
     implement ``DBSCAN(metric="precomputed")`` semantics."""
-    from .. import _native
+    from .. import _native, obs
 
     X = np.asarray(reports_filled, dtype=np.float64)
     rep = np.asarray(reputation, dtype=np.float64)
-    if sq_dists is None:
-        sq_dists = _pairwise_sq_dists_np(X)
-    d2 = np.asarray(sq_dists, dtype=np.float64)
-    d = np.sqrt(d2)
-    # same eps^2 boundary band as the jit variant (see DBSCAN_D2_ATOL):
-    # the device- and host-computed distance matrices differ at the last
-    # ulp exactly where the report lattice concentrates true distances
-    eps_eff = float(np.sqrt(_d2_threshold(d2, float(eps))))
-    labels = _native.dbscan_labels(d, eps_eff, min_samples)
-    if labels is None:
-        from sklearn.cluster import DBSCAN
+    with obs.span("clustering.dbscan", reporters=rep.shape[0]) as sp:
+        if sq_dists is None:
+            sq_dists = _pairwise_sq_dists_np(X)
+        d2 = np.asarray(sq_dists, dtype=np.float64)
+        d = np.sqrt(d2)
+        # same eps^2 boundary band as the jit variant (DBSCAN_D2_ATOL):
+        # the device- and host-computed distance matrices differ at the
+        # last ulp exactly where the report lattice concentrates true
+        # distances
+        eps_eff = float(np.sqrt(_d2_threshold(d2, float(eps))))
+        labels = _native.dbscan_labels(d, eps_eff, min_samples)
+        sp.set_attr("native", labels is not None)
+        if labels is None:
+            from sklearn.cluster import DBSCAN
 
-        labels = DBSCAN(eps=eps_eff, min_samples=min_samples,
-                        metric="precomputed").fit(d).labels_
-    # noise -> unique singleton labels
-    labels = labels.astype(np.int64)
-    next_label = labels.max() + 1 if labels.size else 0
-    out = labels.copy()
-    for i, lbl in enumerate(labels):
-        if lbl == -1:
-            out[i] = next_label
-            next_label += 1
+            labels = DBSCAN(eps=eps_eff, min_samples=min_samples,
+                            metric="precomputed").fit(d).labels_
+        # noise -> unique singleton labels
+        labels = labels.astype(np.int64)
+        next_label = labels.max() + 1 if labels.size else 0
+        out = labels.copy()
+        for i, lbl in enumerate(labels):
+            if lbl == -1:
+                out[i] = next_label
+                next_label += 1
+        sp.set_attr("clusters", int(len(np.unique(out))))
     return _cluster_mass(out, rep)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import obs
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 from . import clustering as cl
@@ -194,8 +195,15 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
     reports = np.asarray(reports, dtype=np.float64)
     old_rep = nk.normalize(np.asarray(reputation, dtype=np.float64))
     scaled = np.asarray(scaled, dtype=bool)
-    rescaled = nk.rescale(reports, scaled, mins, maxs)
-    filled = nk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
+    with obs.span("np.fill", algorithm=p.algorithm):
+        n_na = int(np.isnan(reports).sum())
+        if n_na:
+            obs.counter(
+                "pyconsensus_na_fills_total",
+                "NaN report cells filled by interpolate, per backend",
+                labels=("backend",)).inc(n_na, backend="numpy")
+        rescaled = nk.rescale(reports, scaled, mins, maxs)
+        filled = nk.interpolate(rescaled, old_rep, scaled, p.catch_tolerance)
 
     rep = old_rep
     this_rep = old_rep
@@ -203,22 +211,33 @@ def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
     ica_converged = None
     converged = False
     iterations = 0
-    for _ in range(max(p.max_iterations, 1)):
-        adj, loading, ica_converged = _scores_np(filled, rep, p)
-        this_rep = nk.row_reward_weighted(adj, rep)
-        new_rep = nk.smooth(this_rep, rep, p.alpha)
-        delta = float(np.max(np.abs(new_rep - rep)))
-        rep = new_rep
-        iterations += 1
-        if delta <= p.convergence_tolerance:
-            converged = True
-            break
+    residual = obs.histogram(
+        "pyconsensus_convergence_residual",
+        "max-abs reputation change per redistribution iteration",
+        labels=("backend",), buckets=obs.MAGNITUDE_BUCKETS)
+    with obs.span("np.iterate", algorithm=p.algorithm) as sp:
+        for _ in range(max(p.max_iterations, 1)):
+            adj, loading, ica_converged = _scores_np(filled, rep, p)
+            this_rep = nk.row_reward_weighted(adj, rep)
+            new_rep = nk.smooth(this_rep, rep, p.alpha)
+            delta = float(np.max(np.abs(new_rep - rep)))
+            residual.observe(delta, backend="numpy")
+            rep = new_rep
+            iterations += 1
+            if delta <= p.convergence_tolerance:
+                converged = True
+                break
+        sp.set_attr("iterations", iterations)
+        sp.set_attr("converged", converged)
 
-    outcomes_raw, outcomes_adjusted = nk.resolve_outcomes(
-        rescaled, filled, rep, scaled, p.catch_tolerance)
-    outcomes_final = nk.unscale_outcomes(outcomes_adjusted, scaled, mins, maxs)
-    extras = nk.certainty_and_bonuses(rescaled, filled, rep, outcomes_adjusted,
-                                      scaled, p.catch_tolerance)
+    with obs.span("np.resolve", algorithm=p.algorithm):
+        outcomes_raw, outcomes_adjusted = nk.resolve_outcomes(
+            rescaled, filled, rep, scaled, p.catch_tolerance)
+        outcomes_final = nk.unscale_outcomes(outcomes_adjusted, scaled, mins,
+                                             maxs)
+        extras = nk.certainty_and_bonuses(rescaled, filled, rep,
+                                          outcomes_adjusted, scaled,
+                                          p.catch_tolerance)
     result = {
         "original": reports,
         "rescaled": rescaled,
@@ -422,8 +441,9 @@ def _consensus_core(reports, reputation, scaled, mins, maxs, p: ConsensusParams)
     return result
 
 
-consensus_jit = jax.jit(jk.exact_matmuls(_consensus_core),
-                        static_argnames=("p",))
+consensus_jit = obs.instrument_jit(
+    jax.jit(jk.exact_matmuls(_consensus_core), static_argnames=("p",)),
+    "consensus_core")
 
 #: keys whose values are (R, E)-sized — everything else is O(R) or O(E)
 _LARGE_RESULT_KEYS = ("original", "rescaled", "filled")
@@ -878,8 +898,9 @@ def _consensus_core_light(reports, reputation, scaled, mins, maxs,
     return result
 
 
-consensus_light_jit = jax.jit(_consensus_core_light,
-                              static_argnames=("p",))
+consensus_light_jit = obs.instrument_jit(
+    jax.jit(_consensus_core_light, static_argnames=("p",)),
+    "consensus_light")
 
 
 @functools.partial(jax.jit, static_argnames=("tolerance", "storage_dtype"))
@@ -972,9 +993,11 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     # arrays inside a distributed runtime must keep the single-controller
     # flow — local arrays have no mesh to reshard over)
     multiproc = not getattr(reports, "is_fully_addressable", True)
-    old_rep, rescaled, filled, present, sq_dev = _hybrid_prep_jit(
-        reports, reputation, scaled, mins, maxs, p.catch_tolerance,
-        p.storage_dtype)
+    with obs.span("hybrid.device_prep", algorithm=p.algorithm) as sp:
+        old_rep, rescaled, filled, present, sq_dev = _hybrid_prep_jit(
+            reports, reputation, scaled, mins, maxs, p.catch_tolerance,
+            p.storage_dtype)
+        sp.observe(sq_dev)
     repl = None
     if multiproc:
         # pin the R×R distances AND the reputation replicated (a jitted
@@ -999,21 +1022,29 @@ def _consensus_hybrid(reports, reputation, scaled, mins, maxs,
     this_rep = rep
     converged = False
     iterations = 0
-    for _ in range(max(p.max_iterations, 1)):
-        if p.algorithm == "hierarchical":
-            adj = cl.hierarchical_conformity(filled_host, rep,
-                                             p.hierarchy_threshold, sq_dists=sq)
-        else:
-            adj = cl.dbscan_conformity(filled_host, rep, p.dbscan_eps,
-                                       p.dbscan_min_samples, sq_dists=sq)
-        this_rep = nk.row_reward_weighted(adj, rep)
-        new_rep = nk.smooth(this_rep, rep, p.alpha)
-        delta = float(np.max(np.abs(new_rep - rep)))
-        rep = new_rep
-        iterations += 1
-        if delta <= p.convergence_tolerance:
-            converged = True
-            break
+    residual = obs.histogram(
+        "pyconsensus_convergence_residual",
+        "max-abs reputation change per redistribution iteration",
+        labels=("backend",), buckets=obs.MAGNITUDE_BUCKETS)
+    with obs.span("hybrid.cluster", algorithm=p.algorithm) as sp:
+        for _ in range(max(p.max_iterations, 1)):
+            if p.algorithm == "hierarchical":
+                adj = cl.hierarchical_conformity(
+                    filled_host, rep, p.hierarchy_threshold, sq_dists=sq)
+            else:
+                adj = cl.dbscan_conformity(filled_host, rep, p.dbscan_eps,
+                                           p.dbscan_min_samples, sq_dists=sq)
+            this_rep = nk.row_reward_weighted(adj, rep)
+            new_rep = nk.smooth(this_rep, rep, p.alpha)
+            delta = float(np.max(np.abs(new_rep - rep)))
+            residual.observe(delta, backend="hybrid")
+            rep = new_rep
+            iterations += 1
+            if delta <= p.convergence_tolerance:
+                converged = True
+                break
+        sp.set_attr("iterations", iterations)
+        sp.set_attr("converged", converged)
 
     dtype = jnp.asarray(0.0).dtype
     if multiproc:
@@ -1057,7 +1088,15 @@ def consensus_jax(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
     mins = jnp.asarray(mins, dtype=dtype)
     maxs = jnp.asarray(maxs, dtype=dtype)
     if p.algorithm in JIT_ALGORITHMS:
-        return consensus_jit(reports, reputation, scaled, mins, maxs, p)
+        # dispatch-only span: the jit result stays on device (async), so
+        # this measures trace+dispatch; Oracle.consensus' enclosing span
+        # owns the blocking end-to-end time
+        with obs.span("pipeline.dispatch", algorithm=p.algorithm,
+                      path="jit"):
+            return consensus_jit(reports, reputation, scaled, mins, maxs, p)
     if p.algorithm in HYBRID_ALGORITHMS:
-        return _consensus_hybrid(reports, reputation, scaled, mins, maxs, p)
+        with obs.span("pipeline.dispatch", algorithm=p.algorithm,
+                      path="hybrid"):
+            return _consensus_hybrid(reports, reputation, scaled, mins,
+                                     maxs, p)
     raise ValueError(f"unknown algorithm: {p.algorithm!r}")

@@ -53,8 +53,12 @@ def sztorc_scores_np(reports_filled, reputation):
     """Direction-fixed first-principal-component scores (numpy). Returns
     ``(adj_scores, loading)`` — the loading is reported in the result dict,
     so it is computed once here rather than re-decomposed after the loop."""
-    loading, scores = nk.weighted_prin_comp(reports_filled, reputation)
-    return nk.direction_fixed_scores(scores, reports_filled, reputation), loading
+    from .. import obs
+
+    with obs.span("np.scores", algorithm="sztorc"):
+        loading, scores = nk.weighted_prin_comp(reports_filled, reputation)
+        return (nk.direction_fixed_scores(scores, reports_filled,
+                                          reputation), loading)
 
 
 def sztorc_scores_jax(reports_filled, reputation, pca_method="auto",
@@ -99,15 +103,19 @@ def fixed_variance_scores_np(reports_filled, reputation, variance_threshold,
     """``fixed-variance`` variant: blend direction-fixed scores of the top
     components, weighted by explained variance, until ``variance_threshold``
     of the spectrum is covered (SURVEY.md §2 #10)."""
+    from .. import obs
+
     k = min(max_components, min(reports_filled.shape))
-    loadings, scores, explained = nk.weighted_prin_comps(reports_filled,
-                                                         reputation, k)
-    w = _component_weights_np(explained, variance_threshold)
-    adj = np.zeros(reports_filled.shape[0], dtype=np.float64)
-    for c in range(k):
-        adj_c = nk.direction_fixed_scores(scores[:, c], reports_filled, reputation)
-        adj = adj + w[c] * adj_c
-    return adj, loadings[:, 0]
+    with obs.span("np.scores", algorithm="fixed-variance", components=k):
+        loadings, scores, explained = nk.weighted_prin_comps(reports_filled,
+                                                             reputation, k)
+        w = _component_weights_np(explained, variance_threshold)
+        adj = np.zeros(reports_filled.shape[0], dtype=np.float64)
+        for c in range(k):
+            adj_c = nk.direction_fixed_scores(scores[:, c], reports_filled,
+                                              reputation)
+            adj = adj + w[c] * adj_c
+        return adj, loadings[:, 0]
 
 
 def fixed_variance_k(n_reporters: int, n_events: int,

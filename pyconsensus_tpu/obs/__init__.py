@@ -1,0 +1,109 @@
+"""pyconsensus_tpu.obs — zero-dependency observability subsystem
+(ISSUE 3 tentpole): span tracer + metrics registry + sinks + JAX compile
+observability, instrumenting every layer of the pipeline.
+
+Quick use::
+
+    from pyconsensus_tpu import obs
+
+    with obs.span("resolve", algorithm="sztorc") as sp:
+        out = oracle.consensus()
+        sp.observe(out)                   # block device time into the span
+    obs.counter("my_total").inc()
+    print(obs.report())                   # human span tree
+    print(obs.render_prom())              # Prometheus text exposition
+    obs.write_jsonl("trace.jsonl", obs.events())
+
+Rules of engagement:
+
+- **host-side only.** Spans and metrics are Python; inside jit-traced /
+  shard_map / pallas code they would run once per trace and try to sync
+  the device mid-graph. consensus-lint CL501/CL502 reject this statically.
+- **process-wide singletons.** ``REGISTRY`` and ``TRACER`` are the
+  default sinks so library code needs no plumbing; ``reset()`` clears
+  both (tests, CLI runs). Constructing private ``MetricsRegistry`` /
+  ``Tracer`` instances is supported for isolation.
+- **metric catalog** lives in docs/OBSERVABILITY.md — names follow
+  Prometheus conventions; add new metrics there when instrumenting code.
+"""
+
+from __future__ import annotations
+
+from .compilemon import (InstrumentedJit, install_compile_monitor,
+                         instrument_jit)
+from .metrics import (DURATION_BUCKETS, ITERATION_BUCKETS, MAGNITUDE_BUCKETS,
+                      Counter, Gauge, Histogram, MetricsRegistry)
+from .sinks import read_jsonl, span_tree, write_jsonl, write_prom
+from .tracer import Span, Tracer
+
+__all__ = [
+    "REGISTRY", "TRACER",
+    "span", "observe", "current_span", "counter", "gauge", "histogram",
+    "events", "report", "render_prom", "value", "reset",
+    "write_jsonl", "read_jsonl", "span_tree", "write_prom",
+    "instrument_jit", "install_compile_monitor", "InstrumentedJit",
+    "MetricsRegistry", "Tracer", "Span", "Counter", "Gauge", "Histogram",
+    "DURATION_BUCKETS", "ITERATION_BUCKETS", "MAGNITUDE_BUCKETS",
+]
+
+#: process-wide metrics registry (the default sink for library code)
+REGISTRY = MetricsRegistry()
+#: process-wide tracer; finished spans also feed
+#: ``pyconsensus_phase_seconds{phase=...}`` in REGISTRY
+TRACER = Tracer(registry=REGISTRY)
+
+
+def span(name: str, **attrs):
+    """Open a span on the process-wide tracer (context manager)."""
+    return TRACER.span(name, **attrs)
+
+
+def observe(value):
+    """Attach a device value to the current span's completion barrier."""
+    return TRACER.observe(value)
+
+
+def current_span():
+    return TRACER.current()
+
+
+def counter(name: str, help: str = "", labels=()):
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels=()):
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels=(),
+              buckets=DURATION_BUCKETS):
+    return REGISTRY.histogram(name, help, labels, buckets)
+
+
+def value(name: str, **labels):
+    """Fail-soft metric lookup (None when never emitted) — see
+    ``MetricsRegistry.value``."""
+    return REGISTRY.value(name, **labels)
+
+
+def events():
+    return TRACER.events()
+
+
+def report(max_spans: int = 200) -> str:
+    return TRACER.report(max_spans=max_spans)
+
+
+def render_prom() -> str:
+    return REGISTRY.render_prom()
+
+
+def reset() -> None:
+    """Clear the process-wide tracer and registry (tests / fresh CLI
+    runs). Compile-monitor installation state is NOT reset — the
+    jax.monitoring listener stays registered (jax has no unregister) and
+    both it and the per-entry jit wrappers resolve their metrics from the
+    registry lazily, so they repopulate a freshly-reset registry on the
+    next event."""
+    TRACER.reset()
+    REGISTRY.reset()

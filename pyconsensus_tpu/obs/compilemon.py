@@ -1,0 +1,156 @@
+"""JAX compile observability (ISSUE 3 tentpole (b), compile leg):
+per-entry-point retrace counters + compile-time gauges, plus the global
+``jax.monitoring`` compile-event feed when this jax version exposes it.
+
+Two complementary mechanisms:
+
+- :func:`instrument_jit` wraps a jitted callable and watches its
+  ``_cache_size()`` across calls — growth means this call traced and
+  compiled a new specialization. This is the *per entry point* signal:
+  ``pyconsensus_jit_retraces_total{entry=...}`` counts compiles (the
+  first compile counts as 1, so an entry point called twice with
+  identical (shape, dtype, params) must show the counter stable at 1 —
+  the same invariant consensus-lint CL304 pins statically), and
+  ``pyconsensus_jit_compile_seconds{entry=...}`` holds the wall time of
+  the most recent compiling call. When ``_cache_size`` is unavailable
+  (non-jit callables, exotic wrappers), the wrapper degrades to a plain
+  pass-through — never a crash.
+- :func:`install_compile_monitor` registers a ``jax.monitoring`` duration
+  listener (when this jax has one) feeding
+  ``pyconsensus_jax_compile_events_total{event=...}`` /
+  ``pyconsensus_jax_compile_seconds_total{event=...}`` — the global
+  backend-compile feed that catches compiles the wrappers can't see
+  (colliding lru-cached builds, library-internal jits).
+
+Both are host-side. The wrapper deliberately no-ops its bookkeeping when
+called under an active trace (``consensus_light_jit`` is re-entered
+inside ``jax.jit`` by the schedule analyzer): cache-size deltas observed
+mid-trace describe tracing, not execution.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["instrument_jit", "install_compile_monitor", "InstrumentedJit"]
+
+#: jax.monitoring event substrings worth surfacing (the full event
+#: namespace is an implementation detail; compile cost is the contract)
+_COMPILE_EVENT_MARKERS = ("compil", "trace", "lower")
+
+
+def _tracing_active() -> bool:
+    """True when called under an active jax trace — bookkeeping must
+    no-op there (and must never raise on jax-version drift). Fails
+    CLOSED: when trace-state introspection is unavailable (the API moves
+    across jax versions), assume tracing and skip bookkeeping — a
+    silently disabled counter degrades observability, but counting
+    per-trace phantom retraces breaks the CL304 ci-gate invariant."""
+    try:
+        import jax
+
+        return not jax.core.trace_state_clean()
+    except Exception:
+        return True
+
+
+class InstrumentedJit:
+    """Transparent wrapper around a jitted callable: ``__call__`` adds
+    retrace bookkeeping; every other attribute (``lower``,
+    ``_cache_size``, ``clear_cache``, ...) is forwarded untouched, so
+    existing callers that introspect the jit object keep working."""
+
+    def __init__(self, fn, entry: str, registry) -> None:
+        self._fn = fn
+        self._entry = entry
+        self._registry = registry
+
+    def __call__(self, *args, **kwargs):
+        cache_size = getattr(self._fn, "_cache_size", None)
+        if cache_size is None or _tracing_active():
+            return self._fn(*args, **kwargs)
+        try:
+            before = cache_size()
+        except Exception:
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        try:
+            grew = cache_size() - before
+        except Exception:
+            return out
+        if grew > 0:
+            dt = time.perf_counter() - t0
+            self._registry.counter(
+                "pyconsensus_jit_retraces_total",
+                "jit cache growth per entry point (1 = the initial "
+                "compile; >1 for repeat shapes/params means a retrace "
+                "leak)", labels=("entry",)).inc(grew, entry=self._entry)
+            self._registry.gauge(
+                "pyconsensus_jit_compile_seconds",
+                "wall time of the most recent compiling call (trace + "
+                "backend compile + first dispatch)",
+                labels=("entry",)).set(dt, entry=self._entry)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __repr__(self) -> str:
+        return f"InstrumentedJit({self._entry}, {self._fn!r})"
+
+
+def instrument_jit(fn, entry: str, registry=None):
+    """Wrap jitted ``fn`` so compiles are counted under ``entry`` in the
+    metrics registry (the process-wide default when ``registry`` is
+    omitted)."""
+    if registry is None:
+        from . import REGISTRY as registry          # noqa: N813
+    return InstrumentedJit(fn, entry, registry)
+
+
+_installed = [False]
+
+
+def install_compile_monitor(registry=None) -> bool:
+    """Register the global ``jax.monitoring`` duration listener feeding
+    the compile-event counters (idempotent; returns whether a listener is
+    active). Falls back to False — with the :func:`instrument_jit`
+    wrappers still covering the entry points — when this jax version has
+    no monitoring hooks."""
+    if _installed[0]:
+        return True
+    if registry is None:
+        from . import REGISTRY as registry          # noqa: N813
+    try:
+        import jax.monitoring as monitoring
+
+        register = monitoring.register_event_duration_secs_listener
+    except Exception:
+        return False
+
+    def _listener(event: str, duration: float, **kw) -> None:
+        if any(m in event for m in _COMPILE_EVENT_MARKERS):
+            # normalize the namespaced event to its leaf for label
+            # hygiene ("/jax/core/compile/backend_compile_duration" ->
+            # "backend_compile_duration"); metrics are resolved from the
+            # registry per event (compiles are rare) so an obs.reset()
+            # between events repopulates the fresh registry instead of
+            # feeding orphaned metric objects
+            leaf = event.rstrip("/").rsplit("/", 1)[-1] or event
+            registry.counter(
+                "pyconsensus_jax_compile_events_total",
+                "jax.monitoring compile/trace/lower events observed "
+                "process-wide", labels=("event",)).inc(1.0, event=leaf)
+            registry.counter(
+                "pyconsensus_jax_compile_seconds_total",
+                "cumulative seconds in jax.monitoring compile/trace/"
+                "lower events", labels=("event",)).inc(
+                    max(float(duration), 0.0), event=leaf)
+
+    try:
+        register(_listener)
+    except Exception:
+        return False
+    _installed[0] = True
+    return True

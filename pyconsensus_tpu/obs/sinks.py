@@ -1,0 +1,91 @@
+"""Telemetry sinks (ISSUE 3 tentpole (c)): JSONL span/event log,
+Prometheus text exposition, and the human report tree.
+
+The exposition itself lives with its data structure
+(``MetricsRegistry.render_prom`` / ``Tracer.report``); this module owns
+the file formats — JSONL writing, reading, and span-tree reconstruction —
+so tests and external consumers have one round-trip contract to pin.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["write_jsonl", "read_jsonl", "span_tree", "write_prom"]
+
+
+def write_jsonl(path, events: Sequence[dict], meta: Optional[dict] = None
+                ) -> int:
+    """Write one JSON object per line: an optional leading ``meta`` record
+    (``{"type": "meta", ...}``) followed by the events (normally
+    ``Tracer.events()``). Returns the number of records written. Parent
+    directories are created."""
+    p = pathlib.Path(path)
+    if p.parent and not p.parent.exists():
+        p.parent.mkdir(parents=True, exist_ok=True)
+    n = 0
+    with open(p, "w", encoding="utf-8") as f:
+        if meta is not None:
+            f.write(json.dumps({"type": "meta", **meta}, sort_keys=True)
+                    + "\n")
+            n += 1
+        for ev in events:
+            f.write(json.dumps(ev, sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+def read_jsonl(path) -> List[dict]:
+    """Read a JSONL file back to a list of dicts (blank lines skipped) —
+    the round-trip inverse of :func:`write_jsonl`."""
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def span_tree(events: Sequence[dict]) -> List[dict]:
+    """Reconstruct the nested span forest from flat span events (any
+    order): returns the list of root spans, each a copy carrying a
+    ``children`` list sorted by start time. Non-span records (meta) are
+    ignored; a span whose parent is missing from ``events`` (e.g. a
+    truncated log) becomes a root rather than being dropped."""
+    spans = [dict(ev) for ev in events if ev.get("type") == "span"]
+    # ids are keyed per (process_index, span_id): each host's tracer
+    # numbers span_ids from 1, so merged fleet JSONL would otherwise
+    # collide ids across hosts and mis-parent children (the per-host
+    # trees the tracer promises)
+    by_id: Dict[tuple, dict] = {}
+    for sp in spans:
+        sp["children"] = []
+        by_id[(sp.get("process_index", 0), sp["span_id"])] = sp
+    roots: List[dict] = []
+    for sp in spans:
+        parent = by_id.get((sp.get("process_index", 0),
+                            sp.get("parent_id", 0)))
+        if parent is not None and parent is not sp:
+            parent["children"].append(sp)
+        else:
+            roots.append(sp)
+    def _sort(nodes: List[dict]) -> None:
+        nodes.sort(key=lambda s: s.get("start_s", 0.0))
+        for n in nodes:
+            _sort(n["children"])
+    _sort(roots)
+    return roots
+
+
+def write_prom(path, registry) -> str:
+    """Render ``registry`` to Prometheus text format and write it to
+    ``path`` (parent directories created). Returns the rendered text."""
+    text = registry.render_prom()
+    p = pathlib.Path(path)
+    if p.parent and not p.parent.exists():
+        p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text, encoding="utf-8")
+    return text

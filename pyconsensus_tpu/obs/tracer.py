@@ -1,0 +1,292 @@
+"""Span tracer: nested, exception-safe phase spans with device-time
+attribution (ISSUE 3 tentpole (a)).
+
+The model is a Dapper-style span tree flattened to an event list: every
+``span(...)`` context manager opens a child of the innermost open span on
+the *current thread*, and closing it appends one finished-span record to
+the tracer. Library code emits spans without plumbing a timer object
+through call signatures — the process-wide default tracer lives in
+``pyconsensus_tpu.obs`` — and the streaming prefetch thread gets its own
+span stack (``threading.local``), so cross-thread nesting can never
+corrupt the tree.
+
+Device-time attribution: JAX dispatch is asynchronous, so a span that
+merely *dispatches* device work would charge the compute to whichever
+later span happens to block. ``Span.observe(value)`` marks values the
+span must wait on; span exit calls ``jax.block_until_ready`` on ALL of
+them (a list — the single-slot ``PhaseTimer._pending`` bug this subsystem
+replaces lost every value but the last). The block happens host-side at
+span exit; emitting spans *inside* jit-traced or shard_map code is
+statically rejected by consensus-lint CL501 (the span would time tracing,
+not execution, and the block would be a host sync in the graph).
+
+Multi-host: every span is tagged with the JAX process index (0 when the
+distributed runtime is uninitialized), so merged JSONL from a fleet still
+reconstructs per-host trees.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["Span", "Tracer"]
+
+_ids = itertools.count(1)
+_ids_lock = threading.Lock()
+
+
+def _next_id() -> int:
+    with _ids_lock:
+        return next(_ids)
+
+
+_proc_index_cache: List[Optional[int]] = [None]
+
+
+def _process_index() -> int:
+    """The JAX process index, resolved lazily on first span and cached —
+    import-time resolution would initialize the backend before the
+    launcher configures it. Falls back to 0 without jax or before
+    distributed init."""
+    if _proc_index_cache[0] is None:
+        try:
+            import jax
+
+            _proc_index_cache[0] = int(jax.process_index())
+        except Exception:
+            _proc_index_cache[0] = 0
+    return _proc_index_cache[0]
+
+
+def _block_all(values: list) -> None:
+    """``jax.block_until_ready`` over every observed value (it accepts
+    pytrees, so one call covers the list). Values without device buffers
+    (numpy, scalars) pass through untouched; without jax this is a no-op."""
+    if not values:
+        return
+    try:
+        import jax
+    except Exception:                       # pragma: no cover - no jax
+        return
+    jax.block_until_ready(values)
+
+
+class Span:
+    """One finished-or-open phase. Attributes are small JSON-able values
+    (strings/numbers/bools); anything else is stringified at export."""
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "depth",
+                 "process_index", "start_wall_s", "duration_s", "status",
+                 "error", "_t0", "_pending")
+
+    def __init__(self, name: str, attrs: Dict[str, object], parent_id: int,
+                 depth: int) -> None:
+        self.name = name
+        self.attrs = attrs
+        self.span_id = _next_id()
+        self.parent_id = parent_id          # 0 = root
+        self.depth = depth
+        self.process_index = _process_index()
+        self.start_wall_s = time.time()
+        self.duration_s: Optional[float] = None
+        self.status = "open"
+        self.error: Optional[str] = None
+        self._t0 = time.perf_counter()
+        self._pending: list = []
+
+    def observe(self, value):
+        """Mark a (possibly asynchronous) device value this span must wait
+        on before its clock stops. May be called any number of times; ALL
+        observed values are blocked on at exit. Returns ``value`` so call
+        sites can wrap an expression in place."""
+        self._pending.append(value)
+        return value
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def to_dict(self) -> dict:
+        attrs = {}
+        for k, v in self.attrs.items():
+            attrs[str(k)] = (v if isinstance(v, (str, int, float, bool))
+                             or v is None else str(v))
+        return {
+            "type": "span",
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "depth": self.depth,
+            "process_index": self.process_index,
+            "start_s": self.start_wall_s,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "error": self.error,
+            "attrs": attrs,
+        }
+
+
+class Tracer:
+    """Thread-aware span collector. ``registry`` (a
+    :class:`~pyconsensus_tpu.obs.metrics.MetricsRegistry`) is optional;
+    when given, every finished span also observes
+    ``pyconsensus_phase_seconds{phase=<name>}`` so phase durations show up
+    in the Prometheus exposition with zero extra call-site code."""
+
+    #: completed-span ring bound — a multi-hour sweep must not grow host
+    #: memory without bound; the metrics registry keeps the aggregates,
+    #: the span ring keeps the most recent trees for report()/JSONL
+    MAX_SPANS = 100_000
+
+    def __init__(self, registry=None, max_spans: Optional[int] = None
+                 ) -> None:
+        self._registry = registry
+        self._max_spans = int(max_spans if max_spans is not None
+                              else self.MAX_SPANS)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        # deque(maxlen): O(1) eviction — a list.pop(0) ring would go
+        # quadratic under the lock exactly on the long sweeps the bound
+        # exists for
+        self._finished: "collections.deque[Span]" = collections.deque(
+            maxlen=self._max_spans)
+        self._dropped = 0
+
+    # -- emission ----------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        """Open a child span of the innermost open span on this thread.
+        Exception-safe: an exception inside the body marks the span
+        ``status="error"`` (with the exception repr) and re-raises; the
+        span is recorded either way, and the stack is always popped."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        sp = Span(name, dict(attrs),
+                  parent.span_id if parent is not None else 0,
+                  parent.depth + 1 if parent is not None else 0)
+        stack.append(sp)
+        try:
+            yield sp
+            sp.status = "ok"
+        except BaseException as exc:
+            sp.status = "error"
+            sp.error = repr(exc)
+            raise
+        finally:
+            try:
+                _block_all(sp._pending)
+            except BaseException as exc:
+                # an observed value that failed ASYNCHRONOUSLY surfaces
+                # here (XlaRuntimeError at block time) — the span must
+                # not be recorded green for the phase that crashed; a
+                # body exception's status wins (it came first)
+                if sp.status != "error":
+                    sp.status = "error"
+                    sp.error = repr(exc)
+                raise
+            finally:
+                sp._pending = []
+                sp.duration_s = time.perf_counter() - sp._t0
+                stack.pop()
+                self._record(sp)
+
+    def observe(self, value):
+        """``Span.observe`` on the current span; a no-op pass-through when
+        no span is open (library code needn't care whether a caller
+        traced it)."""
+        sp = self.current()
+        if sp is not None:
+            return sp.observe(value)
+        return value
+
+    def _record(self, sp: Span) -> None:
+        with self._lock:
+            if len(self._finished) == self._max_spans:
+                self._dropped += 1          # deque(maxlen) evicts oldest
+            self._finished.append(sp)
+        if self._registry is not None:
+            self._registry.histogram(
+                "pyconsensus_phase_seconds",
+                "wall-clock span durations (device time attributed via "
+                "observed-value blocking)",
+                labels=("phase",)).observe(sp.duration_s, phase=sp.name)
+
+    # -- export ------------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    def events(self) -> List[dict]:
+        """Finished spans as JSON-ready dicts, in finish order (children
+        before parents — a JSONL reader rebuilds the tree from
+        parent_id)."""
+        return [sp.to_dict() for sp in self.spans()]
+
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def report(self, max_spans: int = 200) -> str:
+        """Human tree: one line per span, indented by nesting, slowest
+        roots first. ``max_spans`` caps the output (a long sweep has
+        thousands of identical panel spans; the metrics registry carries
+        the aggregates)."""
+        spans = self.spans()
+        known = {sp.span_id for sp in spans}
+        by_parent: Dict[int, List[Span]] = {}
+        for sp in spans:
+            # a child whose parent was evicted from the ring becomes a
+            # root (matching sinks.span_tree) instead of silently
+            # vanishing from the report
+            parent = sp.parent_id if sp.parent_id in known else 0
+            by_parent.setdefault(parent, []).append(sp)
+        lines: List[str] = []
+
+        def emit(sp: Span, indent: int) -> None:
+            if len(lines) >= max_spans:
+                return
+            ms = (sp.duration_s or 0.0) * 1e3
+            attrs = " ".join(f"{k}={v}" for k, v in sorted(
+                sp.to_dict()["attrs"].items()))
+            flag = "" if sp.status == "ok" else f" [{sp.status}]"
+            lines.append(f"{'  ' * indent}{sp.name:<{max(1, 40 - 2 * indent)}}"
+                         f" {ms:10.3f} ms{flag}"
+                         + (f"  ({attrs})" if attrs else ""))
+            for child in sorted(by_parent.get(sp.span_id, []),
+                                key=lambda s: s.start_wall_s):
+                emit(child, indent + 1)
+
+        roots = sorted(by_parent.get(0, []),
+                       key=lambda s: -(s.duration_s or 0.0))
+        for root in roots:
+            emit(root, 0)
+        if len(spans) > max_spans:
+            lines.append(f"... ({len(spans) - max_spans} more spans; "
+                         f"aggregates in the metrics registry)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._finished.clear()
+            self._dropped = 0
+        self._local = threading.local()
+
+    def __repr__(self) -> str:
+        return (f"Tracer(spans={len(self._finished)}, "
+                f"dropped={self._dropped}, max_spans={self._max_spans})")

@@ -26,12 +26,13 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from . import obs
 from .models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
                               ConsensusParams, consensus_jax, consensus_np)
 from .ops import jax_kernels as jk
 
 __all__ = ["Oracle", "ALGORITHMS", "BACKENDS", "parse_event_bounds",
-           "assemble_result"]
+           "assemble_result", "record_consensus_result"]
 
 ALGORITHMS = tuple(JIT_ALGORITHMS) + tuple(HYBRID_ALGORITHMS)
 BACKENDS = ("numpy", "jax")
@@ -112,6 +113,39 @@ def assemble_result(raw: dict) -> dict:
         # convergence contract); rebuild addition, no reference analogue
         result["ica_converged"] = bool(raw["ica_converged"])
     return result
+
+
+def record_consensus_result(result: dict, algorithm: str,
+                            backend: str) -> None:
+    """Emit the per-``consensus()`` convergence metrics (ISSUE 3 catalog)
+    from an assembled HOST result dict — everything read here is an O(R)
+    vector or scalar already on host, so this never adds a device sync.
+    Shared by :class:`Oracle` and ``parallel.ShardedOracle``."""
+    obs.counter(
+        "pyconsensus_consensus_total",
+        "finished consensus() resolutions",
+        labels=("algorithm", "backend", "converged")).inc(
+            algorithm=algorithm, backend=backend,
+            converged=str(bool(result["convergence"])).lower())
+    obs.histogram(
+        "pyconsensus_consensus_iterations",
+        "reputation-redistribution iterations per consensus() call",
+        labels=("algorithm", "backend"),
+        buckets=obs.ITERATION_BUCKETS).observe(
+            int(result["iterations"]), algorithm=algorithm, backend=backend)
+    agents = result["agents"]
+    old = np.asarray(agents["old_rep"], dtype=np.float64)
+    mass = obs.histogram(
+        "pyconsensus_redistribution_mass",
+        "reputation mass moved per resolution: raw (catch) redistribution "
+        "|this_rep - old_rep|/2 and smoothed |smooth_rep - old_rep|/2",
+        labels=("kind",), buckets=obs.MAGNITUDE_BUCKETS)
+    mass.observe(0.5 * float(np.abs(
+        np.asarray(agents["this_rep"], dtype=np.float64) - old).sum()),
+        kind="raw")
+    mass.observe(0.5 * float(np.abs(
+        np.asarray(agents["smooth_rep"], dtype=np.float64) - old).sum()),
+        kind="smooth")
 
 
 class Oracle:
@@ -336,8 +370,15 @@ class Oracle:
     def consensus(self) -> dict:
         """Resolve outcomes + reputation; returns the reference-shaped nested
         result dict (all values host numpy)."""
-        raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
-        result = assemble_result(raw)
+        with obs.span("oracle.consensus",
+                      algorithm=self.params.algorithm, backend=self.backend,
+                      reporters=self.reports.shape[0],
+                      events=self.reports.shape[1]):
+            # the host fetch below is the span's natural completion
+            # barrier: np.asarray blocks on every device value
+            raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
+            result = assemble_result(raw)
+        record_consensus_result(result, self.params.algorithm, self.backend)
         if self.verbose:
             self._print_summary(result)
         return result

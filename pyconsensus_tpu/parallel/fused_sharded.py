@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..models.pipeline import ConsensusParams, _fill_stats, _masked_mu
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
@@ -422,7 +423,11 @@ def _build(mesh: Mesh, p: ConsensusParams, interpret: bool, n_valid: int,
     # jax.experimental.shard_map location and check_vma/check_rep
     # spelling differences across jax versions)
     fn = shard_map(body, mesh, in_specs, out_specs)
-    return jax.jit(fn)
+    # retrace observability: the lru_cache above means one wrapper per
+    # (mesh, params, ...) build — repeat resolutions of the same config
+    # must keep pyconsensus_jit_retraces_total{entry="fused_sharded"}
+    # stable (the CL304 invariant, measured at runtime)
+    return obs.instrument_jit(jax.jit(fn), "fused_sharded")
 
 
 def fused_sharded_consensus(reports, reputation, mesh: Mesh,
@@ -488,12 +493,18 @@ def fused_sharded_consensus(reports, reputation, mesh: Mesh,
                 jnp.concatenate([maxs, jnp.ones((pad,), maxs.dtype)]),
                 e_shard)
     seed, base_unit = _seed_placed(mesh, E, pad, acc.name)
-    if p.any_scaled:
-        out = _build(mesh, p, interpret, E, True)(
-            reports, reputation, seed, base_unit, scaled, mins, maxs)
-    else:
-        out = _build(mesh, p, interpret, E, False)(
-            reports, reputation, seed, base_unit)
+    # dispatch-only span (the result stays on device); the per-sweep (R,)
+    # psums this path places are counted in wire terms by the ring module
+    # when the explicit ring backend is used — here the shard width is
+    # the load-bearing attribute
+    with obs.span("fused_sharded.dispatch", event_shards=n_event,
+                  reporters=R, events=E, padded=bool(pad)):
+        if p.any_scaled:
+            out = _build(mesh, p, interpret, E, True)(
+                reports, reputation, seed, base_unit, scaled, mins, maxs)
+        else:
+            out = _build(mesh, p, interpret, E, False)(
+                reports, reputation, seed, base_unit)
     if pad:
         out = {k: (v[:E] if k in _EVENT_KEYS else v)
                for k, v in out.items()}

@@ -54,6 +54,37 @@ def shard_map(f, mesh, in_specs, out_specs):
 __all__ = ["ring_allreduce", "ring_gram", "ring_first_pc", "ring_matvec"]
 
 
+def _record_ring(op: str, payload_elems: int, itemsize: int, n: int,
+                 operand) -> None:
+    """Exact wire accounting for one host-dispatched ring all-reduce:
+    2(n-1) ppermute hops moving 1/n of the payload each, i.e.
+    2(n-1)/n of the tensor per device (the module-docstring bound).
+    Skipped when ``operand`` is a tracer — these entry points can be
+    closed over by a user jit (tests do), and metric emission inside a
+    trace would count traces, not executions (the CL501 contract)."""
+    if n <= 1:
+        return
+    try:
+        import jax
+
+        if isinstance(operand, jax.core.Tracer):
+            return
+    except Exception:                    # pragma: no cover - jax drift
+        return
+    from .. import obs
+
+    obs.counter(
+        "pyconsensus_ring_collective_hops_total",
+        "ppermute hops dispatched by the explicit ring collectives",
+        labels=("op",)).inc(2 * (n - 1), op=op)
+    obs.counter(
+        "pyconsensus_ring_collective_bytes_total",
+        "per-device wire bytes dispatched by the explicit ring "
+        "collectives (2(n-1)/n of the payload)",
+        labels=("op",)).inc(
+            int(payload_elems * itemsize * 2 * (n - 1) / n), op=op)
+
+
 def _axis_size(axis_name) -> int:
     """Static mesh-axis extent inside shard_map — ``lax.axis_size`` where
     the jax version has it, else the core axis-env lookup it wraps."""
@@ -149,6 +180,9 @@ def ring_gram(A: jnp.ndarray, mesh: Mesh, axis_name: str = "event"):
         in_specs=P(None, axis_name),
         out_specs=P(),
     )
+    R = A.shape[0]
+    _record_ring("gram", R * R, jnp.dtype(A.dtype).itemsize,
+                 mesh.shape[axis_name], A)
     return f(A)
 
 
@@ -163,6 +197,8 @@ def ring_matvec(A: jnp.ndarray, v: jnp.ndarray, mesh: Mesh,
         in_specs=(P(None, axis_name), P(axis_name)),
         out_specs=P(),
     )
+    _record_ring("matvec", A.shape[0], jnp.dtype(A.dtype).itemsize,
+                 mesh.shape[axis_name], A)
     return f(A, v)
 
 

@@ -27,10 +27,12 @@ from typing import NamedTuple, Optional
 import jax
 import numpy as np
 
+from .. import obs
 from ..ops import jax_kernels as jk
 from ..models.pipeline import (HYBRID_ALGORITHMS, ConsensusParams,
                                _consensus_hybrid, consensus_light_jit)
-from ..oracle import Oracle, assemble_result, parse_event_bounds
+from ..oracle import (Oracle, assemble_result, parse_event_bounds,
+                      record_consensus_result)
 from .mesh import (Mesh, effective_median_block, event_sharding, make_mesh,
                    replicated)
 
@@ -482,6 +484,29 @@ def _place_inputs(mesh: Mesh, reports, reputation, scaled, mins, maxs):
             _maybe_place(maxs, e_shard, dtype))
 
 
+def _record_sharded_dispatch(p: ConsensusParams, mesh: Mesh) -> None:
+    """Count one sharded resolution by execution path — dispatch-side
+    bookkeeping only (labels are host-static resolved params; the result
+    stays on device, so nothing here can add a sync)."""
+    if p.algorithm in HYBRID_ALGORITHMS:
+        path = "hybrid"
+    elif p.fused_resolution:
+        path = ("fused_sharded" if mesh.shape.get("event", 1) > 1
+                else "fused")
+    else:
+        path = "xla"
+    obs.counter(
+        "pyconsensus_sharded_resolutions_total",
+        "sharded_consensus dispatches by resolved execution path",
+        labels=("path", "algorithm", "storage")).inc(
+            path=path, algorithm=p.algorithm,
+            storage=p.storage_dtype or "full")
+    obs.gauge(
+        "pyconsensus_mesh_event_shards",
+        "event-axis width of the mesh used by the latest sharded "
+        "resolution").set(mesh.shape.get("event", 1))
+
+
 def sharded_consensus(reports, reputation=None, event_bounds=None,
                       mesh: Optional[Mesh] = None,
                       params: Optional[ConsensusParams] = None):
@@ -533,6 +558,9 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
             "pre-encoded int8 sentinel reports require "
             "storage_dtype='int8' (models.pipeline.encode_reports "
             f"convention); resolved storage_dtype={p.storage_dtype!r}")
+    # count AFTER every validation: a rejected call dispatches nothing
+    # and must not inflate the resolutions counter
+    _record_sharded_dispatch(p, mesh)
     if p.algorithm in HYBRID_ALGORITHMS:
         # hybrid host-clustering path: the device phases run JITTED on
         # the placed (event-sharded) arrays — GSPMD turns the O(R²E)
@@ -610,6 +638,7 @@ class ShardedOracle(Oracle):
         return self
 
     def resolve_raw(self):
+        _record_sharded_dispatch(self.params, self.mesh)
         placed = _place_inputs(self.mesh, self.reports, self.reputation,
                                self.scaled, self.mins, self.maxs)
         if self.params.algorithm in HYBRID_ALGORITHMS:
@@ -629,8 +658,14 @@ class ShardedOracle(Oracle):
         return consensus_light_jit(*placed, self.params)
 
     def consensus(self) -> dict:
-        raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
-        result = assemble_result(raw)
+        with obs.span("oracle.consensus",
+                      algorithm=self.params.algorithm, backend="jax",
+                      sharded=True, reporters=self.reports.shape[0],
+                      events=self.reports.shape[1]):
+            # np.asarray is the blocking completion barrier, like Oracle's
+            raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
+            result = assemble_result(raw)
+        record_consensus_result(result, self.params.algorithm, "jax")
         if self.verbose:
             self._print_summary(result)
         return result

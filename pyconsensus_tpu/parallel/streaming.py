@@ -77,6 +77,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models.pipeline import ConsensusParams
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
@@ -429,9 +430,15 @@ def streaming_consensus(reports_src, reputation=None, event_bounds=None,
             from ..io import load_reports
 
             reports_src = load_reports(reports_src, mmap=True)
-        return _streaming_consensus_impl(reports_src, reputation,
-                                         event_bounds, panel_events, params,
-                                         mesh, host_id, n_hosts, allreduce)
+        p = params if params is not None else ConsensusParams()
+        shape = tuple(getattr(reports_src, "shape", ()))  # impl validates
+        with obs.span("streaming.consensus", algorithm=p.algorithm,
+                      shape=str(shape), panel_events=int(panel_events),
+                      multihost=bool(n_hosts and int(n_hosts) > 1)):
+            return _streaming_consensus_impl(reports_src, reputation,
+                                             event_bounds, panel_events,
+                                             params, mesh, host_id, n_hosts,
+                                             allreduce)
     finally:
         if staged is not None:
             staged.unlink(missing_ok=True)
@@ -542,12 +549,17 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
             starts = starts[host_id::n_hosts]
         if not starts:                     # E == 0 / more hosts than panels
             return
+        panel_count = obs.counter(
+            "pyconsensus_streaming_panels_total",
+            "event panels streamed from the source (all passes)")
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
             pending = pool.submit(_prepare, starts[0])
             for nxt in starts[1:]:
                 ready = pending.result()
                 pending = pool.submit(_prepare, nxt)
+                panel_count.inc()
                 yield ready
+            panel_count.inc()
             yield pending.result()
 
     # ---- scoring iterations: one accumulation pass per iteration --------
@@ -594,13 +606,17 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         G = jnp.zeros((R, R), dtype=dtype)
         M = jnp.zeros((R, R), dtype=dtype)
         S_acc = jnp.zeros((R, R), dtype=dtype) if with_s else None
-        for _, _, block, sc, mn, mx, valid in panels():
-            dG, dM, dS = _pass1_panel(block, fill_rep, weight_rep, sc, mn,
-                                      mx, valid, tol, with_s, with_gm)
-            if with_gm:
-                G, M = G + dG, M + dM
-            if with_s:
-                S_acc = S_acc + dS
+        with obs.span("streaming.accumulate_pass", with_s=with_s,
+                      with_gm=with_gm) as sp:
+            for _, _, block, sc, mn, mx, valid in panels():
+                dG, dM, dS = _pass1_panel(block, fill_rep, weight_rep, sc,
+                                          mn, mx, valid, tol, with_s,
+                                          with_gm)
+                if with_gm:
+                    G, M = G + dG, M + dM
+                if with_s:
+                    S_acc = S_acc + dS
+            sp.observe([x for x in (G, M, S_acc) if x is not None])
         if allreduce is not None:
             # sum the R x R partials across hosts in ONE stacked
             # collective (each allreduce is a blocking DCN round-trip);
@@ -640,6 +656,10 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
             U = eigvecs[:, ::-1][:, :k]                   # (R, k)
             total = jnp.sum(jnp.clip(eigvals, 0.0, None))
         else:
+            obs.counter(
+                "pyconsensus_streaming_topk_fallback_total",
+                "streamed spectra taken via orthogonal iteration instead "
+                "of eigh (R > STREAM_EIGH_MAX_R)").inc()
             lam, U = _sym_topk(Gd, k)
             total = jnp.clip(jnp.trace(Gd), 0.0, None)
         # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
@@ -750,6 +770,11 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         this_rep = jk.row_reward_weighted(adj, rep_k)
         new_rep = jk.smooth(this_rep, rep_k, p.alpha)
         delta = float(jnp.max(jnp.abs(new_rep - rep_k)))
+        obs.histogram(
+            "pyconsensus_convergence_residual",
+            "max-abs reputation change per redistribution iteration",
+            labels=("backend",), buckets=obs.MAGNITUDE_BUCKETS).observe(
+                delta, backend="streaming")
         score_rep = rep_k
         rep_k = new_rep
         iterations += 1
@@ -757,6 +782,18 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
             converged = True
             break
     smooth_rep = rep_k
+    obs.counter(
+        "pyconsensus_consensus_total",
+        "finished consensus() resolutions",
+        labels=("algorithm", "backend", "converged")).inc(
+            algorithm=p.algorithm, backend="streaming",
+            converged=str(bool(converged)).lower())
+    obs.histogram(
+        "pyconsensus_consensus_iterations",
+        "reputation-redistribution iterations per consensus() call",
+        labels=("algorithm", "backend"),
+        buckets=obs.ITERATION_BUCKETS).observe(
+            iterations, algorithm=p.algorithm, backend="streaming")
 
     # ---- pass 2: per-panel resolution with the final reputation ---------
     # (zeros, not empty: under multi-host each host fills only its
@@ -769,21 +806,22 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     first_loading = np.zeros(E)
     prow = np.zeros(R)
     na_count = np.zeros(R)
-    for start, stop, block, sc, mn, mx, _ in panels():
-        raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
-            block, fill_rep, score_rep, smooth_rep, u_over_nAu, sc, mn, mx,
-            tol,
-            with_loading=p.algorithm in ("sztorc", "fixed-variance"),
-            median_block=effective_median_block(p.median_block, mesh))
-        width = stop - start
-        outcomes_raw[start:stop] = np.asarray(raw)[:width]
-        outcomes_adjusted[start:stop] = np.asarray(adjd)[:width]
-        outcomes_final[start:stop] = np.asarray(fin)[:width]
-        certainty[start:stop] = np.asarray(cert)[:width]
-        pcols[start:stop] = 1.0 - np.asarray(pc)[:width]
-        first_loading[start:stop] = np.asarray(ld)[:width]
-        prow += np.asarray(pr)       # padded cols: certainty * na(=0) = 0
-        na_count += np.asarray(nc)
+    with obs.span("streaming.resolve_pass", algorithm=p.algorithm):
+        for start, stop, block, sc, mn, mx, _ in panels():
+            raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
+                block, fill_rep, score_rep, smooth_rep, u_over_nAu, sc, mn,
+                mx, tol,
+                with_loading=p.algorithm in ("sztorc", "fixed-variance"),
+                median_block=effective_median_block(p.median_block, mesh))
+            width = stop - start
+            outcomes_raw[start:stop] = np.asarray(raw)[:width]
+            outcomes_adjusted[start:stop] = np.asarray(adjd)[:width]
+            outcomes_final[start:stop] = np.asarray(fin)[:width]
+            certainty[start:stop] = np.asarray(cert)[:width]
+            pcols[start:stop] = 1.0 - np.asarray(pc)[:width]
+            first_loading[start:stop] = np.asarray(ld)[:width]
+            prow += np.asarray(pr)   # padded cols: certainty * na(=0) = 0
+            na_count += np.asarray(nc)
     if allreduce is not None:
         # disjoint panel slices + zero elsewhere: the cross-host sum IS
         # the assembly; the row partials are genuine additive reductions.

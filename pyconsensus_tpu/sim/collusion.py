@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import obs
 from ..models.pipeline import JIT_ALGORITHMS, ConsensusParams, _iterate_jax
 from ..ops import jax_kernels as jk
 
@@ -177,7 +178,9 @@ class CollusionSimulator:
             dbscan_min_samples=int(dbscan_min_samples),
             any_scaled=False, has_na=False)
         self.mesh = mesh
-        self._batched = jax.jit(jk.exact_matmuls(jax.vmap(self._trial_fn())))
+        self._batched = obs.instrument_jit(
+            jax.jit(jk.exact_matmuls(jax.vmap(self._trial_fn()))),
+            "sim_batched")
 
     def _trial_fn(self):
         """Subclass hook: the per-trial function ``(key, lf, var) -> metrics``
@@ -200,22 +203,31 @@ class CollusionSimulator:
         are all bit-identical."""
         indices = np.asarray(indices)
         N = indices.shape[0]
-        n_pad = 0
-        if self.mesh is not None:
-            n_pad = (-N) % int(self.mesh.devices.size)
-            if n_pad:
-                indices = np.pad(indices, (0, n_pad), mode="edge")
-                grid_lf = np.pad(grid_lf, (0, n_pad), mode="edge")
-                grid_var = np.pad(grid_var, (0, n_pad), mode="edge")
-        keys = _fold_keys(seed, indices)
-        lf_dev, var_dev = jnp.asarray(grid_lf), jnp.asarray(grid_var)
-        if self.mesh is not None:
-            shard = NamedSharding(self.mesh,
-                                  PartitionSpec(tuple(self.mesh.axis_names)))
-            keys, lf_dev, var_dev = (jax.device_put(a, shard)
-                                     for a in (keys, lf_dev, var_dev))
-        out = self._batched(keys, lf_dev, var_dev)
-        return {k: np.asarray(v)[:N] for k, v in out.items()}
+        with obs.span("sim.dispatch", trials=int(N),
+                      algorithm=self.params.algorithm,
+                      meshed=self.mesh is not None):
+            n_pad = 0
+            if self.mesh is not None:
+                n_pad = (-N) % int(self.mesh.devices.size)
+                if n_pad:
+                    indices = np.pad(indices, (0, n_pad), mode="edge")
+                    grid_lf = np.pad(grid_lf, (0, n_pad), mode="edge")
+                    grid_var = np.pad(grid_var, (0, n_pad), mode="edge")
+            keys = _fold_keys(seed, indices)
+            lf_dev, var_dev = jnp.asarray(grid_lf), jnp.asarray(grid_var)
+            if self.mesh is not None:
+                shard = NamedSharding(
+                    self.mesh, PartitionSpec(tuple(self.mesh.axis_names)))
+                keys, lf_dev, var_dev = (jax.device_put(a, shard)
+                                         for a in (keys, lf_dev, var_dev))
+            out = self._batched(keys, lf_dev, var_dev)
+            # the host fetch below is the span's completion barrier
+            host = {k: np.asarray(v)[:N] for k, v in out.items()}
+        obs.counter(
+            "pyconsensus_sim_trials_total",
+            "Monte-Carlo trials resolved by the batched simulator",
+            labels=("algorithm",)).inc(N, algorithm=self.params.algorithm)
+        return host
 
     def run(self, liar_fractions: Sequence[float],
             variances: Sequence[float], n_trials: int, seed: int = 0) -> dict:

@@ -41,6 +41,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from .collusion import CollusionSimulator, flat_grid
 
 __all__ = ["CheckpointedSweep"]
@@ -186,13 +187,19 @@ class CheckpointedSweep:
     def _run_chunk(self, c: int) -> None:
         lo = c * self.trials_per_chunk
         hi = min(lo + self.trials_per_chunk, self.total)
-        # the shared dispatch point: a meshed simulator shards each
-        # chunk's trial axis exactly like a monolithic run() would
-        host = self.sim._dispatch(self.seed, np.arange(lo, hi),
-                                  self._grid_lf[lo:hi],
-                                  self._grid_var[lo:hi])
-        self._write_atomic(self._chunk_path(c),
-                           lambda t: np.savez(t, **host), suffix=".tmp.npz")
+        with obs.span("sweep.chunk", chunk=c, trials=hi - lo):
+            # the shared dispatch point: a meshed simulator shards each
+            # chunk's trial axis exactly like a monolithic run() would
+            host = self.sim._dispatch(self.seed, np.arange(lo, hi),
+                                      self._grid_lf[lo:hi],
+                                      self._grid_var[lo:hi])
+            self._write_atomic(self._chunk_path(c),
+                               lambda t: np.savez(t, **host),
+                               suffix=".tmp.npz")
+        obs.counter(
+            "pyconsensus_sweep_chunks_total",
+            "checkpointed sweep chunks computed and written by this "
+            "process").inc()
 
     def run(self, host_id: Optional[int] = None,
             n_hosts: Optional[int] = None) -> int:

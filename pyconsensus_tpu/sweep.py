@@ -23,6 +23,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from . import obs
 from .models.pipeline import HYBRID_ALGORITHMS, JIT_ALGORITHMS
 from .oracle import ALGORITHMS, Oracle
 
@@ -55,20 +56,25 @@ def compare_algorithms(reports, algorithms: Optional[Sequence[str]] = None,
                       reputation=reputation, algorithm=a, backend="jax",
                       **oracle_kwargs)
 
-    # async device dispatch for the jit variants...
-    raw: Dict[str, dict] = {}
-    for a in algorithms:
-        if a in JIT_ALGORITHMS:
-            raw[a] = make(a).resolve_raw()
-    # ...hybrid variants overlap the draining device queue...
-    results: Dict[str, dict] = {}
-    for a in algorithms:
-        if a in HYBRID_ALGORITHMS:
-            results[a] = make(a).consensus()
-    # ...then fetch the queued device results
-    from .oracle import assemble_result
-    for a, r in raw.items():
-        results[a] = assemble_result({k: np.asarray(v) for k, v in r.items()})
+    with obs.span("sweep.compare_algorithms",
+                  algorithms=",".join(algorithms)):
+        # async device dispatch for the jit variants...
+        raw: Dict[str, dict] = {}
+        with obs.span("sweep.dispatch_jit"):
+            for a in algorithms:
+                if a in JIT_ALGORITHMS:
+                    raw[a] = make(a).resolve_raw()
+        # ...hybrid variants overlap the draining device queue...
+        results: Dict[str, dict] = {}
+        for a in algorithms:
+            if a in HYBRID_ALGORITHMS:
+                results[a] = make(a).consensus()
+        # ...then fetch the queued device results
+        from .oracle import assemble_result
+        with obs.span("sweep.fetch_jit"):
+            for a, r in raw.items():
+                results[a] = assemble_result(
+                    {k: np.asarray(v) for k, v in r.items()})
     return {a: results[a] for a in algorithms}
 
 

@@ -1,20 +1,31 @@
-"""Lightweight observability: wall-clock phase timers and a jax.profiler
-wrapper (SURVEY.md §5 — the reference had only ``verbose`` prints; the
-rebuild adds structured timing and real TPU traces)."""
+"""Lightweight observability compatibility layer.
+
+Since ISSUE 3 the real observability subsystem is
+:mod:`pyconsensus_tpu.obs` (span tracer + metrics registry + sinks);
+:class:`PhaseTimer` survives as a thin shim over it so pre-existing
+callers (tools/profile_phases.py and friends) keep their accumulating
+totals()/means()/report() surface while their phases ALSO show up as
+spans in the process-wide tracer and as
+``pyconsensus_phase_seconds{phase=...}`` in the metrics registry.
+
+``trace`` (the jax.profiler wrapper) is unchanged.
+"""
 
 from __future__ import annotations
 
 import contextlib
-import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import jax
+
+from .. import obs
 
 __all__ = ["PhaseTimer", "trace"]
 
 
 class PhaseTimer:
-    """Accumulating named-phase wall-clock timer.
+    """Accumulating named-phase wall-clock timer (compatibility shim over
+    :mod:`pyconsensus_tpu.obs` — each ``phase`` opens a tracer span).
 
     >>> timer = PhaseTimer()
     >>> with timer.phase("pca"):
@@ -22,34 +33,60 @@ class PhaseTimer:
     >>> timer.totals()
     {'pca': 0.0123}
 
-    ``block=True`` (default) calls ``block_until_ready`` on the value the
-    body stores via :meth:`observe`, so asynchronous dispatch doesn't
-    attribute device time to the wrong phase.
+    ``block=True`` (default) calls ``block_until_ready`` on EVERY value
+    the body stores via :meth:`observe` — ``_pending`` is a list, so a
+    phase that observes twice waits on both (the original single-slot
+    implementation overwrote the first value, attributing its device time
+    to whatever phase blocked next).
     """
 
     def __init__(self) -> None:
         self._totals: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
-        self._pending = None
+        self._pending: List = []
+        self._span = None
 
     def observe(self, value):
         """Mark a jax value whose completion the current phase should wait
-        on before stopping the clock."""
-        self._pending = value
+        on before stopping the clock. Accumulates — every observed value
+        is blocked on at phase exit. Outside any phase the slot holds the
+        LAST value only (the original single-slot behavior — nothing will
+        ever drain it, so accumulating there would pin every observed
+        device buffer for the timer's lifetime)."""
+        if self._span is not None:
+            self._pending.append(value)
+            self._span.observe(value)
+        else:
+            self._pending = [value]
         return value
 
     @contextlib.contextmanager
     def phase(self, name: str, block: bool = True) -> Iterator[None]:
-        start = time.perf_counter()
+        outer_span, outer_pending = self._span, self._pending
+        self._pending = []
+        sp = None
         try:
-            yield
+            with obs.span(name, timer="PhaseTimer") as sp:
+                self._span = sp
+                try:
+                    yield
+                finally:
+                    if not block:
+                        # the span must not block either: drop the
+                        # observed values so dispatch stays asynchronous
+                        sp._pending = []
+                    self._span = outer_span
+                    self._pending = outer_pending
         finally:
-            if block and self._pending is not None:
-                jax.block_until_ready(self._pending)
-                self._pending = None
-            elapsed = time.perf_counter() - start
-            self._totals[name] = self._totals.get(name, 0.0) + elapsed
-            self._counts[name] = self._counts.get(name, 0) + 1
+            # span exit blocked on every observed value (observe() feeds
+            # the span) before stamping duration_s; reuse it so shim
+            # totals and tracer spans can never disagree. Accumulate even
+            # when the body raised — the original implementation did (a
+            # sweep tolerating one failing phase keeps its totals).
+            if sp is not None and sp.duration_s is not None:
+                self._totals[name] = (self._totals.get(name, 0.0)
+                                      + sp.duration_s)
+                self._counts[name] = self._counts.get(name, 0) + 1
 
     def totals(self) -> Dict[str, float]:
         return dict(self._totals)

@@ -129,6 +129,42 @@ CORPUS = {
         "import os\nX = 1\n",
         "import os\nX = os.sep\n",
     ),
+    # ISSUE 3: telemetry is host-side only — obs emission in traced code
+    # runs once per TRACE (not per execution) and span exit is a host sync
+    "CL501": (
+        """
+        import jax
+        from pyconsensus_tpu import obs
+        @jax.jit
+        def f(x):
+            with obs.span("inner"):
+                return x * 2
+        """,
+        """
+        import jax
+        from pyconsensus_tpu import obs
+        def host(x):
+            with obs.span("resolve"):
+                return jax.jit(lambda y: y * 2)(x)
+        """,
+    ),
+    "CL502": (
+        """
+        import time
+        import jax
+        @jax.jit
+        def f(x):
+            t0 = time.perf_counter()
+            return x * 2, t0
+        """,
+        """
+        import time
+        import jax
+        def host(x):
+            t0 = time.perf_counter()
+            return jax.jit(lambda y: y * 2)(x), t0
+        """,
+    ),
 }
 
 
@@ -189,6 +225,90 @@ def test_composition_closure(tmp_path):
     found = {f.message.split("'")[3] for f in lint_file(p, rel_path="c.py")
              if f.rule == "CL101"}
     assert found == {"core", "step"}
+
+
+class TestObsInTracedRules:
+    """CL501/CL502 beyond the basic corpus: alias forms, metric handles,
+    shard_map bodies, PhaseTimer (ISSUE 3 satellite)."""
+
+    def _rules(self, tmp_path, src):
+        p = tmp_path / "t.py"
+        p.write_text(textwrap.dedent(src))
+        return [f.rule for f in lint_file(p, rel_path="t.py")]
+
+    def test_from_import_alias_triggers(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu.obs import span as _sp
+            @jax.jit
+            def f(x):
+                with _sp("inner"):
+                    return x
+            """)
+        assert "CL501" in rules
+
+    def test_metric_handle_method_triggers(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu import obs
+            @jax.jit
+            def f(x):
+                h = obs.counter("c")
+                h.inc()
+                return x
+            """)
+        assert rules.count("CL501") == 2      # the build AND the .inc()
+
+    def test_shard_map_body_triggers(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from pyconsensus_tpu import obs
+            def body(x):
+                obs.counter("c").inc()
+                return x
+            f = shard_map(body, mesh=None, in_specs=None, out_specs=None)
+            """)
+        assert "CL501" in rules
+
+    def test_host_metric_handle_silent(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            from pyconsensus_tpu import obs
+            def host():
+                h = obs.counter("c")
+                h.inc()
+            """)
+        assert "CL501" not in rules
+
+    def test_phasetimer_in_traced_triggers(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu.utils import PhaseTimer
+            @jax.jit
+            def f(x):
+                t = PhaseTimer()
+                return x
+            """)
+        assert "CL502" in rules
+
+    def test_suppression_works_for_cl50x(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import time
+            import jax
+            @jax.jit
+            def f(x):
+                t0 = time.perf_counter()  # consensus-lint: disable=CL502
+                return x * 2, t0
+            """)
+        assert "CL502" not in rules
+
+    def test_instrumented_package_is_cl50x_clean(self):
+        """The package's OWN instrumentation (ISSUE 3 touched every
+        layer) must never emit telemetry from traced code — the rule
+        holds over the real tree, not just the corpus."""
+        found = [f for f in lint_paths()
+                 if f.rule in ("CL501", "CL502")]
+        assert found == [], [(f.path, f.line, f.rule) for f in found]
 
 
 def test_fingerprints_stable_across_line_shifts(tmp_path):
@@ -797,9 +917,29 @@ def test_cli_json_format(tmp_path, capsys):
 def test_cli_exit_codes_on_seeded_divergence(tmp_path, capsys):
     """The acceptance seed: a host-divergent value reaching a traced
     branch must fail the default run (Layer 3a rides every lint run),
-    and --no-dataflow must wave the same file through."""
+    and --no-dataflow must wave the same file through. Seeded with an
+    ENV read since ISSUE 3: the original clock seed is now also caught
+    statically by Layer-1 CL502 (host timer in traced code), so a clock
+    file no longer passes --no-dataflow — the env source is the
+    divergence class only the taint engine sees."""
     src = tmp_path / "div.py"
     src.write_text(textwrap.dedent("""
+        import os
+        import jax
+        @jax.jit
+        def f(x):
+            if os.environ.get("HOST_ONLY_FLAG"):
+                return x
+            return -x
+        """))
+    assert cli_run([str(src), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "CL401" in out
+    assert cli_run([str(src), "--no-baseline", "--no-dataflow"]) == 0
+    # the clock form of the same defect is now a STATIC catch (CL502) —
+    # dataflow off no longer waves it through
+    clock = tmp_path / "clock.py"
+    clock.write_text(textwrap.dedent("""
         import time
         import jax
         @jax.jit
@@ -808,10 +948,9 @@ def test_cli_exit_codes_on_seeded_divergence(tmp_path, capsys):
                 return x
             return -x
         """))
-    assert cli_run([str(src), "--no-baseline"]) == 1
-    out = capsys.readouterr().out
-    assert "CL401" in out
-    assert cli_run([str(src), "--no-baseline", "--no-dataflow"]) == 0
+    capsys.readouterr()
+    assert cli_run([str(clock), "--no-baseline", "--no-dataflow"]) == 1
+    assert "CL502" in capsys.readouterr().out
 
 
 def test_cli_select_covers_taint_rules(tmp_path):

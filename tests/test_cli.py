@@ -146,6 +146,13 @@ class TestCli:
             with pytest.raises(SystemExit):
                 main(bad)
 
+    @pytest.mark.xfail(
+        strict=False,
+        reason="environmental: jaxlib CPU backend lacks multiprocess "
+               "computations — process_allgather raises 'Multiprocess "
+               "computations aren't implemented on the CPU backend' "
+               "(needs gloo CPU collectives or multi-host TPU); see "
+               "tests/test_distributed.py triage note")
     def test_stream_multihost_two_processes(self, tmp_path, rng):
         """The real CLI deployment story: the same command on two OS
         processes (each with its own --host-id) joins one distributed

@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from conftest import free_port, worker_env
 from pyconsensus_tpu import Oracle
@@ -17,7 +18,19 @@ from pyconsensus_tpu import Oracle
 _WORKER = pathlib.Path(__file__).resolve().parent / "distributed_worker.py"
 _WORKER4 = pathlib.Path(__file__).resolve().parent / "distributed_worker4.py"
 
+#: ISSUE 3 triage: this jaxlib's CPU client rejects cross-process
+#: computations outright ("Multiprocess computations aren't implemented
+#: on the CPU backend"), so the multi-process global-mesh story cannot
+#: execute here at all — it needs a CPU collectives (gloo)-enabled
+#: jaxlib or real multi-host hardware. strict=False: the tests PASS
+#: where the capability exists.
+_MULTIPROC_XFAIL = pytest.mark.xfail(
+    strict=False,
+    reason="environmental: jaxlib CPU backend lacks multiprocess "
+           "computations (needs gloo CPU collectives or multi-host TPU)")
 
+
+@_MULTIPROC_XFAIL
 def test_four_process_global_mesh():
     """Round-5 (VERDICT r4 item 8): rendezvous, collective lockstep, and
     the streaming round-robin at FOUR processes — covering an odd panel
@@ -89,6 +102,7 @@ def test_four_process_global_mesh():
                                   local_k["outcomes_adjusted"])
 
 
+@_MULTIPROC_XFAIL
 def test_two_process_global_mesh(tmp_path):
     port = free_port()
     env = worker_env()

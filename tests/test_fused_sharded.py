@@ -3,7 +3,21 @@ the multi-device kernel path must agree with the single-device fused
 path (the headline pipeline) — outcomes bit-identically (catch-snapped),
 reputations to f32-kernel tolerance — across storage dtypes, NA
 patterns, iteration counts, and mesh widths, on the 8-virtual-device CPU
-mesh with the Pallas kernels in interpret mode."""
+mesh with the Pallas kernels in interpret mode.
+
+TODO(issue-3) triage: 7 tests in this file fail at seed and still fail —
+the parity/scaled/padding cases whose smooth_rep (and downstream bonus)
+vectors drift past the 5e-6 tolerance between the shard_map path and the
+single-device fused path under CPU interpret mode (catch-snapped
+outcomes and iteration counts DO match; only the reputation tail
+diverges). This is a genuine numeric discrepancy to run down — most
+likely the sharded power loop's psum reduction order vs the one-pass
+kernel's accumulation order feeding the early-exit alignment test a
+different trajectory — NOT an environmental limitation, so these are
+deliberately left failing (not xfail'd) to keep the pressure visible:
+test_matches_single_device_fused[int8|bfloat16|''], test_iterative_loop,
+test_scaled_clustered_on_one_shard, test_scaled_iterative,
+test_nondivisible_iterative."""
 
 import numpy as np
 import jax.numpy as jnp

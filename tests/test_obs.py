@@ -1,0 +1,457 @@
+"""The observability subsystem (ISSUE 3 tentpole): span tracer semantics
+(nesting, exception safety, device blocking, threading), metrics registry
+(counter/gauge/histogram, bucket edges, label hygiene), sinks (Prometheus
+exposition golden test, JSONL round-trip + tree reconstruction), compile
+observability (retrace counter on a deliberately re-specialized jit
+function), and the real pipeline emission contract (convergence metrics
+from a small Oracle.consensus run)."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle, obs
+from pyconsensus_tpu.obs import MetricsRegistry, Tracer
+
+
+@pytest.fixture
+def registry():
+    return MetricsRegistry()
+
+
+@pytest.fixture
+def tracer(registry):
+    return Tracer(registry=registry)
+
+
+# ------------------------------------------------------------- tracer
+
+
+class TestTracer:
+    def test_nesting_and_parent_ids(self, tracer):
+        with tracer.span("root") as root:
+            with tracer.span("child") as child:
+                with tracer.span("grandchild") as grand:
+                    assert tracer.current() is grand
+                assert tracer.current() is child
+        assert child.parent_id == root.span_id
+        assert grand.parent_id == child.span_id
+        assert root.parent_id == 0
+        assert (root.depth, child.depth, grand.depth) == (0, 1, 2)
+        # finish order: children before parents
+        assert [s.name for s in tracer.spans()] == ["grandchild", "child",
+                                                    "root"]
+
+    def test_exception_safety(self, tracer):
+        with pytest.raises(ValueError, match="boom"):
+            with tracer.span("outer"):
+                with tracer.span("failing"):
+                    raise ValueError("boom")
+        spans = {s.name: s for s in tracer.spans()}
+        assert spans["failing"].status == "error"
+        assert "boom" in spans["failing"].error
+        assert spans["outer"].status == "error"   # propagated through
+        assert tracer.current() is None           # stack fully unwound
+        # the tracer still works after the exception
+        with tracer.span("after"):
+            pass
+        assert tracer.spans()[-1].status == "ok"
+
+    def test_observe_blocks_all_values(self, tracer):
+        class Recorder:
+            blocked = 0
+
+            def block_until_ready(self):
+                Recorder.blocked += 1
+                return self
+
+        with tracer.span("s") as sp:
+            sp.observe(Recorder())
+            sp.observe(Recorder())
+        assert Recorder.blocked == 2
+
+    def test_observe_without_span_passes_through(self, tracer):
+        x = object()
+        assert tracer.observe(x) is x
+
+    def test_durations_feed_registry(self, tracer, registry):
+        with tracer.span("timed"):
+            pass
+        hist = registry.get("pyconsensus_phase_seconds")
+        assert hist.value(phase="timed")["count"] == 1
+
+    def test_threads_get_independent_stacks(self, tracer):
+        def worker():
+            with tracer.span("worker_root"):
+                pass
+
+        with tracer.span("main_root"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        spans = {s.name: s for s in tracer.spans()}
+        # the worker's span must NOT be parented under main's open span
+        assert spans["worker_root"].parent_id == 0
+
+    def test_report_tree_indents_children(self, tracer):
+        with tracer.span("root"):
+            with tracer.span("leaf"):
+                pass
+        rep = tracer.report()
+        root_line = [ln for ln in rep.splitlines() if "root" in ln][0]
+        leaf_line = [ln for ln in rep.splitlines() if "leaf" in ln][0]
+        assert not root_line.startswith(" ")
+        assert leaf_line.startswith("  ")
+
+    def test_span_cap_drops_oldest(self, registry):
+        t = Tracer(registry=registry, max_spans=5)
+        for i in range(8):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.spans()) == 5
+        assert t.dropped() == 3
+        assert t.spans()[0].name == "s3"
+
+    def test_report_promotes_orphaned_children(self, tracer):
+        """A finished child whose parent is missing from the ring (still
+        open, or evicted) must appear in report() as a root — matching
+        sinks.span_tree — not silently vanish."""
+        with tracer.span("still_open"):
+            with tracer.span("orphan_child"):
+                pass
+            rep = tracer.report()     # parent not finished yet
+        assert "orphan_child" in rep, rep
+
+
+# ------------------------------------------------------------ metrics
+
+
+class TestMetrics:
+    def test_counter_accumulates_per_label(self, registry):
+        c = registry.counter("t_total", "help", labels=("k",))
+        c.inc(k="a")
+        c.inc(2.5, k="a")
+        c.inc(k="b")
+        assert c.value(k="a") == 3.5
+        assert c.value(k="b") == 1.0
+        assert c.value(k="never") == 0.0
+
+    def test_counter_rejects_decrease_and_label_typos(self, registry):
+        c = registry.counter("t_total", labels=("k",))
+        with pytest.raises(ValueError, match="decrease"):
+            c.inc(-1, k="a")
+        with pytest.raises(ValueError, match="labels"):
+            c.inc(wrong="a")
+
+    def test_gauge_last_write_wins(self, registry):
+        g = registry.gauge("g")
+        assert g.value() is None
+        g.set(3)
+        g.set(7)
+        assert g.value() == 7.0
+
+    def test_histogram_bucket_edges_inclusive_upper(self, registry):
+        """le is an INCLUSIVE upper bound (the Prometheus contract): a
+        value exactly on an edge lands in that edge's bucket."""
+        h = registry.histogram("h", buckets=(1.0, 2.0, 5.0))
+        for v in (0.5, 1.0, 1.0001, 2.0, 5.0, 99.0):
+            h.observe(v)
+        text = registry.render_prom()
+        assert 'h_bucket{le="1"} 2' in text        # 0.5, 1.0
+        assert 'h_bucket{le="2"} 4' in text        # + 1.0001, 2.0
+        assert 'h_bucket{le="5"} 5' in text        # + 5.0
+        assert 'h_bucket{le="+Inf"} 6' in text     # + 99.0
+        assert "h_count 6" in text
+        assert f"h_sum {0.5 + 1.0 + 1.0001 + 2.0 + 5.0 + 99.0!r}" in text
+
+    def test_histogram_rejects_unsorted_buckets(self, registry):
+        with pytest.raises(ValueError, match="ascending"):
+            registry.histogram("h", buckets=(2.0, 1.0))
+
+    def test_reregistration_returns_same_metric(self, registry):
+        a = registry.counter("x_total", labels=("k",))
+        b = registry.counter("x_total", labels=("k",))
+        assert a is b
+        with pytest.raises(ValueError, match="conflicting"):
+            registry.gauge("x_total")
+        with pytest.raises(ValueError, match="conflicting"):
+            registry.counter("x_total", labels=("other",))
+
+    def test_histogram_bucket_conflict_raises(self, registry):
+        h = registry.histogram("h", buckets=(1.0, 2.0))
+        assert registry.histogram("h", buckets=(1.0, 2.0)) is h
+        with pytest.raises(ValueError, match="buckets"):
+            registry.histogram("h", buckets=(5.0, 10.0))
+
+    def test_invalid_names_rejected(self, registry):
+        with pytest.raises(ValueError, match="metric name"):
+            registry.counter("bad-name")
+        with pytest.raises(ValueError, match="label name"):
+            registry.counter("ok", labels=("bad-label",))
+
+    def test_value_lookup_fails_soft(self, registry):
+        assert registry.value("never_registered") is None
+        registry.counter("c_total", labels=("k",))
+        assert registry.value("c_total", wrong_label="x") is None
+
+    def test_thread_safety_under_contention(self, registry):
+        c = registry.counter("n_total")
+        h = registry.histogram("d", buckets=(0.5,))
+
+        def hammer():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.25)
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == 8000
+        assert h.value()["count"] == 8000
+
+
+# -------------------------------------------------------------- sinks
+
+
+class TestSinks:
+    def test_prometheus_exposition_golden(self, registry):
+        """Golden test of the text exposition format v0.0.4: HELP/TYPE
+        headers, label escaping, histogram expansion, trailing newline."""
+        registry.counter("req_total", "requests served",
+                         labels=("path",)).inc(3, path='a"b\\c\nd')
+        registry.gauge("temp", "temperature").set(1.5)
+        registry.histogram("lat_seconds", "latency", buckets=(0.1, 1.0)
+                           ).observe(0.05)
+        got = registry.render_prom()
+        expected = (
+            "# HELP lat_seconds latency\n"
+            "# TYPE lat_seconds histogram\n"
+            'lat_seconds_bucket{le="0.1"} 1\n'
+            'lat_seconds_bucket{le="1"} 1\n'
+            'lat_seconds_bucket{le="+Inf"} 1\n'
+            "lat_seconds_sum 0.05\n"
+            "lat_seconds_count 1\n"
+            "# HELP req_total requests served\n"
+            "# TYPE req_total counter\n"
+            'req_total{path="a\\"b\\\\c\\nd"} 3\n'
+            "# HELP temp temperature\n"
+            "# TYPE temp gauge\n"
+            "temp 1.5\n"
+        )
+        assert got == expected
+
+    def test_empty_registry_renders_empty(self, registry):
+        assert registry.render_prom() == ""
+        # registered but never emitted -> no series, no headers
+        registry.counter("silent_total", labels=("k",))
+        assert registry.render_prom() == ""
+
+    def test_jsonl_round_trip_and_tree(self, tracer, tmp_path):
+        with tracer.span("root", algorithm="sztorc"):
+            with tracer.span("fill"):
+                pass
+            with tracer.span("iterate", n=3):
+                with tracer.span("scores"):
+                    pass
+        path = tmp_path / "trace.jsonl"
+        n = obs.write_jsonl(path, tracer.events(), meta={"run": "test"})
+        back = obs.read_jsonl(path)
+        assert n == len(back) == 5                # meta + 4 spans
+        assert back[0]["type"] == "meta" and back[0]["run"] == "test"
+        # every record is plain JSON (the file is line-parseable)
+        for line in path.read_text().splitlines():
+            json.loads(line)
+        tree = obs.span_tree(back)
+        assert len(tree) == 1
+        root = tree[0]
+        assert root["name"] == "root"
+        assert root["attrs"]["algorithm"] == "sztorc"
+        assert [c["name"] for c in root["children"]] == ["fill", "iterate"]
+        assert [c["name"] for c in root["children"][1]["children"]] == [
+            "scores"]
+        # attrs survive the round trip typed
+        assert root["children"][1]["attrs"]["n"] == 3
+
+    def test_span_tree_keys_per_process(self):
+        """Merged fleet JSONL: every host numbers span_ids from 1, so
+        tree reconstruction must key (process_index, span_id) — a host-0
+        child must never attach under host 1's same-numbered span."""
+        merged = []
+        for proc in (0, 1):
+            merged += [
+                {"type": "span", "name": f"root_p{proc}", "span_id": 1,
+                 "parent_id": 0, "process_index": proc, "start_s": 1.0},
+                {"type": "span", "name": f"child_p{proc}", "span_id": 2,
+                 "parent_id": 1, "process_index": proc, "start_s": 2.0},
+            ]
+        tree = obs.span_tree(merged)
+        assert sorted(t["name"] for t in tree) == ["root_p0", "root_p1"]
+        for root in tree:
+            proc = root["process_index"]
+            assert [c["name"] for c in root["children"]] == [
+                f"child_p{proc}"]
+
+    def test_async_failure_at_block_marks_span_error(self, tracer):
+        """An observed value that fails ASYNCHRONOUSLY (raises at
+        block_until_ready) must not leave a green span for the phase
+        that crashed."""
+
+        class Poisoned:
+            def block_until_ready(self):
+                raise RuntimeError("async XLA failure")
+
+        with pytest.raises(RuntimeError, match="async XLA failure"):
+            with tracer.span("crashing") as sp:
+                sp.observe(Poisoned())
+        recorded = tracer.spans()[-1]
+        assert recorded.status == "error"
+        assert "async XLA failure" in recorded.error
+        assert recorded.duration_s is not None
+        assert tracer.current() is None       # stack still unwound
+
+    def test_span_tree_orphans_become_roots(self):
+        events = [
+            {"type": "span", "name": "orphan", "span_id": 7,
+             "parent_id": 99, "start_s": 1.0},
+            {"type": "meta"},
+        ]
+        tree = obs.span_tree(events)
+        assert [t["name"] for t in tree] == ["orphan"]
+
+    def test_write_prom_writes_file(self, registry, tmp_path):
+        registry.counter("c_total").inc()
+        text = obs.write_prom(tmp_path / "sub" / "m.prom", registry)
+        assert (tmp_path / "sub" / "m.prom").read_text() == text
+        assert "c_total 1" in text
+
+
+# ----------------------------------------------- compile observability
+
+
+class TestCompileObservability:
+    def test_retrace_counter_on_respecialization(self, registry):
+        """The acceptance invariant: identical re-calls keep the counter
+        at 1; a deliberately re-specialized call (new shape -> new trace)
+        increments it."""
+        f = obs.instrument_jit(jax.jit(lambda x: x * 2), "t_entry",
+                               registry=registry)
+        f(jnp.ones(4))
+        f(jnp.ones(4))
+        f(jnp.ones(4))
+        assert registry.value("pyconsensus_jit_retraces_total",
+                              entry="t_entry") == 1
+        f(jnp.ones(8))                         # re-specialize: new shape
+        assert registry.value("pyconsensus_jit_retraces_total",
+                              entry="t_entry") == 2
+        assert registry.value("pyconsensus_jit_compile_seconds",
+                              entry="t_entry") > 0
+
+    def test_wrapper_forwards_jit_introspection(self, registry):
+        f = obs.instrument_jit(jax.jit(lambda x: x + 1), "fwd",
+                               registry=registry)
+        f(jnp.ones(3))
+        assert f._cache_size() == 1            # forwarded attribute
+        lowered = f.lower(jnp.ones(3))         # contracts.py's usage
+        assert "stablehlo" in lowered.as_text().lower() or lowered
+        assert repr(f).startswith("InstrumentedJit(fwd")
+
+    def test_wrapper_passthrough_for_plain_callables(self, registry):
+        g = obs.instrument_jit(lambda x: x - 1, "plain", registry=registry)
+        assert g(3) == 2                       # no _cache_size: no crash
+        # never emitted -> fail-soft lookup (None), never a phantom count
+        assert not registry.value("pyconsensus_jit_retraces_total",
+                                  entry="plain")
+
+    def test_wrapper_noops_under_trace(self, registry):
+        inner = obs.instrument_jit(jax.jit(lambda x: x * 3), "inner_entry",
+                                   registry=registry)
+        outer = jax.jit(lambda x: inner(x))
+        outer(jnp.ones(2))
+        # the inner wrapper saw only tracers — no retrace recorded for it
+        assert not registry.value("pyconsensus_jit_retraces_total",
+                                  entry="inner_entry")
+
+
+# -------------------------------------------- pipeline emission contract
+
+
+REPORTS = np.array([
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 1.0, 1.0, 0.0],
+    [0.0, 0.0, 1.0, 1.0],
+    [np.nan, 0.0, 1.0, 1.0],
+])
+
+
+class TestPipelineEmission:
+    def test_oracle_consensus_emits_convergence_metrics(self):
+        obs.reset()
+        r = Oracle(reports=REPORTS, backend="numpy",
+                   max_iterations=7).consensus()
+        conv = str(bool(r["convergence"])).lower()
+        assert obs.value("pyconsensus_consensus_total", algorithm="sztorc",
+                         backend="numpy", converged=conv) == 1
+        iters = obs.value("pyconsensus_consensus_iterations",
+                          algorithm="sztorc", backend="numpy")
+        assert iters["count"] == 1
+        assert iters["sum"] == r["iterations"]
+        # residual histogram saw one observation per executed iteration
+        res = obs.value("pyconsensus_convergence_residual",
+                        backend="numpy")
+        assert res["count"] == r["iterations"]
+        # redistribution mass: raw + smooth, both in [0, 1]
+        mass = obs.REGISTRY.get("pyconsensus_redistribution_mass")
+        for kind in ("raw", "smooth"):
+            v = mass.value(kind=kind)
+            assert v["count"] == 1
+            assert 0.0 <= v["sum"] <= 1.0
+        # the NaN cell was counted as a fill
+        assert obs.value("pyconsensus_na_fills_total",
+                         backend="numpy") == 1
+        # span tree: oracle.consensus wraps the numpy phases
+        names = [s.name for s in obs.TRACER.spans()]
+        assert "oracle.consensus" in names
+        assert {"np.fill", "np.iterate", "np.resolve"} <= set(names)
+
+    def test_oracle_jax_backend_emits_and_counts_compiles(self):
+        obs.reset()
+        Oracle(reports=REPORTS, backend="jax", max_iterations=3).consensus()
+        Oracle(reports=REPORTS, backend="jax", max_iterations=3).consensus()
+        assert obs.value("pyconsensus_consensus_total", algorithm="sztorc",
+                         backend="jax", converged="false") == 2
+        # identical params + shape: the entry point compiled ONCE across
+        # both resolutions (the acceptance-criterion invariant)
+        assert obs.value("pyconsensus_jit_retraces_total",
+                         entry="consensus_core") == 1
+
+    def test_hybrid_emits_cluster_spans(self):
+        obs.reset()
+        Oracle(reports=REPORTS, algorithm="hierarchical", backend="jax",
+               max_iterations=2).consensus()
+        names = [s.name for s in obs.TRACER.spans()]
+        assert "hybrid.device_prep" in names
+        assert "hybrid.cluster" in names
+        assert "clustering.hierarchical" in names
+        res = obs.value("pyconsensus_convergence_residual",
+                        backend="hybrid")
+        assert res is not None and res["count"] >= 1
+
+    def test_sharded_consensus_counts_paths(self):
+        obs.reset()
+        from pyconsensus_tpu.parallel import make_mesh, sharded_consensus
+
+        mesh = make_mesh(batch=1)
+        out = sharded_consensus(REPORTS, mesh=mesh)
+        np.asarray(out["outcomes_adjusted"])
+        snap = obs.REGISTRY.snapshot()[
+            "pyconsensus_sharded_resolutions_total"]["series"]
+        assert sum(snap.values()) == 1
+        assert obs.value("pyconsensus_mesh_event_shards") is not None

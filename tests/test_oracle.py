@@ -691,6 +691,13 @@ class TestConvergence:
         assert r1["iterations"] == 1
 
     def test_iterations_match_across_backends(self):
+        # TODO(issue-3) triage: fails at seed and still fails — the 50-
+        # iteration trajectory on the knife-edge CANONICAL matrix lands
+        # numpy-f64 and jax smooth_rep past the 1e-8 tolerance (iteration
+        # counts and convergence DO match). Genuine cross-backend
+        # trajectory divergence on an adversarial tie, not environmental;
+        # left failing so a fix (or a justified tolerance) closes it
+        # visibly.
         a = Oracle(reports=CANONICAL, max_iterations=50,
                    backend="numpy").consensus()
         b = Oracle(reports=CANONICAL, max_iterations=50,

@@ -1,12 +1,13 @@
 """Observability helpers (SURVEY.md §5 tracing/profiling row):
-PhaseTimer accumulation/blocking semantics and the trace() no-op/active
-paths."""
+PhaseTimer accumulation/blocking semantics — now a shim over
+pyconsensus_tpu.obs (ISSUE 3) — and the trace() no-op/active paths."""
 
 import time
 
 import jax.numpy as jnp
 import pytest
 
+from pyconsensus_tpu import obs
 from pyconsensus_tpu.utils import PhaseTimer, trace
 
 
@@ -29,7 +30,85 @@ class TestPhaseTimer:
             x = jnp.ones((64, 64))
             timer.observe(x @ x)
         assert timer.totals()["matmul"] > 0.0
-        assert timer._pending is None          # consumed by the phase exit
+        assert timer._pending == []           # restored at the phase exit
+
+    def test_observe_twice_blocks_both(self):
+        """ISSUE 3 satellite regression: the pre-obs implementation kept a
+        SINGLE ``_pending`` slot, so the second ``observe`` in one phase
+        overwrote the first — only the last value was blocked on and the
+        first value's device time was attributed to whatever phase
+        happened to block next. ``_pending`` is a list now: every observed
+        value must be waited on at phase exit."""
+
+        class Recorder:
+            def __init__(self):
+                self.blocked = 0
+
+            def block_until_ready(self):
+                self.blocked += 1
+                return self
+
+        first, second = Recorder(), Recorder()
+        timer = PhaseTimer()
+        with timer.phase("double"):
+            timer.observe(first)
+            assert timer._pending == [first]  # not overwritten below
+            timer.observe(second)
+            assert timer._pending == [first, second]
+        assert first.blocked == 1, "first observed value was dropped"
+        assert second.blocked == 1
+        assert timer._pending == []
+
+    def test_observe_nested_phases_attribute_to_inner(self):
+        """Nested phases keep separate pending lists: the inner phase's
+        observed value must not leak into (or clobber) the outer's."""
+
+        class Recorder:
+            def __init__(self):
+                self.blocked = 0
+
+            def block_until_ready(self):
+                self.blocked += 1
+                return self
+
+        outer_v, inner_v = Recorder(), Recorder()
+        timer = PhaseTimer()
+        with timer.phase("outer"):
+            timer.observe(outer_v)
+            with timer.phase("inner"):
+                timer.observe(inner_v)
+            assert inner_v.blocked == 1       # blocked at INNER exit
+            assert timer._pending == [outer_v]
+        assert outer_v.blocked == 1
+
+    def test_no_block_flag_skips_blocking(self):
+        class Explode:
+            def block_until_ready(self):     # pragma: no cover - must not run
+                raise AssertionError("block=False must not block")
+
+        timer = PhaseTimer()
+        with timer.phase("async", block=False):
+            timer.observe(Explode())
+        assert timer.totals()["async"] >= 0.0
+
+    def test_totals_accumulate_when_body_raises(self):
+        """Original-behavior regression (review catch): totals/counts were
+        updated in a finally, so a phase whose body raises still counts —
+        a sweep tolerating one failing phase keeps its timing."""
+        timer = PhaseTimer()
+        with pytest.raises(RuntimeError, match="boom"):
+            with timer.phase("failing"):
+                raise RuntimeError("boom")
+        assert timer.totals()["failing"] >= 0.0
+        assert timer.means()["failing"] >= 0.0
+
+    def test_observe_outside_phase_keeps_last_only(self):
+        """Outside any phase nothing drains the slot, so it must not
+        accumulate (pinning every observed device buffer)."""
+        timer = PhaseTimer()
+        timer.observe("a")
+        timer.observe("b")
+        assert timer._pending == ["b"]
 
     def test_report_sorted_by_total(self):
         timer = PhaseTimer()
@@ -40,6 +119,24 @@ class TestPhaseTimer:
         report = timer.report()
         assert report.index("slow") < report.index("fast")
         assert "call(s)" in report
+
+    def test_shim_feeds_tracer_and_registry(self):
+        """The compatibility shim is a thin layer over obs: each phase
+        shows up as a span (attrs mark the shim) and as a
+        pyconsensus_phase_seconds series."""
+        before = len(obs.TRACER.spans())
+        timer = PhaseTimer()
+        with timer.phase("shimmed"):
+            pass
+        spans = obs.TRACER.spans()
+        assert len(spans) == before + 1
+        assert spans[-1].name == "shimmed"
+        assert spans[-1].attrs.get("timer") == "PhaseTimer"
+        hist = obs.REGISTRY.get("pyconsensus_phase_seconds")
+        assert hist is not None
+        assert hist.value(phase="shimmed")["count"] >= 1
+        # shim totals equal the span duration exactly (single source)
+        assert timer.totals()["shimmed"] == spans[-1].duration_s
 
 
 class TestTrace:

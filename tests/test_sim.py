@@ -204,7 +204,15 @@ class TestCheckpointedSweep:
         """A mesh= simulator inside CheckpointedSweep shards every
         chunk's trial axis (the shared _dispatch point) and stays
         bit-identical to a single-device monolithic run — chunk widths
-        here are non-multiples of the 8 devices, exercising the pad."""
+        here are non-multiples of the 8 devices, exercising the pad.
+
+        TODO(issue-3) triage: fails at seed and still fails — ONE
+        liar_rep_share element out of 42 differs by a single ulp
+        (1.1e-16), so the documented bit-identity contract of meshed vs
+        monolithic dispatch is violated by one lane. Genuine contract
+        discrepancy (likely a sharded-vs-unsharded reduction-order leak
+        in the padded dispatch), not environmental; left failing until
+        the lane is tracked down or the contract is honestly weakened."""
         from pyconsensus_tpu.parallel import make_mesh
         from pyconsensus_tpu.sim import CheckpointedSweep
         mono = self._sim().run(self.LF, self.VAR, self.T, seed=3)

@@ -59,6 +59,40 @@ echo "=== CLI entry points ==="
 # the console scripts ci.yml's install creates
 "$VENV/bin/pyconsensus-tpu" --example >/dev/null && echo "console script OK"
 
+echo "=== Observability smoke (ISSUE 3: prom exposition + retrace stability) ==="
+# Run the light pipeline through the real CLI with --metrics-out twice in
+# ONE process: the exposition must contain the convergence-iteration,
+# phase-duration, and retrace metrics; the span JSONL must reconstruct
+# the nested phase tree; and the identical second run must keep the
+# retrace counter at exactly 1 (the CL304 invariant, observed at runtime).
+"$PY" - <<'PYEOF'
+import pathlib
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.cli import main
+
+out = pathlib.Path("/tmp/ci-rehearsal-obs")
+out.mkdir(exist_ok=True)
+main(["--example", "--metrics-out", str(out / "m1.prom"),
+      "--trace-out", str(out / "t1.jsonl")])
+main(["--example", "--metrics-out", str(out / "m2.prom")])
+text = (out / "m2.prom").read_text()
+required = ["pyconsensus_consensus_iterations",     # convergence
+            "pyconsensus_phase_seconds",            # phase durations
+            "pyconsensus_jit_retraces_total",       # compile observability
+            "pyconsensus_consensus_total"]
+missing = [m for m in required if m not in text]
+assert not missing, f"metrics missing from exposition: {missing}"
+v = obs.value("pyconsensus_jit_retraces_total", entry="consensus_core")
+assert v == 1, f"retrace counter must stay 1 after an identical re-run, got {v}"
+tree = obs.span_tree(obs.read_jsonl(out / "t1.jsonl"))
+roots = [t["name"] for t in tree]
+assert "oracle.consensus" in roots, f"span roots: {roots}"
+assert any(c["name"] == "pipeline.dispatch"
+           for t in tree for c in t["children"]), "span nesting lost"
+print("obs smoke OK: required metrics present, retrace counter stable at 1, "
+      "span JSONL reconstructs the phase tree")
+PYEOF
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
   --bench-timeout 300 | tail -1 | "$PY" -c \
