@@ -19,10 +19,13 @@ Public surface:
 - :mod:`pyconsensus_tpu.obs` — the observability subsystem: span tracer,
   metrics registry (Prometheus text exposition + JSONL sinks), and JAX
   compile/retrace observability (docs/OBSERVABILITY.md).
+- :mod:`pyconsensus_tpu.faults` — deterministic fault injection,
+  the structured ``ConsensusError`` taxonomy, graceful degradation, and
+  retry/crash-safe persistence (docs/ROBUSTNESS.md).
 - :mod:`pyconsensus_tpu.utils` — phase timers and profiler hooks.
 """
 
-from . import obs
+from . import faults, obs
 from .ledger import ReputationLedger
 from .models.pipeline import decode_reports, encode_reports
 from .oracle import ALGORITHMS, BACKENDS, Oracle
@@ -31,4 +34,5 @@ from .sweep import compare_algorithms, disagreement_matrix
 __version__ = "0.1.0"
 __all__ = ["Oracle", "ReputationLedger", "ALGORITHMS", "BACKENDS",
            "compare_algorithms", "disagreement_matrix",
-           "encode_reports", "decode_reports", "obs", "__version__"]
+           "encode_reports", "decode_reports", "obs", "faults",
+           "__version__"]

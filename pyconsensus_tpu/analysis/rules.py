@@ -56,6 +56,12 @@ RULES = {
     "CL502": ("error", "host wall-clock timer (time.*) or PhaseTimer "
                        "inside a jit-traced context (measures tracing, "
                        "not execution)"),
+    "CL601": ("error", "fault-injection hook (faults.fire / faults.corrupt "
+                       "/ arming) inside a jit-traced or shard_map context "
+                       "(injection sites are host-side only: in traced "
+                       "code the armed-plan check bakes into the compiled "
+                       "graph as a constant and the fault fires once per "
+                       "TRACE, not per run)"),
 }
 
 #: callables that trace their function argument into an XLA graph
@@ -99,6 +105,13 @@ _OBS_API = {
 #: metric-object methods (CL501 when the receiver was built from an obs
 #: call in the same scope)
 _OBS_EMIT_METHODS = {"inc", "set", "observe", "set_attr"}
+
+#: the faults package's injection/arming API (CL601 sources): hook names
+#: that must only ever run host-side. Kept in sync with
+#: pyconsensus_tpu.faults.__all__'s hook subset.
+_FAULTS_API = {
+    "fire", "corrupt", "arm", "disarm", "armed", "active_plan",
+}
 
 #: host wall-clock reads (CL502): under trace these stamp TRACE time into
 #: whatever consumes them, and the jit cache makes later calls not even
@@ -579,6 +592,37 @@ def _rule_obs_in_traced(mod: _Module) -> Iterable[Finding]:
                           f"caller")
 
 
+def _is_faults_dotted(dotted: Optional[str]) -> bool:
+    """Whether a canonicalized dotted call path is a faults-package hook:
+    ``faults.fire`` / ``_faults.corrupt`` (any from-import of the plan
+    module canonicalizes through the alias map), ``pyconsensus_tpu.
+    faults.*``, or a hook name imported directly from the package
+    (canon maps it to ``...faults.<name>`` / ``...faults.plan.<name>``)."""
+    if not dotted:
+        return False
+    parts = dotted.split(".")
+    if "faults" not in parts[:2] and not (
+            len(parts) > 2 and parts[1] == "faults"):
+        return False
+    return parts[-1] in _FAULTS_API
+
+
+def _rule_faults_in_traced(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if _is_faults_dotted(dotted):
+                yield _mk(mod, node, "CL601",
+                          f"'{dotted}' is a fault-injection hook inside "
+                          f"traced function '{fn.name}' — the armed-plan "
+                          f"check would bake into the compiled graph and "
+                          f"the fault would fire once per TRACE; inject "
+                          f"from the host caller (docs/ROBUSTNESS.md "
+                          f"site catalog)")
+
+
 def _rule_host_timer_in_traced(mod: _Module) -> Iterable[Finding]:
     for fn in mod.traced:
         for node in _walk_scope(fn):
@@ -603,7 +647,7 @@ _ALL_RULES = (
     _rule_host_sync, _rule_traced_branch, _rule_key_reuse,
     _rule_f64_in_kernel, _rule_weak_where, _rule_mutable_default,
     _rule_bare_except, _rule_unused_import, _rule_obs_in_traced,
-    _rule_host_timer_in_traced,
+    _rule_host_timer_in_traced, _rule_faults_in_traced,
 )
 
 

@@ -286,6 +286,14 @@ def main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("--obs-report", action="store_true",
                     help="print the human-readable span tree after the "
                          "run")
+    ap.add_argument("--fault-plan", metavar="PATH",
+                    help="arm a JSON fault-injection plan "
+                         "(pyconsensus_tpu.faults.FaultPlan schema) for "
+                         "the whole run — chaos-run reproduction: the "
+                         "same plan over the same inputs re-injects the "
+                         "same faults at the same sites/occurrences "
+                         "(docs/ROBUSTNESS.md); the activation log is "
+                         "printed on exit")
     ap.add_argument("--bounds", metavar="PATH",
                     help="with --file: JSON event-bounds sidecar — a list "
                          "with one entry per event, null for binary or "
@@ -413,55 +421,80 @@ def main(argv: Optional[Sequence[str]] = None,
         # streaming pays one full pass over the file per iteration — default
         # to the cheap single-iteration resolution there
         args.iterations = 1 if args.stream else 5
-    if args.file:
-        if args.stream:
-            try:
-                _run_streaming(args, file_bounds)
-            except (OSError, ValueError) as exc:
-                ap.error(f"--stream: {exc}")
-        else:
-            from .io import load_reports
+    fault_plan = None
+    if args.fault_plan:
+        from . import faults
 
-            try:
-                file_reports = load_reports(args.file)
-            except (OSError, ValueError) as exc:
-                ap.error(f"--file: {exc}")
-            if file_bounds is not None:
-                from .oracle import parse_event_bounds
+        try:
+            fault_plan = faults.FaultPlan.load(args.fault_plan)
+        except (OSError, ValueError, KeyError) as exc:
+            ap.error(f"--fault-plan: {exc}")
+        # armed for the WHOLE run (every demo/file/sweep resolution below
+        # shares the plan's occurrence counters — that is what makes a
+        # replay deterministic); disarmed in the finally, with the
+        # activation log printed for the chaos-run record
+        faults.arm(fault_plan)
+    try:
+        if args.file:
+            if args.stream:
+                try:
+                    _run_streaming(args, file_bounds)
+                except (OSError, ValueError) as exc:
+                    ap.error(f"--stream: {exc}")
+            else:
+                from .io import load_reports
 
                 try:
-                    parse_event_bounds(file_bounds, file_reports.shape[1])
-                except ValueError as exc:
-                    ap.error(f"--bounds: {exc}")
-            _run_demo(f"Reports from {args.file}", file_reports,
-                      file_bounds, args)
-    if args.example:
-        _run_demo("Example (dense binary)", EXAMPLE_REPORTS, None, args)
-    if args.missing:
-        _run_demo("Example with missing reports", MISSING_REPORTS, None, args)
-    if args.scaled:
-        _run_demo("Example with scaled events", SCALED_REPORTS,
-                  SCALED_BOUNDS, args)
-    if args.simulate:
-        _run_simulation(args)
-    if args.metrics_out or args.trace_out or args.obs_report:
-        from . import obs
+                    file_reports = load_reports(args.file)
+                except (OSError, ValueError) as exc:
+                    ap.error(f"--file: {exc}")
+                if file_bounds is not None:
+                    from .oracle import parse_event_bounds
 
-        if args.metrics_out:
-            obs.write_prom(args.metrics_out, obs.REGISTRY)
-            print(f"metrics written to {args.metrics_out} "
-                  f"(Prometheus text exposition)")
-        if args.trace_out:
-            n = obs.write_jsonl(
-                args.trace_out, obs.events(),
-                meta={"prog": prog,
-                      "argv": list(argv if argv is not None
-                                   else sys.argv[1:])})
-            print(f"span trace written to {args.trace_out} "
-                  f"({n} JSONL record(s))")
-        if args.obs_report:
-            print("\n=== Span tree (slowest roots first) ===")
-            print(obs.report())
+                    try:
+                        parse_event_bounds(file_bounds, file_reports.shape[1])
+                    except ValueError as exc:
+                        ap.error(f"--bounds: {exc}")
+                _run_demo(f"Reports from {args.file}", file_reports,
+                          file_bounds, args)
+        if args.example:
+            _run_demo("Example (dense binary)", EXAMPLE_REPORTS, None, args)
+        if args.missing:
+            _run_demo("Example with missing reports", MISSING_REPORTS, None, args)
+        if args.scaled:
+            _run_demo("Example with scaled events", SCALED_REPORTS,
+                      SCALED_BOUNDS, args)
+        if args.simulate:
+            _run_simulation(args)
+        if args.metrics_out or args.trace_out or args.obs_report:
+            from . import obs
+
+            if args.metrics_out:
+                obs.write_prom(args.metrics_out, obs.REGISTRY)
+                print(f"metrics written to {args.metrics_out} "
+                      f"(Prometheus text exposition)")
+            if args.trace_out:
+                n = obs.write_jsonl(
+                    args.trace_out, obs.events(),
+                    meta={"prog": prog,
+                          "argv": list(argv if argv is not None
+                                       else sys.argv[1:])})
+                print(f"span trace written to {args.trace_out} "
+                      f"({n} JSONL record(s))")
+            if args.obs_report:
+                print("\n=== Span tree (slowest roots first) ===")
+                print(obs.report())
+    finally:
+        if fault_plan is not None:
+            from . import faults
+
+            faults.disarm()
+            if fault_plan.fired:
+                print("\ninjected faults (site #occurrence: kind):")
+                for site, occ, kind in fault_plan.fired:
+                    print(f"  {site} #{occ}: {kind}")
+            else:
+                print("\nfault plan armed; no rule fired")
     return 0
 
 

@@ -1,0 +1,53 @@
+"""pyconsensus_tpu.faults — deterministic fault injection, structured
+errors, graceful degradation, and retry (ISSUE 4 tentpole).
+
+Quick use::
+
+    from pyconsensus_tpu import faults
+
+    plan = faults.FaultPlan(seed=7, rules=[
+        {"site": "sharded.reports", "kind": "nan_storm",
+         "occurrences": [0], "args": {"fraction": 0.02}},
+        {"site": "sweep.chunk.pre_commit", "kind": "crash",
+         "occurrences": [1]},
+    ])
+    with faults.armed(plan):
+        ...                       # the chaos run
+    print(plan.fired)             # [(site, occurrence, kind), ...]
+    plan.save("plan.json")        # replay later: --fault-plan plan.json
+
+Rules of engagement:
+
+- **host-side only.** ``fire``/``corrupt`` sites live in host code
+  (IO, checkpoint commits, panel staging, front-door entries) — never
+  inside jit-traced / shard_map / pallas code, where the armed-plan
+  check would bake into the compiled graph. consensus-lint CL601
+  rejects traced injection sites statically.
+- **zero overhead disarmed.** Both hooks test one module global against
+  ``None`` and return; no counters, no PRNG, no allocation.
+- **deterministic.** Activation and payloads are pure functions of
+  (plan seed, site name, occurrence index) — same plan + same workload
+  = same faults, regardless of unrelated call interleaving.
+- the **site catalog**, **error-code table**, and **fallback chain**
+  live in docs/ROBUSTNESS.md; extend them when adding sites.
+"""
+
+from __future__ import annotations
+
+from .degrade import (POWER_METHODS, fallback_steps, quarantine_nonfinite,
+                      raise_exhausted, record_fallback, result_nonfinite)
+from .errors import (ERROR_CODES, CheckpointCorruptionError, ConsensusError,
+                     ConvergenceError, InputError, NumericsError)
+from .plan import (FaultPlan, FaultRule, SimulatedCrash, active_plan, arm,
+                   armed, corrupt, disarm, fire)
+from .retry import retry, retry_call
+
+__all__ = [
+    "FaultPlan", "FaultRule", "SimulatedCrash",
+    "arm", "disarm", "armed", "active_plan", "fire", "corrupt",
+    "ConsensusError", "InputError", "NumericsError", "ConvergenceError",
+    "CheckpointCorruptionError", "ERROR_CODES",
+    "retry", "retry_call",
+    "quarantine_nonfinite", "result_nonfinite", "record_fallback",
+    "fallback_steps", "raise_exhausted", "POWER_METHODS",
+]

@@ -1,0 +1,130 @@
+"""Graceful degradation: quarantine + the documented fallback chain
+(ISSUE 4, part b).
+
+**Row quarantine.** NaN is the mechanism's legal non-participation
+marker, but ±Inf in a reports matrix (a poisoned feed, an overflowed
+upstream aggregation) used to ride the fill pass into every covariance
+contraction and NaN the whole resolution. The front doors
+(:class:`..oracle.Oracle`, ``parallel.sharded_consensus``) now route
+host matrices through :func:`quarantine_nonfinite`: rows containing a
+non-finite non-NaN value are replaced by all-NaN (full
+non-participation — the reporter simply isn't heard this round), the
+row indices are reported (``quarantined_rows`` result field) and
+counted (``pyconsensus_quarantined_rows_total``). The clean-matrix cost
+is one ``np.isfinite().all()`` host scan, which REPLACES the
+``np.isnan().any()`` scan those doors already paid for ``has_na``.
+
+**Fallback chain.** A power-family PCA that fails to converge (residual
+plateau / collapsed loading) or numerically degenerate inputs can leave
+non-finite values in the *outputs*. Detection is host-side on the
+fetched result (:func:`result_nonfinite` — O(R + E), no extra device
+sync) and recovery walks a documented chain, re-resolving with strictly
+more conservative numerics at each rung::
+
+    power-fused (Pallas)  ->  eigh-gram (exact XLA)  ->  numpy reference
+
+Each hop emits ``pyconsensus_fallbacks_total{from,to,reason}``. If the
+numpy reference also yields non-finite outputs, the failure is genuine:
+:class:`..faults.errors.ConvergenceError` (power-family start — the
+plateau was the root cause) or :class:`NumericsError` (already-exact
+start) is raised rather than returning a poisoned result.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from .errors import ConvergenceError, NumericsError
+
+__all__ = ["quarantine_nonfinite", "result_nonfinite", "record_fallback",
+           "fallback_steps", "raise_exhausted", "POWER_METHODS"]
+
+#: pca methods whose failure mode is iterative non-convergence — the
+#: chain's entry rungs (and the ConvergenceError classification)
+POWER_METHODS = ("power-fused", "power")
+
+#: result keys checked for non-finite escape, in cost order: the O(R)
+#: reputation first (a poisoned scorer always shows there), then the
+#: O(E) outcome/certainty vectors
+_CHECK_KEYS = ("smooth_rep", "this_rep", "outcomes_final", "certainty")
+
+
+def quarantine_nonfinite(reports: np.ndarray
+                         ) -> Tuple[np.ndarray, Optional[np.ndarray], bool]:
+    """Replace rows holding ±Inf (any non-finite value that is not the
+    legal NaN marker) with all-NaN rows. Returns ``(reports,
+    quarantined_row_indices-or-None, has_na)``; the input is only copied
+    when a quarantine actually happens. ``has_na`` falls out for free —
+    the front doors previously paid an ``np.isnan().any()`` scan for it,
+    which this single ``np.isfinite()`` pass replaces, so the
+    clean-matrix cost of quarantine is zero extra host passes. Host
+    numpy float matrices only — the callers gate on that."""
+    finite = np.isfinite(reports)
+    if finite.all():
+        return reports, None, False
+    poisoned = ~finite & ~np.isnan(reports)          # Inf / -Inf cells
+    rows = poisoned.any(axis=1)
+    if not rows.any():
+        return reports, None, True                   # NaN-only: legal
+    out = np.array(reports, copy=True)
+    out[rows] = np.nan
+    idx = np.nonzero(rows)[0]
+    obs.counter(
+        "pyconsensus_quarantined_rows_total",
+        "report rows quarantined (set to full non-participation) for "
+        "carrying non-finite non-NaN values").inc(int(idx.size))
+    return out, idx, True
+
+
+def result_nonfinite(raw: dict) -> bool:
+    """Whether a fetched (host) flat result dict carries non-finite
+    values in its decision outputs. O(R + E) host arithmetic."""
+    for key in _CHECK_KEYS:
+        v = raw.get(key)
+        if v is not None and not np.isfinite(
+                np.asarray(v, dtype=np.float64)).all():
+            return True
+    return False
+
+
+def record_fallback(frm: str, to: str, reason: str) -> None:
+    obs.counter(
+        "pyconsensus_fallbacks_total",
+        "graceful-degradation fallback hops (docs/ROBUSTNESS.md chain)",
+        labels=("from", "to", "reason")).inc(
+            **{"from": frm, "to": to, "reason": reason})
+
+
+def fallback_steps(pca_method: str, backend: str):
+    """The ordered ``(from_label, to_label, params_update)`` hops to try
+    after a non-finite result. ``params_update`` is a dict of
+    ConsensusParams field overrides; the special key ``"backend"``
+    switches the whole execution path to the numpy reference."""
+    steps = []
+    if backend == "jax" and pca_method in POWER_METHODS:
+        steps.append((pca_method, "eigh-gram",
+                      {"pca_method": "eigh-gram", "fused_resolution": False,
+                       "allow_fused": False}))
+    if backend == "jax":
+        frm = "eigh-gram" if pca_method in POWER_METHODS else pca_method
+        steps.append((f"jax:{frm}", "numpy", {"backend": "numpy"}))
+    return steps
+
+
+def raise_exhausted(pca_method: str, algorithm: str) -> None:
+    """Every rung failed: classify and raise (never return poison)."""
+    if pca_method in POWER_METHODS:
+        raise ConvergenceError(
+            f"power-family PCA ({pca_method!r}) produced non-finite "
+            f"scores and every fallback rung (eigh-gram, numpy "
+            f"reference) stayed non-finite — the {algorithm!r} "
+            f"resolution has no convergent route for this input",
+            pca_method=pca_method, algorithm=algorithm)
+    raise NumericsError(
+        f"non-finite values in the {algorithm!r} resolution outputs "
+        f"survived the whole fallback chain (docs/ROBUSTNESS.md) — "
+        f"refusing to return a poisoned result",
+        pca_method=pca_method, algorithm=algorithm)

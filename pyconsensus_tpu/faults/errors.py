@@ -1,0 +1,90 @@
+"""Structured consensus error taxonomy (ISSUE 4).
+
+Every failure the pipeline can *diagnose* carries a stable ``error_code``
+so operators (and the chaos suite) can alert on classes of failure
+instead of grepping message strings. The classes double-inherit from the
+builtin exception the pre-taxonomy code raised (``ValueError`` for input
+and checkpoint problems, ``ArithmeticError`` for numeric ones), so every
+existing ``except ValueError`` / ``pytest.raises(ValueError)`` caller
+keeps working — the taxonomy *narrows* what is raised, it never widens
+what must be caught.
+
+Code space (documented in docs/ROBUSTNESS.md):
+
+- ``PYC1xx`` — input: malformed files, ragged CSV rows, bad shapes,
+  non-finite reputation, empty matrices. The caller's data is wrong.
+- ``PYC2xx`` — numerics: non-finite values escaping into (or out of) the
+  resolution after quarantine/fallback exhausted the degradation chain.
+  ``PYC201`` is the generic case; ``PYC202`` marks a detected
+  power-family PCA non-convergence (residual plateau / collapsed
+  loading) that survived every fallback rung.
+- ``PYC3xx`` — checkpoint: torn/corrupted/incomplete persisted state
+  (ledger checkpoints, sweep chunks). Always names the offending field
+  or file so a resume failure is actionable without a debugger.
+
+``context`` keyword arguments are stored on the exception (``.context``)
+for structured logging; the message stays human-first.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ConsensusError", "InputError", "NumericsError",
+           "ConvergenceError", "CheckpointCorruptionError", "ERROR_CODES"]
+
+
+class ConsensusError(Exception):
+    """Base of the structured taxonomy. ``error_code`` is stable across
+    releases; ``context`` carries machine-readable details (row/column
+    indices, field names, file paths)."""
+
+    error_code = "PYC000"
+
+    def __init__(self, message: str = "", **context) -> None:
+        super().__init__(message)
+        self.context = dict(context)
+
+    def __str__(self) -> str:  # "[PYC101] path: bad field ..." in logs
+        return f"[{self.error_code}] {super().__str__()}"
+
+
+class InputError(ConsensusError, ValueError):
+    """The caller's data is malformed: ragged/truncated CSV rows, a
+    non-2-D or empty reports matrix, non-finite reputation, unknown
+    formats. Subclasses ``ValueError`` — the exception this replaced."""
+
+    error_code = "PYC101"
+
+
+class NumericsError(ConsensusError, ArithmeticError):
+    """Non-finite values survived quarantine and the whole documented
+    fallback chain (docs/ROBUSTNESS.md) — the resolution cannot produce
+    a trustworthy answer and refuses to return a poisoned one."""
+
+    error_code = "PYC201"
+
+
+class ConvergenceError(NumericsError):
+    """A power-family PCA scorer failed to converge (residual plateau /
+    collapsed loading detected on the host result) and every fallback
+    rung — exact Gram eigh, then the numpy reference path — failed too."""
+
+    error_code = "PYC202"
+
+
+class CheckpointCorruptionError(ConsensusError, ValueError):
+    """Persisted state failed validation on restore: a missing or
+    malformed field in a ledger checkpoint, a sweep chunk whose content
+    checksum does not match, a torn npz. The message names the offending
+    field/file; recovery (re-dispatch, re-compute) is the caller's call —
+    ``CheckpointedSweep`` recomputes, ``ReputationLedger.load`` raises."""
+
+    error_code = "PYC301"
+
+
+#: stable code -> class registry (docs/ROBUSTNESS.md table is generated
+#: from the same source of truth; tests pin the codes)
+ERROR_CODES = {
+    cls.error_code: cls
+    for cls in (ConsensusError, InputError, NumericsError,
+                ConvergenceError, CheckpointCorruptionError)
+}

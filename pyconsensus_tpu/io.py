@@ -21,13 +21,18 @@ TPU-native framework's ingestion path:
 
 from __future__ import annotations
 
+import os
 import pathlib
 import re as _re
+import tempfile
 
 import numpy as np
 
+from .faults import InputError
+from .faults import plan as _faults
+
 __all__ = ["save_reports", "load_reports", "load_reports_sharded",
-           "csv_to_npy", "ensure_parent"]
+           "csv_to_npy", "ensure_parent", "atomic_write"]
 
 
 def ensure_parent(path) -> pathlib.Path:
@@ -40,25 +45,83 @@ def ensure_parent(path) -> pathlib.Path:
     return path
 
 
+def atomic_write(final, writer, suffix: str = ".tmp", dir=None,
+                 fsync: bool = True) -> pathlib.Path:
+    """All-or-nothing file creation: ``writer(tmp_path)`` fills a
+    ``mkstemp``-unique temporary in the target directory, the data (and,
+    after the rename, the directory entry) is fsynced, and ``os.replace``
+    installs it — a reader never sees a partial file, and a crash at any
+    point leaves either the old content or the new, never a torn write.
+    Safe against CONCURRENT writers of ``final`` (several hosts racing on
+    a shared checkpoint dir): each gets its own tmp — pids alone are not
+    unique across hosts — and last-writer-wins is harmless when racers
+    write identical content by construction.
+
+    ``suffix`` must carry the real extension for numpy writers
+    (``.tmp.npy`` / ``.tmp.npz`` — ``np.save``/``np.savez`` append one to
+    unsuffixed paths). ``fsync=False`` skips both syncs for callers on
+    throwaway data. Returns ``final`` as a Path."""
+    final = ensure_parent(final)
+    fd, tmp = tempfile.mkstemp(dir=dir if dir is not None else final.parent,
+                               suffix=suffix)
+    try:
+        # mkstemp creates 0600 and os.replace preserves it — restore
+        # umask-based permissions so a different account (gather / mop-up
+        # on a shared filesystem) can read the installed file. The fd is
+        # closed unconditionally: an fchmod failure (ACL'd filesystems)
+        # must not leak one descriptor per retry attempt.
+        try:
+            umask = os.umask(0)
+            os.umask(umask)
+            os.fchmod(fd, 0o666 & ~umask)
+        finally:
+            os.close(fd)
+        writer(tmp)
+        if fsync:
+            with open(tmp, "rb") as f:
+                os.fsync(f.fileno())
+        os.replace(tmp, final)
+        if fsync:
+            dfd = os.open(final.parent, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+        raise
+    return final
+
+
 def save_reports(path, reports) -> pathlib.Path:
     """Write a reports matrix to ``path`` (format by suffix: ``.npy`` binary
-    or ``.csv`` text with ``NA`` for missing entries). Returns the path."""
-    path = ensure_parent(path)
+    or ``.csv`` text with ``NA`` for missing entries). The write is atomic
+    (:func:`atomic_write`): a crash mid-save leaves the previous file (or
+    nothing), never a torn matrix. Returns the path."""
+    path = pathlib.Path(path)
     reports = np.asarray(reports, dtype=np.float64)
     if reports.ndim != 2:
-        raise ValueError(f"reports must be 2-D, got shape {reports.shape}")
+        raise InputError(f"reports must be 2-D, got shape {reports.shape}",
+                         shape=tuple(reports.shape))
     if path.suffix == ".npy":
-        np.save(path, reports)
-    elif path.suffix == ".csv":
-        with open(path, "w") as f:
-            for row in reports:
-                f.write(",".join("NA" if np.isnan(v) else repr(float(v))
-                                 for v in row))
-                f.write("\n")
-    else:
-        raise ValueError(f"unsupported reports format {path.suffix!r} "
-                         f"(use .npy or .csv)")
-    return path
+        def write(tmp):
+            np.save(tmp, reports)
+            _faults.fire("io.write", path=tmp)
+        return atomic_write(path, write, suffix=".tmp.npy")
+    if path.suffix == ".csv":
+        def write(tmp):
+            with open(tmp, "w") as f:
+                for row in reports:
+                    f.write(",".join("NA" if np.isnan(v) else repr(float(v))
+                                     for v in row))
+                    f.write("\n")
+            _faults.fire("io.write", path=tmp)
+        return atomic_write(path, write, suffix=".tmp.csv")
+    raise InputError(f"unsupported reports format {path.suffix!r} "
+                     f"(use .npy or .csv)", path=str(path))
 
 
 _NA_TOKENS = frozenset({"", "na", "nan", "null"})
@@ -97,11 +160,12 @@ def _csv_header_lines(path) -> int:
 def _csv_read_fallback(path) -> np.ndarray:
     """Strict pure-Python CSV parse with the native loader's exact contract:
     NA markers -> NaN, but a field that is neither numeric nor an NA marker,
-    or a ragged row, raises ValueError with the same 0-based data-row index
-    the native parser reports. (``np.genfromtxt`` is NOT used: it silently
-    coerces corrupt fields to NaN — i.e. to "non-participation" — which
-    would make results differ between machines with and without a
-    compiler.)"""
+    or a ragged/truncated row, raises a structured :class:`InputError`
+    (a ValueError — the pre-taxonomy contract) with the same 0-based
+    data-row index the native parser reports, plus the offending column.
+    (``np.genfromtxt`` is NOT used: it silently coerces corrupt fields to
+    NaN — i.e. to "non-participation" — which would make results differ
+    between machines with and without a compiler.)"""
     skip = _csv_header_lines(path)
     rows: list = []
     width = -1
@@ -123,27 +187,40 @@ def _csv_read_fallback(path) -> np.ndarray:
             if width < 0:
                 width = len(vals)
             elif len(vals) != width:
-                raise ValueError(f"{path}: bad field or ragged row at data "
-                                 f"row {data_row}")
+                raise _ragged(path, data_row, width, len(vals))
             rows.append(vals)
             data_row += 1
     if not rows:
-        raise ValueError(f"{path}: not a readable, non-empty CSV")
+        raise InputError(f"{path}: not a readable, non-empty CSV",
+                         path=str(path))
     return np.asarray(rows, dtype=np.float64)
+
+
+def _ragged(path, data_row: int, expected: int, got: int) -> InputError:
+    """Shared ragged/truncated-row error: a short final row is what a
+    truncated file looks like to the parser, so the message says so."""
+    kind = "truncated or ragged" if got < expected else "ragged"
+    return InputError(
+        f"{path}: bad field or ragged row at data row {data_row} — "
+        f"{kind} row has {got} field(s), expected {expected}",
+        path=str(path), row=data_row, expected=expected, got=got)
 
 
 def _parse_csv_row(line: str, path, data_row: int) -> list:
     """One CSV data line -> list of floats (NaN for NA markers), with the
-    native loader's strict field contract and error message."""
+    native loader's strict field contract; a bad field raises
+    :class:`InputError` carrying the row AND column index."""
     vals = []
-    for tok in line.split(","):
+    for col, tok in enumerate(line.split(",")):
         tok = tok.strip()
         if tok.lower() in _NA_TOKENS:
             vals.append(np.nan)
             continue
         if not _FLOAT_GRAMMAR.match(tok):
-            raise ValueError(f"{path}: bad field or ragged row at "
-                             f"data row {data_row}")
+            raise InputError(
+                f"{path}: bad field or ragged row at data row {data_row} "
+                f"— field {tok!r} at column {col} is neither numeric nor "
+                f"an NA marker", path=str(path), row=data_row, column=col)
         vals.append(float(tok))
     return vals
 
@@ -163,7 +240,8 @@ def csv_to_npy(src, dst=None, chunk_rows: int = 4096) -> pathlib.Path:
     """
     src = pathlib.Path(src)
     if src.suffix != ".csv":
-        raise ValueError(f"{src}: csv_to_npy stages .csv files")
+        raise InputError(f"{src}: csv_to_npy stages .csv files",
+                         path=str(src))
     dst = pathlib.Path(dst) if dst is not None else src.with_suffix(".npy")
     if chunk_rows < 1:
         raise ValueError("chunk_rows must be >= 1")
@@ -184,10 +262,17 @@ def csv_to_npy(src, dst=None, chunk_rows: int = 4096) -> pathlib.Path:
                 width = len(line.split(","))
             n_rows += 1
     if n_rows == 0:
-        raise ValueError(f"{src}: not a readable, non-empty CSV")
+        raise InputError(f"{src}: not a readable, non-empty CSV",
+                         path=str(src))
 
-    out = np.lib.format.open_memmap(ensure_parent(dst), mode="w+",
-                                    dtype=np.float64, shape=(n_rows, width))
+    # stage into a same-directory tmp and os.replace at the end: a crash
+    # (or malformed row / ENOSPC) mid-stage never leaves a partial .npy
+    # under the final name for a later run to mmap as truth
+    fd, tmp = tempfile.mkstemp(dir=ensure_parent(dst).parent,
+                               suffix=".tmp.npy")
+    os.close(fd)
+    out = np.lib.format.open_memmap(tmp, mode="w+", dtype=np.float64,
+                                    shape=(n_rows, width))
     try:
         # parse straight into a preallocated float64 block: a Python
         # list-of-lists chunk costs ~4x the block in PyFloat objects,
@@ -208,8 +293,7 @@ def csv_to_npy(src, dst=None, chunk_rows: int = 4096) -> pathlib.Path:
                     continue
                 vals = _parse_csv_row(line, src, data_row)
                 if len(vals) != width:
-                    raise ValueError(f"{src}: bad field or ragged row at "
-                                     f"data row {data_row}")
+                    raise _ragged(src, data_row, width, len(vals))
                 buf[fill] = vals
                 fill += 1
                 data_row += 1
@@ -220,9 +304,15 @@ def csv_to_npy(src, dst=None, chunk_rows: int = 4096) -> pathlib.Path:
         if fill:
             out[base:base + fill] = buf[:fill]
         out.flush()
-    except Exception:
         del out
-        dst.unlink(missing_ok=True)
+        _faults.fire("io.stage", path=tmp)
+        os.replace(tmp, dst)
+    except BaseException:
+        try:
+            del out                   # already deleted on the replace path
+        except NameError:
+            pass
+        pathlib.Path(tmp).unlink(missing_ok=True)
         raise
     return dst
 
@@ -232,24 +322,39 @@ def load_reports(path, mmap: bool = False) -> np.ndarray:
 
     ``mmap=True`` memory-maps a ``.npy`` file read-only (no copy until
     sliced) — the building block for shard-wise ingestion of matrices
-    larger than host RAM.
+    larger than host RAM. A torn or truncated ``.npy`` (numpy's reader
+    fails on it) surfaces as a structured :class:`InputError` naming the
+    file, not a bare parser exception; a missing file stays
+    ``FileNotFoundError``.
     """
     path = pathlib.Path(path)
+    _faults.fire("io.read", path=path)
     if path.suffix == ".npy":
-        arr = np.load(path, mmap_mode="r" if mmap else None)
+        try:
+            arr = np.load(path, mmap_mode="r" if mmap else None)
+        except FileNotFoundError:
+            raise
+        except (OSError, ValueError) as exc:
+            raise InputError(
+                f"{path}: unreadable .npy reports file — truncated, torn, "
+                f"or not an .npy ({exc})", path=str(path)) from exc
         if arr.ndim != 2:
-            raise ValueError(f"{path}: expected a 2-D reports matrix, got "
-                             f"shape {arr.shape}")
-        return arr
+            raise InputError(f"{path}: expected a 2-D reports matrix, got "
+                             f"shape {arr.shape}", path=str(path),
+                             shape=tuple(arr.shape))
+        return _faults.corrupt("io.decode", arr)
     if path.suffix == ".csv":
         from . import _native
 
-        arr = _native.csv_read(path)
+        try:
+            arr = _native.csv_read(path)
+        except ValueError as exc:            # native parser: same taxonomy
+            raise InputError(str(exc), path=str(path)) from exc
         if arr is None:                      # no compiler: pure-Python path
             arr = _csv_read_fallback(path)
-        return arr
-    raise ValueError(f"unsupported reports format {path.suffix!r} "
-                     f"(use .npy or .csv)")
+        return _faults.corrupt("io.decode", arr)
+    raise InputError(f"unsupported reports format {path.suffix!r} "
+                     f"(use .npy or .csv)", path=str(path))
 
 
 def load_reports_sharded(path, mesh=None, dtype=None):

@@ -23,12 +23,22 @@ import pathlib
 
 import numpy as np
 
-from .io import ensure_parent
+from .faults import CheckpointCorruptionError
+from .faults import plan as _faults
+from .io import atomic_write
 from .oracle import Oracle
 
 __all__ = ["ReputationLedger"]
 
 _FORMAT_VERSION = 1
+
+#: required checkpoint fields -> validator run on restore. Every restored
+#: value passes its validator or ``load`` raises a
+#: :class:`CheckpointCorruptionError` NAMING the field — a bad
+#: checkpoint must fail at the load site, not rounds later inside
+#: ``resolve`` (ISSUE 4 satellite).
+_REQUIRED_FIELDS = ("format_version", "reputation", "round", "history",
+                    "oracle_kwargs")
 
 
 def _json_scalar(obj):
@@ -140,33 +150,121 @@ class ReputationLedger:
         path = pathlib.Path(path)
         if path.suffix != ".npz":
             path = path.with_name(path.name + ".npz")
-        np.savez(ensure_parent(path), **self._state_tree())
+        state = self._state_tree()
+
+        def write(tmp):
+            np.savez(tmp, **state)
+            _faults.fire("ledger.save", path=tmp)
+        # atomic + fsynced (io.atomic_write): a crash mid-save leaves the
+        # PREVIOUS checkpoint intact — overwriting a good checkpoint in
+        # place was the one way a long run could lose its only copy
+        atomic_write(path, write, suffix=".tmp.npz")
 
     @classmethod
-    def _from_state(cls, data) -> "ReputationLedger":
-        version = int(data["format_version"])
+    def _validate_state(cls, data, source) -> dict:
+        """Field-presence / shape / dtype / finiteness validation of a
+        restored state tree. Returns the decoded pieces; raises
+        :class:`CheckpointCorruptionError` naming the offending field."""
+        def bad(field, why, **ctx):
+            return CheckpointCorruptionError(
+                f"{source}: checkpoint field '{field}' {why}",
+                field=field, source=str(source), **ctx)
+
+        keys = set(getattr(data, "files", None) or data.keys())
+        for field in _REQUIRED_FIELDS:
+            if field not in keys:
+                raise bad(field, "is missing")
+        try:
+            version = int(np.asarray(data["format_version"]).item())
+        except (TypeError, ValueError) as exc:
+            raise bad("format_version", f"is not an integer ({exc})")
         if version > _FORMAT_VERSION:
-            raise ValueError(f"checkpoint format {version} is newer "
-                             f"than supported {_FORMAT_VERSION}")
-        rep = np.asarray(data["reputation"], dtype=np.float64)
-        kwargs = json.loads(bytes(data["oracle_kwargs"]).decode())
-        ledger = cls(n_reporters=rep.shape[0], reputation=rep, **kwargs)
+            raise bad("format_version",
+                      f"({version}) is newer than supported "
+                      f"{_FORMAT_VERSION}", version=version)
+        rep = np.asarray(data["reputation"])
+        if rep.ndim != 1 or rep.shape[0] < 1:
+            raise bad("reputation",
+                      f"must be a non-empty 1-D vector, got shape "
+                      f"{rep.shape}", shape=tuple(rep.shape))
+        if rep.dtype.kind not in "fiu":
+            raise bad("reputation", f"has non-numeric dtype {rep.dtype}")
+        rep = rep.astype(np.float64)
+        if not np.isfinite(rep).all():
+            raise bad("reputation", "contains non-finite values")
+        if (rep < 0).any():
+            raise bad("reputation", "contains negative mass")
+        if rep.sum() <= 0:
+            raise bad("reputation", "has no positive mass")
+        try:
+            rnd = int(np.asarray(data["round"]).item())
+        except (TypeError, ValueError) as exc:
+            raise bad("round", f"is not an integer scalar ({exc})")
+        if rnd < 0:
+            raise bad("round", f"is negative ({rnd})", value=rnd)
+        decoded = {}
+        for field, expect in (("history", list), ("oracle_kwargs", dict)):
+            try:
+                decoded[field] = json.loads(bytes(
+                    np.asarray(data[field], dtype=np.uint8)).decode())
+            except (TypeError, ValueError) as exc:
+                raise bad(field, f"does not decode as JSON ({exc})")
+            if not isinstance(decoded[field], expect):
+                raise bad(field, f"decodes to "
+                          f"{type(decoded[field]).__name__}, expected "
+                          f"{expect.__name__}")
+        return {"reputation": rep, "round": rnd, **decoded}
+
+    @classmethod
+    def _from_state(cls, data, source="checkpoint") -> "ReputationLedger":
+        state = cls._validate_state(data, source)
+        rep = state["reputation"]
+        ledger = cls(n_reporters=rep.shape[0], reputation=rep,
+                     **state["oracle_kwargs"])
         ledger.reputation = rep          # verbatim — no re-normalization,
-        ledger.round = int(data["round"])  # resume is bit-exact
-        ledger.history = json.loads(bytes(data["history"]).decode())
+        ledger.round = state["round"]    # resume is bit-exact
+        ledger.history = state["history"]
         return ledger
 
     @classmethod
     def load(cls, path) -> "ReputationLedger":
         """Restore a ledger exactly as :meth:`save` left it. The format is
-        auto-detected: an orbax checkpoint is a directory, an npz a file."""
+        auto-detected: an orbax checkpoint is a directory, an npz a file.
+        A torn/unreadable file or a failed field validation raises
+        :class:`CheckpointCorruptionError` naming the problem — never a
+        parser traceback or, worse, an error rounds later inside
+        ``resolve``."""
         path = pathlib.Path(path)
+        _faults.fire("ledger.load", path=path)
         if path.is_dir():
             import orbax.checkpoint as ocp
 
-            data = ocp.PyTreeCheckpointer().restore(path.resolve())
-            return cls._from_state(data)
+            try:
+                data = ocp.PyTreeCheckpointer().restore(path.resolve())
+                return cls._from_state(data, source=path)
+            except CheckpointCorruptionError:
+                raise
+            except Exception as exc:
+                # a truncated orbax directory / TensorStore error / bad
+                # kwarg exploding in the rebuild — same taxonomy as the
+                # npz branch below
+                raise CheckpointCorruptionError(
+                    f"{path}: unreadable checkpoint "
+                    f"({type(exc).__name__}: {exc})",
+                    source=str(path)) from exc
         if not path.exists() and path.suffix != ".npz":
             path = path.with_name(path.name + ".npz")
-        with np.load(path) as data:
-            return cls._from_state(data)
+        try:
+            with np.load(path) as data:
+                return cls._from_state(data, source=path)
+        except FileNotFoundError:
+            raise
+        except CheckpointCorruptionError:
+            raise
+        except Exception as exc:
+            # zipfile.BadZipFile (torn write), pickle errors, truncated
+            # members, a bad kwarg exploding in the constructor —
+            # anything the npz reader or the rebuild can throw
+            raise CheckpointCorruptionError(
+                f"{path}: unreadable checkpoint ({type(exc).__name__}: "
+                f"{exc})", source=str(path)) from exc

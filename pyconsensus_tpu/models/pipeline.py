@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 
 from .. import obs
+from ..faults import InputError
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 from . import clustering as cl
@@ -183,7 +184,7 @@ def _scores_np(filled, rep, p: ConsensusParams):
     if algo == "dbscan":
         return cl.dbscan_conformity(filled, rep, p.dbscan_eps,
                                     p.dbscan_min_samples), None, None
-    raise ValueError(f"unknown algorithm: {algo!r}")
+    raise InputError(f"unknown algorithm: {algo!r}")
 
 
 def consensus_np(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
@@ -293,7 +294,7 @@ def _scores_jax(filled, rep, p: ConsensusParams, v_init=None):
     if algo == "dbscan-jit":
         return cl.dbscan_jit_conformity_jax(filled, rep, p.dbscan_eps,
                                             p.dbscan_min_samples), None, None
-    raise ValueError(f"algorithm {algo!r} is not jit-compatible "
+    raise InputError(f"algorithm {algo!r} is not jit-compatible "
                      f"(hybrid algorithms: {HYBRID_ALGORITHMS})")
 
 
@@ -1099,4 +1100,4 @@ def consensus_jax(reports, reputation, scaled, mins, maxs, p: ConsensusParams):
                       path="hybrid"):
             return _consensus_hybrid(reports, reputation, scaled, mins,
                                      maxs, p)
-    raise ValueError(f"unknown algorithm: {p.algorithm!r}")
+    raise InputError(f"unknown algorithm: {p.algorithm!r}")

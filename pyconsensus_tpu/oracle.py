@@ -27,6 +27,9 @@ from typing import Optional, Sequence
 import numpy as np
 
 from . import obs
+from .faults import InputError
+from .faults import degrade as _degrade
+from .faults import plan as _faults
 from .models.pipeline import (HYBRID_ALGORITHMS, JIT_ALGORITHMS,
                               ConsensusParams, consensus_jax, consensus_np)
 from .ops import jax_kernels as jk
@@ -59,8 +62,9 @@ def parse_event_bounds(event_bounds, n_events: int):
     if event_bounds is None:
         return scaled, mins, maxs
     if len(event_bounds) != n_events:
-        raise ValueError(f"event_bounds has {len(event_bounds)} "
-                         f"entries for {n_events} events")
+        raise InputError(f"event_bounds has {len(event_bounds)} "
+                         f"entries for {n_events} events",
+                         got=len(event_bounds), expected=n_events)
     for j, b in enumerate(event_bounds):
         if b is None:
             continue
@@ -68,8 +72,8 @@ def parse_event_bounds(event_bounds, n_events: int):
         mins[j] = float(b.get("min", 0.0))
         maxs[j] = float(b.get("max", 1.0))
         if scaled[j] and maxs[j] <= mins[j]:
-            raise ValueError(f"event {j}: max must exceed min "
-                             f"for a scaled event")
+            raise InputError(f"event {j}: max must exceed min "
+                             f"for a scaled event", event=j)
     return scaled, mins, maxs
 
 
@@ -245,7 +249,7 @@ class Oracle:
                  encoded: Optional[bool] = None,
                  verbose: bool = False):
         if reports is None:
-            raise ValueError("reports matrix is required")
+            raise InputError("reports matrix is required")
         if np.asarray(reports).dtype == np.int8:
             from .models.pipeline import decode_reports, resolve_encoded
 
@@ -265,8 +269,14 @@ class Oracle:
                 f"(encode_reports), got dtype {np.asarray(reports).dtype}")
         self.reports = np.asarray(reports, dtype=np.float64)
         if self.reports.ndim != 2:
-            raise ValueError(f"reports must be 2-D (reporters × events), "
-                             f"got shape {self.reports.shape}")
+            raise InputError(f"reports must be 2-D (reporters × events), "
+                             f"got shape {self.reports.shape}",
+                             shape=tuple(self.reports.shape))
+        if self.reports.size == 0:
+            raise InputError(
+                f"reports matrix is empty (shape {self.reports.shape}) — "
+                f"a resolution needs at least one reporter and one event",
+                shape=tuple(self.reports.shape))
         n_reporters, n_events = self.reports.shape
 
         algorithm = algorithm.lower()
@@ -287,14 +297,18 @@ class Oracle:
         else:
             rep = np.asarray(reputation, dtype=np.float64)
             if rep.shape != (n_reporters,):
-                raise ValueError(f"reputation shape {rep.shape} does not "
-                                 f"match {n_reporters} reporters")
+                raise InputError(f"reputation shape {rep.shape} does not "
+                                 f"match {n_reporters} reporters",
+                                 shape=tuple(rep.shape),
+                                 expected=n_reporters)
             if np.isnan(rep).any():
-                raise ValueError("reputation must not contain NaN")
+                raise InputError("reputation must not contain NaN")
+            if not np.isfinite(rep).all():
+                raise InputError("reputation must be finite (found ±Inf)")
             if (rep < 0).any():
-                raise ValueError("reputation must be non-negative")
+                raise InputError("reputation must be non-negative")
             if rep.sum() <= 0:
-                raise ValueError("reputation must have positive total mass")
+                raise InputError("reputation must have positive total mass")
         if not 0.0 <= alpha <= 1.0:
             raise ValueError("alpha must lie in [0, 1]")
         if catch_tolerance < 0.0:
@@ -321,6 +335,17 @@ class Oracle:
                 f"clustering algorithms ({algorithm!r}): the interpolated "
                 "fill values are continuous — use storage_dtype='bfloat16'")
 
+        # chaos hook + graceful degradation (docs/ROBUSTNESS.md), AFTER
+        # every validation above — a rejected construction must not
+        # inflate the quarantine counter for a resolution that never
+        # runs. Rows carrying ±Inf are quarantined to full
+        # non-participation instead of poisoning every covariance
+        # contraction; the single isfinite scan REPLACES the isnan scan
+        # has_na would cost below, so the clean path pays nothing extra.
+        self.reports = _faults.corrupt("oracle.reports", self.reports)
+        self.reports, self.quarantined_rows, has_na = \
+            _degrade.quarantine_nonfinite(self.reports)
+
         self.reputation = rep
         self.backend = backend
         self.verbose = verbose
@@ -335,7 +360,7 @@ class Oracle:
         self.params = ConsensusParams(
             n_scaled=n_sc if jk.gather_median_pays(n_sc, n_events) else 0,
             any_scaled=bool(scaled.any()),
-            has_na=bool(np.isnan(self.reports).any()),
+            has_na=has_na,
             algorithm=algorithm,
             alpha=float(alpha),
             catch_tolerance=float(catch_tolerance),
@@ -367,17 +392,91 @@ class Oracle:
         return consensus_jax(self.reports, self.reputation, self.scaled,
                              self.mins, self.maxs, self.params)
 
+    # -- graceful degradation (docs/ROBUSTNESS.md fallback chain) -----------
+
+    def _resolve_once(self, update: dict):
+        """One fallback-chain rung: re-run the resolution with
+        ConsensusParams field overrides, or the numpy reference path when
+        ``update == {"backend": "numpy"}``. Subclasses that dispatch
+        differently (``parallel.ShardedOracle``) inherit this as their
+        recovery route — the rare fallback re-resolve trades the sharded
+        fast path for the fidelity path on purpose."""
+        if update.get("backend") == "numpy":
+            # consensus_np handles the int8 sentinel decode itself — no
+            # pre-cast (a float cast of sentinel storage would turn the
+            # -1 NaN marker into a live report value)
+            return consensus_np(np.asarray(self.reports),
+                                np.asarray(self.reputation,
+                                           dtype=np.float64),
+                                np.asarray(self.scaled),
+                                np.asarray(self.mins),
+                                np.asarray(self.maxs), self.params)
+        p2 = self.params._replace(**update)
+        if p2.storage_dtype == "int8":
+            # int8 sentinel storage is legal only on the fused path the
+            # chain is falling back FROM — the recovery rung runs full
+            # fidelity
+            p2 = p2._replace(storage_dtype="")
+        reports = self.reports
+        if getattr(reports, "dtype", None) == np.int8:
+            from .models.pipeline import decode_reports
+
+            reports = decode_reports(np.asarray(reports))
+        return consensus_jax(reports, self.reputation, self.scaled,
+                             self.mins, self.maxs, p2)
+
+    def _effective_pca_method(self) -> str:
+        """The pca_method the jax path actually RAN: ``"auto"`` resolves
+        by static shape inside the kernels (``jk.resolve_pca_method``),
+        so the fallback chain must key on the resolved method — an
+        unresolved ``"auto"`` would skip the eigh-gram rung exactly at
+        the scales where auto picks power iteration. ShardedOracle's
+        params arrive pre-resolved; resolving again is a no-op there."""
+        R, E = self.reports.shape
+        return jk.resolve_pca_method(R, E, self.params.pca_method)
+
+    def _degraded_raw(self) -> dict:
+        """Walk the documented fallback chain (power-fused → eigh-gram →
+        numpy) after a non-finite result, emitting
+        ``pyconsensus_fallbacks_total{from,to,reason}`` per hop; raises
+        the classified taxonomy error when every rung stays
+        non-finite."""
+        effective = self._effective_pca_method()
+        for frm, to, update in _degrade.fallback_steps(
+                effective, self.backend):
+            _degrade.record_fallback(frm, to, "nonfinite_result")
+            raw = {k: np.asarray(v)
+                   for k, v in self._resolve_once(update).items()}
+            if not _degrade.result_nonfinite(raw):
+                return raw
+        _degrade.raise_exhausted(effective, self.params.algorithm)
+
+    def _fetch_raw(self) -> dict:
+        """Host-fetch the flat result (the blocking completion barrier)
+        and run the degradation checks: the ``oracle.raw_result`` chaos
+        site simulates an internal NaN storm, and a non-finite jax
+        result walks the fallback chain instead of being returned."""
+        raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
+        raw = _faults.corrupt("oracle.raw_result", raw)
+        if self.backend == "jax" and _degrade.result_nonfinite(raw):
+            raw = self._degraded_raw()
+        return raw
+
     def consensus(self) -> dict:
         """Resolve outcomes + reputation; returns the reference-shaped nested
-        result dict (all values host numpy)."""
+        result dict (all values host numpy). The ``quarantined_rows``
+        field lists reporter rows zeroed out of this resolution for
+        carrying non-finite (±Inf) values — empty on clean inputs."""
         with obs.span("oracle.consensus",
                       algorithm=self.params.algorithm, backend=self.backend,
                       reporters=self.reports.shape[0],
                       events=self.reports.shape[1]):
-            # the host fetch below is the span's natural completion
-            # barrier: np.asarray blocks on every device value
-            raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
-            result = assemble_result(raw)
+            # the host fetch is the span's natural completion barrier:
+            # np.asarray blocks on every device value
+            result = assemble_result(self._fetch_raw())
+        result["quarantined_rows"] = (
+            np.array([], dtype=np.int64) if self.quarantined_rows is None
+            else np.asarray(self.quarantined_rows))
         record_consensus_result(result, self.params.algorithm, self.backend)
         if self.verbose:
             self._print_summary(result)

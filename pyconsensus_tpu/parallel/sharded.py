@@ -28,6 +28,8 @@ import jax
 import numpy as np
 
 from .. import obs
+from ..faults import degrade as _degrade
+from ..faults import plan as _faults
 from ..ops import jax_kernels as jk
 from ..models.pipeline import (HYBRID_ALGORITHMS, ConsensusParams,
                                _consensus_hybrid, consensus_light_jit)
@@ -524,6 +526,8 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
 
     p = params if params is not None else ConsensusParams()
     is_host = isinstance(reports, np.ndarray)
+    quarantined = None
+    host_has_na = False
     if event_bounds is None:
         # all-binary default: the E-vectors are constants — build them ON
         # DEVICE, pre-sharded, and cache per (mesh, E). Materializing them
@@ -542,10 +546,21 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         scaled, mins, maxs = parse_event_bounds(event_bounds, E)
         any_scaled = bool(scaled.any())
         p = p._replace(n_scaled=int(scaled.sum()))
+    if is_host and reports.dtype != np.int8:
+        # chaos hook (NaN/Inf storms, dropped shards) + Inf-row
+        # quarantine for host matrices, AFTER the bounds parse so a
+        # rejected call cannot inflate the quarantine counter — the
+        # isfinite scan REPLACES the isnan has_na scan below, so the
+        # clean path pays no extra pass; device-resident inputs skip
+        # both (can't cheaply inspect) and int8 sentinel storage cannot
+        # carry Inf by construction
+        reports = _faults.corrupt("sharded.reports", reports)
+        reports, quarantined, host_has_na = \
+            _degrade.quarantine_nonfinite(reports)
     if is_host and reports.dtype == np.int8:
         has_na = bool((reports < 0).any())       # sentinel form: -1 is NaN
     elif is_host:
-        has_na = bool(np.isnan(reports).any())
+        has_na = host_has_na                     # from the quarantine scan
     else:
         # device-resident input: can't cheaply inspect for NaN on host —
         # keep the fill pass unless the caller's params already opted out
@@ -561,6 +576,16 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
     # count AFTER every validation: a rejected call dispatches nothing
     # and must not inflate the resolutions counter
     _record_sharded_dispatch(p, mesh)
+
+    def _finish(result):
+        # surface the quarantine exactly like Oracle.consensus does —
+        # ALWAYS present (empty on clean / device-resident inputs), so
+        # consumers written against the documented contract never KeyError
+        result["quarantined_rows"] = (
+            np.array([], dtype=np.int64) if quarantined is None
+            else np.asarray(quarantined))
+        return result
+
     if p.algorithm in HYBRID_ALGORITHMS:
         # hybrid host-clustering path: the device phases run JITTED on
         # the placed (event-sharded) arrays — GSPMD turns the O(R²E)
@@ -575,7 +600,7 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
             reputation = _default_reputation_placed(mesh, R)
         placed = _place_inputs(mesh, reports, reputation, scaled, mins,
                                maxs)
-        return _consensus_hybrid(*placed, p, light=True)
+        return _finish(_consensus_hybrid(*placed, p, light=True))
     if p.fused_resolution and mesh.shape.get("event", 1) > 1:
         # multi-device fused path: explicit shard_map collectives around
         # the storage kernels (parallel.fused_sharded) — the GSPMD jit
@@ -587,13 +612,14 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
         if p.any_scaled:
             placed = _place_inputs(mesh, reports, reputation, scaled,
                                    mins, maxs)
-            return fused_sharded_consensus(placed[0], placed[1], mesh, p,
-                                           *placed[2:])
+            return _finish(fused_sharded_consensus(
+                placed[0], placed[1], mesh, p, *placed[2:]))
         reports = _maybe_place_reports(reports, _input_shardings(mesh, E)[0],
                                        jax.numpy.asarray(0.0).dtype)
         reputation = _maybe_place(reputation, replicated(mesh),
                                   jax.numpy.asarray(0.0).dtype)
-        return fused_sharded_consensus(reports, reputation, mesh, p)
+        return _finish(fused_sharded_consensus(reports, reputation,
+                                               mesh, p))
     if reputation is None:
         reputation = _default_reputation_placed(mesh, R)   # cached, on device
         if event_bounds is None:
@@ -603,10 +629,10 @@ def sharded_consensus(reports, reputation=None, event_bounds=None,
             reports = _maybe_place_reports(reports,
                                            _input_shardings(mesh, E)[0],
                                            jax.numpy.asarray(0.0).dtype)
-            return consensus_light_jit(reports, reputation, scaled,
-                                       mins, maxs, p)
+            return _finish(consensus_light_jit(reports, reputation,
+                                               scaled, mins, maxs, p))
     placed = _place_inputs(mesh, reports, reputation, scaled, mins, maxs)
-    return consensus_light_jit(*placed, p)
+    return _finish(consensus_light_jit(*placed, p))
 
 
 class ShardedOracle(Oracle):
@@ -662,9 +688,15 @@ class ShardedOracle(Oracle):
                       algorithm=self.params.algorithm, backend="jax",
                       sharded=True, reporters=self.reports.shape[0],
                       events=self.reports.shape[1]):
-            # np.asarray is the blocking completion barrier, like Oracle's
-            raw = {k: np.asarray(v) for k, v in self.resolve_raw().items()}
-            result = assemble_result(raw)
+            # np.asarray inside _fetch_raw is the blocking completion
+            # barrier, like Oracle's; a non-finite result walks the
+            # inherited fallback chain (power-fused → eigh-gram → numpy
+            # — the recovery re-resolve deliberately trades the sharded
+            # fast path for the fidelity path, docs/ROBUSTNESS.md)
+            result = assemble_result(self._fetch_raw())
+        result["quarantined_rows"] = (
+            np.array([], dtype=np.int64) if self.quarantined_rows is None
+            else np.asarray(self.quarantined_rows))
         record_consensus_result(result, self.params.algorithm, "jax")
         if self.verbose:
             self._print_summary(result)

@@ -78,6 +78,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..faults import plan as _faults
 from ..models.pipeline import ConsensusParams
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
@@ -511,6 +512,11 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         # convert straight to the device dtype: one host copy per panel,
         # half the bytes of a float64 detour
         block = np.asarray(reports_src[:, start:stop], dtype=np.dtype(dtype))
+        # chaos hook (host-side, pre-device): a poisoned panel exercises
+        # the accumulator NaN-poison contract (_sym_topk / eigh parity —
+        # loud failure, never a silently wrong spectrum). Zero overhead
+        # disarmed (one global None test).
+        block = _faults.corrupt("streaming.panel", block)
         width = stop - start
         if width < P:                          # zero-pad the ragged tail
             block = np.pad(block, ((0, 0), (0, P - width)))

@@ -33,20 +33,42 @@ loops:
 
 from __future__ import annotations
 
+import hashlib
 import json
-import os
 import pathlib
-import tempfile
 from typing import Optional, Sequence
 
 import numpy as np
 
 from .. import obs
+from ..faults import CheckpointCorruptionError, retry_call
+from ..faults import plan as _faults
 from .collusion import CollusionSimulator, flat_grid
 
 __all__ = ["CheckpointedSweep"]
 
 _MANIFEST = "sweep.json"
+
+#: npz key carrying the chunk's content digest (never a metric array)
+_DIGEST_KEY = "__digest__"
+
+
+def _chunk_digest(host: dict) -> np.ndarray:
+    """SHA-256 over the chunk's ARRAYS (sorted key, dtype, shape, raw
+    bytes) as a uint8 vector — content-addressed, so it survives any
+    npz container re-serialization and catches torn writes, truncated
+    members, and silent bit flips alike. Stored inside the chunk file
+    under ``__digest__`` and re-derived on every load."""
+    h = hashlib.sha256()
+    for k in sorted(host):
+        if k == _DIGEST_KEY:
+            continue
+        arr = np.ascontiguousarray(host[k])
+        h.update(k.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return np.frombuffer(h.digest(), dtype=np.uint8)
 
 
 class CheckpointedSweep:
@@ -90,30 +112,17 @@ class CheckpointedSweep:
 
     def _write_atomic(self, final: pathlib.Path, writer,
                       suffix: str = ".tmp") -> None:
-        """All-or-nothing file creation safe against CONCURRENT writers of
-        ``final`` (several hosts racing on a shared checkpoint dir, or a
-        mop-up process overlapping a restarted host on the same chunk):
-        each writer gets its own ``mkstemp``-unique tmp in the target
-        directory — pids alone are not unique across hosts — and the
-        atomic rename makes last-writer-wins harmless because racers
-        write identical content by construction."""
-        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=suffix)
-        try:
-            # mkstemp creates 0600 and os.replace preserves it — restore
-            # umask-based permissions so a different account (gather /
-            # mop-up on a shared filesystem) can read the installed file
-            umask = os.umask(0)
-            os.umask(umask)
-            os.fchmod(fd, 0o666 & ~umask)
-            os.close(fd)
-            writer(tmp)
-            os.replace(tmp, final)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except FileNotFoundError:
-                pass
-            raise
+        """All-or-nothing, fsynced file creation (``io.atomic_write``),
+        safe against CONCURRENT writers of ``final`` (several hosts
+        racing on a shared checkpoint dir, or a mop-up process
+        overlapping a restarted host on the same chunk): each writer
+        gets its own ``mkstemp``-unique tmp in the checkpoint directory
+        — pids alone are not unique across hosts — and the atomic
+        rename makes last-writer-wins harmless because racers write
+        identical content by construction."""
+        from ..io import atomic_write
+
+        atomic_write(final, writer, suffix=suffix, dir=self.dir)
 
     #: tmp files older than this are orphans from hard-killed writers
     #: (no Python-level except ran); any entry point may reap them
@@ -193,21 +202,103 @@ class CheckpointedSweep:
             host = self.sim._dispatch(self.seed, np.arange(lo, hi),
                                       self._grid_lf[lo:hi],
                                       self._grid_var[lo:hi])
-            self._write_atomic(self._chunk_path(c),
-                               lambda t: np.savez(t, **host),
-                               suffix=".tmp.npz")
+            host = dict(host)
+            host = _faults.corrupt("sweep.chunk.data", host)
+            # the digest is computed over whatever is WRITTEN — an
+            # injected data corruption upstream of this point is the
+            # simulator's problem (and the fuzz suite's), not a torn
+            # write; everything between here and the rename is what the
+            # checksum guards
+            host[_DIGEST_KEY] = _chunk_digest(host)
+
+            def write(tmp):
+                np.savez(tmp, **host)
+                _faults.fire("sweep.chunk.write", path=tmp)
+                _faults.fire("sweep.chunk.pre_commit")
+            # transient-OSError retry (shared-filesystem hiccups): the
+            # jitter seed folds in the chunk index so concurrent hosts
+            # stay decorrelated; SimulatedCrash is a BaseException and
+            # always escapes, like the SIGKILL it stands in for
+            retry_call(self._write_atomic, self._chunk_path(c), write,
+                       suffix=".tmp.npz", retries=3, base_delay=0.05,
+                       deadline=30.0, jitter_seed=self.seed + c,
+                       label="sweep-chunk-write")
+            _faults.fire("sweep.chunk.post_commit")
         obs.counter(
             "pyconsensus_sweep_chunks_total",
             "checkpointed sweep chunks computed and written by this "
             "process").inc()
+
+    def _load_chunk(self, c: int) -> dict:
+        """Read + checksum-verify one chunk checkpoint. Raises
+        :class:`CheckpointCorruptionError` on a torn/corrupted file or a
+        content-digest mismatch (the caller decides between recompute —
+        the sweep's choice — and surfacing)."""
+        path = self._chunk_path(c)
+        try:
+            with np.load(path) as data:
+                part = {k: data[k] for k in data.files}
+        except FileNotFoundError:
+            raise
+        except Exception as exc:        # BadZipFile / truncated member
+            raise CheckpointCorruptionError(
+                f"{path}: unreadable sweep chunk ({type(exc).__name__}: "
+                f"{exc})", chunk=c, source=str(path)) from exc
+        stored = part.pop(_DIGEST_KEY, None)
+        if stored is None:
+            raise CheckpointCorruptionError(
+                f"{path}: sweep chunk has no content digest "
+                f"('{_DIGEST_KEY}' missing — pre-digest or torn file)",
+                chunk=c, source=str(path), field=_DIGEST_KEY)
+        if not np.array_equal(np.asarray(stored, dtype=np.uint8),
+                              _chunk_digest(part)):
+            raise CheckpointCorruptionError(
+                f"{path}: sweep chunk content digest mismatch — the "
+                f"file was torn or corrupted after commit", chunk=c,
+                source=str(path), field=_DIGEST_KEY)
+        return part
+
+    def _scrub(self, chunks=None) -> int:
+        """Checksum-verify the given chunks on disk (default: all);
+        DELETE corrupt ones so they re-enter ``pending()`` and are
+        re-dispatched like never-run chunks (per-trial keys are pure
+        functions of the global flat index, so a recomputed chunk is
+        bit-identical to the lost one). Returns the number scrubbed.
+        Called on every resume entry point — ``run`` scrubs this host's
+        round-robin share (a corrupt chunk's owner re-verifies and
+        redoes it; N hosts each hashing ALL chunks on a shared
+        filesystem would multiply resume I/O by N), ``gather`` verifies
+        everything as the final integrity gate."""
+        scrubbed = 0
+        for c in (range(self.n_chunks) if chunks is None else chunks):
+            path = self._chunk_path(c)
+            if not path.exists():
+                continue
+            try:
+                self._load_chunk(c)
+            except FileNotFoundError:
+                continue              # raced: another host's scrub won
+            except CheckpointCorruptionError:
+                try:
+                    path.unlink()
+                except FileNotFoundError:
+                    pass
+                scrubbed += 1
+        if scrubbed:
+            obs.counter(
+                "pyconsensus_chunk_corruptions_total",
+                "corrupted/torn sweep chunk checkpoints detected by "
+                "checksum and deleted for re-dispatch").inc(scrubbed)
+        return scrubbed
 
     def run(self, host_id: Optional[int] = None,
             n_hosts: Optional[int] = None) -> int:
         """Compute this host's pending chunks (round-robin assignment:
         chunk ``c`` belongs to host ``c % n_hosts``). Already-checkpointed
         chunks — including ones another incarnation of this host wrote
-        before crashing — are skipped. Returns the number of chunks this
-        call computed."""
+        before crashing — are skipped after a checksum scrub: a chunk
+        that exists but fails verification is deleted and recomputed,
+        never trusted. Returns the number of chunks this call computed."""
         if host_id is None or n_hosts is None:
             import jax
 
@@ -216,6 +307,8 @@ class CheckpointedSweep:
         if not (0 <= host_id < n_hosts):
             raise ValueError(f"host_id {host_id} not in [0, {n_hosts})")
         self._reap_stale_tmps()
+        self._scrub([c for c in range(self.n_chunks)
+                     if c % n_hosts == host_id])
         done = 0
         for c in self.pending():
             if c % n_hosts == host_id:
@@ -225,11 +318,15 @@ class CheckpointedSweep:
 
     # -- result assembly -----------------------------------------------------
 
-    def gather(self) -> dict:
+    def gather(self, recompute: bool = True) -> dict:
         """Merge all chunk checkpoints into the monolithic
-        :meth:`CollusionSimulator.run` result dict. Raises if any chunk is
-        missing (run ``run(host_id=0, n_hosts=1)`` first to mop up after
-        lost hosts)."""
+        :meth:`CollusionSimulator.run` result dict. Every chunk is
+        checksum-verified on read; a corrupted or torn chunk is
+        transparently recomputed in place (``recompute=True``, the
+        default — bit-identical by the global-index key construction) or
+        raised as :class:`CheckpointCorruptionError`. Raises if any
+        chunk is missing (run ``run(host_id=0, n_hosts=1)`` first to mop
+        up after lost hosts)."""
         self._reap_stale_tmps()
         missing = self.pending()
         if missing:
@@ -238,8 +335,18 @@ class CheckpointedSweep:
                              f"(e.g. {missing[:4]}); call run() to finish")
         parts: list = []
         for c in range(self.n_chunks):
-            with np.load(self._chunk_path(c)) as data:
-                parts.append({k: data[k] for k in data.files})
+            try:
+                parts.append(self._load_chunk(c))
+            except CheckpointCorruptionError:
+                if not recompute:
+                    raise
+                obs.counter(
+                    "pyconsensus_chunk_corruptions_total",
+                    "corrupted/torn sweep chunk checkpoints detected by "
+                    "checksum and deleted for re-dispatch").inc()
+                self._chunk_path(c).unlink(missing_ok=True)
+                self._run_chunk(c)
+                parts.append(self._load_chunk(c))
         L, V, T = len(self.lf), len(self.var), self.n_trials
         result = {}
         for k in parts[0]:

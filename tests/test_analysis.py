@@ -165,6 +165,26 @@ CORPUS = {
             return jax.jit(lambda y: y * 2)(x), t0
         """,
     ),
+    # ISSUE 4: fault-injection sites are host-side only — in traced code
+    # the armed-plan check bakes into the compiled graph as a constant
+    # and the fault fires once per TRACE
+    "CL601": (
+        """
+        import jax
+        from pyconsensus_tpu import faults
+        @jax.jit
+        def f(x):
+            faults.fire("kernel.site")
+            return x * 2
+        """,
+        """
+        import jax
+        from pyconsensus_tpu import faults
+        def host(x):
+            faults.fire("host.site")
+            return jax.jit(lambda y: y * 2)(x)
+        """,
+    ),
 }
 
 
@@ -308,6 +328,81 @@ class TestObsInTracedRules:
         holds over the real tree, not just the corpus."""
         found = [f for f in lint_paths()
                  if f.rule in ("CL501", "CL502")]
+        assert found == [], [(f.path, f.line, f.rule) for f in found]
+
+
+class TestFaultsInTracedRule:
+    """CL601 (ISSUE 4) beyond the basic corpus: alias/module-import
+    forms, the corrupt hook, and the real injected package staying
+    clean."""
+
+    def _rules(self, tmp_path, src):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent(src))
+        return [f.rule for f in lint_file(p, rel_path="m.py")]
+
+    def test_plan_module_alias_form(self, tmp_path):
+        # the package's own idiom: `from ..faults import plan as _faults`
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu.faults import plan as _faults
+            @jax.jit
+            def f(x):
+                return _faults.corrupt("site", x)
+            """)
+        assert "CL601" in rules
+
+    def test_direct_hook_import(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu.faults import fire
+            @jax.jit
+            def f(x):
+                fire("site")
+                return x
+            """)
+        assert "CL601" in rules
+
+    def test_arming_in_traced_code_flagged(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu import faults
+            @jax.jit
+            def f(x):
+                faults.arm(faults.FaultPlan())
+                return x
+            """)
+        assert "CL601" in rules
+
+    def test_errors_import_not_flagged(self, tmp_path):
+        # taxonomy classes are trace-safe to RAISE (host-static gates)
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu.faults import InputError
+            @jax.jit
+            def f(x):
+                if x.ndim != 2:
+                    raise InputError("bad")
+                return x
+            """)
+        assert "CL601" not in rules
+
+    def test_suppression(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu import faults
+            @jax.jit
+            def f(x):
+                faults.fire("site")  # consensus-lint: disable=CL601
+                return x
+            """)
+        assert "CL601" not in rules
+
+    def test_injected_package_is_cl601_clean(self):
+        """ISSUE 4 threaded injection sites through io / ledger / runner
+        / streaming / sharded / oracle — every one must be host-side
+        over the real tree, not just the corpus."""
+        found = [f for f in lint_paths() if f.rule == "CL601"]
         assert found == [], [(f.path, f.line, f.rule) for f in found]
 
 

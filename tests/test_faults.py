@@ -1,0 +1,815 @@
+"""ISSUE 4 chaos suite: fault injection, structured errors, graceful
+degradation, crash-safe checkpointing, and retry.
+
+The hard acceptance criteria live here: a ``kill -9`` mid-sweep followed
+by a resume is bit-identical to an uninterrupted run; a corrupted
+checkpoint chunk is detected by checksum and transparently recomputed; a
+seeded NaN-storm fault plan yields finite outcomes with quarantined rows
+reported, and replaying the same plan reproduces the run exactly."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle, faults
+from pyconsensus_tpu.faults import (CheckpointCorruptionError,
+                                    ConsensusError, ConvergenceError,
+                                    FaultPlan, InputError, NumericsError,
+                                    SimulatedCrash)
+
+from conftest import worker_env
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No chaos test may leak an armed plan into the rest of the suite."""
+    yield
+    faults.disarm()
+
+
+CANONICAL = np.array([
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 0.0, 0.0, 0.0],
+    [1.0, 1.0, 0.0, 0.0],
+    [1.0, 1.0, 1.0, 0.0],
+    [0.0, 0.0, 1.0, 1.0],
+    [0.0, 0.0, 1.0, 1.0],
+])
+
+
+# -- taxonomy --------------------------------------------------------------
+
+
+class TestErrorTaxonomy:
+    def test_codes_are_stable(self):
+        assert ConsensusError.error_code == "PYC000"
+        assert InputError.error_code == "PYC101"
+        assert NumericsError.error_code == "PYC201"
+        assert ConvergenceError.error_code == "PYC202"
+        assert CheckpointCorruptionError.error_code == "PYC301"
+        assert faults.ERROR_CODES["PYC301"] is CheckpointCorruptionError
+
+    def test_backward_compatible_bases(self):
+        """The taxonomy narrows what is raised without widening what
+        must be caught: every pre-taxonomy except clause keeps working."""
+        assert issubclass(InputError, ValueError)
+        assert issubclass(CheckpointCorruptionError, ValueError)
+        assert issubclass(NumericsError, ArithmeticError)
+        assert issubclass(ConvergenceError, NumericsError)
+
+    def test_context_and_code_in_message(self):
+        e = InputError("bad row", row=3, column=7)
+        assert e.context == {"row": 3, "column": 7}
+        assert "[PYC101]" in str(e) and "bad row" in str(e)
+
+    def test_crash_is_not_an_exception(self):
+        """SimulatedCrash must escape `except Exception` recovery code —
+        that is the whole point of modeling a SIGKILL."""
+        assert issubclass(SimulatedCrash, BaseException)
+        assert not issubclass(SimulatedCrash, Exception)
+
+
+# -- the injection core ----------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_disarmed_hooks_are_identity(self):
+        arr = np.ones((3, 3))
+        assert faults.corrupt("any.site", arr) is arr
+        faults.fire("any.site")              # no-op, no error
+        assert faults.active_plan() is None
+
+    def test_occurrence_indexing(self):
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "s", "kind": "raise", "occurrences": [2],
+             "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            faults.fire("s")
+            faults.fire("s")
+            with pytest.raises(OSError):
+                faults.fire("s")
+            faults.fire("s")                 # max_fires=0 (unlimited) but
+        assert plan.fired == [("s", 2, "raise")]   # occurrence 3 not listed
+
+    def test_site_patterns_and_max_fires(self):
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "sweep.chunk.*", "kind": "raise",
+             "occurrences": [0, 1], "max_fires": 1}])
+        with faults.armed(plan):
+            with pytest.raises(OSError):
+                faults.fire("sweep.chunk.write")
+            faults.fire("sweep.chunk.write")     # capped by max_fires
+            faults.fire("sweep.chunk.pre_commit")  # occ counters per SITE
+        assert len(plan.fired) == 1
+
+    def test_probability_is_seeded_and_deterministic(self):
+        def run(seed):
+            plan = FaultPlan(seed=seed, rules=[
+                {"site": "p", "kind": "nan_storm", "probability": 0.5,
+                 "max_fires": 0, "args": {"fraction": 1.0}}])
+            hits = []
+            with faults.armed(plan):
+                for _ in range(32):
+                    out = faults.corrupt("p", np.ones(4))
+                    hits.append(bool(np.isnan(out).any()))
+            return hits
+
+        a, b = run(7), run(7)
+        assert a == b                        # same seed -> same activations
+        assert run(8) != a                   # different seed -> different
+        assert 0 < sum(a) < 32               # and actually probabilistic
+
+    def test_payload_determinism_is_interleaving_independent(self):
+        """The poisoned cells at (site, occurrence k) must not depend on
+        how often OTHER sites were hit in between — the property that
+        makes a replayed plan reproduce a run whose unrelated call order
+        shifted."""
+        rules = [{"site": "a", "kind": "nan_storm", "occurrences": [1],
+                  "args": {"fraction": 0.3}},
+                 {"site": "b", "kind": "nan_storm", "occurrences": [0],
+                  "args": {"fraction": 0.3}}]
+        arr = np.ones((8, 8))
+        with faults.armed(FaultPlan(seed=1, rules=rules)):
+            faults.corrupt("a", arr)
+            r1 = faults.corrupt("a", arr)
+        with faults.armed(FaultPlan(seed=1, rules=rules)):
+            faults.corrupt("a", arr)
+            faults.corrupt("b", arr)         # extra interleaved site
+            r2 = faults.corrupt("a", arr)
+        np.testing.assert_array_equal(np.isnan(r1), np.isnan(r2))
+
+    def test_json_round_trip(self, tmp_path):
+        plan = FaultPlan(seed=9, rules=[
+            {"site": "x", "kind": "inf_storm", "occurrences": [0, 3],
+             "args": {"fraction": 0.1}},
+            {"site": "y.*", "kind": "torn_write", "probability": 0.25},
+        ])
+        path = plan.save(tmp_path / "plan.json")
+        loaded = FaultPlan.load(path)
+        assert loaded.to_dict() == plan.to_dict()
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault-rule keys"):
+            FaultPlan(rules=[{"site": "s", "kind": "raise", "bogus": 1}])
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan(rules=[{"site": "s", "kind": "explode"}])
+
+    def test_corrupt_never_mutates_input(self):
+        arr = np.ones((4, 4))
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "s", "kind": "nan_storm",
+                 "args": {"fraction": 1.0}}])):
+            out = faults.corrupt("s", arr)
+        assert np.isnan(out).all()
+        assert not np.isnan(arr).any()
+
+    def test_drop_shard_nans_one_column_block(self):
+        arr = np.ones((4, 16))
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "s", "kind": "drop_shard",
+                 "args": {"shard": 1, "n_shards": 4}}])):
+            out = faults.corrupt("s", arr)
+        assert np.isnan(out[:, 4:8]).all()
+        assert np.isfinite(out[:, :4]).all()
+        assert np.isfinite(out[:, 8:]).all()
+
+    def test_dict_payload_poisons_floats_only(self):
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "s", "kind": "nan_storm",
+                 "args": {"fraction": 1.0}}])):
+            out = faults.corrupt("s", {"x": np.ones(3),
+                                       "n": np.arange(3),
+                                       "flag": np.asarray(True)})
+        assert np.isnan(out["x"]).all()
+        np.testing.assert_array_equal(out["n"], np.arange(3))
+        assert out["flag"] == np.asarray(True)
+
+
+# -- retry -----------------------------------------------------------------
+
+
+class TestRetry:
+    def test_transient_failure_recovers(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert faults.retry_call(flaky, base_delay=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_exhaustion_reraises_last(self):
+        def always():
+            raise OSError("still down")
+
+        with pytest.raises(OSError, match="still down"):
+            faults.retry_call(always, retries=2, base_delay=0.001)
+
+    def test_deadline_bounds_total_time(self):
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise OSError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            faults.retry_call(always, retries=50, base_delay=0.2,
+                              max_delay=0.2, deadline=0.3)
+        assert time.monotonic() - t0 < 2.0
+        assert len(calls) < 10               # deadline cut the budget
+
+    def test_corruption_is_not_retried(self):
+        """Checkpoint corruption does not become valid by retrying —
+        the taxonomy is deliberately outside the default retry_on."""
+        calls = []
+
+        def corrupt():
+            calls.append(1)
+            raise CheckpointCorruptionError("bad chunk")
+
+        with pytest.raises(CheckpointCorruptionError):
+            faults.retry_call(corrupt, base_delay=0.001)
+        assert len(calls) == 1
+
+    def test_jitter_is_deterministic(self):
+        from pyconsensus_tpu.faults.retry import _sleep_for
+
+        a = [_sleep_for(k, 0.05, 2.0, 3, "w") for k in range(4)]
+        b = [_sleep_for(k, 0.05, 2.0, 3, "w") for k in range(4)]
+        assert a == b
+        assert a != [_sleep_for(k, 0.05, 2.0, 4, "w") for k in range(4)]
+        # exponential envelope with jitter in [0.5x, 1x]
+        for k, d in enumerate(a):
+            assert 0.5 * min(2.0, 0.05 * 2 ** k) <= d <= min(2.0,
+                                                             0.05 * 2 ** k)
+
+    def test_decorator_form(self):
+        calls = []
+
+        @faults.retry(retries=3, base_delay=0.001)
+        def flaky(x):
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("once")
+            return x + 1
+
+        assert flaky(1) == 2
+
+
+# -- io --------------------------------------------------------------------
+
+
+class TestIOFaults:
+    def test_truncated_csv_row_is_structured(self, tmp_path):
+        from pyconsensus_tpu.io import load_reports
+
+        p = tmp_path / "r.csv"
+        p.write_text("1,0,1\n1,0\n")         # truncated second row
+        with pytest.raises(InputError) as ei:
+            load_reports(p)
+        # row AND width context (native parser may not expose columns)
+        assert ei.value.context.get("row") == 1 or "row 1" in str(ei.value)
+
+    def test_bad_field_names_row_and_column(self, tmp_path):
+        from pyconsensus_tpu.io import _parse_csv_row
+
+        with pytest.raises(InputError) as ei:
+            _parse_csv_row("1,spam,0", "f.csv", 4)
+        assert ei.value.context == {"path": "f.csv", "row": 4, "column": 1}
+
+    def test_csv_to_npy_leaves_no_partial_file(self, tmp_path):
+        from pyconsensus_tpu.io import csv_to_npy
+
+        src = tmp_path / "r.csv"
+        src.write_text("1,0,1\n1,bogus,0\n")
+        with pytest.raises(InputError):
+            csv_to_npy(src)
+        assert not (tmp_path / "r.npy").exists()
+        assert not list(tmp_path.glob("*.tmp*"))
+
+    def test_torn_npy_write_detected_on_read(self, tmp_path):
+        from pyconsensus_tpu.io import load_reports, save_reports
+
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "io.write", "kind": "torn_write", "occurrences": [0],
+             "args": {"keep_bytes": 40}}])
+        with faults.armed(plan):
+            save_reports(tmp_path / "r.npy", CANONICAL)
+        assert plan.fired
+        with pytest.raises(InputError, match="unreadable .npy"):
+            load_reports(tmp_path / "r.npy")
+
+    def test_injected_write_error_leaves_no_file(self, tmp_path):
+        from pyconsensus_tpu.io import save_reports
+
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "io.write", "kind": "raise"}])):
+            with pytest.raises(OSError):
+                save_reports(tmp_path / "r.npy", CANONICAL)
+        assert not (tmp_path / "r.npy").exists()
+        assert not list(tmp_path.glob("*.tmp*"))
+
+    def test_atomic_write_keeps_previous_on_crash(self, tmp_path):
+        from pyconsensus_tpu.io import save_reports
+
+        save_reports(tmp_path / "r.npy", CANONICAL)
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "io.write", "kind": "crash"}])):
+            with pytest.raises(SimulatedCrash):
+                save_reports(tmp_path / "r.npy", np.zeros((2, 2)))
+        from pyconsensus_tpu.io import load_reports
+
+        np.testing.assert_array_equal(load_reports(tmp_path / "r.npy"),
+                                      CANONICAL)
+
+
+# -- ledger ----------------------------------------------------------------
+
+
+class TestLedgerFaults:
+    def _ledger(self):
+        from pyconsensus_tpu import ReputationLedger
+
+        led = ReputationLedger(n_reporters=6, max_iterations=2)
+        led.resolve(CANONICAL)
+        return led
+
+    def test_round_trip_still_exact(self, tmp_path):
+        from pyconsensus_tpu import ReputationLedger
+
+        led = self._ledger()
+        led.save(tmp_path / "state.npz")
+        back = ReputationLedger.load(tmp_path / "state.npz")
+        np.testing.assert_array_equal(back.reputation, led.reputation)
+        assert back.round == led.round and back.history == led.history
+
+    @pytest.mark.parametrize("field,mutate", [
+        ("reputation", lambda d: d.pop("reputation")),
+        ("round", lambda d: d.pop("round")),
+        ("history", lambda d: d.pop("history")),
+        ("oracle_kwargs", lambda d: d.pop("oracle_kwargs")),
+        ("format_version", lambda d: d.pop("format_version")),
+        ("reputation", lambda d: d.update(
+            reputation=np.full(6, np.nan))),
+        ("reputation", lambda d: d.update(
+            reputation=np.ones((2, 3)))),
+        ("reputation", lambda d: d.update(
+            reputation=-np.ones(6))),
+        ("round", lambda d: d.update(round=np.int64(-3))),
+        ("history", lambda d: d.update(history=np.frombuffer(
+            b"{not json", dtype=np.uint8))),
+    ])
+    def test_corrupt_field_named(self, tmp_path, field, mutate):
+        from pyconsensus_tpu import ReputationLedger
+
+        led = self._ledger()
+        led.save(tmp_path / "state.npz")
+        with np.load(tmp_path / "state.npz") as data:
+            tree = {k: data[k] for k in data.files}
+        mutate(tree)
+        np.savez(tmp_path / "bad.npz", **tree)
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            ReputationLedger.load(tmp_path / "bad.npz")
+        assert f"'{field}'" in str(ei.value)
+        assert ei.value.context.get("field") == field
+
+    def test_torn_checkpoint_file(self, tmp_path):
+        from pyconsensus_tpu import ReputationLedger
+
+        led = self._ledger()
+        led.save(tmp_path / "state.npz")
+        raw = (tmp_path / "state.npz").read_bytes()
+        (tmp_path / "state.npz").write_bytes(raw[:len(raw) // 2])
+        with pytest.raises(CheckpointCorruptionError, match="unreadable"):
+            ReputationLedger.load(tmp_path / "state.npz")
+
+    def test_crash_mid_save_keeps_previous(self, tmp_path):
+        from pyconsensus_tpu import ReputationLedger
+
+        led = self._ledger()
+        led.save(tmp_path / "state.npz")
+        before = led.reputation.copy()
+        led.resolve(CANONICAL)
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "ledger.save", "kind": "crash"}])):
+            with pytest.raises(SimulatedCrash):
+                led.save(tmp_path / "state.npz")
+        back = ReputationLedger.load(tmp_path / "state.npz")
+        np.testing.assert_array_equal(back.reputation, before)
+        assert back.round == 1
+
+
+# -- checkpointed sweep ----------------------------------------------------
+
+
+def _sweep(tmp_path, name="ck", trials_per_chunk=2):
+    from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+
+    sim = CollusionSimulator(n_reporters=6, n_events=4, max_iterations=2)
+    return sim, CheckpointedSweep(sim, [0.0, 0.4], [0.1], 4, seed=11,
+                                  checkpoint_dir=tmp_path / name,
+                                  trials_per_chunk=trials_per_chunk)
+
+
+class TestSweepCrashSafety:
+    def test_corrupted_chunk_detected_and_recomputed_on_resume(
+            self, tmp_path):
+        sim, sweep = _sweep(tmp_path)
+        assert sweep.run(host_id=0, n_hosts=1) == sweep.n_chunks
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        # flip bytes inside chunk 1's payload
+        victim = sweep._chunk_path(1)
+        raw = bytearray(victim.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        victim.write_bytes(bytes(raw))
+        _, resumed = _sweep(tmp_path)
+        ran = resumed.run(host_id=0, n_hosts=1)
+        assert ran == 1                      # exactly the scrubbed chunk
+        got = resumed.gather()
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+
+    def test_gather_transparently_recomputes_torn_chunk(self, tmp_path):
+        sim, sweep = _sweep(tmp_path)
+        sweep.run(host_id=0, n_hosts=1)
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        victim = sweep._chunk_path(0)
+        with open(victim, "r+b") as f:       # torn write: truncated zip
+            f.truncate(victim.stat().st_size // 2)
+        got = sweep.gather()                 # detected + recomputed inline
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+        with pytest.raises(CheckpointCorruptionError):
+            # strict mode surfaces instead of recomputing
+            with open(victim, "r+b") as f:
+                f.truncate(victim.stat().st_size // 2)
+            sweep.gather(recompute=False)
+
+    def test_injected_torn_chunk_write(self, tmp_path):
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "sweep.chunk.write", "kind": "torn_write",
+             "occurrences": [1], "args": {"keep_bytes": 64}}])
+        sim, sweep = _sweep(tmp_path)
+        with faults.armed(plan):
+            sweep.run(host_id=0, n_hosts=1)
+        assert plan.fired
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        _, resumed = _sweep(tmp_path)
+        assert resumed.run(host_id=0, n_hosts=1) == 1   # torn one redone
+        got = resumed.gather()
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+
+    def test_crash_before_commit_resumes_bit_identical(self, tmp_path):
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "sweep.chunk.pre_commit", "kind": "crash",
+             "occurrences": [1]}])
+        sim, sweep = _sweep(tmp_path)
+        with faults.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                sweep.run(host_id=0, n_hosts=1)
+        done = sweep.n_chunks - len(sweep.pending())
+        assert done == 1                     # crashed computing chunk 2
+        _, resumed = _sweep(tmp_path)
+        resumed.run(host_id=0, n_hosts=1)
+        got = resumed.gather()
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        for key in ("correct_rate", "liar_rep_share"):
+            np.testing.assert_array_equal(got[key], mono[key], err_msg=key)
+
+    def test_crash_after_commit_resume_skips_chunk(self, tmp_path):
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "sweep.chunk.post_commit", "kind": "crash",
+             "occurrences": [0]}])
+        sim, sweep = _sweep(tmp_path)
+        with faults.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                sweep.run(host_id=0, n_hosts=1)
+        assert sweep.n_chunks - len(sweep.pending()) == 1   # committed
+        _, resumed = _sweep(tmp_path)
+        assert resumed.run(host_id=0, n_hosts=1) == resumed.n_chunks - 1
+        got = resumed.gather()
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+
+    def test_transient_write_error_is_retried(self, tmp_path):
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "sweep.chunk.write", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        sim, sweep = _sweep(tmp_path)
+        with faults.armed(plan):
+            assert sweep.run(host_id=0, n_hosts=1) == sweep.n_chunks
+        got = sweep.gather()
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        np.testing.assert_array_equal(got["correct_rate"],
+                                      mono["correct_rate"])
+
+
+_KILL_WORKER = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+
+    sim = CollusionSimulator(n_reporters=6, n_events=4, max_iterations=2)
+    sweep = CheckpointedSweep(sim, [0.0, 0.4], [0.1], 4, seed=11,
+                              checkpoint_dir=sys.argv[1],
+                              trials_per_chunk=2)
+    print("READY", flush=True)
+    for c in sweep.pending():
+        sweep._run_chunk(c)
+        print("CHUNK", c, flush=True)
+        time.sleep(0.5)
+""")
+
+
+class TestKillMinusNine:
+    def test_sigkill_mid_sweep_then_resume_bit_identical(self, tmp_path):
+        """The acceptance criterion verbatim: a worker process is
+        SIGKILLed mid-sweep (a real kill -9 — no Python cleanup runs),
+        a fresh process resumes against the same checkpoint dir, and
+        the gathered result is bit-identical to an uninterrupted
+        monolithic run."""
+        ckdir = tmp_path / "ck"
+        script = tmp_path / "worker.py"
+        script.write_text(_KILL_WORKER)
+        env = worker_env()
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(ckdir)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            # wait for the first committed chunk, then kill -9
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if ckdir.exists() and list(ckdir.glob("chunk_*.npz")):
+                    break
+                if proc.poll() is not None:
+                    pytest.fail("worker exited before first chunk:\n"
+                                + (proc.stdout.read() or ""))
+                time.sleep(0.05)
+            else:
+                pytest.fail("worker never committed a chunk")
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == -signal.SIGKILL
+        sim, resumed = _sweep(tmp_path)
+        assert len(resumed.pending()) >= 1   # killed mid-sweep
+        resumed.run(host_id=0, n_hosts=1)
+        got = resumed.gather()
+        mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+        for key in ("correct_rate", "capture_rate", "liar_rep_share"):
+            np.testing.assert_array_equal(got[key], mono[key], err_msg=key)
+
+
+# -- quarantine + degradation ---------------------------------------------
+
+
+class TestQuarantine:
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_inf_rows_quarantined_not_poisoning(self, backend):
+        poisoned = CANONICAL.copy()
+        poisoned[1, 2] = np.inf
+        poisoned[4, 0] = -np.inf
+        r = Oracle(reports=poisoned, backend=backend,
+                   max_iterations=2).consensus()
+        np.testing.assert_array_equal(r["quarantined_rows"], [1, 4])
+        assert np.isfinite(r["agents"]["smooth_rep"]).all()
+        assert np.isfinite(r["events"]["outcomes_final"]).all()
+        # equivalent to the same matrix with those rows fully absent
+        nanned = CANONICAL.copy()
+        nanned[[1, 4]] = np.nan
+        ref = Oracle(reports=nanned, backend=backend,
+                     max_iterations=2).consensus()
+        np.testing.assert_array_equal(r["events"]["outcomes_final"],
+                                      ref["events"]["outcomes_final"])
+        np.testing.assert_array_equal(r["agents"]["smooth_rep"],
+                                      ref["agents"]["smooth_rep"])
+
+    def test_quarantine_counter_emitted(self):
+        from pyconsensus_tpu import obs
+
+        before = obs.value("pyconsensus_quarantined_rows_total") or 0
+        poisoned = CANONICAL.copy()
+        poisoned[0, 0] = np.inf
+        Oracle(reports=poisoned).consensus()
+        assert obs.value("pyconsensus_quarantined_rows_total") == before + 1
+
+    def test_sharded_front_end_quarantines(self):
+        from pyconsensus_tpu.parallel import make_mesh, sharded_consensus
+
+        poisoned = CANONICAL.copy()
+        poisoned[2, 1] = np.inf
+        out = sharded_consensus(poisoned, mesh=make_mesh(batch=1))
+        np.testing.assert_array_equal(out["quarantined_rows"], [2])
+        assert np.isfinite(np.asarray(out["smooth_rep"])).all()
+        assert np.isfinite(np.asarray(out["outcomes_final"])).all()
+
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_all_nan_matrix_stays_finite(self, backend):
+        r = Oracle(reports=np.full((4, 3), np.nan),
+                   backend=backend).consensus()
+        assert np.isfinite(r["agents"]["smooth_rep"]).all()
+        assert np.isfinite(r["events"]["outcomes_final"]).all()
+        assert r["participation"] == pytest.approx(0.0)
+
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_all_inf_matrix_degrades_to_all_nan(self, backend):
+        r = Oracle(reports=np.full((4, 3), np.inf),
+                   backend=backend).consensus()
+        assert np.isfinite(r["agents"]["smooth_rep"]).all()
+        np.testing.assert_array_equal(r["quarantined_rows"], [0, 1, 2, 3])
+
+    @pytest.mark.parametrize("shape", [(0, 4), (4, 0), (0, 0)])
+    def test_empty_matrix_is_structured_input_error(self, shape):
+        with pytest.raises(InputError, match="empty"):
+            Oracle(reports=np.zeros(shape))
+
+    def test_inf_reputation_is_structured_input_error(self):
+        with pytest.raises(InputError, match="finite"):
+            Oracle(reports=CANONICAL,
+                   reputation=[1.0, np.inf, 1.0, 1.0, 1.0, 1.0])
+
+
+class TestFallbackChain:
+    def test_nonfinite_jax_result_falls_back_and_recovers(self):
+        """An internal NaN storm (injected at the host fetch) walks
+        power -> eigh-gram and returns a finite result, with the hop
+        counted in pyconsensus_fallbacks_total{from,to,reason}."""
+        from pyconsensus_tpu import obs
+
+        before = obs.value("pyconsensus_fallbacks_total",
+                           **{"from": "power", "to": "eigh-gram",
+                              "reason": "nonfinite_result"}) or 0
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "oracle.raw_result", "kind": "nan_storm",
+             "occurrences": [0], "args": {"fraction": 1.0}}])
+        with faults.armed(plan):
+            r = Oracle(reports=CANONICAL, backend="jax",
+                       pca_method="power").consensus()
+        assert plan.fired
+        assert np.isfinite(r["agents"]["smooth_rep"]).all()
+        assert np.isfinite(r["events"]["outcomes_final"]).all()
+        after = obs.value("pyconsensus_fallbacks_total",
+                          **{"from": "power", "to": "eigh-gram",
+                             "reason": "nonfinite_result"})
+        assert after == before + 1
+        # the recovered outcomes match an uninjected resolution
+        clean = Oracle(reports=CANONICAL, backend="jax",
+                       pca_method="eigh-gram").consensus()
+        np.testing.assert_array_equal(r["events"]["outcomes_final"],
+                                      clean["events"]["outcomes_final"])
+
+    def test_exhausted_chain_raises_convergence_error(self, monkeypatch):
+        oracle = Oracle(reports=CANONICAL, backend="jax",
+                        pca_method="power")
+        bad = {"smooth_rep": np.full(6, np.nan)}
+        monkeypatch.setattr(Oracle, "_resolve_once",
+                            lambda self, update: bad)
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "oracle.raw_result", "kind": "nan_storm",
+                 "occurrences": [0], "args": {"fraction": 1.0}}])):
+            with pytest.raises(ConvergenceError) as ei:
+                oracle.consensus()
+        assert ei.value.error_code == "PYC202"
+
+    def test_exhausted_chain_on_exact_method_is_numerics_error(
+            self, monkeypatch):
+        oracle = Oracle(reports=CANONICAL, backend="jax",
+                        pca_method="eigh-gram")
+        bad = {"smooth_rep": np.full(6, np.nan)}
+        monkeypatch.setattr(Oracle, "_resolve_once",
+                            lambda self, update: bad)
+        with faults.armed(FaultPlan(seed=0, rules=[
+                {"site": "oracle.raw_result", "kind": "nan_storm",
+                 "occurrences": [0], "args": {"fraction": 1.0}}])):
+            with pytest.raises(NumericsError) as ei:
+                oracle.consensus()
+        assert not isinstance(ei.value, ConvergenceError)
+
+
+class TestStreamingPanelFaults:
+    def test_nan_storm_panels_resolve_finite(self):
+        """NaN poisoning of streamed panels is semantically MORE MISSING
+        DATA — the out-of-core path must absorb it, finitely."""
+        from pyconsensus_tpu.models.pipeline import ConsensusParams
+        from pyconsensus_tpu.parallel import streaming_consensus
+
+        rng = np.random.default_rng(0)
+        reports = rng.choice([0.0, 1.0], size=(12, 32))
+        with faults.armed(FaultPlan(seed=1, rules=[
+                {"site": "streaming.panel", "kind": "nan_storm",
+                 "max_fires": 0, "occurrences": [0, 1, 2, 3],
+                 "args": {"fraction": 0.2}}])):
+            out = streaming_consensus(reports, panel_events=8,
+                                      params=ConsensusParams())
+        assert np.isfinite(out["smooth_rep"]).all()
+        assert np.isfinite(out["outcomes_final"]).all()
+
+    def test_inf_storm_fails_loudly_not_silently(self):
+        """±Inf reaching the accumulators must surface as non-finite
+        outputs (the documented loud-failure contract of the streamed
+        spectrum) — never as a silently wrong but finite answer."""
+        from pyconsensus_tpu.models.pipeline import ConsensusParams
+        from pyconsensus_tpu.parallel import streaming_consensus
+
+        rng = np.random.default_rng(0)
+        reports = rng.choice([0.0, 1.0], size=(12, 32))
+        with faults.armed(FaultPlan(seed=1, rules=[
+                {"site": "streaming.panel", "kind": "inf_storm",
+                 "occurrences": [0], "args": {"fraction": 0.05}}])):
+            out = streaming_consensus(reports, panel_events=8,
+                                      params=ConsensusParams())
+        assert not np.isfinite(out["smooth_rep"]).all()
+
+
+# -- NaN-storm fuzz (the seeded chaos extension) ---------------------------
+
+
+class TestNaNStormFuzz:
+    """Satellite: seeded FaultPlan NaN/Inf storms through BOTH backends,
+    asserting finite, quarantine-consistent outputs — and exact
+    replayability of each plan."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_storm_is_finite_consistent_and_replayable(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        reports = rng.choice([0.0, 0.5, 1.0], size=(10, 8))
+        plan_dict = {"seed": seed, "rules": [
+            {"site": "oracle.reports", "kind": "nan_storm",
+             "occurrences": [0], "args": {"fraction": 0.15}},
+            {"site": "oracle.reports", "kind": "inf_storm",
+             "occurrences": [1], "args": {"fraction": 0.1}},
+        ]}
+
+        def resolve(backend, occurrence_shift=0):
+            plan = FaultPlan.from_dict(plan_dict)
+            with faults.armed(plan):
+                if occurrence_shift:          # consume occurrence 0
+                    faults.corrupt("oracle.reports", reports)
+                return Oracle(reports=reports, backend=backend,
+                              max_iterations=2).consensus(), plan
+
+        for occ in (0, 1):                    # NaN storm, then Inf storm
+            r_np, p_np = resolve("numpy", occ)
+            r_jax, p_jax = resolve("jax", occ)
+            for r in (r_np, r_jax):
+                assert np.isfinite(r["agents"]["smooth_rep"]).all()
+                assert np.isfinite(r["events"]["outcomes_final"]).all()
+            # identical injection on both backends -> identical
+            # quarantine decisions
+            np.testing.assert_array_equal(r_np["quarantined_rows"],
+                                          r_jax["quarantined_rows"])
+            assert p_np.fired == p_jax.fired
+            # replay: the same plan reproduces the numpy run exactly
+            r_again, _ = resolve("numpy", occ)
+            np.testing.assert_array_equal(
+                r_np["events"]["outcomes_final"],
+                r_again["events"]["outcomes_final"])
+            np.testing.assert_array_equal(r_np["agents"]["smooth_rep"],
+                                          r_again["agents"]["smooth_rep"])
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+class TestCLIFaultPlan:
+    def test_fault_plan_run_and_summary(self, tmp_path, capsys):
+        from pyconsensus_tpu.cli import main
+
+        plan_path = tmp_path / "plan.json"
+        plan_path.write_text(json.dumps({"seed": 5, "rules": [
+            {"site": "oracle.reports", "kind": "inf_storm",
+             "occurrences": [0], "args": {"fraction": 0.1}}]}))
+        assert main(["--example", "--fault-plan", str(plan_path)]) == 0
+        out = capsys.readouterr().out
+        assert "injected faults" in out
+        assert "oracle.reports #0: inf_storm" in out
+        assert faults.active_plan() is None   # disarmed on exit
+
+    def test_bad_plan_file_errors_cleanly(self, tmp_path):
+        from pyconsensus_tpu.cli import main
+
+        bad = tmp_path / "plan.json"
+        bad.write_text("{не json")
+        with pytest.raises(SystemExit):
+            main(["--example", "--fault-plan", str(bad)])
+        assert faults.active_plan() is None

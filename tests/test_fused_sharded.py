@@ -5,7 +5,8 @@ reputations to f32-kernel tolerance — across storage dtypes, NA
 patterns, iteration counts, and mesh widths, on the 8-virtual-device CPU
 mesh with the Pallas kernels in interpret mode.
 
-TODO(issue-3) triage: 7 tests in this file fail at seed and still fail —
+TODO(issue-4) triage (docs/ROBUSTNESS.md parity ledger #1-7, decision:
+fix, not xfail): 7 tests in this file fail at seed and still fail —
 the parity/scaled/padding cases whose smooth_rep (and downstream bonus)
 vectors drift past the 5e-6 tolerance between the shard_map path and the
 single-device fused path under CPU interpret mode (catch-snapped

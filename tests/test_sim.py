@@ -206,7 +206,9 @@ class TestCheckpointedSweep:
         bit-identical to a single-device monolithic run — chunk widths
         here are non-multiples of the 8 devices, exercising the pad.
 
-        TODO(issue-3) triage: fails at seed and still fails — ONE
+        TODO(issue-4) triage (docs/ROBUSTNESS.md parity ledger #9,
+        decision: fix — bit-identity is the crash/resume contract):
+        fails at seed and still fails — ONE
         liar_rep_share element out of 42 differs by a single ulp
         (1.1e-16), so the documented bit-identity contract of meshed vs
         monolithic dispatch is violated by one lane. Genuine contract

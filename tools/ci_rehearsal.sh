@@ -93,6 +93,93 @@ print("obs smoke OK: required metrics present, retrace counter stable at 1, "
       "span JSONL reconstructs the phase tree")
 PYEOF
 
+echo "=== Chaos smoke (ISSUE 4: kill -9 resume + checkpoint corruption + NaN storm) ==="
+# Three acceptance criteria, end to end: (1) a sweep worker killed with
+# SIGKILL mid-chunk resumes bit-identical to an uninterrupted run;
+# (2) a corrupted chunk checkpoint is detected by content checksum and
+# transparently recomputed; (3) a seeded NaN/Inf-storm fault plan yields
+# finite outcomes with quarantined rows reported, and replaying the same
+# plan reproduces the run exactly (see docs/ROBUSTNESS.md).
+"$PY" - <<'PYEOF'
+import json, os, pathlib, signal, subprocess, sys, tempfile, textwrap, time
+import numpy as np
+
+work = pathlib.Path(tempfile.mkdtemp(prefix="ci-chaos-"))
+ck = work / "ck"
+
+# -- (1) kill -9 mid-sweep, resume, compare digests ----------------------
+worker = work / "worker.py"
+worker.write_text(textwrap.dedent("""
+    import sys, time
+    from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+    sim = CollusionSimulator(n_reporters=6, n_events=4, max_iterations=2)
+    sweep = CheckpointedSweep(sim, [0.0, 0.4], [0.1], 4, seed=11,
+                              checkpoint_dir=sys.argv[1],
+                              trials_per_chunk=2)
+    for c in sweep.pending():
+        sweep._run_chunk(c)
+        time.sleep(0.5)
+"""))
+proc = subprocess.Popen([sys.executable, str(worker), str(ck)])
+deadline = time.monotonic() + 180
+while time.monotonic() < deadline:
+    if ck.exists() and list(ck.glob("chunk_*.npz")):
+        break
+    assert proc.poll() is None, "chaos worker died before first chunk"
+    time.sleep(0.05)
+else:
+    raise SystemExit("chaos worker never committed a chunk")
+os.kill(proc.pid, signal.SIGKILL)
+proc.wait(timeout=30)
+assert proc.returncode == -signal.SIGKILL
+
+from pyconsensus_tpu.sim import CheckpointedSweep, CollusionSimulator
+sim = CollusionSimulator(n_reporters=6, n_events=4, max_iterations=2)
+sweep = CheckpointedSweep(sim, [0.0, 0.4], [0.1], 4, seed=11,
+                          checkpoint_dir=ck, trials_per_chunk=2)
+assert sweep.pending(), "kill -9 landed after the sweep finished"
+sweep.run(host_id=0, n_hosts=1)
+got = sweep.gather()
+mono = sim.run([0.0, 0.4], [0.1], 4, seed=11)
+for key in ("correct_rate", "capture_rate", "liar_rep_share"):
+    assert np.array_equal(got[key], mono[key]), key
+print("chaos (1) OK: kill -9 mid-sweep resume is bit-identical")
+
+# -- (2) corrupt one chunk -> checksum detects, recompute matches --------
+victim = sweep._chunk_path(1)
+raw = bytearray(victim.read_bytes())
+raw[len(raw) // 2] ^= 0xFF
+victim.write_bytes(bytes(raw))
+resumed = CheckpointedSweep(sim, [0.0, 0.4], [0.1], 4, seed=11,
+                            checkpoint_dir=ck, trials_per_chunk=2)
+assert resumed.run(host_id=0, n_hosts=1) == 1     # exactly the scrubbed one
+got = resumed.gather()
+for key in ("correct_rate", "capture_rate", "liar_rep_share"):
+    assert np.array_equal(got[key], mono[key]), key
+print("chaos (2) OK: corrupted chunk detected by checksum and recomputed")
+
+# -- (3) NaN-storm plan: finite + quarantined + replayable ---------------
+from pyconsensus_tpu import Oracle, faults
+plan_dict = {"seed": 5, "rules": [
+    {"site": "oracle.reports", "kind": "inf_storm", "occurrences": [0],
+     "args": {"fraction": 0.1}}]}
+rng = np.random.default_rng(0)
+reports = rng.choice([0.0, 1.0], size=(12, 8))
+
+def storm():
+    with faults.armed(faults.FaultPlan.from_dict(plan_dict)):
+        return Oracle(reports=reports, backend="jax",
+                      max_iterations=2).consensus()
+r1, r2 = storm(), storm()
+assert np.isfinite(r1["agents"]["smooth_rep"]).all()
+assert np.isfinite(r1["events"]["outcomes_final"]).all()
+assert r1["quarantined_rows"].size > 0
+assert np.array_equal(r1["quarantined_rows"], r2["quarantined_rows"])
+assert np.array_equal(r1["events"]["outcomes_final"],
+                      r2["events"]["outcomes_final"])
+print("chaos (3) OK: NaN storm finite + quarantined, replay identical")
+PYEOF
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
   --bench-timeout 300 | tail -1 | "$PY" -c \
