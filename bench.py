@@ -148,6 +148,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "precision. Pass this flag to measure the "
                          "per-resolution-encode form (the pre-round-5 "
                          "series)")
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the fail-soft serve block (the "
+                         "micro-batching service probe appended to the "
+                         "JSON as the 'serve' key)")
+    ap.add_argument("--serve-requests", type=int, default=48)
+    ap.add_argument("--serve-concurrency", type=int, default=8)
+    ap.add_argument("--serve-seed", type=int, default=0)
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="seconds allowed for the backend-availability "
                          "probe subprocess (a wedged axon tunnel hangs "
@@ -355,7 +362,55 @@ def run_bench(args) -> None:
         out_json["pre_encoded"] = True
         out_json["encode_s"] = round(encode_s, 4)
     out_json["obs"] = _obs_columns(out)
+    out_json["serve"] = _serve_block(args)
     print(json.dumps(out_json))
+
+
+def _serve_block(args):
+    """ISSUE 5 satellite: a serving-layer probe alongside the resolution
+    metric — loadgen at fixed concurrency through the micro-batching
+    service (two shape buckets, warmed) reporting throughput, p50/p99
+    latency, mean batch occupancy, and cache hit ratio. FAIL-SOFT like
+    ``_obs_columns``: any failure becomes a stderr WARNING and a null
+    block — the artifact must always parse, and the headline resolution
+    metric must never be hostage to the serving layer."""
+    if args.no_serve:
+        return None
+    try:
+        from pyconsensus_tpu import obs
+        from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+        from pyconsensus_tpu.serve.loadgen import (LoadGenerator,
+                                                   mean_batch_occupancy)
+
+        shapes = ((24, 96), (48, 192))
+        cfg = ServeConfig(batch_window_ms=2.0, max_batch=8)
+        svc = ConsensusService(cfg)
+        buckets = svc.buckets_for(shapes)
+        svc.warm_buckets(buckets)
+        svc.start(warmup=False)
+        gen = LoadGenerator(svc, shapes=shapes, na_frac=0.05,
+                            seed=args.serve_seed)
+        stats = gen.run_closed(args.serve_requests,
+                               args.serve_concurrency)
+        svc.close(drain=True)
+        occ = mean_batch_occupancy()
+        mean_occ = None if occ is None else round(occ, 3)
+        return {
+            "requests": stats["requests"],
+            "failed": stats["failed"],
+            "throughput_rps": stats["throughput_rps"],
+            "latency_p50_ms": stats["latency_p50_ms"],
+            "latency_p99_ms": stats["latency_p99_ms"],
+            "mean_batch_occupancy": mean_occ,
+            "cache_hit_ratio": svc.cache.hit_ratio(),
+            "warmed_buckets": len(buckets),
+            "retraces": obs.value("pyconsensus_jit_retraces_total",
+                                  entry="serve_bucket"),
+        }
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: serve block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
 
 
 def _obs_columns(out) -> dict:

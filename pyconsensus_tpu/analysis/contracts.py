@@ -413,6 +413,72 @@ def _builder_retrace_pipeline(spec: dict) -> List[Finding]:
     return findings
 
 
+def _serve_bucket_args(spec: dict):
+    """Shared arg-shapes for the serve-bucket builders."""
+    import jax
+
+    R, E = _shape(spec)
+    dt = _acc_dtype()
+    return (jax.ShapeDtypeStruct((R, E), dt),       # padded reports
+            jax.ShapeDtypeStruct((R,), dt),         # reputation
+            jax.ShapeDtypeStruct((E,), bool),       # scaled
+            jax.ShapeDtypeStruct((E,), dt),         # mins
+            jax.ShapeDtypeStruct((E,), dt),         # maxs
+            jax.ShapeDtypeStruct((R,), bool),       # row_valid
+            jax.ShapeDtypeStruct((E,), bool),       # col_valid
+            jax.ShapeDtypeStruct((E,), dt))         # power seed
+
+
+def _builder_serve_bucket(spec: dict) -> str:
+    """The serving layer's padded bucket entry point
+    (serve.kernels.padded_consensus) — the hot path every bucketed
+    dispatch rides; must stay collective- and callback-free."""
+    from ..serve.kernels import make_bucket_executable
+
+    fn = make_bucket_executable(_params(spec))
+    return fn.lower(*_serve_bucket_args(spec),
+                    _params(spec)).compile().as_text()
+
+
+def _builder_retrace_serve_bucket(spec: dict) -> List[Finding]:
+    """Dynamic check: two identical bucket dispatches share one cache
+    entry — the runtime mirror is the serve cache warmup contract
+    (steady-state ``serve_bucket`` retraces == warmed bucket count)."""
+    import jax.numpy as jnp
+
+    from ..serve.kernels import bucket_inputs, make_bucket_executable
+
+    R, E = _shape(spec)
+    budget = int(spec.get("retrace_budget", 1))
+    p = _params(spec)
+    rng = np.random.default_rng(0)
+    reports = rng.choice([0.0, 1.0], size=(R, E))
+    reports[0, 0] = np.nan
+    args = [jnp.asarray(a) for a in bucket_inputs(
+        reports, np.full(R, 1.0 / R), np.zeros(E, bool), np.zeros(E),
+        np.ones(E), R, E, has_na=True)]
+    fn = make_bucket_executable(p)
+    before = fn._cache_size()
+    fn(*args, p)
+    mid = fn._cache_size()
+    fn(*args, p)
+    after = fn._cache_size()
+    findings = []
+    if after - mid > 0:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"identical bucket re-dispatch retraced: cache grew "
+                    f"{mid} -> {after}", severity="error",
+            snippet=f"{spec['name']}:recall"))
+    if after - before > budget:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"two dispatches grew the jit cache by "
+                    f"{after - before} (> budget {budget})",
+            severity="error", snippet=f"{spec['name']}:budget"))
+    return findings
+
+
 BUILDERS: Dict[str, Callable] = {
     "pipeline_sharded": _builder_pipeline_sharded,
     "pipeline_single": _builder_pipeline_single,
@@ -422,6 +488,8 @@ BUILDERS: Dict[str, Callable] = {
     "kmeans_single": _builder_kmeans_single,
     "sztorc_scores": _builder_sztorc_scores,
     "retrace_pipeline": _builder_retrace_pipeline,
+    "serve_bucket": _builder_serve_bucket,
+    "retrace_serve_bucket": _builder_retrace_serve_bucket,
 }
 
 

@@ -62,6 +62,15 @@ RULES = {
                        "code the armed-plan check bakes into the compiled "
                        "graph as a constant and the fault fires once per "
                        "TRACE, not per run)"),
+    "CL701": ("error", "blocking wait / queue operation inside a "
+                       "jit-traced context (time.sleep, queue get/put, "
+                       "Event.wait, Lock.acquire, Future.result, serve "
+                       "RequestQueue ops): it blocks once per TRACE — "
+                       "never per execution — and a compiled graph that "
+                       "appears to synchronize with other threads "
+                       "actually baked the wait's side effects in as "
+                       "constants; coordinate on the host, around the "
+                       "dispatch"),
 }
 
 #: callables that trace their function argument into an XLA graph
@@ -623,6 +632,75 @@ def _rule_faults_in_traced(mod: _Module) -> Iterable[Finding]:
                           f"site catalog)")
 
 
+#: dotted calls that BLOCK the calling thread (CL701 direct sources)
+_BLOCKING_CALLS = {
+    "time.sleep", "concurrent.futures.wait",
+    "concurrent.futures.as_completed", "futures.wait",
+    "futures.as_completed", "select.select",
+}
+
+#: constructors whose instances expose blocking methods — a name
+#: assigned from one of these becomes a CL701 handle (the
+#: _obs_handle_names dataflow pattern)
+_BLOCKING_CONSTRUCTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "threading.Event", "threading.Lock",
+    "threading.RLock", "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+    "concurrent.futures.Future", "futures.Future", "Future",
+    "RequestQueue",
+}
+
+#: blocking methods on those handles. Deliberately NOT matched on
+#: arbitrary receivers: ``.get``/``.join``/``.result`` are common benign
+#: names (dict.get, str.join), so only handle-tracked receivers count.
+_BLOCKING_METHODS = {
+    "get", "put", "get_nowait", "put_nowait", "wait", "acquire",
+    "result", "join", "take", "take_matching",
+}
+
+
+def _blocking_handle_names(mod: _Module, fn: ast.AST) -> Set[str]:
+    """Names in ``fn`` assigned from a blocking-object constructor."""
+    out: Set[str] = set()
+    for node in _walk_scope(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            dotted = mod.aliases.canon(_dotted(node.value.func)) or ""
+            if (dotted in _BLOCKING_CONSTRUCTORS
+                    or dotted.split(".")[-1] in ("Queue", "SimpleQueue",
+                                                 "Event", "Condition",
+                                                 "Semaphore", "Barrier",
+                                                 "RequestQueue", "Future")):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _rule_blocking_in_traced(mod: _Module) -> Iterable[Finding]:
+    for fn in mod.traced:
+        handles = _blocking_handle_names(mod, fn)
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.aliases.canon(_dotted(node.func)) or ""
+            if dotted in _BLOCKING_CALLS:
+                yield _mk(mod, node, "CL701",
+                          f"'{dotted}' blocks inside traced function "
+                          f"'{fn.name}' — it runs once per TRACE, never "
+                          f"per execution; wait on the host, around the "
+                          f"dispatch")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BLOCKING_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in handles):
+                yield _mk(mod, node, "CL701",
+                          f"blocking '.{node.func.attr}()' on "
+                          f"'{node.func.value.id}' (a queue/sync object) "
+                          f"inside traced function '{fn.name}' — "
+                          f"coordinate on the host, around the dispatch")
+
+
 def _rule_host_timer_in_traced(mod: _Module) -> Iterable[Finding]:
     for fn in mod.traced:
         for node in _walk_scope(fn):
@@ -648,6 +726,7 @@ _ALL_RULES = (
     _rule_f64_in_kernel, _rule_weak_where, _rule_mutable_default,
     _rule_bare_except, _rule_unused_import, _rule_obs_in_traced,
     _rule_host_timer_in_traced, _rule_faults_in_traced,
+    _rule_blocking_in_traced,
 )
 
 

@@ -37,7 +37,8 @@ from __future__ import annotations
 from .degrade import (POWER_METHODS, fallback_steps, quarantine_nonfinite,
                       raise_exhausted, record_fallback, result_nonfinite)
 from .errors import (ERROR_CODES, CheckpointCorruptionError, ConsensusError,
-                     ConvergenceError, InputError, NumericsError)
+                     ConvergenceError, InputError, NumericsError,
+                     ServiceOverloadError)
 from .plan import (FaultPlan, FaultRule, SimulatedCrash, active_plan, arm,
                    armed, corrupt, disarm, fire)
 from .retry import retry, retry_call
@@ -46,7 +47,7 @@ __all__ = [
     "FaultPlan", "FaultRule", "SimulatedCrash",
     "arm", "disarm", "armed", "active_plan", "fire", "corrupt",
     "ConsensusError", "InputError", "NumericsError", "ConvergenceError",
-    "CheckpointCorruptionError", "ERROR_CODES",
+    "CheckpointCorruptionError", "ServiceOverloadError", "ERROR_CODES",
     "retry", "retry_call",
     "quarantine_nonfinite", "result_nonfinite", "record_fallback",
     "fallback_steps", "raise_exhausted", "POWER_METHODS",

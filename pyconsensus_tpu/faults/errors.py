@@ -21,6 +21,12 @@ Code space (documented in docs/ROBUSTNESS.md):
 - ``PYC3xx`` — checkpoint: torn/corrupted/incomplete persisted state
   (ledger checkpoints, sweep chunks). Always names the offending field
   or file so a resume failure is actionable without a debugger.
+- ``PYC4xx`` — service: the consensus serving layer
+  (``pyconsensus_tpu.serve``) refused or shed a request by POLICY —
+  bounded queue full, per-tenant rate limit exceeded, deadline passed
+  before dispatch, or shutdown drain in progress. The request itself is
+  well-formed; retrying later (the ``context`` carries ``retry``
+  guidance) is the expected recovery.
 
 ``context`` keyword arguments are stored on the exception (``.context``)
 for structured logging; the message stays human-first.
@@ -29,7 +35,8 @@ for structured logging; the message stays human-first.
 from __future__ import annotations
 
 __all__ = ["ConsensusError", "InputError", "NumericsError",
-           "ConvergenceError", "CheckpointCorruptionError", "ERROR_CODES"]
+           "ConvergenceError", "CheckpointCorruptionError",
+           "ServiceOverloadError", "ERROR_CODES"]
 
 
 class ConsensusError(Exception):
@@ -81,10 +88,25 @@ class CheckpointCorruptionError(ConsensusError, ValueError):
     error_code = "PYC301"
 
 
+class ServiceOverloadError(ConsensusError, RuntimeError):
+    """The serving layer (``pyconsensus_tpu.serve``) shed this request by
+    POLICY: the bounded request queue was full, the tenant's token bucket
+    was empty, the request's deadline expired before dispatch, or the
+    service is draining for shutdown. Deterministic by design — over-rate
+    traffic is refused with this stable code at admission, never absorbed
+    into unbounded queue growth or a deadline-less hang. ``context``
+    carries the shed ``reason`` (``queue_full`` / ``rate_limited`` /
+    ``deadline`` / ``draining``) plus tenant/queue detail for structured
+    logging and retry policy."""
+
+    error_code = "PYC401"
+
+
 #: stable code -> class registry (docs/ROBUSTNESS.md table is generated
 #: from the same source of truth; tests pin the codes)
 ERROR_CODES = {
     cls.error_code: cls
     for cls in (ConsensusError, InputError, NumericsError,
-                ConvergenceError, CheckpointCorruptionError)
+                ConvergenceError, CheckpointCorruptionError,
+                ServiceOverloadError)
 }

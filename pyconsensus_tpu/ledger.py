@@ -112,6 +112,37 @@ class ReputationLedger:
         })
         return result
 
+    def record_round(self, result: dict) -> dict:
+        """Carry the reputation of a round RESOLVED ELSEWHERE — the
+        serving layer's market sessions resolve through the bucketed or
+        streaming paths and feed the ledger here, so checkpoint/resume
+        and per-round history work identically to :meth:`resolve`.
+        Accepts either the nested ``Oracle.consensus()`` dict or a flat
+        light result dict; returns ``result`` for chaining."""
+        if "agents" in result:             # nested Oracle.consensus dict
+            agents = result["agents"]
+            certainty = result["certainty"]          # scalar there
+            participation = result["participation"]
+        else:                              # flat light result dict
+            agents = result
+            certainty = result["avg_certainty"]
+            participation = 1.0 - float(result["percent_na"])
+        rep = np.asarray(agents["smooth_rep"], dtype=np.float64)
+        if rep.shape != (self.n_reporters,):
+            raise ValueError(
+                f"round reputation shape {rep.shape} does not match the "
+                f"ledger's {self.n_reporters} reporters")
+        self.reputation = rep
+        self.round += 1
+        self.history.append({
+            "round": self.round,
+            "certainty": float(certainty),
+            "participation": float(participation),
+            "convergence": bool(result["convergence"]),
+            "iterations": int(result["iterations"]),
+        })
+        return result
+
     # -- checkpoint / resume -------------------------------------------------
 
     def _state_tree(self) -> dict:

@@ -103,13 +103,26 @@ def canon_sign(v: jnp.ndarray) -> jnp.ndarray:
     return v * canon_sign_factor(v)
 
 
+def catch_tie_atol(dtype) -> float:
+    """The catch-snap boundary band for ``dtype`` arithmetic:
+    ``numpy_kernels.CATCH_TIE_ATOL`` floored at ``32 * eps`` (the
+    weighted-median tie's dtype rule) — under f32 a knife-edge mean
+    lands up to ~ulp(1.0) = 1.2e-7 off, so the f64-sized band would
+    collapse to exact equality there."""
+    return max(nk.CATCH_TIE_ATOL, 32.0 * float(jnp.finfo(dtype).eps))
+
+
 def catch(x: jnp.ndarray, tolerance) -> jnp.ndarray:
-    """Snap toward {0, 0.5, 1} (numpy_kernels.catch). The 0.5 branch is
-    anchored to ``x.dtype``: an all-weak-scalar ``jnp.where`` promotes to
-    the DEFAULT float dtype, which silently widened f32 inputs to f64 on
-    x64 hosts (consensus-lint CL104's bug class)."""
-    return jnp.where(x < 0.5 - tolerance, 0.0,
-                     jnp.where(x > 0.5 + tolerance, 1.0,
+    """Snap toward {0, 0.5, 1} (numpy_kernels.catch, including its
+    :data:`~numpy_kernels.CATCH_TIE_ATOL` boundary band — a value within
+    the band of ``0.5 ± tolerance`` resolves to the ambiguous 0.5 on
+    every path instead of by reduction-order ulp noise). The 0.5 branch
+    is anchored to ``x.dtype``: an all-weak-scalar ``jnp.where`` promotes
+    to the DEFAULT float dtype, which silently widened f32 inputs to f64
+    on x64 hosts (consensus-lint CL104's bug class)."""
+    atol = catch_tie_atol(x.dtype)
+    return jnp.where(x < 0.5 - tolerance - atol, 0.0,
+                     jnp.where(x > 0.5 + tolerance + atol, 1.0,
                                jnp.asarray(0.5, x.dtype)))
 
 
@@ -226,7 +239,7 @@ def _power_seed(E: int, dtype):
 
 
 def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float,
-                v_init=None):
+                v_init=None, base=None):
     """Shared power-iteration driver (used by the XLA matvec path below and
     the fused Pallas path in ``pallas_kernels``): deterministic start — one
     implicit-covariance application to the fixed-key :func:`_power_seed`
@@ -268,11 +281,19 @@ def _power_loop(apply_cov, E: int, dtype, n_iters: int, tol: float,
     between the two, where the directions are statistically
     interchangeable (and where the exact eigh is itself unstable). Cost:
     at most a sweep or two over the pure warm start when nothing
-    crossed."""
+    crossed.
+
+    ``base`` (optional) substitutes an explicit start vector for the
+    fixed-key :func:`_power_seed` draw. The serving layer's padded
+    bucket kernel passes the TRUE-width seed zero-extended to the bucket
+    width — threefry counters are not prefix-stable across draw lengths,
+    so a bucket-width draw would start a DIFFERENT trajectory than the
+    direct resolution the padded results must match bit-for-bit (the
+    ``fused_sharded._seed_placed`` precedent)."""
     no_exit = tol < 0
     tol = max(float(tol), 8.0 * float(jnp.finfo(dtype).eps))
 
-    base = _power_seed(E, dtype)
+    base = _power_seed(E, dtype) if base is None else base.astype(dtype)
     base_unit = base / jnp.linalg.norm(base)
     if v_init is None:
         seed = base

@@ -74,14 +74,38 @@ def canon_sign(v: np.ndarray) -> np.ndarray:
     return v * (1.0 if s == 0.0 else s)
 
 
+#: Catch-snap boundary tie band (same decision pattern as
+#: MEDIAN_TIE_ATOL / DIRFIX_TIE_ATOL below): a value within this
+#: distance of a snap boundary ``0.5 ± tolerance`` resolves to the
+#: AMBIGUOUS 0.5 bucket instead of letting the last ulp decide.
+#: Rationale (docs/ROBUSTNESS.md parity ledger #1-7): rational report
+#: data under uniform reputation lands weighted means EXACTLY on the
+#: boundary (e.g. 12 ones over 20 present reporters = 0.6 = 0.5 + the
+#: default 0.1 tolerance), and two exact computations of the same mean
+#: through different reduction orders (a (R, E) column reduce vs the
+#: same column inside a (R, E/n) shard block) straddle the boundary by
+#: one ulp — flipping the snapped fill between 0.5 and 1.0 and feeding
+#: a MATERIALLY different filled matrix to the scorer. The band makes
+#: the decision reduction-order-stable: a knife-edge value fails to
+#: resolve (0.5) on every path rather than resolving by noise on some.
+#: 1e-9 sits ~7 orders above f64 ulp noise on O(1) means yet far below
+#: any data-driven margin (a mean 1e-9 inside the snap region requires
+#: a reporter weight that small); f32 paths floor the band at 32*eps
+#: (see the jax kernel), the same dtype rule as the median tie.
+CATCH_TIE_ATOL = 1e-9
+
+
 def catch(x, tolerance: float):
     """Snap a consensus value toward {0, 0.5, 1} (SURVEY.md §2 #6).
 
-    ``x < 0.5 - tolerance -> 0``; ``x > 0.5 + tolerance -> 1``; else ``0.5``.
-    Works elementwise on arrays.
+    ``x < 0.5 - tolerance -> 0``; ``x > 0.5 + tolerance -> 1``; else
+    ``0.5``. Boundary decisions are banded by :data:`CATCH_TIE_ATOL`
+    (shared with the jax and Pallas mirrors) so reduction-order ulp
+    noise cannot flip a knife-edge snap. Works elementwise on arrays.
     """
     x = np.asarray(x, dtype=np.float64)
-    return np.where(x < 0.5 - tolerance, 0.0, np.where(x > 0.5 + tolerance, 1.0, 0.5))
+    return np.where(x < 0.5 - tolerance - CATCH_TIE_ATOL, 0.0,
+                    np.where(x > 0.5 + tolerance + CATCH_TIE_ATOL, 1.0, 0.5))
 
 
 def rescale(reports: np.ndarray, scaled: np.ndarray, mins: np.ndarray,

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import numpy_kernels as nk
+
 __all__ = ["apply_weighted_cov", "apply_weighted_cov_block",
            "power_iteration_fused",
            "scores_dirfix_pass", "resolve_certainty_fused",
@@ -1079,9 +1081,13 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
     # the inner where's branches must anchor to f32: two weak Python
     # scalars promote to the DEFAULT float dtype, which under an x64
     # host (the CPU interpret test environment) is f64 — a dtype this
-    # kernel's output refs reject (consensus-lint CL104's bug class)
-    out = jnp.where(means < 0.5 - tolerance, 0.0,
-                    jnp.where(means > 0.5 + tolerance, 1.0,
+    # kernel's output refs reject (consensus-lint CL104's bug class).
+    # Boundary band: jax_kernels.catch's CATCH_TIE_ATOL rule at the
+    # kernel's f32 mean dtype — knife-edge means must snap identically
+    # across every path (numpy_kernels.CATCH_TIE_ATOL's rationale).
+    atol = max(nk.CATCH_TIE_ATOL, 32.0 * float(jnp.finfo(f32).eps))
+    out = jnp.where(means < 0.5 - tolerance - atol, 0.0,
+                    jnp.where(means > 0.5 + tolerance + atol, 1.0,
                               jnp.asarray(0.5, f32)))
     raw_ref[:] = means
     out_ref[:] = out

@@ -85,7 +85,8 @@ from ..ops import numpy_kernels as nk
 from ..oracle import parse_event_bounds
 from .mesh import effective_median_block
 
-__all__ = ["streaming_consensus"]
+__all__ = ["streaming_consensus", "gram_dirfix", "gram_top_components",
+           "assemble_light_result"]
 
 #: R above which the streamed spectrum comes from orthogonal iteration on
 #: the explicit Gram accumulator instead of ``jnp.linalg.eigh`` — the
@@ -340,6 +341,86 @@ def _fill_rows_panel(panel, fill_rep, rows, scaled, mins, maxs,
     return filled[rows]
 
 
+def gram_dirfix(scores, rep_ref, S):
+    """``direction_fixed_scores`` in closed form over the ``S = F F^T``
+    accumulator: ``||w^T F - rep^T F||^2 = (w-rep)^T S (w-rep)`` — same
+    normalize guard, tie-break, and non-negative winning orientation as
+    every other decision site. Module-level (extracted from the
+    streaming driver's closure) so the serving layer's market sessions
+    score off their incrementally-accumulated statistics through the
+    IDENTICAL arithmetic."""
+    scores = jk.canon_sign(scores)
+    set1 = scores + jnp.abs(jnp.min(scores))
+    set2 = scores - jnp.max(scores)
+
+    def sq_dist_to_old(w):
+        d = w - rep_ref
+        return d @ S @ d
+
+    d1 = sq_dist_to_old(jk.normalize(set1))
+    d2 = sq_dist_to_old(jk.normalize(set2))
+    # banded tie, identical rule to every other decision site
+    # (ops.numpy_kernels.DIRFIX_TIE_ATOL — see its sizing note)
+    return jnp.where(d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2),
+                     set1, -set2)
+
+
+def gram_top_components(G, M, rep_ref, k: int):
+    """Top-k loadings' scores + explained fractions off the Gram
+    accumulator (the full nonzero covariance spectrum lives in G —
+    jax_kernels.weighted_prin_comps' eigh-gram route, streamed).
+    Returns ``(scores (R, k), explained (k,), U (R, k), nAu (k,))``.
+
+    Above ``STREAM_EIGH_MAX_R`` reporters the top-k subspace comes
+    from blocked orthogonal iteration on the explicit symmetric
+    accumulator instead of ``jnp.linalg.eigh`` — round-5 first
+    hardware contact (VERDICT r4 item 1 precedent confirmed): the
+    QDWH eigh's triangular-solve temporaries at R=10000 exceeded the
+    chip's HBM (dozens of ~300 MB buffers), while an orth-iter sweep
+    is one 4R² byte matmul. The threshold mirrors
+    ``jax_kernels.resolve_pca_method``'s R<=4096 Gram-eigh rule; the
+    total variance uses ``trace(G)/denom`` (= the full eigvalue sum)
+    so explained fractions need no full spectrum. Module-level
+    (extracted from the streaming driver's closure) — shared with the
+    serving layer's session resolution."""
+    R = G.shape[0]
+    denom = 1.0 - jnp.sum(rep_ref ** 2)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    Gd = G / denom
+    if R <= STREAM_EIGH_MAX_R:
+        eigvals, eigvecs = jnp.linalg.eigh(Gd)
+        lam = jnp.clip(eigvals[::-1][:k], 0.0, None)
+        U = eigvecs[:, ::-1][:, :k]                   # (R, k)
+        total = jnp.sum(jnp.clip(eigvals, 0.0, None))
+    else:
+        obs.counter(
+            "pyconsensus_streaming_topk_fallback_total",
+            "streamed spectra taken via orthogonal iteration instead "
+            "of eigh (R > STREAM_EIGH_MAX_R)").inc()
+        lam, U = _sym_topk(Gd, k)
+        total = jnp.clip(jnp.trace(Gd), 0.0, None)
+    # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
+    nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0, None))
+    scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
+    # explained-variance discrepancy bound across the
+    # STREAM_EIGH_MAX_R switch: below the cap, lam and total come
+    # from the SAME eigh, so the fractions equal the in-memory
+    # eigh-gram route exactly. Above it, lam are Rayleigh-Ritz
+    # values of the converged orth-iter block — each lam_c lies in
+    # [eig_c - r_c, eig_c] with r_c the block residual, and the
+    # per-column alignment exit at 1 - tol (tol = 1e-7) bounds the
+    # principal angle by sqrt(2*tol), hence r_c <= 2*tol*eig_1 —
+    # while total = trace(Gd) is the exact full eigenvalue sum. Each
+    # fraction is therefore UNDER-estimated by at most
+    # 2*tol*eig_1/total ~ 2e-7: orders of magnitude below the
+    # variance_threshold granularity fixed-variance cuts on, so the
+    # component count never flips across the switch.
+    explained = jnp.where(total > 0.0,
+                          lam / jnp.where(total > 0.0, total, 1.0),
+                          jnp.zeros_like(lam))
+    return scores, explained, U, nAu
+
+
 def _default_allreduce(x):
     """Cross-process sum via the jax distributed runtime (requires
     ``parallel.initialize``); the ``allreduce=`` hook exists so tests and
@@ -585,24 +666,8 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
     u_over_nAu = jnp.zeros((R,), dtype=dtype)
 
     def dirfix_S(scores, rep_ref):
-        """direction_fixed_scores in closed form over the S = F F^T
-        accumulator: ``||w^T F - rep^T F||^2 = (w-rep)^T S (w-rep)`` —
-        same normalize guard, tie-break, and non-negative winning
-        orientation."""
-        scores = jk.canon_sign(scores)
-        set1 = scores + jnp.abs(jnp.min(scores))
-        set2 = scores - jnp.max(scores)
-
-        def sq_dist_to_old(w):
-            d = w - rep_ref
-            return d @ S @ d
-
-        d1 = sq_dist_to_old(jk.normalize(set1))
-        d2 = sq_dist_to_old(jk.normalize(set2))
-        # banded tie, identical rule to every other decision site
-        # (ops.numpy_kernels.DIRFIX_TIE_ATOL — see its sizing note)
-        return jnp.where(d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2),
-                         set1, -set2)
+        """:func:`gram_dirfix` against the run's fill-pinned S."""
+        return gram_dirfix(scores, rep_ref, S)
 
     def accumulate_stats(weight_rep, with_s, with_gm=True):
         """One pass over the source: (G, M[, S]) with the given Gram
@@ -638,56 +703,9 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         return G, M, S_acc
 
     def top_components(G, M, rep_ref, k):
-        """Top-k loadings' scores + explained fractions off the Gram
-        accumulator (the full nonzero covariance spectrum lives in G —
-        jax_kernels.weighted_prin_comps' eigh-gram route, streamed).
-        Returns ``(scores (R, k), explained (k,), U (R, k), nAu (k,))``.
-
-        Above ``STREAM_EIGH_MAX_R`` reporters the top-k subspace comes
-        from blocked orthogonal iteration on the explicit symmetric
-        accumulator instead of ``jnp.linalg.eigh`` — round-5 first
-        hardware contact (VERDICT r4 item 1 precedent confirmed): the
-        QDWH eigh's triangular-solve temporaries at R=10000 exceeded the
-        chip's HBM (dozens of ~300 MB buffers), while an orth-iter sweep
-        is one 4R² byte matmul. The threshold mirrors
-        ``jax_kernels.resolve_pca_method``'s R<=4096 Gram-eigh rule; the
-        total variance uses ``trace(G)/denom`` (= the full eigvalue sum)
-        so explained fractions need no full spectrum."""
-        denom = 1.0 - jnp.sum(rep_ref ** 2)
-        denom = jnp.where(denom == 0.0, 1.0, denom)
-        Gd = G / denom
-        if R <= STREAM_EIGH_MAX_R:
-            eigvals, eigvecs = jnp.linalg.eigh(Gd)
-            lam = jnp.clip(eigvals[::-1][:k], 0.0, None)
-            U = eigvecs[:, ::-1][:, :k]                   # (R, k)
-            total = jnp.sum(jnp.clip(eigvals, 0.0, None))
-        else:
-            obs.counter(
-                "pyconsensus_streaming_topk_fallback_total",
-                "streamed spectra taken via orthogonal iteration instead "
-                "of eigh (R > STREAM_EIGH_MAX_R)").inc()
-            lam, U = _sym_topk(Gd, k)
-            total = jnp.clip(jnp.trace(Gd), 0.0, None)
-        # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
-        nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0, None))
-        scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
-        # explained-variance discrepancy bound across the
-        # STREAM_EIGH_MAX_R switch: below the cap, lam and total come
-        # from the SAME eigh, so the fractions equal the in-memory
-        # eigh-gram route exactly. Above it, lam are Rayleigh-Ritz
-        # values of the converged orth-iter block — each lam_c lies in
-        # [eig_c - r_c, eig_c] with r_c the block residual, and the
-        # per-column alignment exit at 1 - tol (tol = 1e-7) bounds the
-        # principal angle by sqrt(2*tol), hence r_c <= 2*tol*eig_1 —
-        # while total = trace(Gd) is the exact full eigenvalue sum. Each
-        # fraction is therefore UNDER-estimated by at most
-        # 2*tol*eig_1/total ~ 2e-7: orders of magnitude below the
-        # variance_threshold granularity fixed-variance cuts on, so the
-        # component count never flips across the switch.
-        explained = jnp.where(total > 0.0,
-                              lam / jnp.where(total > 0.0, total, 1.0),
-                              jnp.zeros_like(lam))
-        return scores, explained, U, nAu
+        """:func:`gram_top_components` (module-level since the serve
+        refactor — sessions share the identical scoring arithmetic)."""
+        return gram_top_components(G, M, rep_ref, k)
 
     for _ in range(max(p.max_iterations, 1)):
         if p.algorithm == "k-means":
@@ -849,7 +867,22 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         # the chaotic-fallback observability flag, like every other path
         result_extra["ica_converged"] = bool(ica_converged)
 
-    # ---- finalize the bonus accounting (numpy_kernels semantics) --------
+    return assemble_light_result(
+        old_rep, this_rep, smooth_rep, na_count, outcomes_raw,
+        outcomes_adjusted, outcomes_final, iterations, converged,
+        certainty, pcols, prow, result_extra)
+
+
+def assemble_light_result(old_rep, this_rep, smooth_rep, na_count,
+                          outcomes_raw, outcomes_adjusted, outcomes_final,
+                          iterations, converged, certainty, pcols, prow,
+                          result_extra=None) -> dict:
+    """Finalize the bonus accounting (numpy_kernels semantics) from the
+    panel-accumulated pieces and assemble the light result dict — the
+    shared tail of the streaming driver and the serve layer's market
+    sessions (which accumulate the identical pieces incrementally).
+    ``pcols`` is ``participation_columns``; ``prow`` the per-row
+    ``na @ certainty`` partials."""
     total_cert = certainty.sum()
     consensus_reward = nk.normalize(certainty)
     participation_rows = 1.0 - (prow if total_cert == 0.0
@@ -882,5 +915,5 @@ def _streaming_consensus_impl(reports_src, reputation, event_bounds,
         "reporter_bonus": reporter_bonus,
         "na_bonus_cols": na_bonus_cols,
         "author_bonus": author_bonus,
-        **result_extra,
+        **(result_extra or {}),
     }

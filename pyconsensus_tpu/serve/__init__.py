@@ -1,0 +1,46 @@
+"""pyconsensus_tpu.serve — the micro-batching consensus service
+(ISSUE 5 tentpole): request queue + continuous micro-batcher with
+shape-bucketed padding, a warmed executable cache with LRU eviction,
+named market sessions with incremental ingestion, and deterministic
+admission control.
+
+Quick use::
+
+    from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+    svc = ConsensusService(ServeConfig(warmup=((16, 64),))).start()
+    result = svc.submit(reports=matrix).result()   # Oracle-shaped dict
+    svc.close(drain=True)
+
+Guarantees (docs/SERVING.md):
+
+- catch-snapped outcomes from the bucketed fast path are bit-identical
+  to a direct ``Oracle`` resolution; continuous tails match to <= 1e-9;
+  the numpy/direct paths run the Oracle graph itself (bit-identical by
+  construction);
+- a request's full result is a deterministic function of the request
+  alone — never of traffic shape, co-batched requests, or cache state;
+- overload is shed deterministically with ``ServiceOverloadError``
+  (PYC401) at admission or at deadline — queues are bounded, waits are
+  deadlined.
+"""
+
+from __future__ import annotations
+
+from ..faults import ServiceOverloadError
+from .cache import BucketKey, ExecutableCache
+from .kernels import (SERVE_ALGORITHMS, bucket_inputs, bucket_path_eligible,
+                      make_bucket_executable, padded_consensus, slice_result)
+from .loadgen import LoadGenerator
+from .queue import RequestQueue, ResolveRequest
+from .service import ConsensusService, ServeConfig
+from .session import MarketSession, SessionStore
+
+__all__ = [
+    "ConsensusService", "ServeConfig", "ServiceOverloadError",
+    "MarketSession", "SessionStore",
+    "ResolveRequest", "RequestQueue",
+    "ExecutableCache", "BucketKey", "LoadGenerator",
+    "padded_consensus", "make_bucket_executable", "bucket_inputs",
+    "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS",
+]

@@ -1,0 +1,109 @@
+"""Admission control: token-bucket rate limits + deadline shedding
+(serve tentpole part d).
+
+Overload behavior is DETERMINISTIC by design: a request that cannot be
+served within policy is refused at the front door (or shed at dispatch
+when its deadline has already passed) with a structured
+``ServiceOverloadError`` (stable code PYC401, ``context["reason"]``
+naming the policy) — never absorbed into unbounded queue growth or a
+deadline-less hang. The bounded queue itself lives in ``queue.py``; this
+module owns the per-tenant rate policy and the drain flag.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import obs
+from ..faults import ServiceOverloadError
+
+__all__ = ["TokenBucket", "AdmissionController"]
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second refill, ``burst``
+    capacity. ``try_take`` is O(1) and lock-free within the controller's
+    lock (refill is computed lazily from elapsed time, no timer
+    thread)."""
+
+    def __init__(self, rate: float, burst: float) -> None:
+        if rate <= 0 or burst <= 0:
+            raise ValueError("rate and burst must be positive")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._stamp = time.monotonic()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self._stamp) * self.rate)
+        self._stamp = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def retry_after(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available (retry hint)."""
+        return max(0.0, (n - self.tokens) / self.rate)
+
+
+class AdmissionController:
+    """Per-tenant token buckets + the drain flag, consulted by
+    ``ConsensusService.submit`` BEFORE the request touches the queue —
+    over-rate traffic never occupies queue capacity."""
+
+    def __init__(self, rate: float = 0.0, burst: float = 0.0) -> None:
+        #: rate <= 0 disables rate limiting (the bounded queue still
+        #: backstops admission)
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else max(1.0, float(rate))
+        self._buckets: dict = {}
+        self._lock = threading.Lock()
+        self._draining = False
+        self._shed = obs.counter(
+            "pyconsensus_serve_shed_total",
+            "requests refused/shed by admission policy",
+            labels=("reason",))
+
+    # -- drain ----------------------------------------------------------
+
+    def start_drain(self) -> None:
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- admission ------------------------------------------------------
+
+    def admit(self, tenant: str) -> None:
+        """Raise ``ServiceOverloadError`` when ``tenant`` is over rate
+        or the service is draining; otherwise consume one token."""
+        if self._draining:
+            self._shed.inc(reason="draining")
+            raise ServiceOverloadError(
+                "service is draining for shutdown", reason="draining",
+                tenant=tenant)
+        if self.rate <= 0:
+            return
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(self.rate,
+                                                             self.burst)
+            if not bucket.try_take():
+                retry = bucket.retry_after()
+                self._shed.inc(reason="rate_limited")
+                raise ServiceOverloadError(
+                    f"tenant {tenant!r} over rate "
+                    f"({self.rate:g} req/s, burst {self.burst:g})",
+                    reason="rate_limited", tenant=tenant,
+                    retry_after_s=retry)
+
+    def record_shed(self, reason: str) -> None:
+        """Count a shed decided elsewhere (deadline at dispatch,
+        queue_full in the queue) under the same metric."""
+        self._shed.inc(reason=reason)

@@ -1,0 +1,216 @@
+"""Continuous micro-batching dispatch loop (serve tentpole part a).
+
+The batcher thread drains the request queue: the oldest request opens a
+batch, compatible requests (identical ``batch_key`` — bucket shape +
+static params + backend) arriving within the coalescing window join it
+up to ``max_batch``, and the group dispatches through ONE bucket
+executable with the batch axis padded to the FIXED capacity. The fixed
+capacity is load-bearing for the determinism contract: every dispatch
+of a bucket uses the same compiled executable, and vmapped lanes are
+pure functions of their own inputs, so a request's bits never depend on
+what it was co-batched with (or whether it was batched at all). The
+cost is that a singleton dispatch computes ``max_batch`` lanes —
+latency-focused deployments set ``max_batch=1`` to trade coalescing
+away.
+
+Requests whose configuration the bucket kernel does not serve
+(``kernels.bucket_path_eligible``), whose shape exceeds the bucket
+ladders, or whose backend is numpy dispatch DIRECTLY — a per-request
+``Oracle`` resolution, bit-identical to a user-level call by
+construction. Session requests resolve through their
+:class:`~pyconsensus_tpu.serve.session.MarketSession`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..faults import plan as _faults
+from ..oracle import Oracle, assemble_result, record_consensus_result
+from . import kernels as sk
+from .cache import BucketKey
+
+__all__ = ["Microbatcher", "OCCUPANCY_BUCKETS"]
+
+#: batch-occupancy histogram edges (requests per bucketed dispatch)
+OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+
+#: keys of the flat light dict a lane must carry into assemble_result
+_SCALAR_KEYS = ("iterations", "convergence", "percent_na",
+                "avg_certainty")
+
+
+class Microbatcher:
+    """The dispatch engine: one daemon thread owning device dispatch.
+
+    Single-threaded dispatch is deliberate: jit executables are not
+    re-entrant-safe to call concurrently from many threads without
+    contention, and one thread driving an async device already keeps the
+    queue moving; the parallelism that matters (batch lanes) lives
+    INSIDE the executable."""
+
+    def __init__(self, queue, cache, config, sessions,
+                 admission) -> None:
+        self.queue = queue
+        self.cache = cache
+        self.config = config
+        self.sessions = sessions
+        self.admission = admission
+        self._thread = None
+        self._requests = obs.counter(
+            "pyconsensus_serve_requests_total",
+            "serve requests by dispatch path and outcome",
+            labels=("path", "outcome"))
+        self._latency = obs.histogram(
+            "pyconsensus_serve_request_seconds",
+            "submit-to-result latency per request",
+            labels=("path",))
+        self._occupancy = obs.histogram(
+            "pyconsensus_serve_batch_occupancy",
+            "requests coalesced per bucketed dispatch",
+            buckets=OCCUPANCY_BUCKETS)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run,
+                                        name="pyconsensus-serve-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def join(self, timeout=None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- the loop -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            req = self.queue.take(timeout=0.05)
+            if req is None:
+                if self.queue.closed:
+                    return
+                continue
+            try:
+                self._serve_one(req)
+            except BaseException as exc:  # noqa: BLE001 — future carries it
+                if not req.future.done():
+                    req.future.set_exception(exc)
+
+    def _serve_one(self, req) -> None:
+        if req.expired():
+            self.admission.record_shed("deadline")
+            req.shed("deadline", waited_s=time.monotonic()
+                     - req.submitted_at)
+            self._requests.inc(path=req.dispatch_path, outcome="shed")
+            return
+        if req.dispatch_path == "bucket":
+            group = [req] + self._coalesce(req)
+            self._dispatch_bucket(group)
+        elif req.dispatch_path == "session":
+            self._dispatch_session(req)
+        else:
+            self._dispatch_direct(req)
+
+    def _coalesce(self, first) -> list:
+        """Collect same-key requests within the deadline window."""
+        cap = self.config.max_batch - 1
+        if cap <= 0:
+            return []
+        window_end = time.monotonic() + self.config.batch_window_ms / 1e3
+        group: list = []
+        while len(group) < cap:
+            group.extend(self.queue.take_matching(first.batch_key,
+                                                  cap - len(group)))
+            remaining = window_end - time.monotonic()
+            if len(group) >= cap or remaining <= 0:
+                break
+            time.sleep(min(remaining, 5e-4))
+        return group
+
+    # -- dispatch paths -------------------------------------------------
+
+    def _dispatch_bucket(self, group) -> None:
+        self._occupancy.observe(len(group))
+        live = [r for r in group if not r.expired()]
+        for r in group:
+            if r not in live:
+                self.admission.record_shed("deadline")
+                r.shed("deadline")
+                self._requests.inc(path="bucket", outcome="shed")
+        if not live:
+            return
+        try:
+            _faults.fire("serve.dispatch")
+            key: BucketKey = live[0].batch_key
+            capacity = key.batch
+            lanes = []
+            for r in live:
+                lanes.append(sk.bucket_inputs(
+                    r.reports, r.reputation, r.scaled, r.mins, r.maxs,
+                    key.rows, key.events, has_na=key.params.has_na))
+            while len(lanes) < capacity:
+                lanes.append(lanes[0])   # pure lanes: replication is free
+            entry = self.cache.get(key)
+            with obs.span("serve.dispatch",
+                          bucket=f"{key.rows}x{key.events}",
+                          occupancy=len(live)):
+                if capacity > 1:
+                    stacked = [jnp.asarray(np.stack(field))
+                               for field in zip(*lanes)]
+                else:
+                    stacked = [jnp.asarray(a) for a in lanes[0]]
+                raw = entry(*stacked, key.params)
+                host = {k: np.asarray(v) for k, v in raw.items()}
+        except BaseException as exc:  # noqa: BLE001 — EVERY waiter must
+            # learn of a group failure; resolving only the opener would
+            # leave the coalesced members hanging to their timeouts
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+                    self._requests.inc(path="bucket", outcome="error")
+            raise
+        for i, r in enumerate(live):
+            lane = {k: (v[i] if capacity > 1 else v)
+                    for k, v in host.items()}
+            flat = sk.slice_result(lane, r.shape[0], r.shape[1])
+            for k in _SCALAR_KEYS:
+                flat[k] = np.asarray(flat[k]).item()
+            result = assemble_result(flat)
+            result["quarantined_rows"] = r.quarantined_rows
+            record_consensus_result(result, key.params.algorithm,
+                                    "serve")
+            self._finish(r, result, "bucket")
+
+    def _dispatch_direct(self, req) -> None:
+        _faults.fire("serve.dispatch")
+        with obs.span("serve.direct", backend=req.backend,
+                      shape=str(req.shape)):
+            result = Oracle(reports=req.reports,
+                            event_bounds=req.event_bounds,
+                            reputation=req.reputation,
+                            backend=req.backend,
+                            **req.oracle_kwargs).consensus()
+        self._finish(req, result, "direct")
+
+    def _dispatch_session(self, req) -> None:
+        _faults.fire("serve.dispatch")
+        session = self.sessions.get(req.session)
+        flat = session.resolve(**req.oracle_kwargs)
+        result = assemble_result(flat)
+        result["quarantined_rows"] = np.array([], dtype=np.int64)
+        self._finish(req, result, "session")
+
+    def _finish(self, req, result, path: str) -> None:
+        if not req.future.done():
+            req.future.set_result(result)
+            self._requests.inc(path=path, outcome="ok")
+            self._latency.observe(
+                time.monotonic() - req.submitted_at, path=path)

@@ -1,0 +1,143 @@
+"""Shape-bucketed executable cache (serve tentpole part b).
+
+One warmed jitted executable per (bucket shape, batch capacity, static
+params) key. Each entry owns a PRIVATE ``jax.jit`` wrapper
+(``kernels.make_bucket_executable``), so LRU eviction actually frees the
+compiled executable instead of leaking it in a process-global cache —
+and the ``--warmup`` preflight can compile the configured buckets before
+the service accepts traffic, the runtime mirror of consensus-lint
+CL304's retrace budget: steady-state serving must show
+``pyconsensus_jit_retraces_total{entry="serve_bucket"}`` pinned at the
+warmed bucket count (the CI smoke asserts exactly that).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..faults import plan as _faults
+from . import kernels as sk
+
+__all__ = ["ExecutableCache", "BucketKey"]
+
+
+class BucketKey(tuple):
+    """(rows, events, batch_capacity, params) — hashable cache key.
+    ``params`` is the fully-resolved static ``ConsensusParams`` (a
+    NamedTuple, hashable); two tenants with different alphas are two
+    executables, exactly as jit itself would key them."""
+
+    __slots__ = ()
+
+    @classmethod
+    def make(cls, rows: int, events: int, batch: int, params):
+        return cls((int(rows), int(events), int(batch), params))
+
+    @property
+    def rows(self):
+        return self[0]
+
+    @property
+    def events(self):
+        return self[1]
+
+    @property
+    def batch(self):
+        return self[2]
+
+    @property
+    def params(self):
+        return self[3]
+
+
+class ExecutableCache:
+    """Bucket-keyed LRU of warmed executables with hit/miss/evict
+    metrics. Thread-safe; the compile itself runs outside the lock is
+    NOT attempted — the batcher is the only caller, and serializing
+    compiles keeps the retrace accounting exact."""
+
+    def __init__(self, capacity: int = 64) -> None:
+        if int(capacity) < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._entries: OrderedDict = OrderedDict()
+        self._lock = threading.RLock()
+        self._hits = obs.counter(
+            "pyconsensus_serve_cache_hits_total",
+            "bucket-executable cache hits")
+        self._misses = obs.counter(
+            "pyconsensus_serve_cache_misses_total",
+            "bucket-executable cache misses (each one compiles)")
+        self._evictions = obs.counter(
+            "pyconsensus_serve_cache_evictions_total",
+            "bucket executables evicted by LRU pressure")
+        self._size = obs.gauge(
+            "pyconsensus_serve_cache_size",
+            "bucket executables currently cached")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries.keys())
+
+    def hit_ratio(self):
+        """Lifetime hit ratio (None before any lookup) — the bench /
+        loadgen summary column."""
+        h = obs.value("pyconsensus_serve_cache_hits_total") or 0
+        m = obs.value("pyconsensus_serve_cache_misses_total") or 0
+        total = h + m
+        return None if total == 0 else h / total
+
+    def get(self, key: BucketKey):
+        """The executable for ``key`` — compiled (and stored) on miss,
+        LRU-refreshed on hit."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self._hits.inc()
+                return entry
+            self._misses.inc()
+            _faults.fire("serve.cache_store")
+            entry = sk.make_bucket_executable(key.params,
+                                              batched=key.batch > 1)
+            self._entries[key] = entry
+            while len(self._entries) > self.capacity:
+                _, evicted = self._entries.popitem(last=False)
+                del evicted
+                self._evictions.inc()
+            self._size.set(len(self._entries))
+            return entry
+
+    def warm(self, key: BucketKey) -> None:
+        """Compile ``key``'s executable AND populate its jit cache by
+        running it once on zero inputs (an AOT ``lower().compile()``
+        would not seed the ``jit`` call cache, so the first real request
+        would compile again). A zero matrix resolves degenerately fast —
+        the power loop's zero-covariance guard exits on the first
+        sweep."""
+        entry = self.get(key)
+        rows, events, batch = key.rows, key.events, key.batch
+        acc = jnp.asarray(0.0).dtype
+        p = key.params
+        reports = np.zeros((rows, events))
+        if p.has_na:
+            reports[-1, 0] = np.nan     # exercise the fill graph
+        rep = np.full((rows,), 1.0 / rows)
+        args = [jnp.asarray(a) for a in (
+            reports, rep, np.zeros(events, bool), np.zeros(events),
+            np.ones(events), np.ones(rows, bool), np.ones(events, bool),
+            np.zeros(events, np.dtype(acc)))]
+        if batch > 1:
+            args = [jnp.broadcast_to(a, (batch,) + a.shape) for a in args]
+        out = entry(*args, p)
+        # block on one output: the warmup must include backend compile
+        np.asarray(out["smooth_rep"])

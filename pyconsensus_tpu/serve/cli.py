@@ -1,0 +1,144 @@
+"""``pyconsensus-serve`` — the serving layer's operational front door.
+
+The service is in-process (a network protocol is a deployment concern
+this library deliberately stays below), so the CLI's job is the
+OPERATIONAL loop around it: load a config file, warm the configured
+buckets, optionally drive a load-generation run against the live
+service, and write the metrics exposition — the artifacts an operator
+needs to size a deployment.
+
+Usage::
+
+    pyconsensus-serve --config serve.json --warmup-only
+    pyconsensus-serve --requests 200 --concurrency 16 \
+        --shapes 16x64,32x128 --metrics-out serve.prom
+    pyconsensus-serve --requests 100 --rate 50 --na-frac 0.1
+
+Exit code 0 iff every generated request succeeded (shed requests under
+an open-loop overload probe with ``--allow-shed`` keep 0 — shedding is
+the configured behavior there, not a failure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+__all__ = ["main"]
+
+
+def _parse_shapes(spec: str):
+    shapes = []
+    for part in spec.split(","):
+        r, e = part.lower().split("x")
+        shapes.append((int(r), int(e)))
+    return shapes
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pyconsensus-serve",
+        description="micro-batching consensus service: warmup preflight "
+                    "+ in-process load generation (docs/SERVING.md)")
+    ap.add_argument("--config", metavar="PATH",
+                    help="ServeConfig JSON (flags below override)")
+    ap.add_argument("--warmup-only", action="store_true",
+                    help="compile the configured buckets, print the "
+                         "cache summary, exit")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop workers (ignored with --rate)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--shapes", default="12x48,24x96",
+                    help="comma-separated RxE request shapes")
+    ap.add_argument("--na-frac", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--window-ms", type=float, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--rate-limit", type=float, default=None,
+                    help="per-tenant admission rate (req/s)")
+    ap.add_argument("--allow-shed", action="store_true",
+                    help="shed requests (PYC401) do not fail the run — "
+                         "the expected outcome of an overload probe")
+    ap.add_argument("--metrics-out", metavar="PATH",
+                    help="write the Prometheus exposition on exit")
+    args = ap.parse_args(argv)
+
+    from .. import obs
+    from .service import ConsensusService, ServeConfig
+
+    if args.config:
+        try:
+            cfg = ServeConfig.load(args.config)
+        except (OSError, ValueError) as exc:
+            ap.error(f"--config: {exc}")
+    else:
+        cfg = ServeConfig()
+    overrides = {}
+    if args.window_ms is not None:
+        overrides["batch_window_ms"] = float(args.window_ms)
+    if args.max_batch is not None:
+        overrides["max_batch"] = int(args.max_batch)
+    if args.rate_limit is not None:
+        overrides["rate_limit_rps"] = float(args.rate_limit)
+    if overrides:
+        cfg = ServeConfig.from_dict({**cfg.__dict__, **overrides})
+
+    try:
+        shapes = _parse_shapes(args.shapes)
+    except ValueError:
+        ap.error(f"--shapes: cannot parse {args.shapes!r} (want RxE,...)")
+
+    svc = ConsensusService(cfg)
+    warm = list(cfg.warmup) or svc.buckets_for(shapes)
+    n_warm = svc.warm_buckets(warm)
+    print(f"warmed {n_warm} bucket executable(s): "
+          f"{', '.join(f'{r}x{e}' for r, e in warm)}", file=sys.stderr)
+    if args.warmup_only:
+        print(json.dumps({
+            "warmed_buckets": n_warm,
+            "cache_size": len(svc.cache),
+            "retraces": obs.value("pyconsensus_jit_retraces_total",
+                                  entry="serve_bucket")}))
+        if args.metrics_out:
+            obs.write_prom(args.metrics_out, obs.REGISTRY)
+        return 0
+
+    from .loadgen import LoadGenerator
+
+    svc.start(warmup=False)
+    gen = LoadGenerator(svc, shapes=shapes, na_frac=args.na_frac,
+                        seed=args.seed)
+    if args.rate:
+        stats = gen.run_open(args.requests, args.rate)
+    else:
+        stats = gen.run_closed(args.requests, args.concurrency)
+    svc.close(drain=True)
+
+    stats["cache"] = {
+        "size": len(svc.cache),
+        "hit_ratio": svc.cache.hit_ratio(),
+        "retraces": obs.value("pyconsensus_jit_retraces_total",
+                              entry="serve_bucket"),
+    }
+    from .loadgen import mean_batch_occupancy
+
+    occ = mean_batch_occupancy()
+    if occ is not None:
+        stats["mean_batch_occupancy"] = round(occ, 3)
+    print(json.dumps(stats, indent=2))
+    if args.metrics_out:
+        obs.write_prom(args.metrics_out, obs.REGISTRY)
+        print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+
+    hard_failures = stats["failed"]
+    if args.allow_shed:
+        hard_failures -= stats["errors"].get("PYC401", 0)
+    return 0 if hard_failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,410 @@
+"""The padded bucket entry point: one compiled executable per shape
+bucket, bit-identical to direct resolution.
+
+A serving workload presents a stream of (R, E) report matrices whose
+shapes vary request to request; compiling ``consensus_light_jit`` per
+exact shape would pay a multi-second retrace on every new market size.
+The batcher instead pads every request up to a configured shape bucket
+(powers of two on both axes) and dispatches through THIS kernel — one
+executable per (bucket, params), warmed before traffic.
+
+The guarantee (pinned by tests on both backends, docs/SERVING.md):
+
+- **discrete answers are exact**: catch-snapped outcomes
+  (``outcomes_adjusted`` / ``outcomes_final``) and iteration counts are
+  bit-identical to a direct ``Oracle`` resolution of the unpadded
+  matrix, for every configured bucket — backed by the catch/median/
+  dirfix tie bands, which make every snap decision reduction-order
+  stable;
+- **serving determinism**: a given request produces bit-identical FULL
+  results on every dispatch — the bucket choice is a deterministic
+  function of its shape, each (bucket, params) key maps to one fixed
+  executable, and vmapped batch lanes are pure functions of their own
+  inputs — so answers never depend on traffic shape or co-batched
+  requests;
+- **continuous tails** (reputations, certainty, bonuses) match direct
+  resolution to ≤ 1e-9 (measured ≤ 3e-10 over the fuzz corpus). They
+  are NOT bit-identical: XLA's reduction tilings are shape- and
+  fusion-dependent, so two different compiled graphs — even at
+  identical logical shapes — may associate the same f64 sums
+  differently by an ulp, and no padding construction can undo that
+  (measured: exact-fit buckets drift without any padding at all).
+
+Padding is nonetheless constructed so every padded contribution is
+EXACTLY zero (or an exact reduction identity) rather than corrected
+afterwards — that is what keeps the drift at ulp scale and the snap
+decisions inside the tie bands:
+
+- **pad rows** (reporters): reputation 0, reports NaN in real columns —
+  absent from the fill means (0-weight), zero rows of the centered
+  scoring operand (``rep * t`` with rep = 0), +inf/0-weight entries
+  sorted LAST in the weighted median (the existing absent-entry rule,
+  exact by construction). Their scores are garbage, so the scorer masks
+  them to 0 before the direction-fix statistics — the same contract as
+  ``jax_kernels.sztorc_scores_power_fused``'s ``n_rows`` slicing.
+- **pad events** (columns): all-PRESENT constant 0.0 — the filled
+  column is exactly zero, its weighted mean is exactly zero, so the
+  centered deviation column is exactly zero and it contributes exact
+  zeros to every event-axis contraction (Gram products, score matvecs,
+  direction-fix distances). NaN padding would NOT work here: an all-NaN
+  column fills with the 0.5 guard whose rep-weighted mean is 0.5 ±
+  normalization ulps, leaving a ~1e-17 deviation column that poisons
+  the spectrum.
+- **power seed**: threefry draws are not prefix-stable across lengths,
+  so the TRUE-width ``_power_seed(E)`` is computed host-side and passed
+  in zero-extended (``fused_sharded._seed_placed`` precedent) — the
+  padded cold start is bitwise the direct cold start.
+- **cross-column aggregates** (consensus reward normalization, NA
+  bonuses, percent_na, avg_certainty) are recomputed against the
+  validity masks; each masked reduction sees the direct reduction's
+  operands plus exact zeros.
+
+Scope: ``algorithm="sztorc"`` with ``pca_method="power"`` — the one
+scorer whose arithmetic is shape-stable under padding (eigh factors a
+DIFFERENT-size matrix when either axis pads, losing even the exact-
+arithmetic equivalence; the service resolves ``"auto"`` to ``"power"``
+for bucketed dispatch and routes every other algorithm/method to the
+direct per-shape path, which runs the same graph as ``Oracle`` and is
+trivially bit-identical to it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import obs
+from ..models.pipeline import ConsensusParams
+from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
+
+__all__ = ["padded_consensus", "make_bucket_executable", "bucket_inputs",
+           "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS"]
+
+#: algorithms the padded bucket kernel scores (see module docstring);
+#: everything else takes the direct per-shape dispatch path
+SERVE_ALGORITHMS = ("sztorc",)
+
+
+def bucket_path_eligible(algorithm: str, pca_method: str, any_scaled: bool,
+                         has_na: bool, storage_dtype: str) -> bool:
+    """Whether a request may ride the padded bucket kernel (the ONE copy
+    of the routing rule, shared by the batcher and the tests): sztorc
+    with a power-family scorer (the one scorer whose arithmetic is
+    shape-stable under padding — eigh factors a different-size matrix
+    per bucket), and not int8 sentinel storage (that encoding needs the
+    fused NaN-threaded path). Everything else takes the direct per-shape
+    dispatch path, which runs the same graph as ``Oracle`` and is
+    therefore trivially bit-identical to it."""
+    return (algorithm in SERVE_ALGORITHMS
+            and pca_method in ("auto", "power")
+            and storage_dtype != "int8")
+
+
+def _masked_power_scores(filled, rep_k, row_valid, seed, v_init,
+                         p: ConsensusParams):
+    """The full masked scoring step (``jk._first_pc_power`` + masked
+    direction fix) with warm start ``v_init`` (zeros = cold, like the
+    direct scan): identical arithmetic to the unpadded path — every
+    reduction sees the direct operands plus exact zeros (or exact
+    min/max identities), and pad-row scores are zeroed before the
+    direction-fix statistics. ``seed`` is the injected true-width power
+    start (module docstring). Returns ``(adj_scores, loading)``."""
+    acc = rep_k.dtype
+    mu, denom = jk._mu_denom(filled, rep_k)
+    mm = jk.matvec_narrow(filled, p.matvec_dtype)
+
+    def apply_cov(v):
+        t = jnp.matmul(mm, v.astype(mm.dtype),
+                       preferred_element_type=acc) - mu @ v
+        rt = rep_k * t
+        y = (jnp.matmul(mm.T, rt.astype(mm.dtype),
+                        preferred_element_type=acc)
+             - mu * jnp.sum(rt))
+        return y / denom
+
+    loading, _ = jk._power_loop(apply_cov, filled.shape[1], acc,
+                                p.power_iters, p.power_tol,
+                                v_init=v_init, base=seed)
+    scores = (jnp.matmul(filled, loading.astype(filled.dtype),
+                         preferred_element_type=acc) - mu @ loading)
+    # pad rows project to -mu.loading garbage — zero them BEFORE the
+    # direction-fix statistics (sztorc_scores_power_fused's n_rows rule)
+    scores = jnp.where(row_valid, scores, 0.0)
+    adj = _masked_dirfix(scores, filled, rep_k, row_valid)
+    return adj, loading
+
+
+def _masked_dirfix(scores, filled, rep_k, row_valid):
+    """``jk.direction_fixed_scores`` with pad rows excluded: min/max run
+    over ±inf identities, the candidate sets are re-zeroed on pad rows so
+    the normalize sums and the stacked projection see exact zeros."""
+    acc = scores.dtype
+    scores = jk.canon_sign(scores)               # pads are 0: argmax safe
+    a1 = jnp.abs(jnp.min(jnp.where(row_valid, scores, jnp.inf)))
+    a2 = jnp.max(jnp.where(row_valid, scores, -jnp.inf))
+    set1 = jnp.where(row_valid, scores + a1, 0.0)
+    set2 = jnp.where(row_valid, scores - a2, 0.0)
+    W = jnp.stack([rep_k.astype(acc), jk.normalize(set1),
+                   jk.normalize(set2)])
+    M = jnp.matmul(W.astype(filled.dtype), filled,
+                   preferred_element_type=acc)
+    old, new1, new2 = M[0], M[1], M[2]
+    d1 = jnp.sum((new1 - old) ** 2)              # pad cols: exact zeros
+    d2 = jnp.sum((new2 - old) ** 2)
+    return jnp.where(d1 - d2 <= nk.DIRFIX_TIE_ATOL * (d1 + d2),
+                     set1, -set2)
+
+
+def _masked_row_reward(adj, rep_k, n_rows_f):
+    """``jk.row_reward_weighted`` with the mean taken over the TRUE
+    reporter count (``jnp.mean`` would divide by the bucket height)."""
+    degenerate = jnp.max(jnp.abs(adj)) == 0.0
+    mean_rep = jnp.sum(rep_k) / n_rows_f
+    candidate = jk.normalize(adj * (rep_k / mean_rep))
+    return jnp.where(degenerate, rep_k, candidate)
+
+
+def _masked_bonuses(present, filled, rep_f, outcomes_adjusted, scaled,
+                    tolerance, row_valid, col_valid, n_rows_f, n_cols_f,
+                    p: ConsensusParams):
+    """``jk.certainty_and_bonuses`` with every cross-column/cross-row
+    aggregate recomputed against the validity masks. Per-element outputs
+    keep bucket width (the caller slices); the masked sums equal the
+    direct sums because pad contributions are forced to exact zero."""
+    dtype = rep_f.dtype
+    # shared head (both branches): the agreement matrix and the masked
+    # certainty chain — pad columns report full agreement (zero-filled
+    # vs zero-snapped outcome), so certainty is re-zeroed on them before
+    # the aggregate sums
+    agree = jnp.where(
+        scaled[None, :],
+        jnp.abs(filled.astype(dtype)
+                - outcomes_adjusted[None, :]) <= tolerance,
+        filled.astype(dtype) == outcomes_adjusted[None, :])
+    certainty = jnp.sum(agree * rep_f[:, None], axis=0)
+    certainty = jnp.where(col_valid, certainty, 0.0)
+    consensus_reward = jk.normalize(certainty)
+    avg_certainty = jnp.sum(certainty) / n_cols_f
+    if p.has_na:
+        na_mat = (~present).astype(dtype)
+        participation_columns = 1.0 - rep_f @ na_mat
+        # pad rows are all-NaN in real columns; their na row would drag
+        # a garbage (but finite) participation entry into the normalize
+        participation_rows = jnp.where(
+            row_valid, 1.0 - na_mat @ consensus_reward, 0.0)
+        pc_masked = jnp.where(col_valid, participation_columns, 0.0)
+        percent_na = 1.0 - jnp.sum(pc_masked) / n_cols_f
+        na_bonus_rows = jk.normalize(participation_rows)
+        reporter_bonus = (na_bonus_rows * percent_na
+                          + rep_f * (1.0 - percent_na))
+        na_bonus_cols = jk.normalize(pc_masked)
+        author_bonus = (na_bonus_cols * percent_na
+                        + consensus_reward * (1.0 - percent_na))
+        na_row = jk.row_any(~present, dtype)
+    else:
+        # dense request, rows exact-fit (has_na=False implies no row
+        # padding — bucket_inputs sets has_na whenever rows pad): the
+        # direct closed forms, masked where they aggregate over events
+        R_b, E_b = filled.shape
+        participation_columns = jnp.ones((E_b,), dtype=dtype)
+        participation_rows = jnp.ones((R_b,), dtype=dtype)
+        percent_na = jnp.asarray(0.0, dtype=dtype)
+        na_bonus_rows = jnp.full((R_b,), 1.0, dtype) / n_rows_f
+        reporter_bonus = rep_f
+        na_bonus_cols = jnp.full((E_b,), 1.0, dtype) / n_cols_f
+        author_bonus = consensus_reward
+        na_row = jnp.zeros((R_b,), dtype=bool)
+    return {
+        "certainty": certainty,
+        "consensus_reward": consensus_reward,
+        "avg_certainty": avg_certainty,
+        "participation_columns": participation_columns,
+        "participation_rows": participation_rows,
+        "percent_na": percent_na,
+        "na_bonus_rows": na_bonus_rows,
+        "reporter_bonus": reporter_bonus,
+        "na_bonus_cols": na_bonus_cols,
+        "author_bonus": author_bonus,
+        "na_row": na_row,
+    }
+
+
+def padded_consensus(reports, reputation, scaled, mins, maxs, row_valid,
+                     col_valid, seed, p: ConsensusParams):
+    """The bucket-shaped light pipeline: ``_consensus_core_light``'s data
+    flow with validity masking at the decision points. All array inputs
+    are bucket-shaped (see :func:`bucket_inputs`); the flat result dict
+    is bucket-shaped too — :func:`slice_result` trims it. Static
+    ``p.has_na`` must be True whenever rows pad (pad rows are NaN)."""
+    if p.algorithm not in SERVE_ALGORITHMS:
+        raise ValueError(
+            f"the padded bucket kernel scores {SERVE_ALGORITHMS} only "
+            f"(shape-stable power iteration); algorithm={p.algorithm!r} "
+            f"must take the direct dispatch path")
+    if p.pca_method != "power":
+        raise ValueError(
+            f"the padded bucket kernel requires pca_method='power' (eigh "
+            f"factors a different-size matrix per bucket and cannot be "
+            f"bit-identical across them), got {p.pca_method!r}")
+    if p.storage_dtype == "int8":
+        raise ValueError(
+            "storage_dtype='int8' requires the fused NaN-threaded path; "
+            "the bucket kernel stores the interpolated matrix "
+            "(use '' or 'bfloat16')")
+    n_rows_f = jnp.sum(row_valid.astype(reputation.dtype))
+    n_cols_f = jnp.sum(col_valid.astype(reputation.dtype))
+    old_rep = jk.normalize(reputation)
+    rescaled = (jk.rescale(reports, scaled, mins, maxs) if p.any_scaled
+                else reports)
+    if p.has_na:
+        filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
+                                                p.catch_tolerance)
+    else:
+        filled, present = rescaled, None
+    if p.storage_dtype:
+        filled = filled.astype(jnp.dtype(p.storage_dtype))
+
+    E_b = filled.shape[1]
+
+    def step(carry, _):
+        rep_c, this_prev, loading_prev, converged, iters = carry
+        adj, loading = _masked_power_scores(
+            filled, rep_c, row_valid, seed, loading_prev, p)
+        this_rep = _masked_row_reward(adj, rep_c, n_rows_f)
+        new_rep = jk.smooth(this_rep, rep_c, p.alpha)
+        delta = jnp.max(jnp.abs(new_rep - rep_c))
+        rep_out = jnp.where(converged, rep_c, new_rep)
+        this_out = jnp.where(converged, this_prev, this_rep)
+        loading_out = jnp.where(converged, loading_prev, loading)
+        iters_out = jnp.where(converged, iters, iters + 1)
+        conv_out = converged | (delta <= p.convergence_tolerance)
+        return (rep_out, this_out, loading_out, conv_out, iters_out), None
+
+    init = (old_rep, old_rep, jnp.zeros((E_b,), dtype=old_rep.dtype),
+            jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+    (rep, this_rep, loading, converged, iters), _ = lax.scan(
+        step, init, None, length=max(p.max_iterations, 1))
+
+    outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
+        present, filled, rep, scaled, p.catch_tolerance,
+        any_scaled=p.any_scaled, has_na=p.has_na,
+        median_block=p.median_block, n_scaled=p.n_scaled)
+    outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins,
+                                          maxs)
+                      if p.any_scaled else outcomes_adjusted)
+    extras = _masked_bonuses(present, filled, rep, outcomes_adjusted,
+                             scaled, p.catch_tolerance, row_valid,
+                             col_valid, n_rows_f, n_cols_f, p)
+    result = {
+        "old_rep": old_rep,
+        "this_rep": this_rep,
+        "smooth_rep": rep,
+        "outcomes_raw": outcomes_raw,
+        "outcomes_adjusted": outcomes_adjusted,
+        "outcomes_final": outcomes_final,
+        "iterations": iters,
+        "convergence": converged,
+        "first_loading": jk.canon_sign(loading),
+    }
+    result.update(extras)
+    return result
+
+
+def make_bucket_executable(p: ConsensusParams, batched: bool = False):
+    """A FRESH jitted executable for one (params[, batch]) cache entry —
+    its compile cache is private, so evicting the entry from the serve
+    cache actually frees the executable. Instrumented under the shared
+    ``serve_bucket`` entry label: after warmup the retrace counter equals
+    the number of compiled buckets and must stay there under steady
+    traffic (the runtime CL304 invariant the CI smoke pins)."""
+    if batched:
+        def fn(reports, reputation, scaled, mins, maxs, row_valid,
+               col_valid, seed, p):
+            return jax.vmap(
+                functools.partial(jk.exact_matmuls(padded_consensus), p=p)
+            )(reports, reputation, scaled, mins, maxs, row_valid,
+              col_valid, seed)
+    else:
+        fn = jk.exact_matmuls(padded_consensus)
+    return obs.instrument_jit(
+        jax.jit(fn, static_argnames=("p",)), "serve_bucket")
+
+
+def bucket_inputs(reports, reputation, scaled, mins, maxs,
+                  bucket_rows: int, bucket_events: int,
+                  has_na: bool = None):
+    """Pad host arrays to the bucket shape per the module contract.
+    Returns ``(reports', reputation', scaled', mins', maxs', row_valid,
+    col_valid, seed)`` as host numpy arrays ready for device dispatch.
+    ``reports`` must be float (R, E) with NaN non-reports; ``reputation``
+    the unnormalized prior (the kernel normalizes, like ``Oracle``).
+
+    ``has_na`` (default: derived from the data) picks the pad-row
+    encoding: NaN rows (absent, 0-weight) when the pipeline runs the NA
+    fill anyway, but PRESENT zero rows for a dense request — so the
+    kernel can keep ``p.has_na=False`` and compile the same elided-fill
+    arithmetic as the direct path (the static hint changes which exact
+    reduction computes the outcome means, so it must MATCH the direct
+    resolution, not just be semantically equivalent). Present zero rows
+    are exact: zero reputation zeroes them out of every contraction."""
+    reports = np.asarray(reports, dtype=np.float64)
+    R, E = reports.shape
+    if has_na is None:
+        has_na = bool(np.isnan(reports).any())
+    if not (R <= bucket_rows and E <= bucket_events):
+        raise ValueError(f"shape {(R, E)} exceeds bucket "
+                         f"{(bucket_rows, bucket_events)}")
+    pr, pe = bucket_rows - R, bucket_events - E
+    # pad rows: NaN in real columns (absent, 0-weight) on the NA path,
+    # present zeros on the dense path; pad columns: present zeros
+    # everywhere (exactly-zero deviation columns)
+    padded = np.full((bucket_rows, bucket_events), 0.0, dtype=np.float64)
+    padded[:R, :E] = reports
+    if pr and has_na:
+        padded[R:, :E] = np.nan
+    rep = np.zeros(bucket_rows, dtype=np.float64)
+    rep[:R] = np.asarray(reputation, dtype=np.float64)
+    sc = np.zeros(bucket_events, dtype=bool)
+    sc[:E] = np.asarray(scaled, dtype=bool)
+    mn = np.zeros(bucket_events, dtype=np.float64)
+    mn[:E] = np.asarray(mins, dtype=np.float64)
+    mx = np.ones(bucket_events, dtype=np.float64)
+    mx[:E] = np.asarray(maxs, dtype=np.float64)
+    row_valid = np.zeros(bucket_rows, dtype=bool)
+    row_valid[:R] = True
+    col_valid = np.zeros(bucket_events, dtype=bool)
+    col_valid[:E] = True
+    # the TRUE-width power seed, zero-extended (threefry draws are not
+    # prefix-stable across lengths — module docstring)
+    acc = jnp.asarray(0.0).dtype
+    seed = np.zeros(bucket_events, dtype=np.dtype(acc))
+    seed[:E] = np.asarray(jk._power_seed(E, acc))
+    return padded, rep, sc, mn, mx, row_valid, col_valid, seed
+
+
+#: result keys sliced on the row axis / event axis when trimming a
+#: bucket-shaped result back to the request's true shape
+_ROW_KEYS = ("old_rep", "this_rep", "smooth_rep", "na_row",
+             "participation_rows", "na_bonus_rows", "reporter_bonus")
+_COL_KEYS = ("outcomes_raw", "outcomes_adjusted", "outcomes_final",
+             "certainty", "consensus_reward", "participation_columns",
+             "na_bonus_cols", "author_bonus", "first_loading")
+
+
+def slice_result(raw: dict, n_rows: int, n_cols: int) -> dict:
+    """Trim a bucket-shaped flat result to the request's true (R, E) —
+    host-side, after the fetch."""
+    out = {}
+    for k, v in raw.items():
+        v = np.asarray(v)
+        if k in _ROW_KEYS:
+            v = v[..., :n_rows]
+        elif k in _COL_KEYS:
+            v = v[..., :n_cols]
+        out[k] = v
+    return out

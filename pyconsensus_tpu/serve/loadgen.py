@@ -1,0 +1,235 @@
+"""Closed- and open-loop load generator for the consensus service
+(ISSUE 5 front door; reachable as ``tools/loadgen.py`` from a checkout,
+used by ``pyconsensus-serve``, the bench ``serve`` block, and the CI
+serve smoke).
+
+Closed loop: ``concurrency`` workers each keep exactly one request in
+flight — the steady-state throughput probe (offered load adapts to
+service speed, so the queue never grows without bound and the numbers
+measure the pipeline, not a backlog). Open loop: requests arrive on a
+fixed schedule regardless of completions — the overload probe (offered
+load is the independent variable, so shed rates mean something).
+
+Pure library + ``python tools/loadgen.py`` CLI; no dependency beyond
+the package itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy"]
+
+
+def mean_batch_occupancy():
+    """Mean requests per bucketed dispatch since the last ``obs.reset``
+    (None before any dispatch) — read from the
+    ``pyconsensus_serve_batch_occupancy`` histogram. The ONE copy of
+    the registry-schema-dependent extraction, shared by the CLI
+    summary, the bench ``serve`` block, and the CI smoke."""
+    from .. import obs
+
+    series = obs.REGISTRY.snapshot().get(
+        "pyconsensus_serve_batch_occupancy", {}).get("series", {})
+    if not series:
+        return None
+    ser = next(iter(series.values()))
+    return ser["sum"] / ser["count"] if ser["count"] else None
+
+
+def _quantile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(latencies, errors, wall_s: float, n_requests: int) -> dict:
+    """The shared stats block: throughput + latency quantiles + error
+    counts (stable keys — the bench JSON embeds this verbatim)."""
+    lat = sorted(latencies)
+    return {
+        "requests": int(n_requests),
+        "succeeded": len(lat),
+        "failed": int(sum(errors.values())),
+        "errors": dict(errors),
+        "wall_s": round(wall_s, 4),
+        "throughput_rps": round(len(lat) / wall_s, 4) if wall_s > 0 else None,
+        "latency_p50_ms": (None if not lat
+                           else round(1e3 * _quantile(lat, 0.50), 3)),
+        "latency_p99_ms": (None if not lat
+                           else round(1e3 * _quantile(lat, 0.99), 3)),
+        "latency_max_ms": (None if not lat
+                           else round(1e3 * lat[-1], 3)),
+    }
+
+
+class LoadGenerator:
+    """Drives a :class:`~pyconsensus_tpu.serve.ConsensusService`.
+
+    Parameters
+    ----------
+    service : ConsensusService
+    shapes : sequence of (R, E)
+        Request shapes, cycled per request (>= 2 distinct bucket targets
+        exercise the cache the way real mixed traffic does).
+    na_frac : float
+        NaN non-report fraction of the synthetic matrices.
+    seed : int
+        Matrix-corpus seed — the corpus is generated once up front so
+        generation cost never pollutes the latency numbers.
+    oracle_kwargs : dict
+        Forwarded to every ``submit`` (algorithm, iterations, ...).
+    """
+
+    def __init__(self, service, shapes=((12, 48), (24, 96)),
+                 na_frac: float = 0.1, seed: int = 0,
+                 tenant: str = "loadgen", oracle_kwargs=None) -> None:
+        self.service = service
+        self.shapes = [tuple(s) for s in shapes]
+        self.tenant = tenant
+        self.oracle_kwargs = dict(oracle_kwargs or {})
+        rng = np.random.default_rng(seed)
+        self._corpus = []
+        for R, E in self.shapes:
+            m = rng.choice([0.0, 1.0], size=(R, E))
+            if na_frac > 0:
+                m[rng.random((R, E)) < na_frac] = np.nan
+            self._corpus.append(m)
+
+    def _submit(self, i: int):
+        return self.service.submit(
+            reports=self._corpus[i % len(self._corpus)],
+            tenant=self.tenant, **self.oracle_kwargs)
+
+    # -- closed loop ----------------------------------------------------
+
+    def run_closed(self, n_requests: int, concurrency: int = 8,
+                   timeout_s: float = 120.0) -> dict:
+        """``concurrency`` workers, one request in flight each, until
+        ``n_requests`` have been issued. Returns the summary dict."""
+        lock = threading.Lock()
+        counter = [0]
+        latencies: list = []
+        errors: dict = {}
+
+        def worker():
+            while True:
+                with lock:
+                    if counter[0] >= n_requests:
+                        return
+                    i = counter[0]
+                    counter[0] += 1
+                t0 = time.monotonic()
+                try:
+                    fut = self._submit(i)
+                    fut.result(timeout=timeout_s)
+                except Exception as exc:  # noqa: BLE001 — tallied, not raised
+                    name = getattr(exc, "error_code",
+                                   type(exc).__name__)
+                    with lock:
+                        errors[name] = errors.get(name, 0) + 1
+                else:
+                    with lock:
+                        latencies.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, concurrency))]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return summarize(latencies, errors, time.monotonic() - t0,
+                         n_requests)
+
+    # -- open loop ------------------------------------------------------
+
+    def run_open(self, n_requests: int, rate_rps: float,
+                 timeout_s: float = 120.0) -> dict:
+        """Fixed-schedule arrivals at ``rate_rps`` regardless of
+        completions — admission errors (``ServiceOverloadError``) are
+        tallied per error code, which is the point of the probe."""
+        if rate_rps <= 0:
+            raise ValueError("rate_rps must be positive")
+        lock = threading.Lock()
+        latencies: list = []
+        errors: dict = {}
+        futures: list = []
+        interval = 1.0 / rate_rps
+        t0 = time.monotonic()
+        for i in range(n_requests):
+            target = t0 + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            start = time.monotonic()
+            try:
+                fut = self._submit(i)
+            except Exception as exc:  # noqa: BLE001 — shed at admission
+                name = getattr(exc, "error_code", type(exc).__name__)
+                with lock:
+                    errors[name] = errors.get(name, 0) + 1
+                continue
+            futures.append((start, fut))
+        for start, fut in futures:
+            try:
+                fut.result(timeout=timeout_s)
+            except Exception as exc:  # noqa: BLE001
+                name = getattr(exc, "error_code", type(exc).__name__)
+                with lock:
+                    errors[name] = errors.get(name, 0) + 1
+            else:
+                with lock:
+                    latencies.append(time.monotonic() - start)
+        return summarize(latencies, errors, time.monotonic() - t0,
+                         n_requests)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+    ap = argparse.ArgumentParser(
+        description="load-generate an in-process consensus service")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop workers (ignored with --rate)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate (req/s); omit for "
+                         "closed loop")
+    ap.add_argument("--shapes", default="12x48,24x96",
+                    help="comma-separated RxE request shapes")
+    ap.add_argument("--na-frac", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--window-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    shapes = [tuple(int(x) for x in s.split("x"))
+              for s in args.shapes.split(",")]
+    cfg = ServeConfig(batch_window_ms=args.window_ms,
+                      max_batch=args.max_batch)
+    svc = ConsensusService(cfg)
+    gen = LoadGenerator(svc, shapes=shapes, na_frac=args.na_frac,
+                        seed=args.seed)
+    if not args.no_warmup:
+        svc.warm_buckets(svc.buckets_for(shapes))
+    svc.start(warmup=False)
+    if args.rate:
+        stats = gen.run_open(args.requests, args.rate)
+    else:
+        stats = gen.run_closed(args.requests, args.concurrency)
+    svc.close(drain=True)
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,317 @@
+"""``ConsensusService`` — the in-process micro-batching consensus
+server (the serve tentpole's front door).
+
+Wires the subsystem together: admission control → bounded queue →
+micro-batcher → shape-bucketed executable cache, with named market
+sessions on the side. Concurrent callers ``submit`` resolutions and get
+``concurrent.futures.Future``\\ s back; the batcher thread coalesces
+compatible requests into padded bucket dispatches (``kernels``'s
+determinism contract) and everything is instrumented end to end
+(queue-depth gauge, batch-occupancy histogram, request-latency
+histogram, cache hit/miss/evict counters — catalog in
+docs/OBSERVABILITY.md; overload semantics in docs/SERVING.md).
+
+Quick use::
+
+    from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+    with ConsensusService(ServeConfig(warmup=((16, 64), (32, 128)))) as svc:
+        fut = svc.submit(reports=matrix)          # returns a Future
+        result = fut.result(timeout=30)           # Oracle-shaped dict
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..faults import InputError, ServiceOverloadError
+from ..faults import degrade as _degrade
+from ..faults import plan as _faults
+from ..models.pipeline import ConsensusParams
+from ..ops import jax_kernels as jk
+from ..oracle import ALGORITHMS, BACKENDS, parse_event_bounds
+from .admission import AdmissionController
+from .batcher import Microbatcher
+from .cache import BucketKey, ExecutableCache
+from .kernels import bucket_path_eligible
+from .queue import RequestQueue, ResolveRequest
+from .session import SessionStore
+
+__all__ = ["ServeConfig", "ConsensusService"]
+
+#: oracle_kwargs that participate in the static ConsensusParams of a
+#: bucketed dispatch (everything else forces the direct path)
+_BUCKET_KWARGS = ("alpha", "catch_tolerance", "max_iterations",
+                  "convergence_tolerance", "power_iters", "power_tol",
+                  "matvec_dtype", "storage_dtype")
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Service policy. JSON-loadable (``ServeConfig.load``) so a
+    deployment is a config file, not code."""
+
+    #: shape-bucket ladders (powers of two by default); a request maps
+    #: to the smallest (rows, events) bucket that fits, or to the
+    #: direct path when it exceeds both ladders
+    row_buckets: tuple = (8, 16, 32, 64, 128, 256, 512, 1024)
+    event_buckets: tuple = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+    #: bounded queue depth — the overload backstop
+    max_queue: int = 256
+    #: coalescing window (ms) the batcher holds a fresh batch open
+    batch_window_ms: float = 2.0
+    #: batch capacity: every bucketed dispatch runs this many lanes
+    #: (fixed — the determinism contract; 1 disables batching)
+    max_batch: int = 8
+    #: default per-request shed deadline (ms; None = no deadline)
+    default_deadline_ms: Optional[float] = 30_000.0
+    #: per-tenant token-bucket rate (req/s; 0 disables rate limiting)
+    rate_limit_rps: float = 0.0
+    rate_burst: float = 0.0
+    #: LRU capacity of the bucket-executable cache
+    cache_capacity: int = 32
+    #: (rows, events) bucket shapes compiled before traffic (with the
+    #: default serving params, has_na=True)
+    warmup: tuple = ()
+    #: default compute backend for requests that do not name one
+    backend: str = "jax"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise InputError(f"unknown serve config keys "
+                             f"{sorted(unknown)}")
+        d = dict(d)
+        for key in ("row_buckets", "event_buckets"):
+            if key in d:
+                d[key] = tuple(int(x) for x in d[key])
+        if "warmup" in d:
+            d["warmup"] = tuple((int(r), int(e)) for r, e in d["warmup"])
+        return cls(**d)
+
+    @classmethod
+    def load(cls, path) -> "ServeConfig":
+        return cls.from_dict(json.loads(pathlib.Path(path).read_text()))
+
+
+class ConsensusService:
+    """See the module docstring. Thread-safe front door; one batcher
+    thread owns device dispatch."""
+
+    def __init__(self, config: Optional[ServeConfig] = None) -> None:
+        self.config = config or ServeConfig()
+        if sorted(self.config.row_buckets) != list(self.config.row_buckets) \
+                or sorted(self.config.event_buckets) != list(
+                    self.config.event_buckets):
+            raise InputError("bucket ladders must be ascending")
+        if self.config.max_batch < 1:
+            raise InputError("max_batch must be >= 1")
+        self.queue = RequestQueue(self.config.max_queue)
+        self.cache = ExecutableCache(self.config.cache_capacity)
+        self.admission = AdmissionController(self.config.rate_limit_rps,
+                                             self.config.rate_burst)
+        self.sessions = SessionStore()
+        self.batcher = Microbatcher(self.queue, self.cache, self.config,
+                                    self.sessions, self.admission)
+        self._started = False
+        self._start_lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "ConsensusService":
+        # check-then-act under a lock: two concurrent first submits must
+        # not each spawn a batcher thread (single-threaded dispatch is
+        # the determinism/occupancy contract)
+        with self._start_lock:
+            if not self._started:
+                if warmup and self.config.warmup:
+                    self.warm_buckets()
+                self.batcher.start()
+                self._started = True
+        return self
+
+    def __enter__(self) -> "ConsensusService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    def warm_buckets(self, shapes=None, **oracle_kwargs) -> int:
+        """Compile the configured (or given) bucket shapes before
+        accepting traffic — the ``--warmup`` preflight. Returns the
+        number of executables compiled."""
+        n = 0
+        for rows, events in (shapes or self.config.warmup):
+            key = self._bucket_key((rows, events), has_na=True,
+                                   any_scaled=False, n_scaled=0,
+                                   oracle_kwargs=oracle_kwargs)
+            with obs.span("serve.warmup", bucket=f"{rows}x{events}"):
+                self.cache.warm(key)
+            n += 1
+        return n
+
+    def drain(self, timeout: Optional[float] = 60.0) -> None:
+        """Graceful shutdown: refuse new work, finish everything
+        queued, stop the batcher."""
+        self.admission.start_drain()
+        self.queue.close()
+        self.batcher.join(timeout)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 60.0) -> None:
+        if drain:
+            self.drain(timeout)
+            return
+        self.admission.start_drain()
+        self.queue.close()
+        for req in self.queue.drain_pending():
+            self.admission.record_shed("draining")
+            req.shed("draining")
+        self.batcher.join(timeout)
+
+    # -- request derivation --------------------------------------------
+
+    def _pick_bucket(self, R: int, E: int):
+        rb = next((b for b in self.config.row_buckets if b >= R), None)
+        eb = next((b for b in self.config.event_buckets if b >= E), None)
+        return None if rb is None or eb is None else (rb, eb)
+
+    def buckets_for(self, shapes) -> list:
+        """The distinct ladder buckets a set of (R, E) request shapes
+        map to, sorted — the warmup list a deployment serving those
+        shapes should configure (shapes beyond the ladders are skipped:
+        they dispatch direct and compile nothing bucketed). The shared
+        helper behind the CLI/loadgen/bench warmup preflights."""
+        return sorted({b for b in (self._pick_bucket(*s) for s in shapes)
+                       if b is not None})
+
+    def _bucket_key(self, bucket, has_na, any_scaled, n_scaled,
+                    oracle_kwargs) -> BucketKey:
+        p = ConsensusParams(
+            algorithm="sztorc", pca_method="power", has_na=has_na,
+            any_scaled=any_scaled, n_scaled=n_scaled,
+            **{k: v for k, v in oracle_kwargs.items()
+               if k in _BUCKET_KWARGS})
+        return BucketKey.make(bucket[0], bucket[1],
+                              self.config.max_batch, p)
+
+    def _derive(self, req: ResolveRequest, oracle_kwargs: dict) -> None:
+        """Classify and prepare a matrix request: validate, quarantine
+        ±Inf rows (the Oracle front-door contract), parse bounds, pick
+        the dispatch path and batch key."""
+        reports = np.asarray(req.reports, dtype=np.float64)
+        if reports.ndim != 2 or reports.size == 0:
+            raise InputError(
+                f"reports must be a non-empty 2-D matrix, got shape "
+                f"{reports.shape}", shape=tuple(reports.shape))
+        R, E = reports.shape
+        scaled, mins, maxs = parse_event_bounds(req.event_bounds, E)
+        reports, quarantined, has_na = _degrade.quarantine_nonfinite(
+            reports)
+        req.quarantined_rows = (np.array([], dtype=np.int64)
+                                if quarantined is None
+                                else np.asarray(quarantined))
+        if req.reputation is None:
+            req.reputation = np.full(R, 1.0 / R)
+        else:
+            rep = np.asarray(req.reputation, dtype=np.float64)
+            if rep.shape != (R,):
+                raise InputError(f"reputation shape {rep.shape} does "
+                                 f"not match {R} reporters")
+            req.reputation = rep
+        req.reports = reports
+        req.shape = (R, E)
+        req.scaled, req.mins, req.maxs = scaled, mins, maxs
+
+        algorithm = oracle_kwargs.get("algorithm", "sztorc")
+        pca_method = oracle_kwargs.get("pca_method", "auto")
+        if algorithm not in ALGORITHMS:
+            raise InputError(f"unknown algorithm {algorithm!r}")
+        bucket = self._pick_bucket(R, E)
+        eligible = (req.backend == "jax" and bucket is not None
+                    and req.session is None
+                    and bucket_path_eligible(
+                        algorithm, pca_method, bool(scaled.any()),
+                        has_na, oracle_kwargs.get("storage_dtype", ""))
+                    and not set(oracle_kwargs)
+                    - set(_BUCKET_KWARGS) - {"algorithm", "pca_method"})
+        if not eligible:
+            req.dispatch_path = "direct"
+            return
+        rows_pad = bucket[0] > R
+        eff_has_na = has_na or rows_pad
+        n_sc = int(scaled.sum())
+        key = self._bucket_key(
+            bucket, has_na=eff_has_na, any_scaled=bool(scaled.any()),
+            n_scaled=n_sc if jk.gather_median_pays(n_sc, E) else 0,
+            oracle_kwargs=oracle_kwargs)
+        req.dispatch_path = "bucket"
+        req.bucket = bucket
+        req.params = key.params
+        req.batch_key = key
+
+    # -- the front door -------------------------------------------------
+
+    def submit(self, reports=None, event_bounds=None, reputation=None,
+               session: Optional[str] = None, tenant: str = "default",
+               deadline_ms: Optional[float] = None, backend=None,
+               **oracle_kwargs):
+        """Enqueue one resolution; returns a
+        ``concurrent.futures.Future`` resolving to the Oracle-shaped
+        nested result dict. Raises :class:`ServiceOverloadError`
+        (PYC401) synchronously when admission refuses the request;
+        input validation errors raise synchronously too."""
+        if (reports is None) == (session is None):
+            raise InputError(
+                "exactly one of reports= / session= is required")
+        self.admission.admit(tenant)
+        _faults.fire("serve.enqueue")
+        req = ResolveRequest(
+            reports=reports, event_bounds=event_bounds,
+            reputation=reputation, session=session,
+            oracle_kwargs=dict(oracle_kwargs),
+            backend=backend or self.config.backend, tenant=tenant)
+        if req.backend not in BACKENDS:
+            raise InputError(f"unknown backend {req.backend!r}")
+        ms = (self.config.default_deadline_ms if deadline_ms is None
+              else deadline_ms)
+        if ms is not None:
+            req.deadline = req.submitted_at + float(ms) / 1e3
+        if session is not None:
+            self.sessions.get(session)       # fail fast on unknown name
+            req.dispatch_path = "session"
+        else:
+            self._derive(req, oracle_kwargs)
+        try:
+            self.queue.put(req)
+        except ServiceOverloadError:
+            self.admission.record_shed("queue_full")
+            raise
+        if not self._started:
+            self.start(warmup=False)
+        return req.future
+
+    def resolve(self, timeout: Optional[float] = None, **kwargs) -> dict:
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(**kwargs).result(timeout)
+
+    # -- sessions -------------------------------------------------------
+
+    def create_session(self, name: str, n_reporters: int, **kwargs):
+        """Create a named market session (see ``serve.session``)."""
+        return self.sessions.create(name, n_reporters, **kwargs)
+
+    def append(self, session: str, reports_block,
+               event_bounds=None) -> int:
+        """Append an event block to a named session."""
+        return self.sessions.get(session).append(reports_block,
+                                                 event_bounds)

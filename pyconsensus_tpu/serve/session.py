@@ -1,0 +1,298 @@
+"""Named market sessions: incremental report ingestion with staged
+sufficient statistics (serve tentpole part c).
+
+A Truthcoin voting period is not a single matrix arriving at once:
+ballots for a FIXED reporter roster trickle in per event block over the
+period, and the resolution is demanded on a schedule. A
+:class:`MarketSession` models one such period: ``append`` stages an
+event block AND immediately folds it into the streaming sufficient
+statistics (``parallel.streaming._pass1_panel``'s G/M/S accumulators,
+weighted by the round's starting reputation), so ``resolve`` pays only
+the scoring (R×R eigh off the Gram accumulator) plus one outcome pass
+over the staged blocks — never a re-ingestion of the full panel. The
+arithmetic is IDENTICAL to ``streaming_consensus`` over the same panel
+split (``gram_top_components`` / ``gram_dirfix`` / ``_pass2_panel`` /
+``assemble_light_result`` are the same functions), pinned by tests.
+
+Reputation carries across rounds through an optional backing
+:class:`~pyconsensus_tpu.ledger.ReputationLedger`
+(``ledger.record_round``), giving sessions the ledger's
+checkpoint/resume story for free. ``resolve`` CLOSES the round: staged
+state clears and the next round's appends accumulate against the
+carried reputation.
+
+Scope: the statistics fast path serves ``algorithm="sztorc"`` with
+``max_iterations=1`` (the serving default — each extra iteration is a
+full pass over data the session deliberately does not re-read); other
+configurations assemble the staged blocks and resolve through
+``Oracle`` directly (correct, just not incremental).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..faults import InputError
+from ..faults import plan as _faults
+from ..ledger import ReputationLedger
+from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
+from ..oracle import parse_event_bounds
+from ..parallel.streaming import (_pass1_panel, _pass2_panel,
+                                  assemble_light_result, gram_dirfix,
+                                  gram_top_components)
+
+__all__ = ["MarketSession", "SessionStore"]
+
+
+class MarketSession:
+    """One market round of incremental ballots for a fixed reporter set.
+
+    Parameters
+    ----------
+    name : str
+        Session identity (the ``session=`` handle in serve requests).
+    n_reporters : int
+        Fixed roster size; every appended block must have this many rows.
+    reputation : (R,) array or None
+        Starting reputation (uniform if None); replaced by the carried
+        ``smooth_rep`` after each ``resolve``.
+    ledger : ReputationLedger or None
+        Optional backing ledger — each resolve is recorded as a round
+        (``record_round``), and the ledger's checkpointing carries the
+        session across process restarts.
+    alpha, catch_tolerance, convergence_tolerance :
+        The Oracle knobs the statistics path honors.
+    """
+
+    def __init__(self, name: str, n_reporters: int, reputation=None,
+                 ledger: Optional[ReputationLedger] = None,
+                 alpha: float = 0.1, catch_tolerance: float = 0.1,
+                 convergence_tolerance: float = 1e-6) -> None:
+        self.name = str(name)
+        self.n_reporters = int(n_reporters)
+        if self.n_reporters < 1:
+            raise InputError("a session needs at least one reporter")
+        if ledger is not None and ledger.n_reporters != self.n_reporters:
+            raise InputError(
+                f"ledger carries {ledger.n_reporters} reporters, session "
+                f"declares {self.n_reporters}")
+        if reputation is None:
+            reputation = (np.asarray(ledger.reputation)
+                          if ledger is not None
+                          else np.full(self.n_reporters,
+                                       1.0 / self.n_reporters))
+        rep = np.asarray(reputation, dtype=np.float64)
+        if rep.shape != (self.n_reporters,):
+            raise InputError(f"reputation shape {rep.shape} does not "
+                             f"match {self.n_reporters} reporters")
+        self.reputation = nk.normalize(rep)
+        self.ledger = ledger
+        self.alpha = float(alpha)
+        self.catch_tolerance = float(catch_tolerance)
+        self.convergence_tolerance = float(convergence_tolerance)
+        self.rounds_resolved = 0
+        self._lock = threading.RLock()
+        self._reset_round()
+
+    def _reset_round(self) -> None:
+        R = self.n_reporters
+        dtype = jnp.asarray(0.0).dtype
+        self._blocks: list = []        # staged (R, e) host blocks
+        self._bounds: list = []        # per-block event_bounds lists
+        self._G = jnp.zeros((R, R), dtype=dtype)
+        self._M = jnp.zeros((R, R), dtype=dtype)
+        self._S = jnp.zeros((R, R), dtype=dtype)
+        #: the reputation the round's statistics are pinned to
+        self._round_rep = jnp.asarray(self.reputation, dtype=dtype)
+
+    # -- ingestion ------------------------------------------------------
+
+    @property
+    def n_events(self) -> int:
+        return sum(b.shape[1] for b in self._blocks)
+
+    def append(self, reports_block, event_bounds=None) -> int:
+        """Stage one event block (R × e, NaN = non-report) and fold it
+        into the round's sufficient statistics. Returns the session's
+        total staged event count."""
+        block = np.asarray(reports_block, dtype=np.float64)
+        if block.ndim == 1:
+            block = block[:, None]
+        if block.ndim != 2 or block.shape[0] != self.n_reporters:
+            raise InputError(
+                f"appended block must be ({self.n_reporters}, e), got "
+                f"{block.shape}", shape=tuple(block.shape))
+        e = block.shape[1]
+        scaled, mins, maxs = parse_event_bounds(event_bounds, e)
+        block = _faults.corrupt("serve.session_append", block)
+        with self._lock, obs.span("serve.session_append",
+                                  session=self.name, events=e):
+            dtype = self._round_rep.dtype
+            dG, dM, dS = _pass1_panel(
+                jnp.asarray(block, dtype=dtype), self._round_rep,
+                self._round_rep, jnp.asarray(scaled),
+                jnp.asarray(mins, dtype=dtype),
+                jnp.asarray(maxs, dtype=dtype),
+                jnp.ones((e,), dtype=bool), self.catch_tolerance, True)
+            self._G = self._G + dG
+            self._M = self._M + dM
+            self._S = self._S + dS
+            self._blocks.append(block)
+            self._bounds.append(
+                list(event_bounds) if event_bounds is not None
+                else [None] * e)
+            total = self.n_events
+        obs.counter(
+            "pyconsensus_serve_session_appends_total",
+            "event blocks appended to market sessions").inc()
+        return total
+
+    # -- resolution -----------------------------------------------------
+
+    def _assembled(self):
+        reports = np.concatenate(self._blocks, axis=1)
+        bounds = [b for chunk in self._bounds for b in chunk]
+        if all(b is None for b in bounds):
+            bounds = None
+        return reports, bounds
+
+    def resolve(self, algorithm: str = "sztorc", max_iterations: int = 1,
+                **oracle_kwargs) -> dict:
+        """Resolve the staged round and carry the reputation forward.
+        Returns the flat light result dict (``assemble_light_result``
+        shape). The round's staged state clears; subsequent appends
+        start the next round against the carried reputation."""
+        with self._lock:
+            if not self._blocks:
+                raise InputError(
+                    f"session {self.name!r} has no staged reports")
+            with obs.span("serve.session_resolve", session=self.name,
+                          events=self.n_events, algorithm=algorithm):
+                if (algorithm == "sztorc" and max_iterations == 1
+                        and not oracle_kwargs):
+                    result = self._resolve_stats()
+                else:
+                    result = self._resolve_direct(algorithm,
+                                                  max_iterations,
+                                                  oracle_kwargs)
+            self.reputation = np.asarray(result["smooth_rep"],
+                                         dtype=np.float64)
+            self.rounds_resolved += 1
+            if self.ledger is not None:
+                self.ledger.record_round(result)
+            self._reset_round()
+        return result
+
+    def _resolve_stats(self) -> dict:
+        """The incremental path: score off the accumulated G/M/S (the
+        identical arithmetic to ``streaming_consensus`` over the same
+        block split), then one outcome pass over the staged blocks."""
+        rep0 = self._round_rep
+        dtype = rep0.dtype
+        tol = self.catch_tolerance
+        R = self.n_reporters
+
+        scores_k, _, U, nAu = gram_top_components(self._G, self._M,
+                                                  rep0, 1)
+        u_over_nAu = U[:, 0] / jnp.where(nAu[0] == 0.0, 1.0, nAu[0])
+        adj = gram_dirfix(scores_k[:, 0], rep0, self._S)
+        this_rep = jk.row_reward_weighted(adj, rep0)
+        smooth_rep = jk.smooth(this_rep, rep0, self.alpha)
+        delta = float(jnp.max(jnp.abs(smooth_rep - rep0)))
+        converged = delta <= self.convergence_tolerance
+
+        E = self.n_events
+        outcomes_raw = np.zeros(E)
+        outcomes_adjusted = np.zeros(E)
+        outcomes_final = np.zeros(E)
+        certainty = np.zeros(E)
+        pcols = np.zeros(E)
+        first_loading = np.zeros(E)
+        prow = np.zeros(R)
+        na_count = np.zeros(R)
+        start = 0
+        for block, bounds in zip(self._blocks, self._bounds):
+            e = block.shape[1]
+            scaled, mins, maxs = parse_event_bounds(
+                None if all(b is None for b in bounds) else bounds, e)
+            raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
+                jnp.asarray(block, dtype=dtype), rep0, rep0, smooth_rep,
+                u_over_nAu, jnp.asarray(scaled),
+                jnp.asarray(mins, dtype=dtype),
+                jnp.asarray(maxs, dtype=dtype), tol)
+            stop = start + e
+            outcomes_raw[start:stop] = np.asarray(raw)
+            outcomes_adjusted[start:stop] = np.asarray(adjd)
+            outcomes_final[start:stop] = np.asarray(fin)
+            certainty[start:stop] = np.asarray(cert)
+            pcols[start:stop] = 1.0 - np.asarray(pc)
+            first_loading[start:stop] = np.asarray(ld)
+            prow += np.asarray(pr)
+            na_count += np.asarray(nc)
+            start = stop
+        first_loading = nk.canon_sign(first_loading)
+        return assemble_light_result(
+            np.asarray(rep0, dtype=float), this_rep, smooth_rep,
+            na_count, outcomes_raw, outcomes_adjusted, outcomes_final,
+            1, converged, certainty, pcols, prow,
+            {"first_loading": first_loading})
+
+    def _resolve_direct(self, algorithm, max_iterations, kwargs) -> dict:
+        """The non-incremental fallback: assemble the staged panel and
+        run the full Oracle (host-fetch the flat light-shaped pieces)."""
+        from ..oracle import Oracle
+
+        reports, bounds = self._assembled()
+        oracle = Oracle(reports=reports, event_bounds=bounds,
+                        reputation=np.asarray(self.reputation),
+                        algorithm=algorithm, max_iterations=max_iterations,
+                        alpha=self.alpha,
+                        catch_tolerance=self.catch_tolerance,
+                        convergence_tolerance=self.convergence_tolerance,
+                        backend="jax", **kwargs)
+        raw = {k: np.asarray(v) for k, v in oracle._fetch_raw().items()
+               if k not in ("original", "rescaled", "filled")}
+        return raw
+
+
+class SessionStore:
+    """Thread-safe registry of named sessions (the service's
+    ``session=`` namespace)."""
+
+    def __init__(self) -> None:
+        self._sessions: dict = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str, n_reporters: int, **kwargs
+               ) -> MarketSession:
+        with self._lock:
+            if name in self._sessions:
+                raise InputError(f"session {name!r} already exists")
+            session = MarketSession(name, n_reporters, **kwargs)
+            self._sessions[name] = session
+            obs.gauge("pyconsensus_serve_sessions",
+                      "live market sessions").set(len(self._sessions))
+            return session
+
+    def get(self, name: str) -> MarketSession:
+        with self._lock:
+            try:
+                return self._sessions[name]
+            except KeyError:
+                raise InputError(f"unknown session {name!r}") from None
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._sessions.pop(name, None)
+            obs.gauge("pyconsensus_serve_sessions",
+                      "live market sessions").set(len(self._sessions))
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._sessions)

@@ -150,9 +150,16 @@ class CollusionSimulator:
         replicate-and-vmap per chip — trials are independent, so this is
         pure data parallelism with zero collectives; an 8-chip host runs
         8× the trials per wall-second). The grid is padded up to a device
-        multiple on device and the padding dropped on the way out, so
-        results are bit-identical to the single-device sweep for any
-        trial count.
+        multiple on device and the padding dropped on the way out.
+        Determinism contract: the SAME dispatch topology (mesh × batch
+        width) replayed over the same seed is bit-identical — the
+        crash/resume guarantee — while a DIFFERENT topology (meshed vs
+        single-device, or a different chunk width on a mesh) agrees to
+        reduction-order ulps only: GSPMD partitioning at a different
+        per-device batch width may re-tile within-trial reductions
+        (measured: 1-ulp leaks in 3 of 42 lanes at 1-lane-per-device vs
+        a monolithic 42-wide dispatch; full-width meshed dispatch agreed
+        bitwise — docs/ROBUSTNESS.md parity ledger #9).
     """
 
     def __init__(self, n_reporters: int = 20, n_events: int = 10,
@@ -199,8 +206,10 @@ class CollusionSimulator:
         uneven NamedSharding placement is impossible in JAX, so the
         batch is padded to a device multiple (edge-repeated lanes) and
         the tail dropped on the way out. Lanes at the same flat index
-        are untouched, so meshed, single-device, and chunked dispatches
-        are all bit-identical."""
+        carry the same per-trial key, so a replay of the SAME topology
+        is bit-identical; across topologies agreement is to
+        reduction-order ulps (see the class docstring's determinism
+        contract)."""
         indices = np.asarray(indices)
         N = indices.shape[0]
         with obs.span("sim.dispatch", trials=int(N),

@@ -185,6 +185,28 @@ CORPUS = {
             return jax.jit(lambda y: y * 2)(x)
         """,
     ),
+    # ISSUE 5: blocking waits / queue ops in traced code block once per
+    # TRACE, never per execution — host coordination baked in as a
+    # constant
+    "CL701": (
+        """
+        import jax
+        import queue
+        @jax.jit
+        def f(x):
+            q = queue.Queue()
+            q.put(x)
+            return x * 2
+        """,
+        """
+        import jax
+        import queue
+        def host(x):
+            q = queue.Queue()
+            q.put(x)
+            return jax.jit(lambda y: y * 2)(x)
+        """,
+    ),
 }
 
 
@@ -403,6 +425,116 @@ class TestFaultsInTracedRule:
         / streaming / sharded / oracle — every one must be host-side
         over the real tree, not just the corpus."""
         found = [f for f in lint_paths() if f.rule == "CL601"]
+        assert found == [], [(f.path, f.line, f.rule) for f in found]
+
+
+class TestBlockingInTracedRule:
+    """CL701 (ISSUE 5) beyond the basic corpus: sync-object handles,
+    time.sleep, Future.result, benign-receiver immunity, and the real
+    serve package staying clean."""
+
+    def _rules(self, tmp_path, src):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent(src))
+        return [f.rule for f in lint_file(p, rel_path="m.py")]
+
+    def test_event_wait_handle(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            import threading
+            @jax.jit
+            def f(x):
+                ev = threading.Event()
+                ev.wait()
+                return x
+            """)
+        assert "CL701" in rules
+
+    def test_time_sleep(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            import time
+            @jax.jit
+            def f(x):
+                time.sleep(0.1)
+                return x
+            """)
+        assert "CL701" in rules
+
+    def test_future_result_handle(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from concurrent.futures import Future
+            @jax.jit
+            def f(x):
+                fut = Future()
+                return fut.result(), x
+            """)
+        assert "CL701" in rules
+
+    def test_serve_queue_ops(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            from pyconsensus_tpu.serve import RequestQueue
+            @jax.jit
+            def f(x):
+                q = RequestQueue(4)
+                q.take(timeout=1.0)
+                return x
+            """)
+        assert "CL701" in rules
+
+    def test_lock_acquire_handle(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            import threading
+            @jax.jit
+            def f(x):
+                lock = threading.Lock()
+                lock.acquire()
+                return x
+            """)
+        assert "CL701" in rules
+
+    def test_benign_receivers_not_flagged(self, tmp_path):
+        # dict.get / str.join / untracked .result must stay silent —
+        # only handles assigned from blocking constructors count
+        rules = self._rules(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x, cfg):
+                name = "-".join(["a", "b"])
+                v = cfg.get("k", 0)
+                return x * v, name
+            """)
+        assert "CL701" not in rules
+
+    def test_host_side_not_flagged(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            import queue
+            def host(x):
+                q = queue.Queue()
+                q.put(x)
+                return jax.jit(lambda y: y * 2)(q.get())
+            """)
+        assert "CL701" not in rules
+
+    def test_suppression(self, tmp_path):
+        rules = self._rules(tmp_path, """
+            import jax
+            import time
+            @jax.jit
+            def f(x):
+                time.sleep(0.0)  # consensus-lint: disable=CL701
+                return x
+            """)
+        assert "CL701" not in rules
+
+    def test_serve_package_is_cl701_clean(self):
+        """The serving layer is built ON queues and waits — every one
+        must live host-side, outside the traced kernel."""
+        found = [f for f in lint_paths() if f.rule == "CL701"]
         assert found == [], [(f.path, f.line, f.rule) for f in found]
 
 

@@ -5,20 +5,15 @@ reputations to f32-kernel tolerance — across storage dtypes, NA
 patterns, iteration counts, and mesh widths, on the 8-virtual-device CPU
 mesh with the Pallas kernels in interpret mode.
 
-TODO(issue-4) triage (docs/ROBUSTNESS.md parity ledger #1-7, decision:
-fix, not xfail): 7 tests in this file fail at seed and still fail —
-the parity/scaled/padding cases whose smooth_rep (and downstream bonus)
-vectors drift past the 5e-6 tolerance between the shard_map path and the
-single-device fused path under CPU interpret mode (catch-snapped
-outcomes and iteration counts DO match; only the reputation tail
-diverges). This is a genuine numeric discrepancy to run down — most
-likely the sharded power loop's psum reduction order vs the one-pass
-kernel's accumulation order feeding the early-exit alignment test a
-different trajectory — NOT an environmental limitation, so these are
-deliberately left failing (not xfail'd) to keep the pressure visible:
-test_matches_single_device_fused[int8|bfloat16|''], test_iterative_loop,
-test_scaled_clustered_on_one_shard, test_scaled_iterative,
-test_nondivisible_iterative."""
+Parity-ledger #1-7 closure (docs/ROBUSTNESS.md): the 7 long-failing
+cases in this file were NOT power-loop reduction noise — a column whose
+present-weighted mean sits EXACTLY on the catch boundary (0.6 with the
+default 0.1 tolerance under uniform reputation) snapped its FILL
+differently per path because XLA's column reductions at different
+shapes land one ulp apart. Fixed by the ``CATCH_TIE_ATOL`` boundary
+band (numpy/jax/Pallas `catch` kernels — the MEDIAN/DIRFIX tie-band
+pattern): knife-edge fills now resolve to the ambiguous 0.5 on every
+path, and the original 5e-6 tolerances hold."""
 
 import numpy as np
 import jax.numpy as jnp

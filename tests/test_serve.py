@@ -1,0 +1,618 @@
+"""pyconsensus_tpu.serve — micro-batching consensus service (ISSUE 5).
+
+Covers the padded bucket kernel's equivalence contract (snapped
+outcomes bit-identical to direct Oracle resolution across every bucket
+of the ladder, both backends, binary + scaled; continuous tails within
+the documented 1e-9 band; full determinism across batch compositions),
+the queue/admission overload semantics (deterministic PYC401 shedding),
+the executable cache (LRU, hit/miss metrics, warmup-pinned retraces),
+market sessions (incremental statistics bit-identical to the streaming
+driver over the same block split), and the fault sites.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from conftest import collusion_reports
+from pyconsensus_tpu import Oracle, obs
+from pyconsensus_tpu.faults import (ERROR_CODES, ConsensusError,
+                                    ServiceOverloadError)
+from pyconsensus_tpu.serve import (BucketKey, ConsensusService,
+                                   LoadGenerator, RequestQueue,
+                                   ResolveRequest, ServeConfig,
+                                   bucket_path_eligible)
+
+#: the continuous-tail band vs direct resolution (docs/SERVING.md —
+#: XLA reduce tilings are shape/fusion-dependent, so only the snapped
+#: outcomes are bitwise across compiled graphs; measured <= 3e-10)
+SERVE_ATOL = 1e-9
+
+#: result-field accessors compared against direct Oracle resolutions
+_EXACT_KEYS = (("events", "outcomes_final"), ("events", "outcomes_adjusted"))
+_BAND_KEYS = (("agents", "smooth_rep"), ("agents", "this_rep"),
+              ("agents", "reporter_bonus"), ("agents", "relative_part"),
+              ("agents", "participation_rows"),
+              ("events", "outcomes_raw"), ("events", "certainty"),
+              ("events", "consensus_reward"), ("events", "author_bonus"),
+              ("events", "participation_columns"))
+
+
+def _get(result, path):
+    section, key = path
+    return np.asarray(result[section][key])
+
+
+def serve_one(reports, bounds=None, cfg=None, backend="jax", **kw):
+    with ConsensusService(cfg or ServeConfig()) as svc:
+        return svc.submit(reports=reports, event_bounds=bounds,
+                          backend=backend, **kw).result(timeout=120)
+
+
+def assert_serve_parity(got, ref):
+    for path in _EXACT_KEYS:
+        np.testing.assert_array_equal(_get(got, path), _get(ref, path),
+                                      err_msg=str(path))
+    assert got["iterations"] == ref["iterations"]
+    assert got["convergence"] == ref["convergence"]
+    for path in _BAND_KEYS:
+        np.testing.assert_allclose(_get(got, path), _get(ref, path),
+                                   atol=SERVE_ATOL, rtol=0,
+                                   err_msg=str(path))
+    assert got["certainty"] == pytest.approx(ref["certainty"],
+                                             abs=SERVE_ATOL)
+    assert got["participation"] == pytest.approx(ref["participation"],
+                                                 abs=SERVE_ATOL)
+
+
+def scaled_fixture(rng, R, E, n_scaled):
+    reports, _ = collusion_reports(rng, R, E, liars=max(2, R // 4),
+                                   na_frac=0.12)
+    cols = rng.choice(E, n_scaled, replace=False)
+    bounds = [None] * E
+    for c in cols:
+        bounds[c] = {"scaled": True, "min": -5.0, "max": 15.0}
+        with np.errstate(invalid="ignore"):
+            reports[:, c] = reports[:, c] * 20.0 - 5.0
+    return reports, bounds
+
+
+class TestPaddingEquivalence:
+    """The satellite property test: a request resolved through EVERY
+    bucket size yields the same answers as a direct Oracle call."""
+
+    #: ladders forcing four different buckets around a 13 x 52 request
+    BUCKETS = [(13, 52), (16, 64), (32, 128), (64, 256)]
+
+    def _cfg(self, rb, eb):
+        return ServeConfig(row_buckets=(rb,), event_buckets=(eb,),
+                           batch_window_ms=0.0)
+
+    @pytest.mark.parametrize("bucket", BUCKETS)
+    def test_binary_na_every_bucket(self, rng, bucket):
+        reports, _ = collusion_reports(rng, 13, 52, liars=4, na_frac=0.15)
+        ref = Oracle(reports=reports, backend="jax",
+                     pca_method="power").consensus()
+        got = serve_one(reports, cfg=self._cfg(*bucket))
+        assert_serve_parity(got, ref)
+
+    @pytest.mark.parametrize("bucket", BUCKETS)
+    def test_scaled_every_bucket(self, rng, bucket):
+        reports, bounds = scaled_fixture(rng, 13, 52, n_scaled=6)
+        ref = Oracle(reports=reports, event_bounds=bounds, backend="jax",
+                     pca_method="power").consensus()
+        got = serve_one(reports, bounds, cfg=self._cfg(*bucket))
+        assert_serve_parity(got, ref)
+
+    @pytest.mark.parametrize("trial", range(4))
+    def test_property_random_shapes(self, trial):
+        """Random shapes/NA/iterations through the default ladder."""
+        rng = np.random.default_rng(4200 + trial)
+        R = int(rng.integers(5, 40))
+        E = int(rng.integers(8, 130))
+        na = float(rng.uniform(0.0, 0.3))
+        it = int(rng.integers(1, 5))
+        reports, _ = collusion_reports(rng, R, E, liars=max(2, R // 4),
+                                       na_frac=na)
+        ref = Oracle(reports=reports, backend="jax", pca_method="power",
+                     max_iterations=it).consensus()
+        got = serve_one(reports, max_iterations=it)
+        assert_serve_parity(got, ref)
+
+    def test_numpy_backend_bit_identical(self, rng):
+        """The numpy path dispatches the Oracle graph directly — FULL
+        bit-identity, both value and aggregate."""
+        reports, _ = collusion_reports(rng, 11, 30, liars=3, na_frac=0.2)
+        ref = Oracle(reports=reports, backend="numpy").consensus()
+        got = serve_one(reports, backend="numpy")
+        for path in _EXACT_KEYS + _BAND_KEYS:
+            np.testing.assert_array_equal(_get(got, path),
+                                          _get(ref, path),
+                                          err_msg=str(path))
+        assert got["certainty"] == ref["certainty"]
+
+    def test_direct_path_bit_identical(self, rng):
+        """A bucket-ineligible algorithm rides the direct path — the
+        Oracle graph itself, bit-identical."""
+        reports, _ = collusion_reports(rng, 10, 24, liars=3, na_frac=0.1)
+        ref = Oracle(reports=reports, backend="jax",
+                     algorithm="k-means").consensus()
+        got = serve_one(reports, algorithm="k-means")
+        np.testing.assert_array_equal(
+            _get(got, ("events", "outcomes_final")),
+            _get(ref, ("events", "outcomes_final")))
+        np.testing.assert_array_equal(_get(got, ("agents", "smooth_rep")),
+                                      _get(ref, ("agents", "smooth_rep")))
+
+    def test_quarantine_matches_oracle(self, rng):
+        """±Inf rows quarantine at the serve front door exactly like the
+        Oracle front door."""
+        reports, _ = collusion_reports(rng, 12, 32, liars=3, na_frac=0.1)
+        reports[4, 7] = np.inf
+        ref = Oracle(reports=reports, backend="jax",
+                     pca_method="power").consensus()
+        got = serve_one(reports)
+        np.testing.assert_array_equal(got["quarantined_rows"],
+                                      ref["quarantined_rows"])
+        assert_serve_parity(got, ref)
+
+
+class TestDeterminism:
+    """A request's bits never depend on traffic shape or co-batched
+    requests (the fixed-capacity executable contract)."""
+
+    def test_same_bits_across_batch_compositions(self, rng):
+        reports, _ = collusion_reports(rng, 12, 48, liars=4, na_frac=0.1)
+        others = [collusion_reports(np.random.default_rng(50 + i), 12, 48,
+                                    liars=4, na_frac=0.1)[0]
+                  for i in range(5)]
+        cfg = ServeConfig(batch_window_ms=20.0, max_batch=8)
+        outs = []
+        # solo dispatch
+        outs.append(serve_one(reports, cfg=cfg))
+        # co-batched with 5 other requests (one dispatch window)
+        with ConsensusService(cfg) as svc:
+            futs = [svc.submit(reports=m) for m in [reports] + others]
+            outs.append(futs[0].result(timeout=120))
+        # repeated dispatch in a fresh service
+        outs.append(serve_one(reports, cfg=cfg))
+        first = outs[0]
+        for other in outs[1:]:
+            for path in _EXACT_KEYS + _BAND_KEYS:
+                np.testing.assert_array_equal(_get(first, path),
+                                              _get(other, path),
+                                              err_msg=str(path))
+            assert other["certainty"] == first["certainty"]
+
+    def test_concurrent_clients_get_their_own_results(self, rng):
+        """N interleaved clients, distinct matrices — each future must
+        carry ITS request's resolution (lane-routing correctness)."""
+        N = 12
+        matrices = []
+        for i in range(N):
+            r = np.random.default_rng(900 + i)
+            m, _ = collusion_reports(r, 10 + (i % 3), 40 + 4 * (i % 4),
+                                     liars=3, na_frac=0.1)
+            matrices.append(m)
+        refs = [Oracle(reports=m, backend="jax",
+                       pca_method="power").consensus() for m in matrices]
+        cfg = ServeConfig(batch_window_ms=5.0)
+        with ConsensusService(cfg) as svc:
+            futs = [None] * N
+
+            def client(i):
+                futs[i] = svc.submit(reports=matrices[i])
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(N)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            results = [f.result(timeout=120) for f in futs]
+        for got, ref in zip(results, refs):
+            assert_serve_parity(got, ref)
+
+
+class TestAdmission:
+    def test_error_taxonomy(self):
+        assert ServiceOverloadError.error_code == "PYC401"
+        assert ERROR_CODES["PYC401"] is ServiceOverloadError
+        assert issubclass(ServiceOverloadError, ConsensusError)
+        assert issubclass(ServiceOverloadError, RuntimeError)
+
+    def test_queue_full_is_deterministic(self):
+        q = RequestQueue(max_depth=2)
+        q.put(ResolveRequest(reports=np.zeros((2, 2))))
+        q.put(ResolveRequest(reports=np.zeros((2, 2))))
+        with pytest.raises(ServiceOverloadError) as e:
+            q.put(ResolveRequest(reports=np.zeros((2, 2))))
+        assert e.value.context["reason"] == "queue_full"
+        assert e.value.error_code == "PYC401"
+
+    def test_rate_limit_sheds_over_rate_traffic(self, rng):
+        reports, _ = collusion_reports(rng, 8, 24, liars=2, na_frac=0.0)
+        cfg = ServeConfig(rate_limit_rps=1e-3, rate_burst=2.0)
+        with ConsensusService(cfg) as svc:
+            svc.submit(reports=reports).result(timeout=120)
+            svc.submit(reports=reports).result(timeout=120)
+            with pytest.raises(ServiceOverloadError) as e:
+                svc.submit(reports=reports)
+        assert e.value.context["reason"] == "rate_limited"
+        assert e.value.context["retry_after_s"] > 0
+
+    def test_deadline_shed_not_hang(self, rng):
+        """An expired request is shed with PYC401, never served late and
+        never hung."""
+        reports, _ = collusion_reports(rng, 8, 24, liars=2, na_frac=0.0)
+        with ConsensusService(ServeConfig()) as svc:
+            fut = svc.submit(reports=reports, deadline_ms=1e-6)
+            with pytest.raises(ServiceOverloadError) as e:
+                fut.result(timeout=60)
+        assert e.value.context["reason"] == "deadline"
+
+    def test_drain_finishes_queued_then_refuses(self, rng):
+        reports, _ = collusion_reports(rng, 8, 24, liars=2, na_frac=0.0)
+        svc = ConsensusService(ServeConfig()).start()
+        futs = [svc.submit(reports=reports) for _ in range(4)]
+        svc.close(drain=True)
+        for f in futs:
+            assert f.result(timeout=60)["convergence"] in (True, False)
+        with pytest.raises(ServiceOverloadError) as e:
+            svc.submit(reports=reports)
+        assert e.value.context["reason"] == "draining"
+
+    def test_validation_errors_are_synchronous(self):
+        svc = ConsensusService(ServeConfig())
+        with pytest.raises(ValueError):
+            svc.submit(reports=np.zeros((0, 3)))
+        with pytest.raises(ValueError):
+            svc.submit()
+        with pytest.raises(ValueError):
+            svc.submit(reports=np.zeros((2, 2)), session="x")
+
+
+class TestCacheAndWarmup:
+    def test_warmup_pins_retraces_at_bucket_count(self, rng):
+        obs.reset()
+        cfg = ServeConfig(warmup=((16, 64), (32, 128)),
+                          batch_window_ms=1.0)
+        reports, _ = collusion_reports(rng, 12, 48, liars=4, na_frac=0.1)
+        big, _ = collusion_reports(rng, 24, 100, liars=6, na_frac=0.1)
+        with ConsensusService(cfg) as svc:
+            for _ in range(3):
+                svc.submit(reports=reports).result(timeout=120)
+                svc.submit(reports=big).result(timeout=120)
+            assert len(svc.cache) == 2
+        assert obs.value("pyconsensus_jit_retraces_total",
+                         entry="serve_bucket") == 2
+        assert obs.value("pyconsensus_serve_cache_misses_total") == 2
+        assert obs.value("pyconsensus_serve_cache_hits_total") >= 6
+
+    def test_lru_eviction(self, rng):
+        cfg = ServeConfig(cache_capacity=1, batch_window_ms=0.0)
+        small, _ = collusion_reports(rng, 6, 12, liars=2, na_frac=0.1)
+        wide, _ = collusion_reports(rng, 6, 20, liars=2, na_frac=0.1)
+        before = obs.value("pyconsensus_serve_cache_evictions_total") or 0
+        with ConsensusService(cfg) as svc:
+            svc.submit(reports=small).result(timeout=120)
+            svc.submit(reports=wide).result(timeout=120)
+            assert len(svc.cache) == 1
+        after = obs.value("pyconsensus_serve_cache_evictions_total")
+        assert after - before >= 1
+
+    def test_bucket_key_fields(self):
+        from pyconsensus_tpu.models.pipeline import ConsensusParams
+
+        p = ConsensusParams(algorithm="sztorc", pca_method="power")
+        key = BucketKey.make(16, 64, 8, p)
+        assert (key.rows, key.events, key.batch) == (16, 64, 8)
+        assert key.params is p
+        assert key == BucketKey.make(16, 64, 8, p)
+
+
+class TestRouting:
+    def test_eligibility_rule(self):
+        assert bucket_path_eligible("sztorc", "power", False, True, "")
+        assert bucket_path_eligible("sztorc", "auto", True, True,
+                                    "bfloat16")
+        assert not bucket_path_eligible("ica", "power", False, True, "")
+        assert not bucket_path_eligible("sztorc", "eigh-gram", False,
+                                        True, "")
+        assert not bucket_path_eligible("sztorc", "power", False, True,
+                                        "int8")
+
+    def test_oversize_request_takes_direct_path(self, rng):
+        """A shape beyond the ladders still resolves (direct path)."""
+        cfg = ServeConfig(row_buckets=(8,), event_buckets=(16,),
+                          batch_window_ms=0.0)
+        reports, _ = collusion_reports(rng, 12, 40, liars=3, na_frac=0.1)
+        ref = Oracle(reports=reports, backend="jax").consensus()
+        got = serve_one(reports, cfg=cfg)
+        np.testing.assert_array_equal(
+            _get(got, ("events", "outcomes_final")),
+            _get(ref, ("events", "outcomes_final")))
+
+    def test_coalescing_is_measurably_active(self, rng):
+        """The acceptance demo: concurrent same-bucket traffic must
+        coalesce (mean occupancy > 1)."""
+        obs.reset()
+        reports, _ = collusion_reports(rng, 12, 48, liars=4, na_frac=0.1)
+        cfg = ServeConfig(warmup=((16, 64),), batch_window_ms=10.0)
+        with ConsensusService(cfg) as svc:
+            futs = [svc.submit(reports=reports) for _ in range(8)]
+            for f in futs:
+                f.result(timeout=120)
+        snap = obs.REGISTRY.snapshot()[
+            "pyconsensus_serve_batch_occupancy"]["series"]
+        ser = next(iter(snap.values()))
+        assert ser["sum"] / ser["count"] > 1.0
+
+
+class TestSessions:
+    def test_incremental_matches_streaming_driver(self, rng):
+        """append-accumulated statistics resolve bit-identically to
+        streaming_consensus over the same panel split."""
+        from pyconsensus_tpu.models.pipeline import ConsensusParams
+        from pyconsensus_tpu.parallel import streaming_consensus
+
+        R, width, blocks = 14, 16, 3
+        full = np.concatenate(
+            [collusion_reports(rng, R, width, liars=4, na_frac=0.1)[0]
+             for _ in range(blocks)], axis=1)
+        stream = streaming_consensus(
+            full, panel_events=width,
+            params=ConsensusParams(algorithm="sztorc", max_iterations=1))
+        svc = ConsensusService(ServeConfig())
+        svc.create_session("m1", n_reporters=R)
+        for b in range(blocks):
+            svc.append("m1", full[:, b * width:(b + 1) * width])
+        got = svc.submit(session="m1").result(timeout=120)
+        svc.close(drain=True)
+        np.testing.assert_array_equal(
+            _get(got, ("events", "outcomes_final")),
+            stream["outcomes_final"])
+        np.testing.assert_array_equal(_get(got, ("agents", "smooth_rep")),
+                                      stream["smooth_rep"])
+        np.testing.assert_array_equal(
+            _get(got, ("events", "certainty")), stream["certainty"])
+        np.testing.assert_array_equal(
+            _get(got, ("agents", "reporter_bonus")),
+            stream["reporter_bonus"])
+
+    def test_outcomes_match_oracle(self, rng):
+        from pyconsensus_tpu.serve import MarketSession
+
+        R = 12
+        b1, _ = collusion_reports(rng, R, 10, liars=3, na_frac=0.1)
+        b2, _ = collusion_reports(rng, R, 14, liars=3, na_frac=0.1)
+        session = MarketSession("m", n_reporters=R)
+        session.append(b1)
+        session.append(b2)
+        flat = session.resolve()
+        ref = Oracle(reports=np.concatenate([b1, b2], axis=1),
+                     backend="jax").consensus()
+        np.testing.assert_array_equal(flat["outcomes_adjusted"],
+                                      _get(ref, ("events",
+                                                 "outcomes_adjusted")))
+
+    def test_reputation_carries_and_round_closes(self, rng):
+        from pyconsensus_tpu.serve import MarketSession
+
+        R = 10
+        session = MarketSession("m", n_reporters=R)
+        b1, _ = collusion_reports(rng, R, 12, liars=3, na_frac=0.0)
+        session.append(b1)
+        r1 = session.resolve()
+        np.testing.assert_array_equal(session.reputation,
+                                      r1["smooth_rep"])
+        assert session.n_events == 0          # round closed
+        with pytest.raises(ValueError):
+            session.resolve()                 # nothing staged
+        b2, _ = collusion_reports(rng, R, 12, liars=3, na_frac=0.0)
+        session.append(b2)
+        r2 = session.resolve()
+        # round 2 resolved against the carried reputation
+        ref2 = Oracle(reports=b2, reputation=r1["smooth_rep"],
+                      backend="jax").consensus()
+        np.testing.assert_array_equal(r2["outcomes_adjusted"],
+                                      _get(ref2, ("events",
+                                                  "outcomes_adjusted")))
+
+    def test_ledger_integration(self, rng, tmp_path):
+        from pyconsensus_tpu.ledger import ReputationLedger
+        from pyconsensus_tpu.serve import MarketSession
+
+        R = 8
+        ledger = ReputationLedger(n_reporters=R)
+        session = MarketSession("m", n_reporters=R, ledger=ledger)
+        b, _ = collusion_reports(rng, R, 10, liars=2, na_frac=0.1)
+        session.append(b)
+        session.resolve()
+        assert ledger.round == 1
+        assert len(ledger.history) == 1
+        np.testing.assert_array_equal(ledger.reputation,
+                                      session.reputation)
+        # checkpoint round-trips the carried state
+        ledger.save(tmp_path / "state.npz")
+        resumed = ReputationLedger.load(tmp_path / "state.npz")
+        np.testing.assert_array_equal(resumed.reputation,
+                                      ledger.reputation)
+        assert resumed.round == 1
+
+    def test_scaled_blocks(self, rng):
+        from pyconsensus_tpu.serve import MarketSession
+
+        R = 12
+        block, bounds = scaled_fixture(rng, R, 16, n_scaled=4)
+        session = MarketSession("m", n_reporters=R)
+        session.append(block, event_bounds=bounds)
+        flat = session.resolve()
+        ref = Oracle(reports=block, event_bounds=bounds,
+                     backend="jax").consensus()
+        np.testing.assert_array_equal(
+            flat["outcomes_adjusted"][np.asarray(
+                [b is None for b in bounds])],
+            _get(ref, ("events", "outcomes_adjusted"))[np.asarray(
+                [b is None for b in bounds])])
+
+    def test_shape_validation(self):
+        from pyconsensus_tpu.serve import MarketSession
+
+        session = MarketSession("m", n_reporters=6)
+        with pytest.raises(ValueError):
+            session.append(np.zeros((5, 3)))
+
+    def test_direct_fallback_for_iterated_resolve(self, rng):
+        """A non-default configuration (max_iterations > 1) assembles
+        the staged panel and resolves through Oracle — same carried
+        reputation, full algorithm table."""
+        from pyconsensus_tpu.serve import MarketSession
+
+        R = 10
+        b1, _ = collusion_reports(rng, R, 8, liars=3, na_frac=0.1)
+        b2, _ = collusion_reports(rng, R, 8, liars=3, na_frac=0.1)
+        session = MarketSession("m", n_reporters=R)
+        session.append(b1)
+        session.append(b2)
+        flat = session.resolve(max_iterations=3)
+        ref = Oracle(reports=np.concatenate([b1, b2], axis=1),
+                     backend="jax", max_iterations=3).consensus()
+        np.testing.assert_array_equal(flat["smooth_rep"],
+                                      _get(ref, ("agents", "smooth_rep")))
+        assert flat["iterations"] == ref["iterations"]
+        np.testing.assert_array_equal(session.reputation,
+                                      flat["smooth_rep"])
+
+
+class TestFaultSites:
+    def test_enqueue_site(self, rng):
+        from pyconsensus_tpu import faults
+
+        reports, _ = collusion_reports(rng, 8, 16, liars=2, na_frac=0.0)
+        plan = faults.FaultPlan(seed=1, rules=[
+            {"site": "serve.enqueue", "kind": "raise",
+             "occurrences": [0]}])
+        svc = ConsensusService(ServeConfig())
+        with faults.armed(plan):
+            with pytest.raises(OSError):
+                svc.submit(reports=reports)
+        assert plan.fired == [("serve.enqueue", 0, "raise")]
+
+    def test_dispatch_site(self, rng):
+        from pyconsensus_tpu import faults
+
+        reports, _ = collusion_reports(rng, 8, 16, liars=2, na_frac=0.0)
+        plan = faults.FaultPlan(seed=1, rules=[
+            {"site": "serve.dispatch", "kind": "raise",
+             "occurrences": [0]}])
+        with ConsensusService(ServeConfig()) as svc:
+            with faults.armed(plan):
+                fut = svc.submit(reports=reports)
+                with pytest.raises(OSError):
+                    fut.result(timeout=60)
+
+    def test_group_failure_resolves_every_future(self, rng):
+        """A dispatch failure must surface on EVERY coalesced future —
+        never leave group members hanging to their timeouts."""
+        from pyconsensus_tpu import faults
+
+        reports, _ = collusion_reports(rng, 8, 16, liars=2, na_frac=0.0)
+        plan = faults.FaultPlan(seed=1, rules=[
+            {"site": "serve.dispatch", "kind": "raise",
+             "occurrences": [0]}])
+        cfg = ServeConfig(batch_window_ms=30.0)
+        with ConsensusService(cfg) as svc:
+            with faults.armed(plan):
+                futs = [svc.submit(reports=reports) for _ in range(4)]
+                outcomes = []
+                for f in futs:
+                    try:
+                        f.result(timeout=30)
+                        outcomes.append("ok")
+                    except OSError:
+                        outcomes.append("err")
+        # every coalesced member of the failed dispatch resolved with
+        # the error; none hung (the result(timeout=30) would have
+        # raised TimeoutError instead of OSError)
+        assert outcomes.count("err") >= 1
+        assert set(outcomes) <= {"ok", "err"}
+
+    def test_session_append_corruption(self, rng):
+        from pyconsensus_tpu import faults
+        from pyconsensus_tpu.serve import MarketSession
+
+        plan = faults.FaultPlan(seed=3, rules=[
+            {"site": "serve.session_append", "kind": "nan_storm",
+             "occurrences": [0], "args": {"fraction": 0.5}}])
+        session = MarketSession("m", n_reporters=8)
+        block = np.ones((8, 6))
+        with faults.armed(plan):
+            session.append(block)
+        # the staged block was poisoned, the caller's array untouched
+        assert np.isnan(session._blocks[0]).any()
+        assert not np.isnan(block).any()
+
+
+class TestLoadgen:
+    def test_closed_loop_demo(self, rng):
+        """The acceptance demo: >= 8 concurrent clients, zero failures,
+        coalescing active, retraces pinned at warmed bucket count."""
+        obs.reset()
+        cfg = ServeConfig(warmup=((16, 64), (32, 128)),
+                          batch_window_ms=3.0)
+        with ConsensusService(cfg) as svc:
+            gen = LoadGenerator(svc, shapes=((12, 48), (24, 100)),
+                                na_frac=0.1, seed=5)
+            stats = gen.run_closed(n_requests=40, concurrency=8)
+        assert stats["failed"] == 0
+        assert stats["succeeded"] == 40
+        assert stats["throughput_rps"] > 0
+        assert stats["latency_p99_ms"] >= stats["latency_p50_ms"]
+        snap = obs.REGISTRY.snapshot()[
+            "pyconsensus_serve_batch_occupancy"]["series"]
+        ser = next(iter(snap.values()))
+        assert ser["sum"] / ser["count"] > 1.0
+        assert obs.value("pyconsensus_jit_retraces_total",
+                         entry="serve_bucket") == 2
+
+    def test_open_loop_sheds_deterministically(self, rng):
+        """Over-rate open-loop traffic: every failure is a PYC401 —
+        never a hang, never an unclassified error."""
+        cfg = ServeConfig(rate_limit_rps=5.0, rate_burst=3.0,
+                          batch_window_ms=0.0)
+        with ConsensusService(cfg) as svc:
+            gen = LoadGenerator(svc, shapes=((8, 24),), na_frac=0.0,
+                                seed=2)
+            stats = gen.run_open(n_requests=30, rate_rps=400.0)
+        assert stats["failed"] > 0
+        assert set(stats["errors"]) == {"PYC401"}
+        assert stats["succeeded"] + stats["failed"] == 30
+
+
+class TestServeConfig:
+    def test_json_round_trip(self, tmp_path):
+        import json
+
+        path = tmp_path / "serve.json"
+        path.write_text(json.dumps({
+            "row_buckets": [8, 32], "event_buckets": [64],
+            "max_batch": 4, "rate_limit_rps": 10.0,
+            "warmup": [[8, 64]]}))
+        cfg = ServeConfig.load(path)
+        assert cfg.row_buckets == (8, 32)
+        assert cfg.warmup == ((8, 64),)
+        assert cfg.max_batch == 4
+
+    def test_unknown_key_rejected(self, tmp_path):
+        import json
+
+        path = tmp_path / "serve.json"
+        path.write_text(json.dumps({"no_such_knob": 1}))
+        with pytest.raises(ValueError, match="no_such_knob"):
+            ServeConfig.load(path)
+
+    def test_unsorted_ladder_rejected(self):
+        with pytest.raises(ValueError, match="ascending"):
+            ConsensusService(ServeConfig(row_buckets=(32, 8)))

@@ -180,6 +180,45 @@ assert np.array_equal(r1["events"]["outcomes_final"],
 print("chaos (3) OK: NaN storm finite + quarantined, replay identical")
 PYEOF
 
+echo "=== Serve smoke (ISSUE 5: warmup + 50 concurrent requests through 2 buckets + drain) ==="
+# Start the micro-batching service with two warmed buckets, drive 50
+# concurrent closed-loop requests whose shapes map to BOTH buckets,
+# and assert: every request succeeds, coalescing is measurably active
+# (mean batch occupancy > 1), the steady-state retrace counter equals
+# the warmed bucket count (the executable-cache contract — the runtime
+# CL304), and graceful drain completes. See docs/SERVING.md.
+"$PY" - <<'PYEOF'
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+from pyconsensus_tpu.serve.loadgen import LoadGenerator
+
+cfg = ServeConfig(warmup=((16, 64), (32, 128)), batch_window_ms=3.0)
+svc = ConsensusService(cfg).start()
+gen = LoadGenerator(svc, shapes=((12, 48), (24, 100)), na_frac=0.1,
+                    seed=7)
+stats = gen.run_closed(n_requests=50, concurrency=10)
+svc.close(drain=True)
+
+assert stats["failed"] == 0, f"failed requests: {stats['errors']}"
+assert stats["succeeded"] == 50, stats
+retraces = obs.value("pyconsensus_jit_retraces_total",
+                     entry="serve_bucket")
+assert retraces == 2, (
+    f"steady-state retraces {retraces} != warmed bucket count 2 — "
+    f"a bucket executable retraced under traffic")
+from pyconsensus_tpu.serve.loadgen import mean_batch_occupancy
+mean_occ = mean_batch_occupancy()
+assert mean_occ and mean_occ > 1.0, \
+    f"coalescing inactive: occupancy {mean_occ}"
+print(f"serve smoke OK: 50/50 succeeded at "
+      f"{stats['throughput_rps']} req/s "
+      f"(p50 {stats['latency_p50_ms']} ms / "
+      f"p99 {stats['latency_p99_ms']} ms), mean occupancy "
+      f"{mean_occ:.2f}, retraces pinned at warmed bucket count (2), "
+      f"drain clean")
+PYEOF
+"$VENV/bin/pyconsensus-serve" --warmup-only --shapes 8x32 >/dev/null && echo "console script pyconsensus-serve OK"
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
   --bench-timeout 300 | tail -1 | "$PY" -c \

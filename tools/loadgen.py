@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Closed/open-loop load generator for the consensus service — thin
+launcher for :mod:`pyconsensus_tpu.serve.loadgen` (the implementation
+lives in the package so the installed ``pyconsensus-serve`` console
+script can reach it; this shim keeps the documented ``tools/loadgen.py``
+front door working from a checkout).
+
+    python tools/loadgen.py --requests 64 --concurrency 8
+"""
+
+import sys
+
+from pyconsensus_tpu.serve.loadgen import main
+
+if __name__ == "__main__":
+    sys.exit(main())
