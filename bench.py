@@ -148,6 +148,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "precision. Pass this flag to measure the "
                          "per-resolution-encode form (the pre-round-5 "
                          "series)")
+    ap.add_argument("--no-device-scaling", action="store_true",
+                    help="skip the device-scaling sweep block (the "
+                         "1/2/4/.../n_devices submesh rates appended to "
+                         "the JSON as 'device_scaling'; only runs when "
+                         "more than one device is visible)")
     ap.add_argument("--no-serve", action="store_true",
                     help="skip the fail-soft serve block (the "
                          "micro-batching service probe appended to the "
@@ -357,13 +362,96 @@ def run_bench(args) -> None:
         "latency_s": round(latency, 4),
         "backend": jax.default_backend(),
         "n_devices": n_dev,
+        # the hot loop's mesh layout — the headline metric must say which
+        # topology it exercised (ROADMAP item 1: n_devices alone hid five
+        # rounds of single-chip serving on an 8-chip-capable stack)
+        "mesh": {"batch": 1, "event": n_dev},
     }
     if pre_encoded:
         out_json["pre_encoded"] = True
         out_json["encode_s"] = round(encode_s, 4)
     out_json["obs"] = _obs_columns(out)
+    out_json["device_scaling"] = _device_scaling_block(args, reports,
+                                                       params, n_dev,
+                                                       value)
     out_json["serve"] = _serve_block(args)
     print(json.dumps(out_json))
+
+
+def _device_scaling_block(args, reports, params, n_dev: int, headline):
+    """Tentpole (c): rates at 1/2/4/.../n_devices submeshes, so the
+    artifact carries the scaling CURVE (is throughput actually following
+    device count, or is the mesh idle?). Every rung — the full mesh
+    included — runs the SAME protocol: re-place the (possibly
+    pre-encoded) device matrix, one compile+warm call, one timed
+    back-to-back batch. A uniform protocol is what makes ratios between
+    rungs meaningful; the (more heavily warmed, median-of-batches)
+    headline is attached to the full-mesh entry as a separate field, not
+    substituted for its measurement. FAIL-SOFT per rung AND bounded
+    overall: a rung failure becomes an error entry, and the sweep stops
+    once its wall budget is spent — the headline metric must never be
+    lost to a scaling probe (the artifact-zeroing lesson of
+    BENCH_r01/r02)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pyconsensus_tpu.parallel import (make_mesh, place_event_bounds,
+                                          sharded_consensus)
+
+    if args.no_device_scaling or n_dev <= 1:
+        return None
+    ladder, d = [], 1
+    while d < n_dev:
+        if n_dev % d == 0:
+            ladder.append(d)
+        d *= 2
+    ladder.append(n_dev)
+    devices = jax.devices()
+    repeats = max(2, min(args.repeats, 8))
+    deadline = time.perf_counter() + min(300.0, args.bench_timeout / 3.0)
+    block = []
+    for d in ladder:
+        entry = {"n_devices": d}
+        if d == n_dev:
+            entry["headline_resolutions_per_sec"] = round(headline, 4)
+        if time.perf_counter() > deadline:
+            entry["resolutions_per_sec"] = None
+            entry["error"] = "skipped: scaling wall budget spent"
+            block.append(entry)
+            continue
+        try:
+            mesh_d = make_mesh(batch=1, event=d, devices=devices[:d])
+            r_d = jax.device_put(reports, jax.sharding.NamedSharding(
+                mesh_d, jax.sharding.PartitionSpec(None, "event")))
+            jax.block_until_ready(r_d)
+            bounds_d = None
+            if args.scaled:
+                E_d = r_d.shape[1]
+                bounds_d = place_event_bounds(
+                    [None] * (E_d - args.scaled)
+                    + [{"scaled": True, "min": -5.0,
+                        "max": 15.0}] * args.scaled, E_d, mesh_d)
+
+            def res():
+                return sharded_consensus(r_d, event_bounds=bounds_d,
+                                         mesh=mesh_d, params=params)
+
+            float(np.asarray(res()["avg_certainty"]))   # compile + warm
+            t0 = time.perf_counter()
+            outs = [res() for _ in range(repeats)]
+            float(np.asarray(
+                jnp.stack([o["avg_certainty"] for o in outs]).sum()))
+            dt = time.perf_counter() - t0
+            entry["resolutions_per_sec"] = round(repeats / dt, 4)
+        except Exception as exc:                      # noqa: BLE001
+            msg = f"{type(exc).__name__}: {exc}"
+            print(f"WARNING: device-scaling rung n_devices={d} failed: "
+                  f"{msg}", file=sys.stderr)
+            entry["resolutions_per_sec"] = None
+            entry["error"] = msg[:300]
+        block.append(entry)
+    return block
 
 
 def _serve_block(args):
@@ -380,10 +468,15 @@ def _serve_block(args):
         from pyconsensus_tpu import obs
         from pyconsensus_tpu.serve import ConsensusService, ServeConfig
         from pyconsensus_tpu.serve.loadgen import (LoadGenerator,
+                                                   device_block,
                                                    mean_batch_occupancy)
 
         shapes = ((24, 96), (48, 192))
-        cfg = ServeConfig(batch_window_ms=2.0, max_batch=8)
+        # sharded_buckets=True (not "auto"): the probe should exercise
+        # the mesh bucket class whenever this process sees >1 device —
+        # including the CI rehearsal's 8 virtual CPU devices
+        cfg = ServeConfig(batch_window_ms=2.0, max_batch=8,
+                          sharded_buckets=True)
         svc = ConsensusService(cfg)
         buckets = svc.buckets_for(shapes)
         svc.warm_buckets(buckets)
@@ -402,10 +495,14 @@ def _serve_block(args):
             "latency_p50_ms": stats["latency_p50_ms"],
             "latency_p99_ms": stats["latency_p99_ms"],
             "mean_batch_occupancy": mean_occ,
+            **device_block(svc),
             "cache_hit_ratio": svc.cache.hit_ratio(),
             "warmed_buckets": len(buckets),
             "retraces": obs.value("pyconsensus_jit_retraces_total",
                                   entry="serve_bucket"),
+            "retraces_sharded": obs.value(
+                "pyconsensus_jit_retraces_total",
+                entry="serve_bucket_sharded"),
         }
     except Exception as exc:                      # noqa: BLE001
         print(f"WARNING: serve block unavailable: "
@@ -448,12 +545,6 @@ def _obs_columns(out) -> dict:
               "entry-point instrumentation emitted nothing this run",
               file=sys.stderr)
         cols["retraces"] = None
-    shards = obs.value("pyconsensus_mesh_event_shards")
-    if shards is None:
-        print("WARNING: expected metric pyconsensus_mesh_event_shards "
-              "absent — sharded dispatch instrumentation emitted nothing",
-              file=sys.stderr)
-    cols["event_shards"] = None if shards is None else int(shards)
     snap = obs.REGISTRY.snapshot().get(
         "pyconsensus_sharded_resolutions_total", {})
     paths = {}
@@ -461,6 +552,19 @@ def _obs_columns(out) -> dict:
         labels = json.loads(skey) if skey else {}
         paths[labels.get("path", "?")] = paths.get(
             labels.get("path", "?"), 0) + int(v)
+    shards = obs.value("pyconsensus_mesh_event_shards")
+    if shards is None:
+        # both the sharded-oracle dispatch (_record_sharded_dispatch) and
+        # the serve/fused bucket dispatch (serve.batcher) emit this gauge
+        # now — name which dispatch(es) actually ran so the warning says
+        # WHERE the instrumentation went missing, not just that it did
+        ran = sorted(set(list(retraces) + list(paths)))
+        print(f"WARNING: expected metric pyconsensus_mesh_event_shards "
+              f"absent — neither the sharded-oracle dispatch nor a "
+              f"sharded bucket dispatch emitted it (dispatches recorded "
+              f"this run: {', '.join(ran) if ran else 'none'})",
+              file=sys.stderr)
+    cols["event_shards"] = None if shards is None else int(shards)
     if paths:
         cols["resolution_paths"] = paths
     else:

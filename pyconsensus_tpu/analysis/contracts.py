@@ -139,8 +139,17 @@ def check_collective_budget(inventory: List[tuple], budget: dict,
       integer-only all-reduces (PRNG-bit / index assemblies — GSPMD
       sometimes expresses an all-gather as a sum-all-reduce of u32
       bits, same bytes on the wire);
+    - ``max_collectives``: bound on the TOTAL collective instruction
+      count in the artifact — the psum-count half of a collective
+      budget (the element bounds above are the bytes half): a new
+      reduction sneaking into a per-sweep loop body shows up here even
+      when its payload is small;
     - ``matrix_backstop``: absolute bound for anything (defaults to
       ``R * E // (2 * n_dev)`` — half a matrix shard).
+
+    Expressions may also use ``B`` (the contract's declared batch-lane
+    capacity, default 1) — mesh-batched entry points carry
+    ``B / n_batch`` lanes of each (R,) partial per psum.
     """
     def ev(expr):
         ns = dict(env, max=max, min=min)
@@ -182,6 +191,15 @@ def check_collective_budget(inventory: List[tuple], budget: dict,
                 out.append(f"{op} ({'/'.join(sorted(dt))}) moving {n} "
                            f"elements (> {bound}): a sharded operand is "
                            f"being re-assembled")
+    if "max_collectives" in budget:
+        bound = ev(budget["max_collectives"])
+        if len(inventory) > bound:
+            counts: Dict[str, int] = {}
+            for op, _, _ in inventory:
+                counts[op] = counts.get(op, 0) + 1
+            out.append(f"{len(inventory)} collective instructions exceed "
+                       f"the declared count budget {bound} ({counts}) — "
+                       f"a reduction crept into the traced path")
     backstop = ev(budget.get(
         "matrix_backstop", "R * E // (2 * n_dev) if n_dev > 1 else R * E"))
     if backstop > 0:
@@ -479,6 +497,83 @@ def _builder_retrace_serve_bucket(spec: dict) -> List[Finding]:
     return findings
 
 
+def _serve_mesh_setup(spec: dict):
+    """Shared (mesh, params, batch capacity) for the sharded serve-bucket
+    builders."""
+    from ..parallel import make_mesh
+
+    mesh = make_mesh(**spec.get("mesh", {"batch": 2, "event": 4}))
+    B = int(spec.get("shape", {}).get("B", 8))
+    return mesh, _params(spec), B
+
+
+def _builder_serve_bucket_sharded(spec: dict) -> str:
+    """The mesh-sharded serving bucket entry point
+    (serve.sharded.make_sharded_bucket_executable): co-batched lanes
+    over the mesh's batch axis, events over its event axis — every
+    psum must carry only (B/n_batch, R) partials or O(1) scalars, and
+    the total psum count per dispatch is pinned."""
+    import jax
+
+    from ..serve.sharded import make_sharded_bucket_executable
+
+    R, E = _shape(spec)
+    mesh, p, B = _serve_mesh_setup(spec)
+    dt = _acc_dtype()
+    fn = make_sharded_bucket_executable(p, mesh, batched=B > 1)
+    lead = (B,) if B > 1 else ()
+    args = (jax.ShapeDtypeStruct(lead + (R, E), dt),
+            jax.ShapeDtypeStruct(lead + (R,), dt),
+            jax.ShapeDtypeStruct(lead + (E,), bool),
+            jax.ShapeDtypeStruct(lead + (E,), dt),
+            jax.ShapeDtypeStruct(lead + (E,), dt),
+            jax.ShapeDtypeStruct(lead + (R,), bool),
+            jax.ShapeDtypeStruct(lead + (E,), bool),
+            jax.ShapeDtypeStruct(lead + (E,), dt))
+    return fn.lower(*args, p).compile().as_text()
+
+
+def _builder_retrace_serve_bucket_sharded(spec: dict) -> List[Finding]:
+    """Dynamic check: two identical sharded bucket dispatches share one
+    jit cache entry — the runtime mirror is the multi-device serve
+    smoke's warmed-bucket retrace pin."""
+    import jax.numpy as jnp
+
+    from ..serve.kernels import bucket_inputs
+    from ..serve.sharded import make_sharded_bucket_executable
+
+    R, E = _shape(spec)
+    mesh, p, B = _serve_mesh_setup(spec)
+    budget = int(spec.get("retrace_budget", 1))
+    rng = np.random.default_rng(0)
+    reports = rng.choice([0.0, 1.0], size=(R, E))
+    reports[0, 0] = np.nan
+    lane = bucket_inputs(reports, np.full(R, 1.0 / R), np.zeros(E, bool),
+                         np.zeros(E), np.ones(E), R, E, has_na=True)
+    args = [jnp.broadcast_to(jnp.asarray(a), (B,) + np.shape(a))
+            for a in lane]
+    fn = make_sharded_bucket_executable(p, mesh, batched=B > 1)
+    before = fn._cache_size()
+    fn(*args, p)
+    mid = fn._cache_size()
+    fn(*args, p)
+    after = fn._cache_size()
+    findings = []
+    if after - mid > 0:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"identical sharded bucket re-dispatch retraced: "
+                    f"cache grew {mid} -> {after}", severity="error",
+            snippet=f"{spec['name']}:recall"))
+    if after - before > budget:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"two dispatches grew the jit cache by "
+                    f"{after - before} (> budget {budget})",
+            severity="error", snippet=f"{spec['name']}:budget"))
+    return findings
+
+
 BUILDERS: Dict[str, Callable] = {
     "pipeline_sharded": _builder_pipeline_sharded,
     "pipeline_single": _builder_pipeline_single,
@@ -490,6 +585,8 @@ BUILDERS: Dict[str, Callable] = {
     "retrace_pipeline": _builder_retrace_pipeline,
     "serve_bucket": _builder_serve_bucket,
     "retrace_serve_bucket": _builder_retrace_serve_bucket,
+    "serve_bucket_sharded": _builder_serve_bucket_sharded,
+    "retrace_serve_bucket_sharded": _builder_retrace_serve_bucket_sharded,
 }
 
 
@@ -513,6 +610,7 @@ def check_artifact(name: str, hlo_text: str, spec: dict) -> List[Finding]:
     R, E = _shape(spec)
     mesh_spec = spec.get("mesh") or {}
     env = {"R": R, "E": E,
+           "B": int(spec.get("shape", {}).get("B", 1)),
            "n_dev": int(mesh_spec.get("batch", 1))
            * int(mesh_spec.get("event", 1)) if mesh_spec else 1}
     path = f"contract:{name}"

@@ -35,6 +35,9 @@ from .loadgen import LoadGenerator
 from .queue import RequestQueue, ResolveRequest
 from .service import ConsensusService, ServeConfig
 from .session import MarketSession, SessionStore
+from .sharded import (SINGLE_TOPOLOGY, make_sharded_bucket_executable,
+                      mesh_fingerprint, serve_mesh,
+                      sharded_bucket_eligible)
 
 __all__ = [
     "ConsensusService", "ServeConfig", "ServiceOverloadError",
@@ -43,4 +46,6 @@ __all__ = [
     "ExecutableCache", "BucketKey", "LoadGenerator",
     "padded_consensus", "make_bucket_executable", "bucket_inputs",
     "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS",
+    "SINGLE_TOPOLOGY", "make_sharded_bucket_executable",
+    "mesh_fingerprint", "serve_mesh", "sharded_bucket_eligible",
 ]

@@ -34,6 +34,7 @@ from ..faults import plan as _faults
 from ..oracle import Oracle, assemble_result, record_consensus_result
 from . import kernels as sk
 from .cache import BucketKey
+from .sharded import SINGLE_TOPOLOGY, topology_event_shards
 
 __all__ = ["Microbatcher", "OCCUPANCY_BUCKETS"]
 
@@ -139,17 +140,21 @@ class Microbatcher:
 
     def _dispatch_bucket(self, group) -> None:
         self._occupancy.observe(len(group))
+        # one label for EVERY outcome of this group (ok/shed/error) — the
+        # coalescer groups by batch_key, so the topology is group-wide
+        key: BucketKey = group[0].batch_key
+        path = ("bucket_sharded" if key.topology != SINGLE_TOPOLOGY
+                else "bucket")
         live = [r for r in group if not r.expired()]
         for r in group:
             if r not in live:
                 self.admission.record_shed("deadline")
                 r.shed("deadline")
-                self._requests.inc(path="bucket", outcome="shed")
+                self._requests.inc(path=path, outcome="shed")
         if not live:
             return
         try:
             _faults.fire("serve.dispatch")
-            key: BucketKey = live[0].batch_key
             capacity = key.batch
             lanes = []
             for r in live:
@@ -159,8 +164,19 @@ class Microbatcher:
             while len(lanes) < capacity:
                 lanes.append(lanes[0])   # pure lanes: replication is free
             entry = self.cache.get(key)
+            if key.topology != SINGLE_TOPOLOGY:
+                # the serve/fused bucket dispatch emits the mesh-width
+                # gauge too (ISSUE 6 satellite) — bench's missing-metric
+                # path must see mesh traffic regardless of which tier
+                # (sharded oracle or sharded bucket) produced it
+                obs.gauge(
+                    "pyconsensus_mesh_event_shards",
+                    "event-axis width of the mesh used by the latest "
+                    "sharded resolution").set(
+                        topology_event_shards(key.topology))
             with obs.span("serve.dispatch",
                           bucket=f"{key.rows}x{key.events}",
+                          topology=key.topology,
                           occupancy=len(live)):
                 if capacity > 1:
                     stacked = [jnp.asarray(np.stack(field))
@@ -175,7 +191,7 @@ class Microbatcher:
             for r in live:
                 if not r.future.done():
                     r.future.set_exception(exc)
-                    self._requests.inc(path="bucket", outcome="error")
+                    self._requests.inc(path=path, outcome="error")
             raise
         for i, r in enumerate(live):
             lane = {k: (v[i] if capacity > 1 else v)
@@ -187,7 +203,7 @@ class Microbatcher:
             result["quarantined_rows"] = r.quarantined_rows
             record_consensus_result(result, key.params.algorithm,
                                     "serve")
-            self._finish(r, result, "bucket")
+            self._finish(r, result, path)
 
     def _dispatch_direct(self, req) -> None:
         _faults.fire("serve.dispatch")

@@ -1,14 +1,22 @@
 """Shape-bucketed executable cache (serve tentpole part b).
 
 One warmed jitted executable per (bucket shape, batch capacity, static
-params) key. Each entry owns a PRIVATE ``jax.jit`` wrapper
-(``kernels.make_bucket_executable``), so LRU eviction actually frees the
-compiled executable instead of leaking it in a process-global cache —
-and the ``--warmup`` preflight can compile the configured buckets before
-the service accepts traffic, the runtime mirror of consensus-lint
-CL304's retrace budget: steady-state serving must show
-``pyconsensus_jit_retraces_total{entry="serve_bucket"}`` pinned at the
-warmed bucket count (the CI smoke asserts exactly that).
+params, topology) key. Each entry owns a PRIVATE ``jax.jit`` wrapper
+(``kernels.make_bucket_executable``, or
+``sharded.make_sharded_bucket_executable`` for mesh-topology keys), so
+LRU eviction actually frees the compiled executable instead of leaking
+it in a process-global cache — and the ``--warmup`` preflight can
+compile the configured buckets before the service accepts traffic, the
+runtime mirror of consensus-lint CL304's retrace budget: steady-state
+serving must show ``pyconsensus_jit_retraces_total`` for the bucket
+entry (``serve_bucket`` / ``serve_bucket_sharded``) pinned at the
+warmed bucket count (the CI smokes assert exactly that).
+
+The topology fingerprint (mesh shape + device kind, ISSUE 6 tentpole
+part b) is part of the key so the LRU can never serve a wrong-topology
+executable: a cache is bound to at most ONE mesh, and a key minted for
+any other topology is rejected loudly instead of silently compiled for
+hardware it was not budgeted for.
 """
 
 from __future__ import annotations
@@ -22,21 +30,30 @@ import numpy as np
 from .. import obs
 from ..faults import plan as _faults
 from . import kernels as sk
+from .sharded import (SINGLE_TOPOLOGY, make_sharded_bucket_executable,
+                      mesh_fingerprint)
 
 __all__ = ["ExecutableCache", "BucketKey"]
 
 
 class BucketKey(tuple):
-    """(rows, events, batch_capacity, params) — hashable cache key.
-    ``params`` is the fully-resolved static ``ConsensusParams`` (a
+    """(rows, events, batch_capacity, params, topology) — hashable cache
+    key. ``params`` is the fully-resolved static ``ConsensusParams`` (a
     NamedTuple, hashable); two tenants with different alphas are two
-    executables, exactly as jit itself would key them."""
+    executables, exactly as jit itself would key them. ``topology`` is
+    the executable's device-topology fingerprint —
+    :data:`~pyconsensus_tpu.serve.sharded.SINGLE_TOPOLOGY` for the
+    single-device kernel, ``sharded.mesh_fingerprint(mesh)`` for the
+    mesh-sharded one — so one bucket shape warmed on two topologies is
+    two distinct executables and can never be cross-served."""
 
     __slots__ = ()
 
     @classmethod
-    def make(cls, rows: int, events: int, batch: int, params):
-        return cls((int(rows), int(events), int(batch), params))
+    def make(cls, rows: int, events: int, batch: int, params,
+             topology: str = SINGLE_TOPOLOGY):
+        return cls((int(rows), int(events), int(batch), params,
+                    str(topology)))
 
     @property
     def rows(self):
@@ -54,17 +71,29 @@ class BucketKey(tuple):
     def params(self):
         return self[3]
 
+    @property
+    def topology(self):
+        return self[4]
+
 
 class ExecutableCache:
     """Bucket-keyed LRU of warmed executables with hit/miss/evict
     metrics. Thread-safe; the compile itself runs outside the lock is
     NOT attempted — the batcher is the only caller, and serializing
-    compiles keeps the retrace accounting exact."""
+    compiles keeps the retrace accounting exact.
 
-    def __init__(self, capacity: int = 64) -> None:
+    ``mesh`` binds the cache to one device topology: keys carrying that
+    mesh's fingerprint build the shard_map executable, single-topology
+    keys build the single-device one, and any OTHER topology is a hard
+    error (the wrong-topology rejection contract)."""
+
+    def __init__(self, capacity: int = 64, mesh=None) -> None:
         if int(capacity) < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
+        self.mesh = mesh
+        self.mesh_topology = (mesh_fingerprint(mesh) if mesh is not None
+                              else None)
         self._entries: OrderedDict = OrderedDict()
         self._lock = threading.RLock()
         self._hits = obs.counter(
@@ -107,8 +136,7 @@ class ExecutableCache:
                 return entry
             self._misses.inc()
             _faults.fire("serve.cache_store")
-            entry = sk.make_bucket_executable(key.params,
-                                              batched=key.batch > 1)
+            entry = self._build(key)
             self._entries[key] = entry
             while len(self._entries) > self.capacity:
                 _, evicted = self._entries.popitem(last=False)
@@ -117,13 +145,34 @@ class ExecutableCache:
             self._size.set(len(self._entries))
             return entry
 
+    def _build(self, key: BucketKey):
+        """Compile the right executable class for ``key`` — or refuse a
+        key minted for a topology this cache does not serve (it could
+        only ever produce an executable compiled for the wrong
+        hardware layout)."""
+        topology = key.topology
+        if topology == SINGLE_TOPOLOGY:
+            return sk.make_bucket_executable(key.params,
+                                             batched=key.batch > 1)
+        if topology != self.mesh_topology:
+            raise ValueError(
+                f"wrong-topology bucket key {topology!r}: this cache "
+                f"serves {self.mesh_topology or SINGLE_TOPOLOGY!r} — a "
+                f"key minted for another mesh/device kind must never "
+                f"reach this executable cache")
+        return make_sharded_bucket_executable(key.params, self.mesh,
+                                              batched=key.batch > 1)
+
     def warm(self, key: BucketKey) -> None:
         """Compile ``key``'s executable AND populate its jit cache by
         running it once on zero inputs (an AOT ``lower().compile()``
         would not seed the ``jit`` call cache, so the first real request
         would compile again). A zero matrix resolves degenerately fast —
         the power loop's zero-covariance guard exits on the first
-        sweep."""
+        sweep. The preflight is per-TOPOLOGY: a mesh-topology key warms
+        the shard_map executable on its mesh (jit places the zero inputs
+        per the shard_map specs), so the first real mesh dispatch pays
+        no compile either."""
         entry = self.get(key)
         rows, events, batch = key.rows, key.events, key.batch
         acc = jnp.asarray(0.0).dtype

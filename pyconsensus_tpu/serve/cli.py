@@ -101,8 +101,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(json.dumps({
             "warmed_buckets": n_warm,
             "cache_size": len(svc.cache),
+            "n_devices": svc.n_devices,
             "retraces": obs.value("pyconsensus_jit_retraces_total",
-                                  entry="serve_bucket")}))
+                                  entry="serve_bucket"),
+            "retraces_sharded": obs.value(
+                "pyconsensus_jit_retraces_total",
+                entry="serve_bucket_sharded")}))
         if args.metrics_out:
             obs.write_prom(args.metrics_out, obs.REGISTRY)
         return 0
@@ -123,12 +127,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "hit_ratio": svc.cache.hit_ratio(),
         "retraces": obs.value("pyconsensus_jit_retraces_total",
                               entry="serve_bucket"),
+        "retraces_sharded": obs.value("pyconsensus_jit_retraces_total",
+                                      entry="serve_bucket_sharded"),
     }
-    from .loadgen import mean_batch_occupancy
+    from .loadgen import device_block, mean_batch_occupancy
 
     occ = mean_batch_occupancy()
     if occ is not None:
         stats["mean_batch_occupancy"] = round(occ, 3)
+    # mesh interpretability (ISSUE 6): throughput numbers mean nothing
+    # without knowing how many devices served them
+    stats.update(device_block(svc))
     print(json.dumps(stats, indent=2))
     if args.metrics_out:
         obs.write_prom(args.metrics_out, obs.REGISTRY)

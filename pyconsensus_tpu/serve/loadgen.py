@@ -21,7 +21,29 @@ import time
 
 import numpy as np
 
-__all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy"]
+__all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy",
+           "device_block"]
+
+
+def device_block(service) -> dict:
+    """The mesh-interpretability columns of a serve summary (ISSUE 6
+    satellite): how many devices the serving mesh spans and the mean
+    co-batched occupancy PER DEVICE LANE SLOT — with the lane axis split
+    over the mesh's batch dimension, a dispatch occupying all 8 lanes of
+    a 2x4 mesh is running 4 requests per event group, so raw occupancy
+    alone overstates per-device load by the batch-axis width. The ONE
+    copy of this derivation, shared by the ``pyconsensus-serve`` / tools
+    loadgen summaries and the bench ``serve`` block."""
+    n = getattr(service, "n_devices", 1)
+    mesh = getattr(service, "mesh", None)
+    n_batch = int(mesh.shape.get("batch", 1)) if mesh is not None else 1
+    occ = mean_batch_occupancy()
+    return {
+        "n_devices": int(n),
+        "mesh_batch_lanes": n_batch,
+        "per_device_occupancy": (None if occ is None
+                                 else round(occ / n_batch, 3)),
+    }
 
 
 def mean_batch_occupancy():
@@ -227,6 +249,7 @@ def main(argv=None) -> int:
     else:
         stats = gen.run_closed(args.requests, args.concurrency)
     svc.close(drain=True)
+    stats.update(device_block(svc))
     print(json.dumps(stats, indent=2))
     return 0
 

@@ -43,6 +43,8 @@ from .cache import BucketKey, ExecutableCache
 from .kernels import bucket_path_eligible
 from .queue import RequestQueue, ResolveRequest
 from .session import SessionStore
+from .sharded import (SINGLE_TOPOLOGY, mesh_fingerprint, serve_mesh,
+                      sharded_bucket_eligible)
 
 __all__ = ["ServeConfig", "ConsensusService"]
 
@@ -82,6 +84,18 @@ class ServeConfig:
     warmup: tuple = ()
     #: default compute backend for requests that do not name one
     backend: str = "jax"
+    #: mesh-sharded bucket policy (ISSUE 6): "auto" puts eligible
+    #: buckets on the device mesh when the process owns a multi-device
+    #: TPU backend; True forces the mesh whenever >1 device exists (the
+    #: fake-device CPU test/CI meshes); False pins every bucket to the
+    #: single-device kernel. Eligibility per bucket is
+    #: ``sharded.sharded_bucket_eligible`` (event width divisible over
+    #: the mesh's event axis, capacity over its batch axis) — small
+    #: buckets stay single-device as the documented low-latency class.
+    sharded_buckets: object = "auto"
+    #: mesh batch-axis width (0 = auto: 2 x (n/2) when the device count
+    #: and batch capacity split evenly, else 1 x n)
+    mesh_batch: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServeConfig":
@@ -116,7 +130,9 @@ class ConsensusService:
         if self.config.max_batch < 1:
             raise InputError("max_batch must be >= 1")
         self.queue = RequestQueue(self.config.max_queue)
-        self.cache = ExecutableCache(self.config.cache_capacity)
+        self.mesh = self._build_mesh()
+        self.cache = ExecutableCache(self.config.cache_capacity,
+                                     mesh=self.mesh)
         self.admission = AdmissionController(self.config.rate_limit_rps,
                                              self.config.rate_burst)
         self.sessions = SessionStore()
@@ -124,6 +140,37 @@ class ConsensusService:
                                     self.sessions, self.admission)
         self._started = False
         self._start_lock = threading.Lock()
+
+    def _build_mesh(self):
+        """The serving mesh per the ``sharded_buckets`` policy: "auto"
+        engages only on a multi-device TPU backend (the production
+        setting — CPU test hosts with forced virtual devices keep their
+        single-device contracts untouched), True engages on any
+        multi-device backend, False never."""
+        mode = self.config.sharded_buckets
+        if mode is False:
+            return None
+        if mode == "auto":
+            import jax
+
+            if jax.default_backend() != "tpu":
+                return None
+        elif mode is not True:
+            raise InputError(
+                f"sharded_buckets must be 'auto', True or False, "
+                f"got {mode!r}")
+        return serve_mesh(self.config.max_batch,
+                          mesh_batch=self.config.mesh_batch)
+
+    @property
+    def n_devices(self) -> int:
+        """Devices the serving mesh spans (1 = single-device buckets) —
+        the loadgen/CLI summary column that makes throughput numbers
+        interpretable on a mesh."""
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape.get("batch", 1)
+                   * self.mesh.shape.get("event", 1))
 
     # -- lifecycle ------------------------------------------------------
 
@@ -201,8 +248,12 @@ class ConsensusService:
             any_scaled=any_scaled, n_scaled=n_scaled,
             **{k: v for k, v in oracle_kwargs.items()
                if k in _BUCKET_KWARGS})
+        topology = SINGLE_TOPOLOGY
+        if sharded_bucket_eligible(bucket[1], self.config.max_batch, p,
+                                   self.mesh):
+            topology = mesh_fingerprint(self.mesh)
         return BucketKey.make(bucket[0], bucket[1],
-                              self.config.max_batch, p)
+                              self.config.max_batch, p, topology)
 
     def _derive(self, req: ResolveRequest, oracle_kwargs: dict) -> None:
         """Classify and prepare a matrix request: validate, quarantine

@@ -1,0 +1,403 @@
+"""Mesh-sharded serving hot path: the padded bucket kernel under
+``shard_map`` (ISSUE 6 tentpole part a).
+
+``kernels.padded_consensus`` is the single-device bucket entry point —
+one compiled executable per shape bucket, every request padded up to
+the bucket with validity masks. This module is the SAME kernel placed
+over the device mesh: the masked power/dirfix/row-reward body runs per
+event shard under :func:`jax.shard_map`, every cross-event reduction is
+an explicit ``psum`` (reusing ``parallel.fused_sharded``'s
+``_sharded_power`` / ``_psum`` / ``_canon_sign_sharded`` machinery),
+and the co-batched lane axis is data-parallel over the mesh's "batch"
+dimension — a 2x4 (batch x event) layout on an 8-device host, so one
+bucketed dispatch drives all eight chips.
+
+The parity contract is the single-device bucket contract, one level up
+(pinned by tests/test_serve_sharded.py on the 8-fake-device CPU mesh):
+
+- **discrete answers are exact**: catch-snapped outcomes and iteration
+  counts are bit-identical to the single-device bucket kernel (and
+  therefore to a direct ``Oracle`` resolution) — the catch/median/
+  dirfix tie bands make every snap decision reduction-order stable, so
+  splitting the event-axis sums into per-shard partials + a psum cannot
+  flip them;
+- **continuous tails** (reputations, certainty, bonuses) sit within the
+  documented GSPMD tiling band: a psum associates the same sums
+  differently than one device's fused reduction, exactly the ulp-scale
+  drift two differently-compiled single-device graphs already show;
+- **pad shards contribute exactly zero**: the bucket's validity masks
+  survive the mesh unchanged. Pad COLUMNS are present-zero columns
+  (exactly-zero deviation columns whose psum partials are exact zeros)
+  and the zero-extended power seed keeps their loading entries exactly
+  zero through every sweep; pad ROWS are masked out of the score/
+  direction-fix statistics before any replicated reduction, identically
+  on every shard. Nothing needs re-masking after a collective because
+  nothing nonzero ever enters one.
+
+Policy (tentpole part b, enforced by ``sharded_bucket_eligible`` /
+``ConsensusService``): the mesh path requires the bucket's event width
+to divide over the mesh's event axis and the batch capacity to divide
+over its batch axis. Small buckets (``E < n_event`` — which always
+fails divisibility) stay on the single-device kernel as the documented
+low-latency class: at those widths the per-sweep psum latency exceeds
+the matvec it would parallelize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import obs
+from ..models.pipeline import ConsensusParams
+from ..ops import jax_kernels as jk
+from ..ops import numpy_kernels as nk
+from ..parallel.fused_sharded import (_canon_sign_sharded, _guard_div,
+                                      _psum, _sharded_power)
+from ..parallel.mesh import Mesh, make_mesh
+from ..parallel.ring import shard_map
+from . import kernels as sk
+
+__all__ = ["SINGLE_TOPOLOGY", "serve_mesh", "mesh_fingerprint",
+           "topology_event_shards", "topology_n_devices",
+           "sharded_bucket_eligible", "make_sharded_bucket_executable",
+           "padded_consensus_lane"]
+
+#: the topology fingerprint of a single-device bucket executable — the
+#: default BucketKey topology, and the only one a mesh-less cache serves
+SINGLE_TOPOLOGY = "single"
+
+
+def mesh_fingerprint(mesh: Mesh) -> str:
+    """``"<device-kind>:<batch>x<event>"`` — the BucketKey topology of a
+    mesh-sharded bucket executable. Device kind is part of the key so an
+    executable compiled for one accelerator generation can never be
+    served on another (the cache rejects, it does not recompile)."""
+    kind = str(mesh.devices.flat[0].device_kind).replace(" ", "-")
+    return (f"{kind}:{mesh.shape.get('batch', 1)}"
+            f"x{mesh.shape.get('event', 1)}")
+
+
+def _topology_shape(topology: str):
+    if topology == SINGLE_TOPOLOGY:
+        return 1, 1
+    b, e = topology.rsplit(":", 1)[1].split("x")
+    return int(b), int(e)
+
+
+def topology_event_shards(topology: str) -> int:
+    """Event-axis width encoded in a BucketKey topology (1 for the
+    single-device class) — the ``pyconsensus_mesh_event_shards`` value a
+    bucketed dispatch reports."""
+    return _topology_shape(topology)[1]
+
+
+def topology_n_devices(topology: str) -> int:
+    """Total devices a BucketKey topology spans (1 for single-device)."""
+    b, e = _topology_shape(topology)
+    return b * e
+
+
+def serve_mesh(max_batch: int, devices=None,
+               mesh_batch: int = 0) -> Optional[Mesh]:
+    """The serving mesh for this process, or None on a single device.
+
+    Layout: ``mesh_batch`` pins the batch-axis width explicitly; 0 picks
+    the 2 x (n/2) layout whenever both the device count and the batch
+    capacity split evenly (the 2x4 layout on an 8-device host — half the
+    co-batched lanes per event group halves each psum payload), else a
+    pure event mesh ``1 x n``."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n < 2:
+        return None
+    if mesh_batch:
+        batch = int(mesh_batch)
+        if n % batch or max_batch % batch:
+            raise ValueError(
+                f"mesh_batch={batch} must divide both the device count "
+                f"({n}) and max_batch ({max_batch})")
+    else:
+        batch = 2 if (n >= 4 and n % 2 == 0 and max_batch % 2 == 0) else 1
+    return make_mesh(batch=batch, event=n // batch, devices=devices)
+
+
+def sharded_bucket_eligible(events: int, batch_capacity: int,
+                            p: ConsensusParams,
+                            mesh: Optional[Mesh]) -> bool:
+    """Whether a (bucket, capacity, params) may ride the mesh-sharded
+    bucket executable — the ONE copy of the mesh-path routing rule
+    (service key derivation and the tests share it). Requires a mesh,
+    the kernel-eligible params family (the same family
+    ``padded_consensus`` scores), an event width divisible over the
+    mesh's event axis (small ``E < n_event`` buckets always fail this —
+    the documented single-device low-latency class), and a batch
+    capacity divisible over its batch axis."""
+    if mesh is None:
+        return False
+    n_event = mesh.shape.get("event", 1)
+    n_batch = mesh.shape.get("batch", 1)
+    return (p.algorithm in sk.SERVE_ALGORITHMS
+            and p.pca_method == "power"
+            and p.storage_dtype != "int8"
+            and events % n_event == 0
+            and batch_capacity % n_batch == 0)
+
+
+# -- the per-shard lane body ----------------------------------------------
+
+
+def padded_consensus_lane(reports, reputation, scaled, mins, maxs,
+                          row_valid, col_valid, seed, p: ConsensusParams):
+    """One lane of :func:`kernels.padded_consensus`, per event shard:
+    every event-axis operand is the LOCAL ``(E_loc,)`` slice of the
+    bucket-shaped input, every cross-event reduction is an explicit
+    ``psum`` over the "event" mesh axis, and every O(R) quantity is
+    computed replicated (identically on each shard, from psum'd
+    partials). Runs under ``shard_map`` (vmapped over the local lane
+    block when batched)."""
+    acc = reputation.dtype
+    n_rows_f = jnp.sum(row_valid.astype(acc))
+    n_cols_f = _psum(jnp.sum(col_valid.astype(acc)))
+    old_rep = jk.normalize(reputation)
+    rescaled = (jk.rescale(reports, scaled, mins, maxs) if p.any_scaled
+                else reports)
+    if p.has_na:
+        # column-local: the fill statistics reduce over rows only
+        filled, present = jk.interpolate_masked(rescaled, old_rep, scaled,
+                                                p.catch_tolerance)
+    else:
+        filled, present = rescaled, None
+    if p.storage_dtype:
+        filled = filled.astype(jnp.dtype(p.storage_dtype))
+
+    E_loc = filled.shape[1]
+    e_start = (lax.axis_index("event") * E_loc).astype(jnp.int32)
+    # the zero-extended TRUE-width power seed (kernels.bucket_inputs)
+    # arrives event-sharded; its global unit form is the degenerate-
+    # covariance fallback direction — exactly zero on pad columns, so no
+    # post-collective re-masking is ever needed
+    sn = jnp.sqrt(_psum(jnp.sum(seed * seed)))
+    base_unit = seed / jnp.where(sn == 0.0, 1.0, sn)
+
+    def scores_at(rep_k, v_init):
+        """_masked_power_scores + _masked_dirfix with the event axis
+        sharded: per-sweep collectives carry one (R,) partial + O(1)
+        scalars, the direction-fix decision one stacked scalar pair."""
+        mu = rep_k @ filled                         # (E_loc,) local
+        denom = 1.0 - jnp.sum(rep_k ** 2)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        mm = jk.matvec_narrow(filled, p.matvec_dtype)
+
+        def apply_cov(v_loc):
+            t_part = jnp.matmul(mm, v_loc.astype(mm.dtype),
+                                preferred_element_type=acc)
+            muv_part = mu @ v_loc
+            t, muv = _psum((t_part, muv_part))
+            rt = rep_k * (t - muv)                  # (R,) replicated
+            y = (jnp.matmul(mm.T, rt.astype(mm.dtype),
+                            preferred_element_type=acc)
+                 - mu * jnp.sum(rt))
+            return y / denom
+
+        loading = _sharded_power(apply_cov, seed, base_unit,
+                                 p.power_iters, p.power_tol, v_init=v_init)
+        s_part = jnp.matmul(filled, loading.astype(filled.dtype),
+                            preferred_element_type=acc)
+        ml_part = mu @ loading
+        s_raw, ml = _psum((s_part, ml_part))
+        scores = s_raw - ml                         # (R,) replicated
+        # pad rows project to garbage — zero them BEFORE the direction-
+        # fix statistics (the single-device kernel's n_rows rule)
+        scores = jnp.where(row_valid, scores, 0.0)
+        scores = jk.canon_sign(scores)              # replicated: plain form
+        a1 = jnp.abs(jnp.min(jnp.where(row_valid, scores, jnp.inf)))
+        a2 = jnp.max(jnp.where(row_valid, scores, -jnp.inf))
+        set1 = jnp.where(row_valid, scores + a1, 0.0)
+        set2 = jnp.where(row_valid, scores - a2, 0.0)
+        W = jnp.stack([rep_k.astype(acc), jk.normalize(set1),
+                       jk.normalize(set2)])
+        M = jnp.matmul(W.astype(filled.dtype), filled,
+                       preferred_element_type=acc)  # (3, E_loc) local
+        d1 = jnp.sum((M[1] - M[0]) ** 2)            # pad cols: exact zeros
+        d2 = jnp.sum((M[2] - M[0]) ** 2)
+        d = _psum(jnp.stack([d1, d2]))
+        adj = jnp.where(d[0] - d[1] <= nk.DIRFIX_TIE_ATOL * (d[0] + d[1]),
+                        set1, -set2)
+        return adj, loading
+
+    def step(carry, _):
+        rep_c, this_prev, loading_prev, converged, iters = carry
+        adj, loading = scores_at(rep_c, loading_prev)
+        this_rep = sk._masked_row_reward(adj, rep_c, n_rows_f)
+        new_rep = jk.smooth(this_rep, rep_c, p.alpha)
+        delta = jnp.max(jnp.abs(new_rep - rep_c))
+        rep_out = jnp.where(converged, rep_c, new_rep)
+        this_out = jnp.where(converged, this_prev, this_rep)
+        loading_out = jnp.where(converged, loading_prev, loading)
+        iters_out = jnp.where(converged, iters, iters + 1)
+        conv_out = converged | (delta <= p.convergence_tolerance)
+        return (rep_out, this_out, loading_out, conv_out, iters_out), None
+
+    init = (old_rep, old_rep, jnp.zeros((E_loc,), dtype=acc),
+            jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+    (rep, this_rep, loading, converged, iters), _ = lax.scan(
+        step, init, None, length=max(p.max_iterations, 1))
+
+    # outcome resolution is column-local given the replicated reputation
+    # (weighted means/medians and the catch snap reduce over rows only);
+    # n_scaled=0 forces the full-width per-shard median — a static gather
+    # keyed on the GLOBAL scaled count cannot be applied to a shard slice
+    outcomes_raw, outcomes_adjusted = jk.resolve_outcomes(
+        present, filled, rep, scaled, p.catch_tolerance,
+        any_scaled=p.any_scaled, has_na=p.has_na,
+        median_block=p.median_block, n_scaled=0)
+    outcomes_final = (jk.unscale_outcomes(outcomes_adjusted, scaled, mins,
+                                          maxs)
+                      if p.any_scaled else outcomes_adjusted)
+    extras = _masked_bonuses_sharded(present, filled, rep,
+                                     outcomes_adjusted, scaled, row_valid,
+                                     col_valid, n_rows_f, n_cols_f, p)
+    result = {
+        "old_rep": old_rep,
+        "this_rep": this_rep,
+        "smooth_rep": rep,
+        "outcomes_raw": outcomes_raw,
+        "outcomes_adjusted": outcomes_adjusted,
+        "outcomes_final": outcomes_final,
+        "iterations": iters,
+        "convergence": converged,
+        "first_loading": _canon_sign_sharded(loading, e_start, E_loc),
+    }
+    result.update(extras)
+    return result
+
+
+def _masked_bonuses_sharded(present, filled, rep_f, outcomes_adjusted,
+                            scaled, row_valid, col_valid, n_rows_f,
+                            n_cols_f, p: ConsensusParams):
+    """``kernels._masked_bonuses`` with the event axis sharded: the
+    per-column quantities stay shard-local, every cross-column aggregate
+    is a masked local partial + psum (pad columns are zeroed BEFORE the
+    collective, so their contribution is exactly zero)."""
+    dtype = rep_f.dtype
+    tolerance = p.catch_tolerance
+    agree = jnp.where(
+        scaled[None, :],
+        jnp.abs(filled.astype(dtype)
+                - outcomes_adjusted[None, :]) <= tolerance,
+        filled.astype(dtype) == outcomes_adjusted[None, :])
+    certainty = jnp.sum(agree * rep_f[:, None], axis=0)
+    certainty = jnp.where(col_valid, certainty, 0.0)
+    cert_sum = _psum(jnp.sum(certainty))
+    consensus_reward = _guard_div(certainty, cert_sum)
+    avg_certainty = cert_sum / n_cols_f
+    if p.has_na:
+        na_mat = (~present).astype(dtype)
+        participation_columns = 1.0 - rep_f @ na_mat
+        prow = _psum(na_mat @ consensus_reward)     # (R,) replicated
+        participation_rows = jnp.where(row_valid, 1.0 - prow, 0.0)
+        pc_masked = jnp.where(col_valid, participation_columns, 0.0)
+        pc_sum = _psum(jnp.sum(pc_masked))
+        percent_na = 1.0 - pc_sum / n_cols_f
+        na_bonus_rows = jk.normalize(participation_rows)
+        reporter_bonus = (na_bonus_rows * percent_na
+                          + rep_f * (1.0 - percent_na))
+        na_bonus_cols = _guard_div(pc_masked, pc_sum)
+        author_bonus = (na_bonus_cols * percent_na
+                        + consensus_reward * (1.0 - percent_na))
+        # row-axis NA counts as an MXU matvec (jk.row_any's rationale),
+        # summed across shards before the threshold
+        na_count = jnp.matmul(na_mat, jnp.ones((na_mat.shape[1],), dtype))
+        na_row = _psum(na_count) > 0.0
+    else:
+        R_b, E_loc = filled.shape
+        participation_columns = jnp.ones((E_loc,), dtype=dtype)
+        participation_rows = jnp.ones((R_b,), dtype=dtype)
+        percent_na = jnp.asarray(0.0, dtype=dtype)
+        na_bonus_rows = jnp.full((R_b,), 1.0, dtype) / n_rows_f
+        reporter_bonus = rep_f
+        na_bonus_cols = jnp.full((E_loc,), 1.0, dtype) / n_cols_f
+        author_bonus = consensus_reward
+        na_row = jnp.zeros((R_b,), dtype=bool)
+    return {
+        "certainty": certainty,
+        "consensus_reward": consensus_reward,
+        "avg_certainty": avg_certainty,
+        "participation_columns": participation_columns,
+        "participation_rows": participation_rows,
+        "percent_na": percent_na,
+        "na_bonus_rows": na_bonus_rows,
+        "reporter_bonus": reporter_bonus,
+        "na_bonus_cols": na_bonus_cols,
+        "author_bonus": author_bonus,
+        "na_row": na_row,
+    }
+
+
+#: result keys that are per-event vectors (event-sharded under the mesh);
+#: scalars are listed separately, everything else is an O(R) vector
+_EVENT_KEYS = frozenset(sk._COL_KEYS)
+_SCALAR_KEYS = frozenset(["iterations", "convergence", "percent_na",
+                          "avg_certainty"])
+_RESULT_KEYS = tuple(sk._ROW_KEYS) + tuple(sk._COL_KEYS) + (
+    "iterations", "convergence", "percent_na", "avg_certainty")
+
+
+def _out_specs(batched: bool):
+    def spec(k):
+        lead = ("batch",) if batched else ()
+        if k in _EVENT_KEYS:
+            return P(*lead, "event")
+        if k in _SCALAR_KEYS:
+            return P(*lead)
+        return P(*lead)                     # O(R) vectors: replicated
+    return {k: spec(k) for k in _RESULT_KEYS}
+
+
+def make_sharded_bucket_executable(p: ConsensusParams, mesh: Mesh,
+                                   batched: bool = False):
+    """A FRESH jitted shard_map executable for one mesh-topology cache
+    entry — same call signature as ``kernels.make_bucket_executable``
+    (``fn(*bucket_arrays, p)`` with ``p`` static), so the batcher and
+    the warmup preflight drive both classes identically. Instrumented
+    under the ``serve_bucket_sharded`` entry label: after warmup the
+    retrace counter equals the number of compiled sharded buckets and
+    must stay there under steady traffic (the runtime CL304 invariant
+    the multi-device CI smoke pins)."""
+    built_p = p
+    lane = functools.partial(jk.exact_matmuls(padded_consensus_lane), p=p)
+    if batched:
+        body = jax.vmap(lane)
+        in_specs = (P("batch", None, "event"), P("batch"),
+                    P("batch", "event"), P("batch", "event"),
+                    P("batch", "event"), P("batch"),
+                    P("batch", "event"), P("batch", "event"))
+    else:
+        body = lane
+        in_specs = (P(None, "event"), P(), P("event"), P("event"),
+                    P("event"), P(), P("event"), P("event"))
+    mapped = shard_map(body, mesh, in_specs, _out_specs(batched))
+
+    def fn(reports, reputation, scaled, mins, maxs, row_valid, col_valid,
+           seed, p):
+        # ``p`` rides along (static) purely for call-compat with the
+        # single-device executable; the shard_map closure owns the real
+        # params — a mismatch would silently compute with the build-time
+        # params under a fresh cache key, so refuse it loudly (checked
+        # at trace time: identical p never re-enters here)
+        if p != built_p:
+            raise ValueError(
+                f"sharded bucket executable was built for params "
+                f"{built_p!r} but called with {p!r} — the cache builds "
+                f"one executable per params; mint a new key instead")
+        return mapped(reports, reputation, scaled, mins, maxs, row_valid,
+                      col_valid, seed)
+
+    return obs.instrument_jit(
+        jax.jit(fn, static_argnames=("p",)), "serve_bucket_sharded")

@@ -1,0 +1,347 @@
+"""Mesh-sharded serving hot path (ISSUE 6): the padded bucket kernel
+under shard_map on the 8-fake-device CPU mesh.
+
+Pins the tentpole's parity contract — sharded-vs-single-device bucket
+dispatches agree on catch-snapped outcomes and iteration counts
+BIT-IDENTICALLY (the tie bands make every snap reduction-order stable),
+continuous tails within the documented GSPMD tiling band — plus
+batch-composition determinism on the mesh (co-batched lanes never
+change a request's bits), the topology-aware cache policy (wrong-
+topology keys rejected, divisibility gate routing), and the serve-side
+``pyconsensus_mesh_event_shards`` gauge emission.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from conftest import collusion_reports
+from pyconsensus_tpu import Oracle, obs
+from pyconsensus_tpu.models.pipeline import ConsensusParams
+from pyconsensus_tpu.parallel import make_mesh
+from pyconsensus_tpu.serve import (BucketKey, ConsensusService,
+                                   ExecutableCache, ServeConfig)
+from pyconsensus_tpu.serve import kernels as sk
+from pyconsensus_tpu.serve import sharded as ss
+
+#: continuous tails across differently-reduced graphs (the fused_sharded
+#: parity band — psum association vs one device's fused reduction)
+SHARD_ATOL = 5e-6
+
+#: result keys compared within the band (everything continuous)
+_BAND_KEYS = ("old_rep", "this_rep", "smooth_rep", "certainty",
+              "consensus_reward", "participation_rows",
+              "participation_columns", "na_bonus_rows", "na_bonus_cols",
+              "reporter_bonus", "author_bonus", "percent_na",
+              "avg_certainty")
+
+
+def serve_params(**kw):
+    kw.setdefault("algorithm", "sztorc")
+    kw.setdefault("pca_method", "power")
+    kw.setdefault("has_na", True)
+    kw.setdefault("any_scaled", False)
+    kw.setdefault("n_scaled", 0)
+    return ConsensusParams(**kw)
+
+
+def bucket_args(reports, rep, scaled, mins, maxs, bucket, has_na=True):
+    return [jnp.asarray(a) for a in sk.bucket_inputs(
+        reports, rep, scaled, mins, maxs, bucket[0], bucket[1],
+        has_na=has_na)]
+
+
+def run_pair(args, p, mesh):
+    """One unbatched dispatch through both kernel classes."""
+    single = sk.make_bucket_executable(p)(*args, p)
+    sharded = ss.make_sharded_bucket_executable(p, mesh,
+                                                batched=False)(*args, p)
+    return ({k: np.asarray(v) for k, v in sharded.items()},
+            {k: np.asarray(v) for k, v in single.items()})
+
+
+def assert_bucket_parity(sharded, single, scaled=None):
+    binary = (slice(None) if scaled is None
+              else ~np.asarray(scaled, dtype=bool))
+    for key in ("outcomes_adjusted", "outcomes_final"):
+        np.testing.assert_array_equal(sharded[key][binary],
+                                      single[key][binary], err_msg=key)
+    if scaled is not None:
+        sc = np.asarray(scaled, dtype=bool)
+        for key in ("outcomes_raw", "outcomes_adjusted", "outcomes_final"):
+            np.testing.assert_allclose(sharded[key][sc], single[key][sc],
+                                       atol=SHARD_ATOL, err_msg=key)
+    assert sharded["iterations"] == single["iterations"]
+    assert sharded["convergence"] == single["convergence"]
+    np.testing.assert_array_equal(sharded["na_row"], single["na_row"])
+    for key in _BAND_KEYS:
+        np.testing.assert_allclose(sharded[key], single[key],
+                                   atol=SHARD_ATOL, err_msg=key)
+
+
+class TestShardedBucketParity:
+    @pytest.mark.parametrize("bucket", [(16, 64), (32, 128), (8, 32)])
+    @pytest.mark.parametrize("layout", [(1, 8), (2, 4)])
+    def test_binary_na_across_buckets_and_layouts(self, rng, bucket,
+                                                  layout):
+        R, E = bucket[0] - 3, bucket[1] - 9
+        reports, _ = collusion_reports(rng, R, E, liars=max(2, R // 4),
+                                       na_frac=0.12)
+        p = serve_params()
+        args = bucket_args(reports, np.full(R, 1.0 / R),
+                           np.zeros(E, bool), np.zeros(E), np.ones(E),
+                           bucket)
+        mesh = make_mesh(batch=layout[0], event=layout[1])
+        sharded, single = run_pair(args, p, mesh)
+        assert_bucket_parity(sharded, single)
+
+    def test_scaled_bucket(self, rng):
+        R, E, bucket = 13, 50, (16, 64)
+        reports, _ = collusion_reports(rng, R, E, liars=4, na_frac=0.1)
+        scaled = np.zeros(E, bool)
+        scaled[[3, 20, 41]] = True
+        mins = np.where(scaled, -5.0, 0.0)
+        maxs = np.where(scaled, 15.0, 1.0)
+        with np.errstate(invalid="ignore"):
+            reports[:, scaled] = reports[:, scaled] * 20.0 - 5.0
+        p = serve_params(any_scaled=True, n_scaled=3)
+        args = bucket_args(reports, np.full(R, 1.0 / R), scaled, mins,
+                           maxs, bucket)
+        mesh = make_mesh(batch=2, event=4)
+        sharded, single = run_pair(args, p, mesh)
+        # the bucket-shaped scaled mask (padded with False)
+        assert_bucket_parity(sharded, single, scaled=np.asarray(args[2]))
+
+    def test_iterative_loop_iterations_pinned(self, rng):
+        R, E, bucket = 12, 48, (16, 64)
+        reports, _ = collusion_reports(rng, R, E, liars=4, na_frac=0.1)
+        p = serve_params(max_iterations=5)
+        args = bucket_args(reports, np.full(R, 1.0 / R),
+                           np.zeros(E, bool), np.zeros(E), np.ones(E),
+                           bucket)
+        mesh = make_mesh(batch=2, event=4)
+        sharded, single = run_pair(args, p, mesh)
+        assert_bucket_parity(sharded, single)
+        assert sharded["iterations"] >= 1
+
+    def test_dense_exact_fit(self, rng):
+        """has_na=False (dense request, exact-fit rows): the elided-fill
+        arithmetic must shard identically."""
+        R, E = 16, 64
+        reports, _ = collusion_reports(rng, R, E, liars=4, na_frac=0.0)
+        p = serve_params(has_na=False)
+        args = bucket_args(reports, np.full(R, 1.0 / R),
+                           np.zeros(E, bool), np.zeros(E), np.ones(E),
+                           (R, E), has_na=False)
+        mesh = make_mesh(batch=1, event=8)
+        sharded, single = run_pair(args, p, mesh)
+        assert_bucket_parity(sharded, single)
+        assert sharded["percent_na"] == pytest.approx(0.0, abs=1e-12)
+
+    def test_nonuniform_reputation(self, rng):
+        R, E, bucket = 14, 40, (16, 64)
+        reports, _ = collusion_reports(rng, R, E, liars=4, na_frac=0.15)
+        rep = rng.random(R) + 0.05
+        rep = rep / rep.sum()
+        p = serve_params()
+        args = bucket_args(reports, rep, np.zeros(E, bool), np.zeros(E),
+                           np.ones(E), bucket)
+        mesh = make_mesh(batch=2, event=4)
+        sharded, single = run_pair(args, p, mesh)
+        assert_bucket_parity(sharded, single)
+
+
+class TestShardedBatchLanes:
+    """Co-batched lanes on the mesh's batch axis: every lane must be a
+    pure function of its own inputs — bit-identical to the unbatched
+    single-device kernel on that lane's inputs, in any lane position,
+    with any co-batched partners."""
+
+    def _lanes(self, rng, n, R=12, E=48):
+        out = []
+        for i in range(n):
+            r = np.random.default_rng(700 + i)
+            m, _ = collusion_reports(r, R, E, liars=4, na_frac=0.1)
+            out.append(m)
+        return out
+
+    def test_each_lane_matches_single_device(self, rng):
+        B, bucket = 4, (16, 64)
+        p = serve_params()
+        mesh = make_mesh(batch=2, event=4)
+        lanes = [bucket_args(m, np.full(12, 1.0 / 12), np.zeros(48, bool),
+                             np.zeros(48), np.ones(48), bucket)
+                 for m in self._lanes(rng, B)]
+        stacked = [jnp.stack(field) for field in zip(*lanes)]
+        batched = ss.make_sharded_bucket_executable(p, mesh, batched=True)(
+            *stacked, p)
+        batched = {k: np.asarray(v) for k, v in batched.items()}
+        single_fn = sk.make_bucket_executable(p)
+        for i, lane in enumerate(lanes):
+            ref = {k: np.asarray(v) for k, v in single_fn(*lane, p).items()}
+            np.testing.assert_array_equal(
+                batched["outcomes_adjusted"][i], ref["outcomes_adjusted"],
+                err_msg=f"lane {i}")
+            assert batched["iterations"][i] == ref["iterations"]
+            np.testing.assert_allclose(batched["smooth_rep"][i],
+                                       ref["smooth_rep"], atol=SHARD_ATOL)
+
+    def test_batch_composition_determinism_on_mesh(self, rng):
+        """The service-level contract, on the mesh: the same request
+        dispatched solo, co-batched, and in a fresh service produces
+        bit-identical FULL results."""
+        reports, _ = collusion_reports(rng, 12, 48, liars=4, na_frac=0.1)
+        others = [collusion_reports(np.random.default_rng(80 + i), 12, 48,
+                                    liars=4, na_frac=0.1)[0]
+                  for i in range(5)]
+        cfg = ServeConfig(batch_window_ms=20.0, max_batch=8,
+                          sharded_buckets=True)
+        outs = []
+        with ConsensusService(cfg) as svc:
+            assert svc.mesh is not None
+            outs.append(svc.submit(reports=reports).result(timeout=120))
+        with ConsensusService(cfg) as svc:
+            futs = [svc.submit(reports=m) for m in [reports] + others]
+            outs.append(futs[0].result(timeout=120))
+        with ConsensusService(cfg) as svc:
+            outs.append(svc.submit(reports=reports).result(timeout=120))
+        first = outs[0]
+        for other in outs[1:]:
+            for section in ("agents", "events"):
+                for key, v in first[section].items():
+                    np.testing.assert_array_equal(
+                        np.asarray(v), np.asarray(other[section][key]),
+                        err_msg=f"{section}.{key}")
+            assert other["certainty"] == first["certainty"]
+            assert other["iterations"] == first["iterations"]
+
+
+class TestServiceMeshPolicy:
+    def test_eligibility_gate(self):
+        mesh = make_mesh(batch=2, event=4)
+        p = serve_params()
+        assert ss.sharded_bucket_eligible(64, 8, p, mesh)
+        # event width must divide over the event axis
+        assert not ss.sharded_bucket_eligible(66, 8, p, mesh)
+        # small E < n_event is the documented single-device class
+        assert not ss.sharded_bucket_eligible(2, 8, p, mesh)
+        # capacity must divide over the batch axis
+        assert not ss.sharded_bucket_eligible(64, 3, p, mesh)
+        # no mesh -> never
+        assert not ss.sharded_bucket_eligible(64, 8, p, None)
+        # int8 sentinel storage stays on the fused path
+        assert not ss.sharded_bucket_eligible(
+            64, 8, p._replace(storage_dtype="int8"), mesh)
+
+    def test_service_routes_by_divisibility(self, rng):
+        """An indivisible event bucket falls back to the single-device
+        topology; a divisible one rides the mesh — from one service."""
+        cfg = ServeConfig(event_buckets=(18, 64), row_buckets=(16,),
+                          batch_window_ms=0.0, sharded_buckets=True)
+        svc = ConsensusService(cfg)
+        assert svc.mesh is not None
+        key_div = svc._bucket_key((16, 64), has_na=True, any_scaled=False,
+                                  n_scaled=0, oracle_kwargs={})
+        key_odd = svc._bucket_key((16, 18), has_na=True, any_scaled=False,
+                                  n_scaled=0, oracle_kwargs={})
+        assert key_div.topology == ss.mesh_fingerprint(svc.mesh)
+        assert key_odd.topology == ss.SINGLE_TOPOLOGY
+        svc.close(drain=False)
+
+    def test_auto_stays_single_device_off_tpu(self):
+        """sharded_buckets='auto' (the default) must not engage the mesh
+        on the CPU test platform — existing single-device serving
+        contracts stay untouched."""
+        svc = ConsensusService(ServeConfig(batch_window_ms=0.0))
+        assert svc.mesh is None and svc.n_devices == 1
+        key = svc._bucket_key((16, 64), has_na=True, any_scaled=False,
+                              n_scaled=0, oracle_kwargs={})
+        assert key.topology == ss.SINGLE_TOPOLOGY
+        svc.close(drain=False)
+
+    def test_serve_mesh_layouts(self):
+        mesh = ss.serve_mesh(max_batch=8)
+        assert dict(mesh.shape) == {"batch": 2, "event": 4}
+        # odd capacity cannot split lanes over a batch axis
+        mesh1 = ss.serve_mesh(max_batch=1)
+        assert dict(mesh1.shape) == {"batch": 1, "event": 8}
+        with pytest.raises(ValueError, match="mesh_batch"):
+            ss.serve_mesh(max_batch=8, mesh_batch=3)
+        assert ss.serve_mesh(max_batch=8, devices=[object()]) is None
+
+    def test_topology_helpers(self):
+        mesh = make_mesh(batch=2, event=4)
+        fp = ss.mesh_fingerprint(mesh)
+        assert fp.endswith(":2x4")
+        assert ss.topology_event_shards(fp) == 4
+        assert ss.topology_n_devices(fp) == 8
+        assert ss.topology_event_shards(ss.SINGLE_TOPOLOGY) == 1
+        assert ss.topology_n_devices(ss.SINGLE_TOPOLOGY) == 1
+
+
+class TestWrongTopologyRejection:
+    def _key(self, topology):
+        return BucketKey.make(16, 64, 8, serve_params(), topology)
+
+    def test_mesh_cache_rejects_foreign_topology(self):
+        cache = ExecutableCache(4, mesh=make_mesh(batch=2, event=4))
+        with pytest.raises(ValueError, match="wrong-topology"):
+            cache.get(self._key("tpu-v5e:2x4"))
+        with pytest.raises(ValueError, match="wrong-topology"):
+            cache.get(self._key("cpu:1x8"))
+
+    def test_meshless_cache_rejects_any_mesh_topology(self):
+        cache = ExecutableCache(4)
+        fp = ss.mesh_fingerprint(make_mesh(batch=2, event=4))
+        with pytest.raises(ValueError, match="wrong-topology"):
+            cache.get(self._key(fp))
+
+    def test_matching_topologies_serve(self):
+        mesh = make_mesh(batch=1, event=8)
+        cache = ExecutableCache(4, mesh=mesh)
+        assert cache.get(self._key(ss.SINGLE_TOPOLOGY)) is not None
+        assert cache.get(self._key(ss.mesh_fingerprint(mesh))) is not None
+        assert len(cache) == 2
+
+    def test_bucket_key_topology_field(self):
+        p = serve_params()
+        assert BucketKey.make(16, 64, 8, p).topology == ss.SINGLE_TOPOLOGY
+        key = BucketKey.make(16, 64, 8, p, "cpu:2x4")
+        assert key.topology == "cpu:2x4"
+        assert key != BucketKey.make(16, 64, 8, p)
+
+
+class TestShardedServeEndToEnd:
+    def test_parity_with_direct_oracle_and_gauge(self, rng):
+        """One mesh-served request: outcomes bit-identical to a direct
+        Oracle resolution, retraces land under serve_bucket_sharded, and
+        the bucket dispatch emits the mesh-width gauge (ISSUE 6
+        satellite: bench's missing-metric path sees serve traffic)."""
+        obs.reset()
+        reports, _ = collusion_reports(rng, 12, 48, liars=4, na_frac=0.1)
+        cfg = ServeConfig(warmup=((16, 64),), batch_window_ms=1.0,
+                          sharded_buckets=True)
+        with ConsensusService(cfg) as svc:
+            n_event = svc.mesh.shape["event"]
+            got = svc.submit(reports=reports).result(timeout=120)
+            got2 = svc.submit(reports=reports).result(timeout=120)
+        ref = Oracle(reports=reports, backend="jax",
+                     pca_method="power").consensus()
+        np.testing.assert_array_equal(got["events"]["outcomes_final"],
+                                      ref["events"]["outcomes_final"])
+        np.testing.assert_array_equal(
+            got["events"]["outcomes_adjusted"],
+            ref["events"]["outcomes_adjusted"])
+        assert got["iterations"] == ref["iterations"]
+        np.testing.assert_allclose(got["agents"]["smooth_rep"],
+                                   ref["agents"]["smooth_rep"],
+                                   atol=SHARD_ATOL)
+        # serving determinism on the mesh
+        np.testing.assert_array_equal(
+            got["events"]["outcomes_raw"], got2["events"]["outcomes_raw"])
+        # warmup pinned the sharded retrace counter; traffic kept it there
+        assert obs.value("pyconsensus_jit_retraces_total",
+                         entry="serve_bucket_sharded") == 1
+        assert not obs.value("pyconsensus_jit_retraces_total",
+                             entry="serve_bucket")
+        assert obs.value("pyconsensus_mesh_event_shards") == n_event
