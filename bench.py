@@ -153,6 +153,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "1/2/4/.../n_devices submesh rates appended to "
                          "the JSON as 'device_scaling'; only runs when "
                          "more than one device is visible)")
+    ap.add_argument("--no-latency", action="store_true",
+                    help="skip the fail-soft interactive-latency block "
+                         "(p50/p99 blocking per-resolution latency at "
+                         "small shapes per available kernel path, "
+                         "appended to the JSON as 'latency')")
+    ap.add_argument("--latency-shapes", default="50x500,200x2000",
+                    help="comma-separated RxE shapes of the latency "
+                         "probe (small interactive markets)")
+    ap.add_argument("--latency-samples", type=int, default=15,
+                    help="blocking resolutions timed per (shape, path) "
+                         "rung; p50/p99 over these")
     ap.add_argument("--no-serve", action="store_true",
                     help="skip the fail-soft serve block (the "
                          "micro-batching service probe appended to the "
@@ -374,8 +385,99 @@ def run_bench(args) -> None:
     out_json["device_scaling"] = _device_scaling_block(args, reports,
                                                        params, n_dev,
                                                        value)
+    out_json["latency"] = _latency_block(args)
     out_json["serve"] = _serve_block(args)
     print(json.dumps(out_json))
+
+
+def _latency_block(args):
+    """ISSUE 7 satellite: blocking per-resolution latency at small
+    interactive shapes, per available kernel path — the latency tier's
+    acceptance artifact (the headline metric is throughput-shaped and
+    cannot see it). Each (shape, path) rung warms one single-device
+    resolution then times ``--latency-samples`` blocking resolutions
+    (p50/p99; p99 of a 15-sample rung is the max — the rung sizes for
+    signal per wall-second, not tail estimation). Paths: ``xla`` (the
+    pure-XLA pipeline, f32 storage — int8 is only legal fused) and
+    ``pallas`` (the fused NaN-threaded pipeline with its auto storage),
+    the latter reported only where the fused gate actually opens (TPU).
+    FAIL-SOFT like the serve block: any failure is a stderr WARNING and
+    a null block; a per-rung failure nulls just that rung."""
+    if args.no_latency:
+        return None
+    try:
+        import jax
+        import numpy as np
+
+        from pyconsensus_tpu.models.pipeline import ConsensusParams
+        from pyconsensus_tpu.parallel import (make_mesh,
+                                              resolve_auto_storage,
+                                              resolve_params,
+                                              sharded_consensus)
+
+        shapes = []
+        for part in args.latency_shapes.split(","):
+            r, e = part.lower().split("x")
+            shapes.append((int(r), int(e)))
+        n = max(3, args.latency_samples)
+        mesh = make_mesh(batch=1, event=1, devices=jax.devices()[:1])
+        gen = jax.jit(generate_reports_device, static_argnums=(1, 2))
+        block = []
+        for R, E in shapes:
+            reports = np.asarray(gen(jax.random.key(7), R, E,
+                                     args.na_frac, 0.1, 0.05))
+            entry = {"shape": f"{R}x{E}", "samples": n, "paths": {}}
+            base = ConsensusParams(
+                algorithm="sztorc", pca_method="auto",
+                max_iterations=args.max_iterations,
+                power_iters=args.power_iters, power_tol=args.power_tol,
+                has_na=True, any_scaled=False, n_scaled=0)
+            for path, p in (
+                    ("xla", base._replace(allow_fused=False,
+                                          storage_dtype="")),
+                    ("pallas", base._replace(allow_fused=True))):
+                try:
+                    if path == "pallas":
+                        storage, _ = resolve_auto_storage(p, R, E, mesh)
+                        p = p._replace(storage_dtype=storage)
+                    resolved = resolve_params(p, R, E, mesh)
+                    if path == "pallas" and not resolved.fused_resolution:
+                        # the fused gate did not open (non-TPU backend /
+                        # VMEM misfit) — no Pallas rung to measure
+                        continue
+
+                    def res(p=p):
+                        return sharded_consensus(reports, mesh=mesh,
+                                                 params=p)
+
+                    float(np.asarray(res()["avg_certainty"]))  # warm
+                    samples = []
+                    for _ in range(n):
+                        t0 = time.perf_counter()
+                        float(np.asarray(res()["avg_certainty"]))
+                        samples.append(time.perf_counter() - t0)
+                    samples.sort()
+                    entry["paths"][path] = {
+                        "p50_ms": round(
+                            1e3 * samples[len(samples) // 2], 3),
+                        "p99_ms": round(
+                            1e3 * samples[min(len(samples) - 1,
+                                              round(0.99 * (len(samples)
+                                                            - 1)))], 3),
+                        "min_ms": round(1e3 * samples[0], 3),
+                        "storage": resolved.storage_dtype or "full",
+                    }
+                except Exception as exc:              # noqa: BLE001
+                    print(f"WARNING: latency rung {R}x{E}/{path} "
+                          f"failed: {type(exc).__name__}: {exc}",
+                          file=sys.stderr)
+                    entry["paths"][path] = None
+            block.append(entry)
+        return block
+    except Exception as exc:                          # noqa: BLE001
+        print(f"WARNING: latency block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
 
 
 def _device_scaling_block(args, reports, params, n_dev: int, headline):
@@ -474,9 +576,14 @@ def _serve_block(args):
         shapes = ((24, 96), (48, 192))
         # sharded_buckets=True (not "auto"): the probe should exercise
         # the mesh bucket class whenever this process sees >1 device —
-        # including the CI rehearsal's 8 virtual CPU devices
+        # including the CI rehearsal's 8 virtual CPU devices.
+        # pallas_buckets=False: this block measures the MICRO-BATCHING
+        # tier (occupancy, hit ratio, warmed-bucket retraces); on a TPU
+        # the auto policy would route these small binary shapes onto
+        # bucket_pallas and the columns would describe an empty bucket
+        # path — the Pallas tier has its own 'latency' block
         cfg = ServeConfig(batch_window_ms=2.0, max_batch=8,
-                          sharded_buckets=True)
+                          sharded_buckets=True, pallas_buckets=False)
         svc = ConsensusService(cfg)
         buckets = svc.buckets_for(shapes)
         svc.warm_buckets(buckets)
@@ -572,6 +679,26 @@ def _obs_columns(out) -> dict:
               "pyconsensus_sharded_resolutions_total absent — no sharded "
               "resolution was counted", file=sys.stderr)
         cols["resolution_paths"] = None
+    # kernel-FAMILY rollup (ISSUE 7 satellite): which kernel family
+    # actually served this run's traffic — pallas (fused kernels), xla,
+    # hybrid — across the oracle AND serve dispatch sites. Read straight
+    # from the registry (like resolution_paths above): the obs columns
+    # must never depend on the serve subsystem importing cleanly —
+    # that dependency is exactly what _serve_block's fail-soft wraps
+    kp_snap = obs.REGISTRY.snapshot().get(
+        "pyconsensus_kernel_path_total", {})
+    kp = {}
+    for skey, v in kp_snap.get("series", {}).items():
+        labels = json.loads(skey) if skey else {}
+        kp[labels.get("path", "?")] = kp.get(
+            labels.get("path", "?"), 0) + int(v)
+    if kp:
+        cols["kernel_paths"] = kp
+    else:
+        print("WARNING: expected metric pyconsensus_kernel_path_total "
+              "absent — no dispatch site recorded a kernel family this "
+              "run", file=sys.stderr)
+        cols["kernel_paths"] = None
     ring = {}
     for op in ("gram", "matvec"):
         v = obs.value("pyconsensus_ring_collective_bytes_total", op=op)

@@ -51,6 +51,9 @@ CONTRACT_RULES = {
     "CL303": ("error", "host callback / infeed / outfeed in compiled HLO"),
     "CL304": ("error", "jit cache grew on an identical re-call "
                        "(retrace budget exceeded)"),
+    "CL305": ("error", "bf16/i8-operand compare in compiled HLO "
+                       "(Mosaic rejects the lowered cmpf/cmpi — "
+                       "BENCH_r02's compile-failure class)"),
 }
 
 _DEFAULT_CONTRACTS = pathlib.Path(__file__).with_name("contracts.json")
@@ -119,6 +122,25 @@ def host_callbacks(hlo_text: str) -> List[str]:
     """HLO lines that re-enter the host mid-graph."""
     return [ln.strip() for ln in hlo_text.splitlines()
             if _HOST_CALLBACK_RE.search(ln)]
+
+
+#: compare instruction whose OPERAND region names a dtype Mosaic rejects
+#: in kernel comparisons (bf16 cmpf — BENCH_r02's crash — and s8/u8
+#: cmpi, probed round 4). Compiled HLO text carries operand types inline
+#: (`pred[...] compare(bf16[...] %a, bf16[...] %b), direction=LT`), so a
+#: line check suffices; jaxpr-level tests/test_mosaic_compat.py is the
+#: structural guard, this is its post-lowering mirror inside the lint
+#: gate.
+_ILLEGAL_CMP_RE = re.compile(r"compare\([^)]*\b(bf16|s8|u8)\[")
+
+
+def bf16_compare_ops(hlo_text: str) -> List[str]:
+    """HLO compare instructions on bf16/i8 operands — the lowered form
+    Mosaic refuses to compile in Pallas kernels ("Target does not
+    support this comparison"). Ignores metadata-only mentions, like
+    :func:`f64_ops`."""
+    return [ln.strip() for ln in hlo_text.splitlines()
+            if _ILLEGAL_CMP_RE.search(ln.split("metadata=")[0])]
 
 
 def check_collective_budget(inventory: List[tuple], budget: dict,
@@ -324,6 +346,31 @@ def _builder_fused_sharded(spec: dict) -> str:
     args = (jax.ShapeDtypeStruct((R, E), dt, sharding=event_sharding(mesh)),
             jax.ShapeDtypeStruct((R,), dt, sharding=replicated(mesh)))
     return fn.lower(*args, seed, base_unit).compile().as_text()
+
+
+def _builder_pallas_resolve(spec: dict) -> str:
+    """The revived fused-resolution tier (ISSUE 7): the single-device
+    light pipeline with ``fused_resolution=True`` — the graph the
+    Oracle's TPU fused gate and the serve ``bucket_pallas`` class run.
+    Off-TPU the Pallas kernels lower through the interpreter to plain
+    XLA ops (the ``fused_sharded`` builder's precedent), which is
+    exactly the surface the ``forbid_bf16_compares`` assertion needs:
+    every kernel comparison appears in the compiled module, and one on
+    bf16/i8 operands is the BENCH_r02 Mosaic rejection waiting to
+    happen on hardware."""
+    import jax
+
+    from ..models.pipeline import consensus_light_jit
+
+    R, E = _shape(spec)
+    p = _params(spec, fused_resolution=True)
+    dt = _acc_dtype()
+    args = (jax.ShapeDtypeStruct((R, E), dt),
+            jax.ShapeDtypeStruct((R,), dt),
+            jax.ShapeDtypeStruct((E,), bool),
+            jax.ShapeDtypeStruct((E,), dt),
+            jax.ShapeDtypeStruct((E,), dt))
+    return consensus_light_jit.lower(*args, p).compile().as_text()
 
 
 def _builder_collusion_vmap(spec: dict) -> str:
@@ -578,6 +625,7 @@ BUILDERS: Dict[str, Callable] = {
     "pipeline_sharded": _builder_pipeline_sharded,
     "pipeline_single": _builder_pipeline_single,
     "fused_sharded": _builder_fused_sharded,
+    "pallas_resolve": _builder_pallas_resolve,
     "collusion_vmap": _builder_collusion_vmap,
     "streaming_panel": _builder_streaming_panel,
     "kmeans_single": _builder_kmeans_single,
@@ -637,6 +685,15 @@ def check_artifact(name: str, hlo_text: str, spec: dict) -> List[Finding]:
                 message=f"{len(bad)} host re-entry op(s) in compiled HLO "
                         f"(first: {bad[0][:120]})", severity="error",
                 snippet=f"{name}:callback"))
+    if spec.get("forbid_bf16_compares"):
+        bad = bf16_compare_ops(hlo_text)
+        if bad:
+            out.append(Finding(
+                rule="CL305", path=path, line=0,
+                message=f"{len(bad)} bf16/i8-operand compare(s) in "
+                        f"compiled HLO — Mosaic rejects the lowered "
+                        f"form (first: {bad[0][:120]})",
+                severity="error", snippet=f"{name}:bf16cmp"))
     return out
 
 

@@ -43,14 +43,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import numpy_kernels as nk
-
 __all__ = ["apply_weighted_cov", "apply_weighted_cov_block",
            "power_iteration_fused",
            "scores_dirfix_pass", "resolve_certainty_fused",
            "storage_matvec", "storage_rows_matmat", "storage_matmat",
            "matmat_kernels_fit", "matmat_tile_rows",
-           "cov_block_kernel_fits"]
+           "cov_block_kernel_fits",
+           "set_tune_provider", "cov_tile_fits", "cov_tile_candidates",
+           "resolve_block_fits", "resolve_block_candidates"]
 
 #: target VMEM footprint of one row panel (bytes); actual VMEM use is a few
 #: times this (double-buffered input + in-register f32 upcast)
@@ -80,6 +80,136 @@ def _panel_rows(n_events: int, itemsize: int,
 _VMEM_BUDGET = 14 * 1024 * 1024
 
 
+# -- autotuned block shapes (pyconsensus_tpu.tune) -------------------------
+#
+# The block shapes above were hand-measured on v5e and are the
+# deterministic fallback. The autotuner (ISSUE 7 tentpole b) can install a
+# PROVIDER here that overrides them per (TPU generation, dtype, shape
+# class): ``matmat_tile_rows`` consults it for the storage/cov row-panel
+# size and ``resolve_certainty_fused`` for the resolution column-block
+# width. Provider calls happen at TRACE time (host code building static
+# grid/BlockSpec shapes); a provider must be deterministic per process —
+# the tune runtime guarantees that by resolving its cache file once at
+# install time. Every value a provider returns is re-validated against
+# the legality helpers below before use, so a stale or corrupt cache
+# entry can degrade performance but never produce an illegal kernel.
+
+_TUNE_PROVIDER = None
+_TUNE_AUTOLOAD = True
+
+
+def set_tune_provider(provider):
+    """Install (or clear, with None) the block-shape provider —
+    ``provider(kind, **ctx) -> int | None`` with kinds ``"cov_tile_rows"``
+    (ctx: n_events, itemsize, nan_fill) and ``"resolve_block_cols"``
+    (ctx: n_reporters, itemsize). Returns the previous provider.
+    Explicitly installing a provider (even None) disables the lazy
+    default-cache autoload."""
+    global _TUNE_PROVIDER, _TUNE_AUTOLOAD
+    prev = _TUNE_PROVIDER
+    _TUNE_PROVIDER = provider
+    _TUNE_AUTOLOAD = False
+    return prev
+
+
+def _tuned(kind: str, **ctx):
+    """The provider's override for ``kind`` at ``ctx`` (None = use the
+    built-in measured-good heuristic). First call lazily installs the
+    tune runtime's default provider (persisted-cache lookup; a no-op
+    provider when no cache file exists) unless one was set explicitly.
+
+    Hardened like the autoload: a provider that raises, or returns
+    anything but a positive integral number (a hand-edited cache file
+    can put ANY JSON value behind ``"value"``), yields None — tuning is
+    never load-bearing, so a bad cache entry must degrade to the
+    heuristic, never crash a kernel build."""
+    global _TUNE_PROVIDER, _TUNE_AUTOLOAD
+    if _TUNE_PROVIDER is None and _TUNE_AUTOLOAD:
+        _TUNE_AUTOLOAD = False
+        try:
+            from ..tune import default_provider
+
+            _TUNE_PROVIDER = default_provider()
+        except Exception:      # noqa: BLE001 — tuning is never load-bearing
+            _TUNE_PROVIDER = None
+    if _TUNE_PROVIDER is None:
+        return None
+    try:
+        v = _TUNE_PROVIDER(kind, **ctx)
+    except Exception:          # noqa: BLE001 — same rule as the autoload
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    if isinstance(v, float) and not v.is_integer():
+        return None
+    v = int(v)
+    return v if v > 0 else None
+
+
+def cov_tile_fits(tile_rows: int, n_events: int, itemsize: int) -> bool:
+    """Whether a ``tile_rows``-row panel of the storage sweep kernels
+    (matvec/matmat class) fits scoped VMEM: double-buffered storage block
+    + the decode image (bf16 on compact storage, f32 otherwise) + the
+    shared aux/accumulator vectors. This is the legality bound the
+    autotuner sweeps under — deliberately the small-k model; the
+    k-heavy block kernels re-check their own fit predicates
+    (``cov_block_kernel_fits`` / ``matmat_kernels_fit``), which consult
+    the tuned tile through :func:`matmat_tile_rows` and therefore stay
+    consistent with whatever the provider installs."""
+    lanes = -(-n_events // 128) * 128
+    elem = 4 if itemsize == 4 else 2
+    est = tile_rows * lanes * (2 * itemsize + elem) + 8 * lanes * 4
+    return est <= _VMEM_BUDGET
+
+
+def cov_tile_candidates(n_events: int, itemsize: int,
+                        nan_fill: bool) -> list:
+    """The legal row-panel sizes the autotuner may sweep for the storage
+    sweep kernels at this (E, itemsize): a geometric multiple-of-8
+    ladder from the minimum sub-tile panel up to the scoped-VMEM bound
+    (a full multiple-of-8 scan would be ~100 configs at small E — sweep
+    cost with no resolution benefit). The built-in heuristic
+    (:func:`matmat_tile_rows`'s fallback value) joins the ladder ONLY
+    when it passes :func:`cov_tile_fits` itself — the sweep must never
+    propose a config outside its own legality model, and the
+    hand-measured heuristic can exceed this (deliberately conservative)
+    model at compact dense storage; in that case it simply stays what
+    the kernels fall back to when no winner is installed. An empty list
+    means no panel fits at all (the caller's shape belongs to the XLA
+    path)."""
+    ladder = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512, 768,
+              1024)
+    out = [t for t in ladder if cov_tile_fits(t, n_events, itemsize)]
+    fallback = _panel_rows(n_events, itemsize,
+                           _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
+    if fallback not in out and cov_tile_fits(fallback, n_events, itemsize):
+        out.append(fallback)
+        out.sort()
+    return out
+
+
+def resolve_block_fits(n_reporters: int, block_cols: int,
+                       itemsize: int) -> bool:
+    """Whether the fused resolution kernel fits scoped VMEM at a
+    ``block_cols``-wide column block for this (padded) R: double-buffered
+    (R, C) block + (R, 1) f32 outputs + chunk-loop temps. Block widths
+    must be multiples of 128 (the Pallas TPU lane-tiling rule)."""
+    if block_cols % 128 or block_cols < 128:
+        return False
+    chunk = min(_pick_chunk(n_reporters) or 8, 1024)
+    est = (n_reporters * block_cols * itemsize * 2 + n_reporters * 4 * 4
+           + 6 * chunk * block_cols * 4 + 8 * block_cols * 4)
+    return est <= _VMEM_BUDGET
+
+
+def resolve_block_candidates(n_reporters: int, itemsize: int) -> list:
+    """Legal column-block widths for :func:`resolve_certainty_fused` at
+    this (padded) R, ascending — the autotuner's sweep space (the
+    hand-measured heuristic picks from {256, 128})."""
+    return [c for c in (128, 256, 384, 512, 768, 1024)
+            if resolve_block_fits(n_reporters, c, itemsize)]
+
+
 def fused_pca_fits(n_events: int, itemsize: int) -> bool:
     """Whether the E-wide row-panel kernels (apply_weighted_cov,
     scores_dirfix_pass) fit scoped VMEM at the minimum 8-row panel:
@@ -90,16 +220,16 @@ def fused_pca_fits(n_events: int, itemsize: int) -> bool:
 
 
 def _resolve_block_cols(n_reporters: int, itemsize: int):
-    """Largest column-block width the fused resolution kernel can hold in
-    scoped VMEM for this R (double-buffered (R, C) block + (R, 1) f32
-    outputs + chunk-loop temps); None when even the narrowest legal block
-    does not fit. Pallas TPU lowering requires the block width be a
-    multiple of 128 (or the whole array), so 128 is the floor."""
-    chunk = min(_pick_chunk(n_reporters) or 8, 1024)
+    """Largest column-block width of the measured-good {256, 128} ladder
+    the fused resolution kernel can hold in scoped VMEM for this R; None
+    when even the narrowest legal block does not fit. The VMEM estimate
+    itself lives in ONE place — :func:`resolve_block_fits`, which the
+    autotuner's sweep space uses too, so the heuristic and the sweep can
+    never budget against different models. (Pallas TPU lowering requires
+    the block width be a multiple of 128 or the whole array, so 128 is
+    the floor.)"""
     for C in (256, 128):
-        est = (n_reporters * C * itemsize * 2 + n_reporters * 4 * 4
-               + 6 * chunk * C * 4 + 8 * C * 4)
-        if est <= _VMEM_BUDGET:
+        if resolve_block_fits(n_reporters, C, itemsize):
             return C
     return None
 
@@ -571,7 +701,17 @@ def matmat_tile_rows(n_events: int, itemsize: int, nan_fill: bool) -> int:
     kernels' internal ``_pad_rows`` then no-ops) instead of paying a full
     (R, E) HBM pad copy on every sweep when R is not a panel multiple
     (the hoist ``power_iteration_fused`` applies; measured ~25-35%
-    end-to-end on ica at panel-indivisible R, 2026-08-01)."""
+    end-to-end on ica at panel-indivisible R, 2026-08-01).
+
+    Consults the autotune provider first (``pyconsensus_tpu.tune``):
+    a persisted per-(generation, dtype, shape-class) winner overrides
+    the hand-measured heuristic, re-validated against
+    :func:`cov_tile_fits` so a stale cache entry can never produce an
+    illegal kernel."""
+    t = _tuned("cov_tile_rows", n_events=n_events, itemsize=itemsize,
+               nan_fill=nan_fill)
+    if t and t % 8 == 0 and cov_tile_fits(int(t), n_events, itemsize):
+        return int(t)
     return _panel_rows(n_events, itemsize,
                        _PANEL_BYTES // 2 if nan_fill else _PANEL_BYTES)
 
@@ -976,7 +1116,7 @@ def scores_dirfix_pass(x, rep, loading, fill=None, interpret: bool = False):
 
 def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
                               cert_ref, pcol_ref, prow_ref, narow_ref, *,
-                              tolerance, chunk, n_chunks, n_events):
+                              tolerance, atol, chunk, n_chunks, n_events):
     """One column panel, one HBM read, the whole back half of the pipeline.
 
     The panel's full column must be resident before outcomes exist (they are
@@ -1082,10 +1222,11 @@ def _resolve_certainty_kernel(x_ref, rep_ref, fv_ref, raw_ref, out_ref,
     # scalars promote to the DEFAULT float dtype, which under an x64
     # host (the CPU interpret test environment) is f64 — a dtype this
     # kernel's output refs reject (consensus-lint CL104's bug class).
-    # Boundary band: jax_kernels.catch's CATCH_TIE_ATOL rule at the
-    # kernel's f32 mean dtype — knife-edge means must snap identically
-    # across every path (numpy_kernels.CATCH_TIE_ATOL's rationale).
-    atol = max(nk.CATCH_TIE_ATOL, 32.0 * float(jnp.finfo(f32).eps))
+    # Boundary band: ``atol`` is jax_kernels.catch_tie_atol(f32) — the
+    # ONE dtype-floored band shared by the numpy/XLA/Pallas catch
+    # kernels, threaded in by resolve_certainty_fused so a band change
+    # cannot be applied to one kernel family and missed here (knife-edge
+    # means must snap identically across every path).
     out = jnp.where(means < 0.5 - tolerance - atol, 0.0,
                     jnp.where(means > 0.5 + tolerance + atol, 1.0,
                               jnp.asarray(0.5, f32)))
@@ -1166,7 +1307,16 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
         if interpret:
             block_cols = 128    # the interpreter has no VMEM limit
         else:
-            block_cols = _resolve_block_cols(Rp, x.dtype.itemsize)
+            # autotuned width first (pyconsensus_tpu.tune), re-validated
+            # against the VMEM fit so a stale cache entry cannot compile
+            # an illegal kernel; the hand-measured heuristic otherwise
+            tuned = _tuned("resolve_block_cols", n_reporters=Rp,
+                           itemsize=x.dtype.itemsize)
+            if tuned and resolve_block_fits(Rp, int(tuned),
+                                            x.dtype.itemsize):
+                block_cols = int(tuned)
+            else:
+                block_cols = _resolve_block_cols(Rp, x.dtype.itemsize)
             if block_cols is None:
                 raise ValueError(f"R={R} (padded to {Rp}) does not fit the "
                                  "fused resolution kernel's VMEM budget; "
@@ -1180,9 +1330,16 @@ def resolve_certainty_fused(x, rep, fill, full_total, tolerance: float,
     col_spec = pl.BlockSpec((1, C), lambda j: (0, j), memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((Rp, 1), lambda j: (0, 0),
                             memory_space=pltpu.VMEM)
+    # the dtype-floored catch boundary band (jax_kernels.catch_tie_atol)
+    # — computed HERE, at the same f32 the kernel's means carry, so the
+    # numpy/XLA/Pallas catch families share one band definition (lazy
+    # import: jax_kernels lazily imports this module's kernels back)
+    from .jax_kernels import catch_tie_atol
+
     raw, out, cert, pcol, prow, narow = pl.pallas_call(
         functools.partial(_resolve_certainty_kernel,
-                          tolerance=float(tolerance), chunk=chunk,
+                          tolerance=float(tolerance),
+                          atol=catch_tie_atol(f32), chunk=chunk,
                           n_chunks=n_chunks, n_events=E),
         grid=(n_blocks,),
         in_specs=[

@@ -503,6 +503,18 @@ def _record_sharded_dispatch(p: ConsensusParams, mesh: Mesh) -> None:
         labels=("path", "algorithm", "storage")).inc(
             path=path, algorithm=p.algorithm,
             storage=p.storage_dtype or "full")
+    # the kernel-FAMILY rollup (ISSUE 7 satellite): which kernel family
+    # actually served traffic — "pallas" covers both the single-device
+    # fused pipeline and the shard_map fused path (the same Pallas
+    # storage/resolve kernels per shard)
+    obs.counter(
+        "pyconsensus_kernel_path_total",
+        "resolutions dispatched by kernel family (which kernel family "
+        "actually served traffic — the bench obs block's path "
+        "breakdown)", labels=("path",)).inc(
+            path=("pallas" if p.fused_resolution
+                  else ("hybrid" if p.algorithm in HYBRID_ALGORITHMS
+                        else "xla")))
     obs.gauge(
         "pyconsensus_mesh_event_shards",
         "event-axis width of the mesh used by the latest sharded "

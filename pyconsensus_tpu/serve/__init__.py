@@ -32,6 +32,8 @@ from .cache import BucketKey, ExecutableCache
 from .kernels import (SERVE_ALGORITHMS, bucket_inputs, bucket_path_eligible,
                       make_bucket_executable, padded_consensus, slice_result)
 from .loadgen import LoadGenerator
+from .pallas import (PALLAS_KERNEL_PATH, XLA_KERNEL_PATH,
+                     make_pallas_bucket_executable, pallas_bucket_eligible)
 from .queue import RequestQueue, ResolveRequest
 from .service import ConsensusService, ServeConfig
 from .session import MarketSession, SessionStore
@@ -48,4 +50,6 @@ __all__ = [
     "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS",
     "SINGLE_TOPOLOGY", "make_sharded_bucket_executable",
     "mesh_fingerprint", "serve_mesh", "sharded_bucket_eligible",
+    "PALLAS_KERNEL_PATH", "XLA_KERNEL_PATH",
+    "make_pallas_bucket_executable", "pallas_bucket_eligible",
 ]

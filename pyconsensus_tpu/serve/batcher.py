@@ -34,6 +34,7 @@ from ..faults import plan as _faults
 from ..oracle import Oracle, assemble_result, record_consensus_result
 from . import kernels as sk
 from .cache import BucketKey
+from .pallas import PALLAS_KERNEL_PATH, pallas_bucket_inputs
 from .sharded import SINGLE_TOPOLOGY, topology_event_shards
 
 __all__ = ["Microbatcher", "OCCUPANCY_BUCKETS"]
@@ -75,6 +76,11 @@ class Microbatcher:
             "pyconsensus_serve_batch_occupancy",
             "requests coalesced per bucketed dispatch",
             buckets=OCCUPANCY_BUCKETS)
+        self._kernel_path = obs.counter(
+            "pyconsensus_kernel_path_total",
+            "resolutions dispatched by kernel family (which kernel "
+            "family actually served traffic — the bench obs block's "
+            "path breakdown)", labels=("path",))
 
     # -- lifecycle ------------------------------------------------------
 
@@ -121,8 +127,11 @@ class Microbatcher:
             self._dispatch_direct(req)
 
     def _coalesce(self, first) -> list:
-        """Collect same-key requests within the deadline window."""
-        cap = self.config.max_batch - 1
+        """Collect same-key requests within the deadline window — up to
+        the KEY's batch capacity (the low-latency Pallas class runs
+        capacity 1: coalescing past the capacity would silently drop
+        lanes at dispatch)."""
+        cap = min(self.config.max_batch, first.batch_key.batch) - 1
         if cap <= 0:
             return []
         window_end = time.monotonic() + self.config.batch_window_ms / 1e3
@@ -143,8 +152,11 @@ class Microbatcher:
         # one label for EVERY outcome of this group (ok/shed/error) — the
         # coalescer groups by batch_key, so the topology is group-wide
         key: BucketKey = group[0].batch_key
-        path = ("bucket_sharded" if key.topology != SINGLE_TOPOLOGY
-                else "bucket")
+        if key.kernel_path == PALLAS_KERNEL_PATH:
+            path = "bucket_pallas"
+        else:
+            path = ("bucket_sharded" if key.topology != SINGLE_TOPOLOGY
+                    else "bucket")
         live = [r for r in group if not r.expired()]
         for r in group:
             if r not in live:
@@ -153,8 +165,12 @@ class Microbatcher:
                 self._requests.inc(path=path, outcome="shed")
         if not live:
             return
+        if key.kernel_path == PALLAS_KERNEL_PATH:
+            self._dispatch_pallas(key, live)
+            return
         try:
             _faults.fire("serve.dispatch")
+            self._kernel_path.inc(len(live), path="xla")
             capacity = key.batch
             lanes = []
             for r in live:
@@ -204,6 +220,43 @@ class Microbatcher:
             record_consensus_result(result, key.params.algorithm,
                                     "serve")
             self._finish(r, result, path)
+
+    def _dispatch_pallas(self, key: BucketKey, live) -> None:
+        """The ``bucket_pallas`` low-latency dispatch: per-request,
+        exact-shape, through the fused NaN-threaded pipeline executable
+        (``serve.pallas``). No lane padding, no result slicing — the
+        executable runs the very graph the Oracle's single-device fused
+        path runs, so the result assembly is the light dict straight
+        through. Capacity is 1 by construction; the loop tolerates a
+        longer group defensively (sequential dispatches, every waiter
+        resolved)."""
+        for i, r in enumerate(live):
+            try:
+                _faults.fire("serve.dispatch")
+                self._kernel_path.inc(path="pallas")
+                entry = self.cache.get(key)
+                with obs.span("serve.dispatch",
+                              bucket=f"{key.rows}x{key.events}",
+                              topology=key.topology,
+                              kernel_path=key.kernel_path, occupancy=1):
+                    raw = entry(*pallas_bucket_inputs(r), key.params)
+                    flat = {k: np.asarray(v) for k, v in raw.items()}
+            except BaseException as exc:  # noqa: BLE001 — EVERY waiter
+                # must learn of the failure (the _dispatch_bucket rule):
+                # the raise aborts the loop, so the not-yet-served tail
+                # would otherwise hang to its timeouts
+                for rr in live[i:]:
+                    if not rr.future.done():
+                        rr.future.set_exception(exc)
+                        self._requests.inc(path="bucket_pallas",
+                                           outcome="error")
+                raise
+            for k in _SCALAR_KEYS:
+                flat[k] = np.asarray(flat[k]).item()
+            result = assemble_result(flat)
+            result["quarantined_rows"] = r.quarantined_rows
+            record_consensus_result(result, key.params.algorithm, "serve")
+            self._finish(r, result, "bucket_pallas")
 
     def _dispatch_direct(self, req) -> None:
         _faults.fire("serve.dispatch")

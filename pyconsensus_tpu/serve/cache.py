@@ -30,6 +30,8 @@ import numpy as np
 from .. import obs
 from ..faults import plan as _faults
 from . import kernels as sk
+from .pallas import (PALLAS_KERNEL_PATH, XLA_KERNEL_PATH,
+                     make_pallas_bucket_executable)
 from .sharded import (SINGLE_TOPOLOGY, make_sharded_bucket_executable,
                       mesh_fingerprint)
 
@@ -37,23 +39,30 @@ __all__ = ["ExecutableCache", "BucketKey"]
 
 
 class BucketKey(tuple):
-    """(rows, events, batch_capacity, params, topology) — hashable cache
-    key. ``params`` is the fully-resolved static ``ConsensusParams`` (a
-    NamedTuple, hashable); two tenants with different alphas are two
-    executables, exactly as jit itself would key them. ``topology`` is
-    the executable's device-topology fingerprint —
+    """(rows, events, batch_capacity, params, topology, kernel_path) —
+    hashable cache key. ``params`` is the fully-resolved static
+    ``ConsensusParams`` (a NamedTuple, hashable); two tenants with
+    different alphas are two executables, exactly as jit itself would
+    key them. ``topology`` is the executable's device-topology
+    fingerprint —
     :data:`~pyconsensus_tpu.serve.sharded.SINGLE_TOPOLOGY` for the
     single-device kernel, ``sharded.mesh_fingerprint(mesh)`` for the
     mesh-sharded one — so one bucket shape warmed on two topologies is
-    two distinct executables and can never be cross-served."""
+    two distinct executables and can never be cross-served.
+    ``kernel_path`` (ISSUE 7 tentpole c) keys the executable FAMILY the
+    same way: ``"xla"`` is the padded bucket kernel, ``"pallas"`` the
+    fused low-latency pipeline at exact shape — one (shape, params) on
+    two kernel paths is two distinct executables that can never collide
+    in the cache."""
 
     __slots__ = ()
 
     @classmethod
     def make(cls, rows: int, events: int, batch: int, params,
-             topology: str = SINGLE_TOPOLOGY):
+             topology: str = SINGLE_TOPOLOGY,
+             kernel_path: str = XLA_KERNEL_PATH):
         return cls((int(rows), int(events), int(batch), params,
-                    str(topology)))
+                    str(topology), str(kernel_path)))
 
     @property
     def rows(self):
@@ -74,6 +83,10 @@ class BucketKey(tuple):
     @property
     def topology(self):
         return self[4]
+
+    @property
+    def kernel_path(self):
+        return self[5]
 
 
 class ExecutableCache:
@@ -151,6 +164,19 @@ class ExecutableCache:
         only ever produce an executable compiled for the wrong
         hardware layout)."""
         topology = key.topology
+        if key.kernel_path == PALLAS_KERNEL_PATH:
+            # the low-latency fused class is single-device by policy
+            # (the mesh belongs to the throughput tiers)
+            if topology != SINGLE_TOPOLOGY:
+                raise ValueError(
+                    f"bucket_pallas keys are single-topology by "
+                    f"definition, got {topology!r}")
+            return make_pallas_bucket_executable(key.params)
+        if key.kernel_path != XLA_KERNEL_PATH:
+            raise ValueError(f"unknown bucket kernel path "
+                             f"{key.kernel_path!r} (expected "
+                             f"{XLA_KERNEL_PATH!r} or "
+                             f"{PALLAS_KERNEL_PATH!r})")
         if topology == SINGLE_TOPOLOGY:
             return sk.make_bucket_executable(key.params,
                                              batched=key.batch > 1)
@@ -181,12 +207,21 @@ class ExecutableCache:
         if p.has_na:
             reports[-1, 0] = np.nan     # exercise the fill graph
         rep = np.full((rows,), 1.0 / rows)
-        args = [jnp.asarray(a) for a in (
-            reports, rep, np.zeros(events, bool), np.zeros(events),
-            np.ones(events), np.ones(rows, bool), np.ones(events, bool),
-            np.zeros(events, np.dtype(acc)))]
-        if batch > 1:
-            args = [jnp.broadcast_to(a, (batch,) + a.shape) for a in args]
+        if key.kernel_path == PALLAS_KERNEL_PATH:
+            # the fused executable takes the bare light-pipeline
+            # signature at exact shape — no masks, no seed
+            args = [jnp.asarray(a, dtype=(bool if a.dtype == bool
+                                          else acc)) for a in (
+                reports, rep, np.zeros(events, bool), np.zeros(events),
+                np.ones(events))]
+        else:
+            args = [jnp.asarray(a) for a in (
+                reports, rep, np.zeros(events, bool), np.zeros(events),
+                np.ones(events), np.ones(rows, bool),
+                np.ones(events, bool), np.zeros(events, np.dtype(acc)))]
+            if batch > 1:
+                args = [jnp.broadcast_to(a, (batch,) + a.shape)
+                        for a in args]
         out = entry(*args, p)
         # block on one output: the warmup must include backend compile
         np.asarray(out["smooth_rep"])

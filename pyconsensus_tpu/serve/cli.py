@@ -60,6 +60,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--rate-limit", type=float, default=None,
                     help="per-tenant admission rate (req/s)")
+    ap.add_argument("--pallas-buckets", choices=["auto", "on", "off"],
+                    default=None,
+                    help="low-latency Pallas bucket class policy "
+                         "(ISSUE 7): auto = TPU backend only, on = any "
+                         "backend (interpreter off-TPU), off = padded "
+                         "XLA buckets only")
     ap.add_argument("--allow-shed", action="store_true",
                     help="shed requests (PYC401) do not fail the run — "
                          "the expected outcome of an overload probe")
@@ -84,6 +90,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         overrides["max_batch"] = int(args.max_batch)
     if args.rate_limit is not None:
         overrides["rate_limit_rps"] = float(args.rate_limit)
+    if args.pallas_buckets is not None:
+        overrides["pallas_buckets"] = {"auto": "auto", "on": True,
+                                       "off": False}[args.pallas_buckets]
     if overrides:
         cfg = ServeConfig.from_dict({**cfg.__dict__, **overrides})
 
@@ -129,8 +138,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               entry="serve_bucket"),
         "retraces_sharded": obs.value("pyconsensus_jit_retraces_total",
                                       entry="serve_bucket_sharded"),
+        "retraces_pallas": obs.value("pyconsensus_jit_retraces_total",
+                                     entry="serve_bucket_pallas"),
     }
-    from .loadgen import device_block, mean_batch_occupancy
+    from .loadgen import device_block, kernel_path_block, \
+        mean_batch_occupancy
+
+    stats["kernel_paths"] = kernel_path_block() or None
 
     occ = mean_batch_occupancy()
     if occ is not None:

@@ -22,7 +22,26 @@ import time
 import numpy as np
 
 __all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy",
-           "device_block"]
+           "device_block", "kernel_path_block"]
+
+
+def kernel_path_block():
+    """Dispatch counts by kernel family (ISSUE 7 satellite) — the
+    ``pyconsensus_kernel_path_total`` breakdown ({} before any counted
+    dispatch). The ONE copy of the registry extraction, shared by the
+    CLI summaries and the bench ``obs`` block's serve probe."""
+    import json as _json
+
+    from .. import obs
+
+    series = obs.REGISTRY.snapshot().get(
+        "pyconsensus_kernel_path_total", {}).get("series", {})
+    out = {}
+    for skey, v in series.items():
+        labels = _json.loads(skey) if skey else {}
+        path = labels.get("path", "?")
+        out[path] = out.get(path, 0) + int(v)
+    return out
 
 
 def device_block(service) -> dict:
@@ -250,6 +269,7 @@ def main(argv=None) -> int:
         stats = gen.run_closed(args.requests, args.concurrency)
     svc.close(drain=True)
     stats.update(device_block(svc))
+    stats["kernel_paths"] = kernel_path_block() or None
     print(json.dumps(stats, indent=2))
     return 0
 

@@ -1,0 +1,146 @@
+"""The ``bucket_pallas`` low-latency bucket class (ISSUE 7 tentpole c).
+
+Small interactive markets don't want the padded-bucket machinery's
+coalescing window or its pad-lane compute — they want the fewest HBM
+passes per resolution the hardware allows. That is exactly the fused
+NaN-threaded Pallas pipeline (``models.pipeline._consensus_core_fused``:
+one storage read per power sweep, one for scores+direction fix, ONE for
+the entire outcome/certainty/participation back half), which the Oracle
+already runs on single-device TPU when the fused gate opens. This module
+gives the serve tier a cached executable class for it:
+
+- **exact-shape keys, no padding**: a ``bucket_pallas`` executable is
+  keyed by the request's true (R, E) with ``batch=1`` — the tier trades
+  executable reuse across shapes for the minimum per-request work, which
+  is the right trade exactly in the small-shape class the eligibility
+  gate admits (small compiles are cheap, and the LRU bounds how many a
+  process holds). Because the executable runs the same fused graph the
+  Oracle's single-device fused path runs, catch-snapped outcomes and
+  iteration counts are bit-identical to a direct Oracle resolution by
+  construction (the fused-vs-XLA parity corpus), with none of the
+  padded-bucket equivalence machinery in the loop.
+- **never colliding with the XLA buckets**: ``BucketKey`` carries a
+  ``kernel_path`` dimension ("xla" | "pallas"); the ``ExecutableCache``
+  builds each class with its own constructor, so a Pallas executable can
+  never be served where the padded XLA kernel was warmed (or vice
+  versa), exactly like the topology field keeps mesh and single-device
+  executables apart.
+- **gated by the kernel fit predicates**: eligibility
+  (:func:`pallas_bucket_eligible`) requires the fused pipeline's scoped
+  VMEM fits (``resolve_kernel_fits`` at the padded reporter count,
+  ``fused_pca_fits`` at the event width) plus the small-E single-device
+  class bound (``ServeConfig.pallas_max_events`` — large E belongs to
+  the throughput tiers: the padded XLA buckets and the mesh). The
+  ``pallas_buckets`` policy mirrors ``sharded_buckets``: "auto" engages
+  on a TPU backend only, True forces the class anywhere (CPU tests/CI
+  run the kernels through the Pallas interpreter), False disables it.
+
+Autotuned block shapes (``pyconsensus_tpu.tune``) apply here at
+kernel-build time: the executable's Pallas kernels size their panels
+through the provider, so a persisted per-generation winner serves the
+latency tier without any serve-layer knowledge.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .. import obs
+from ..models.pipeline import ConsensusParams, _consensus_core_light
+
+__all__ = ["PALLAS_KERNEL_PATH", "XLA_KERNEL_PATH",
+           "pallas_bucket_eligible", "pallas_bucket_params",
+           "make_pallas_bucket_executable"]
+
+#: BucketKey.kernel_path values — the cache-key dimension that keeps the
+#: two executable families apart
+XLA_KERNEL_PATH = "xla"
+PALLAS_KERNEL_PATH = "pallas"
+
+
+def pallas_bucket_eligible(n_reporters: int, n_events: int,
+                           algorithm: str, pca_method: str,
+                           any_scaled: bool, storage_dtype: str,
+                           mode, max_events: int) -> bool:
+    """Whether a request may ride the ``bucket_pallas`` class — the ONE
+    copy of the routing rule (service derivation and the tests share
+    it). ``mode`` is ``ServeConfig.pallas_buckets``; sztorc scored by
+    power iteration on an all-binary panel (the fused kernel's scope —
+    the serve tier does not take the scaled gather-and-fix arm), an
+    event width inside the low-latency class bound, and the fused
+    kernels' scoped-VMEM fit at this shape."""
+    from ..ops.pallas_kernels import fused_pca_fits, resolve_kernel_fits
+
+    if mode is False:
+        return False
+    if mode == "auto":
+        if jax.default_backend() != "tpu":
+            return False
+    elif mode is not True:
+        raise ValueError(f"pallas_buckets must be 'auto', True or False, "
+                         f"got {mode!r}")
+    if algorithm != "sztorc" or pca_method not in ("auto", "power"):
+        return False
+    if any_scaled:
+        return False
+    if n_events > int(max_events):
+        return False
+    itemsize = (jax.numpy.dtype(storage_dtype).itemsize if storage_dtype
+                else jax.numpy.asarray(0.0).dtype.itemsize)
+    r_padded = n_reporters + (-n_reporters) % 8
+    return (fused_pca_fits(n_events, itemsize)
+            and resolve_kernel_fits(r_padded, itemsize))
+
+
+def pallas_bucket_params(has_na: bool, oracle_kwargs: dict,
+                         bucket_kwargs) -> ConsensusParams:
+    """The fully-resolved static params of a ``bucket_pallas``
+    executable: the fused single-device pipeline on sztorc power
+    iteration, binary-only. ``bucket_kwargs`` is the service's
+    ``_BUCKET_KWARGS`` allowlist."""
+    return ConsensusParams(
+        algorithm="sztorc", pca_method="power", fused_resolution=True,
+        has_na=has_na, any_scaled=False, n_scaled=0,
+        **{k: v for k, v in oracle_kwargs.items() if k in bucket_kwargs})
+
+
+def make_pallas_bucket_executable(p: ConsensusParams):
+    """A FRESH jitted executable for one ``bucket_pallas`` cache entry —
+    the fused light pipeline under a PRIVATE jit (eviction frees the
+    executable, like ``kernels.make_bucket_executable``), instrumented
+    under the ``serve_bucket_pallas`` retrace entry: after a request
+    warms a (shape, params) key the steady-state retrace counter must
+    equal the number of cached Pallas executables (the same runtime
+    CL304 invariant the padded buckets pin).
+
+    The signature is ``consensus_light_jit``'s
+    ``(reports, reputation, scaled, mins, maxs, p)`` at the request's
+    TRUE shape — no masks, no pad lanes, no injected seed: the executable
+    runs the very graph the Oracle's fused path runs, which is what makes
+    its parity trivial instead of engineered."""
+    if not p.fused_resolution:
+        raise ValueError("a bucket_pallas executable requires "
+                         "fused_resolution=True params "
+                         "(pallas_bucket_params builds them)")
+
+    def fn(reports, reputation, scaled, mins, maxs, p):
+        return _consensus_core_light(reports, reputation, scaled, mins,
+                                     maxs, p)
+
+    return obs.instrument_jit(
+        jax.jit(fn, static_argnames=("p",)), "serve_bucket_pallas")
+
+
+def pallas_bucket_inputs(req, dtype=None):
+    """Device inputs for a ``bucket_pallas`` dispatch from a derived
+    request — the acc-dtype arrays ``consensus_light_jit`` takes, at the
+    true shape (the quarantine/validation already ran at admission)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    dt = dtype or jnp.asarray(0.0).dtype
+    return (jnp.asarray(np.asarray(req.reports), dt),
+            jnp.asarray(np.asarray(req.reputation), dt),
+            jnp.asarray(np.asarray(req.scaled), bool),
+            jnp.asarray(np.asarray(req.mins), dt),
+            jnp.asarray(np.asarray(req.maxs), dt))

@@ -41,6 +41,8 @@ from .admission import AdmissionController
 from .batcher import Microbatcher
 from .cache import BucketKey, ExecutableCache
 from .kernels import bucket_path_eligible
+from .pallas import (PALLAS_KERNEL_PATH, pallas_bucket_eligible,
+                     pallas_bucket_params)
 from .queue import RequestQueue, ResolveRequest
 from .session import SessionStore
 from .sharded import (SINGLE_TOPOLOGY, mesh_fingerprint, serve_mesh,
@@ -96,6 +98,23 @@ class ServeConfig:
     #: mesh batch-axis width (0 = auto: 2 x (n/2) when the device count
     #: and batch capacity split evenly, else 1 x n)
     mesh_batch: int = 0
+    #: low-latency Pallas bucket class (ISSUE 7): "auto" routes eligible
+    #: small binary requests through the fused NaN-threaded pipeline
+    #: (``serve.pallas``, exact-shape executables, no coalescing window)
+    #: when the process owns a TPU backend; True forces the class on any
+    #: backend (CPU tests/CI run the kernels through the Pallas
+    #: interpreter); False pins everything to the padded XLA buckets.
+    #: Eligibility per request is ``pallas.pallas_bucket_eligible``
+    #: (sztorc/power, all-binary, E <= ``pallas_max_events``, and the
+    #: fused kernels' scoped-VMEM fits).
+    pallas_buckets: object = "auto"
+    #: event-width bound of the low-latency class — beyond it the padded
+    #: buckets / mesh throughput tiers serve the request
+    pallas_max_events: int = 4096
+    #: exact (rows, events) shapes compiled onto the Pallas class before
+    #: traffic (the low-latency tier's warmup ladder; unlike ``warmup``
+    #: these are true request shapes, not bucket shapes)
+    pallas_warmup: tuple = ()
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServeConfig":
@@ -108,8 +127,9 @@ class ServeConfig:
         for key in ("row_buckets", "event_buckets"):
             if key in d:
                 d[key] = tuple(int(x) for x in d[key])
-        if "warmup" in d:
-            d["warmup"] = tuple((int(r), int(e)) for r, e in d["warmup"])
+        for key in ("warmup", "pallas_warmup"):
+            if key in d:
+                d[key] = tuple((int(r), int(e)) for r, e in d[key])
         return cls(**d)
 
     @classmethod
@@ -195,7 +215,11 @@ class ConsensusService:
     def warm_buckets(self, shapes=None, **oracle_kwargs) -> int:
         """Compile the configured (or given) bucket shapes before
         accepting traffic — the ``--warmup`` preflight. Returns the
-        number of executables compiled."""
+        number of executables compiled. The low-latency Pallas class
+        warms its configured exact shapes too
+        (``ServeConfig.pallas_warmup``) — but only when given shapes
+        were not passed (an explicit list warms the XLA ladder it
+        names)."""
         n = 0
         for rows, events in (shapes or self.config.warmup):
             key = self._bucket_key((rows, events), has_na=True,
@@ -204,6 +228,15 @@ class ConsensusService:
             with obs.span("serve.warmup", bucket=f"{rows}x{events}"):
                 self.cache.warm(key)
             n += 1
+        if shapes is None:
+            for rows, events in self.config.pallas_warmup:
+                key = self._pallas_key(rows, events, has_na=True,
+                                       oracle_kwargs=oracle_kwargs)
+                with obs.span("serve.warmup",
+                              bucket=f"{rows}x{events}",
+                              kernel_path=PALLAS_KERNEL_PATH):
+                    self.cache.warm(key)
+                n += 1
         return n
 
     def drain(self, timeout: Optional[float] = 60.0) -> None:
@@ -240,6 +273,16 @@ class ConsensusService:
         helper behind the CLI/loadgen/bench warmup preflights."""
         return sorted({b for b in (self._pick_bucket(*s) for s in shapes)
                        if b is not None})
+
+    def _pallas_key(self, rows: int, events: int, has_na,
+                    oracle_kwargs) -> BucketKey:
+        """The low-latency class key: TRUE shape, batch capacity 1 (no
+        coalescing — the whole point is the minimum per-request work),
+        single topology, ``kernel_path="pallas"`` so it can never
+        collide with a padded XLA executable of the same shape."""
+        p = pallas_bucket_params(has_na, oracle_kwargs, _BUCKET_KWARGS)
+        return BucketKey.make(rows, events, 1, p, SINGLE_TOPOLOGY,
+                              kernel_path=PALLAS_KERNEL_PATH)
 
     def _bucket_key(self, bucket, has_na, any_scaled, n_scaled,
                     oracle_kwargs) -> BucketKey:
@@ -287,14 +330,32 @@ class ConsensusService:
         pca_method = oracle_kwargs.get("pca_method", "auto")
         if algorithm not in ALGORITHMS:
             raise InputError(f"unknown algorithm {algorithm!r}")
+        kwargs_ok = not (set(oracle_kwargs) - set(_BUCKET_KWARGS)
+                         - {"algorithm", "pca_method"})
+        # low-latency Pallas class first (ISSUE 7): a small all-binary
+        # interactive market wants the fused pipeline's minimum HBM
+        # passes, not the padded bucket's coalescing window + pad lanes
+        if (req.backend == "jax" and req.session is None and kwargs_ok
+                and not bool(scaled.any())
+                and pallas_bucket_eligible(
+                    R, E, algorithm, pca_method, False,
+                    oracle_kwargs.get("storage_dtype", ""),
+                    self.config.pallas_buckets,
+                    self.config.pallas_max_events)):
+            key = self._pallas_key(R, E, has_na=has_na,
+                                   oracle_kwargs=oracle_kwargs)
+            req.dispatch_path = "bucket"
+            req.bucket = (R, E)
+            req.params = key.params
+            req.batch_key = key
+            return
         bucket = self._pick_bucket(R, E)
         eligible = (req.backend == "jax" and bucket is not None
                     and req.session is None
                     and bucket_path_eligible(
                         algorithm, pca_method, bool(scaled.any()),
                         has_na, oracle_kwargs.get("storage_dtype", ""))
-                    and not set(oracle_kwargs)
-                    - set(_BUCKET_KWARGS) - {"algorithm", "pca_method"})
+                    and kwargs_ok)
         if not eligible:
             req.dispatch_path = "direct"
             return

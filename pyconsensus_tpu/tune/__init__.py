@@ -1,0 +1,28 @@
+"""Block-shape autotuner for the Pallas kernel tier (ISSUE 7 tentpole b).
+
+The fused kernels' block shapes (row-panel size of the storage/cov
+sweeps, column-block width of the fused resolution kernel) were
+hand-measured on v5e and hard-coded. This package makes them
+self-tuning: :func:`autotune_cov` / :func:`autotune_resolve` sweep the
+legal configurations (``pallas_kernels.cov_tile_candidates`` /
+``resolve_block_candidates`` — every candidate satisfies the scoped-VMEM
+fit predicates by construction), persist the winner keyed by
+``(TPU generation, storage dtype, shape class)`` through the
+crash-safe ``io.atomic_write`` machinery, and :func:`install` (or the
+lazy default provider the kernels load at build time) replays persisted
+winners into ``pallas_kernels.set_tune_provider``. With no cache entry
+the provider falls through to :data:`FALLBACK_TABLE` and finally to the
+in-kernel measured-good v5e heuristics — always deterministic.
+
+See docs/PERFORMANCE.md ("Autotuned kernel block shapes") for the cache
+key layout, the fallback rules, and how to re-tune
+(``python -m pyconsensus_tpu.tune``).
+"""
+
+from .autotune import (FALLBACK_TABLE, TuneCache, autotune_cov,
+                       autotune_resolve, cache_path, default_provider,
+                       install, shape_class, tpu_generation)
+
+__all__ = ["autotune_cov", "autotune_resolve", "default_provider",
+           "install", "TuneCache", "cache_path", "shape_class",
+           "tpu_generation", "FALLBACK_TABLE"]

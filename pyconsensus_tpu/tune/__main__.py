@@ -1,0 +1,61 @@
+"""Re-tune the Pallas block shapes for a target shape:
+
+    python -m pyconsensus_tpu.tune --reporters 10000 --events 100000 \
+        --storage-dtype int8 [--cache PATH] [--force] [--interpret]
+
+Runs the cov-sweep and resolution sweeps for the shape's classes,
+persists the winners (atomic write), and prints one JSON summary line.
+On a non-TPU backend pass ``--interpret`` — the sweep then validates the
+machinery through the Pallas interpreter and persists the deterministic
+analytic winner (see tune.autotune's module docstring).
+"""
+
+import argparse
+import json
+
+from .autotune import autotune_cov, autotune_resolve, cache_path
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="python -m pyconsensus_tpu.tune",
+                                 description=__doc__)
+    ap.add_argument("--reporters", type=int, default=10_000)
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--storage-dtype", default="",
+                    help="storage encoding to tune for ('', 'bfloat16', "
+                         "'int8')")
+    ap.add_argument("--cache", default=None,
+                    help="cache file (default: $PYCONSENSUS_AUTOTUNE_CACHE "
+                         "or ~/.cache/pyconsensus_tpu/autotune.json)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-sweep even when a cache entry exists")
+    ap.add_argument("--interpret", action="store_true",
+                    help="Pallas interpreter sweep (off-TPU validation; "
+                         "deterministic analytic winner)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--probe-events", type=int, default=512,
+                    help="event width of the resolution-sweep probe "
+                         "matrix (the winner is keyed by reporter class "
+                         "only)")
+    ap.add_argument("--probe-reporters", type=int, default=256,
+                    help="reporter count of the cov-sweep probe matrix "
+                         "(the winner is keyed by event class only)")
+    args = ap.parse_args(argv)
+
+    cov = autotune_cov(args.events, n_reporters=args.probe_reporters,
+                       storage_dtype=args.storage_dtype,
+                       interpret=args.interpret, path=args.cache,
+                       force=args.force, repeats=args.repeats)
+    res = autotune_resolve(args.reporters, n_events=args.probe_events,
+                           storage_dtype=args.storage_dtype,
+                           interpret=args.interpret, path=args.cache,
+                           force=args.force, repeats=args.repeats)
+    print(json.dumps({
+        "cache": str(cache_path(args.cache)),
+        "cov_tile_rows": cov,
+        "resolve_block_cols": res,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,448 @@
+"""Sweep, persist, and replay Pallas block-shape configurations.
+
+Design rules:
+
+- **Legality is the kernels' own fit predicates.** The sweep spaces come
+  from ``pallas_kernels.cov_tile_candidates`` /
+  ``resolve_block_candidates``; every candidate fits scoped VMEM by the
+  same models the kernels gate on, and the provider re-validates on
+  lookup — a stale or hand-edited cache entry can cost performance but
+  can never compile an illegal kernel.
+- **Block shapes never change results.** Each sweep runs every candidate
+  on the same seeded inputs and asserts the outputs agree before a
+  winner may be persisted (catch-snapped outputs bit-identically, the
+  continuous accumulations to reduction-order tolerance) — an autotuner
+  that could trade correctness for speed would be a bug farm.
+- **Deterministic off-TPU.** ``interpret=True`` sweeps (CPU tests, the
+  CI smoke) still execute every candidate through the Pallas
+  interpreter, but rank by the analytic measured-good model (the
+  in-kernel heuristic) instead of interpreter wall time — interpreter
+  timings reflect nothing about the TPU and would make the persisted
+  winner a coin flip. On a real TPU the median of timed runs decides.
+- **Crash-safe, replay-stable persistence.** Winners go through
+  ``io.atomic_write`` (fsynced tmp + rename — the ledger/sweep-chunk
+  machinery) under the ``tune.cache_write`` fault site; a torn or
+  corrupt cache file is detected on load and treated as empty (the
+  fallback chain still serves), never trusted.
+- **Import-time environment resolution.** ``PYCONSENSUS_AUTOTUNE_CACHE``
+  is read ONCE at import (the ``_FILL_STATS_KERNEL`` hoist precedent —
+  a per-trace ``os.environ`` read could compile different programs per
+  host, consensus-lint CL401's bug class), and the default provider
+  disables itself on multi-process meshes: per-host cache files could
+  otherwise install different block shapes on different hosts of one
+  program, the classic compile-divergence deadlock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import numpy as np
+
+from .. import io as pio
+from .. import obs
+from ..faults import plan as _faults
+from ..ops import pallas_kernels as pk
+
+__all__ = ["autotune_cov", "autotune_resolve", "default_provider",
+           "install", "TuneCache", "cache_path", "shape_class",
+           "tpu_generation", "FALLBACK_TABLE"]
+
+_VERSION = 1
+
+#: env override for the cache location — read once at import time (a
+#: per-call read would be a per-trace host divergence source, CL401)
+_CACHE_PATH_ENV = os.environ.get("PYCONSENSUS_AUTOTUNE_CACHE", "")
+
+#: deterministic measured-good fallback rows consulted when no cache
+#: entry exists, keyed ``(kind, generation)`` with ``"*"`` wildcard
+#: generation. ``None`` (and any missing row) means "use the in-kernel
+#: v5e-measured heuristic" (``_panel_rows`` / ``_resolve_block_cols``) —
+#: the heuristics ARE the measured-good defaults, so the table only
+#: carries rows where a generation is known to want something else.
+#: The interpreter row pins the width the interpret path always used.
+FALLBACK_TABLE = {
+    ("resolve_block_cols", "cpu"): 128,
+    ("cov_tile_rows", "*"): None,
+    ("resolve_block_cols", "*"): None,
+}
+
+
+def tpu_generation() -> str:
+    """The accelerator-generation component of every cache key —
+    ``device_kind`` of device 0 with spaces dashed (``"TPU-v5e"``;
+    ``"cpu"`` on CPU hosts), matching ``serve.sharded.mesh_fingerprint``'s
+    device-kind convention."""
+    import jax
+
+    return str(jax.devices()[0].device_kind).replace(" ", "-")
+
+
+def shape_class(n: int) -> str:
+    """Power-of-two shape-class bucket (``"p4096"``): winners generalize
+    across nearby sizes but not across decades, and the padded serving
+    buckets land exactly on class boundaries."""
+    p = 1
+    while p < max(1, int(n)):
+        p *= 2
+    return f"p{p}"
+
+
+def _entry_key(kind: str, generation: str, itemsize: int, cls: str,
+               nan_fill=None) -> str:
+    key = f"{generation}/{kind}/i{int(itemsize)}/{cls}"
+    if nan_fill is not None:
+        key += "/nan" if nan_fill else "/dense"
+    return key
+
+
+def cache_path(path=None) -> pathlib.Path:
+    """The autotune cache file: explicit ``path`` >
+    ``PYCONSENSUS_AUTOTUNE_CACHE`` (resolved at import) >
+    ``~/.cache/pyconsensus_tpu/autotune.json``."""
+    p = path or _CACHE_PATH_ENV or "~/.cache/pyconsensus_tpu/autotune.json"
+    return pathlib.Path(p).expanduser()
+
+
+def _sweeps_counter():
+    return obs.counter(
+        "pyconsensus_autotune_sweeps_total",
+        "autotune sweeps executed (cache misses that measured candidates)",
+        labels=("kind",))
+
+
+def _hits_counter():
+    return obs.counter(
+        "pyconsensus_autotune_cache_hits_total",
+        "autotune lookups served from the persisted cache",
+        labels=("kind",))
+
+
+def _configs_counter():
+    return obs.counter(
+        "pyconsensus_autotune_configs_total",
+        "candidate block configurations evaluated by autotune sweeps",
+        labels=("kind",))
+
+
+def _fallback_counter():
+    return obs.counter(
+        "pyconsensus_autotune_fallback_total",
+        "provider lookups that fell through to the fallback table or the "
+        "in-kernel heuristic", labels=("kind",))
+
+
+class TuneCache:
+    """The persisted winner table — one JSON file, atomically replaced
+    on every ``put`` (crash leaves old content or new, never torn). A
+    corrupt/torn/foreign-version file loads as EMPTY with a stderr
+    warning: the fallback chain still serves, and the next sweep's
+    ``put`` rewrites a clean file."""
+
+    def __init__(self, path=None) -> None:
+        self.path = cache_path(path)
+        self.entries: dict = {}
+        self.load()
+
+    def load(self) -> None:
+        self.entries = {}
+        try:
+            raw = json.loads(self.path.read_text())
+            if raw.get("version") == _VERSION and \
+                    isinstance(raw.get("entries"), dict):
+                self.entries = raw["entries"]
+            else:
+                import sys
+
+                print(f"WARNING: autotune cache {self.path} has "
+                      f"version {raw.get('version')!r} != {_VERSION}; "
+                      f"ignoring it", file=sys.stderr)
+        except FileNotFoundError:
+            pass
+        except (ValueError, OSError) as exc:
+            import sys
+
+            print(f"WARNING: autotune cache {self.path} unreadable "
+                  f"({type(exc).__name__}: {exc}); treating as empty",
+                  file=sys.stderr)
+
+    def get(self, key: str):
+        return self.entries.get(key)
+
+    def put(self, key: str, entry: dict) -> None:
+        self.entries[key] = entry
+        payload = json.dumps({"version": _VERSION, "entries": self.entries},
+                             indent=1, sort_keys=True)
+        _faults.fire("tune.cache_write", path=self.path)
+
+        def writer(tmp):
+            pathlib.Path(tmp).write_text(payload)
+
+        pio.atomic_write(self.path, writer)
+
+
+# -- provider (kernel-build-time lookup) -----------------------------------
+
+
+def _fallback(kind: str, generation: str):
+    row = FALLBACK_TABLE.get((kind, generation))
+    if row is None:
+        row = FALLBACK_TABLE.get((kind, "*"))
+    return row
+
+
+def default_provider(path=None):
+    """The provider ``pallas_kernels`` lazily installs at kernel-build
+    time: persisted winner first (counted as a cache hit), then the
+    deterministic :data:`FALLBACK_TABLE`, then None (the in-kernel
+    heuristic). Resolves the cache file and device generation ONCE — the
+    provider itself is pure dict lookup, deterministic for the process
+    lifetime (trace-time code must never re-read the environment).
+
+    On a multi-process program the provider is inert (always falls back):
+    per-host cache files could install different block shapes — and
+    therefore different compiled programs — on different hosts.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        def inert(kind, **ctx):
+            _fallback_counter().inc(kind=kind)
+            return _fallback(kind, "multiprocess")
+        return inert
+
+    cache = TuneCache(path)
+    generation = tpu_generation()
+    hits, fallbacks = _hits_counter(), _fallback_counter()
+
+    def provider(kind, **ctx):
+        if kind == "cov_tile_rows":
+            key = _entry_key(kind, generation, ctx["itemsize"],
+                             shape_class(ctx["n_events"]),
+                             nan_fill=ctx.get("nan_fill"))
+        elif kind == "resolve_block_cols":
+            key = _entry_key(kind, generation, ctx["itemsize"],
+                             shape_class(ctx["n_reporters"]))
+        else:
+            return None
+        entry = cache.get(key)
+        if entry is not None:
+            hits.inc(kind=kind)
+            return entry.get("value")
+        fallbacks.inc(kind=kind)
+        return _fallback(kind, generation)
+
+    return provider
+
+
+def install(path=None):
+    """Build the default provider from ``path`` (or the default cache)
+    and install it into ``pallas_kernels`` — the explicit form of the
+    lazy kernel-build-time autoload. Returns the provider."""
+    provider = default_provider(path)
+    pk.set_tune_provider(provider)
+    return provider
+
+
+# -- sweeps ----------------------------------------------------------------
+
+
+def _median_time(fn, repeats: int) -> float:
+    fn()                                    # warm (compile) untimed
+    samples = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def _synth_storage(rng, R: int, E: int, storage_dtype: str, na_frac: float):
+    """Seeded synthetic storage matrix + fill stats for the sweeps —
+    binary lattice values with NaN absences, in the requested storage
+    encoding."""
+    import jax.numpy as jnp
+
+    vals = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+    na = rng.random((R, E)) < na_frac
+    if storage_dtype == "int8":
+        enc = np.where(na, -1, np.round(2 * vals)).astype(np.int8)
+        x = jnp.asarray(enc)
+    else:
+        dt = jnp.dtype(storage_dtype or jnp.asarray(0.0).dtype)
+        x = jnp.asarray(np.where(na, np.nan, vals), dt)
+    rep = jnp.asarray(np.full(R, 1.0 / R), jnp.float32)
+    fill = jnp.asarray(rng.choice([0.0, 0.5, 1.0], size=E), jnp.float32)
+    return x, rep, fill
+
+
+def _agreeing_winner(results, candidates, pick, kind: str):
+    """Assert every candidate produced the same outputs, then return the
+    picked winner. ``results`` maps candidate -> tuple of np arrays; the
+    catch-snapped arrays must be bit-identical, continuous ones within
+    reduction-order tolerance (block width changes accumulation order,
+    the same ulp class the XLA tilings already carry)."""
+    base_c = candidates[0]
+    base = results[base_c]
+    for c in candidates[1:]:
+        for i, (a, b) in enumerate(zip(base, results[c])):
+            np.testing.assert_allclose(
+                a, b, rtol=0, atol=1e-5, equal_nan=True,
+                err_msg=(f"autotune {kind}: candidate {c} output {i} "
+                         f"disagrees with candidate {base_c} — block "
+                         f"shapes must never change results"))
+    return pick
+
+
+def autotune_resolve(n_reporters: int, n_events: int = 512,
+                     storage_dtype: str = "", *, interpret: bool = False,
+                     path=None, force: bool = False, repeats: int = 5,
+                     na_frac: float = 0.05, seed: int = 0) -> dict:
+    """Sweep the fused resolution kernel's column-block width for this
+    reporter shape class and persist the winner. Returns the cache entry
+    (``{"value": C, ...}``). Cache hit (same key, ``force=False``) skips
+    the sweep entirely."""
+    import jax
+    import jax.numpy as jnp
+
+    itemsize = (jnp.dtype(storage_dtype).itemsize if storage_dtype
+                else jnp.asarray(0.0).dtype.itemsize)
+    Rp = n_reporters + (-n_reporters) % 8
+    generation = "interpret" if interpret else tpu_generation()
+    key = _entry_key("resolve_block_cols", generation, itemsize,
+                     shape_class(Rp))
+    cache = TuneCache(path)
+    if not force:
+        hit = cache.get(key)
+        if hit is not None:
+            _hits_counter().inc(kind="resolve_block_cols")
+            return hit
+    candidates = pk.resolve_block_candidates(Rp, itemsize)
+    if not candidates:
+        raise ValueError(f"R={n_reporters} (padded {Rp}) has no legal "
+                         f"resolution block width at itemsize {itemsize}; "
+                         f"the XLA path serves this shape")
+    _sweeps_counter().inc(kind="resolve_block_cols")
+    rng = np.random.default_rng(seed)
+    x, rep, fill = _synth_storage(rng, Rp, n_events, storage_dtype, na_frac)
+    total = jnp.sum(rep)
+    timings, results = {}, {}
+    for C in candidates:
+        _configs_counter().inc(kind="resolve_block_cols")
+
+        def run(C=C):
+            out = pk.resolve_certainty_fused(x, rep, fill, total, 0.1,
+                                             block_cols=C,
+                                             interpret=interpret)
+            jax.block_until_ready(out)
+            return out
+
+        results[C] = tuple(np.asarray(o) for o in run())
+        timings[C] = None if interpret else _median_time(run, repeats)
+    if interpret:
+        # deterministic analytic ranking — interpreter wall time says
+        # nothing about the TPU (module docstring)
+        pick = pk._resolve_block_cols(Rp, itemsize) or candidates[0]
+        if pick not in candidates:
+            pick = candidates[-1]
+    else:
+        pick = min(candidates, key=lambda c: (timings[c], c))
+    pick = _agreeing_winner(results, candidates, pick, "resolve")
+    entry = {"value": int(pick), "kind": "resolve_block_cols",
+             "candidates": [int(c) for c in candidates],
+             "mode": "interpret" if interpret else "timed",
+             "probe_shape": [int(Rp), int(n_events)],
+             "storage_dtype": storage_dtype or "full"}
+    if not interpret:
+        entry["timings_ms"] = {str(c): round(t * 1e3, 4)
+                               for c, t in timings.items()}
+    cache.put(key, entry)
+    return entry
+
+
+def autotune_cov(n_events: int, n_reporters: int = 256,
+                 storage_dtype: str = "", nan_fill: bool = True, *,
+                 interpret: bool = False, path=None, force: bool = False,
+                 repeats: int = 5, na_frac: float = 0.05,
+                 seed: int = 0) -> dict:
+    """Sweep the storage/cov sweep kernels' row-panel size for this event
+    shape class and persist the winner. Candidate tiles are forced
+    through a scoped provider override and a FRESH jit per candidate —
+    the tile is a trace-time constant, so re-calling the module-level
+    jitted kernel would silently reuse the first candidate's
+    executable."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    itemsize = (jnp.dtype(storage_dtype).itemsize if storage_dtype
+                else jnp.asarray(0.0).dtype.itemsize)
+    generation = "interpret" if interpret else tpu_generation()
+    key = _entry_key("cov_tile_rows", generation, itemsize,
+                     shape_class(n_events), nan_fill=nan_fill)
+    cache = TuneCache(path)
+    if not force:
+        hit = cache.get(key)
+        if hit is not None:
+            _hits_counter().inc(kind="cov_tile_rows")
+            return hit
+    candidates = pk.cov_tile_candidates(n_events, itemsize, nan_fill)
+    if not candidates:
+        raise ValueError(f"E={n_events} has no legal cov row panel at "
+                         f"itemsize {itemsize}; the XLA path serves "
+                         f"this shape")
+    _sweeps_counter().inc(kind="cov_tile_rows")
+    rng = np.random.default_rng(seed)
+    x, rep, fill = _synth_storage(rng, n_reporters, n_events, storage_dtype,
+                                  na_frac if nan_fill else 0.0)
+    mu = jnp.asarray(rng.random(n_events), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(n_events), jnp.float32)
+    timings, results = {}, {}
+    for tile in candidates:
+        _configs_counter().inc(kind="cov_tile_rows")
+        fn = jax.jit(functools.partial(
+            pk.apply_weighted_cov.__wrapped__, interpret=interpret))
+
+        def run(fn=fn, tile=tile):
+            # scoped override saving the module state DIRECTLY —
+            # set_tune_provider would latch autoload off, so a sweep in
+            # a fresh process would permanently disconnect the kernels
+            # from the winner it is about to persist
+            prev_p, prev_a = pk._TUNE_PROVIDER, pk._TUNE_AUTOLOAD
+            pk._TUNE_PROVIDER = (
+                lambda kind, **ctx: tile if kind == "cov_tile_rows"
+                else None)
+            pk._TUNE_AUTOLOAD = False
+            try:
+                out = fn(x, mu, rep, v, fill if nan_fill else None)
+                jax.block_until_ready(out)
+                return out
+            finally:
+                pk._TUNE_PROVIDER, pk._TUNE_AUTOLOAD = prev_p, prev_a
+
+        results[tile] = (np.asarray(run()),)
+        timings[tile] = None if interpret else _median_time(run, repeats)
+    if interpret:
+        pick = pk._panel_rows(
+            n_events, itemsize,
+            pk._PANEL_BYTES // 2 if nan_fill else pk._PANEL_BYTES)
+        if pick not in candidates:
+            pick = candidates[-1]
+    else:
+        pick = min(candidates, key=lambda t: (timings[t], t))
+    pick = _agreeing_winner(results, candidates, pick, "cov")
+    entry = {"value": int(pick), "kind": "cov_tile_rows",
+             "candidates": [int(c) for c in candidates],
+             "mode": "interpret" if interpret else "timed",
+             "probe_shape": [int(n_reporters), int(n_events)],
+             "nan_fill": bool(nan_fill),
+             "storage_dtype": storage_dtype or "full"}
+    if not interpret:
+        entry["timings_ms"] = {str(c): round(t * 1e3, 4)
+                               for c, t in timings.items()}
+    cache.put(key, entry)
+    return entry

@@ -1024,6 +1024,52 @@ def test_f64_and_callback_detectors():
     assert f64_ops("  %ok = f32[2] add(f32[2] %x, f32[2] %y)") == []
 
 
+def test_bf16_compare_detector():
+    """CL305 (ISSUE 7): bf16/i8-operand compares in compiled HLO — the
+    lowered form Mosaic rejects in Pallas kernels (BENCH_r02's crash
+    class). f32/pred compares and metadata-only mentions stay clean."""
+    from pyconsensus_tpu.analysis.contracts import bf16_compare_ops
+
+    bad = ("  %c = pred[8,128]{1,0} compare(bf16[8,128]{1,0} %a, "
+           "bf16[8,128]{1,0} %b), direction=LT\n"
+           "  %d = pred[32]{0} compare(s8[32]{0} %p, s8[32]{0} %q), "
+           "direction=EQ\n"
+           "  %ok = pred[32]{0} compare(f32[32]{0} %x, f32[32]{0} %y), "
+           "direction=GE")
+    hits = bf16_compare_ops(bad)
+    assert len(hits) == 2
+    assert bf16_compare_ops(
+        "  %ok = pred[4]{0} compare(f32[4]{0} %x, f32[4]{0} %y)") == []
+    # a bf16 mention only in metadata must not trigger
+    assert bf16_compare_ops(
+        "  %ok = pred[4]{0} compare(f32[4]{0} %x, f32[4]{0} %y), "
+        "metadata={op_name=\"bf16[stuff]\"}") == []
+
+
+def test_check_artifact_forbid_bf16_compares():
+    spec = {"name": "t", "shape": {"R": 8, "E": 16},
+            "forbid_bf16_compares": True}
+    bad = ("  %c = pred[8]{0} compare(bf16[8]{0} %a, bf16[8]{0} %b), "
+           "direction=LT")
+    rules = {f.rule for f in check_artifact("t", bad, spec)}
+    assert "CL305" in rules
+    ok = ("  %c = pred[8]{0} compare(f32[8]{0} %a, f32[8]{0} %b), "
+          "direction=LT")
+    assert not {f.rule for f in check_artifact("t", ok, spec)} & {"CL305"}
+    # without the spec flag the same HLO is not checked
+    assert not {f.rule
+                for f in check_artifact(
+                    "t", bad, {"name": "t", "shape": {"R": 8, "E": 16}})
+                } & {"CL305"}
+
+
+def test_pallas_resolve_contract_holds_live():
+    """The ISSUE 7 contract end-to-end in-process: the fused tier's
+    compiled module is collective-free, f64-free, and carries no
+    bf16/i8-operand compare (the full set runs under --strict in CI)."""
+    assert run_contracts(names=["pallas-resolve"]) == []
+
+
 def test_check_artifact_reports_findings():
     spec = {"name": "t", "shape": {"R": 32, "E": 2048},
             "mesh": {"batch": 1, "event": 8},

@@ -1,0 +1,168 @@
+"""CATCH_TIE_ATOL boundary-band parity across all three kernel families
+(ISSUE 7 satellite): an exact-boundary weighted mean — landing ON
+``0.5 ± tolerance`` — must snap to the ambiguous 0.5 identically through
+the numpy reference (``numpy_kernels.catch``), the XLA kernels
+(``jax_kernels.catch`` / ``resolve_outcomes``), and the Pallas fused
+resolution kernel (``resolve_certainty_fused``, interpret mode on CPU),
+for every storage encoding. The parity-ledger #1-7 root cause was
+exactly this class: knife-edge fills snapping oppositely across XLA
+reduce tilings; the band (now ONE definition —
+``jax_kernels.catch_tie_atol``, threaded into the Pallas kernel) is the
+fix, and this corpus pins it on the revived Pallas path.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.ops import jax_kernels as jk
+from pyconsensus_tpu.ops import numpy_kernels as nk
+from pyconsensus_tpu.ops.pallas_kernels import resolve_certainty_fused
+
+TOL = 0.1
+
+#: per-column vote stacks engineering the present-weighted mean (uniform
+#: reputation) to exact boundary / near-boundary values: (votes, mean)
+_COLUMNS = [
+    ([1, 1, 1, 0, 0], 0.6),       # exactly 0.5 + tol  -> band -> 0.5
+    ([1, 1, 0, 0, 0], 0.4),       # exactly 0.5 - tol  -> band -> 0.5
+    ([1, 1, 1, 1, 0], 0.8),       # clearly above      -> 1.0
+    ([1, 0, 0, 0, 0], 0.2),       # clearly below      -> 0.0
+    ([1, 1, 1, 0, 1], 0.8),       # above              -> 1.0
+]
+
+_EXPECTED = np.array([0.5, 0.5, 1.0, 0.0, 1.0])
+
+
+def _matrix():
+    """(5, 5) all-present vote matrix whose column means are _COLUMNS'."""
+    return np.array([[c[0][r] for c in _COLUMNS]
+                     for r in range(5)], dtype=np.float64)
+
+
+def _encode(reports, dtype):
+    if dtype == "int8":
+        return jnp.asarray(
+            np.where(np.isnan(reports), -1,
+                     np.round(2 * reports)).astype(np.int8))
+    return jnp.asarray(reports, dtype=dtype)
+
+
+def test_catch_band_shared_definition():
+    """The three families share ONE band definition: numpy's constant,
+    jax's dtype-floored variant, and the value the Pallas kernel is
+    built with (jax_kernels.catch_tie_atol — the unification this PR
+    pins)."""
+    assert jk.catch_tie_atol(jnp.float64) == nk.CATCH_TIE_ATOL
+    f32_band = jk.catch_tie_atol(jnp.float32)
+    assert f32_band == max(nk.CATCH_TIE_ATOL,
+                           32.0 * float(jnp.finfo(jnp.float32).eps))
+    assert f32_band > nk.CATCH_TIE_ATOL      # the f32 floor engages
+
+
+@pytest.mark.parametrize("mean,expected", [
+    (0.6, 0.5), (0.4, 0.5), (0.8, 1.0), (0.2, 0.0),
+    # one ulp inside the f32 band still snaps to 0.5 on every family
+    (0.6 - 1e-8, 0.5), (0.4 + 1e-8, 0.5),
+    # outside the band resolves to the side
+    (0.6 + 1e-3, 1.0), (0.4 - 1e-3, 0.0),
+])
+def test_catch_numpy_vs_jax_scalar(mean, expected):
+    got_np = float(nk.catch(np.asarray([mean]), TOL)[0])
+    got_jax = float(np.asarray(
+        jk.catch(jnp.asarray([mean], jnp.float32), TOL))[0])
+    assert got_np == got_jax == expected
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])
+def test_resolve_kernel_snaps_boundary_identically(dtype):
+    """The Pallas fused resolution kernel's catch snap on exact-boundary
+    column means must match the numpy and XLA families bit-identically
+    (interpret mode on CPU — the kernel arithmetic, not Mosaic, decides
+    the snap)."""
+    reports = _matrix()
+    R, E = reports.shape
+    rep = jnp.full((R,), 1.0 / R, jnp.float32)
+    x = _encode(reports, dtype)
+    fill = jnp.full((E,), 0.5, jnp.float32)   # no NaN: fill never used
+    raw, adjusted, *_ = resolve_certainty_fused(
+        x, rep, fill, jnp.sum(rep), TOL, interpret=True)
+    np.testing.assert_array_equal(np.asarray(adjusted, np.float64),
+                                  _EXPECTED)
+    # the numpy family on the EXACT f64 means, and the jax family on
+    # the kernel's own f32 means (each family snaps at ITS dtype's
+    # floored band — that is the unification's whole point: the f32
+    # kernel mean lands ~1e-7 off the knife edge and the f32-floored
+    # band absorbs it, while the exact f64 mean sits inside the 1e-9
+    # reference band)
+    exact_means = np.array([m for _, m in _COLUMNS])
+    np.testing.assert_array_equal(nk.catch(exact_means, TOL), _EXPECTED)
+    np.testing.assert_array_equal(
+        np.asarray(jk.catch(jnp.asarray(raw, jnp.float32), TOL),
+                   np.float64), _EXPECTED)
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16", "float32"])
+def test_boundary_fill_snaps_identically_with_na(dtype):
+    """Exact-boundary FILLS: a column whose present-weighted mean sits
+    on the boundary fills its NaN with the banded 0.5 on every family —
+    the parity-ledger #1-7 scenario, replayed through the Pallas
+    NaN-threaded storage (absent entries in-storage, fill vector from
+    the interpolate semantics)."""
+    reports = _matrix()
+    reports = np.vstack([reports, np.full((1, reports.shape[1]),
+                                          np.nan)])   # one NaN row
+    R, E = reports.shape
+    rep_np = np.full(R, 1.0 / R)
+    # the interpolate fill (numpy reference): present-weighted means of
+    # _COLUMNS — exactly the boundary values — then catch-snapped
+    filled = nk.interpolate(reports, rep_np, np.zeros(E, bool), TOL)
+    np.testing.assert_array_equal(filled[-1], _EXPECTED)
+    # jax family
+    filled_j, _ = jk.interpolate_masked(
+        jnp.asarray(reports, jnp.float32),
+        jnp.asarray(rep_np, jnp.float32), jnp.zeros(E, bool), TOL)
+    np.testing.assert_array_equal(np.asarray(filled_j)[-1], _EXPECTED)
+    # Pallas family: the resolve kernel consumes the fill vector and the
+    # sentinel storage; its adjusted outcomes must agree with the
+    # reference resolution of the FILLED matrix
+    x = _encode(reports, dtype)
+    rep = jnp.asarray(rep_np, jnp.float32)
+    fill = jnp.asarray(filled[-1], jnp.float32)
+    _, adjusted, *_ = resolve_certainty_fused(
+        x, rep, fill, jnp.sum(rep), TOL, interpret=True)
+    # present-weighted means are _COLUMNS' boundary values (the NaN row
+    # carries no present weight) — the kernel must land the same snaps
+    np.testing.assert_array_equal(np.asarray(adjusted, np.float64),
+                                  _EXPECTED)
+
+
+def test_full_pipeline_boundary_outcomes_numpy_vs_fused(rng):
+    """Pipeline-level: a matrix carrying boundary-mean columns resolved
+    through the numpy reference backend and through the fused Pallas
+    pipeline (``_consensus_core_fused``, interpret mode on CPU — the
+    graph the TPU fused gate and the serve ``bucket_pallas`` tier run)
+    produces identical catch-snapped outcomes and iteration counts —
+    the ISSUE 7 acceptance contract at the pipeline surface."""
+    from pyconsensus_tpu import Oracle
+    from pyconsensus_tpu.models.pipeline import (ConsensusParams,
+                                                 _consensus_core_fused)
+
+    reports = np.vstack([_matrix()] * 3)     # enough rows to score
+    reports[rng.random(reports.shape) < 0.1] = np.nan
+    R, E = reports.shape
+    p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                        power_tol=0.0, catch_tolerance=TOL,
+                        max_iterations=3, has_na=True, any_scaled=False,
+                        n_scaled=0, fused_resolution=True)
+    acc = jnp.asarray(0.0).dtype
+    fused = _consensus_core_fused(
+        jnp.asarray(reports, acc), jnp.full((R,), 1.0 / R, acc),
+        jnp.zeros((E,), bool), jnp.zeros((E,), acc),
+        jnp.ones((E,), acc), p)
+    res_np = Oracle(reports=reports, backend="numpy",
+                    catch_tolerance=TOL, max_iterations=3).consensus()
+    np.testing.assert_array_equal(
+        np.asarray(fused["outcomes_adjusted"], np.float64),
+        np.asarray(res_np["events"]["outcomes_adjusted"]))
+    assert int(np.asarray(fused["iterations"])) == res_np["iterations"]

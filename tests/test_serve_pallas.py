@@ -1,0 +1,313 @@
+"""The ``bucket_pallas`` low-latency serve tier (ISSUE 7 tentpole c).
+
+The fused NaN-threaded pipeline as a cached serve executable class:
+kernel-path-keyed executables that can never collide with the padded
+XLA buckets, eligibility gated by the fused kernels' VMEM fit
+predicates and the small-E class bound, catch-snapped outcomes and
+iteration counts bit-identical to a direct Oracle resolution (the tier
+runs the Oracle's own fused graph — CPU tests drive the kernels through
+the Pallas interpreter with ``pallas_buckets=True``), and the
+steady-state retrace pin for the ``serve_bucket_pallas`` entry.
+"""
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import Oracle, obs
+from pyconsensus_tpu.models.pipeline import ConsensusParams
+from pyconsensus_tpu.serve import (BucketKey, ConsensusService,
+                                   ExecutableCache, PALLAS_KERNEL_PATH,
+                                   XLA_KERNEL_PATH, ServeConfig,
+                                   pallas_bucket_eligible)
+from pyconsensus_tpu.serve.pallas import pallas_bucket_params
+
+#: fused-vs-direct continuous-tail band: the Pallas kernels decode and
+#: accumulate in f32 while the x64 test stack's direct Oracle runs f64
+#: end to end, so per-reporter scores drift at the f32-kernel class
+#: (the sharding suite's 2e-3, plus margin for the f64 reference);
+#: outcomes/iterations are bitwise — that is the tier's contract
+FUSED_ATOL = 5e-3
+
+_CONT_KEYS = (("agents", "smooth_rep"), ("agents", "this_rep"),
+              ("agents", "reporter_bonus"),
+              ("events", "certainty"), ("events", "consensus_reward"),
+              ("events", "participation_columns"))
+
+
+def _reports(rng, R=14, E=44, na_frac=0.1):
+    reports = rng.choice([0.0, 1.0], size=(R, E))
+    reports[rng.random((R, E)) < na_frac] = np.nan
+    return reports
+
+
+def _pallas_cfg(**kw):
+    kw.setdefault("pallas_buckets", True)
+    return ServeConfig(**kw)
+
+
+class TestEligibility:
+    def test_gate_modes(self):
+        import jax
+
+        args = dict(algorithm="sztorc", pca_method="auto",
+                    any_scaled=False, storage_dtype="", max_events=4096)
+        assert pallas_bucket_eligible(16, 64, mode=True, **args)
+        assert not pallas_bucket_eligible(16, 64, mode=False, **args)
+        # "auto" requires a TPU backend — this suite runs on CPU
+        assert jax.default_backend() != "tpu"
+        assert not pallas_bucket_eligible(16, 64, mode="auto", **args)
+        with pytest.raises(ValueError):
+            pallas_bucket_eligible(16, 64, mode="yes", **args)
+
+    def test_gate_scope(self):
+        base = dict(algorithm="sztorc", pca_method="power",
+                    any_scaled=False, storage_dtype="", mode=True,
+                    max_events=4096)
+        assert pallas_bucket_eligible(16, 64, **base)
+        # scaled events take the XLA/bucket tiers (the serve tier does
+        # not ride the gather-and-fix arm)
+        assert not pallas_bucket_eligible(
+            16, 64, **{**base, "any_scaled": True})
+        # beyond the small-E class bound
+        assert not pallas_bucket_eligible(
+            16, 8192, **base)
+        # non-sztorc / non-power algorithms stay off the fused tier
+        assert not pallas_bucket_eligible(
+            16, 64, **{**base, "algorithm": "k-means"})
+        assert not pallas_bucket_eligible(
+            16, 64, **{**base, "pca_method": "eigh"})
+        # VMEM misfit at huge padded R (resolve_kernel_fits' bound)
+        assert not pallas_bucket_eligible(60_000, 64, **base)
+
+    def test_default_config_off_tpu_stays_xla(self, rng):
+        """``pallas_buckets="auto"`` on a CPU host must not change any
+        pre-existing routing: the request lands on the padded XLA
+        bucket path exactly as before ISSUE 7."""
+        obs.reset()
+        reports = _reports(rng)
+        with ConsensusService(ServeConfig()) as svc:
+            res = svc.submit(reports=reports).result(120)
+        assert res["iterations"] >= 1
+        snap = obs.REGISTRY.snapshot().get(
+            "pyconsensus_serve_requests_total", {}).get("series", {})
+        assert not any("bucket_pallas" in k for k in snap)
+
+
+class TestBucketKey:
+    def test_kernel_path_dimension(self):
+        p = ConsensusParams(algorithm="sztorc", pca_method="power")
+        xla = BucketKey.make(16, 64, 8, p)
+        pal = BucketKey.make(16, 64, 8, p, kernel_path=PALLAS_KERNEL_PATH)
+        assert xla.kernel_path == XLA_KERNEL_PATH
+        assert pal.kernel_path == PALLAS_KERNEL_PATH
+        assert xla != pal          # same shape+params, distinct entries
+
+    def test_cache_never_collides_across_kernel_paths(self):
+        cache = ExecutableCache(capacity=4)
+        p_xla = ConsensusParams(algorithm="sztorc", pca_method="power",
+                                has_na=True, any_scaled=False)
+        p_pal = pallas_bucket_params(True, {}, ())
+        k_xla = BucketKey.make(8, 16, 1, p_xla)
+        k_pal = BucketKey.make(8, 16, 1, p_pal,
+                               kernel_path=PALLAS_KERNEL_PATH)
+        e1, e2 = cache.get(k_xla), cache.get(k_pal)
+        assert e1 is not e2
+        assert len(cache) == 2
+        assert cache.get(k_pal) is e2       # hit, not a rebuild
+
+    def test_pallas_key_rejects_mesh_topology(self):
+        cache = ExecutableCache(capacity=4)
+        p_pal = pallas_bucket_params(True, {}, ())
+        bad = BucketKey.make(8, 16, 1, p_pal, topology="TPU-v5e:2x4",
+                             kernel_path=PALLAS_KERNEL_PATH)
+        with pytest.raises(ValueError, match="single-topology"):
+            cache.get(bad)
+
+    def test_unknown_kernel_path_rejected(self):
+        cache = ExecutableCache(capacity=4)
+        p = ConsensusParams(algorithm="sztorc", pca_method="power")
+        bad = BucketKey.make(8, 16, 1, p, kernel_path="mosaic2")
+        with pytest.raises(ValueError, match="unknown bucket kernel"):
+            cache.get(bad)
+
+    def test_pallas_executable_requires_fused_params(self):
+        from pyconsensus_tpu.serve import make_pallas_bucket_executable
+
+        p = ConsensusParams(algorithm="sztorc", pca_method="power")
+        with pytest.raises(ValueError, match="fused_resolution"):
+            make_pallas_bucket_executable(p)
+
+
+class TestPallasTierParity:
+    @pytest.mark.parametrize("max_iterations", [1, 3])
+    def test_outcomes_bitwise_vs_direct_oracle(self, rng, max_iterations):
+        """The tier's contract (ISSUE 7 acceptance): catch-snapped
+        outcomes and iteration counts bit-identical to a direct Oracle
+        resolution; continuous tails in the documented fused-vs-XLA
+        band."""
+        reports = _reports(rng)
+        with ConsensusService(_pallas_cfg()) as svc:
+            got = svc.submit(reports=reports,
+                             max_iterations=max_iterations).result(120)
+        ref = Oracle(reports=reports,
+                     max_iterations=max_iterations).consensus()
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_adjusted"]),
+            np.asarray(ref["events"]["outcomes_adjusted"]))
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_final"]),
+            np.asarray(ref["events"]["outcomes_final"]))
+        assert got["iterations"] == ref["iterations"]
+        assert got["convergence"] == ref["convergence"]
+        for section, key in _CONT_KEYS:
+            np.testing.assert_allclose(
+                np.asarray(got[section][key]),
+                np.asarray(ref[section][key]), atol=FUSED_ATOL,
+                err_msg=f"{section}.{key}")
+
+    def test_dense_request(self, rng):
+        reports = _reports(rng, na_frac=0.0)
+        with ConsensusService(_pallas_cfg()) as svc:
+            got = svc.submit(reports=reports).result(120)
+        ref = Oracle(reports=reports).consensus()
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_adjusted"]),
+            np.asarray(ref["events"]["outcomes_adjusted"]))
+        assert got["iterations"] == ref["iterations"]
+
+    def test_repeat_dispatch_bitwise_and_retrace_pinned(self, rng):
+        """Serving determinism + the runtime CL304 pin: the same request
+        twice is bit-identical everywhere, and the second dispatch rides
+        the cached executable (serve_bucket_pallas retraces stay at the
+        number of cached Pallas executables)."""
+        obs.reset()
+        reports = _reports(rng)
+        with ConsensusService(_pallas_cfg()) as svc:
+            a = svc.submit(reports=reports).result(120)
+            b = svc.submit(reports=reports).result(120)
+            cached = len(svc.cache)
+        for section in ("agents", "events"):
+            for key in a[section]:
+                np.testing.assert_array_equal(
+                    np.asarray(a[section][key]),
+                    np.asarray(b[section][key]),
+                    err_msg=f"{section}.{key}")
+        assert cached == 1
+        assert obs.value("pyconsensus_jit_retraces_total",
+                         entry="serve_bucket_pallas") == 1
+
+    def test_kernel_path_counter_and_request_labels(self, rng):
+        obs.reset()
+        reports = _reports(rng)
+        with ConsensusService(_pallas_cfg()) as svc:
+            svc.submit(reports=reports).result(120)
+        assert obs.value("pyconsensus_kernel_path_total",
+                         path="pallas") >= 1
+        assert obs.value("pyconsensus_serve_requests_total",
+                         path="bucket_pallas", outcome="ok") == 1
+
+    def test_two_shapes_two_executables(self, rng):
+        """Exact-shape keying: two request shapes are two cache entries
+        (the documented latency-tier trade), both served."""
+        with ConsensusService(_pallas_cfg()) as svc:
+            svc.submit(reports=_reports(rng, R=10, E=24)).result(120)
+            svc.submit(reports=_reports(rng, R=12, E=32)).result(120)
+            assert len(svc.cache) == 2
+
+    def test_int8_storage_request_rides_pallas(self, rng):
+        """int8 sentinel storage is the fused tier's native encoding —
+        a binary request asking for it must ride bucket_pallas (the
+        padded XLA bucket refuses int8), with outcomes equal to the
+        f32 Oracle."""
+        obs.reset()
+        reports = _reports(rng)
+        with ConsensusService(_pallas_cfg()) as svc:
+            got = svc.submit(reports=reports,
+                             storage_dtype="int8").result(120)
+        ref = Oracle(reports=reports).consensus()
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_adjusted"]),
+            np.asarray(ref["events"]["outcomes_adjusted"]))
+        assert obs.value("pyconsensus_serve_requests_total",
+                         path="bucket_pallas", outcome="ok") >= 1
+
+    def test_scaled_request_falls_back(self, rng):
+        """A scaled-event request must NOT ride the fused tier (binary
+        scope) — it lands on another path and still resolves."""
+        obs.reset()
+        reports = _reports(rng, R=10, E=16, na_frac=0.0)
+        bounds = [None] * 15 + [{"scaled": True, "min": 0.0, "max": 10.0}]
+        reports[:, -1] = np.round(reports[:, -1] * 10)
+        with ConsensusService(_pallas_cfg()) as svc:
+            got = svc.submit(reports=reports,
+                             event_bounds=bounds).result(120)
+        snap = obs.REGISTRY.snapshot().get(
+            "pyconsensus_serve_requests_total", {}).get("series", {})
+        assert not any("bucket_pallas" in k for k in snap)
+        assert got["iterations"] >= 1
+
+
+class TestGroupFailure:
+    def test_dispatch_pallas_resolves_every_waiter_on_failure(self):
+        """A dispatch failure must resolve EVERY waiter in the group —
+        the tail after the failing request must not hang to its
+        timeouts (the _dispatch_bucket rule; review finding, ISSUE 7).
+        batch=1 keys make multi-request groups unreachable today, but
+        the handler claims to tolerate them defensively."""
+        from pyconsensus_tpu.serve.batcher import Microbatcher
+        from pyconsensus_tpu.serve.queue import ResolveRequest
+
+        class BoomCache:
+            def get(self, key):
+                raise RuntimeError("compile exploded")
+
+        p = pallas_bucket_params(True, {}, ())
+        key = BucketKey.make(4, 8, 1, p, kernel_path=PALLAS_KERNEL_PATH)
+        reqs = []
+        for _ in range(2):
+            r = ResolveRequest(reports=np.zeros((4, 8)))
+            r.reputation = np.full(4, 0.25)
+            r.scaled = np.zeros(8, bool)
+            r.mins, r.maxs = np.zeros(8), np.ones(8)
+            r.batch_key = key
+            reqs.append(r)
+        mb = Microbatcher(queue=None, cache=BoomCache(), config=None,
+                          sessions=None, admission=None)
+        with pytest.raises(RuntimeError, match="compile exploded"):
+            mb._dispatch_pallas(key, reqs)
+        for r in reqs:
+            assert r.future.done()
+            with pytest.raises(RuntimeError, match="compile exploded"):
+                r.future.result(timeout=0)
+
+
+class TestWarmupAndConfig:
+    def test_pallas_warmup_preflight(self):
+        obs.reset()
+        cfg = _pallas_cfg(pallas_warmup=((12, 24),), warmup=())
+        svc = ConsensusService(cfg)
+        n = svc.warm_buckets()
+        assert n == 1
+        assert len(svc.cache) == 1
+        key = svc.cache.keys()[0]
+        assert key.kernel_path == PALLAS_KERNEL_PATH
+        assert (key.rows, key.events, key.batch) == (12, 24, 1)
+        assert obs.value("pyconsensus_jit_retraces_total",
+                         entry="serve_bucket_pallas") == 1
+
+    def test_config_json_round_trip(self, tmp_path):
+        import json
+
+        path = tmp_path / "serve.json"
+        path.write_text(json.dumps({
+            "pallas_buckets": True, "pallas_max_events": 512,
+            "pallas_warmup": [[12, 24]]}))
+        cfg = ServeConfig.load(path)
+        assert cfg.pallas_buckets is True
+        assert cfg.pallas_max_events == 512
+        assert cfg.pallas_warmup == ((12, 24),)
+
+    def test_bad_mode_raises_at_submit(self, rng):
+        with ConsensusService(ServeConfig(pallas_buckets="never")) as svc:
+            with pytest.raises(Exception):
+                svc.submit(reports=_reports(rng)).result(120)

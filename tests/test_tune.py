@@ -1,0 +1,260 @@
+"""pyconsensus_tpu.tune — the Pallas block-shape autotuner (ISSUE 7
+tentpole b): legal-candidate sweeps under the kernels' VMEM fit
+predicates, deterministic interpret-mode winners, atomic persistence +
+cache-hit reload, provider wiring into ``pallas_kernels`` with stale-
+value re-validation, and the block-shapes-never-change-results
+invariant."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.ops import pallas_kernels as pk
+from pyconsensus_tpu.tune import (TuneCache, autotune_cov,
+                                  autotune_resolve, default_provider,
+                                  shape_class)
+
+
+@pytest.fixture(autouse=True)
+def _restore_provider():
+    """Every test leaves the kernel module's provider state as it found
+    it (other suites must keep seeing the heuristics)."""
+    prev = pk._TUNE_PROVIDER
+    prev_auto = pk._TUNE_AUTOLOAD
+    yield
+    pk._TUNE_PROVIDER = prev
+    pk._TUNE_AUTOLOAD = prev_auto
+
+
+class TestCandidates:
+    def test_resolve_candidates_legal(self):
+        for R in (64, 1000, 10_008):
+            for itemsize in (1, 2, 4):
+                for c in pk.resolve_block_candidates(R, itemsize):
+                    assert c % 128 == 0
+                    assert pk.resolve_block_fits(R, c, itemsize)
+
+    def test_resolve_candidates_cover_heuristic(self):
+        assert 128 in pk.resolve_block_candidates(10_008, 4)
+
+    def test_cov_candidates_legal_and_cover_heuristic(self):
+        for E in (128, 2048, 100_000):
+            for itemsize in (1, 2, 4):
+                cands = pk.cov_tile_candidates(E, itemsize, True)
+                assert all(t % 8 == 0 for t in cands)
+                heuristic = pk.matmat_tile_rows(E, itemsize, True)
+                assert heuristic in cands
+
+    @pytest.mark.parametrize("nan_fill", [True, False])
+    def test_cov_candidates_all_pass_fit_model(self, nan_fill):
+        """EVERY candidate must satisfy the sweep's own legality model —
+        including the appended heuristic (at compact DENSE storage the
+        hand-measured heuristic exceeds the conservative model and must
+        then stay OUT of the sweep space; review finding, ISSUE 7)."""
+        for E in (256, 1024, 4096, 100_000):
+            for itemsize in (1, 2, 4):
+                for t in pk.cov_tile_candidates(E, itemsize, nan_fill):
+                    assert pk.cov_tile_fits(t, E, itemsize), \
+                        (E, itemsize, nan_fill, t)
+
+    def test_no_fit_no_candidates(self):
+        # R=60k f32: no column block fits the 14 MB budget
+        assert pk.resolve_block_candidates(60_000, 4) == []
+
+
+class TestProviderWiring:
+    def test_tile_override_and_validation(self):
+        default = pk.matmat_tile_rows(2048, 1, True)
+        pk.set_tune_provider(
+            lambda kind, **ctx: 32 if kind == "cov_tile_rows" else None)
+        assert pk.matmat_tile_rows(2048, 1, True) == 32
+        # an ILLEGAL provider value (not mult-of-8 / VMEM misfit) is
+        # ignored, never trusted
+        pk.set_tune_provider(lambda kind, **ctx: 12)
+        assert pk.matmat_tile_rows(2048, 1, True) == default
+        pk.set_tune_provider(lambda kind, **ctx: 1 << 20)
+        assert pk.matmat_tile_rows(2048, 1, True) == default
+        pk.set_tune_provider(None)
+        assert pk.matmat_tile_rows(2048, 1, True) == default
+
+    def test_garbage_provider_values_degrade_to_heuristic(self, rng):
+        """A hand-edited cache can put ANY JSON behind "value" — a
+        provider returning a string/float/bool/negative, or raising,
+        must yield the heuristic, never crash a kernel build (review
+        finding, ISSUE 7)."""
+        default = pk.matmat_tile_rows(2048, 1, True)
+        for bad in ("fast", 16.5, True, -8, 0, None):
+            pk.set_tune_provider(lambda kind, _b=bad, **ctx: _b)
+            assert pk.matmat_tile_rows(2048, 1, True) == default, bad
+        def boom(kind, **ctx):
+            raise RuntimeError("corrupt provider")
+        pk.set_tune_provider(boom)
+        assert pk.matmat_tile_rows(2048, 1, True) == default
+        # end to end through the resolve kernel's tuned-width lookup
+        pk.set_tune_provider(lambda kind, **ctx: "fast")
+        x = jnp.asarray(rng.choice([0.0, 1.0], size=(16, 64)),
+                        jnp.float32)
+        rep = jnp.full((16,), 1 / 16, jnp.float32)
+        fill = jnp.full((64,), 0.5, jnp.float32)
+        out = pk.resolve_certainty_fused(x, rep, fill, jnp.sum(rep), 0.1,
+                                         interpret=True)
+        assert np.isfinite(np.asarray(out[0])).all()
+        # an integral float IS accepted (JSON round-trips ints as such)
+        pk.set_tune_provider(lambda kind, **ctx: 32.0)
+        assert pk.matmat_tile_rows(2048, 1, True) == 32
+
+    def test_resolve_width_override_changes_nothing_numeric(self, rng):
+        """A tuned column width must change the grid, not the results:
+        the fused resolution kernel at two widths is bit-identical."""
+        x = jnp.asarray(rng.choice([0.0, 0.5, 1.0, np.nan],
+                                   size=(16, 300)), jnp.float32)
+        rep = jnp.full((16,), 1 / 16, jnp.float32)
+        fill = jnp.full((300,), 0.5, jnp.float32)
+        outs = {}
+        for C in (128, 256):
+            outs[C] = [np.asarray(o) for o in pk.resolve_certainty_fused(
+                x, rep, fill, jnp.sum(rep), 0.1, block_cols=C,
+                interpret=True)]
+        for a, b in zip(outs[128], outs[256]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_default_provider_serves_persisted_winner(self, tmp_path):
+        """An entry persisted under this host's generation is served by
+        the default provider at kernel-build time; absent entries fall
+        through to the fallback chain (None = in-kernel heuristic)."""
+        from pyconsensus_tpu.tune.autotune import (_entry_key,
+                                                   tpu_generation)
+
+        path = tmp_path / "cache.json"
+        cache = TuneCache(path)
+        key = _entry_key("cov_tile_rows", tpu_generation(), 1,
+                         shape_class(2048), nan_fill=True)
+        cache.put(key, {"value": 48})
+        provider = default_provider(path)
+        assert provider("cov_tile_rows", n_events=2048, itemsize=1,
+                        nan_fill=True) == 48
+        # absent shape class -> fallback (None on this generation)
+        assert provider("cov_tile_rows", n_events=65_536, itemsize=1,
+                        nan_fill=True) is None
+        # end to end: the kernel sizing picks the persisted winner
+        pk.set_tune_provider(provider)
+        assert pk.matmat_tile_rows(2048, 1, True) == 48
+
+
+class TestSweeps:
+    def test_interpret_sweep_deterministic_and_persisted(self, tmp_path):
+        path = tmp_path / "cache.json"
+        obs.reset()
+        e1 = autotune_resolve(64, n_events=96, interpret=True, path=path)
+        assert e1["mode"] == "interpret"
+        assert e1["value"] in e1["candidates"]
+        assert obs.value("pyconsensus_autotune_sweeps_total",
+                         kind="resolve_block_cols") == 1
+        # the persisted file is valid JSON with the entry installed
+        raw = json.loads(path.read_text())
+        assert raw["version"] == 1
+        assert any(v["value"] == e1["value"]
+                   for v in raw["entries"].values())
+        # second call: served from cache — NO sweep, same winner
+        e2 = autotune_resolve(64, n_events=96, interpret=True, path=path)
+        assert e2["value"] == e1["value"]
+        assert obs.value("pyconsensus_autotune_sweeps_total",
+                         kind="resolve_block_cols") == 1
+        assert obs.value("pyconsensus_autotune_cache_hits_total",
+                         kind="resolve_block_cols") == 1
+        # force re-sweeps and re-lands the same deterministic winner
+        e3 = autotune_resolve(64, n_events=96, interpret=True, path=path,
+                              force=True)
+        assert e3["value"] == e1["value"]
+
+    def test_cov_sweep_deterministic_and_persisted(self, tmp_path):
+        path = tmp_path / "cache.json"
+        obs.reset()
+        e1 = autotune_cov(256, n_reporters=24, interpret=True, path=path)
+        e2 = autotune_cov(256, n_reporters=24, interpret=True, path=path)
+        assert e1["value"] == e2["value"]
+        assert e1["value"] in e1["candidates"]
+        assert obs.value("pyconsensus_autotune_sweeps_total",
+                         kind="cov_tile_rows") == 1
+        assert obs.value("pyconsensus_autotune_cache_hits_total",
+                         kind="cov_tile_rows") == 1
+
+    def test_cov_sweep_preserves_provider_autoload(self, tmp_path):
+        """The cov sweep's scoped per-candidate override must not latch
+        the lazy default-provider autoload off: a fresh process that
+        tunes and then builds kernels must pick its own winner up
+        (review finding, ISSUE 7)."""
+        pk._TUNE_PROVIDER = None
+        pk._TUNE_AUTOLOAD = True
+        autotune_cov(256, n_reporters=24, interpret=True,
+                     path=tmp_path / "cache.json")
+        assert pk._TUNE_PROVIDER is None
+        assert pk._TUNE_AUTOLOAD is True
+
+    def test_storage_dtypes_key_separately(self, tmp_path):
+        path = tmp_path / "cache.json"
+        autotune_resolve(64, n_events=96, storage_dtype="int8",
+                         interpret=True, path=path)
+        autotune_resolve(64, n_events=96, storage_dtype="",
+                         interpret=True, path=path)
+        raw = json.loads(path.read_text())
+        assert len(raw["entries"]) == 2
+
+    def test_unfittable_shape_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="XLA path"):
+            autotune_resolve(60_000, storage_dtype="float32",
+                             interpret=True,
+                             path=tmp_path / "cache.json")
+
+
+class TestCacheDurability:
+    def test_corrupt_cache_treated_as_empty(self, tmp_path, capsys):
+        path = tmp_path / "cache.json"
+        path.write_text("{torn")
+        cache = TuneCache(path)
+        assert cache.entries == {}
+        assert "unreadable" in capsys.readouterr().err
+        # a sweep then rewrites a clean file
+        autotune_resolve(64, n_events=96, interpret=True, path=path)
+        assert json.loads(path.read_text())["version"] == 1
+
+    def test_foreign_version_ignored(self, tmp_path, capsys):
+        path = tmp_path / "cache.json"
+        path.write_text(json.dumps({"version": 99, "entries": {"k": 1}}))
+        cache = TuneCache(path)
+        assert cache.entries == {}
+        assert "version" in capsys.readouterr().err
+
+    def test_atomic_write_fault_site(self, tmp_path):
+        """The persistence rides the faults machinery: a seeded raise at
+        tune.cache_write surfaces, and the file keeps its previous
+        content (atomic_write never tears)."""
+        from pyconsensus_tpu.faults import plan as fplan
+
+        path = tmp_path / "cache.json"
+        cache = TuneCache(path)
+        cache.put("a", {"value": 1})
+        plan = fplan.FaultPlan(
+            seed=3, rules=[fplan.FaultRule("tune.cache_write", "raise")])
+        with fplan.armed(plan):
+            with pytest.raises(Exception):
+                cache.put("b", {"value": 2})
+        assert json.loads(path.read_text())["entries"] == {"a": {"value": 1}}
+
+
+class TestCLI:
+    def test_module_cli_json_line(self, tmp_path, capsys):
+        from pyconsensus_tpu.tune.__main__ import main
+
+        main(["--reporters", "64", "--events", "128",
+              "--probe-events", "96", "--probe-reporters", "24",
+              "--interpret", "--cache", str(tmp_path / "c.json")])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        d = json.loads(out)
+        assert d["cov_tile_rows"]["value"] in \
+            d["cov_tile_rows"]["candidates"]
+        assert d["resolve_block_cols"]["value"] in \
+            d["resolve_block_cols"]["candidates"]
