@@ -171,6 +171,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--serve-requests", type=int, default=48)
     ap.add_argument("--serve-concurrency", type=int, default=8)
     ap.add_argument("--serve-seed", type=int, default=0)
+    ap.add_argument("--no-fleet", action="store_true",
+                    help="skip the fail-soft fleet chaos probe (worker "
+                         "kill mid-traffic + session failover, appended "
+                         "to the JSON as the 'fleet' key)")
+    ap.add_argument("--fleet-requests", type=int, default=36,
+                    help="stateless requests driven through the fleet "
+                         "probe (a worker dies mid-run)")
+    ap.add_argument("--fleet-workers", type=int, default=3)
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="seconds allowed for the backend-availability "
                          "probe subprocess (a wedged axon tunnel hangs "
@@ -387,6 +395,7 @@ def run_bench(args) -> None:
                                                        value)
     out_json["latency"] = _latency_block(args)
     out_json["serve"] = _serve_block(args)
+    out_json["fleet"] = _fleet_block(args)
     print(json.dumps(out_json))
 
 
@@ -615,6 +624,162 @@ def _serve_block(args):
         print(f"WARNING: serve block unavailable: "
               f"{type(exc).__name__}: {exc}", file=sys.stderr)
         return None
+
+
+def _fleet_block(args):
+    """ISSUE 8 satellite: a fleet chaos probe alongside the resolution
+    metric — N workers behind the consistent-hash router, concurrent
+    stateless traffic (numpy direct path: the probe measures the
+    ROUTING/FAILOVER layer, not kernel throughput) plus one durable
+    session, with a worker hard-killed mid-run. Reports the survival
+    arithmetic (failovers, sessions migrated, sheds absorbed by the
+    honest-retry client) and p99 latency DURING the takeover window vs
+    steady state — the operator number that says what a worker death
+    costs clients. FAIL-SOFT like the serve block: any failure is a
+    stderr WARNING and a null block."""
+    if args.no_fleet:
+        return None
+    fleet = log_dir = None
+    try:
+        import tempfile
+        import threading
+
+        import numpy as np
+
+        from pyconsensus_tpu import obs
+        from pyconsensus_tpu.serve import ServeConfig
+        from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+        from pyconsensus_tpu.serve.loadgen import RETRYABLE_CODES
+
+        n_requests = max(12, args.fleet_requests)
+        log_dir = tempfile.mkdtemp(prefix="bench-fleet-")
+        window_s = 1.0            # takeover window; also the latency
+        fleet = ConsensusFleet(FleetConfig(   # attribution bucket below
+            n_workers=max(2, args.fleet_workers), log_dir=log_dir,
+            worker=ServeConfig(warmup=(), batch_window_ms=1.0),
+            takeover_window_s=window_s)).start(warmup=False)
+        rng = np.random.default_rng(args.serve_seed)
+        matrix = rng.choice([0.0, 1.0], size=(16, 24))
+        block = rng.choice([0.0, 1.0], size=(12, 6))
+        fleet.create_session("bench-market", n_reporters=12)
+        fleet.append("bench-market", block)
+        fleet.submit(session="bench-market").result(timeout=120)
+
+        failovers0 = obs.value("pyconsensus_failovers_total") or 0
+        migrated0 = obs.value("pyconsensus_sessions_migrated_total") or 0
+        samples = []          # (start, end) of successes
+        tallies = {"shed": 0, "retried": 0, "abandoned": 0}
+        fatal = []            # non-retryable client errors, re-raised
+        lock = threading.Lock()   # on the MAIN thread (fail-soft path)
+        kill_gate = threading.Event()
+        kill_at = [None]
+
+        def client(n):
+            for i in range(n):
+                if i == min(n - 1, max(1, n // 3)):
+                    kill_gate.set()          # mid-traffic
+                t0 = time.perf_counter()
+                for attempt in range(5):
+                    try:
+                        fleet.submit(reports=matrix,
+                                     backend="numpy").result(60)
+                        with lock:
+                            samples.append((t0, time.perf_counter()))
+                        break
+                    except Exception as exc:  # noqa: BLE001 — tallied
+                        code = getattr(exc, "error_code", "")
+                        with lock:
+                            tallies["shed"] += 1
+                        if code not in RETRYABLE_CODES:
+                            with lock:
+                                fatal.append(exc)
+                            return
+                        if attempt == 4:
+                            continue   # budget spent: abandon without a
+                                       # futile sleep or a phantom retry
+                        with lock:
+                            tallies["retried"] += 1
+                        time.sleep(float(getattr(exc, "context", {})
+                                         .get("retry_after_s", 0.05)))
+                else:
+                    with lock:
+                        tallies["abandoned"] += 1
+
+        conc = max(2, args.serve_concurrency // 2)
+        per = -(-n_requests // conc)
+        threads = [threading.Thread(target=client, args=(per,))
+                   for _ in range(conc)]
+
+        def killer():
+            kill_gate.wait(timeout=60)
+            kill_at[0] = time.perf_counter()
+            fleet.kill_worker(fleet.owner_of("bench-market"))
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        kt.join()
+        if fatal:
+            raise fatal[0]   # fail-soft: becomes the WARNING+null block
+        # the session resumed on the standby, bit-identically (the
+        # tests pin the bits; the bench pins that it still serves)
+        fleet.append("bench-market", block)
+        fleet.submit(session="bench-market").result(timeout=120)
+        fleet.close(drain=True)
+
+        from pyconsensus_tpu.serve.loadgen import _quantile
+
+        def p99(vals):
+            q = _quantile(sorted(vals), 0.99)
+            return None if q is None else round(1e3 * q, 3)
+
+        t_kill = kill_at[0]
+        during = [e - s for s, e in samples
+                  if t_kill is not None and e >= t_kill
+                  and s <= t_kill + window_s]
+        steady = [e - s for s, e in samples
+                  if t_kill is None or not (e >= t_kill
+                                            and s <= t_kill + window_s)]
+        status = fleet.status()
+        return {
+            "workers": len(fleet.workers),
+            "workers_alive_after": status["alive"],
+            "requests": conc * per,
+            "succeeded": len(samples),
+            "failovers_survived": int(
+                (obs.value("pyconsensus_failovers_total") or 0)
+                - failovers0),
+            "sessions_migrated": int(
+                (obs.value("pyconsensus_sessions_migrated_total") or 0)
+                - migrated0),
+            "sheds_observed": tallies["shed"],
+            "retried": tallies["retried"],
+            "abandoned": tallies["abandoned"],
+            "latency_p99_steady_ms": p99(steady),
+            "latency_p99_takeover_ms": p99(during),
+            "takeover_window_s": window_s,
+        }
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: fleet block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+    finally:
+        # the probe must not leak its workers or its replication-log
+        # tempdir, success or fail-soft alike (drain-free close; the
+        # success path already drained, a failed run has nothing worth
+        # draining)
+        if fleet is not None:
+            try:
+                fleet.close(drain=False, timeout=5.0)
+            except Exception:                     # noqa: BLE001
+                pass
+        if log_dir is not None:
+            import shutil
+
+            shutil.rmtree(log_dir, ignore_errors=True)
 
 
 def _obs_columns(out) -> dict:

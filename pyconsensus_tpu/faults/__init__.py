@@ -37,8 +37,9 @@ from __future__ import annotations
 from .degrade import (POWER_METHODS, fallback_steps, quarantine_nonfinite,
                       raise_exhausted, record_fallback, result_nonfinite)
 from .errors import (ERROR_CODES, CheckpointCorruptionError, ConsensusError,
-                     ConvergenceError, InputError, NumericsError,
-                     ServiceOverloadError)
+                     ConvergenceError, FailoverInProgressError, InputError,
+                     NumericsError, PlacementError, ServiceOverloadError,
+                     WorkerLostError)
 from .plan import (FaultPlan, FaultRule, SimulatedCrash, active_plan, arm,
                    armed, corrupt, disarm, fire)
 from .retry import retry, retry_call
@@ -47,7 +48,9 @@ __all__ = [
     "FaultPlan", "FaultRule", "SimulatedCrash",
     "arm", "disarm", "armed", "active_plan", "fire", "corrupt",
     "ConsensusError", "InputError", "NumericsError", "ConvergenceError",
-    "CheckpointCorruptionError", "ServiceOverloadError", "ERROR_CODES",
+    "CheckpointCorruptionError", "ServiceOverloadError",
+    "WorkerLostError", "FailoverInProgressError", "PlacementError",
+    "ERROR_CODES",
     "retry", "retry_call",
     "quarantine_nonfinite", "result_nonfinite", "record_fallback",
     "fallback_steps", "raise_exhausted", "POWER_METHODS",

@@ -27,6 +27,15 @@ Code space (documented in docs/ROBUSTNESS.md):
   before dispatch, or shutdown drain in progress. The request itself is
   well-formed; retrying later (the ``context`` carries ``retry``
   guidance) is the expected recovery.
+- ``PYC5xx`` — fleet: the replicated serve fleet
+  (``pyconsensus_tpu.serve.fleet``) could not place or complete a
+  request because of a WORKER fault rather than load policy — the
+  owning worker died with the request in flight (``PYC501``), its
+  sessions are mid-takeover on the standby (``PYC502``), or no worker
+  can own the key at all (``PYC503``). ``PYC501``/``PYC502`` carry an
+  honest ``retry_after_s`` (the expected takeover window) — the client
+  retries and lands on the survivor; ``PYC503`` is a deployment error
+  (empty fleet / unknown worker), not retryable.
 
 ``context`` keyword arguments are stored on the exception (``.context``)
 for structured logging; the message stays human-first.
@@ -36,7 +45,8 @@ from __future__ import annotations
 
 __all__ = ["ConsensusError", "InputError", "NumericsError",
            "ConvergenceError", "CheckpointCorruptionError",
-           "ServiceOverloadError", "ERROR_CODES"]
+           "ServiceOverloadError", "WorkerLostError",
+           "FailoverInProgressError", "PlacementError", "ERROR_CODES"]
 
 
 class ConsensusError(Exception):
@@ -102,11 +112,46 @@ class ServiceOverloadError(ConsensusError, RuntimeError):
     error_code = "PYC401"
 
 
+class WorkerLostError(ConsensusError, RuntimeError):
+    """A fleet worker died (SIGKILL, crash, heartbeat loss) while this
+    request was queued or in flight on it. The request was ACCEPTED and
+    is now provably not running anywhere — it is safe to retry; the
+    consistent-hash ring routes the retry to a surviving worker (or, for
+    a session, to the standby once takeover completes). ``context``
+    carries the dead ``worker`` name and an honest ``retry_after_s``
+    (the fleet's expected takeover window)."""
+
+    error_code = "PYC501"
+
+
+class FailoverInProgressError(ConsensusError, RuntimeError):
+    """The request targets a session whose owning worker just died and
+    whose durable state (ledger checkpoint + staged-block journal) is
+    being replayed onto the standby RIGHT NOW. The session is fenced
+    during replay — serving from half-replayed state could return bits
+    that differ from the single-box run, the one thing the fleet
+    guarantees never happens. ``context.retry_after_s`` is the honest
+    remaining takeover-window estimate."""
+
+    error_code = "PYC502"
+
+
+class PlacementError(ConsensusError, RuntimeError):
+    """Consistent-hash placement has no worker for the key: the ring is
+    empty (every worker dead or the fleet never started), or a caller
+    named a worker the fleet does not know. Unlike PYC501/PYC502 this is
+    not transient — retrying without operator action (restart workers)
+    cannot succeed, so no ``retry_after_s`` is offered."""
+
+    error_code = "PYC503"
+
+
 #: stable code -> class registry (docs/ROBUSTNESS.md table is generated
 #: from the same source of truth; tests pin the codes)
 ERROR_CODES = {
     cls.error_code: cls
     for cls in (ConsensusError, InputError, NumericsError,
                 ConvergenceError, CheckpointCorruptionError,
-                ServiceOverloadError)
+                ServiceOverloadError, WorkerLostError,
+                FailoverInProgressError, PlacementError)
 }

@@ -247,38 +247,45 @@ class ReputationLedger:
         return {"reputation": rep, "round": rnd, **decoded}
 
     @classmethod
-    def _from_state(cls, data, source="checkpoint") -> "ReputationLedger":
-        state = cls._validate_state(data, source)
+    def _from_state(cls, state, source="checkpoint") -> "ReputationLedger":
+        """Build a ledger from an ALREADY-validated state dict (see
+        :meth:`_validate_state`). A rebuild failure (e.g. a foreign
+        kwarg in ``oracle_kwargs``) is still a checkpoint problem and
+        surfaces under the taxonomy."""
         rep = state["reputation"]
-        ledger = cls(n_reporters=rep.shape[0], reputation=rep,
-                     **state["oracle_kwargs"])
+        try:
+            ledger = cls(n_reporters=rep.shape[0], reputation=rep,
+                         **state["oracle_kwargs"])
+        except Exception as exc:
+            raise CheckpointCorruptionError(
+                f"{source}: checkpoint does not rebuild "
+                f"({type(exc).__name__}: {exc})",
+                source=str(source)) from exc
         ledger.reputation = rep          # verbatim — no re-normalization,
         ledger.round = state["round"]    # resume is bit-exact
         ledger.history = state["history"]
         return ledger
 
     @classmethod
-    def load(cls, path) -> "ReputationLedger":
-        """Restore a ledger exactly as :meth:`save` left it. The format is
-        auto-detected: an orbax checkpoint is a directory, an npz a file.
-        A torn/unreadable file or a failed field validation raises
-        :class:`CheckpointCorruptionError` naming the problem — never a
-        parser traceback or, worse, an error rounds later inside
-        ``resolve``."""
+    def _read_state(cls, path) -> dict:
+        """Open a checkpoint (orbax dir / npz file, with the ``.npz``
+        suffix fallback) and run the full field validation. The ONE
+        reader behind both :meth:`load` and :meth:`verify` — the
+        takeover preflight must accept and reject exactly the files the
+        load that follows it would, so they cannot be allowed to
+        drift."""
         path = pathlib.Path(path)
-        _faults.fire("ledger.load", path=path)
         if path.is_dir():
             import orbax.checkpoint as ocp
 
             try:
                 data = ocp.PyTreeCheckpointer().restore(path.resolve())
-                return cls._from_state(data, source=path)
+                return cls._validate_state(data, source=path)
             except CheckpointCorruptionError:
                 raise
             except Exception as exc:
-                # a truncated orbax directory / TensorStore error / bad
-                # kwarg exploding in the rebuild — same taxonomy as the
-                # npz branch below
+                # a truncated orbax directory / TensorStore error —
+                # same taxonomy as the npz branch below
                 raise CheckpointCorruptionError(
                     f"{path}: unreadable checkpoint "
                     f"({type(exc).__name__}: {exc})",
@@ -287,15 +294,49 @@ class ReputationLedger:
             path = path.with_name(path.name + ".npz")
         try:
             with np.load(path) as data:
-                return cls._from_state(data, source=path)
-        except FileNotFoundError:
-            raise
-        except CheckpointCorruptionError:
+                return cls._validate_state(data, source=path)
+        except (FileNotFoundError, CheckpointCorruptionError):
             raise
         except Exception as exc:
-            # zipfile.BadZipFile (torn write), pickle errors, truncated
-            # members, a bad kwarg exploding in the constructor —
-            # anything the npz reader or the rebuild can throw
+            # a torn final record truncates the npz central directory /
+            # last member — zipfile.BadZipFile or a short-read
+            # ValueError, the classic power-loss / SIGKILL-mid-write
+            # artifact, surfaced under the taxonomy
             raise CheckpointCorruptionError(
                 f"{path}: unreadable checkpoint ({type(exc).__name__}: "
                 f"{exc})", source=str(path)) from exc
+
+    @classmethod
+    def verify(cls, path) -> dict:
+        """Dry-run integrity check of a checkpoint: run the FULL load
+        validation (field presence / shape / dtype / finiteness / JSON
+        decode, torn-npz detection included) WITHOUT constructing a
+        ledger or mutating anything — the file is opened read-only and
+        no ``ReputationLedger`` state exists afterward. Returns a
+        summary ``{"n_reporters", "round", "rounds_recorded"}`` on
+        success; raises :class:`CheckpointCorruptionError` naming the
+        offending field or file otherwise.
+
+        This is the hot-standby takeover PREFLIGHT (ISSUE 8): a standby
+        about to adopt a dead worker's sessions verifies every ledger it
+        would replay first, so it never builds serving state from a
+        corrupt log — a torn final record (the classic power-loss /
+        SIGKILL-mid-write artifact) fails HERE, before any session
+        exists to serve wrong bits."""
+        state = cls._read_state(path)
+        return {"n_reporters": int(state["reputation"].shape[0]),
+                "round": int(state["round"]),
+                "rounds_recorded": len(state["history"])}
+
+    @classmethod
+    def load(cls, path) -> "ReputationLedger":
+        """Restore a ledger exactly as :meth:`save` left it. The format is
+        auto-detected: an orbax checkpoint is a directory, an npz a file.
+        A torn/unreadable file or a failed field validation raises
+        :class:`CheckpointCorruptionError` naming the problem — never a
+        parser traceback or, worse, an error rounds later inside
+        ``resolve``. :meth:`verify` runs the same validation as a
+        no-construction dry run (the takeover preflight)."""
+        path = pathlib.Path(path)
+        _faults.fire("ledger.load", path=path)
+        return cls._from_state(cls._read_state(path), source=path)

@@ -22,18 +22,29 @@ Guarantees (docs/SERVING.md):
   alone — never of traffic shape, co-batched requests, or cache state;
 - overload is shed deterministically with ``ServiceOverloadError``
   (PYC401) at admission or at deadline — queues are bounded, waits are
-  deadlined.
+  deadlined;
+- the replicated fleet (``serve.fleet``, ISSUE 8) survives any worker's
+  death mid-traffic: consistent-hash placement moves only the dead
+  worker's sessions, the replication log (ledger checkpoints + staged
+  journals) resumes them bit-identical on the standby, and everything
+  in between sheds with PYC5xx errors carrying honest ``retry_after_s``
+  — never a silent drop.
 """
 
 from __future__ import annotations
 
-from ..faults import ServiceOverloadError
+from ..faults import (FailoverInProgressError, PlacementError,
+                      ServiceOverloadError, WorkerLostError)
+from .admission import ClusterCapacity
 from .cache import BucketKey, ExecutableCache
+from .failover import DurableSession, ReplicationLog, replay_session
+from .fleet import ConsensusFleet, FleetConfig, FleetWorker
 from .kernels import (SERVE_ALGORITHMS, bucket_inputs, bucket_path_eligible,
                       make_bucket_executable, padded_consensus, slice_result)
 from .loadgen import LoadGenerator
 from .pallas import (PALLAS_KERNEL_PATH, XLA_KERNEL_PATH,
                      make_pallas_bucket_executable, pallas_bucket_eligible)
+from .placement import HashRing
 from .queue import RequestQueue, ResolveRequest
 from .service import ConsensusService, ServeConfig
 from .session import MarketSession, SessionStore
@@ -52,4 +63,8 @@ __all__ = [
     "mesh_fingerprint", "serve_mesh", "sharded_bucket_eligible",
     "PALLAS_KERNEL_PATH", "XLA_KERNEL_PATH",
     "make_pallas_bucket_executable", "pallas_bucket_eligible",
+    "ConsensusFleet", "FleetConfig", "FleetWorker", "HashRing",
+    "ClusterCapacity", "DurableSession", "ReplicationLog",
+    "replay_session", "WorkerLostError", "FailoverInProgressError",
+    "PlacementError",
 ]

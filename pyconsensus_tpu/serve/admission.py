@@ -1,5 +1,6 @@
 """Admission control: token-bucket rate limits + deadline shedding
-(serve tentpole part d).
+(serve tentpole part d), plus the fleet's cluster-capacity view
+(ISSUE 8).
 
 Overload behavior is DETERMINISTIC by design: a request that cannot be
 served within policy is refused at the front door (or shed at dispatch
@@ -8,6 +9,14 @@ when its deadline has already passed) with a structured
 naming the policy) — never absorbed into unbounded queue growth or a
 deadline-less hang. The bounded queue itself lives in ``queue.py``; this
 module owns the per-tenant rate policy and the drain flag.
+
+:class:`ClusterCapacity` extends the same discipline to a FLEET: it
+tracks which workers are alive and how much bounded-queue headroom each
+contributes, so a cluster-wide shed can quote an honest
+``retry_after_s`` that scales with how much of the fleet survives (half
+the workers → roughly twice the drain time for the same backlog), and a
+takeover window is a first-class, deadline-bounded state the router can
+quote to clients instead of guessing.
 """
 
 from __future__ import annotations
@@ -18,7 +27,7 @@ import time
 from .. import obs
 from ..faults import ServiceOverloadError
 
-__all__ = ["TokenBucket", "AdmissionController"]
+__all__ = ["TokenBucket", "AdmissionController", "ClusterCapacity"]
 
 
 class TokenBucket:
@@ -107,3 +116,109 @@ class AdmissionController:
         """Count a shed decided elsewhere (deadline at dispatch,
         queue_full in the queue) under the same metric."""
         self._shed.inc(reason=reason)
+
+
+class ClusterCapacity:
+    """The fleet's shedding arithmetic (ISSUE 8): who is alive, how much
+    bounded-queue headroom survives, how long the current takeover
+    window has left. Pure bookkeeping — the ROUTER decides and raises;
+    this view makes its ``retry_after_s`` quotes honest instead of a
+    constant someone guessed.
+
+    ``base_retry_s`` calibrates the healthy-fleet retry hint; a cluster
+    shed scales it by ``registered/alive`` (fewer survivors drain the
+    same offered load proportionally slower) and adds any remaining
+    takeover window (a retry during takeover that lands before the
+    standby finishes would only be refused again)."""
+
+    def __init__(self, base_retry_s: float = 0.25) -> None:
+        self.base_retry_s = float(base_retry_s)
+        self._lock = threading.Lock()
+        self._workers: dict = {}       # name -> {"alive": bool, "slots"}
+        self._takeover_until = 0.0
+        self._takeovers = 0            # concurrently open windows
+        self._gauge = obs.gauge(
+            "pyconsensus_fleet_workers",
+            "alive workers in the consensus serve fleet")
+        self._queue_gauge = obs.gauge(
+            "pyconsensus_fleet_worker_queue_depth",
+            "queued requests per fleet worker", labels=("worker",))
+
+    # -- membership -----------------------------------------------------
+
+    def register(self, worker: str, queue_slots: int) -> None:
+        with self._lock:
+            self._workers[str(worker)] = {"alive": True,
+                                          "slots": int(queue_slots)}
+            self._gauge.set(self._alive_locked())
+
+    def mark_dead(self, worker: str) -> None:
+        with self._lock:
+            if str(worker) in self._workers:
+                self._workers[str(worker)]["alive"] = False
+            self._gauge.set(self._alive_locked())
+
+    def _alive_locked(self) -> int:
+        return sum(1 for w in self._workers.values() if w["alive"])
+
+    @property
+    def alive(self) -> int:
+        with self._lock:
+            return self._alive_locked()
+
+    @property
+    def registered(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def alive_slots(self) -> int:
+        """Bounded-queue capacity the surviving workers contribute.
+        Quoted in cluster-full sheds and the fleet status snapshot so
+        clients and operators see how much headroom died with the
+        worker (enforcement stays per-queue: the router spills over the
+        ring and sheds only when every surviving queue refused)."""
+        with self._lock:
+            return sum(w["slots"] for w in self._workers.values()
+                       if w["alive"])
+
+    def observe_queue_depth(self, worker: str, depth: int) -> None:
+        """Feed the per-worker queue gauge (the router samples depths
+        on its heartbeat scan)."""
+        self._queue_gauge.set(int(depth), worker=str(worker))
+
+    # -- takeover window ------------------------------------------------
+
+    def begin_takeover(self, window_s: float) -> None:
+        """Open (or extend) the takeover window: until it closes, fleet
+        sheds fold the remaining window into their retry hints. Windows
+        nest — two workers dying near-simultaneously each open one, and
+        the window closes only when the LAST takeover ends (the first
+        to finish must not collapse a window still in flight)."""
+        with self._lock:
+            self._takeovers += 1
+            self._takeover_until = max(self._takeover_until,
+                                       time.monotonic() + float(window_s))
+
+    def end_takeover(self) -> None:
+        with self._lock:
+            self._takeovers = max(0, self._takeovers - 1)
+            if self._takeovers == 0:
+                self._takeover_until = 0.0
+
+    def takeover_remaining(self) -> float:
+        with self._lock:
+            return max(0.0, self._takeover_until - time.monotonic())
+
+    # -- the honest retry hint ------------------------------------------
+
+    def shed_retry_after(self) -> float:
+        """``retry_after_s`` for a cluster-wide shed: the healthy-fleet
+        base scaled by the dead fraction's lost drain rate, plus
+        whatever remains of the takeover window. With zero alive
+        workers there is no honest hint — the caller should be raising
+        ``PlacementError``, not shedding."""
+        with self._lock:
+            alive = self._alive_locked()
+            scale = (len(self._workers) / alive) if alive else 1.0
+            window = max(0.0, self._takeover_until - time.monotonic())
+        return round(self.base_retry_s * scale + window, 6)

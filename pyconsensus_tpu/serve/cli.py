@@ -56,6 +56,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="comma-separated RxE request shapes")
     ap.add_argument("--na-frac", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--retries", type=int, default=0,
+                    help="bounded client retries on PYC401/PYC5xx sheds "
+                         "(honoring retry_after_s; 0 disables — the "
+                         "summary reports retried/abandoned counts)")
     ap.add_argument("--window-ms", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--rate-limit", type=float, default=None,
@@ -124,7 +128,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     svc.start(warmup=False)
     gen = LoadGenerator(svc, shapes=shapes, na_frac=args.na_frac,
-                        seed=args.seed)
+                        seed=args.seed, max_retries=args.retries)
     if args.rate:
         stats = gen.run_open(args.requests, args.rate)
     else:

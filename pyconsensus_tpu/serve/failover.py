@@ -1,0 +1,439 @@
+"""Ledger-backed session durability + hot-standby takeover (ISSUE 8
+tentpole, part b).
+
+The replication log of a fleet session is deliberately NOT a new wire
+protocol — it is the :class:`~pyconsensus_tpu.ledger.ReputationLedger`
+checkpoint the parity ledger already guarantees bit-exact resume for,
+plus a journal of the current round's staged event blocks, on a
+directory every worker can reach (shared filesystem — the same
+deployment substrate the checkpointed sweep uses). Layout per session::
+
+    <log_root>/<session>/
+        meta.json                       # roster size + session knobs
+        ledger.npz                      # state AFTER the last resolved
+                                        # round (atomic, fsynced)
+        staged/round_<k>_block_<i>.npz  # round k's journaled appends,
+                                        # SHA-256 content-digested
+
+Write ordering is what makes "zero lost resolutions" true:
+
+- ``append`` journals the block (atomic write + digest) BEFORE folding
+  it into the in-memory statistics — an append that returned to the
+  caller is durable; an append that raised never happened anywhere.
+- ``resolve`` records the round into the ledger and saves the
+  checkpoint BEFORE clearing the round's journal — a crash between the
+  two leaves stale staged files for an already-committed round, which
+  replay recognizes by round index and discards.
+- a crash BEFORE the ledger save leaves the previous checkpoint plus
+  the full journal — replay re-resolves the round from identical inputs
+  and, because every resolution path is deterministic, produces the
+  same bits the dead worker would have returned.
+
+:func:`replay_session` is the hot-standby takeover path: VERIFY the
+whole log first (:meth:`ReplicationLog.verify` — a dry run built on the
+new ``ReputationLedger.verify``; a standby never adopts a corrupt log),
+then reconstruct a :class:`DurableSession` whose reputation, round
+count, and staged blocks are bit-for-bit the dead worker's durable
+state. The same-topology replay contract of the parity ledger does the
+rest: resumed ``resolve()`` outcomes, iteration counts, and carried
+``smooth_rep`` are bit-identical to the never-killed run (pinned by the
+tests/test_fleet.py kill-point property test).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import re
+
+import numpy as np
+
+from ..faults import CheckpointCorruptionError, InputError
+from ..faults import plan as _faults
+from ..io import atomic_write
+from ..ledger import ReputationLedger
+from ..oracle import parse_event_bounds
+from .session import MarketSession
+
+__all__ = ["ReplicationLog", "DurableSession", "replay_session"]
+
+_META_FIELDS = ("session", "n_reporters", "alpha", "catch_tolerance",
+                "convergence_tolerance")
+_BLOCK_RE = re.compile(r"^round_(\d+)_block_(\d+)\.npz$")
+
+
+def _digest(block: np.ndarray, bounds_json: bytes) -> str:
+    h = hashlib.sha256()
+    h.update(str(block.shape).encode())
+    h.update(np.ascontiguousarray(block, dtype=np.float64).tobytes())
+    h.update(bounds_json)
+    return h.hexdigest()
+
+
+class ReplicationLog:
+    """One session's durable directory (see module docstring). The log
+    is the unit a standby adopts: every mutation goes through
+    ``io.atomic_write`` so a SIGKILL at any instruction leaves either
+    the old record or the new — never a torn one the verifier would
+    have to guess about (a torn FILE from a lost fsync is still
+    detected: npz structure + content digest)."""
+
+    def __init__(self, root, name: str) -> None:
+        self.name = str(name)
+        self.dir = pathlib.Path(root) / self.name
+        self.staged_dir = self.dir / "staged"
+        self.ledger_path = self.dir / "ledger.npz"
+        self.meta_path = self.dir / "meta.json"
+
+    # -- creation / opening ---------------------------------------------
+
+    @classmethod
+    def create(cls, root, name: str, n_reporters: int,
+               alpha: float = 0.1, catch_tolerance: float = 0.1,
+               convergence_tolerance: float = 1e-6) -> "ReplicationLog":
+        log = cls(root, name)
+        if log.meta_path.exists():
+            raise InputError(
+                f"replication log for session {name!r} already exists "
+                f"at {log.dir}", session=name)
+        log.staged_dir.mkdir(parents=True, exist_ok=True)
+        meta = {"session": log.name, "n_reporters": int(n_reporters),
+                "alpha": float(alpha),
+                "catch_tolerance": float(catch_tolerance),
+                "convergence_tolerance": float(convergence_tolerance)}
+
+        def write(tmp):
+            pathlib.Path(tmp).write_text(json.dumps(meta, indent=2))
+        atomic_write(log.meta_path, write)
+        return log
+
+    def exists(self) -> bool:
+        return self.meta_path.exists()
+
+    def meta(self) -> dict:
+        try:
+            meta = json.loads(self.meta_path.read_text())
+        except FileNotFoundError:
+            raise
+        except Exception as exc:
+            raise CheckpointCorruptionError(
+                f"{self.meta_path}: session meta does not decode as JSON "
+                f"({type(exc).__name__}: {exc})",
+                source=str(self.meta_path)) from exc
+        for field in _META_FIELDS:
+            if field not in meta:
+                raise CheckpointCorruptionError(
+                    f"{self.meta_path}: session meta field {field!r} is "
+                    f"missing", field=field, source=str(self.meta_path))
+        return meta
+
+    # -- the journal ----------------------------------------------------
+
+    def _block_path(self, round_idx: int, block_idx: int) -> pathlib.Path:
+        return self.staged_dir / (f"round_{int(round_idx):06d}"
+                                  f"_block_{int(block_idx):06d}.npz")
+
+    def journal_block(self, round_idx: int, block_idx: int, block,
+                      event_bounds=None) -> pathlib.Path:
+        """Durably journal one appended event block (atomic + digested).
+        Returns the journal path. Runs BEFORE the in-memory fold — see
+        the module-docstring ordering argument."""
+        block = np.ascontiguousarray(block, dtype=np.float64)
+        bounds_json = json.dumps(
+            None if event_bounds is None else list(event_bounds)).encode()
+        state = {
+            "round": np.int64(round_idx),
+            "index": np.int64(block_idx),
+            "block": block,
+            "bounds": np.frombuffer(bounds_json, dtype=np.uint8),
+            "digest": np.frombuffer(
+                _digest(block, bounds_json).encode(), dtype=np.uint8),
+        }
+        path = self._block_path(round_idx, block_idx)
+
+        def write(tmp):
+            np.savez(tmp, **state)
+        return atomic_write(path, write, suffix=".tmp.npz")
+
+    def _read_block(self, path: pathlib.Path) -> tuple:
+        """Load + integrity-check one journaled block. Returns
+        ``(index, block, bounds)``; raises CheckpointCorruptionError
+        naming the file on any structural or digest failure."""
+        def bad(why, **ctx):
+            return CheckpointCorruptionError(
+                f"{path}: staged block {why}", source=str(path), **ctx)
+
+        try:
+            with np.load(path) as data:
+                fields = set(data.files)
+                for field in ("round", "index", "block", "bounds",
+                              "digest"):
+                    if field not in fields:
+                        raise bad(f"field {field!r} is missing",
+                                  field=field)
+                block = np.asarray(data["block"], dtype=np.float64)
+                bounds_json = bytes(np.asarray(data["bounds"],
+                                               dtype=np.uint8))
+                digest = bytes(np.asarray(data["digest"],
+                                          dtype=np.uint8)).decode()
+                index = int(np.asarray(data["index"]).item())
+        except CheckpointCorruptionError:
+            raise
+        except Exception as exc:
+            # a torn final record: the npz zip structure itself is cut
+            # short (BadZipFile / short read) — the power-loss artifact
+            raise bad(f"is unreadable ({type(exc).__name__}: {exc})") \
+                from exc
+        if _digest(block, bounds_json) != digest:
+            raise bad("content digest mismatch (torn or tampered "
+                      "replication record)")
+        bounds = json.loads(bounds_json.decode())
+        return index, block, bounds
+
+    def staged(self, round_idx: int) -> list:
+        """The journaled blocks of round ``round_idx`` in append order:
+        ``[(block, bounds), ...]``. Validates digests and index
+        contiguity (a gap means a deleted/lost record — replication is
+        torn, refuse)."""
+        found = []
+        if self.staged_dir.exists():
+            for p in sorted(self.staged_dir.iterdir()):
+                m = _BLOCK_RE.match(p.name)
+                if m and int(m.group(1)) == int(round_idx):
+                    found.append(p)
+        out, indices = [], []
+        for p in found:
+            index, block, bounds = self._read_block(p)
+            indices.append(index)
+            out.append((block, bounds))
+        if indices != list(range(len(indices))):
+            raise CheckpointCorruptionError(
+                f"{self.staged_dir}: staged blocks of round {round_idx} "
+                f"are not contiguous from 0 (got indices {indices}) — a "
+                f"journal record is missing", source=str(self.staged_dir),
+                round=int(round_idx), indices=indices)
+        return out
+
+    def commit_round(self, ledger: ReputationLedger) -> None:
+        """Persist the post-round ledger state, then clear every staged
+        record of now-closed rounds (anything below ``ledger.round``).
+        The ledger save is the commit point — the cleanup is garbage
+        collection a crash may skip and replay tolerates."""
+        ledger.save(self.ledger_path)
+        if self.staged_dir.exists():
+            for p in sorted(self.staged_dir.iterdir()):
+                m = _BLOCK_RE.match(p.name)
+                if m and int(m.group(1)) < ledger.round:
+                    p.unlink(missing_ok=True)
+
+    # -- verification + replay ------------------------------------------
+
+    def verify(self) -> dict:
+        """The takeover preflight: a DRY RUN over the whole log — meta,
+        ledger checkpoint (the full ``ReputationLedger.verify``
+        validation, no construction), and every staged block of the
+        current round (digest + contiguity) — with zero state mutation.
+        Returns a summary dict; raises
+        :class:`CheckpointCorruptionError` naming the offending
+        field/file. A standby calls this before adopting: a corrupt log
+        must fail the takeover loudly, never seed a session that serves
+        different bits than the dead worker would have."""
+        return self.verify_collect()[0]
+
+    def verify_collect(self) -> tuple:
+        """:meth:`verify` plus everything the takeover replay needs:
+        ``(summary, [(block, bounds), ...], ledger_state_or_None)``.
+        The takeover path uses this so the journal AND the ledger
+        checkpoint are each read and validated ONCE — re-reading either
+        after the preflight would double the I/O inside the exact
+        window clients are being shed with PYC502."""
+        meta = self.meta()
+        summary = {"session": meta["session"],
+                   "n_reporters": int(meta["n_reporters"]),
+                   "round": 0, "staged_blocks": 0, "ledger": None}
+        state = None
+        if self.ledger_path.exists():
+            state = ReputationLedger._read_state(self.ledger_path)
+            n_reporters = int(state["reputation"].shape[0])
+            if n_reporters != int(meta["n_reporters"]):
+                raise CheckpointCorruptionError(
+                    f"{self.ledger_path}: ledger carries "
+                    f"{n_reporters} reporters, session "
+                    f"meta declares {meta['n_reporters']}",
+                    field="reputation", source=str(self.ledger_path))
+            summary["ledger"] = {"n_reporters": n_reporters,
+                                 "round": int(state["round"]),
+                                 "rounds_recorded": len(state["history"])}
+            summary["round"] = int(state["round"])
+        staged = self.staged(summary["round"])
+        summary["staged_blocks"] = len(staged)
+        return summary, staged, state
+
+
+class DurableSession(MarketSession):
+    """A :class:`MarketSession` whose every accepted mutation is durable
+    in a :class:`ReplicationLog` before it is acknowledged — the unit of
+    state the fleet can fail over with zero lost resolutions. Use the
+    classmethods: :meth:`create` starts a fresh session (and commits its
+    starting reputation, so a non-uniform prior survives a round-0
+    crash); :func:`replay_session` resumes a dead worker's."""
+
+    def __init__(self, log: ReplicationLog, n_reporters: int,
+                 ledger: ReputationLedger, **kwargs) -> None:
+        super().__init__(log.name, n_reporters, ledger=ledger, **kwargs)
+        self._log = log
+        self._fenced = None
+        self.rounds_resolved = ledger.round
+
+    @classmethod
+    def create(cls, log_root, name: str, n_reporters: int,
+               reputation=None, alpha: float = 0.1,
+               catch_tolerance: float = 0.1,
+               convergence_tolerance: float = 1e-6) -> "DurableSession":
+        log = ReplicationLog.create(
+            log_root, name, n_reporters, alpha=alpha,
+            catch_tolerance=catch_tolerance,
+            convergence_tolerance=convergence_tolerance)
+        ledger = ReputationLedger(n_reporters, reputation=reputation)
+        session = cls(log, n_reporters, ledger, alpha=alpha,
+                      catch_tolerance=catch_tolerance,
+                      convergence_tolerance=convergence_tolerance)
+        # commit round 0: the starting reputation is durable before the
+        # first append, so a standby replaying an empty journal starts
+        # from the same prior the caller configured
+        log.commit_round(ledger)
+        return session
+
+    @property
+    def log(self) -> ReplicationLog:
+        return self._log
+
+    def _admit(self, block):
+        return block   # applied pre-journal in append() — see base
+
+    def fence(self, exc: BaseException) -> None:
+        """Fence this object at takeover: every later ``append`` /
+        ``resolve`` raises ``exc`` instead of mutating state the standby
+        does not carry. Taking the session lock means an in-flight
+        mutation finishes its journal write FIRST — the replay that
+        follows the fence reads it — and anything after the fence was
+        never acknowledged, so the retrying client lands on the standby
+        with nothing lost."""
+        with self._lock:
+            self._fenced = exc
+
+    def append(self, reports_block, event_bounds=None) -> int:
+        # journal-then-fold under the session lock: the journal index is
+        # the in-memory block count, and no interleaved append may slip
+        # between the durable write and the fold (replay order must be
+        # the fold order)
+        with self._lock:
+            if self._fenced is not None:
+                raise self._fenced
+            block = np.asarray(reports_block, dtype=np.float64)
+            if block.ndim == 1:
+                block = block[:, None]
+            if block.ndim != 2 or block.shape[0] != self.n_reporters:
+                raise InputError(
+                    f"appended block must be ({self.n_reporters}, e), "
+                    f"got {block.shape}", shape=tuple(block.shape))
+            # validate BEFORE journaling: a refused append must leave no
+            # journal record, or replay would fold (or crash on) a block
+            # the caller was told never happened
+            parse_event_bounds(event_bounds, block.shape[1])
+            # the injection seam fires HERE, before the journal write:
+            # whatever corruption the site applies is what both the log
+            # and the fold see (the base _admit is a no-op on this
+            # class), so a standby replays the acknowledged bytes
+            block = MarketSession._admit(self, block)
+            path = self._log.journal_block(self.ledger.round,
+                                           len(self._blocks), block,
+                                           event_bounds)
+            try:
+                return super().append(block, event_bounds)
+            except BaseException:
+                # the fold failed AFTER the journal write: the caller is
+                # told this append never happened, so the record must
+                # not survive for replay to fold (a phantom block would
+                # change the standby's bits). If even the unlink fails,
+                # fence — serving on with journal and memory
+                # disagreeing is the one thing this class prevents.
+                try:
+                    path.unlink(missing_ok=True)
+                except OSError as cleanup:
+                    self._fenced = CheckpointCorruptionError(
+                        f"session {self.name!r} is fenced: a failed "
+                        f"append left an orphan journal record that "
+                        f"could not be removed ({cleanup})",
+                        session=self.name, source=str(path))
+                raise
+
+    def resolve(self, algorithm: str = "sztorc", max_iterations: int = 1,
+                **oracle_kwargs) -> dict:
+        with self._lock:
+            if self._fenced is not None:
+                raise self._fenced
+            result = super().resolve(algorithm=algorithm,
+                                     max_iterations=max_iterations,
+                                     **oracle_kwargs)
+            # commit point: super().resolve already recorded the round
+            # into the ledger; persisting it closes the round durably
+            # and garbage-collects the round's journal
+            try:
+                self._log.commit_round(self.ledger)
+            except BaseException as exc:
+                # the round resolved in MEMORY but its commit never
+                # landed: this object is now one round ahead of its
+                # log, so a later acknowledged append would journal
+                # under a round index replay discards — an acknowledged
+                # write the fleet would forget. Fence loudly instead of
+                # serving on; the durable log (previous checkpoint +
+                # the round's full journal) replays this round
+                # bit-identically on a standby.
+                self._fenced = CheckpointCorruptionError(
+                    f"session {self.name!r} is fenced: round "
+                    f"{self.ledger.round} resolved but its ledger "
+                    f"commit failed ({type(exc).__name__}: {exc}) — "
+                    f"replay the replication log to resume",
+                    session=self.name,
+                    source=str(self._log.ledger_path))
+                raise
+        return result
+
+
+def replay_session(log_root, name: str) -> DurableSession:
+    """Hot-standby takeover of one session: verify the dead worker's
+    log (preflight — no corrupt log is ever adopted), rebuild the ledger
+    bit-exactly, and re-fold the journaled staged blocks in append
+    order. The returned session is indistinguishable — bit-for-bit in
+    reputation, round count, and staged statistics — from the dead
+    worker's in-memory session at its last acknowledged operation.
+
+    The ``fleet.takeover`` / ``fleet.ledger_replay`` fault sites wrap
+    this path (the fleet fires them); ``fleet.ledger_replay`` exposes
+    the ledger file so a ``torn_write`` rule can tear the replication
+    log between death and adoption — the verify preflight then refuses
+    with PYC301, which is the correct behavior the chaos suite pins."""
+    log = ReplicationLog(log_root, name)
+    _faults.fire("fleet.ledger_replay",
+                 path=log.ledger_path if log.ledger_path.exists()
+                 else None)
+    summary, staged, state = log.verify_collect()
+    if state is not None:       # the preflight's validated read — the
+        ledger = ReputationLedger._from_state(  # checkpoint is opened
+            state, source=log.ledger_path)      # once per takeover
+    else:                       # pre-commit round-0 crash: fresh uniform
+        ledger = ReputationLedger(summary["n_reporters"])
+    meta = log.meta()
+    session = DurableSession(
+        log, int(meta["n_reporters"]), ledger,
+        alpha=float(meta["alpha"]),
+        catch_tolerance=float(meta["catch_tolerance"]),
+        convergence_tolerance=float(meta["convergence_tolerance"]))
+    for block, bounds in staged:
+        # fold WITHOUT re-journaling (the records already exist):
+        # MarketSession.append is the identical arithmetic the dead
+        # worker ran, against the identical ledger-carried reputation
+        MarketSession.append(session, block, bounds)
+    return session

@@ -1,0 +1,559 @@
+"""``ConsensusFleet`` — N serve workers behind a consistent-hash router
+with ledger-backed hot-standby failover (ISSUE 8 tentpole).
+
+One box is not a service: at the ROADMAP's traffic targets workers are
+killed and restarted constantly, and before this module a dead
+``ConsensusService`` took its in-flight market sessions with it. The
+fleet composes the pieces the previous PRs made true one at a time —
+PR 4's bit-identical crash/resume, PR 5's ledger-durable sessions —
+into the property the chaos suite pins end to end:
+
+    **any worker can die mid-traffic and every accepted request either
+    resolves with bits identical to a single-box run, or sheds with a
+    structured PYC-coded error carrying an honest ``retry_after_s`` —
+    never a silent drop, never corrupted state.**
+
+Architecture (docs/SERVING.md "Replicated fleet"):
+
+- **placement** (``serve.placement``): sessions (and, for spread,
+  stateless requests) map to workers through one consistent-hash ring —
+  membership change moves ONLY the dead worker's keys.
+- **replication log** (``serve.failover``): every session mutation is
+  durable (ledger checkpoint + staged-block journal on a shared
+  directory) before it is acknowledged; ``record_round`` IS the
+  replication stream.
+- **failover**: a worker death (SIGKILL, heartbeat loss, explicit
+  ``kill_worker``) fences the worker, sheds its queued requests as
+  ``WorkerLostError`` (PYC501), opens a takeover window during which
+  its sessions answer ``FailoverInProgressError`` (PYC502), verifies
+  each session's log (a standby never adopts a corrupt one — PYC301
+  surfaces instead), and replays them onto their new ring owners,
+  resumed bit-identical.
+- **admission** (``serve.admission.ClusterCapacity``): cluster-wide
+  sheds quote retry hints scaled by surviving capacity; per-worker
+  queue depths export as gauges.
+
+The workers are in-process ``ConsensusService`` instances — the fleet
+is the ROUTING + DURABILITY + FAILOVER layer, deliberately below any
+network protocol (the library's long-standing stance; a deployment
+wraps workers in processes/pods and this module's semantics carry over
+because all shared state lives in the replication log, which the chaos
+suite exercises with a REAL ``kill -9`` against a worker process).
+Fault sites ``fleet.route`` / ``fleet.heartbeat`` / ``fleet.takeover``
+/ ``fleet.ledger_replay`` let a seeded ``FaultPlan`` inject worker
+loss, heartbeat flap, and torn ledger replication deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import obs
+from ..faults import (CheckpointCorruptionError, FailoverInProgressError,
+                      InputError, PlacementError, ServiceOverloadError,
+                      WorkerLostError)
+from ..faults import plan as _faults
+from .admission import ClusterCapacity
+from .failover import DurableSession, replay_session
+from .placement import DEFAULT_VNODES, HashRing
+from .service import ConsensusService, ServeConfig
+
+__all__ = ["FleetConfig", "FleetWorker", "ConsensusFleet"]
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fleet policy. ``worker`` is the per-worker :class:`ServeConfig`
+    (every worker runs the same one — heterogeneous fleets would break
+    the any-worker-same-bits routing freedom)."""
+
+    #: worker count (names default to ``w0..w{n-1}``)
+    n_workers: int = 3
+    #: per-worker service policy
+    worker: ServeConfig = field(default_factory=ServeConfig)
+    #: shared replication-log directory (REQUIRED for fleet sessions —
+    #: a session that is not durable cannot survive its worker, so the
+    #: fleet refuses to create one rather than pretend)
+    log_dir: Optional[str] = None
+    #: heartbeat staleness beyond which a worker is declared dead
+    heartbeat_timeout_s: float = 2.0
+    #: monitor scan period (``monitor=True`` runs a background thread;
+    #: otherwise call :meth:`ConsensusFleet.check_workers` yourself)
+    heartbeat_interval_s: float = 0.5
+    monitor: bool = False
+    #: honest takeover-window estimate quoted in PYC501/PYC502 retry
+    #: hints and used to bound the window the capacity view opens
+    takeover_window_s: float = 1.0
+    #: healthy-fleet base retry hint for cluster-wide sheds
+    base_retry_s: float = 0.25
+    #: virtual points per worker on the placement ring
+    vnodes: int = DEFAULT_VNODES
+    #: stateless requests spill to the next ring arc when the owner's
+    #: queue is full (sessions never spill — they are sticky by design)
+    spillover: bool = True
+
+
+class FleetWorker:
+    """One worker: a named :class:`ConsensusService` plus the liveness
+    bookkeeping the router needs. ``hard_kill`` is the in-process
+    SIGKILL model: fence (no new work, no drain) and shed everything
+    queued as ``WorkerLostError`` — in-flight device dispatches finish
+    (their callers get correct bits; a real kill would have dropped
+    them, which the REAL ``kill -9`` chaos stage covers via the
+    replication log instead)."""
+
+    def __init__(self, name: str, config: ServeConfig) -> None:
+        self.name = str(name)
+        self.service = ConsensusService(config)
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        #: serializes concurrent death declarations for THIS worker
+        #: (kill_worker vs routing-time discovery vs monitor scan) —
+        #: exactly one takeover runs; the losers observe its result
+        self.declare_lock = threading.Lock()
+
+    def heartbeat(self) -> bool:
+        """Record one liveness beat. Returns False — the beat is LOST —
+        when the worker is dead or the ``fleet.heartbeat`` fault site
+        raises (heartbeat flap: the injected error models a dropped
+        health probe, so the timestamp must NOT advance)."""
+        if not self.alive:
+            return False
+        try:
+            _faults.fire("fleet.heartbeat")
+        except Exception:   # noqa: BLE001 — a lost probe, not a fault
+            return False
+        self.last_heartbeat = time.monotonic()
+        return True
+
+    def stale(self, timeout_s: float) -> bool:
+        return (time.monotonic() - self.last_heartbeat) > timeout_s
+
+    def queue_depth(self) -> int:
+        return len(self.service.queue)
+
+    def hard_kill(self, retry_after_s: float) -> int:
+        """Fence + shed (see class docstring). Returns the number of
+        queued requests shed as PYC501. Idempotent."""
+        if not self.alive:
+            return 0
+        self.alive = False
+        self.service.admission.start_drain()
+        self.service.queue.close()
+        shed = 0
+        for req in self.service.queue.drain_pending():
+            if not req.future.done():
+                req.future.set_exception(WorkerLostError(
+                    f"worker {self.name!r} died with this request "
+                    f"queued", worker=self.name, tenant=req.tenant,
+                    retry_after_s=retry_after_s))
+                shed += 1
+        return shed
+
+
+class ConsensusFleet:
+    """The replicated serve fleet (see module docstring).
+
+    Quick use::
+
+        from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+
+        fleet = ConsensusFleet(FleetConfig(
+            n_workers=3, log_dir="/shared/fleet-log")).start()
+        fleet.create_session("btc-settles", n_reporters=50)
+        fleet.append("btc-settles", block)
+        result = fleet.submit(session="btc-settles").result()
+        fleet.kill_worker("w1")        # chaos: sessions fail over
+        fleet.close(drain=True)
+    """
+
+    def __init__(self, config: Optional[FleetConfig] = None) -> None:
+        self.config = config or FleetConfig()
+        if self.config.n_workers < 1:
+            raise InputError("a fleet needs at least one worker")
+        self.workers = {f"w{i}": FleetWorker(f"w{i}", self.config.worker)
+                        for i in range(self.config.n_workers)}
+        self.ring = HashRing(self.workers, vnodes=self.config.vnodes)
+        self.capacity = ClusterCapacity(self.config.base_retry_s)
+        for name, w in self.workers.items():
+            self.capacity.register(name, w.service.config.max_queue)
+        #: session name -> owning worker name (None while failed)
+        self._sessions: dict = {}
+        #: sessions currently replaying onto their standby (fenced)
+        self._migrating: set = set()
+        #: session name -> CheckpointCorruptionError (refused takeovers)
+        self._failed_sessions: dict = {}
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._failovers = obs.counter(
+            "pyconsensus_failovers_total",
+            "worker-loss takeovers performed by the fleet")
+        self._migrated = obs.counter(
+            "pyconsensus_sessions_migrated_total",
+            "sessions replayed onto a standby worker")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "ConsensusFleet":
+        for w in self.workers.values():
+            w.service.start(warmup=warmup)
+        if self.config.monitor and self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop,
+                name="pyconsensus-fleet-monitor", daemon=True)
+            self._monitor.start()
+        return self
+
+    def __enter__(self) -> "ConsensusFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 60.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        for w in self.workers.values():
+            if w.alive:
+                w.service.close(drain=drain, timeout=timeout)
+
+    # -- liveness -------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval_s):
+            try:
+                self.check_workers()
+            except Exception:   # noqa: BLE001 — the monitor must outlive
+                pass            # an injected routing/takeover error
+
+    def check_workers(self) -> list:
+        """One liveness scan: ping every worker, export queue depths,
+        declare dead anything fenced or heartbeat-stale, and fail over
+        its sessions. Returns the names declared dead this scan (the
+        monitor thread calls this on its interval; tests and synchronous
+        deployments call it directly)."""
+        dead = []
+        for name, w in list(self.workers.items()):
+            if w.alive:
+                w.heartbeat()
+                self.capacity.observe_queue_depth(name, w.queue_depth())
+            if name in self.ring and (
+                    not w.alive
+                    or w.stale(self.config.heartbeat_timeout_s)):
+                dead.append(name)
+        for name in dead:
+            self._declare_dead(name)
+        return dead
+
+    def kill_worker(self, name: str) -> dict:
+        """The chaos entry point: hard-kill ``name`` exactly as a
+        SIGKILL would look to the router (fence, shed queued as PYC501,
+        fail its sessions over). Returns a loss summary."""
+        if name not in self.workers:
+            raise PlacementError(f"unknown worker {name!r}", worker=name)
+        return self._declare_dead(name)
+
+    def _declare_dead(self, name: str) -> dict:
+        w = self.workers[name]
+        # one declaration at a time per worker: a kill_worker racing a
+        # routing-time discovery (or the monitor scan) must not run two
+        # takeovers of the same sessions — the second declarer blocks
+        # here, then sees nothing left to move and returns a no-op
+        with w.declare_lock:
+            shed = w.hard_kill(self.config.takeover_window_s)
+            with self._lock:
+                in_ring = name in self.ring
+                self.ring.remove(name)
+                # stranded sessions (an earlier takeover aborted by an
+                # injected fleet.takeover fault) must get another chance
+                # — a dead worker re-declared is only a no-op when
+                # nothing still maps to it
+                stranded = any(o == name
+                               for o in self._sessions.values())
+            self.capacity.mark_dead(name)
+            self.capacity.observe_queue_depth(name, 0)
+            # a stranded-session retry needs a standby to exist: with
+            # an empty ring the takeover cannot land anywhere, and
+            # re-running it per routed request would only inflate the
+            # failover counter (routing answers PYC503 instead)
+            migrated = (self._failover(name)
+                        if (in_ring or (stranded and len(self.ring)))
+                        else [])
+        return {"worker": name, "shed_queued": shed,
+                "sessions_migrated": migrated}
+
+    # -- failover -------------------------------------------------------
+
+    def _failover(self, dead: str) -> list:
+        """Hot-standby takeover of ``dead``'s sessions. The window is
+        explicit: affected sessions are fenced in ``_migrating`` (their
+        submits answer PYC502 with the honest remaining window) while
+        each log is verified and replayed onto its new ring owner. A
+        log that fails verification is REFUSED — the session is marked
+        failed and keeps answering its corruption error; adopting it
+        could serve bits that differ from the single-box run."""
+        _faults.fire("fleet.takeover")
+        with self._lock:
+            # claim atomically: a session already fenced in _migrating
+            # belongs to a takeover in flight and is never double-played
+            moving = [s for s, o in self._sessions.items()
+                      if o == dead and s not in self._migrating]
+            self._migrating.update(moving)
+        if not moving:
+            self._failovers.inc()
+            return []
+        self.capacity.begin_takeover(self.config.takeover_window_s)
+        self._failovers.inc()
+        migrated = []
+        try:
+            for name in moving:
+                try:
+                    self._fence_stale(dead, name)
+                    new_owner = self.ring.owner(name)
+                    session = replay_session(self.config.log_dir, name)
+                    self.workers[new_owner].service.sessions.add(session)
+                    # the fenced stale object leaves the dead worker's
+                    # store: the session lives in exactly ONE store, so
+                    # the live-session gauge stays honest
+                    self.workers[dead].service.sessions.remove(name)
+                    with self._lock:
+                        self._sessions[name] = new_owner
+                    self._migrated.inc()
+                    migrated.append((name, new_owner))
+                except CheckpointCorruptionError as exc:
+                    # a standby never adopts a corrupt log: the session
+                    # keeps answering its corruption error (durable
+                    # state on disk is untouched for forensics)
+                    with self._lock:
+                        self._sessions[name] = None
+                        self._failed_sessions[name] = exc
+                except PlacementError:
+                    # every worker is dead — leave the session mapped to
+                    # its (dead) owner; the durable log survives, and a
+                    # restarted fleet can adopt it
+                    pass
+                except Exception:   # noqa: BLE001 — transient replay
+                    # failure (e.g. a shared-filesystem OSError): leave
+                    # the session stranded-but-durable — still mapped to
+                    # the dead owner, so the next declaration retries
+                    # the takeover — and KEEP MOVING the remaining
+                    # sessions; routing meanwhile answers the retryable
+                    # worker-loss error, never this raw exception
+                    pass
+                finally:
+                    with self._lock:
+                        self._migrating.discard(name)
+        finally:
+            with self._lock:
+                self._migrating.difference_update(moving)
+            self.capacity.end_takeover()
+        return migrated
+
+    def _fence_stale(self, dead: str, name: str) -> None:
+        """Fence the dead worker's in-memory session object BEFORE the
+        replay reads its log. A client that resolved the owner just
+        ahead of the kill still holds that object; without the fence its
+        ``append`` could journal a block the already-replayed standby
+        never folds — an acknowledged write the fleet then forgets. The
+        fence (under the session lock) makes the race two-sided: a
+        mutation that completed its journal write is read by the replay;
+        anything later raises the retryable worker-loss error and was
+        never acknowledged."""
+        try:
+            stale = self.workers[dead].service.sessions.get(name)
+        except InputError:
+            return      # not in this store (e.g. retried stranded take)
+        fence = getattr(stale, "fence", None)
+        if fence is not None:
+            fence(WorkerLostError(
+                f"session {name!r} migrated off dead worker {dead!r}",
+                worker=dead, session=name,
+                retry_after_s=self.config.takeover_window_s))
+
+    # -- routing --------------------------------------------------------
+
+    def _session_worker(self, session: str,
+                        _retried: bool = False) -> FleetWorker:
+        """Resolve a session to its live owning worker, surfacing the
+        takeover states as their structured errors."""
+        with self._lock:
+            if session in self._migrating:
+                raise FailoverInProgressError(
+                    f"session {session!r} is replaying onto its standby",
+                    session=session,
+                    retry_after_s=max(self.capacity.takeover_remaining(),
+                                      0.05))
+            if session in self._failed_sessions:
+                raise self._failed_sessions[session]
+            owner = self._sessions.get(session)
+        if owner is None:
+            raise InputError(f"unknown fleet session {session!r}")
+        w = self.workers[owner]
+        if not w.alive:
+            if _retried:
+                if not len(self.ring):
+                    # every worker is dead: a retry cannot succeed
+                    # until an operator restarts the fleet — the
+                    # non-retryable placement error, not PYC501 (a
+                    # polite client would burn its whole retry budget
+                    # against a fleet that cannot serve)
+                    raise PlacementError(
+                        f"session {session!r} has no live owner and "
+                        f"the fleet has no alive workers",
+                        session=session, worker=owner)
+                # the takeover we just ran did not land this session on
+                # a live worker (injected takeover fault / transient
+                # replay failure) — surface the retryable loss instead
+                # of looping
+                raise WorkerLostError(
+                    f"session {session!r} has no live owner (worker "
+                    f"{owner!r} is dead)", worker=owner, session=session,
+                    retry_after_s=self.config.takeover_window_s)
+            # death discovered at routing time (monitor hasn't scanned
+            # yet): fail over NOW, synchronously, then re-resolve — the
+            # caller lands on the standby instead of an error
+            try:
+                self._declare_dead(owner)
+            except Exception as exc:  # noqa: BLE001 — an injected
+                # fleet.takeover fault or a transient declare failure:
+                # the session is stranded-but-durable (the next routed
+                # request retries the takeover); THIS client gets the
+                # structured retryable loss, never the raw error
+                raise WorkerLostError(
+                    f"session {session!r} lost worker {owner!r} and its "
+                    f"takeover did not complete", worker=owner,
+                    session=session,
+                    retry_after_s=self.config.takeover_window_s
+                ) from exc
+            return self._session_worker(session, _retried=True)
+        return w
+
+    def submit(self, reports=None, session: Optional[str] = None,
+               tenant: str = "default", **kwargs):
+        """Route one resolution into the fleet; returns the worker's
+        ``Future``. Stateless requests spread over the ring and (by
+        policy) spill to the next arc when the owner's queue is full;
+        session requests are sticky to the session's owner. Raises the
+        structured fleet taxonomy: PYC401 (cluster full / worker
+        policy), PYC501/502 (worker loss / takeover, retryable),
+        PYC503 (no placeable worker)."""
+        _faults.fire("fleet.route")
+        if session is not None:
+            if reports is not None:   # same contract as the service's
+                raise InputError(     # submit — never silently drop one
+                    "exactly one of reports= / session= is required")
+            w = self._session_worker(session)
+            try:
+                return w.service.submit(session=session, tenant=tenant,
+                                        **kwargs)
+            except ServiceOverloadError as exc:
+                if exc.context.get("reason") == "draining" and not w.alive:
+                    # lost the race with this worker's death (hard_kill
+                    # fences alive=False before it starts the drain):
+                    # translate to the retryable worker-loss code — the
+                    # standby will own the session shortly. A LIVE
+                    # worker's drain is a graceful shutdown and stays
+                    # PYC401: no takeover is coming, so a client must
+                    # not burn its retry budget waiting for one.
+                    raise WorkerLostError(
+                        f"worker {w.name!r} died while routing session "
+                        f"{session!r}", worker=w.name, session=session,
+                        tenant=tenant,
+                        retry_after_s=self.config.takeover_window_s
+                    ) from exc
+                raise
+        with self._lock:
+            self._seq += 1
+            key = f"~{tenant}:{self._seq}"
+        candidates = (self.ring.preference(key) if self.config.spillover
+                      else [self.ring.owner(key)])
+        last_exc = None
+        for name in candidates:
+            w = self.workers[name]
+            if not w.alive:
+                continue
+            try:
+                return w.service.submit(reports=reports, tenant=tenant,
+                                        **kwargs)
+            except ServiceOverloadError as exc:
+                if exc.context.get("reason") not in ("queue_full",
+                                                     "draining"):
+                    raise          # rate limit etc.: spilling would
+                last_exc = exc     # double-charge the tenant's bucket
+        raise ServiceOverloadError(
+            "every surviving worker's queue is full",
+            reason="cluster_full", tenant=tenant,
+            alive_workers=self.capacity.alive,
+            alive_slots=self.capacity.alive_slots(),
+            retry_after_s=self.capacity.shed_retry_after()) from last_exc
+
+    def resolve(self, timeout: Optional[float] = None, **kwargs) -> dict:
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(**kwargs).result(timeout)
+
+    # -- sessions -------------------------------------------------------
+
+    def create_session(self, name: str, n_reporters: int,
+                       **kwargs) -> str:
+        """Create a DURABLE session placed by the ring. Returns the
+        owning worker's name. Requires ``FleetConfig.log_dir`` — a
+        fleet session that could not survive its worker would be a lie,
+        so the fleet refuses to create one."""
+        if self.config.log_dir is None:
+            raise InputError(
+                "fleet sessions need FleetConfig.log_dir (the shared "
+                "replication-log directory) — a session without a log "
+                "cannot fail over")
+        _faults.fire("fleet.route")
+        owner = self.ring.owner(name)
+        session = DurableSession.create(self.config.log_dir, name,
+                                        n_reporters, **kwargs)
+        self.workers[owner].service.sessions.add(session)
+        with self._lock:
+            self._sessions[name] = owner
+        return owner
+
+    def append(self, session: str, reports_block,
+               event_bounds=None) -> int:
+        """Append an event block to a fleet session (durable before
+        acknowledged — the replication-log write order)."""
+        _faults.fire("fleet.route")
+        w = self._session_worker(session)
+        return w.service.sessions.get(session).append(reports_block,
+                                                      event_bounds)
+
+    def owner_of(self, session: str) -> Optional[str]:
+        with self._lock:
+            return self._sessions.get(session)
+
+    def sessions(self) -> dict:
+        with self._lock:
+            return dict(self._sessions)
+
+    # -- introspection --------------------------------------------------
+
+    def status(self) -> dict:
+        """Operator snapshot (the bench ``fleet`` block embeds this)."""
+        with self._lock:
+            sessions = dict(self._sessions)
+            failed = sorted(self._failed_sessions)
+        return {
+            "workers": {n: {"alive": w.alive,
+                            "queue_depth": w.queue_depth()}
+                        for n, w in self.workers.items()},
+            "alive": self.capacity.alive,
+            "alive_slots": self.capacity.alive_slots(),
+            "sessions": sessions,
+            "failed_sessions": failed,
+            "failovers": obs.value("pyconsensus_failovers_total"),
+            "sessions_migrated": obs.value(
+                "pyconsensus_sessions_migrated_total"),
+        }

@@ -22,7 +22,7 @@ import time
 import numpy as np
 
 __all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy",
-           "device_block", "kernel_path_block"]
+           "device_block", "kernel_path_block", "RETRYABLE_CODES"]
 
 
 def kernel_path_block():
@@ -88,15 +88,24 @@ def _quantile(sorted_vals, q: float):
     return sorted_vals[idx]
 
 
-def summarize(latencies, errors, wall_s: float, n_requests: int) -> dict:
+def summarize(latencies, errors, wall_s: float, n_requests: int,
+              retried: int = 0, abandoned: int = 0) -> dict:
     """The shared stats block: throughput + latency quantiles + error
-    counts (stable keys — the bench JSON embeds this verbatim)."""
+    counts (stable keys — the bench JSON embeds this verbatim), plus
+    the client-retry accounting (ISSUE 8 satellite): ``retried`` counts
+    retry ATTEMPTS issued after honest ``retry_after_s`` sheds,
+    ``abandoned`` counts requests that exhausted their retry budget on
+    retryable errors — the number that is actually client-visible loss
+    in a fleet chaos run (a shed that a bounded retry absorbed is not
+    loss)."""
     lat = sorted(latencies)
     return {
         "requests": int(n_requests),
         "succeeded": len(lat),
         "failed": int(sum(errors.values())),
         "errors": dict(errors),
+        "retried": int(retried),
+        "abandoned": int(abandoned),
         "wall_s": round(wall_s, 4),
         "throughput_rps": round(len(lat) / wall_s, 4) if wall_s > 0 else None,
         "latency_p50_ms": (None if not lat
@@ -108,12 +117,21 @@ def summarize(latencies, errors, wall_s: float, n_requests: int) -> dict:
     }
 
 
+#: error codes a polite client retries: load-policy sheds (PYC401) and
+#: the fleet's transient worker-loss family (PYC501 worker lost, PYC502
+#: failover in progress). PYC503 (no placeable worker) is deliberately
+#: absent — retrying an empty fleet cannot succeed.
+RETRYABLE_CODES = ("PYC401", "PYC501", "PYC502")
+
+
 class LoadGenerator:
-    """Drives a :class:`~pyconsensus_tpu.serve.ConsensusService`.
+    """Drives a :class:`~pyconsensus_tpu.serve.ConsensusService` (or a
+    :class:`~pyconsensus_tpu.serve.fleet.ConsensusFleet` — same
+    ``submit(reports=..., tenant=...)`` surface).
 
     Parameters
     ----------
-    service : ConsensusService
+    service : ConsensusService or ConsensusFleet
     shapes : sequence of (R, E)
         Request shapes, cycled per request (>= 2 distinct bucket targets
         exercise the cache the way real mixed traffic does).
@@ -121,18 +139,34 @@ class LoadGenerator:
         NaN non-report fraction of the synthetic matrices.
     seed : int
         Matrix-corpus seed — the corpus is generated once up front so
-        generation cost never pollutes the latency numbers.
+        generation cost never pollutes the latency numbers. Also seeds
+        the deterministic retry jitter.
     oracle_kwargs : dict
         Forwarded to every ``submit`` (algorithm, iterations, ...).
+    max_retries : int
+        Bounded client-retry budget per request on RETRYABLE sheds
+        (``RETRYABLE_CODES`` — PYC401/PYC501/PYC502). Each retry waits
+        the shed's honest ``retry_after_s`` hint, floored by the
+        deterministic jittered backoff of ``faults.retry`` (keyed on
+        ``(seed, request, attempt)`` — reproducible runs, decorrelated
+        clients) and capped at ``retry_cap_s``. 0 disables retries (the
+        pre-fleet behavior).
+    retry_cap_s : float
+        Upper bound of any single retry wait — the budget stays bounded
+        even against a pathological hint.
     """
 
     def __init__(self, service, shapes=((12, 48), (24, 96)),
                  na_frac: float = 0.1, seed: int = 0,
-                 tenant: str = "loadgen", oracle_kwargs=None) -> None:
+                 tenant: str = "loadgen", oracle_kwargs=None,
+                 max_retries: int = 0, retry_cap_s: float = 2.0) -> None:
         self.service = service
         self.shapes = [tuple(s) for s in shapes]
         self.tenant = tenant
         self.oracle_kwargs = dict(oracle_kwargs or {})
+        self.max_retries = int(max_retries)
+        self.retry_cap_s = float(retry_cap_s)
+        self.seed = int(seed)
         rng = np.random.default_rng(seed)
         self._corpus = []
         for R, E in self.shapes:
@@ -146,6 +180,53 @@ class LoadGenerator:
             reports=self._corpus[i % len(self._corpus)],
             tenant=self.tenant, **self.oracle_kwargs)
 
+    def _retry_delay(self, exc, i: int, attempt: int) -> float:
+        """One bounded retry wait: honor the shed's honest
+        ``retry_after_s`` (retrying earlier would just be refused
+        again), floored by the deterministic jittered backoff so a
+        thousand shed clients do not stampede back in lockstep."""
+        from ..faults.retry import _sleep_for
+
+        hint = 0.0
+        ctx = getattr(exc, "context", None)
+        if isinstance(ctx, dict):
+            try:
+                hint = float(ctx.get("retry_after_s") or 0.0)
+            except (TypeError, ValueError):
+                hint = 0.0
+        jitter = _sleep_for(attempt, 0.02, self.retry_cap_s,
+                            self.seed, f"req{i}")
+        return min(self.retry_cap_s, max(hint, jitter))
+
+    def _one_request(self, i: int, timeout_s: float,
+                     first_error=None) -> tuple:
+        """Issue request ``i`` with the bounded retry policy. Returns
+        ``(latency_or_None, error_name_or_None, retried, abandoned)``.
+        ``first_error`` seeds the loop with an already-observed failure
+        (the open-loop deferral path)."""
+        attempt, retried = 0, 0
+        t0 = time.monotonic()
+        exc = first_error
+        while True:
+            if exc is None:
+                try:
+                    fut = self._submit(i)
+                    fut.result(timeout=timeout_s)
+                    return time.monotonic() - t0, None, retried, 0
+                except Exception as e:  # noqa: BLE001 — tallied below
+                    exc = e
+            code = getattr(exc, "error_code", None)
+            name = code or type(exc).__name__
+            if code not in RETRYABLE_CODES:
+                return None, name, retried, 0
+            if attempt >= self.max_retries:
+                return (None, name, retried,
+                        1 if self.max_retries > 0 else 0)
+            time.sleep(self._retry_delay(exc, i, attempt))
+            attempt += 1
+            retried += 1
+            exc = None
+
     # -- closed loop ----------------------------------------------------
 
     def run_closed(self, n_requests: int, concurrency: int = 8,
@@ -156,6 +237,7 @@ class LoadGenerator:
         counter = [0]
         latencies: list = []
         errors: dict = {}
+        tallies = {"retried": 0, "abandoned": 0}
 
         def worker():
             while True:
@@ -164,18 +246,15 @@ class LoadGenerator:
                         return
                     i = counter[0]
                     counter[0] += 1
-                t0 = time.monotonic()
-                try:
-                    fut = self._submit(i)
-                    fut.result(timeout=timeout_s)
-                except Exception as exc:  # noqa: BLE001 — tallied, not raised
-                    name = getattr(exc, "error_code",
-                                   type(exc).__name__)
-                    with lock:
-                        errors[name] = errors.get(name, 0) + 1
-                else:
-                    with lock:
-                        latencies.append(time.monotonic() - t0)
+                lat, err, retried, abandoned = self._one_request(
+                    i, timeout_s)
+                with lock:
+                    tallies["retried"] += retried
+                    tallies["abandoned"] += abandoned
+                    if err is not None:
+                        errors[err] = errors.get(err, 0) + 1
+                    else:
+                        latencies.append(lat)
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(max(1, concurrency))]
@@ -185,7 +264,7 @@ class LoadGenerator:
         for t in threads:
             t.join()
         return summarize(latencies, errors, time.monotonic() - t0,
-                         n_requests)
+                         n_requests, **tallies)
 
     # -- open loop ------------------------------------------------------
 
@@ -193,13 +272,27 @@ class LoadGenerator:
                  timeout_s: float = 120.0) -> dict:
         """Fixed-schedule arrivals at ``rate_rps`` regardless of
         completions — admission errors (``ServiceOverloadError``) are
-        tallied per error code, which is the point of the probe."""
+        tallied per error code, which is the point of the probe. With a
+        retry budget, retryable failures are DEFERRED past the arrival
+        schedule (an inline retry would stall the fixed-rate clock that
+        makes offered load the independent variable) and retried
+        sequentially in the drain phase."""
         if rate_rps <= 0:
             raise ValueError("rate_rps must be positive")
-        lock = threading.Lock()
         latencies: list = []
         errors: dict = {}
         futures: list = []
+        deferred: list = []            # (i, first exception)
+        tallies = {"retried": 0, "abandoned": 0}
+
+        def tally(err, lat, retried=0, abandoned=0):
+            tallies["retried"] += retried
+            tallies["abandoned"] += abandoned
+            if err is not None:
+                errors[err] = errors.get(err, 0) + 1
+            else:
+                latencies.append(lat)
+
         interval = 1.0 / rate_rps
         t0 = time.monotonic()
         for i in range(n_requests):
@@ -211,23 +304,30 @@ class LoadGenerator:
             try:
                 fut = self._submit(i)
             except Exception as exc:  # noqa: BLE001 — shed at admission
-                name = getattr(exc, "error_code", type(exc).__name__)
-                with lock:
-                    errors[name] = errors.get(name, 0) + 1
+                code = getattr(exc, "error_code", None)
+                if self.max_retries > 0 and code in RETRYABLE_CODES:
+                    deferred.append((i, exc))
+                else:
+                    tally(code or type(exc).__name__, None)
                 continue
-            futures.append((start, fut))
-        for start, fut in futures:
+            futures.append((i, start, fut))
+        for i, start, fut in futures:
             try:
                 fut.result(timeout=timeout_s)
             except Exception as exc:  # noqa: BLE001
-                name = getattr(exc, "error_code", type(exc).__name__)
-                with lock:
-                    errors[name] = errors.get(name, 0) + 1
+                code = getattr(exc, "error_code", None)
+                if self.max_retries > 0 and code in RETRYABLE_CODES:
+                    deferred.append((i, exc))
+                else:
+                    tally(code or type(exc).__name__, None)
             else:
-                with lock:
-                    latencies.append(time.monotonic() - start)
+                tally(None, time.monotonic() - start)
+        for i, exc in deferred:
+            lat, err, retried, abandoned = self._one_request(
+                i, timeout_s, first_error=exc)
+            tally(err, lat, retried, abandoned)
         return summarize(latencies, errors, time.monotonic() - t0,
-                         n_requests)
+                         n_requests, **tallies)
 
 
 def main(argv=None) -> int:
@@ -250,6 +350,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--window-ms", type=float, default=2.0)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--retries", type=int, default=0,
+                    help="bounded client retries on PYC401/PYC5xx sheds "
+                         "(honoring retry_after_s; 0 disables)")
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
@@ -259,7 +362,7 @@ def main(argv=None) -> int:
                       max_batch=args.max_batch)
     svc = ConsensusService(cfg)
     gen = LoadGenerator(svc, shapes=shapes, na_frac=args.na_frac,
-                        seed=args.seed)
+                        seed=args.seed, max_retries=args.retries)
     if not args.no_warmup:
         svc.warm_buckets(svc.buckets_for(shapes))
     svc.start(warmup=False)

@@ -82,16 +82,24 @@ class MarketSession:
             raise InputError(
                 f"ledger carries {ledger.n_reporters} reporters, session "
                 f"declares {self.n_reporters}")
-        if reputation is None:
-            reputation = (np.asarray(ledger.reputation)
-                          if ledger is not None
-                          else np.full(self.n_reporters,
-                                       1.0 / self.n_reporters))
-        rep = np.asarray(reputation, dtype=np.float64)
-        if rep.shape != (self.n_reporters,):
-            raise InputError(f"reputation shape {rep.shape} does not "
-                             f"match {self.n_reporters} reporters")
-        self.reputation = nk.normalize(rep)
+        if reputation is None and ledger is not None:
+            # ledger-carried state enters VERBATIM: resolve() carries
+            # smooth_rep forward un-renormalized, so a session resumed
+            # from its ledger must start from the identical bits the
+            # uninterrupted session would hold — renormalizing here
+            # would break the failover bit-identity contract by an ulp
+            self.reputation = np.asarray(ledger.reputation,
+                                         dtype=np.float64)
+        else:
+            if reputation is None:
+                reputation = np.full(self.n_reporters,
+                                     1.0 / self.n_reporters)
+            rep = np.asarray(reputation, dtype=np.float64)
+            if rep.shape != (self.n_reporters,):
+                raise InputError(f"reputation shape {rep.shape} does "
+                                 f"not match {self.n_reporters} "
+                                 f"reporters")
+            self.reputation = nk.normalize(rep)
         self.ledger = ledger
         self.alpha = float(alpha)
         self.catch_tolerance = float(catch_tolerance)
@@ -117,6 +125,15 @@ class MarketSession:
     def n_events(self) -> int:
         return sum(b.shape[1] for b in self._blocks)
 
+    def _admit(self, block):
+        """The append-path fault-injection seam (site
+        ``serve.session_append``) — fired exactly ONCE per acknowledged
+        block. ``DurableSession`` applies it before the journal write
+        and overrides this to the identity, so the replication log and
+        the folded statistics can never diverge under an injected
+        corruption."""
+        return _faults.corrupt("serve.session_append", block)
+
     def append(self, reports_block, event_bounds=None) -> int:
         """Stage one event block (R × e, NaN = non-report) and fold it
         into the round's sufficient statistics. Returns the session's
@@ -130,7 +147,7 @@ class MarketSession:
                 f"{block.shape}", shape=tuple(block.shape))
         e = block.shape[1]
         scaled, mins, maxs = parse_event_bounds(event_bounds, e)
-        block = _faults.corrupt("serve.session_append", block)
+        block = self._admit(block)
         with self._lock, obs.span("serve.session_append",
                                   session=self.name, events=e):
             dtype = self._round_rep.dtype
@@ -245,9 +262,14 @@ class MarketSession:
 
     def _resolve_direct(self, algorithm, max_iterations, kwargs) -> dict:
         """The non-incremental fallback: assemble the staged panel and
-        run the full Oracle (host-fetch the flat light-shaped pieces)."""
+        run the full Oracle (host-fetch the flat light-shaped pieces).
+        ``backend=`` in the resolve kwargs is honored (the failover
+        determinism property test runs the SAME session rounds on both
+        backends)."""
         from ..oracle import Oracle
 
+        kwargs = dict(kwargs)
+        backend = kwargs.pop("backend", "jax")
         reports, bounds = self._assembled()
         oracle = Oracle(reports=reports, event_bounds=bounds,
                         reputation=np.asarray(self.reputation),
@@ -255,7 +277,7 @@ class MarketSession:
                         alpha=self.alpha,
                         catch_tolerance=self.catch_tolerance,
                         convergence_tolerance=self.convergence_tolerance,
-                        backend="jax", **kwargs)
+                        backend=backend, **kwargs)
         raw = {k: np.asarray(v) for k, v in oracle._fetch_raw().items()
                if k not in ("original", "rescaled", "filled")}
         return raw
@@ -276,8 +298,26 @@ class SessionStore:
                 raise InputError(f"session {name!r} already exists")
             session = MarketSession(name, n_reporters, **kwargs)
             self._sessions[name] = session
+            # delta-counted: the gauge is the LIVE session total across
+            # every store in the process (a fleet runs one store per
+            # worker — per-store .set() would leave it reporting only
+            # whichever store mutated last)
             obs.gauge("pyconsensus_serve_sessions",
-                      "live market sessions").set(len(self._sessions))
+                      "live market sessions").inc(1)
+            return session
+
+    def add(self, session: MarketSession) -> MarketSession:
+        """Register an externally constructed session under its own
+        name — the fleet's durable sessions (``serve.failover``) are
+        built against a replication log and then ADDED to the owning
+        worker's store, both at creation and at hot-standby takeover."""
+        with self._lock:
+            if session.name in self._sessions:
+                raise InputError(
+                    f"session {session.name!r} already exists")
+            self._sessions[session.name] = session
+            obs.gauge("pyconsensus_serve_sessions",
+                      "live market sessions").inc(1)
             return session
 
     def get(self, name: str) -> MarketSession:
@@ -289,9 +329,9 @@ class SessionStore:
 
     def remove(self, name: str) -> None:
         with self._lock:
-            self._sessions.pop(name, None)
-            obs.gauge("pyconsensus_serve_sessions",
-                      "live market sessions").set(len(self._sessions))
+            if self._sessions.pop(name, None) is not None:
+                obs.gauge("pyconsensus_serve_sessions",
+                          "live market sessions").inc(-1)
 
     def names(self) -> list:
         with self._lock:

@@ -1,0 +1,67 @@
+"""Fleet chaos worker: one serve worker's session traffic as a REAL OS
+process (ISSUE 8 chaos stage). Drives a durable market session —
+deterministic per-(round, block) event blocks, two appends then a
+resolve per round — against a shared replication log, printing progress
+markers. The parent test (or tools/ci_rehearsal.sh) SIGKILLs this
+process mid-traffic and a standby adopts the session by
+``replay_session``: because every append is journaled before it is
+acknowledged and every resolve commits the ledger before clearing its
+journal, the standby resumes bit-identical no matter which instruction
+the kill landed on.
+
+Usage: fleet_worker.py LOG_ROOT SESSION N_ROUNDS [SLEEP_S]
+
+Restart-safe by design: if the session's log already exists the worker
+replays it and continues from the durable position — the same recovery
+discipline the standby uses.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+N_REPORTERS = 12
+BLOCK_EVENTS = 5
+BLOCKS_PER_ROUND = 2
+
+
+def make_block(round_idx: int, block_idx: int) -> np.ndarray:
+    """Deterministic event block for (round, block) — the parent
+    regenerates the identical traffic to continue after the kill and to
+    build the uninterrupted reference run."""
+    rng = np.random.default_rng([7, round_idx, block_idx])
+    block = rng.choice([0.0, 1.0], size=(N_REPORTERS, BLOCK_EVENTS))
+    block[rng.random(block.shape) < 0.1] = np.nan
+    return block
+
+
+def main(argv) -> int:
+    from pyconsensus_tpu.serve.failover import (DurableSession,
+                                                ReplicationLog,
+                                                replay_session)
+
+    log_root, name = argv[1], argv[2]
+    n_rounds = int(argv[3])
+    sleep_s = float(argv[4]) if len(argv) > 4 else 0.15
+
+    if ReplicationLog(log_root, name).exists():
+        session = replay_session(log_root, name)
+    else:
+        session = DurableSession.create(log_root, name, N_REPORTERS)
+    print(f"READY round={session.ledger.round} "
+          f"staged={len(session._blocks)}", flush=True)
+    for k in range(session.ledger.round, n_rounds):
+        for j in range(len(session._blocks), BLOCKS_PER_ROUND):
+            session.append(make_block(k, j))
+            print(f"APPEND {k} {j}", flush=True)
+            time.sleep(sleep_s)
+        session.resolve()
+        print(f"ROUND {k}", flush=True)
+        time.sleep(sleep_s)
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -1,0 +1,1179 @@
+"""Replicated serve fleet (ISSUE 8): consistent-hash placement,
+ledger-backed hot-standby failover, fleet admission, client retry, and
+kill-a-worker-mid-traffic chaos.
+
+The contract under test, end to end: any worker can die mid-traffic and
+every accepted request either resolves with bits identical to a
+single-box run, or sheds with a structured PYC-coded error carrying an
+honest ``retry_after_s`` — never a silent drop, never corrupted state.
+"""
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from conftest import worker_env
+from fleet_worker import BLOCKS_PER_ROUND, N_REPORTERS, make_block
+from pyconsensus_tpu import Oracle, ReputationLedger, obs
+from pyconsensus_tpu import faults
+from pyconsensus_tpu.faults import (ERROR_CODES, CheckpointCorruptionError,
+                                    FailoverInProgressError, InputError,
+                                    PlacementError, ServiceOverloadError,
+                                    WorkerLostError)
+from pyconsensus_tpu.serve import (ConsensusFleet, DurableSession,
+                                   FleetConfig, HashRing, MarketSession,
+                                   ReplicationLog, ServeConfig,
+                                   replay_session)
+from pyconsensus_tpu.serve.admission import ClusterCapacity
+from pyconsensus_tpu.serve.loadgen import (RETRYABLE_CODES, LoadGenerator,
+                                           summarize)
+from pyconsensus_tpu.serve.queue import ResolveRequest
+
+
+def small_fleet(tmp_path, n=3, **cfg_kwargs):
+    cfg = FleetConfig(
+        n_workers=n, log_dir=str(tmp_path / "log"),
+        worker=ServeConfig(warmup=(), batch_window_ms=1.0),
+        **cfg_kwargs)
+    return ConsensusFleet(cfg)
+
+
+def flat_bits(result):
+    """The bit-identity tuple of a flat light result dict."""
+    return (np.asarray(result["smooth_rep"]),
+            np.asarray(result["outcomes_final"]),
+            np.asarray(result["outcomes_adjusted"]),
+            int(np.asarray(result["iterations"])),
+            np.asarray(result["old_rep"]),
+            np.asarray(result["avg_certainty"]))
+
+
+def assert_same_bits(got, ref, msg=""):
+    for a, b in zip(flat_bits(got), flat_bits(ref)):
+        np.testing.assert_array_equal(a, b, err_msg=msg)
+
+
+# -- consistent-hash placement ---------------------------------------------
+
+
+class TestHashRing:
+    KEYS = [f"session-{i}" for i in range(240)]
+
+    def test_deterministic_across_instances(self):
+        a = HashRing(["w0", "w1", "w2"])
+        b = HashRing(["w2", "w0", "w1"])     # insertion order irrelevant
+        assert [a.owner(k) for k in self.KEYS] == \
+               [b.owner(k) for k in self.KEYS]
+
+    def test_removal_moves_only_the_dead_workers_keys(self):
+        ring = HashRing(["w0", "w1", "w2"])
+        before = {k: ring.owner(k) for k in self.KEYS}
+        moved = ring.moved_keys(self.KEYS, "w1")
+        assert moved == [k for k, o in before.items() if o == "w1"]
+        ring.remove("w1")
+        after = {k: ring.owner(k) for k in self.KEYS}
+        for k in self.KEYS:
+            if before[k] != "w1":
+                assert after[k] == before[k], k     # stability
+            else:
+                assert after[k] != "w1"             # redistributed
+        assert any(before[k] == "w1" for k in self.KEYS)
+
+    def test_add_back_restores_placement(self):
+        ring = HashRing(["w0", "w1", "w2"])
+        before = {k: ring.owner(k) for k in self.KEYS}
+        ring.remove("w1")
+        ring.add("w1")
+        assert {k: ring.owner(k) for k in self.KEYS} == before
+
+    def test_distribution_is_roughly_balanced(self):
+        ring = HashRing(["w0", "w1", "w2"])
+        owners = [ring.owner(k) for k in self.KEYS]
+        for w in ("w0", "w1", "w2"):
+            assert owners.count(w) >= len(self.KEYS) * 0.15, w
+
+    def test_empty_ring_raises_placement_error(self):
+        ring = HashRing()
+        with pytest.raises(PlacementError) as ei:
+            ring.owner("anything")
+        assert ei.value.error_code == "PYC503"
+        with pytest.raises(PlacementError):
+            ring.preference("anything")
+
+    def test_preference_owner_first_distinct(self):
+        ring = HashRing(["w0", "w1", "w2"])
+        for k in self.KEYS[:40]:
+            pref = ring.preference(k)
+            assert pref[0] == ring.owner(k)
+            assert sorted(pref) == ["w0", "w1", "w2"]
+
+    def test_remove_unknown_is_noop(self):
+        ring = HashRing(["w0"])
+        ring.remove("nope")
+        assert ring.owner("k") == "w0"
+
+    def test_bad_vnodes_rejected(self):
+        with pytest.raises(PlacementError):
+            HashRing(vnodes=0)
+
+
+# -- PYC5xx taxonomy -------------------------------------------------------
+
+
+class TestFleetTaxonomy:
+    def test_codes_registered_and_stable(self):
+        assert ERROR_CODES["PYC501"] is WorkerLostError
+        assert ERROR_CODES["PYC502"] is FailoverInProgressError
+        assert ERROR_CODES["PYC503"] is PlacementError
+
+    @pytest.mark.parametrize("cls", [WorkerLostError,
+                                     FailoverInProgressError,
+                                     PlacementError])
+    def test_double_inheritance_and_context(self, cls):
+        exc = cls("boom", retry_after_s=0.5, worker="w1")
+        assert isinstance(exc, RuntimeError)
+        assert exc.context["worker"] == "w1"
+        assert exc.error_code in str(exc)
+
+
+# -- ledger.verify() (takeover preflight) ----------------------------------
+
+
+class TestLedgerVerify:
+    def _saved(self, tmp_path, rounds=2):
+        ledger = ReputationLedger(n_reporters=6, max_iterations=2)
+        rng = np.random.default_rng(3)
+        for _ in range(rounds):
+            ledger.resolve(rng.choice([0.0, 1.0], size=(6, 5)))
+        path = tmp_path / "state.npz"
+        ledger.save(path)
+        return ledger, path
+
+    def test_verify_summary_without_construction(self, tmp_path):
+        ledger, path = self._saved(tmp_path)
+        raw = path.read_bytes()
+        summary = ReputationLedger.verify(path)
+        assert summary == {"n_reporters": 6, "round": 2,
+                           "rounds_recorded": 2}
+        assert path.read_bytes() == raw        # dry run: zero mutation
+
+    def test_torn_final_record_detected(self, tmp_path):
+        _, path = self._saved(tmp_path)
+        raw = path.read_bytes()
+        # a power-loss torn write: the file is cut short mid final
+        # record (the npz central directory is gone)
+        path.write_bytes(raw[: len(raw) - len(raw) // 3])
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            ReputationLedger.verify(path)
+        assert ei.value.error_code == "PYC301"
+        assert path.name in str(ei.value)
+
+    def test_missing_field_named(self, tmp_path):
+        _, path = self._saved(tmp_path)
+        with np.load(path) as data:
+            state = {k: data[k] for k in data.files if k != "round"}
+        np.savez(path, **state)
+        with pytest.raises(CheckpointCorruptionError,
+                           match="'round' is missing"):
+            ReputationLedger.verify(path)
+
+    def test_nonfinite_reputation_named(self, tmp_path):
+        _, path = self._saved(tmp_path)
+        with np.load(path) as data:
+            state = {k: data[k] for k in data.files}
+        state["reputation"] = np.array([0.5, np.nan, 0.5])
+        np.savez(path, **state)
+        with pytest.raises(CheckpointCorruptionError,
+                           match="non-finite"):
+            ReputationLedger.verify(path)
+
+    def test_missing_file_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ReputationLedger.verify(tmp_path / "absent.npz")
+
+
+# -- replication log -------------------------------------------------------
+
+
+class TestReplicationLog:
+    def test_journal_round_trip_bitwise(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        rng = np.random.default_rng(0)
+        b0 = rng.random((4, 3))
+        b0[0, 1] = np.nan
+        bounds = [None, {"scaled": True, "min": 0.0, "max": 10.0}, None]
+        log.journal_block(0, 0, b0, bounds)
+        b1 = rng.random((4, 2))
+        log.journal_block(0, 1, b1, None)
+        staged = log.staged(0)
+        assert len(staged) == 2
+        np.testing.assert_array_equal(staged[0][0], b0)
+        assert staged[0][1] == bounds
+        np.testing.assert_array_equal(staged[1][0], b1)
+        assert staged[1][1] is None
+
+    def test_digest_mismatch_refused(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        log.journal_block(0, 0, np.ones((4, 3)))
+        victim = log._block_path(0, 0)
+        raw = bytearray(victim.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        victim.write_bytes(bytes(raw))
+        with pytest.raises(CheckpointCorruptionError):
+            log.staged(0)
+
+    def test_torn_final_block_detected(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        log.journal_block(0, 0, np.ones((4, 3)))
+        log.journal_block(0, 1, np.zeros((4, 2)))
+        victim = log._block_path(0, 1)       # the FINAL journal record
+        raw = victim.read_bytes()
+        victim.write_bytes(raw[: len(raw) // 2])
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            log.staged(0)
+        assert victim.name in str(ei.value)
+
+    def test_index_gap_refused(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        log.journal_block(0, 0, np.ones((4, 3)))
+        log.journal_block(0, 1, np.ones((4, 3)))
+        log._block_path(0, 0).unlink()
+        with pytest.raises(CheckpointCorruptionError,
+                           match="not contiguous"):
+            log.staged(0)
+
+    def test_commit_clears_only_closed_rounds(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        log.journal_block(0, 0, np.ones((4, 3)))
+        log.journal_block(1, 0, np.zeros((4, 3)))   # next round's journal
+        ledger = ReputationLedger(4)
+        ledger.round = 1
+        log.commit_round(ledger)
+        assert not log._block_path(0, 0).exists()
+        assert log._block_path(1, 0).exists()
+        assert log.verify()["staged_blocks"] == 1
+
+    def test_duplicate_create_refused(self, tmp_path):
+        ReplicationLog.create(tmp_path, "s", 4)
+        with pytest.raises(InputError):
+            ReplicationLog.create(tmp_path, "s", 4)
+
+    def test_meta_corruption_named(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        log.meta_path.write_text("{not json")
+        with pytest.raises(CheckpointCorruptionError):
+            log.verify()
+
+    def test_verify_refuses_roster_mismatch(self, tmp_path):
+        log = ReplicationLog.create(tmp_path, "s", 4)
+        ReputationLedger(5).save(log.ledger_path)
+        with pytest.raises(CheckpointCorruptionError,
+                           match="reporters"):
+            log.verify()
+
+    def test_failed_commit_fences_session(self, tmp_path):
+        """A resolve whose ledger commit fails must FENCE the session:
+        memory is one round ahead of disk, so a later acknowledged
+        append would journal under a round index replay discards — an
+        acknowledged write the fleet would forget. The fence makes the
+        failure loud; the durable log (previous checkpoint + the
+        round's journal) still replays the round bit-identically."""
+        ref = MarketSession("ref", N_REPORTERS)
+        ref.append(make_block(0, 0))
+        want = ref.resolve()
+
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        session.append(make_block(0, 0))
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "ledger.save", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            with pytest.raises(OSError):
+                session.resolve()
+        assert plan.fired == [("ledger.save", 0, "raise")]
+        with pytest.raises(CheckpointCorruptionError, match="fenced"):
+            session.append(make_block(1, 0))
+        with pytest.raises(CheckpointCorruptionError, match="fenced"):
+            session.resolve()
+        standby = replay_session(tmp_path, "s")
+        assert_same_bits(standby.resolve(), want,
+                         "uncommitted round must replay bit-identical")
+
+    def test_failed_fold_removes_journal_record(self, tmp_path,
+                                                monkeypatch):
+        """An append whose in-memory fold fails must not leave its
+        journal record behind: the caller was told the append never
+        happened, so replay must not fold it — a phantom acknowledged
+        block would change the standby's bits."""
+        import pyconsensus_tpu.serve.session as session_mod
+
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        session.append(make_block(0, 0))
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("device fell over mid-fold")
+        monkeypatch.setattr(session_mod, "_pass1_panel", boom)
+        with pytest.raises(RuntimeError):
+            session.append(make_block(0, 1))
+        monkeypatch.undo()
+
+        standby = replay_session(tmp_path, "s")
+        assert len(standby._blocks) == 1     # the phantom never replays
+        ref = MarketSession("ref", N_REPORTERS)
+        ref.append(make_block(0, 0))
+        assert_same_bits(standby.resolve(), ref.resolve(),
+                         "failed append must not reach the standby")
+
+    def test_injected_append_corruption_is_durable(self, tmp_path):
+        """A ``serve.session_append`` corruption must hit the journal
+        and the in-memory fold IDENTICALLY: the standby replays
+        whatever the dead worker acknowledged — corrupted traffic
+        included — or the bit-identity contract breaks under the exact
+        faults the chaos plans inject."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        plan = faults.FaultPlan(seed=3, rules=[
+            {"site": "serve.session_append", "kind": "nan_storm",
+             "occurrences": [0], "args": {"fraction": 0.5}}])
+        with faults.armed(plan):
+            session.append(make_block(0, 0))
+        # exactly one fire: the seam moved pre-journal, it did not fork
+        assert plan.fired == [("serve.session_append", 0, "nan_storm")]
+        assert np.isnan(session._blocks[0]).any()
+        standby = replay_session(tmp_path, "s")
+        np.testing.assert_array_equal(
+            standby._blocks[0], session._blocks[0],
+            err_msg="journal and fold diverged under injected corruption")
+
+
+# -- failover determinism (the kill-point property test) -------------------
+
+
+N_ROUNDS = 3
+
+
+def drive(session, ops):
+    """Run ``ops`` (a list of ("append", k, j) / ("resolve", k) steps)
+    against ``session``; returns the per-round results."""
+    results = []
+    for op in ops:
+        if op[0] == "append":
+            session.append(make_block(op[1], op[2]))
+        else:
+            results.append(session.resolve())
+    return results
+
+
+def all_ops():
+    ops = []
+    for k in range(N_ROUNDS):
+        for j in range(BLOCKS_PER_ROUND):
+            ops.append(("append", k, j))
+        ops.append(("resolve", k))
+    return ops
+
+
+@pytest.fixture(scope="module")
+def reference_rounds():
+    """The never-killed single-worker run (plain in-memory session)."""
+    session = MarketSession("ref", N_REPORTERS)
+    return drive(session, all_ops())
+
+
+class TestFailoverDeterminism:
+    @pytest.mark.parametrize("kill_at", range(len(all_ops())))
+    def test_any_kill_point_resumes_bit_identical(self, tmp_path,
+                                                  kill_at,
+                                                  reference_rounds):
+        """For EVERY point in a multi-round session — between appends,
+        mid-round, right after a resolve — abandoning the worker there
+        and replaying the log on the standby yields outcomes, iteration
+        counts, and carried smooth_rep bit-identical to the
+        uninterrupted run."""
+        ops = all_ops()
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        results = drive(session, ops[:kill_at])
+        del session                      # the worker dies here
+        standby = replay_session(tmp_path, "s")
+        results += drive(standby, ops[kill_at:])
+        assert len(results) == N_ROUNDS
+        for got, ref in zip(results, reference_rounds):
+            assert_same_bits(got, ref, f"kill_at={kill_at}")
+        np.testing.assert_array_equal(
+            standby.reputation,
+            np.asarray(reference_rounds[-1]["smooth_rep"]))
+
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_direct_backend_rounds_resume_bit_identical(self, tmp_path,
+                                                        backend):
+        """The non-incremental resolve path (explicit backend /
+        multi-iteration kwargs) has the same failover contract on both
+        backends."""
+        kwargs = {"max_iterations": 2, "backend": backend}
+        ref_session = MarketSession("ref", N_REPORTERS)
+        ref = []
+        for k in range(2):
+            for j in range(BLOCKS_PER_ROUND):
+                ref_session.append(make_block(k, j))
+            ref.append(ref_session.resolve(**kwargs))
+
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        for j in range(BLOCKS_PER_ROUND):
+            session.append(make_block(0, j))
+        got = [session.resolve(**kwargs)]
+        session.append(make_block(1, 0))
+        del session                      # killed mid-round 1
+        standby = replay_session(tmp_path, "s")
+        standby.append(make_block(1, 1))
+        got.append(standby.resolve(**kwargs))
+        for g, r in zip(got, ref):
+            assert_same_bits(g, r, backend)
+
+    def test_crash_before_commit_re_resolves_identically(self, tmp_path):
+        """A kill between the round's resolve and its ledger commit
+        leaves the previous checkpoint + full journal; the standby
+        re-resolves the round from identical inputs to identical bits
+        (no lost, no double-applied round)."""
+        session = DurableSession.create(tmp_path / "a", "s", N_REPORTERS)
+        for j in range(BLOCKS_PER_ROUND):
+            session.append(make_block(0, j))
+        # snapshot the durable state BEFORE the resolve commits
+        shutil.copytree(tmp_path / "a", tmp_path / "b")
+        ref = session.resolve()
+        standby = replay_session(tmp_path / "b", "s")
+        assert standby.ledger.round == 0
+        assert len(standby._blocks) == BLOCKS_PER_ROUND
+        assert_same_bits(standby.resolve(), ref)
+
+    def test_refused_append_leaves_no_journal_record(self, tmp_path):
+        """Validation runs BEFORE the journal write: an append the
+        caller was told never happened must leave no record replay
+        would fold — or crash on — during a takeover."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        session.append(make_block(0, 0))
+        with pytest.raises(InputError):
+            session.append(make_block(0, 1),
+                           event_bounds=[(0.0, 1.0)] * 99)  # wrong len
+        assert len(session.log.staged(session.ledger.round)) == 1
+        standby = replay_session(tmp_path, "s")
+        assert len(standby._blocks) == 1
+        assert_same_bits(standby.resolve(), session.resolve())
+
+    def test_replay_ignores_stale_closed_round_journal(self, tmp_path):
+        """A crash between ledger commit and journal GC leaves stale
+        staged files for an already-closed round — replay recognizes
+        them by round index and the next round stays clean."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        session.append(make_block(0, 0))
+        log = session.log
+        committed = log._block_path(0, 0).read_bytes()
+        session.resolve()
+        # resurrect the closed round's journal record (the GC the
+        # crash skipped)
+        log._block_path(0, 0).write_bytes(committed)
+        standby = replay_session(tmp_path, "s")
+        assert standby.ledger.round == 1
+        assert len(standby._blocks) == 0
+
+
+# -- the fleet router ------------------------------------------------------
+
+
+class TestFleetRouting:
+    def test_stateless_requests_bit_identical_to_oracle(self, tmp_path):
+        rng = np.random.default_rng(5)
+        m = rng.choice([0.0, 1.0], size=(10, 8))
+        ref = Oracle(reports=m, backend="numpy").consensus()
+        with small_fleet(tmp_path) as fleet:
+            futs = [fleet.submit(reports=m, backend="numpy")
+                    for _ in range(9)]
+            for f in futs:
+                got = f.result(timeout=60)
+                np.testing.assert_array_equal(
+                    got["events"]["outcomes_final"],
+                    ref["events"]["outcomes_final"])
+                np.testing.assert_array_equal(
+                    got["agents"]["smooth_rep"],
+                    ref["agents"]["smooth_rep"])
+
+    def test_submit_rejects_reports_and_session(self, tmp_path):
+        fleet = small_fleet(tmp_path)
+        fleet.create_session("mkt", n_reporters=N_REPORTERS)
+        with pytest.raises(InputError, match="exactly one"):
+            fleet.submit(reports=np.ones((3, 3)), session="mkt")
+
+    def test_session_requires_log_dir(self):
+        fleet = ConsensusFleet(FleetConfig(
+            n_workers=1, worker=ServeConfig(warmup=())))
+        with pytest.raises(InputError, match="log_dir"):
+            fleet.create_session("s", n_reporters=4)
+
+    def test_unknown_session_and_worker(self, tmp_path):
+        fleet = small_fleet(tmp_path)
+        with pytest.raises(InputError, match="unknown fleet session"):
+            fleet.submit(session="nope")
+        with pytest.raises(PlacementError):
+            fleet.kill_worker("w99")
+
+    def test_all_workers_dead_is_placement_error(self, tmp_path):
+        fleet = small_fleet(tmp_path, n=2)
+        fleet.kill_worker("w0")
+        fleet.kill_worker("w1")
+        with pytest.raises(PlacementError) as ei:
+            fleet.submit(reports=np.ones((3, 3)), backend="numpy")
+        assert ei.value.error_code == "PYC503"
+
+    def test_cluster_full_shed_quotes_scaled_retry(self, tmp_path):
+        fleet = small_fleet(tmp_path, base_retry_s=0.2)
+
+        def full(**kw):
+            raise ServiceOverloadError("full", reason="queue_full")
+        for w in fleet.workers.values():
+            w.service.submit = full
+        fleet.kill_worker("w2")          # 2/3 alive
+        with pytest.raises(ServiceOverloadError) as ei:
+            fleet.submit(reports=np.ones((3, 3)), backend="numpy")
+        ctx = ei.value.context
+        assert ctx["reason"] == "cluster_full"
+        assert ctx["alive_workers"] == 2
+        # honest hint: base * registered/alive = 0.2 * 3/2
+        assert ctx["retry_after_s"] == pytest.approx(0.3, abs=1e-6)
+
+    def test_rate_limit_not_spilled(self, tmp_path):
+        """Spillover is for full queues; a tenant over its rate budget
+        must not get n_workers times the configured rate."""
+        fleet = small_fleet(tmp_path)
+        calls = []
+
+        def limited(**kw):
+            calls.append(1)
+            raise ServiceOverloadError("over rate", reason="rate_limited",
+                                       retry_after_s=0.1)
+        for w in fleet.workers.values():
+            w.service.submit = limited
+        with pytest.raises(ServiceOverloadError) as ei:
+            fleet.submit(reports=np.ones((3, 3)))
+        assert ei.value.context["reason"] == "rate_limited"
+        assert len(calls) == 1
+
+
+# -- failover through the fleet --------------------------------------------
+
+
+class TestFleetFailover:
+    def test_all_workers_dead_sheds_placement_not_retryable(
+            self, tmp_path):
+        """With every worker dead a session request must shed the
+        NON-retryable PYC503 — not PYC501, which a polite client would
+        retry against a fleet that can never serve — and repeated
+        routing must not re-run (or re-count) takeovers that cannot
+        land anywhere."""
+        fleet = small_fleet(tmp_path, n=1).start(warmup=False)
+        fleet.create_session("s", n_reporters=6)
+        fleet.append("s", make_block(0, 0)[:6])
+        fleet.submit(session="s").result(timeout=60)
+        fleet.kill_worker("w0")
+        failovers = obs.value("pyconsensus_failovers_total")
+        for _ in range(3):
+            with pytest.raises(PlacementError):
+                fleet.submit(session="s")
+        assert obs.value("pyconsensus_failovers_total") == failovers
+        # the durable log survives the whole-fleet death: a fresh
+        # adoption path still replays the session
+        assert replay_session(fleet.config.log_dir, "s").ledger.round == 1
+        fleet.close(drain=True)
+
+    def test_migrated_session_leaves_dead_workers_store(self, tmp_path):
+        """The live-session gauge counts every store in the process;
+        a migrated session must live in exactly ONE of them."""
+        fleet = small_fleet(tmp_path, n=2).start(warmup=False)
+        before = obs.value("pyconsensus_serve_sessions") or 0
+        fleet.create_session("s", n_reporters=6)
+        assert obs.value("pyconsensus_serve_sessions") == before + 1
+        victim = fleet.owner_of("s")
+        fleet.kill_worker(victim)
+        assert fleet.owner_of("s") != victim
+        assert "s" not in fleet.workers[victim].service.sessions.names()
+        assert obs.value("pyconsensus_serve_sessions") == before + 1
+        fleet.close(drain=True)
+
+    def test_graceful_drain_is_not_worker_loss(self, tmp_path):
+        """A LIVE worker's shutdown drain must shed as PYC401
+        (reason ``draining``), not PYC501 — no takeover is coming, so
+        a polite client must not burn its retry budget waiting for
+        one."""
+        fleet = small_fleet(tmp_path).start(warmup=False)
+        fleet.create_session("s", n_reporters=6)
+        owner = fleet.owner_of("s")
+        fleet.workers[owner].service.admission.start_drain()
+        with pytest.raises(ServiceOverloadError) as ei:
+            fleet.submit(session="s")
+        assert ei.value.error_code == "PYC401"
+        assert ei.value.context["reason"] == "draining"
+        fleet.close(drain=True)
+
+    def test_routing_discovery_takeover_fault_is_structured(
+            self, tmp_path):
+        """An injected ``fleet.takeover`` fault during the synchronous
+        routing-time death declaration must reach the client as
+        retryable PYC501 — never the raw injected error — and the
+        stranded session must land on the survivor on the next routed
+        request."""
+        fleet = small_fleet(tmp_path, n=2).start(warmup=False)
+        fleet.create_session("s", n_reporters=6)
+        fleet.append("s", make_block(0, 0)[:6])
+        fleet.submit(session="s").result(timeout=60)
+        owner = fleet.owner_of("s")
+        # fence the worker without declaring it (the monitor has not
+        # scanned): the next routed request discovers the death
+        fleet.workers[owner].hard_kill(0.1)
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "fleet.takeover", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            with pytest.raises(WorkerLostError) as ei:
+                fleet.submit(session="s")
+        assert plan.fired == [("fleet.takeover", 0, "raise")]
+        assert ei.value.error_code == "PYC501"
+        assert ei.value.context["retry_after_s"] > 0
+        # the retried route runs the takeover for real this time
+        fleet.append("s", make_block(1, 0)[:6])
+        assert fleet.owner_of("s") != owner
+        fleet.submit(session="s").result(timeout=60)
+        fleet.close(drain=True)
+
+    def test_only_dead_workers_sessions_move(self, tmp_path):
+        fleet = small_fleet(tmp_path)
+        names = [f"market-{i}" for i in range(8)]
+        owners = {n: fleet.create_session(n, n_reporters=6)
+                  for n in names}
+        assert len(set(owners.values())) > 1       # actually spread
+        victim = fleet.owner_of(names[0])
+        before_migrated = obs.value("pyconsensus_sessions_migrated_total")
+        info = fleet.kill_worker(victim)
+        moved = {s for s, _ in info["sessions_migrated"]}
+        assert moved == {n for n, o in owners.items() if o == victim}
+        for n in names:
+            if owners[n] != victim:
+                assert fleet.owner_of(n) == owners[n]   # stability
+            else:
+                assert fleet.owner_of(n) != victim
+        assert (obs.value("pyconsensus_sessions_migrated_total")
+                - before_migrated) == len(moved)
+        assert obs.value("pyconsensus_fleet_workers") == 2
+
+    def test_queued_requests_shed_as_worker_lost(self, tmp_path):
+        fleet = small_fleet(tmp_path)          # not started: no batcher
+        w = fleet.workers["w0"]
+        req = ResolveRequest(reports=np.ones((3, 3)))
+        w.service.queue.put(req)
+        info = fleet.kill_worker("w0")
+        assert info["shed_queued"] == 1
+        with pytest.raises(WorkerLostError) as ei:
+            req.future.result(timeout=0)
+        assert ei.value.error_code == "PYC501"
+        assert ei.value.context["retry_after_s"] > 0
+        assert ei.value.context["worker"] == "w0"
+
+    def test_stale_session_object_is_fenced_at_takeover(self, tmp_path):
+        """The acknowledged-append race: a client that resolved the
+        owner just before the kill still holds the dead worker's
+        session object. After the takeover that object is FENCED — a
+        late append raises the retryable loss instead of journaling a
+        block the standby never folds (and whose journal index the
+        standby's next append would silently overwrite)."""
+        fleet = small_fleet(tmp_path).start(warmup=False)
+        owner = fleet.create_session("mkt", n_reporters=N_REPORTERS)
+        fleet.append("mkt", make_block(0, 0))
+        stale = fleet.workers[owner].service.sessions.get("mkt")
+        fleet.kill_worker(owner)
+        with pytest.raises(WorkerLostError) as ei:
+            stale.append(make_block(0, 1))
+        assert ei.value.error_code == "PYC501"
+        assert ei.value.context["retry_after_s"] > 0
+        with pytest.raises(WorkerLostError):
+            stale.resolve()
+        # the retrying client lands on the standby, and the session
+        # carries exactly the acknowledged blocks — bit-identical to a
+        # single box that saw the same appends
+        fleet.append("mkt", make_block(0, 1))
+        got = fleet.submit(session="mkt").result(timeout=60)
+        ref = MarketSession("ref", N_REPORTERS)
+        ref.append(make_block(0, 0))
+        ref.append(make_block(0, 1))
+        want = ref.resolve()
+        np.testing.assert_array_equal(
+            np.asarray(got["agents"]["smooth_rep"]),
+            np.asarray(want["smooth_rep"]))
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_final"]),
+            np.asarray(want["outcomes_final"]))
+        fleet.close(drain=True)
+
+    def test_concurrent_death_declarations_single_takeover(self,
+                                                           tmp_path):
+        """kill_worker racing a second declaration of the same worker:
+        the per-worker declare lock serializes them — exactly one
+        takeover replays the session, the loser observes a no-op, and
+        no InputError ('session already exists') escapes to a client."""
+        fleet = small_fleet(tmp_path)
+        owner = fleet.create_session("mkt", n_reporters=N_REPORTERS)
+        fleet.append("mkt", make_block(0, 0))
+        failovers0 = obs.value("pyconsensus_failovers_total") or 0
+        migrated0 = obs.value("pyconsensus_sessions_migrated_total") or 0
+        failures = []
+        gate = threading.Barrier(2)
+
+        def declare():
+            gate.wait()
+            try:
+                fleet.kill_worker(owner)
+            except Exception as exc:   # noqa: BLE001 — the assertion
+                failures.append(exc)
+        threads = [threading.Thread(target=declare) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not failures, failures
+        assert fleet.owner_of("mkt") not in (None, owner)
+        assert ((obs.value("pyconsensus_failovers_total") or 0)
+                - failovers0) == 1
+        assert ((obs.value("pyconsensus_sessions_migrated_total") or 0)
+                - migrated0) == 1
+
+    def test_takeover_window_surfaces_failover_in_progress(self,
+                                                           tmp_path):
+        fleet = small_fleet(tmp_path)
+        fleet.create_session("s", n_reporters=4)
+        fleet._migrating.add("s")
+        fleet.capacity.begin_takeover(0.5)
+        with pytest.raises(FailoverInProgressError) as ei:
+            fleet.submit(session="s")
+        assert ei.value.error_code == "PYC502"
+        assert 0 < ei.value.context["retry_after_s"] <= 0.51
+
+    def test_standby_never_adopts_corrupt_log(self, tmp_path):
+        """Torn ledger replication: the takeover preflight refuses, the
+        session answers its corruption error, and HEALTHY sessions on
+        the same dead worker still migrate."""
+        fleet = small_fleet(tmp_path)
+        names = [f"m{i}" for i in range(6)]
+        for n in names:
+            fleet.create_session(n, n_reporters=6)
+            fleet.append(n, make_block(0, 0)[:6])
+            fleet.submit(session=n).result(timeout=60)
+        victim_worker = fleet.owner_of(names[0])
+        victims = [n for n in names
+                   if fleet.owner_of(n) == victim_worker]
+        torn = victims[0]
+        path = ReplicationLog(fleet.config.log_dir, torn).ledger_path
+        raw = path.read_bytes()
+        path.write_bytes(raw[: len(raw) // 2])
+        info = fleet.kill_worker(victim_worker)
+        migrated = {s for s, _ in info["sessions_migrated"]}
+        assert migrated == set(victims) - {torn}
+        with pytest.raises(CheckpointCorruptionError):
+            fleet.submit(session=torn)
+        assert torn in fleet.status()["failed_sessions"]
+        fleet.close(drain=True)
+
+    def test_injected_torn_ledger_replay_site(self, tmp_path):
+        """The seeded-FaultPlan spelling of the same contract: a
+        ``torn_write`` rule at ``fleet.ledger_replay`` tears the
+        replication log between death and adoption."""
+        fleet = small_fleet(tmp_path, n=2)
+        fleet.create_session("s", n_reporters=6)
+        fleet.append("s", make_block(0, 0)[:6])
+        fleet.submit(session="s").result(timeout=60)
+        owner = fleet.owner_of("s")
+        plan = faults.FaultPlan(seed=1, rules=[
+            {"site": "fleet.ledger_replay", "kind": "torn_write",
+             "occurrences": [0], "args": {"keep_bytes": 40}}])
+        with faults.armed(plan):
+            fleet.kill_worker(owner)
+        assert plan.fired == [("fleet.ledger_replay", 0, "torn_write")]
+        with pytest.raises(CheckpointCorruptionError):
+            fleet.submit(session="s")
+        fleet.close(drain=True)
+
+    def test_route_site_injection(self, tmp_path):
+        fleet = small_fleet(tmp_path)
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "fleet.route", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            with pytest.raises(OSError):
+                fleet.submit(reports=np.ones((3, 3)), backend="numpy")
+
+    def test_heartbeat_single_flap_is_tolerated(self, tmp_path):
+        fleet = small_fleet(tmp_path, n=2, heartbeat_timeout_s=0.5)
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "fleet.heartbeat", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            assert fleet.check_workers() == []    # w0's beat lost...
+            time.sleep(0.05)
+            assert fleet.check_workers() == []    # ...but it recovers
+        assert fleet.workers["w0"].alive
+
+    def test_sustained_heartbeat_flap_triggers_failover(self, tmp_path):
+        fleet = small_fleet(tmp_path, n=2, heartbeat_timeout_s=0.08)
+        fleet.create_session("s", n_reporters=6)
+        # force the session onto w0 so the flap visibly migrates it
+        if fleet.owner_of("s") != "w0":
+            with fleet._lock:
+                owner = fleet._sessions["s"]
+                sess = fleet.workers[owner].service.sessions.get("s")
+                fleet.workers[owner].service.sessions.remove("s")
+                fleet.workers["w0"].service.sessions.add(sess)
+                fleet._sessions["s"] = "w0"
+        # with 2 alive workers the scan order is w0, w1: occurrences
+        # 0, 2, 4 are w0's beats — every one lost, w1 never touched
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "fleet.heartbeat", "kind": "raise",
+             "occurrences": [0, 2, 4, 6], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            assert fleet.check_workers() == []
+            time.sleep(0.1)
+            dead = fleet.check_workers()
+        assert dead == ["w0"]
+        assert not fleet.workers["w0"].alive      # fenced (single writer)
+        assert fleet.workers["w1"].alive
+        assert fleet.owner_of("s") == "w1"
+        # the migrated session still serves, from the replayed log
+        fleet.append("s", make_block(0, 0)[:6])
+        result = fleet.submit(session="s").result(timeout=60)
+        assert np.isfinite(
+            np.asarray(result["agents"]["smooth_rep"])).all()
+        fleet.close(drain=True)
+
+    def test_dead_owner_discovered_at_routing_fails_over(self, tmp_path):
+        """A submit that races ahead of the monitor: the dead owner is
+        discovered at routing time, takeover runs synchronously, and
+        the caller lands on the standby — no error at all."""
+        fleet = small_fleet(tmp_path, n=2)
+        fleet.create_session("s", n_reporters=6)
+        owner = fleet.owner_of("s")
+        # fence without declaring (the monitor has not scanned yet)
+        fleet.workers[owner].hard_kill(0.1)
+        fleet.append("s", make_block(0, 0)[:6])
+        result = fleet.submit(session="s").result(timeout=60)
+        assert fleet.owner_of("s") != owner
+        assert np.isfinite(
+            np.asarray(result["agents"]["smooth_rep"])).all()
+        fleet.close(drain=True)
+
+
+# -- cluster capacity (fleet-aware admission) ------------------------------
+
+
+class TestClusterCapacity:
+    def test_alive_accounting_and_gauge(self):
+        cap = ClusterCapacity(base_retry_s=0.2)
+        for i in range(3):
+            cap.register(f"w{i}", 16)
+        assert cap.alive == 3
+        assert cap.alive_slots() == 48
+        assert obs.value("pyconsensus_fleet_workers") == 3
+        cap.mark_dead("w1")
+        assert cap.alive == 2
+        assert cap.alive_slots() == 32
+        assert obs.value("pyconsensus_fleet_workers") == 2
+
+    def test_retry_hint_scales_with_survivors(self):
+        cap = ClusterCapacity(base_retry_s=0.2)
+        for i in range(4):
+            cap.register(f"w{i}", 8)
+        assert cap.shed_retry_after() == pytest.approx(0.2)
+        cap.mark_dead("w0")
+        cap.mark_dead("w1")
+        assert cap.shed_retry_after() == pytest.approx(0.4)
+
+    def test_takeover_window_folds_into_hint(self):
+        cap = ClusterCapacity(base_retry_s=0.1)
+        cap.register("w0", 8)
+        cap.begin_takeover(5.0)
+        assert cap.shed_retry_after() > 4.0
+        assert cap.takeover_remaining() > 4.0
+        cap.end_takeover()
+        assert cap.takeover_remaining() == 0.0
+        assert cap.shed_retry_after() == pytest.approx(0.1)
+
+    def test_per_worker_queue_gauge(self, tmp_path):
+        fleet = small_fleet(tmp_path, n=2)
+        fleet.check_workers()
+        assert obs.value("pyconsensus_fleet_worker_queue_depth",
+                         worker="w0") == 0
+        assert obs.value("pyconsensus_fleet_worker_queue_depth",
+                         worker="w1") == 0
+
+
+# -- loadgen retry (honest retry_after_s) ----------------------------------
+
+
+class _ShedThenServe:
+    """Sheds each request ``fails`` times with ``exc_factory()``, then
+    serves it. Deterministic per request index (keyed by submit order)."""
+
+    def __init__(self, fails, exc_factory):
+        self.fails = fails
+        self.exc_factory = exc_factory
+        self.seen: dict = {}
+        self.submits = 0
+
+    def submit(self, reports=None, tenant="t", **kw):
+        self.submits += 1
+        key = self.submits          # attempt-unique; per-request count
+        n = self.seen.get(id(reports), 0)
+        self.seen[id(reports)] = n + 1
+        if n < self.fails:
+            raise self.exc_factory()
+        fut = Future()
+        fut.set_result({"ok": key})
+        return fut
+
+
+class TestLoadgenRetry:
+    def test_retryable_codes_cover_fleet_taxonomy(self):
+        assert set(RETRYABLE_CODES) == {"PYC401", "PYC501", "PYC502"}
+
+    def test_retry_absorbs_bounded_sheds(self):
+        svc = _ShedThenServe(2, lambda: WorkerLostError(
+            "lost", retry_after_s=0.01))
+        # distinct shapes -> distinct corpus matrices, so the fake
+        # service counts sheds per request, not per matrix object
+        gen = LoadGenerator(svc, shapes=((2, 2), (2, 3), (2, 4), (2, 5)),
+                            max_retries=3, retry_cap_s=0.05)
+        stats = gen.run_closed(n_requests=4, concurrency=1)
+        assert stats["succeeded"] == 4 and stats["failed"] == 0
+        assert stats["retried"] == 8          # 2 retries x 4 requests
+        assert stats["abandoned"] == 0
+
+    def test_exhausted_budget_counts_abandoned(self):
+        svc = _ShedThenServe(99, lambda: ServiceOverloadError(
+            "full", reason="queue_full", retry_after_s=0.01))
+        gen = LoadGenerator(svc, shapes=((2, 2), (2, 3), (2, 4)),
+                            max_retries=1, retry_cap_s=0.05)
+        stats = gen.run_closed(n_requests=3, concurrency=1)
+        assert stats["failed"] == 3
+        assert stats["errors"] == {"PYC401": 3}
+        assert stats["retried"] == 3
+        assert stats["abandoned"] == 3
+
+    def test_zero_budget_keeps_pre_fleet_semantics(self):
+        svc = _ShedThenServe(99, lambda: ServiceOverloadError(
+            "full", reason="queue_full", retry_after_s=0.01))
+        gen = LoadGenerator(svc, shapes=((2, 2),))
+        stats = gen.run_closed(n_requests=3, concurrency=1)
+        assert stats["failed"] == 3
+        assert stats["retried"] == 0 and stats["abandoned"] == 0
+
+    def test_placement_error_not_retried(self):
+        svc = _ShedThenServe(99, lambda: PlacementError("empty"))
+        gen = LoadGenerator(svc, shapes=((2, 2),), max_retries=5)
+        stats = gen.run_closed(n_requests=2, concurrency=1)
+        assert stats["errors"] == {"PYC503": 2}
+        assert stats["retried"] == 0 and stats["abandoned"] == 0
+
+    def test_non_taxonomy_errors_not_retried(self):
+        svc = _ShedThenServe(99, lambda: ValueError("bad"))
+        gen = LoadGenerator(svc, shapes=((2, 2),), max_retries=5)
+        stats = gen.run_closed(n_requests=2, concurrency=1)
+        assert stats["errors"] == {"ValueError": 2}
+        assert stats["retried"] == 0
+
+    def test_open_loop_defers_retries_past_schedule(self):
+        svc = _ShedThenServe(1, lambda: ServiceOverloadError(
+            "full", reason="queue_full", retry_after_s=0.01))
+        gen = LoadGenerator(svc, shapes=((2, 2), (2, 3), (2, 4), (2, 5)),
+                            max_retries=2, retry_cap_s=0.05)
+        stats = gen.run_open(n_requests=4, rate_rps=200.0)
+        assert stats["succeeded"] == 4 and stats["failed"] == 0
+        assert stats["retried"] == 4
+        assert stats["abandoned"] == 0
+
+    def test_summary_keys_stable(self):
+        s = summarize([0.1], {"PYC401": 1}, 1.0, 2, retried=3,
+                      abandoned=1)
+        assert s["retried"] == 3 and s["abandoned"] == 1
+        assert s["succeeded"] == 1 and s["failed"] == 1
+
+
+# -- chaos: kill a worker mid-traffic --------------------------------------
+
+
+class TestKillWorkerMidTraffic:
+    def test_in_process_chaos_zero_client_visible_loss(self, tmp_path):
+        """The acceptance criterion, in-process: concurrent stateless
+        traffic + a session, one worker hard-killed mid-run. Every
+        request either resolves bit-identical to the single-box
+        reference or sheds with a PYC-coded structured error that a
+        bounded retry absorbs — zero silent drops, zero abandoned."""
+        rng = np.random.default_rng(9)
+        m = rng.choice([0.0, 1.0], size=(10, 8))
+        ref = Oracle(reports=m, backend="numpy").consensus()
+        fleet = small_fleet(tmp_path).start(warmup=False)
+        fleet.create_session("chaos", n_reporters=N_REPORTERS)
+
+        results, errors = [], []
+        lock = threading.Lock()
+        barrier = threading.Event()
+
+        def client(n):
+            for i in range(n):
+                if i == 3:
+                    barrier.set()       # mid-traffic signal
+                for attempt in range(6):
+                    try:
+                        r = fleet.submit(reports=m,
+                                         backend="numpy").result(60)
+                        with lock:
+                            results.append(r)
+                        break
+                    except Exception as exc:  # noqa: BLE001
+                        code = getattr(exc, "error_code", None)
+                        with lock:
+                            errors.append(exc)
+                        if code not in ("PYC401", "PYC501", "PYC502"):
+                            return
+                        time.sleep(float(getattr(exc, "context", {})
+                                         .get("retry_after_s", 0.05)))
+                else:
+                    pytest.fail("request abandoned after retries")
+
+        threads = [threading.Thread(target=client, args=(8,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        barrier.wait(timeout=60)
+        victim = fleet.owner_of("chaos")
+        fleet.kill_worker(victim)               # SIGKILL model, mid-run
+        for t in threads:
+            t.join(timeout=120)
+        fleet.close(drain=True)
+        assert len(results) == 32               # every request resolved
+        for r in results:
+            np.testing.assert_array_equal(
+                r["events"]["outcomes_final"],
+                ref["events"]["outcomes_final"])
+            np.testing.assert_array_equal(
+                r["agents"]["smooth_rep"], ref["agents"]["smooth_rep"])
+        for exc in errors:                      # sheds all structured
+            assert getattr(exc, "error_code", "").startswith("PYC"), exc
+        assert fleet.owner_of("chaos") != victim
+
+    def test_session_chaos_bit_identical_to_single_box(self, tmp_path):
+        """Session traffic through the kill: the client retries PYC5xx
+        sheds and the completed round sequence is bit-identical to the
+        uninterrupted single-box run."""
+        fleet = small_fleet(tmp_path).start(warmup=False)
+        fleet.create_session("s", n_reporters=N_REPORTERS)
+        got = []
+        killed = False
+        for k in range(N_ROUNDS):
+            for j in range(BLOCKS_PER_ROUND):
+                for _ in range(20):
+                    try:
+                        fleet.append("s", make_block(k, j))
+                        break
+                    except (WorkerLostError,
+                            FailoverInProgressError) as exc:
+                        time.sleep(exc.context.get("retry_after_s",
+                                                   0.05))
+                if k == 1 and j == 0 and not killed:
+                    fleet.kill_worker(fleet.owner_of("s"))
+                    killed = True
+            for _ in range(20):
+                try:
+                    got.append(fleet.submit(session="s").result(60))
+                    break
+                except (WorkerLostError,
+                        FailoverInProgressError) as exc:
+                    time.sleep(exc.context.get("retry_after_s", 0.05))
+        fleet.close(drain=True)
+        ref_session = MarketSession("ref", N_REPORTERS)
+        ref = drive(ref_session, all_ops())
+        assert len(got) == N_ROUNDS
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(
+                np.asarray(g["agents"]["smooth_rep"]),
+                np.asarray(r["smooth_rep"]))
+            np.testing.assert_array_equal(
+                np.asarray(g["events"]["outcomes_final"]),
+                np.asarray(r["outcomes_final"]))
+            assert g["iterations"] == int(np.asarray(r["iterations"]))
+
+
+class TestRealSigkill:
+    def test_kill_minus_nine_mid_session_standby_resumes_bit_identical(
+            self, tmp_path):
+        """The acceptance criterion with a REAL ``kill -9``: a worker
+        process drives a durable session; SIGKILLed mid-round, a
+        standby (this process) adopts via verify + replay and finishes
+        the rounds — final reputation and outcomes bit-identical to the
+        never-killed run, no matter which instruction the kill hit."""
+        log_root = tmp_path / "log"
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "fleet_worker.py")
+        env = worker_env()
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, script, str(log_root), "mkt", "4", "0.1"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            deadline = time.monotonic() + 180
+            seen = []
+            # kill once the worker is INSIDE round 1 (mid-traffic, a
+            # committed round behind it and a partial journal ahead)
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    pytest.fail("worker exited early:\n" + "".join(seen))
+                seen.append(line)
+                if line.startswith("APPEND 1"):
+                    break
+            else:
+                pytest.fail("worker never reached round 1:\n"
+                            + "".join(seen))
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == -signal.SIGKILL
+
+        # the standby: verify-preflight + replay, then continue with
+        # the same deterministic traffic to the same horizon
+        standby = replay_session(log_root, "mkt")
+        assert standby.ledger.round >= 1        # round 0 survived
+        got = []
+        for k in range(standby.ledger.round, 4):
+            for j in range(len(standby._blocks), BLOCKS_PER_ROUND):
+                standby.append(make_block(k, j))
+            got.append(standby.resolve())
+
+        ref_session = MarketSession("ref", N_REPORTERS)
+        ref = []
+        for k in range(4):
+            for j in range(BLOCKS_PER_ROUND):
+                ref_session.append(make_block(k, j))
+            ref.append(ref_session.resolve())
+        # every round the standby resolved matches the uninterrupted
+        # run bit-for-bit, as does the carried reputation
+        for g, r in zip(got, ref[-len(got):]):
+            assert_same_bits(g, r)
+        np.testing.assert_array_equal(
+            standby.reputation, np.asarray(ref[-1]["smooth_rep"]))
+        assert standby.ledger.round == 4

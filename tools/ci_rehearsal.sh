@@ -359,6 +359,201 @@ print("bucket_pallas smoke OK: outcomes + iterations bit-identical to "
       "cached executable count, kernel-path counter shows pallas traffic")
 PYEOF
 
+echo "=== Fleet chaos smoke (ISSUE 8: kill a worker mid-traffic, zero lost resolutions) ==="
+# The replicated-fleet acceptance criterion end to end: (1) a 3-worker
+# fleet with warmed buckets serves concurrent traffic while one worker
+# is hard-killed mid-run — every accepted request either resolves with
+# bits identical to a direct Oracle run or sheds with a PYC-coded
+# structured error a bounded retry absorbs (zero abandoned), the killed
+# worker's session resumes bit-identical on the survivor, and drain
+# completes clean; (2) a REAL `kill -9` lands on a worker process
+# mid-round and the standby adopts its session via the verify-preflight
+# + ledger replay, finishing the rounds bit-identical to the
+# never-killed run; (3) consensus-lint confirms CL601/CL701 stay green
+# over the new fleet modules. See docs/SERVING.md "Replicated fleet".
+"$PY" - <<'PYEOF'
+import tempfile, threading, time
+import numpy as np
+from pyconsensus_tpu import Oracle, obs
+from pyconsensus_tpu.serve import (ConsensusFleet, FleetConfig,
+                                   MarketSession, ServeConfig)
+from pyconsensus_tpu.serve.loadgen import RETRYABLE_CODES
+
+log_dir = tempfile.mkdtemp(prefix="ci-fleet-")
+fleet = ConsensusFleet(FleetConfig(
+    n_workers=3, log_dir=log_dir,
+    worker=ServeConfig(warmup=((16, 64),), batch_window_ms=2.0),
+    takeover_window_s=1.0)).start(warmup=True)   # warm buckets per worker
+
+rng = np.random.default_rng(8)
+matrix = rng.choice([0.0, 1.0], size=(12, 48))
+matrix[rng.random(matrix.shape) < 0.1] = np.nan
+ref = Oracle(reports=matrix, backend="jax", pca_method="power").consensus()
+
+blocks = [rng.choice([0.0, 1.0], size=(10, 6)) for _ in range(3)]
+fleet.create_session("mkt", n_reporters=10)
+fleet.append("mkt", blocks[0])
+round_results = [fleet.submit(session="mkt").result(timeout=120)]
+
+results, errors, fatal = [], [], []
+lock = threading.Lock()
+mid = threading.Event()
+
+def client(n):
+    for i in range(n):
+        if i == 3:
+            mid.set()
+        for attempt in range(6):
+            try:
+                r = fleet.submit(reports=matrix).result(120)
+                with lock:
+                    results.append(r)
+                break
+            except Exception as exc:
+                code = getattr(exc, "error_code", "")
+                with lock:
+                    errors.append(exc)
+                if code not in RETRYABLE_CODES:
+                    # the one retry policy (loadgen.RETRYABLE_CODES):
+                    # non-retryable PYC503/PYC301 regressions must fail
+                    # the smoke, not be silently retried into a pass
+                    with lock:   # surfaced on the main thread below —
+                        fatal.append(exc)   # a raise here would vanish
+                    return
+                time.sleep(float(getattr(exc, "context", {})
+                                 .get("retry_after_s", 0.05)))
+        else:
+            with lock:
+                fatal.append(AssertionError(
+                    "request abandoned after bounded retries"))
+            return
+
+threads = [threading.Thread(target=client, args=(8,)) for _ in range(5)]
+for t in threads:
+    t.start()
+mid.wait(timeout=120)
+victim = fleet.owner_of("mkt")
+info = fleet.kill_worker(victim)                # SIGKILL model, mid-traffic
+for t in threads:
+    t.join(timeout=300)
+if fatal:
+    raise SystemExit(f"client thread failed: {fatal[0]!r}")
+assert fleet.owner_of("mkt") != victim, "session did not migrate"
+
+# the killed worker's session continues on the survivor
+fleet.append("mkt", blocks[1])
+round_results.append(fleet.submit(session="mkt").result(timeout=120))
+fleet.append("mkt", blocks[2])
+round_results.append(fleet.submit(session="mkt").result(timeout=120))
+fleet.close(drain=True)                        # drain clean
+
+assert len(results) == 40, f"lost resolutions: {len(results)}/40"
+for r in results:
+    # zero corrupted bits: the serve equivalence contract
+    # (docs/SERVING.md) — catch-snapped outcomes + iteration counts
+    # bit-identical to direct Oracle; continuous tails in the
+    # documented band (f32 pipeline here: no x64 in this smoke)
+    assert np.array_equal(r["events"]["outcomes_final"],
+                          ref["events"]["outcomes_final"])
+    assert np.array_equal(r["events"]["outcomes_adjusted"],
+                          ref["events"]["outcomes_adjusted"])
+    assert r["iterations"] == ref["iterations"]
+    np.testing.assert_allclose(r["agents"]["smooth_rep"],
+                               ref["agents"]["smooth_rep"],
+                               rtol=1e-4, atol=1e-5)
+    # the fleet determinism claim: identical request -> identical BITS
+    # no matter which worker served it, before or after the kill
+    assert np.array_equal(r["agents"]["smooth_rep"],
+                          results[0]["agents"]["smooth_rep"])
+    assert np.array_equal(r["events"]["outcomes_final"],
+                          results[0]["events"]["outcomes_final"])
+ref_session = MarketSession("ref", 10)         # uninterrupted single box
+for b, got in zip(blocks, round_results):
+    ref_session.append(b)
+    want = ref_session.resolve()
+    assert np.array_equal(np.asarray(got["agents"]["smooth_rep"]),
+                          np.asarray(want["smooth_rep"]))
+    assert np.array_equal(np.asarray(got["events"]["outcomes_final"]),
+                          np.asarray(want["outcomes_final"]))
+    assert got["iterations"] == int(np.asarray(want["iterations"]))
+shed_codes = sorted({getattr(e, "error_code", "?") for e in errors})
+assert obs.value("pyconsensus_fleet_workers") == 2
+assert obs.value("pyconsensus_failovers_total") >= 1
+assert obs.value("pyconsensus_sessions_migrated_total") >= 1
+print(f"fleet chaos (1) OK: 40/40 resolutions bit-identical through the "
+      f"kill ({info['shed_queued']} queued shed as PYC501, "
+      f"{len(errors)} sheds retried, codes {shed_codes or 'none'}), "
+      f"3 session rounds bit-identical to the single-box run across the "
+      f"failover, drain clean")
+PYEOF
+"$PY" - <<'PYEOF'
+import os, signal, subprocess, sys, tempfile, time
+import numpy as np
+
+log_root = tempfile.mkdtemp(prefix="ci-fleet-kill9-")
+env = dict(os.environ)
+proc = subprocess.Popen(
+    [sys.executable, "tests/fleet_worker.py", log_root, "mkt", "4", "0.1"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+try:
+    deadline = time.monotonic() + 180
+    seen = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        assert line, "worker exited early:\n" + "".join(seen)
+        seen.append(line)
+        if line.startswith("APPEND 1"):        # inside round 1: mid-traffic
+            break
+    else:
+        raise SystemExit("worker never reached round 1")
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=30)
+finally:
+    if proc.poll() is None:
+        proc.kill()
+assert proc.returncode == -signal.SIGKILL
+
+sys.path.insert(0, "tests")
+from fleet_worker import BLOCKS_PER_ROUND, N_REPORTERS, make_block
+from pyconsensus_tpu.serve import MarketSession, ReplicationLog, replay_session
+
+# takeover preflight: the log verifies BEFORE adoption
+summary = ReplicationLog(log_root, "mkt").verify()
+standby = replay_session(log_root, "mkt")
+assert standby.ledger.round >= 1
+resumed_from = (standby.ledger.round, len(standby._blocks))
+got = []
+for k in range(standby.ledger.round, 4):
+    for j in range(len(standby._blocks), BLOCKS_PER_ROUND):
+        standby.append(make_block(k, j))
+    got.append(standby.resolve())
+
+ref_session = MarketSession("ref", N_REPORTERS)
+ref = []
+for k in range(4):
+    for j in range(BLOCKS_PER_ROUND):
+        ref_session.append(make_block(k, j))
+    ref.append(ref_session.resolve())
+for g, r in zip(got, ref[-len(got):]):
+    assert np.array_equal(np.asarray(g["smooth_rep"]),
+                          np.asarray(r["smooth_rep"]))
+    assert np.array_equal(np.asarray(g["outcomes_final"]),
+                          np.asarray(r["outcomes_final"]))
+    assert int(np.asarray(g["iterations"])) == int(np.asarray(r["iterations"]))
+np.testing.assert_array_equal(standby.reputation,
+                              np.asarray(ref[-1]["smooth_rep"]))
+print(f"fleet chaos (2) OK: real kill -9 mid-round, standby verified the "
+      f"log and resumed from round={resumed_from[0]} "
+      f"staged={resumed_from[1]}, all remaining rounds bit-identical to "
+      f"the never-killed run")
+PYEOF
+# (3) CL601/CL701 stay green over the new fleet modules (the full
+# --strict gate above already covers the package; this names the check)
+"$PY" -m pyconsensus_tpu.analysis --select CL601,CL701 \
+  pyconsensus_tpu/serve/fleet.py pyconsensus_tpu/serve/failover.py \
+  pyconsensus_tpu/serve/placement.py pyconsensus_tpu/serve/admission.py \
+  && echo "fleet chaos (3) OK: CL601/CL701 green over the fleet modules"
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
   --bench-timeout 300 | tail -1 | "$PY" -c \
