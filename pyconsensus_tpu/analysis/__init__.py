@@ -23,6 +23,17 @@ Three layers (docs/STATIC_ANALYSIS.md):
   the hand-written-collective entry points and verifies cond-branch
   collective balance, ``ppermute`` bijectivity per mesh axis, and axis
   binding under ``shard_map``.
+- **Layer 4 (host concurrency, :mod:`.concurrency`)**: an
+  interprocedural lock-order graph over the whole package with
+  attribute-resolved lock identities — cycle detection and declared
+  total-order enforcement (CL801), blocking-call and
+  replication-log-I/O detection under held locks (CL802), guarded-by
+  inference for mutable instance attributes with a ``# guarded-by:``
+  annotation convention (CL803/CL804), and fault-site catalog drift
+  (CL805). :mod:`.witness` is the runtime mirror: an instrumented-lock
+  recorder the fleet/serve tests and the CI chaos smoke run under,
+  asserting the *observed* acquisition order stays acyclic and
+  consistent with the static graph.
 
 Findings carry rule IDs, file:line and severity; a checked-in baseline
 (``baseline.json``, :mod:`.baseline`) lets the tree stay green while CI
@@ -31,6 +42,8 @@ the ``consensus-lint`` console script.
 """
 
 from .baseline import load_baseline, match_baseline, save_baseline
+from .concurrency import (CONCURRENCY_RULES, analyze_concurrency,
+                          lock_order_edges)
 from .dataflow import DATAFLOW_RULES, analyze_paths
 from .findings import Finding, fingerprints
 from .rules import RULES, lint_file, lint_paths
@@ -38,12 +51,17 @@ from .contracts import (collective_sizes, f64_ops, host_callbacks,
                         load_contracts, run_contracts)
 from .schedule import (SCHEDULE_RULES, check_schedule, extract_schedule,
                        run_schedules)
+from .witness import (LockWitness, WitnessViolation, load_witness,
+                      static_lock_graph, witnessed)
 
 __all__ = [
     "Finding", "fingerprints", "RULES", "lint_file", "lint_paths",
     "DATAFLOW_RULES", "analyze_paths",
     "SCHEDULE_RULES", "check_schedule", "extract_schedule",
     "run_schedules",
+    "CONCURRENCY_RULES", "analyze_concurrency", "lock_order_edges",
+    "LockWitness", "WitnessViolation", "load_witness",
+    "static_lock_graph", "witnessed",
     "collective_sizes", "f64_ops", "host_callbacks", "load_contracts",
     "run_contracts", "load_baseline", "save_baseline", "match_baseline",
 ]

@@ -24,6 +24,7 @@ from typing import List, Optional
 
 from .baseline import (default_baseline_path, load_baseline, match_baseline,
                        save_baseline)
+from .concurrency import CONCURRENCY_RULES
 from .dataflow import DATAFLOW_RULES
 from .findings import Finding, fingerprints
 from .rules import RULES, lint_paths
@@ -53,6 +54,10 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-dataflow", action="store_true",
                     help="skip the Layer 3a interprocedural "
                          "host-divergence taint analysis")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the Layer 4 host-concurrency analysis "
+                         "(lock-order cycles, blocking-under-lock, "
+                         "guarded-by inference, fault-site drift)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default: "
                          f"{default_baseline_path()})")
@@ -85,6 +90,9 @@ def _list_rules() -> str:
     lines.append("Layer 3b (collective schedules):")
     for rid, (sev, desc) in sorted(SCHEDULE_RULES.items()):
         lines.append(f"  {rid} [{sev:7s}] {desc}")
+    lines.append("Layer 4 (host concurrency):")
+    for rid, (sev, desc) in sorted(CONCURRENCY_RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
     return "\n".join(lines)
 
 
@@ -107,6 +115,15 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
         from .dataflow import analyze_paths
 
         findings.extend(analyze_paths(args.paths or None, select=select))
+
+    # Layer 4 mirrors Layer 3a: its lock/call-graph fixpoint only runs
+    # when at least one CL80x rule is in scope
+    if not args.no_concurrency and (select is None
+                                    or select & CONCURRENCY_RULES.keys()):
+        from .concurrency import analyze_concurrency
+
+        findings.extend(analyze_concurrency(args.paths or None,
+                                            select=select))
 
     run_contracts_layer = (args.strict or args.contracts
                            or args.contract) and not args.no_contracts
@@ -143,6 +160,8 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
                 return not run_schedules_layer
             if entry["rule"] in DATAFLOW_RULES and args.no_dataflow:
                 return True
+            if entry["rule"] in CONCURRENCY_RULES and args.no_concurrency:
+                return True
             if entry["path"] not in scanned:
                 return True
             return bool(select) and entry["rule"] not in select
@@ -175,6 +194,8 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
             if e["path"].startswith("schedule:"):
                 return run_schedules_layer
             if e["rule"] in DATAFLOW_RULES and args.no_dataflow:
+                return False
+            if e["rule"] in CONCURRENCY_RULES and args.no_concurrency:
                 return False
             return e["path"] in scanned and (
                 not select or e["rule"] in select)

@@ -187,7 +187,11 @@ class _Aliases:
 
 def _line_directives(text: str) -> Dict[int, Set[str]]:
     """{lineno: set of suppressed rule IDs} ('*' = all) from
-    ``# consensus-lint: disable=...`` / ``# noqa`` comments."""
+    ``# consensus-lint: disable=...`` / ``# noqa`` comments. Each
+    comma-separated piece contributes its first whitespace token, so a
+    suppression can carry its written rationale in the same comment:
+    ``# consensus-lint: disable=CL802 — the journal write must commit
+    under the session lock``."""
     out: Dict[int, Set[str]] = {}
     for i, line in enumerate(text.splitlines(), 1):
         if "#" not in line:
@@ -195,7 +199,7 @@ def _line_directives(text: str) -> Dict[int, Set[str]]:
         comment = line[line.index("#"):]
         if "consensus-lint:" in comment and "disable=" in comment:
             ids = comment.split("disable=", 1)[1]
-            out[i] = {s.strip() for s in ids.replace(";", ",").split(",")
+            out[i] = {s.split()[0] for s in ids.replace(";", ",").split(",")
                       if s.strip()}
         elif "# noqa" in comment:
             out[i] = {"*"}

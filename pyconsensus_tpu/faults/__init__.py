@@ -40,12 +40,12 @@ from .errors import (ERROR_CODES, CheckpointCorruptionError, ConsensusError,
                      ConvergenceError, FailoverInProgressError, InputError,
                      NumericsError, PlacementError, ServiceOverloadError,
                      WorkerLostError)
-from .plan import (FaultPlan, FaultRule, SimulatedCrash, active_plan, arm,
-                   armed, corrupt, disarm, fire)
+from .plan import (FAULT_SITES, FaultPlan, FaultRule, SimulatedCrash,
+                   active_plan, arm, armed, corrupt, disarm, fire)
 from .retry import retry, retry_call
 
 __all__ = [
-    "FaultPlan", "FaultRule", "SimulatedCrash",
+    "FAULT_SITES", "FaultPlan", "FaultRule", "SimulatedCrash",
     "arm", "disarm", "armed", "active_plan", "fire", "corrupt",
     "ConsensusError", "InputError", "NumericsError", "ConvergenceError",
     "CheckpointCorruptionError", "ServiceOverloadError",

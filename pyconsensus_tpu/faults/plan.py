@@ -46,8 +46,28 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["FaultRule", "FaultPlan", "SimulatedCrash", "arm", "disarm",
-           "armed", "active_plan", "fire", "corrupt"]
+__all__ = ["FAULT_SITES", "FaultRule", "FaultPlan", "SimulatedCrash",
+           "arm", "disarm", "armed", "active_plan", "fire", "corrupt"]
+
+#: The catalog of named injection sites — every :func:`fire` /
+#: :func:`corrupt` hook call in the package names exactly one of these,
+#: and every entry here is reached by at least one hook call.
+#: consensus-lint CL805 enforces both directions against the source, and
+#: tests/test_concurrency.py pins docs/ROBUSTNESS.md's site table to
+#: this tuple, so plan files, code, and docs cannot drift apart.
+FAULT_SITES = (
+    "io.read", "io.decode", "io.write", "io.stage",
+    "ledger.save", "ledger.load",
+    "sweep.chunk.data", "sweep.chunk.write",
+    "sweep.chunk.pre_commit", "sweep.chunk.post_commit",
+    "streaming.panel", "sharded.reports",
+    "oracle.reports", "oracle.raw_result",
+    "serve.enqueue", "serve.dispatch", "serve.cache_store",
+    "serve.session_append",
+    "tune.cache_write",
+    "fleet.route", "fleet.heartbeat", "fleet.takeover",
+    "fleet.ledger_replay",
+)
 
 
 class SimulatedCrash(BaseException):

@@ -84,9 +84,12 @@ class ReputationLedger:
             if total <= 0:
                 raise ValueError("reputation must have positive mass")
             rep = rep / total
-        self.reputation = rep
+        # Owner-confined, deliberately lock-free: a ledger is either
+        # used single-threaded (sweep/CLI) or owned by exactly one
+        # MarketSession, whose _lock serializes every resolve/record.
+        self.reputation = rep          # guarded-by: none
         self.oracle_kwargs = dict(oracle_kwargs)
-        self.round = 0
+        self.round = 0                 # guarded-by: none
         #: per-round scalars: certainty / participation / convergence
         self.history: list[dict] = []
 

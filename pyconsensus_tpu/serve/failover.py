@@ -347,7 +347,11 @@ class DurableSession(MarketSession):
             # and the fold see (the base _admit is a no-op on this
             # class), so a standby replays the acknowledged bytes
             block = MarketSession._admit(self, block)
-            path = self._log.journal_block(self.ledger.round,
+            # the journal write deliberately commits UNDER the session
+            # lock: an append is acknowledged iff its record is durable,
+            # and the fence check + fold + journal must be atomic
+            # against a racing takeover (the PR-8 contract)
+            path = self._log.journal_block(self.ledger.round,  # consensus-lint: disable=CL802 — ack-iff-durable needs the journal write inside the critical section
                                            len(self._blocks), block,
                                            event_bounds)
             try:
@@ -381,7 +385,10 @@ class DurableSession(MarketSession):
             # into the ledger; persisting it closes the round durably
             # and garbage-collects the round's journal
             try:
-                self._log.commit_round(self.ledger)
+                # the commit too stays under the lock: releasing between
+                # resolve and commit would let an append journal under a
+                # round index the commit then garbage-collects
+                self._log.commit_round(self.ledger)  # consensus-lint: disable=CL802 — round close must be atomic with the in-memory resolve
             except BaseException as exc:
                 # the round resolved in MEMORY but its commit never
                 # landed: this object is now one round ahead of its
@@ -416,10 +423,15 @@ def replay_session(log_root, name: str) -> DurableSession:
     log between death and adoption — the verify preflight then refuses
     with PYC301, which is the correct behavior the chaos suite pins."""
     log = ReplicationLog(log_root, name)
-    _faults.fire("fleet.ledger_replay",
+    # both the injection seam and the verify+read run under the caller's
+    # declare lock BY DESIGN: the single-claim _migrating fence exists
+    # precisely so one standby reads, verifies, and adopts the log with
+    # no second takeover interleaved — moving the I/O outside the lock
+    # is the double-takeover race PR 8 closed
+    _faults.fire("fleet.ledger_replay",  # consensus-lint: disable=CL802 — torn-log injection must land inside the takeover window it tests
                  path=log.ledger_path if log.ledger_path.exists()
                  else None)
-    summary, staged, state = log.verify_collect()
+    summary, staged, state = log.verify_collect()  # consensus-lint: disable=CL802 — exactly-one-takeover: the log is read once, under the claim
     if state is not None:       # the preflight's validated read — the
         ledger = ReputationLedger._from_state(  # checkpoint is opened
             state, source=log.ledger_path)      # once per takeover

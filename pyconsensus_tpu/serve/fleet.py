@@ -63,6 +63,15 @@ from .service import ConsensusService, ServeConfig
 
 __all__ = ["FleetConfig", "FleetWorker", "ConsensusFleet"]
 
+# The fleet's intended lock hierarchy, declared for consensus-lint
+# CL801 (any acquisition contradicting an order below is flagged even
+# without a full cycle) and mirrored at runtime by the lock witness:
+# a worker's declare lock is always outermost — the takeover path holds
+# it across fleet-state, ring, and capacity updates.
+# consensus-lint: lock-order FleetWorker.declare_lock < ConsensusFleet._lock
+# consensus-lint: lock-order ConsensusFleet._lock < HashRing._lock
+# consensus-lint: lock-order ConsensusFleet._lock < ClusterCapacity._lock
+
 
 @dataclass(frozen=True)
 class FleetConfig:
@@ -108,8 +117,13 @@ class FleetWorker:
     def __init__(self, name: str, config: ServeConfig) -> None:
         self.name = str(name)
         self.service = ConsensusService(config)
-        self.alive = True
-        self.last_heartbeat = time.monotonic()
+        # Racy reads are this codebase's documented idiom for monotonic
+        # liveness state: `alive` only ever transitions True -> False
+        # (the transition itself is serialized by declare_lock's
+        # single-claim takeover), and a stale `last_heartbeat` read can
+        # only DELAY a staleness declaration by one scan.
+        self.alive = True                       # guarded-by: none
+        self.last_heartbeat = time.monotonic()  # guarded-by: none
         #: serializes concurrent death declarations for THIS worker
         #: (kill_worker vs routing-time discovery vs monitor scan) —
         #: exactly one takeover runs; the losers observe its result
@@ -181,11 +195,11 @@ class ConsensusFleet:
         for name, w in self.workers.items():
             self.capacity.register(name, w.service.config.max_queue)
         #: session name -> owning worker name (None while failed)
-        self._sessions: dict = {}
+        self._sessions: dict = {}           # guarded-by: _lock
         #: sessions currently replaying onto their standby (fenced)
-        self._migrating: set = set()
+        self._migrating: set = set()        # guarded-by: _lock
         #: session name -> CheckpointCorruptionError (refused takeovers)
-        self._failed_sessions: dict = {}
+        self._failed_sessions: dict = {}    # guarded-by: _lock
         self._lock = threading.RLock()
         self._seq = 0
         self._monitor: Optional[threading.Thread] = None

@@ -47,6 +47,35 @@ def _bound_xla_map_regions():
     jax.clear_caches()
 
 
+@pytest.fixture(scope="session")
+def _static_lock_graph():
+    """The static may-hold-before graph, computed once per session —
+    the reference the runtime lock witness validates against."""
+    from pyconsensus_tpu.analysis.witness import static_lock_graph
+
+    return static_lock_graph()
+
+
+@pytest.fixture
+def lock_witness(_static_lock_graph, tmp_path):
+    """Run a test under the runtime lock witness (ISSUE 9): package
+    locks constructed during the test are instrumented, and at teardown
+    the OBSERVED acquisition order must be acyclic and consistent with
+    the static lock-order graph (the dynamic mirror of CL801). On
+    violation the witness JSON lands in the test's tmp_path. The
+    lock-dense suites (test_fleet.py, test_serve.py) opt in wholesale
+    via a module-level autouse fixture."""
+    from pyconsensus_tpu.analysis.witness import LockWitness
+
+    w = LockWitness().install()
+    try:
+        yield w
+    finally:
+        w.uninstall()
+    w.check(static=_static_lock_graph,
+            dump_path=tmp_path / "lock_witness.json")
+
+
 def free_port() -> int:
     """An OS-assigned free TCP port for multi-process coordinator tests."""
     import socket

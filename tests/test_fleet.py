@@ -38,6 +38,15 @@ from pyconsensus_tpu.serve.loadgen import (RETRYABLE_CODES, LoadGenerator,
 from pyconsensus_tpu.serve.queue import ResolveRequest
 
 
+@pytest.fixture(autouse=True)
+def _under_lock_witness(lock_witness):
+    """Every fleet test runs under the runtime lock witness (ISSUE 9):
+    the observed acquisition order across router/heartbeat/takeover/
+    session locks must stay acyclic and consistent with the static
+    CL801 graph, or the test fails with the witness JSON dumped."""
+    yield
+
+
 def small_fleet(tmp_path, n=3, **cfg_kwargs):
     cfg = FleetConfig(
         n_workers=n, log_dir=str(tmp_path / "log"),

@@ -29,6 +29,15 @@ from pyconsensus_tpu.serve import (BucketKey, ConsensusService,
 #: outcomes are bitwise across compiled graphs; measured <= 3e-10)
 SERVE_ATOL = 1e-9
 
+
+@pytest.fixture(autouse=True)
+def _under_lock_witness(lock_witness):
+    """Every serve test runs under the runtime lock witness (ISSUE 9):
+    batcher/queue/cache/admission/session lock acquisitions are
+    recorded and the observed order checked against the static CL801
+    graph at teardown."""
+    yield
+
 #: result-field accessors compared against direct Oracle resolutions
 _EXACT_KEYS = (("events", "outcomes_final"), ("events", "outcomes_adjusted"))
 _BAND_KEYS = (("agents", "smooth_rep"), ("agents", "this_rep"),

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Metric-name drift check: code vs docs/OBSERVABILITY.md (ISSUE 9).
+
+Every metric the package emits through the obs registry
+(``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` with a literal
+``pyconsensus_*`` name) must have a row in docs/OBSERVABILITY.md's
+catalog tables, and every cataloged row must correspond to a metric the
+code can actually emit. PRs 3-8 each grew both sides by hand; this
+script is what CI trusts instead (tools/ci_rehearsal.sh runs it, and
+tests/test_concurrency.py pins the live tree clean).
+
+Zero dependencies; importable — :func:`check` returns the drift lists
+so the test suite can assert on them directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PACKAGE = REPO / "pyconsensus_tpu"
+CATALOG = REPO / "docs" / "OBSERVABILITY.md"
+
+#: obs registration entry points whose first literal argument names a
+#: metric (module functions and Registry methods share these names)
+_REGISTER_CALLS = {"counter", "gauge", "histogram"}
+
+#: full backticked metric names inside a catalog table row — a row may
+#: catalog several related metrics in one cell (``...hits_total`` /
+#: ``...misses_total``), but each must be spelled out in full: the
+#: whole point is that a grep for the emitted name finds its row
+_NAME_RE = re.compile(r"`(pyconsensus_\w+)`")
+
+
+def collect_emitted(package: pathlib.Path = PACKAGE
+                    ) -> Dict[str, List[str]]:
+    """{metric name: [registration sites]} for every literal
+    ``pyconsensus_*`` name passed to a counter/gauge/histogram call
+    anywhere in the package source."""
+    out: Dict[str, List[str]] = {}
+    for path in sorted(package.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"),
+                             filename=str(path))
+        except SyntaxError:
+            continue
+        try:
+            rel = path.relative_to(REPO).as_posix()
+        except ValueError:
+            rel = path.name
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name not in _REGISTER_CALLS or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                    and arg.value.startswith("pyconsensus_"):
+                out.setdefault(arg.value, []).append(f"{rel}:{node.lineno}")
+    return out
+
+
+def collect_documented(catalog: pathlib.Path = CATALOG) -> Set[str]:
+    """Metric names appearing (backticked, in full) in catalog table
+    rows of docs/OBSERVABILITY.md."""
+    names: Set[str] = set()
+    for line in catalog.read_text(encoding="utf-8").splitlines():
+        if line.strip().startswith("|"):
+            names.update(_NAME_RE.findall(line))
+    return names
+
+
+def check() -> Tuple[List[str], List[str], Dict[str, List[str]]]:
+    """(undocumented, unemitted, emitted-sites). Empty lists = green."""
+    emitted = collect_emitted()
+    documented = collect_documented()
+    undocumented = sorted(set(emitted) - documented)
+    unemitted = sorted(documented - set(emitted))
+    return undocumented, unemitted, emitted
+
+
+def main() -> int:
+    undocumented, unemitted, emitted = check()
+    for name in undocumented:
+        print(f"DRIFT: metric {name!r} is registered at "
+              f"{', '.join(emitted[name])} but has no row in "
+              f"{CATALOG.relative_to(REPO)}")
+    for name in unemitted:
+        print(f"DRIFT: {CATALOG.relative_to(REPO)} catalogs {name!r} "
+              f"but no obs registration in the package emits it")
+    if undocumented or unemitted:
+        return 1
+    print(f"metric docs in sync: {len(emitted)} emitted metric(s) all "
+          f"cataloged, no dead catalog rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

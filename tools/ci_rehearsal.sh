@@ -39,12 +39,68 @@ echo "=== Lint (consensus-lint: AST rules + contracts + deadlock pass) ==="
 # Layer 1 (JAX/TPU AST rules) + Layer 3a (interprocedural host-
 # divergence taint, CL401-404) over the package, Layer 2 (collective
 # inventory / f64 / host-callback / retrace contracts, compiled on the
-# 8-virtual-device CPU mesh) and Layer 3b (collective-schedule deadlock
-# detection over the ring/fused/pipeline jaxprs, CL410-413). Fails on
-# any non-baselined finding or stale baseline entry; see
+# 8-virtual-device CPU mesh), Layer 3b (collective-schedule deadlock
+# detection over the ring/fused/pipeline jaxprs, CL410-413), and
+# Layer 4 (host-concurrency: lock-order cycles, blocking-under-lock,
+# guarded-by inference, fault-site drift, CL801-805). Fails on any
+# non-baselined finding or stale baseline entry; see
 # docs/STATIC_ANALYSIS.md.
 "$PY" -m pyconsensus_tpu.analysis --strict
 "$VENV/bin/consensus-lint" --list-rules >/dev/null && echo "console script consensus-lint OK"
+
+echo "=== Layer 4 seeded violations (ISSUE 9: each must exit 1) ==="
+# The gate above proves the PACKAGE clean; these prove the rules can
+# still see. A lock-order inversion and an unbounded blocking wait
+# under a lock are planted in throwaway files — consensus-lint must
+# fail each one, or the layer has gone blind.
+L4DIR=$(mktemp -d /tmp/ci-l4-seed-XXXX)
+cat > "$L4DIR/inversion.py" <<'SEED'
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def alpha(self, journal):
+        with self._lock:
+            with journal._jlock:
+                pass
+
+
+class Journal:
+    def __init__(self):
+        self._jlock = threading.Lock()
+
+    def beta(self, store):
+        with self._jlock:
+            with store._lock:
+                pass
+SEED
+cat > "$L4DIR/blocking.py" <<'SEED'
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self, fut):
+        with self._lock:
+            return fut.result()
+SEED
+if "$PY" -m pyconsensus_tpu.analysis --select CL801 --no-baseline "$L4DIR/inversion.py" >/dev/null; then
+  echo "seeded lock inversion NOT detected"; exit 1
+fi
+echo "seeded lock-order inversion -> exit 1 (CL801) OK"
+if "$PY" -m pyconsensus_tpu.analysis --select CL802 --no-baseline "$L4DIR/blocking.py" >/dev/null; then
+  echo "seeded blocking-under-lock NOT detected"; exit 1
+fi
+echo "seeded blocking-under-lock -> exit 1 (CL802) OK"
+rm -rf "$L4DIR"
+
+echo "=== Metric-name drift (code vs docs/OBSERVABILITY.md) ==="
+"$PY" tools/check_metric_docs.py
 
 echo "=== Test suite (8-virtual-device CPU mesh) ==="
 "$PY" -m pytest tests/ -q --durations=15
@@ -371,9 +427,19 @@ echo "=== Fleet chaos smoke (ISSUE 8: kill a worker mid-traffic, zero lost resol
 # + ledger replay, finishing the rounds bit-identical to the
 # never-killed run; (3) consensus-lint confirms CL601/CL701 stay green
 # over the new fleet modules. See docs/SERVING.md "Replicated fleet".
+# The whole in-process stage runs under the RUNTIME LOCK WITNESS
+# (ISSUE 9): every package lock acquisition is recorded, and the
+# observed order must come out acyclic and consistent with the static
+# CL801 may-hold-before graph, or this stage fails with the witness
+# JSON dumped to /tmp/ci-fleet-witness.json.
 "$PY" - <<'PYEOF'
 import tempfile, threading, time
 import numpy as np
+from pyconsensus_tpu.analysis.witness import LockWitness, static_lock_graph
+
+_static = static_lock_graph()
+_witness = LockWitness().install()
+
 from pyconsensus_tpu import Oracle, obs
 from pyconsensus_tpu.serve import (ConsensusFleet, FleetConfig,
                                    MarketSession, ServeConfig)
@@ -485,6 +551,14 @@ print(f"fleet chaos (1) OK: 40/40 resolutions bit-identical through the "
       f"{len(errors)} sheds retried, codes {shed_codes or 'none'}), "
       f"3 session rounds bit-identical to the single-box run across the "
       f"failover, drain clean")
+
+_witness.uninstall()
+rep = _witness.check(static=_static,
+                     dump_path="/tmp/ci-fleet-witness.json")
+print(f"lock witness OK: {len(rep['edges'])} observed acquisition "
+      f"edge(s) over {len(rep['locks'])} lock site(s) — acyclic and "
+      f"consistent with the static CL801 graph "
+      f"({len(_static['edges'])} static edges)")
 PYEOF
 "$PY" - <<'PYEOF'
 import os, signal, subprocess, sys, tempfile, time
@@ -547,12 +621,13 @@ print(f"fleet chaos (2) OK: real kill -9 mid-round, standby verified the "
       f"staged={resumed_from[1]}, all remaining rounds bit-identical to "
       f"the never-killed run")
 PYEOF
-# (3) CL601/CL701 stay green over the new fleet modules (the full
-# --strict gate above already covers the package; this names the check)
-"$PY" -m pyconsensus_tpu.analysis --select CL601,CL701 \
+# (3) CL601/CL701 + the Layer-4 lock rules stay green over the fleet
+# modules (the full --strict gate above already covers the package;
+# this names the check)
+"$PY" -m pyconsensus_tpu.analysis --select CL601,CL701,CL801,CL802 \
   pyconsensus_tpu/serve/fleet.py pyconsensus_tpu/serve/failover.py \
   pyconsensus_tpu/serve/placement.py pyconsensus_tpu/serve/admission.py \
-  && echo "fleet chaos (3) OK: CL601/CL701 green over the fleet modules"
+  && echo "fleet chaos (3) OK: CL601/CL701/CL801/CL802 green over the fleet modules"
 
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
