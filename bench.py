@@ -171,6 +171,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--serve-requests", type=int, default=48)
     ap.add_argument("--serve-concurrency", type=int, default=8)
     ap.add_argument("--serve-seed", type=int, default=0)
+    ap.add_argument("--no-cold-start", action="store_true",
+                    help="skip the fail-soft cold-start probe (fresh "
+                         "subprocess time-to-first-resolution with vs "
+                         "without a persisted AOT executable cache, "
+                         "appended to the JSON as 'cold_start')")
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fail-soft fleet chaos probe (worker "
                          "kill mid-traffic + session failover, appended "
@@ -395,6 +400,7 @@ def run_bench(args) -> None:
                                                        value)
     out_json["latency"] = _latency_block(args)
     out_json["serve"] = _serve_block(args)
+    out_json["cold_start"] = _cold_start_block(args)
     out_json["fleet"] = _fleet_block(args)
     print(json.dumps(out_json))
 
@@ -624,6 +630,92 @@ def _serve_block(args):
         print(f"WARNING: serve block unavailable: "
               f"{type(exc).__name__}: {exc}", file=sys.stderr)
         return None
+
+
+#: the cold-start probe child: a FRESH interpreter (the whole point is
+#: paying — or not paying — the import+trace+compile cost from nothing)
+#: that warms one serve bucket, serves one resolution, and reports
+#: time-to-first-resolution plus the retrace/AOT counters. The AOT cache
+#: dir arrives via PYC_COLD_AOT_DIR ("" disables persistence).
+_COLD_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+cfg = ServeConfig(warmup=((16, 64),), sharded_buckets=False,
+                  pallas_buckets=False,
+                  aot_cache_dir=os.environ.get("PYC_COLD_AOT_DIR") or None)
+svc = ConsensusService(cfg)
+t0 = time.perf_counter()
+svc.warm_buckets()
+svc.start(warmup=False)
+rng = np.random.default_rng(0)
+m = rng.choice([0.0, 1.0, np.nan], size=(12, 48), p=[0.45, 0.45, 0.1])
+svc.submit(reports=m).result(300)
+ttfr = time.perf_counter() - t0
+svc.close(drain=True)
+print(json.dumps({
+    "ttfr_s": round(ttfr, 4),
+    "retraces": obs.value("pyconsensus_jit_retraces_total",
+                          entry="serve_bucket") or 0,
+    "retraces_aot": obs.value("pyconsensus_jit_retraces_total",
+                              entry="serve_bucket_aot") or 0,
+    "aot_loaded": obs.value("pyconsensus_aot_load_total",
+                            outcome="loaded") or 0,
+    "aot_persisted": obs.value("pyconsensus_aot_persist_total",
+                               outcome="written") or 0,
+}))
+"""
+
+
+def _cold_start_block(args):
+    """ISSUE 10 satellite: what a process restart actually costs — a
+    fresh subprocess warms one bucket and serves one resolution, once
+    against an empty AOT cache directory (full retrace+compile, and the
+    run that populates the cache) and once against the populated one
+    (adopt-from-disk). The ``with``-cache rung must show
+    ``retraces == 0``: zero pipeline retraces after restart is the
+    zero-cold-start acceptance bar the CI kill-and-restart stage pins.
+    FAIL-SOFT like the serve block: any failure is a stderr WARNING and
+    a null block."""
+    if args.no_cold_start:
+        return None
+    import shutil
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="pyc-coldstart-")
+    try:
+        env = dict(os.environ)
+        env["PYC_COLD_AOT_DIR"] = tmpdir
+
+        def rung():
+            out = subprocess.run([sys.executable, "-c", _COLD_CHILD],
+                                 env=env, capture_output=True, text=True,
+                                 timeout=600)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"cold-start child rc={out.returncode}: "
+                    f"{out.stderr[-400:]}")
+            return json.loads(out.stdout.strip().splitlines()[-1])
+
+        cold = rung()        # empty cache: compiles, then persists
+        warm = rung()        # populated cache: adopts from disk
+        block = {"bucket": "16x64", "cold": cold, "aot_warm": warm}
+        if warm["ttfr_s"] > 0:
+            block["ttfr_speedup"] = round(cold["ttfr_s"] / warm["ttfr_s"],
+                                          3)
+        if warm["retraces"] != 0:
+            print(f"WARNING: cold-start probe: aot-warm rung shows "
+                  f"{warm['retraces']} pipeline retrace(s), expected 0",
+                  file=sys.stderr)
+        return block
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: cold-start block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _fleet_block(args):

@@ -36,7 +36,8 @@ from __future__ import annotations
 
 from .degrade import (POWER_METHODS, fallback_steps, quarantine_nonfinite,
                       raise_exhausted, record_fallback, result_nonfinite)
-from .errors import (ERROR_CODES, CheckpointCorruptionError, ConsensusError,
+from .errors import (ERROR_CODES, AotCacheCorruptionError,
+                     CheckpointCorruptionError, ConsensusError,
                      ConvergenceError, FailoverInProgressError, InputError,
                      NumericsError, PlacementError, ServiceOverloadError,
                      WorkerLostError)
@@ -48,7 +49,8 @@ __all__ = [
     "FAULT_SITES", "FaultPlan", "FaultRule", "SimulatedCrash",
     "arm", "disarm", "armed", "active_plan", "fire", "corrupt",
     "ConsensusError", "InputError", "NumericsError", "ConvergenceError",
-    "CheckpointCorruptionError", "ServiceOverloadError",
+    "CheckpointCorruptionError", "AotCacheCorruptionError",
+    "ServiceOverloadError",
     "WorkerLostError", "FailoverInProgressError", "PlacementError",
     "ERROR_CODES",
     "retry", "retry_call",

@@ -45,8 +45,9 @@ from __future__ import annotations
 
 __all__ = ["ConsensusError", "InputError", "NumericsError",
            "ConvergenceError", "CheckpointCorruptionError",
-           "ServiceOverloadError", "WorkerLostError",
-           "FailoverInProgressError", "PlacementError", "ERROR_CODES"]
+           "AotCacheCorruptionError", "ServiceOverloadError",
+           "WorkerLostError", "FailoverInProgressError",
+           "PlacementError", "ERROR_CODES"]
 
 
 class ConsensusError(Exception):
@@ -96,6 +97,22 @@ class CheckpointCorruptionError(ConsensusError, ValueError):
     ``CheckpointedSweep`` recomputes, ``ReputationLedger.load`` raises."""
 
     error_code = "PYC301"
+
+
+class AotCacheCorruptionError(CheckpointCorruptionError):
+    """A persisted AOT bucket executable failed verify-before-adopt
+    (``serve.aotcache``, ISSUE 10): torn/truncated file, payload digest
+    mismatch, or a compatibility-fingerprint miss (different jaxlib/XLA
+    version, device generation, topology, or BucketKey). The entry is
+    REFUSED and deleted — deserializing it could install an executable
+    compiled for different hardware or a different toolchain — and the
+    bucket transparently recompiles. ``context`` carries the machine
+    fields (``reason``, ``path``, expected vs found); the message names
+    the refusing check. A corruption subclass of PYC301 rather than a
+    new family: the recovery semantics (never adopt, rebuild from
+    source of truth) are the checkpoint discipline's."""
+
+    error_code = "PYC302"
 
 
 class ServiceOverloadError(ConsensusError, RuntimeError):
@@ -152,6 +169,6 @@ ERROR_CODES = {
     cls.error_code: cls
     for cls in (ConsensusError, InputError, NumericsError,
                 ConvergenceError, CheckpointCorruptionError,
-                ServiceOverloadError, WorkerLostError,
-                FailoverInProgressError, PlacementError)
+                AotCacheCorruptionError, ServiceOverloadError,
+                WorkerLostError, FailoverInProgressError, PlacementError)
 }

@@ -64,6 +64,7 @@ FAULT_SITES = (
     "oracle.reports", "oracle.raw_result",
     "serve.enqueue", "serve.dispatch", "serve.cache_store",
     "serve.session_append",
+    "aot.cache_write", "aot.cache_load",
     "tune.cache_write",
     "fleet.route", "fleet.heartbeat", "fleet.takeover",
     "fleet.ledger_replay",

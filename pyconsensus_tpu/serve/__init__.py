@@ -33,10 +33,12 @@ Guarantees (docs/SERVING.md):
 
 from __future__ import annotations
 
-from ..faults import (FailoverInProgressError, PlacementError,
-                      ServiceOverloadError, WorkerLostError)
+from ..faults import (AotCacheCorruptionError, FailoverInProgressError,
+                      PlacementError, ServiceOverloadError,
+                      WorkerLostError)
 from .admission import ClusterCapacity
-from .cache import BucketKey, ExecutableCache
+from .aotcache import AOT_ENTRY, AotCache, AotExecutable
+from .cache import BucketKey, ExecutableCache, warm_inputs
 from .failover import DurableSession, ReplicationLog, replay_session
 from .fleet import ConsensusFleet, FleetConfig, FleetWorker
 from .kernels import (SERVE_ALGORITHMS, bucket_inputs, bucket_path_eligible,
@@ -67,4 +69,6 @@ __all__ = [
     "ClusterCapacity", "DurableSession", "ReplicationLog",
     "replay_session", "WorkerLostError", "FailoverInProgressError",
     "PlacementError",
+    "AotCache", "AotExecutable", "AOT_ENTRY", "AotCacheCorruptionError",
+    "warm_inputs",
 ]

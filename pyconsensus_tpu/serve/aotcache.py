@@ -1,0 +1,412 @@
+"""Disk-persisted AOT bucket executables — zero-cold-start serving
+(ISSUE 10 tentpole).
+
+At fleet scale the service autoscales and restarts constantly, and
+before this module every fresh process paid full retrace + compile for
+each warmed bucket — a latency outage exactly when the fleet is least
+able to absorb one (a takeover window, a rollout). This module closes
+the gap left by PR 4 (crash/resume bit-identical) and PR 8 (worker
+death fails over with zero lost resolutions): a *recovered* process no
+longer serves cold.
+
+Mechanism — ``jax.export`` AOT serialization:
+
+- **persist** (:meth:`AotCache.persist`): a freshly warmed bucket
+  executable is AOT-lowered (``jax.export.export`` over the same jit
+  the cache compiled, at the exact warm-input avals) and its serialized
+  StableHLO module written through ``io.atomic_write`` (fsynced tmp +
+  rename — a crash never leaves a torn file under the final name).
+- **load** (:meth:`AotCache.adopt`): on boot (or inside a fleet
+  takeover window) ``ExecutableCache.warm`` consults the disk first. A
+  valid entry deserializes into a thin jit wrapper with **zero
+  retraces of the consensus pipeline** — the expensive Python
+  trace/lowering never runs, so
+  ``pyconsensus_jit_retraces_total{entry="serve_bucket*"}`` stays at 0
+  after a restart (the CI kill-and-restart stage pins exactly that).
+  The wrapper's own backend compile of the pre-lowered module is
+  instrumented separately under ``entry="serve_bucket_aot"``.
+
+Verify-before-adopt (the ``ReputationLedger.verify()`` /
+``ReplicationLog.verify_collect()`` discipline): every entry is keyed
+by a FULL compatibility fingerprint — all six ``BucketKey`` dimensions
+(rows, events, batch capacity, resolved static params, mesh-topology,
+kernel path) plus the runtime half from
+``tune.fingerprint.runtime_fingerprint`` (jax/jaxlib versions, backend
+platform, device generation, visible-device count, x64 flag) — and a
+SHA-256 content digest over the serialized module. A torn, truncated,
+digest-mismatched, or fingerprint-incompatible file is **refused with a
+structured** :class:`~pyconsensus_tpu.faults.AotCacheCorruptionError`
+(PYC302) **naming the reason, deleted, and transparently recompiled** —
+never deserialized into a wrong-hardware or wrong-toolchain executable.
+
+Parity contract (pinned by tests/test_aotcache.py on real traffic
+through the live service): an adopted executable runs the byte-identical
+StableHLO module the fresh compile lowered, compiled by the same XLA —
+outcomes, iteration counts, and every continuous tail are BIT-IDENTICAL
+to the freshly-compiled executable's.
+
+File format (one file per entry, ``<fingerprint-digest>.aotx``)::
+
+    MAGIC b"PYCAOT1\\n"
+    8-byte big-endian header length
+    header JSON  {format, fingerprint, payload_sha256, payload_bytes, entry}
+    payload      jax.export serialization of the executable
+
+Fault sites ``aot.cache_write`` / ``aot.cache_load`` (CL805-cataloged)
+let a seeded :class:`~pyconsensus_tpu.faults.FaultPlan` tear the file at
+either end of its life; persist failures are fail-soft (serving never
+depends on the disk cache existing), load failures are the refuse path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import struct
+import sys
+
+from .. import io as pio
+from .. import obs
+from ..faults import AotCacheCorruptionError
+from ..faults import plan as _faults
+from ..tune.fingerprint import runtime_fingerprint
+from .sharded import SINGLE_TOPOLOGY
+
+__all__ = ["AotCache", "AotExecutable", "AOT_ENTRY", "AOT_MAGIC",
+           "key_fingerprint", "entry_filename"]
+
+#: retrace-instrumentation entry of the adopted-executable wrapper: the
+#: backend compile of a deserialized module is visible here, NEVER under
+#: the serve_bucket* entries (whose zero-after-restart is the contract)
+AOT_ENTRY = "serve_bucket_aot"
+
+AOT_MAGIC = b"PYCAOT1\n"
+_FORMAT = 1
+#: header length is bounded (fingerprints are small); anything larger is
+#: a torn/foreign file, refused before a byte of JSON parses
+_MAX_HEADER = 1 << 20
+
+
+def _params_fields(p) -> dict:
+    """``ConsensusParams`` as a JSON-stable field map — the params
+    dimension of the compatibility fingerprint. Every field participates
+    (two tenants differing in any static param are two executables,
+    exactly as the in-memory BucketKey keys them)."""
+    return {k: (v if isinstance(v, (bool, int, float, str, type(None)))
+                else repr(v))
+            for k, v in p._asdict().items()}
+
+
+def key_fingerprint(key) -> dict:
+    """The FULL compatibility fingerprint of one cache entry: all six
+    ``BucketKey`` dimensions plus the runtime/toolchain half
+    (``tune.fingerprint.runtime_fingerprint`` — the shared helper the
+    block-shape winner cache keys on too). Equality of this dict is the
+    adopt condition; any difference is a refusal."""
+    return {
+        "rows": int(key.rows),
+        "events": int(key.events),
+        "batch": int(key.batch),
+        "params": _params_fields(key.params),
+        "topology": str(key.topology),
+        "kernel_path": str(key.kernel_path),
+        "runtime": runtime_fingerprint(),
+    }
+
+
+def _canonical(fp: dict) -> bytes:
+    return json.dumps(fp, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def entry_filename(fp: dict) -> str:
+    """Content-addressed file name: the first 24 hex chars of the
+    fingerprint digest. Two incompatible worlds can never share a file —
+    but the header fingerprint is STILL verified on load (a renamed or
+    copied file must not smuggle a foreign executable under a valid
+    name: the wrong-BucketKey-collision arm of the corruption matrix)."""
+    return hashlib.sha256(_canonical(fp)).hexdigest()[:24] + ".aotx"
+
+
+def _pack(header: dict, payload: bytes) -> bytes:
+    hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+    return AOT_MAGIC + struct.pack(">Q", len(hdr)) + hdr + payload
+
+
+class AotExecutable:
+    """A deserialized AOT entry behind the bucket-executable call
+    convention ``fn(*bucket_arrays, p)`` — drop-in for the jits
+    ``make_bucket_executable`` (and friends) return, so the batcher and
+    the warm preflight drive adopted and fresh executables identically.
+    ``p`` rides along for call-compat and is VERIFIED against the params
+    the entry was exported for (the sharded executable's refuse-loudly
+    rule: a mismatch would silently compute with foreign params)."""
+
+    def __init__(self, exported, key, mesh=None) -> None:
+        import jax
+
+        self.key = key
+        self._params = key.params
+        n_in = len(exported.in_avals)
+        if key.topology != SINGLE_TOPOLOGY:
+            # a multi-device exported module must be CALLED in a context
+            # spanning the same device count; replicated in_shardings
+            # over the serving mesh place the call there (the module's
+            # internal shardings then partition exactly as the fresh
+            # shard_map executable did)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            fn = jax.jit(exported.call, in_shardings=(rep,) * n_in)
+        else:
+            fn = jax.jit(exported.call)
+        self._fn = obs.instrument_jit(fn, AOT_ENTRY)
+
+    def __call__(self, *args):
+        arrays, p = args[:-1], args[-1]
+        if p != self._params:
+            raise ValueError(
+                f"AOT bucket executable was persisted for params "
+                f"{self._params!r} but called with {p!r} — the cache "
+                f"keys one executable per params; mint a new key instead")
+        return self._fn(*arrays)
+
+    def __repr__(self) -> str:
+        return f"AotExecutable({tuple(self.key)!r})"
+
+
+class AotCache:
+    """The on-disk executable store: one directory, one ``.aotx`` file
+    per (BucketKey, runtime-fingerprint). Thread-compat (callers
+    serialize through ``ExecutableCache``'s lock); every write is
+    atomic; every read is verify-before-adopt."""
+
+    def __init__(self, path) -> None:
+        self.dir = pathlib.Path(path).expanduser()
+        self._persists = obs.counter(
+            "pyconsensus_aot_persist_total",
+            "AOT bucket-executable persist attempts by outcome "
+            "(written / exists / failed — failures are fail-soft)",
+            labels=("outcome",))
+        self._loads = obs.counter(
+            "pyconsensus_aot_load_total",
+            "AOT disk-cache consults by outcome (loaded = adopted with "
+            "zero pipeline retraces; miss = no file for this "
+            "fingerprint)", labels=("outcome",))
+        self._rejects = obs.counter(
+            "pyconsensus_aot_reject_total",
+            "persisted AOT entries refused by verify-before-adopt "
+            "(each is deleted and recompiled, never loaded)",
+            labels=("reason",))
+        self._bytes = obs.gauge(
+            "pyconsensus_aot_cache_bytes",
+            "total bytes of persisted AOT bucket executables on disk")
+        self._sweep_orphans()
+        self._update_bytes()       # gauge reflects disk state from boot
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def _sweep_orphans(self) -> None:
+        """Best-effort removal of ``*.tmp.aotx`` mkstemp leftovers a
+        hard kill mid-persist can strand (atomic_write's cleanup never
+        runs under SIGKILL). Age-gated: a RECENT tmp may be a live
+        concurrent writer in a shared fleet cache dir — only files old
+        enough that no persist could still own them are swept."""
+        import time
+
+        try:
+            now = time.time()
+            for f in self.dir.glob("*.tmp.aotx"):
+                try:
+                    if now - f.stat().st_mtime > 3600.0:
+                        f.unlink()
+                except OSError:
+                    continue
+        except OSError:
+            pass
+
+    def entry_path(self, key) -> pathlib.Path:
+        return self.dir / entry_filename(key_fingerprint(key))
+
+    def has(self, key) -> bool:
+        """Whether a (possibly invalid) entry exists for ``key``'s full
+        fingerprint — the cheap preflight the fleet takeover uses to
+        decide what can warm from disk inside the PYC502 window."""
+        return self.entry_path(key).exists()
+
+    def _update_bytes(self) -> None:
+        # "*.aotx" also matches mkstemp's "*.tmp.aotx" names — exclude
+        # them: in-flight (or orphaned) temporaries are not cache content
+        try:
+            total = sum(f.stat().st_size for f in self.dir.glob("*.aotx")
+                        if ".tmp." not in f.name)
+        except OSError:
+            return
+        self._bytes.set(total)
+
+    # -- persist --------------------------------------------------------
+
+    def persist(self, key, entry) -> bool:
+        """AOT-lower ``entry`` (the warmed executable for ``key``) and
+        write it. Idempotent (an existing file is kept — it was verified
+        or will be on next load) and FAIL-SOFT: serving must never
+        depend on the disk cache being writable, so any export or write
+        failure is a stderr warning + a ``failed`` outcome, not an
+        error. Returns True iff a new file was written."""
+        import jax
+
+        path = self.entry_path(key)
+        if path.exists():
+            self._persists.inc(outcome="exists")
+            return False
+        fp = key_fingerprint(key)
+        try:
+            from jax import export as jax_export
+
+            from .cache import warm_inputs
+
+            raw = getattr(entry, "_fn", entry)   # unwrap InstrumentedJit
+            specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in warm_inputs(key)]
+            exported = jax_export.export(raw)(*specs, p=key.params)
+            payload = bytes(exported.serialize())
+            header = {
+                "format": _FORMAT,
+                "fingerprint": fp,
+                "payload_sha256": hashlib.sha256(payload).hexdigest(),
+                "payload_bytes": len(payload),
+                "entry": AOT_ENTRY,
+            }
+            blob = _pack(header, payload)
+            pio.atomic_write(path, lambda tmp:
+                             pathlib.Path(tmp).write_bytes(blob),
+                             suffix=".tmp.aotx")
+            # post-write fault hook: torn_write models disk damage
+            # between the persist and a later boot's load; a raise kind
+            # is a simulated write failure (the file may remain — a
+            # valid survivor is harmless, the next load verifies it)
+            _faults.fire("aot.cache_write", path=path)
+        except Exception as exc:   # noqa: BLE001 — fail-soft by contract
+            print(f"WARNING: AOT persist of {tuple(key)!r} failed "
+                  f"({type(exc).__name__}: {exc}); serving continues "
+                  f"without a disk entry", file=sys.stderr)
+            self._persists.inc(outcome="failed")
+            return False
+        self._persists.inc(outcome="written")
+        self._update_bytes()
+        return True
+
+    # -- verify + load --------------------------------------------------
+
+    def verify(self, key):
+        """Read and verify ``key``'s entry WITHOUT adopting it: returns
+        the deserialized ``jax.export.Exported`` on success, raises
+        :class:`AotCacheCorruptionError` (PYC302) naming the refusing
+        check on any corruption or incompatibility, ``FileNotFoundError``
+        on a missing entry. The dry-run preflight mirror of
+        ``ReputationLedger.verify``; :meth:`adopt` is the transparent
+        refuse-delete-recompile wrapper around it."""
+        path = self.entry_path(key)
+        # the load-side injection point: a raise kind is a failed read
+        # (adopt degrades to recompile), torn_write tears the file right
+        # before this read — the refuse path, exercised end to end
+        _faults.fire("aot.cache_load", path=path)
+        data = path.read_bytes()     # FileNotFoundError propagates: a miss
+        if len(data) < len(AOT_MAGIC) + 8 or \
+                not data.startswith(AOT_MAGIC):
+            raise AotCacheCorruptionError(
+                f"{path}: not an AOT cache entry (bad magic — torn, "
+                f"truncated, or foreign file)", reason="magic",
+                path=str(path))
+        (hdr_len,) = struct.unpack_from(">Q", data, len(AOT_MAGIC))
+        body = len(AOT_MAGIC) + 8
+        if hdr_len > _MAX_HEADER or body + hdr_len > len(data):
+            raise AotCacheCorruptionError(
+                f"{path}: truncated header (file torn at "
+                f"{len(data)} bytes)", reason="torn", path=str(path))
+        try:
+            header = json.loads(data[body:body + hdr_len])
+        except ValueError as exc:
+            raise AotCacheCorruptionError(
+                f"{path}: unparseable entry header ({exc})",
+                reason="header", path=str(path)) from exc
+        if header.get("format") != _FORMAT:
+            raise AotCacheCorruptionError(
+                f"{path}: AOT format {header.get('format')!r} != "
+                f"{_FORMAT} (written by an incompatible release)",
+                reason="format", path=str(path))
+        payload = data[body + hdr_len:]
+        if len(payload) != header.get("payload_bytes"):
+            raise AotCacheCorruptionError(
+                f"{path}: payload is {len(payload)} bytes, header "
+                f"promised {header.get('payload_bytes')} — file torn",
+                reason="torn", path=str(path))
+        expected = key_fingerprint(key)
+        found = header.get("fingerprint")
+        if not isinstance(found, dict):
+            # valid JSON, wrong shape: still a refusal, never a crash
+            found = {}
+        if found != expected:
+            drift = sorted(k for k in set(expected) | set(found)
+                           if found.get(k) != expected.get(k))
+            raise AotCacheCorruptionError(
+                f"{path}: compatibility fingerprint mismatch in "
+                f"{drift} — persisted for a different "
+                f"{'/'.join(drift)}, must recompile, never load",
+                reason="fingerprint", path=str(path), fields=drift,
+                found={k: found.get(k) for k in drift},
+                expected={k: expected.get(k) for k in drift})
+        digest = hashlib.sha256(payload).hexdigest()
+        if digest != header.get("payload_sha256"):
+            raise AotCacheCorruptionError(
+                f"{path}: payload SHA-256 {digest[:12]}… does not match "
+                f"header {str(header.get('payload_sha256'))[:12]}… — "
+                f"content corrupted on disk", reason="digest",
+                path=str(path))
+        from jax import export as jax_export
+
+        try:
+            return jax_export.deserialize(payload)
+        except Exception as exc:   # noqa: BLE001 — refuse, never crash
+            raise AotCacheCorruptionError(
+                f"{path}: serialized module failed to deserialize "
+                f"({type(exc).__name__}: {exc})", reason="deserialize",
+                path=str(path)) from exc
+
+    def adopt(self, key, mesh=None):
+        """The boot-time load: verified entry → :class:`AotExecutable`
+        (zero pipeline retraces), missing entry → None, invalid entry →
+        refused with the structured PYC302 (logged), **deleted**, and
+        None — the caller recompiles transparently and re-persists a
+        clean file."""
+        path = self.entry_path(key)
+        if not path.exists():
+            self._loads.inc(outcome="miss")
+            return None
+        try:
+            exported = self.verify(key)
+        except FileNotFoundError:
+            self._loads.inc(outcome="miss")
+            return None
+        except OSError as exc:
+            # an unreadable file (injected os_error, shared-FS hiccup)
+            # is not evidence of corruption: refuse WITHOUT deleting —
+            # recompiling serves this boot, the file gets re-verified
+            # next time the filesystem cooperates
+            print(f"WARNING: AOT entry {path.name} unreadable "
+                  f"({type(exc).__name__}: {exc}); recompiling",
+                  file=sys.stderr)
+            self._rejects.inc(reason="io")
+            return None
+        except AotCacheCorruptionError as exc:
+            reason = exc.context.get("reason", "unknown")
+            print(f"WARNING: refusing persisted AOT entry {path.name} "
+                  f"({exc}); deleting and recompiling", file=sys.stderr)
+            self._rejects.inc(reason=reason)
+            path.unlink(missing_ok=True)
+            self._update_bytes()
+            return None
+        entry = AotExecutable(exported, key, mesh=mesh)
+        self._loads.inc(outcome="loaded")
+        return entry

@@ -17,6 +17,18 @@ part b) is part of the key so the LRU can never serve a wrong-topology
 executable: a cache is bound to at most ONE mesh, and a key minted for
 any other topology is rejected loudly instead of silently compiled for
 hardware it was not budgeted for.
+
+With an :class:`~pyconsensus_tpu.serve.aotcache.AotCache` attached
+(ISSUE 10 tentpole), ``warm`` consults the disk first: a verified
+persisted executable adopts with ZERO retraces of the consensus
+pipeline (``pyconsensus_jit_retraces_total{entry="serve_bucket*"}``
+stays 0 across a process restart — the zero-cold-start contract), a
+fresh compile is AOT-exported and persisted for the next boot, and a
+torn/incompatible entry is refused + deleted + recompiled
+(``aotcache``'s verify-before-adopt). Runtime misses in ``get`` consult
+the disk too — a bucket first warmed by a previous process never
+recompiles — but only ``warm`` persists: export costs a second
+trace+lower, which belongs in the preflight, not the dispatch path.
 """
 
 from __future__ import annotations
@@ -30,12 +42,45 @@ import numpy as np
 from .. import obs
 from ..faults import plan as _faults
 from . import kernels as sk
+from .aotcache import AotExecutable
 from .pallas import (PALLAS_KERNEL_PATH, XLA_KERNEL_PATH,
                      make_pallas_bucket_executable)
 from .sharded import (SINGLE_TOPOLOGY, make_sharded_bucket_executable,
                       mesh_fingerprint)
 
-__all__ = ["ExecutableCache", "BucketKey"]
+__all__ = ["ExecutableCache", "BucketKey", "warm_inputs"]
+
+
+def warm_inputs(key) -> list:
+    """The zero-input device arrays that warm (and spec) ``key``'s
+    executable — one definition shared by the warm preflight and the
+    AOT export (``aotcache.AotCache.persist`` derives the exported
+    avals from exactly these arrays, so an adopted executable can never
+    disagree with dispatch about shapes or dtypes). A zero matrix
+    resolves degenerately fast — the power loop's zero-covariance guard
+    exits on the first sweep — while still compiling the full graph;
+    ``has_na`` params get one NaN so the fill graph compiles too."""
+    rows, events, batch = key.rows, key.events, key.batch
+    acc = jnp.asarray(0.0).dtype
+    p = key.params
+    reports = np.zeros((rows, events))
+    if p.has_na:
+        reports[-1, 0] = np.nan     # exercise the fill graph
+    rep = np.full((rows,), 1.0 / rows)
+    if key.kernel_path == PALLAS_KERNEL_PATH:
+        # the fused executable takes the bare light-pipeline
+        # signature at exact shape — no masks, no seed
+        return [jnp.asarray(a, dtype=(bool if a.dtype == bool
+                                      else acc)) for a in (
+            reports, rep, np.zeros(events, bool), np.zeros(events),
+            np.ones(events))]
+    args = [jnp.asarray(a) for a in (
+        reports, rep, np.zeros(events, bool), np.zeros(events),
+        np.ones(events), np.ones(rows, bool),
+        np.ones(events, bool), np.zeros(events, np.dtype(acc)))]
+    if batch > 1:
+        args = [jnp.broadcast_to(a, (batch,) + a.shape) for a in args]
+    return args
 
 
 class BucketKey(tuple):
@@ -100,13 +145,15 @@ class ExecutableCache:
     keys build the single-device one, and any OTHER topology is a hard
     error (the wrong-topology rejection contract)."""
 
-    def __init__(self, capacity: int = 64, mesh=None) -> None:
+    def __init__(self, capacity: int = 64, mesh=None, aot=None) -> None:
         if int(capacity) < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
         self.mesh = mesh
         self.mesh_topology = (mesh_fingerprint(mesh) if mesh is not None
                               else None)
+        #: optional aotcache.AotCache — the disk tier behind warm()/get()
+        self.aot = aot
         self._entries: OrderedDict = OrderedDict()
         self._lock = threading.RLock()
         self._hits = obs.counter(
@@ -139,8 +186,8 @@ class ExecutableCache:
         return None if total == 0 else h / total
 
     def get(self, key: BucketKey):
-        """The executable for ``key`` — compiled (and stored) on miss,
-        LRU-refreshed on hit."""
+        """The executable for ``key`` — adopted from the AOT disk tier
+        or compiled (and stored) on miss, LRU-refreshed on hit."""
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
@@ -149,14 +196,31 @@ class ExecutableCache:
                 return entry
             self._misses.inc()
             _faults.fire("serve.cache_store")
-            entry = self._build(key)
-            self._entries[key] = entry
-            while len(self._entries) > self.capacity:
-                _, evicted = self._entries.popitem(last=False)
-                del evicted
-                self._evictions.inc()
-            self._size.set(len(self._entries))
+            entry = self._adopt(key)
+            if entry is None:
+                entry = self._build(key)
+            self._store(key, entry)
             return entry
+
+    def _store(self, key: BucketKey, entry) -> None:
+        """Install ``entry`` under the held lock with LRU pressure."""
+        self._entries[key] = entry
+        while len(self._entries) > self.capacity:
+            _, evicted = self._entries.popitem(last=False)
+            del evicted
+            self._evictions.inc()
+        self._size.set(len(self._entries))
+
+    def _adopt(self, key: BucketKey):
+        """Consult the AOT disk tier (None without one, on a miss, or
+        on a refused entry — the caller compiles fresh). The topology
+        gate runs FIRST: a wrong-topology key must get ``_build``'s
+        loud rejection, never a quiet disk miss."""
+        if self.aot is None:
+            return None
+        if key.topology not in (SINGLE_TOPOLOGY, self.mesh_topology):
+            return None
+        return self.aot.adopt(key, mesh=self.mesh)
 
     def _build(self, key: BucketKey):
         """Compile the right executable class for ``key`` — or refuse a
@@ -190,38 +254,27 @@ class ExecutableCache:
                                               batched=key.batch > 1)
 
     def warm(self, key: BucketKey) -> None:
-        """Compile ``key``'s executable AND populate its jit cache by
-        running it once on zero inputs (an AOT ``lower().compile()``
-        would not seed the ``jit`` call cache, so the first real request
-        would compile again). A zero matrix resolves degenerately fast —
-        the power loop's zero-covariance guard exits on the first
-        sweep. The preflight is per-TOPOLOGY: a mesh-topology key warms
-        the shard_map executable on its mesh (jit places the zero inputs
+        """Materialize ``key``'s executable AND populate its call cache
+        by running it once on :func:`warm_inputs` (a bare
+        ``lower().compile()`` would not seed the ``jit`` call cache, so
+        the first real request would compile again). With an AOT disk
+        tier attached, a verified persisted entry adopts with zero
+        pipeline retraces, and a fresh compile is exported + persisted
+        for the next boot (``aotcache`` module docstring). The
+        preflight is per-TOPOLOGY: a mesh-topology key warms the
+        shard_map executable on its mesh (jit places the zero inputs
         per the shard_map specs), so the first real mesh dispatch pays
         no compile either."""
-        entry = self.get(key)
-        rows, events, batch = key.rows, key.events, key.batch
-        acc = jnp.asarray(0.0).dtype
-        p = key.params
-        reports = np.zeros((rows, events))
-        if p.has_na:
-            reports[-1, 0] = np.nan     # exercise the fill graph
-        rep = np.full((rows,), 1.0 / rows)
-        if key.kernel_path == PALLAS_KERNEL_PATH:
-            # the fused executable takes the bare light-pipeline
-            # signature at exact shape — no masks, no seed
-            args = [jnp.asarray(a, dtype=(bool if a.dtype == bool
-                                          else acc)) for a in (
-                reports, rep, np.zeros(events, bool), np.zeros(events),
-                np.ones(events))]
-        else:
-            args = [jnp.asarray(a) for a in (
-                reports, rep, np.zeros(events, bool), np.zeros(events),
-                np.ones(events), np.ones(rows, bool),
-                np.ones(events, bool), np.zeros(events, np.dtype(acc)))]
-            if batch > 1:
-                args = [jnp.broadcast_to(a, (batch,) + a.shape)
-                        for a in args]
-        out = entry(*args, p)
-        # block on one output: the warmup must include backend compile
+        entry = self.get(key)       # adopt-or-build; lock held only there
+        # the warm execution (where the backend compile actually lands —
+        # for adopted entries under the serve_bucket_aot entry) and the
+        # AOT export both run OUTSIDE the cache lock: a fleet standby
+        # warming inside a takeover window must not stall the batcher's
+        # get() on its own already-warmed buckets
+        args = warm_inputs(key)
+        out = entry(*args, key.params)
         np.asarray(out["smooth_rep"])
+        if self.aot is not None and not isinstance(entry, AotExecutable):
+            # persist the freshly-compiled executable (idempotent — an
+            # existing file is kept; failures are fail-soft)
+            self.aot.persist(key, entry)

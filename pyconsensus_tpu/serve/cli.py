@@ -70,6 +70,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(ISSUE 7): auto = TPU backend only, on = any "
                          "backend (interpreter off-TPU), off = padded "
                          "XLA buckets only")
+    ap.add_argument("--aot-cache", metavar="DIR", default=None,
+                    help="zero-cold-start AOT executable cache "
+                         "directory (ISSUE 10): warmed bucket "
+                         "executables persist here; a restarted "
+                         "process warms from disk with zero pipeline "
+                         "retraces")
     ap.add_argument("--allow-shed", action="store_true",
                     help="shed requests (PYC401) do not fail the run — "
                          "the expected outcome of an overload probe")
@@ -97,6 +103,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.pallas_buckets is not None:
         overrides["pallas_buckets"] = {"auto": "auto", "on": True,
                                        "off": False}[args.pallas_buckets]
+    if args.aot_cache is not None:
+        overrides["aot_cache_dir"] = args.aot_cache
     if overrides:
         cfg = ServeConfig.from_dict({**cfg.__dict__, **overrides})
 
@@ -119,7 +127,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                   entry="serve_bucket"),
             "retraces_sharded": obs.value(
                 "pyconsensus_jit_retraces_total",
-                entry="serve_bucket_sharded")}))
+                entry="serve_bucket_sharded"),
+            "aot_loaded": obs.value("pyconsensus_aot_load_total",
+                                    outcome="loaded"),
+            "aot_persisted": obs.value("pyconsensus_aot_persist_total",
+                                       outcome="written")}))
         if args.metrics_out:
             obs.write_prom(args.metrics_out, obs.REGISTRY)
         return 0

@@ -46,6 +46,7 @@ loss, heartbeat flap, and torn ledger replication deterministically.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -327,11 +328,17 @@ class ConsensusFleet:
         self.capacity.begin_takeover(self.config.takeover_window_s)
         self._failovers.inc()
         migrated = []
+        warmed_owners: set = set()
         try:
             for name in moving:
                 try:
                     self._fence_stale(dead, name)
                     new_owner = self.ring.owner(name)
+                    if new_owner not in warmed_owners:
+                        # once per ADOPTING owner, not per session — the
+                        # scan is the same work every time
+                        warmed_owners.add(new_owner)
+                        self._warm_standby(new_owner)
                     session = replay_session(self.config.log_dir, name)
                     self.workers[new_owner].service.sessions.add(session)
                     # the fenced stale object leaves the dead worker's
@@ -370,6 +377,28 @@ class ConsensusFleet:
                 self._migrating.difference_update(moving)
             self.capacity.end_takeover()
         return migrated
+
+    def _warm_standby(self, owner: str) -> None:
+        """Warm the adopting worker's bucket executables from the AOT
+        disk cache inside the takeover window (ISSUE 10): a standby
+        that skipped the boot-time warmup (lazy start, autoscaled
+        replacement) adopts the persisted executables the dead worker
+        (or any earlier fleet member) already compiled — zero pipeline
+        retraces, so the first post-takeover request is not a compile
+        stall on top of a failover. Fail-soft: warming can shrink the
+        PYC502 window, it must never abort the takeover."""
+        try:
+            adopted = self.workers[owner].service.warm_from_disk()
+        except Exception as exc:   # noqa: BLE001 — the takeover wins
+            print(f"WARNING: standby {owner!r} AOT warm failed "
+                  f"({type(exc).__name__}: {exc}); takeover continues",
+                  file=sys.stderr)
+            return
+        if adopted:
+            obs.counter(
+                "pyconsensus_aot_takeover_warms_total",
+                "bucket executables a standby adopted from the AOT "
+                "disk cache inside a takeover window").inc(adopted)
 
     def _fence_stale(self, dead: str, name: str) -> None:
         """Fence the dead worker's in-memory session object BEFORE the

@@ -115,6 +115,13 @@ class ServeConfig:
     #: traffic (the low-latency tier's warmup ladder; unlike ``warmup``
     #: these are true request shapes, not bucket shapes)
     pallas_warmup: tuple = ()
+    #: zero-cold-start AOT executable cache directory (ISSUE 10): warmed
+    #: bucket executables are AOT-serialized here and a restarted (or
+    #: autoscaled, or failed-over) process warms from disk with zero
+    #: pipeline retraces. None disables persistence. Safe to share
+    #: across a fleet — entries are content-addressed by a full
+    #: compatibility fingerprint and verified before adoption.
+    aot_cache_dir: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServeConfig":
@@ -151,8 +158,13 @@ class ConsensusService:
             raise InputError("max_batch must be >= 1")
         self.queue = RequestQueue(self.config.max_queue)
         self.mesh = self._build_mesh()
+        aot = None
+        if self.config.aot_cache_dir:
+            from .aotcache import AotCache
+
+            aot = AotCache(self.config.aot_cache_dir)
         self.cache = ExecutableCache(self.config.cache_capacity,
-                                     mesh=self.mesh)
+                                     mesh=self.mesh, aot=aot)
         self.admission = AdmissionController(self.config.rate_limit_rps,
                                              self.config.rate_burst)
         self.sessions = SessionStore()
@@ -237,6 +249,42 @@ class ConsensusService:
                               kernel_path=PALLAS_KERNEL_PATH):
                     self.cache.warm(key)
                 n += 1
+        return n
+
+    def configured_keys(self, **oracle_kwargs) -> list:
+        """The BucketKeys of the configured warmup ladders (XLA/sharded
+        buckets + exact-shape Pallas warmups) — what ``warm_buckets``
+        would compile, and what :meth:`warm_from_disk` probes the AOT
+        store for."""
+        keys = [self._bucket_key((r, e), has_na=True, any_scaled=False,
+                                 n_scaled=0, oracle_kwargs=oracle_kwargs)
+                for r, e in self.config.warmup]
+        keys += [self._pallas_key(r, e, has_na=True,
+                                  oracle_kwargs=oracle_kwargs)
+                 for r, e in self.config.pallas_warmup]
+        return keys
+
+    def warm_from_disk(self, **oracle_kwargs) -> int:
+        """Adopt every configured bucket whose verified AOT entry is on
+        disk — zero pipeline retraces (the expensive Python
+        trace/lowering never runs; only the pre-lowered module's
+        backend compile remains, visible under the ``serve_bucket_aot``
+        entry). Keys without a persisted entry are skipped, NOT
+        compiled: this is the cheap leg the fleet runs inside the
+        PYC502 takeover window, where a full retrace+compile would
+        widen exactly the window it is shrinking. Returns the number of
+        executables adopted. No-op without an ``aot_cache_dir``."""
+        if self.cache.aot is None:
+            return 0
+        n = 0
+        for key in self.configured_keys(**oracle_kwargs):
+            if key in self.cache.keys() or not self.cache.aot.has(key):
+                continue
+            with obs.span("serve.warm_from_disk",
+                          bucket=f"{key.rows}x{key.events}",
+                          kernel_path=key.kernel_path):
+                self.cache.warm(key)
+            n += 1
         return n
 
     def drain(self, timeout: Optional[float] = 60.0) -> None:

@@ -22,7 +22,9 @@ key layout, the fallback rules, and how to re-tune
 from .autotune import (FALLBACK_TABLE, TuneCache, autotune_cov,
                        autotune_resolve, cache_path, default_provider,
                        install, shape_class, tpu_generation)
+from .fingerprint import device_generation, runtime_fingerprint
 
 __all__ = ["autotune_cov", "autotune_resolve", "default_provider",
            "install", "TuneCache", "cache_path", "shape_class",
-           "tpu_generation", "FALLBACK_TABLE"]
+           "tpu_generation", "FALLBACK_TABLE",
+           "device_generation", "runtime_fingerprint"]

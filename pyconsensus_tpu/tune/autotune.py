@@ -46,6 +46,7 @@ from .. import io as pio
 from .. import obs
 from ..faults import plan as _faults
 from ..ops import pallas_kernels as pk
+from .fingerprint import device_generation
 
 __all__ = ["autotune_cov", "autotune_resolve", "default_provider",
            "install", "TuneCache", "cache_path", "shape_class",
@@ -71,14 +72,11 @@ FALLBACK_TABLE = {
 }
 
 
-def tpu_generation() -> str:
-    """The accelerator-generation component of every cache key —
-    ``device_kind`` of device 0 with spaces dashed (``"TPU-v5e"``;
-    ``"cpu"`` on CPU hosts), matching ``serve.sharded.mesh_fingerprint``'s
-    device-kind convention."""
-    import jax
-
-    return str(jax.devices()[0].device_kind).replace(" ", "-")
+#: the accelerator-generation component of every winner-cache key — ONE
+#: definition shared with the AOT executable cache (ISSUE 10 satellite:
+#: ``tune.fingerprint.device_generation``; the historical name stays
+#: exported because the sweeps and tests key on it)
+tpu_generation = device_generation
 
 
 def shape_class(n: int) -> str:

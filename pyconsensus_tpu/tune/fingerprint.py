@@ -1,0 +1,63 @@
+"""Shared cache-key fingerprint helpers (ISSUE 10 satellite).
+
+Two subsystems persist compiled-artifact caches keyed by "what hardware
+and toolchain produced this": the block-shape winner cache
+(``tune.autotune.TuneCache``) and the AOT bucket-executable cache
+(``serve.aotcache.AotCache``). Both need the same answer to "is this
+entry from a compatible world?", and PR 7 + PR 10 each growing a private
+copy is exactly the drift the CATCH_TIE_ATOL unification (PR 7) killed
+for the tie bands — so the fingerprint logic lives HERE, once, and both
+caches import it (tests/test_aotcache.py pins both to these
+definitions).
+
+- :func:`device_generation` — the accelerator-generation component
+  (``device_kind`` of device 0, spaces dashed: ``"TPU-v5e"``, ``"cpu"``)
+  shared by tune winner keys, ``serve.sharded.mesh_fingerprint``'s
+  device-kind convention, and the AOT compatibility fingerprint. A
+  winner (or executable) measured on one generation must never be
+  adopted on another.
+- :func:`runtime_fingerprint` — the full toolchain/topology fingerprint
+  the AOT cache refuses on: jax + jaxlib versions (a serialized
+  StableHLO module is only guaranteed to deserialize into the same
+  program under the toolchain that produced it), backend platform,
+  device generation, visible-device count, and the x64 flag (it changes
+  every array dtype in the exported calling convention).
+
+Both resolve the environment at CALL time, not import time — they run
+host-side at cache load/store, never inside a trace (the CL401
+import-time-hoist discipline applies to trace-time reads; these are
+boot-time reads that must see the real runtime).
+"""
+
+from __future__ import annotations
+
+__all__ = ["device_generation", "runtime_fingerprint"]
+
+
+def device_generation() -> str:
+    """The accelerator-generation component of every persisted cache
+    key — ``device_kind`` of device 0 with spaces dashed (``"TPU-v5e"``;
+    ``"cpu"`` on CPU hosts), matching
+    ``serve.sharded.mesh_fingerprint``'s device-kind convention."""
+    import jax
+
+    return str(jax.devices()[0].device_kind).replace(" ", "-")
+
+
+def runtime_fingerprint() -> dict:
+    """The compatibility fingerprint of this process's compile
+    toolchain + visible hardware — the runtime half of an AOT cache
+    key. Every field participates in the refuse-vs-adopt decision: a
+    mismatch in ANY of them means the persisted executable was built
+    for a different world and must be recompiled, never loaded."""
+    import jax
+    import jaxlib
+
+    return {
+        "jax": str(jax.__version__),
+        "jaxlib": str(jaxlib.__version__),
+        "platform": str(jax.default_backend()),
+        "generation": device_generation(),
+        "n_devices": int(jax.device_count()),
+        "x64": bool(jax.config.jax_enable_x64),
+    }

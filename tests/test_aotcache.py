@@ -1,0 +1,567 @@
+"""Zero-cold-start AOT executable cache (ISSUE 10).
+
+Covers the persist/adopt round trip (bitwise parity between adopted and
+freshly-compiled executables on real traffic, across all three bucket
+classes), the verify-before-adopt corruption matrix (torn file, bit
+flip, stale runtime fingerprint, wrong-BucketKey collision — each
+refused with a structured PYC302 naming the reason, deleted, and
+transparently recompiled), the shared tune/AOT fingerprint helper, the
+``aot.cache_write`` / ``aot.cache_load`` fault sites, the fleet
+takeover warm-from-disk hook, and a REAL kill-and-restart subprocess
+run asserting ``pyconsensus_jit_retraces_total{entry="serve_bucket"}
+== 0`` and bitwise parity with the pre-kill resolution.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.faults import (ERROR_CODES, AotCacheCorruptionError,
+                                    CheckpointCorruptionError, FaultPlan,
+                                    armed)
+from pyconsensus_tpu.models.pipeline import ConsensusParams
+from pyconsensus_tpu.serve import (AotCache, AotExecutable, BucketKey,
+                                   ConsensusService, ExecutableCache,
+                                   ServeConfig, warm_inputs)
+from pyconsensus_tpu.serve import kernels as sk
+from pyconsensus_tpu.serve.aotcache import (AOT_MAGIC, entry_filename,
+                                            key_fingerprint)
+from pyconsensus_tpu.tune import autotune
+from pyconsensus_tpu.tune.fingerprint import (device_generation,
+                                              runtime_fingerprint)
+
+
+def bucket_params(**kw):
+    base = dict(algorithm="sztorc", pca_method="power", has_na=True,
+                any_scaled=False, n_scaled=0)
+    base.update(kw)
+    return ConsensusParams(**base)
+
+
+def xla_key(rows=16, events=32, batch=2, **kw):
+    return BucketKey.make(rows, events, batch, bucket_params(**kw))
+
+
+def traffic_lanes(key, rng, R=10, E=20):
+    """Real request arrays padded up to ``key`` and stacked to its
+    batch capacity — what the batcher actually dispatches."""
+    import jax.numpy as jnp
+
+    m = rng.choice([0.0, 1.0, np.nan], size=(R, E), p=[.45, .45, .1])
+    lane = sk.bucket_inputs(m, np.full(R, 1.0 / R), np.zeros(E, bool),
+                            np.zeros(E), np.ones(E), key.rows, key.events,
+                            has_na=True)
+    if key.batch > 1:
+        return [jnp.asarray(np.stack([f] * key.batch)) for f in lane]
+    return [jnp.asarray(f) for f in lane]
+
+
+def assert_bitwise(a, b):
+    for k in b:
+        np.testing.assert_array_equal(
+            np.asarray(a[k]), np.asarray(b[k]),
+            err_msg=f"AOT-loaded executable output {k!r} is not "
+                    f"bit-identical to the freshly-compiled one")
+
+
+class TestSharedFingerprint:
+    """ISSUE 10 satellite: ONE definition of the version/generation
+    fingerprint, shared by the tune winner cache and the AOT keys."""
+
+    def test_tune_cache_pins_to_shared_helper(self):
+        # the winner cache's generation component IS the shared helper
+        # (not a drifted copy) — the CATCH_TIE_ATOL unification rule
+        assert autotune.tpu_generation is device_generation
+        from pyconsensus_tpu import tune
+
+        assert tune.tpu_generation is device_generation
+        key = autotune._entry_key("resolve_block_cols",
+                                  autotune.tpu_generation(), 4, "p128")
+        assert key.startswith(device_generation() + "/")
+
+    def test_aot_key_pins_to_shared_helper(self):
+        fp = key_fingerprint(xla_key())
+        assert fp["runtime"] == runtime_fingerprint()
+        assert fp["runtime"]["generation"] == device_generation()
+
+    def test_runtime_fingerprint_fields(self):
+        import jax
+        import jaxlib
+
+        fp = runtime_fingerprint()
+        assert fp["jax"] == jax.__version__
+        assert fp["jaxlib"] == jaxlib.__version__
+        assert fp["platform"] == "cpu"
+        assert fp["x64"] is True        # conftest enables x64
+        assert fp["n_devices"] == jax.device_count()
+
+    def test_every_bucketkey_dimension_keys_the_file(self):
+        base = xla_key()
+        variants = [xla_key(rows=32), xla_key(events=64),
+                    BucketKey.make(16, 32, 4, bucket_params()),
+                    xla_key(alpha=0.3),
+                    BucketKey.make(16, 32, 2, bucket_params(),
+                                   "cpu:2x4"),
+                    BucketKey.make(16, 32, 1,
+                                   bucket_params(fused_resolution=True),
+                                   kernel_path="pallas")]
+        names = {entry_filename(key_fingerprint(k))
+                 for k in [base] + variants}
+        assert len(names) == len(variants) + 1
+
+    def test_error_taxonomy(self):
+        assert ERROR_CODES["PYC302"] is AotCacheCorruptionError
+        exc = AotCacheCorruptionError("x", reason="digest")
+        assert isinstance(exc, CheckpointCorruptionError)
+        assert isinstance(exc, ValueError)
+        assert exc.context["reason"] == "digest"
+        assert str(exc).startswith("[PYC302]")
+
+
+class TestRoundTrip:
+    def test_persist_adopt_bitwise_parity(self, tmp_path, rng):
+        key = xla_key()
+        c1 = ExecutableCache(8, aot=AotCache(tmp_path))
+        c1.warm(key)
+        files = list(tmp_path.glob("*.aotx"))
+        assert len(files) == 1
+        assert files[0].read_bytes().startswith(AOT_MAGIC)
+        lanes = traffic_lanes(key, rng)
+        fresh = c1.get(key)(*lanes, key.params)
+
+        c2 = ExecutableCache(8, aot=AotCache(tmp_path))
+        before = obs.value("pyconsensus_jit_retraces_total",
+                           entry="serve_bucket") or 0
+        c2.warm(key)
+        adopted = c2.get(key)
+        assert isinstance(adopted, AotExecutable)
+        # zero retraces of the consensus pipeline: the adopted entry
+        # never touches the instrumented serve_bucket jit
+        after = obs.value("pyconsensus_jit_retraces_total",
+                          entry="serve_bucket") or 0
+        assert after == before
+        assert_bitwise(adopted(*lanes, key.params), fresh)
+
+    def test_runtime_miss_adopts_from_disk(self, tmp_path, rng):
+        key = xla_key(rows=8, events=32, batch=1)
+        ExecutableCache(8, aot=AotCache(tmp_path)).warm(key)
+        c2 = ExecutableCache(8, aot=AotCache(tmp_path))
+        # a cold GET (traffic hitting an unwarmed bucket) consults the
+        # disk tier before compiling
+        assert isinstance(c2.get(key), AotExecutable)
+
+    def test_cold_warm_counts_one_disk_miss(self, tmp_path):
+        # warm of an unpersisted bucket consults the disk exactly once
+        # (adopt-or-build lives in get(); a double consult would make
+        # every loaded/(loaded+miss) adoption-rate dashboard read low)
+        before = obs.value("pyconsensus_aot_load_total",
+                           outcome="miss") or 0
+        ExecutableCache(8, aot=AotCache(tmp_path)).warm(
+            xla_key(rows=8, events=32, batch=1))
+        assert obs.value("pyconsensus_aot_load_total",
+                         outcome="miss") == before + 1
+
+    def test_persist_idempotent(self, tmp_path):
+        key = xla_key(rows=8, events=32, batch=1)
+        cache = ExecutableCache(8, aot=AotCache(tmp_path))
+        cache.warm(key)
+        written = obs.value("pyconsensus_aot_persist_total",
+                            outcome="written")
+        cache.warm(key)
+        assert obs.value("pyconsensus_aot_persist_total",
+                         outcome="written") == written
+        assert obs.value("pyconsensus_aot_persist_total",
+                         outcome="exists") >= 1
+        assert len(list(tmp_path.glob("*.aotx"))) == 1
+
+    def test_params_mismatch_refused_at_call(self, tmp_path):
+        key = xla_key(rows=8, events=32, batch=1)
+        cache = ExecutableCache(8, aot=AotCache(tmp_path))
+        cache.warm(key)
+        adopted = ExecutableCache(8, aot=AotCache(tmp_path)).get(key)
+        assert isinstance(adopted, AotExecutable)
+        args = warm_inputs(key)
+        with pytest.raises(ValueError, match="persisted for params"):
+            adopted(*args, bucket_params(alpha=0.7))
+
+    def test_without_aot_dir_unchanged(self, tmp_path):
+        key = xla_key(rows=8, events=32, batch=1)
+        cache = ExecutableCache(8)
+        cache.warm(key)
+        assert cache.aot is None
+        assert not list(tmp_path.glob("*.aotx"))
+
+
+class TestCorruptionMatrix:
+    """Every damaged or incompatible entry: refused with a structured
+    PYC302 naming the reason, deleted, transparently recompiled —
+    NEVER deserialized."""
+
+    def _persisted(self, tmp_path, key=None):
+        key = key or xla_key(rows=8, events=32, batch=1)
+        ExecutableCache(8, aot=AotCache(tmp_path)).warm(key)
+        (path,) = tmp_path.glob("*.aotx")
+        return key, path
+
+    def _assert_refused(self, tmp_path, key, path, reason):
+        aot = AotCache(tmp_path)
+        with pytest.raises(AotCacheCorruptionError) as ei:
+            aot.verify(key)
+        assert ei.value.context["reason"] == reason
+        assert ei.value.error_code == "PYC302"
+        # transparent arm: adopt refuses, DELETES, returns None...
+        assert aot.adopt(key) is None
+        assert not path.exists()
+        assert obs.value("pyconsensus_aot_reject_total",
+                         reason=reason) >= 1
+        # ...and warm recompiles + re-persists a clean entry
+        cache = ExecutableCache(8, aot=AotCache(tmp_path))
+        cache.warm(key)
+        assert not isinstance(cache.get(key), AotExecutable)
+        assert AotCache(tmp_path).verify(key) is not None
+
+    def test_truncated_file(self, tmp_path):
+        key, path = self._persisted(tmp_path)
+        data = path.read_bytes()
+        path.write_bytes(data[:int(len(data) * 0.6)])
+        self._assert_refused(tmp_path, key, path, "torn")
+
+    def test_bit_flipped_payload(self, tmp_path):
+        key, path = self._persisted(tmp_path)
+        data = bytearray(path.read_bytes())
+        data[-50] ^= 0x01                      # one flipped payload bit
+        path.write_bytes(bytes(data))
+        self._assert_refused(tmp_path, key, path, "digest")
+
+    def test_stale_runtime_fingerprint(self, tmp_path):
+        # rewrite the header claiming a different jaxlib — what a cache
+        # dir surviving a toolchain upgrade looks like. The digest is
+        # kept CONSISTENT with the payload so only the fingerprint can
+        # refuse (the check under test).
+        key, path = self._persisted(tmp_path)
+        data = path.read_bytes()
+        (hdr_len,) = struct.unpack_from(">Q", data, len(AOT_MAGIC))
+        body = len(AOT_MAGIC) + 8
+        header = json.loads(data[body:body + hdr_len])
+        header["fingerprint"]["runtime"]["jaxlib"] = "0.0.1-stale"
+        hdr = json.dumps(header, sort_keys=True).encode()
+        path.write_bytes(AOT_MAGIC + struct.pack(">Q", len(hdr)) + hdr
+                         + data[body + hdr_len:])
+        aot = AotCache(tmp_path)
+        with pytest.raises(AotCacheCorruptionError) as ei:
+            aot.verify(key)
+        assert ei.value.context["reason"] == "fingerprint"
+        assert "runtime" in ei.value.context["fields"]
+        self._assert_refused(tmp_path, key, path, "fingerprint")
+
+    def test_wrong_bucketkey_collision(self, tmp_path):
+        # a valid entry for key A renamed under key B's file name (copy
+        # mistake, digest collision fantasy): the header fingerprint is
+        # verified on load, so it can never be adopted as B
+        key_a, path_a = self._persisted(tmp_path)
+        key_b = xla_key(rows=16, events=32, batch=1)
+        aot = AotCache(tmp_path)
+        path_b = aot.entry_path(key_b)
+        path_b.write_bytes(path_a.read_bytes())
+        with pytest.raises(AotCacheCorruptionError) as ei:
+            aot.verify(key_b)
+        assert ei.value.context["reason"] == "fingerprint"
+        assert "rows" in ei.value.context["fields"]
+        assert aot.adopt(key_b) is None
+        assert not path_b.exists()
+        # key A's own entry is untouched and still adopts
+        assert isinstance(aot.adopt(key_a), AotExecutable)
+
+    def test_foreign_magic(self, tmp_path):
+        key, path = self._persisted(tmp_path)
+        path.write_bytes(b"not an aot entry at all" + b"\0" * 64)
+        self._assert_refused(tmp_path, key, path, "magic")
+
+    def test_garbage_header(self, tmp_path):
+        key, path = self._persisted(tmp_path)
+        hdr = b"{definitely not json"
+        path.write_bytes(AOT_MAGIC + struct.pack(">Q", len(hdr)) + hdr)
+        self._assert_refused(tmp_path, key, path, "header")
+
+    def test_non_dict_fingerprint_refused_not_crashed(self, tmp_path):
+        # valid JSON header whose fingerprint is a STRING: must take the
+        # structured fingerprint refusal, never an AttributeError escape
+        key, path = self._persisted(tmp_path)
+        hdr = json.dumps({"format": 1, "fingerprint": "xyz",
+                          "payload_bytes": 0,
+                          "payload_sha256": ""}).encode()
+        path.write_bytes(AOT_MAGIC + struct.pack(">Q", len(hdr)) + hdr)
+        self._assert_refused(tmp_path, key, path, "fingerprint")
+
+
+class TestFaultSites:
+    def test_cache_write_raise_is_failsoft(self, tmp_path, capsys):
+        key = xla_key(rows=8, events=32, batch=1)
+        cache = ExecutableCache(8, aot=AotCache(tmp_path))
+        plan = FaultPlan(seed=1, rules=[
+            {"site": "aot.cache_write", "kind": "raise"}])
+        with armed(plan):
+            cache.warm(key)                    # serving must not break
+        assert plan.fired == [("aot.cache_write", 0, "raise")]
+        assert obs.value("pyconsensus_aot_persist_total",
+                         outcome="failed") >= 1
+        assert "AOT persist" in capsys.readouterr().err
+
+    def test_cache_write_torn_then_refused_on_load(self, tmp_path):
+        key = xla_key(rows=8, events=32, batch=1)
+        cache = ExecutableCache(8, aot=AotCache(tmp_path))
+        plan = FaultPlan(seed=2, rules=[
+            {"site": "aot.cache_write", "kind": "torn_write"}])
+        with armed(plan):
+            cache.warm(key)
+        assert plan.fired == [("aot.cache_write", 0, "torn_write")]
+        (path,) = tmp_path.glob("*.aotx")
+        aot = AotCache(tmp_path)
+        with pytest.raises(AotCacheCorruptionError):
+            aot.verify(key)
+        assert aot.adopt(key) is None          # refused + deleted
+        assert not path.exists()
+
+    def test_cache_load_error_degrades_without_delete(self, tmp_path,
+                                                      capsys):
+        key = xla_key(rows=8, events=32, batch=1)
+        ExecutableCache(8, aot=AotCache(tmp_path)).warm(key)
+        (path,) = tmp_path.glob("*.aotx")
+        plan = FaultPlan(seed=3, rules=[
+            {"site": "aot.cache_load", "kind": "raise"}])
+        aot = AotCache(tmp_path)
+        with armed(plan):
+            assert aot.adopt(key) is None      # recompile this boot...
+        assert path.exists()                   # ...but keep the file
+        assert "unreadable" in capsys.readouterr().err
+        assert isinstance(aot.adopt(key), AotExecutable)  # next boot ok
+
+
+class TestServiceIntegration:
+    CFG = dict(warmup=((16, 64),), sharded_buckets=False,
+               pallas_buckets=False, batch_window_ms=1.0, max_batch=2)
+
+    def _request(self, rng):
+        return rng.choice([0.0, 1.0, np.nan], size=(12, 48),
+                          p=[.45, .45, .1])
+
+    def test_restart_parity_and_zero_retraces(self, tmp_path, rng):
+        cfg = ServeConfig(aot_cache_dir=str(tmp_path), **self.CFG)
+        m = self._request(rng)
+        with ConsensusService(cfg) as svc:
+            r1 = svc.submit(reports=m).result(120)
+        assert len(list(tmp_path.glob("*.aotx"))) == 1
+
+        before = obs.value("pyconsensus_jit_retraces_total",
+                           entry="serve_bucket") or 0
+        svc2 = ConsensusService(cfg)
+        assert svc2.warm_buckets() == 1
+        after = obs.value("pyconsensus_jit_retraces_total",
+                          entry="serve_bucket") or 0
+        assert after == before, "adopting from disk must not retrace"
+        with svc2:
+            r2 = svc2.submit(reports=m).result(120)
+        for section in ("events", "agents"):
+            for k, v in r1[section].items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(r2[section][k]),
+                    err_msg=f"{section}.{k}")
+        assert r1["iterations"] == r2["iterations"]
+
+    def test_sharded_bucket_roundtrip(self, tmp_path, rng):
+        cfg = ServeConfig(warmup=((16, 128),), sharded_buckets=True,
+                          pallas_buckets=False, max_batch=8,
+                          aot_cache_dir=str(tmp_path))
+        svc = ConsensusService(cfg)
+        svc.warm_buckets()
+        (key,) = svc.cache.keys()
+        assert key.topology != "single"
+        lanes = traffic_lanes(key, rng, R=12, E=100)
+        fresh = svc.cache.get(key)(*lanes, key.params)
+
+        svc2 = ConsensusService(cfg)
+        before = obs.value("pyconsensus_jit_retraces_total",
+                           entry="serve_bucket_sharded") or 0
+        svc2.warm_buckets()
+        adopted = svc2.cache.get(key)
+        assert isinstance(adopted, AotExecutable)
+        assert (obs.value("pyconsensus_jit_retraces_total",
+                          entry="serve_bucket_sharded") or 0) == before
+        assert_bitwise(adopted(*lanes, key.params), fresh)
+
+    def test_pallas_bucket_roundtrip(self, tmp_path, rng):
+        import jax.numpy as jnp
+
+        cfg = ServeConfig(warmup=(), pallas_warmup=((12, 48),),
+                          sharded_buckets=False, pallas_buckets=True,
+                          aot_cache_dir=str(tmp_path))
+        svc = ConsensusService(cfg)
+        svc.warm_buckets()
+        (key,) = svc.cache.keys()
+        assert key.kernel_path == "pallas"
+        acc = jnp.asarray(0.0).dtype
+        m = self._request(rng)
+        args = (jnp.asarray(m, acc),
+                jnp.asarray(np.full(12, 1 / 12), acc),
+                jnp.zeros(48, bool), jnp.zeros(48, acc),
+                jnp.ones(48, acc))
+        fresh = svc.cache.get(key)(*args, key.params)
+
+        svc2 = ConsensusService(cfg)
+        before = obs.value("pyconsensus_jit_retraces_total",
+                           entry="serve_bucket_pallas") or 0
+        svc2.warm_buckets()
+        adopted = svc2.cache.get(key)
+        assert isinstance(adopted, AotExecutable)
+        assert (obs.value("pyconsensus_jit_retraces_total",
+                          entry="serve_bucket_pallas") or 0) == before
+        assert_bitwise(adopted(*args, key.params), fresh)
+
+    def test_warm_from_disk_skips_unpersisted(self, tmp_path):
+        cfg = ServeConfig(warmup=((8, 32), (16, 64)),
+                          sharded_buckets=False, pallas_buckets=False,
+                          aot_cache_dir=str(tmp_path))
+        svc = ConsensusService(cfg)
+        # persist only the FIRST bucket
+        svc.cache.warm(svc.configured_keys()[0])
+        svc2 = ConsensusService(cfg)
+        assert svc2.warm_from_disk() == 1      # adopted, not compiled
+        assert len(svc2.cache) == 1
+        assert isinstance(
+            svc2.cache.get(svc2.configured_keys()[0]), AotExecutable)
+
+    def test_config_roundtrip(self, tmp_path):
+        cfg = ServeConfig.from_dict({"aot_cache_dir": str(tmp_path),
+                                     "warmup": [[8, 32]]})
+        assert cfg.aot_cache_dir == str(tmp_path)
+        assert ConsensusService(cfg).cache.aot is not None
+        assert ConsensusService(ServeConfig()).cache.aot is None
+
+
+class TestFleetTakeoverWarm:
+    def test_standby_warms_from_disk_in_takeover(self, tmp_path):
+        from pyconsensus_tpu.serve import ConsensusFleet, FleetConfig
+
+        aot_dir = tmp_path / "aot"
+        cfg = ServeConfig(warmup=((8, 32),), sharded_buckets=False,
+                          pallas_buckets=False,
+                          aot_cache_dir=str(aot_dir))
+        # an earlier fleet member (or boot) persisted the bucket set
+        ConsensusService(cfg).warm_buckets()
+        assert len(list(aot_dir.glob("*.aotx"))) == 1
+
+        fleet = ConsensusFleet(FleetConfig(
+            n_workers=2, worker=cfg, log_dir=str(tmp_path / "log")))
+        fleet.start(warmup=False)              # nobody compiles at boot
+        try:
+            owner = fleet.create_session("mkt", n_reporters=6)
+            block = np.tile([1.0, 0.0, 1.0, 0.0], (6, 2))
+            fleet.append("mkt", block[:, :8])
+            standby = next(n for n in fleet.workers if n != owner)
+            assert len(fleet.workers[standby].service.cache) == 0
+            before = obs.value("pyconsensus_aot_takeover_warms_total") \
+                or 0
+            fleet.kill_worker(owner)
+            # the standby adopted the persisted executable inside the
+            # takeover window — zero compiles, zero pipeline retraces
+            w = fleet.workers[standby].service
+            assert len(w.cache) == 1
+            assert isinstance(w.cache.get(w.configured_keys()[0]),
+                              AotExecutable)
+            assert obs.value("pyconsensus_aot_takeover_warms_total") \
+                == before + 1
+            assert fleet.owner_of("mkt") == standby
+        finally:
+            fleet.close(drain=True)
+
+
+#: phase scripts of the real kill-and-restart run. Phase 1 warms +
+#: persists + serves + SIGKILLs itself (the dump happens before the
+#: kill); phase 2 is the restarted process: adopt from disk, assert the
+#: zero-retrace contract, serve the same request.
+_PHASE1 = r"""
+import os, signal, sys
+import numpy as np
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+out, aot = sys.argv[1], sys.argv[2]
+cfg = ServeConfig(warmup=((16, 64),), sharded_buckets=False,
+                  pallas_buckets=False, aot_cache_dir=aot)
+svc = ConsensusService(cfg)
+svc.warm_buckets()
+svc.start(warmup=False)
+rng = np.random.default_rng(3)
+m = rng.choice([0.0, 1.0, np.nan], size=(12, 48), p=[.45, .45, .1])
+r = svc.submit(reports=m).result(300)
+np.savez(out, outcomes=np.asarray(r["events"]["outcomes_final"]),
+         smooth=np.asarray(r["agents"]["smooth_rep"]),
+         iters=np.asarray(r["iterations"]))
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+_PHASE2 = r"""
+import sys
+import numpy as np
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+out, aot = sys.argv[1], sys.argv[2]
+cfg = ServeConfig(warmup=((16, 64),), sharded_buckets=False,
+                  pallas_buckets=False, aot_cache_dir=aot)
+svc = ConsensusService(cfg)
+svc.warm_buckets()
+retr = obs.value("pyconsensus_jit_retraces_total",
+                 entry="serve_bucket") or 0
+assert retr == 0, f"restart retraced the pipeline {retr} time(s)"
+assert obs.value("pyconsensus_aot_load_total", outcome="loaded") == 1
+svc.start(warmup=False)
+rng = np.random.default_rng(3)
+m = rng.choice([0.0, 1.0, np.nan], size=(12, 48), p=[.45, .45, .1])
+r = svc.submit(reports=m).result(300)
+svc.close(drain=True)
+np.savez(out, outcomes=np.asarray(r["events"]["outcomes_final"]),
+         smooth=np.asarray(r["agents"]["smooth_rep"]),
+         iters=np.asarray(r["iterations"]))
+print("RESTART_OK")
+"""
+
+
+class TestKillAndRestart:
+    def test_sigkill_restart_zero_retraces_bitwise(self, tmp_path):
+        """The acceptance criterion, end to end with a REAL SIGKILL: a
+        process warms + persists + serves, dies by kill -9, and its
+        restart serves the first request with zero pipeline retraces
+        and bits identical to the pre-kill run."""
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                    "JAX_ENABLE_X64": "1"})
+        aot = str(tmp_path / "aot")
+        out1, out2 = str(tmp_path / "pre.npz"), str(tmp_path / "post.npz")
+        p1 = subprocess.run(
+            [sys.executable, "-c", _PHASE1, out1, aot],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert p1.returncode == -signal.SIGKILL, p1.stderr[-2000:]
+        assert os.path.exists(out1), "phase 1 died before serving"
+        import pathlib
+
+        assert list(pathlib.Path(aot).glob("*.aotx")), \
+            "phase 1 persisted nothing"
+        p2 = subprocess.run(
+            [sys.executable, "-c", _PHASE2, out2, aot],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        assert "RESTART_OK" in p2.stdout
+        pre, post = np.load(out1), np.load(out2)
+        for k in ("outcomes", "smooth", "iters"):
+            np.testing.assert_array_equal(
+                pre[k], post[k],
+                err_msg=f"restart changed {k} — the AOT executable is "
+                        f"not bit-identical to the pre-kill compile")

@@ -275,6 +275,121 @@ print(f"serve smoke OK: 50/50 succeeded at "
 PYEOF
 "$VENV/bin/pyconsensus-serve" --warmup-only --shapes 8x32 >/dev/null && echo "console script pyconsensus-serve OK"
 
+echo "=== Zero-cold-start serve smoke (ISSUE 10: warm -> SIGKILL -> restart with retraces==0; corrupt -> refuse+recompile) ==="
+# Phase 1 warms two buckets with an AOT cache dir, serves a probe
+# (result saved), and dies by REAL SIGKILL. The restarted phase 2 must
+# warm BOTH buckets from disk with the serve_bucket retrace counter at
+# 0 (zero pipeline retraces — the zero-cold-start acceptance bar) and
+# serve the same request bit-identically. Phase 3 then boots against a
+# bit-flipped cache entry: it must be REFUSED (PYC302 digest reject),
+# deleted, recompiled (retraces == 1, exactly the damaged bucket),
+# re-persisted, and the probe must still serve the pre-kill bits — a
+# corrupted executable is never loaded. See docs/SERVING.md
+# "Zero cold start".
+AOTDIR=$(mktemp -d /tmp/ci-aot-XXXX)
+set +e
+"$PY" - "$AOTDIR" <<'PYEOF'
+import os, signal, sys
+import numpy as np
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+aot = sys.argv[1]
+cfg = ServeConfig(warmup=((16, 64), (32, 128)), sharded_buckets=False,
+                  pallas_buckets=False, aot_cache_dir=aot)
+svc = ConsensusService(cfg).start()
+rng = np.random.default_rng(11)
+m = rng.choice([0.0, 1.0, np.nan], size=(12, 48), p=[.45, .45, .1])
+r = svc.submit(reports=m).result(300)
+np.savez(os.path.join(aot, "prekill.npz"),
+         outcomes=np.asarray(r["events"]["outcomes_final"]),
+         smooth=np.asarray(r["agents"]["smooth_rep"]),
+         iters=np.asarray(r["iterations"]))
+n = len([f for f in os.listdir(aot) if f.endswith(".aotx")])
+assert n == 2, f"expected 2 persisted entries, found {n}"
+print(f"aot phase 1: warmed 2 buckets, persisted {n} entries, served "
+      f"probe; dying by SIGKILL", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+PYEOF
+rc=$?
+set -e
+[ "$rc" -eq 137 ] || { echo "aot phase 1 should die by SIGKILL (rc 137), got $rc"; exit 1; }
+"$PY" - "$AOTDIR" <<'PYEOF'
+import os, sys
+import numpy as np
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+from pyconsensus_tpu.serve.aotcache import AotExecutable
+
+aot = sys.argv[1]
+cfg = ServeConfig(warmup=((16, 64), (32, 128)), sharded_buckets=False,
+                  pallas_buckets=False, aot_cache_dir=aot)
+svc = ConsensusService(cfg)
+assert svc.warm_buckets() == 2
+retr = obs.value("pyconsensus_jit_retraces_total",
+                 entry="serve_bucket") or 0
+assert retr == 0, (
+    f"restart retraced the pipeline {retr} time(s) — the persisted AOT "
+    f"entries were not adopted")
+assert obs.value("pyconsensus_aot_load_total", outcome="loaded") == 2
+assert all(isinstance(svc.cache.get(k), AotExecutable)
+           for k in svc.cache.keys())
+svc.start(warmup=False)
+rng = np.random.default_rng(11)
+m = rng.choice([0.0, 1.0, np.nan], size=(12, 48), p=[.45, .45, .1])
+r = svc.submit(reports=m).result(300)
+svc.close(drain=True)
+pre = np.load(os.path.join(aot, "prekill.npz"))
+assert np.array_equal(pre["outcomes"],
+                      np.asarray(r["events"]["outcomes_final"]))
+assert np.array_equal(pre["smooth"],
+                      np.asarray(r["agents"]["smooth_rep"]))
+assert int(pre["iters"]) == int(r["iterations"])
+print("aot phase 2 OK: restart warmed 2/2 from disk, "
+      "serve_bucket retraces == 0, probe bit-identical to pre-kill")
+PYEOF
+"$PY" - "$AOTDIR" <<'PYEOF'
+import pathlib, sys
+
+p = sorted(pathlib.Path(sys.argv[1]).glob("*.aotx"))[0]
+data = bytearray(p.read_bytes())
+data[-64] ^= 0xFF
+p.write_bytes(bytes(data))
+print(f"corrupted {p.name} (bit flip in the serialized module)")
+PYEOF
+"$PY" - "$AOTDIR" <<'PYEOF'
+import os, sys
+import numpy as np
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+aot = sys.argv[1]
+cfg = ServeConfig(warmup=((16, 64), (32, 128)), sharded_buckets=False,
+                  pallas_buckets=False, aot_cache_dir=aot)
+svc = ConsensusService(cfg)
+assert svc.warm_buckets() == 2
+assert obs.value("pyconsensus_aot_reject_total", reason="digest") == 1, \
+    "the bit-flipped entry must be refused on its content digest"
+retr = obs.value("pyconsensus_jit_retraces_total",
+                 entry="serve_bucket") or 0
+assert retr == 1, (
+    f"exactly the damaged bucket must recompile, got {retr} retraces")
+assert obs.value("pyconsensus_aot_load_total", outcome="loaded") == 1
+assert obs.value("pyconsensus_aot_persist_total", outcome="written") == 1
+svc.start(warmup=False)
+rng = np.random.default_rng(11)
+m = rng.choice([0.0, 1.0, np.nan], size=(12, 48), p=[.45, .45, .1])
+r = svc.submit(reports=m).result(300)
+svc.close(drain=True)
+pre = np.load(os.path.join(aot, "prekill.npz"))
+assert np.array_equal(pre["outcomes"],
+                      np.asarray(r["events"]["outcomes_final"]))
+assert np.array_equal(pre["smooth"],
+                      np.asarray(r["agents"]["smooth_rep"]))
+print("aot phase 3 OK: corrupted entry refused (PYC302 digest) + "
+      "deleted + recompiled + re-persisted; probe still bit-identical")
+PYEOF
+rm -rf "$AOTDIR"
+
 echo "=== Sharded serve smoke (ISSUE 6: mesh-bucketed dispatch on the 8-virtual-device mesh) ==="
 # The mesh-sharded serving hot path, end to end: a service with
 # sharded_buckets forced on engages the 2x4 (batch x event) mesh, warms
