@@ -176,6 +176,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "subprocess time-to-first-resolution with vs "
                          "without a persisted AOT executable cache, "
                          "appended to the JSON as 'cold_start')")
+    ap.add_argument("--no-econ", action="store_true",
+                    help="skip the fail-soft adversarial-economy probe "
+                         "(adaptive cartels attacking the mechanism "
+                         "through the live serve tier, appended to the "
+                         "JSON as the 'economy' key)")
+    ap.add_argument("--econ-sessions", type=int, default=1000,
+                    help="concurrent market sessions in the economy "
+                         "probe (split across --econ-strategies)")
+    ap.add_argument("--econ-rounds", type=int, default=3)
+    ap.add_argument("--econ-strategies",
+                    default="camouflage,sybil_split,flash_crowd",
+                    help="comma-separated adaptive cartel strategies "
+                         "the economy probe runs (>= 3 for the "
+                         "acceptance shape)")
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fail-soft fleet chaos probe (worker "
                          "kill mid-traffic + session failover, appended "
@@ -402,6 +416,7 @@ def run_bench(args) -> None:
     out_json["serve"] = _serve_block(args)
     out_json["cold_start"] = _cold_start_block(args)
     out_json["fleet"] = _fleet_block(args)
+    out_json["economy"] = _economy_block(args)
     print(json.dumps(out_json))
 
 
@@ -874,6 +889,58 @@ def _fleet_block(args):
             shutil.rmtree(log_dir, ignore_errors=True)
 
 
+def _economy_block(args):
+    """ISSUE 11 tentpole (c): the "is the oracle economically sound
+    under production traffic" number — an adversarial economy of
+    ``--econ-sessions`` concurrent market sessions (heterogeneous
+    shapes, mixed binary+scaled panels, stateless mirrors stressing the
+    bucket classes) attacked by ``--econ-strategies`` adaptive cartels
+    for ``--econ-rounds`` rounds through a live ConsensusService.
+    Reports cartel ROI / honest-reporter yield / time-to-catch per
+    strategy ALONGSIDE the service SLOs (p99, shed rate, occupancy) of
+    the same traffic, plus the mechanism digest that pins the whole
+    economy bit-identical under the scenario seed (the
+    deterministic-replay contract tests/test_econ.py enforces).
+    FAIL-SOFT like the serve block: any failure is a stderr WARNING
+    and a null block."""
+    if args.no_econ:
+        return None
+    try:
+        from pyconsensus_tpu.econ import MarketEconomy, build_scenario
+        from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+        strategies = tuple(s for s in args.econ_strategies.split(",")
+                           if s)
+        per = -(-max(len(strategies), args.econ_sessions)
+                // len(strategies))
+        scenario = build_scenario(
+            seed=args.serve_seed, rounds=args.econ_rounds,
+            strategies=strategies, markets_per_strategy=per,
+            concurrency=32)
+        svc = ConsensusService(ServeConfig(
+            batch_window_ms=1.0, sharded_buckets=True,
+            pallas_buckets=False)).start(warmup=False)
+        try:
+            result = MarketEconomy(svc, scenario).run()
+        finally:
+            # a failed economy must not leave the batcher thread and
+            # its queue gauges running under the remaining blocks
+            svc.close(drain=True)
+        service = dict(result["service"])
+        return {
+            "sessions": result["n_sessions"],
+            "rounds": result["rounds"],
+            "wall_s": result["wall_s"],
+            "strategies": result["per_strategy"],
+            "service": service,
+            "mechanism_digest": result["mechanism_digest"],
+        }
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: economy block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
 def _obs_columns(out) -> dict:
     """ISSUE 3: the BENCH JSON gains iteration / retrace / collective
     columns straight from the obs registry. FAIL-SOFT contract: a metric
@@ -1119,6 +1186,11 @@ def main() -> None:
     smoke_argv += ["--reporters", "256", "--events", "2048",
                    "--repeats", "2", "--batches", "2",
                    "--storage-dtype", "", "--pca-method", "auto"]
+    if "--no-econ" not in smoke_argv:
+        # a smoke proves the pipeline runs; the 1000-session economy
+        # probe is not smoke material (same honesty stance as the
+        # nulled vs_baseline)
+        smoke_argv.append("--no-econ")
     if args.scaled:
         smoke_argv += ["--scaled", str(max(1, min(args.scaled, 256)))]
     smoke_line, smoke_reason = _run_child(

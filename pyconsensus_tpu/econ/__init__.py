@@ -1,0 +1,32 @@
+"""pyconsensus_tpu.econ — the adversarial market economy (ISSUE 11):
+adaptive cartel strategies, a multi-round economy harness driving the
+live serve tier, and an economic scoreboard reporting cartel ROI /
+honest-reporter yield / time-to-catch alongside service SLOs.
+
+Quick use::
+
+    from pyconsensus_tpu.econ import MarketEconomy, build_scenario
+    from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+    svc = ConsensusService(ServeConfig()).start()
+    result = MarketEconomy(svc, build_scenario(seed=7)).run()
+    print(result["per_strategy"]["camouflage"]["cartel_roi"])
+    svc.close(drain=True)
+
+CLI front door: ``python -m pyconsensus_tpu.econ`` (see ``econ.cli``).
+Full model and scoreboard definitions: docs/ECONOMY.md.
+"""
+
+from __future__ import annotations
+
+from .economy import (MarketEconomy, MarketSpec, Scenario, build_scenario,
+                      round_panel, split_blocks)
+from .scoreboard import Scoreboard, mechanism_digest
+from .strategies import (STRATEGIES, CartelStrategy, RoundPlan,
+                         StrategyContext, make_strategy, strategy_rng)
+
+__all__ = ["MarketEconomy", "MarketSpec", "Scenario", "build_scenario",
+           "round_panel", "split_blocks", "Scoreboard",
+           "mechanism_digest", "STRATEGIES", "CartelStrategy",
+           "RoundPlan", "StrategyContext", "make_strategy",
+           "strategy_rng"]

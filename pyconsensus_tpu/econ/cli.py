@@ -1,0 +1,129 @@
+"""``python -m pyconsensus_tpu.econ`` / ``pyconsensus-econ`` — the
+adversarial-economy front door (ISSUE 11 tentpole, part d).
+
+Run a scenario from a JSON config (or the quick flags) against an
+in-process serve tier — a single :class:`ConsensusService` or an
+N-worker :class:`ConsensusFleet` — and print the scoreboard as one JSON
+document::
+
+    python -m pyconsensus_tpu.econ --strategies camouflage,sybil_split \\
+        --markets-per-strategy 8 --rounds 4 --json-out econ.json
+
+    python -m pyconsensus_tpu.econ --scenario scenario.json \\
+        --fleet-workers 2 --log-dir /shared/econ-log --metrics-out m.prom
+
+With ``--log-dir`` the markets are durable fleet sessions: re-running
+the same command over the same directory RESUMES the economy from the
+replication log (the mid-economy SIGKILL recovery path the CI stage
+exercises). ``--fault-plan`` arms a seeded chaos plan over the run,
+exactly as on the main CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .. import obs
+from ..faults import plan as _faults
+from .economy import MarketEconomy, Scenario, build_scenario
+from .strategies import STRATEGIES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m pyconsensus_tpu.econ",
+        description="adversarial market economy against the live "
+                    "serve tier")
+    ap.add_argument("--scenario", metavar="PATH",
+                    help="scenario JSON (Scenario.to_dict shape); "
+                         "overrides the quick flags below")
+    ap.add_argument("--strategies",
+                    default="camouflage,sybil_split,flash_crowd",
+                    help=f"comma-separated strategy names from "
+                         f"{sorted(STRATEGIES)}")
+    ap.add_argument("--markets-per-strategy", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--fleet-workers", type=int, default=0,
+                    help="run the economy through an N-worker fleet "
+                         "instead of a single service (needs "
+                         "--log-dir)")
+    ap.add_argument("--log-dir", default=None,
+                    help="replication-log directory: markets become "
+                         "durable fleet sessions and an existing "
+                         "directory RESUMES the economy")
+    ap.add_argument("--window-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--fault-plan", metavar="PATH",
+                    help="arm a seeded FaultPlan JSON over the run "
+                         "(activation log printed on exit)")
+    ap.add_argument("--json-out", metavar="PATH",
+                    help="also write the scoreboard JSON here")
+    ap.add_argument("--metrics-out", metavar="PATH",
+                    help="write the Prometheus exposition here")
+    return ap
+
+
+def _scenario_from(args) -> Scenario:
+    if args.scenario:
+        return Scenario.from_dict(
+            json.loads(pathlib.Path(args.scenario).read_text()))
+    return build_scenario(
+        seed=args.seed, rounds=args.rounds,
+        strategies=tuple(s for s in args.strategies.split(",") if s),
+        markets_per_strategy=args.markets_per_strategy,
+        concurrency=args.concurrency)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    scenario = _scenario_from(args)
+
+    from ..serve import ConsensusService, ServeConfig
+
+    worker_cfg = ServeConfig(batch_window_ms=args.window_ms,
+                             max_batch=args.max_batch,
+                             max_queue=args.max_queue)
+    plan = None
+    if args.fault_plan:
+        plan = _faults.arm(_faults.FaultPlan.load(args.fault_plan))
+    service = None
+    try:
+        if args.fleet_workers > 0:
+            from ..serve.fleet import ConsensusFleet, FleetConfig
+
+            if not args.log_dir:
+                print("ERROR: --fleet-workers needs --log-dir (fleet "
+                      "sessions must be durable)", file=sys.stderr)
+                return 2
+            service = ConsensusFleet(FleetConfig(
+                n_workers=args.fleet_workers, worker=worker_cfg,
+                log_dir=args.log_dir)).start(warmup=False)
+        else:
+            service = ConsensusService(worker_cfg).start(warmup=False)
+        result = MarketEconomy(service, scenario).run()
+    finally:
+        if service is not None:
+            service.close(drain=True)
+        if plan is not None:
+            _faults.disarm()
+            if plan.fired:
+                print(f"fault activations: {plan.fired}",
+                      file=sys.stderr)
+    if args.json_out:
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(result, indent=2) + "\n")
+    if args.metrics_out:
+        obs.write_prom(args.metrics_out, obs.REGISTRY)
+        print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,6 +68,7 @@ FAULT_SITES = (
     "tune.cache_write",
     "fleet.route", "fleet.heartbeat", "fleet.takeover",
     "fleet.ledger_replay",
+    "econ.round", "econ.panel", "econ.submit",
 )
 
 

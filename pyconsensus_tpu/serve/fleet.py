@@ -564,6 +564,36 @@ class ConsensusFleet:
             self._sessions[name] = owner
         return owner
 
+    def adopt_session(self, name: str) -> str:
+        """Adopt a replication log a PREVIOUS fleet left behind: verify
+        and replay ``name``'s log from ``log_dir`` onto its ring owner.
+        This is the cross-process resume path (the econ harness resumes
+        a killed economy this way): where takeover replays a dead
+        worker's log inside one fleet, adopt replays a dead FLEET's log
+        into a new one. Returns the owning worker's name; refuses a
+        corrupt log exactly as a takeover would (PYC301)."""
+        if self.config.log_dir is None:
+            raise InputError(
+                "adopt_session needs FleetConfig.log_dir (the shared "
+                "replication-log directory)")
+        _faults.fire("fleet.route")
+        with self._lock:
+            if name in self._sessions:
+                raise InputError(
+                    f"session {name!r} is already placed on this fleet")
+        owner = self.ring.owner(name)
+        session = replay_session(self.config.log_dir, name)
+        self.workers[owner].service.sessions.add(session)
+        with self._lock:
+            self._sessions[name] = owner
+        return owner
+
+    def session_state(self, name: str) -> dict:
+        """The owning worker's :meth:`MarketSession.state` snapshot,
+        routed like any session request (PYC5xx during takeovers)."""
+        w = self._session_worker(name)
+        return w.service.sessions.get(name).state()
+
     def append(self, session: str, reports_block,
                event_bounds=None) -> int:
         """Append an event block to a fleet session (durable before

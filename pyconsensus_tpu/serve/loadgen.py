@@ -22,7 +22,8 @@ import time
 import numpy as np
 
 __all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy",
-           "device_block", "kernel_path_block", "RETRYABLE_CODES"]
+           "device_block", "kernel_path_block", "quantile",
+           "RETRYABLE_CODES"]
 
 
 def kernel_path_block():
@@ -81,11 +82,18 @@ def mean_batch_occupancy():
     return ser["sum"] / ser["count"] if ser["count"] else None
 
 
-def _quantile(sorted_vals, q: float):
+def quantile(sorted_vals, q: float):
+    """Nearest-rank quantile of an ALREADY-SORTED sequence (None when
+    empty) — the one latency-quantile definition shared by the loadgen
+    summary, the bench fleet probe, and the econ scoreboard."""
     if not sorted_vals:
         return None
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
+
+
+#: backward-compatible private alias (pre-ISSUE-11 imports)
+_quantile = quantile
 
 
 def summarize(latencies, errors, wall_s: float, n_requests: int,
@@ -109,9 +117,9 @@ def summarize(latencies, errors, wall_s: float, n_requests: int,
         "wall_s": round(wall_s, 4),
         "throughput_rps": round(len(lat) / wall_s, 4) if wall_s > 0 else None,
         "latency_p50_ms": (None if not lat
-                           else round(1e3 * _quantile(lat, 0.50), 3)),
+                           else round(1e3 * quantile(lat, 0.50), 3)),
         "latency_p99_ms": (None if not lat
-                           else round(1e3 * _quantile(lat, 0.99), 3)),
+                           else round(1e3 * quantile(lat, 0.99), 3)),
         "latency_max_ms": (None if not lat
                            else round(1e3 * lat[-1], 3)),
     }

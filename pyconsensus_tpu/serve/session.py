@@ -47,7 +47,21 @@ from ..parallel.streaming import (_pass1_panel, _pass2_panel,
                                   assemble_light_result, gram_dirfix,
                                   gram_top_components)
 
-__all__ = ["MarketSession", "SessionStore"]
+__all__ = ["MarketSession", "SessionStore", "share_of"]
+
+
+def share_of(reputation, seats) -> float:
+    """Fraction of a reputation vector's mass held by ``seats`` (0.0
+    when the vector carries no positive mass). The ONE definition of
+    the share observable — :meth:`MarketSession.reputation_share`, the
+    econ strategies' post-catch observation, and the econ scoreboard
+    all compute shares through here, so the zero-mass and seat-indexing
+    conventions cannot drift apart."""
+    rep = np.asarray(reputation, dtype=np.float64)
+    total = float(rep.sum())
+    if total <= 0.0:
+        return 0.0
+    return float(rep[list(seats)].sum() / total)
 
 
 class MarketSession:
@@ -169,6 +183,27 @@ class MarketSession:
             "pyconsensus_serve_session_appends_total",
             "event blocks appended to market sessions").inc()
         return total
+
+    def state(self) -> dict:
+        """Consistent operator snapshot (one lock hold): rounds
+        resolved, the current round's staged block/event counts, and a
+        COPY of the carried reputation. The econ harness keys its
+        resume logic on this — ``staged_blocks`` tells a resumed
+        economy which appends of the current round the journal already
+        carries."""
+        with self._lock:
+            return {"session": self.name,
+                    "rounds_resolved": int(self.rounds_resolved),
+                    "staged_blocks": len(self._blocks),
+                    "staged_events": self.n_events,
+                    "reputation": np.array(self.reputation, copy=True)}
+
+    def reputation_share(self, seats) -> float:
+        """Fraction of the carried reputation held by ``seats`` — the
+        cartel-share observable the econ scoreboard reports."""
+        with self._lock:
+            rep = np.array(self.reputation, copy=True)
+        return share_of(rep, seats)
 
     # -- resolution -----------------------------------------------------
 

@@ -4,11 +4,13 @@ the sweep results."""
 
 from .collusion import (CollusionSimulator, RoundsSimulator, flat_grid,
                         generate_reports, simulate_grid)
-from .plots import (plot_retention_curves, plot_round_trajectories,
+from .plots import (plot_cartel_roi_heatmap, plot_honest_yield_curves,
+                    plot_retention_curves, plot_round_trajectories,
                     plot_sweep_heatmap, save_sweep_report)
 from .runner import CheckpointedSweep
 
 __all__ = ["CollusionSimulator", "RoundsSimulator", "generate_reports",
            "simulate_grid", "flat_grid", "CheckpointedSweep",
            "plot_sweep_heatmap", "plot_retention_curves",
-           "plot_round_trajectories", "save_sweep_report"]
+           "plot_round_trajectories", "save_sweep_report",
+           "plot_cartel_roi_heatmap", "plot_honest_yield_curves"]

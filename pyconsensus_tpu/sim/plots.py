@@ -1,6 +1,9 @@
 """Plotting helpers for collusion-sweep results (SURVEY.md §3.3 — the
 reference's sweep ends in "aggregate / plot"; these are the rebuild's
-equivalents for :meth:`CollusionSimulator.run` result dicts).
+equivalents for :meth:`CollusionSimulator.run` result dicts) and for
+the adversarial-economy scoreboard (ISSUE 11:
+:func:`plot_cartel_roi_heatmap` / :func:`plot_honest_yield_curves`
+over :meth:`~pyconsensus_tpu.econ.MarketEconomy.run` result dicts).
 
 Design rules applied: magnitude grids use a single-hue sequential colormap
 (light -> dark, never a rainbow); per-variance curves use a fixed
@@ -18,7 +21,8 @@ import numpy as np
 from ..io import ensure_parent
 
 __all__ = ["plot_sweep_heatmap", "plot_retention_curves",
-           "plot_round_trajectories", "save_sweep_report"]
+           "plot_round_trajectories", "save_sweep_report",
+           "plot_cartel_roi_heatmap", "plot_honest_yield_curves"]
 
 #: fixed categorical hue order (validated palette; assigned in order, never
 #: cycled — plot_retention_curves raises past the 8-hue budget: facet or
@@ -69,41 +73,59 @@ def _style_axes(ax):
     ax.tick_params(colors=_INK_2, labelsize=9)
 
 
+def _grid_heatmap(grid, xticks, yticks, xlabel, ylabel, title, ax=None,
+                  vmin: float = 0.0, vmax: float = 1.0,
+                  annotate: Optional[bool] = None):
+    """The shared (grid -> heatmap) core: single-hue sequential ramp
+    (Blues, light -> dark), value annotations while the grid stays
+    readable, colorbar otherwise. Both the collusion-sweep heatmaps and
+    the econ cartel-ROI heatmap render through here."""
+    plt = _require_mpl()
+    grid = np.asarray(grid, dtype=float)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(1.2 + 0.6 * len(xticks),
+                                      1.0 + 0.45 * len(yticks)), dpi=120)
+    im = ax.imshow(grid, cmap="Blues", vmin=vmin, vmax=vmax,
+                   aspect="auto", origin="lower")
+    ax.set_xticks(range(len(xticks)), [str(x) for x in xticks])
+    ax.set_yticks(range(len(yticks)), [str(y) for y in yticks])
+    ax.set_xlabel(xlabel, color=_INK, fontsize=10)
+    ax.set_ylabel(ylabel, color=_INK, fontsize=10)
+    ax.set_title(title, color=_INK, fontsize=11)
+    _style_axes(ax)
+    if annotate is None:
+        annotate = grid.size <= 60
+    if annotate:
+        # ink flips to white past the dark end of the ramp
+        dark_past = vmin + 0.6 * (vmax - vmin)
+        for i in range(grid.shape[0]):
+            for j in range(grid.shape[1]):
+                if not np.isfinite(grid[i, j]):
+                    continue
+                ax.text(j, i, f"{grid[i, j]:.2f}", ha="center",
+                        va="center", fontsize=8,
+                        color="#ffffff" if grid[i, j] > dark_past
+                        else _INK)
+    else:
+        ax.figure.colorbar(im, ax=ax, shrink=0.85)
+    return ax
+
+
 def plot_sweep_heatmap(result: dict, metric: str = "capture_rate", ax=None,
                        annotate: Optional[bool] = None):
     """Heatmap of a per-cell mean metric over the (liar_fraction x variance)
     grid. Magnitude -> single-hue sequential (Blues, light -> dark); cells
     are annotated with their values when the grid is small enough to read.
     Returns the matplotlib Axes."""
-    plt = _require_mpl()
     if metric not in result["mean"]:
         raise ValueError(f"metric {metric!r} not in result; choose from "
                          f"{sorted(result['mean'])}")
     grid = _mean_grid(result, metric)                  # (L, V)
     lf, var = result["liar_fractions"], result["variances"]
-    if ax is None:
-        _, ax = plt.subplots(figsize=(1.2 + 0.6 * len(var),
-                                      1.0 + 0.45 * len(lf)), dpi=120)
-    im = ax.imshow(grid, cmap="Blues", vmin=0.0, vmax=1.0, aspect="auto",
-                   origin="lower")
-    ax.set_xticks(range(len(var)), [f"{v:g}" for v in var])
-    ax.set_yticks(range(len(lf)), [f"{f:g}" for f in lf])
-    ax.set_xlabel("honest-reporter noise (variance)", color=_INK, fontsize=10)
-    ax.set_ylabel("liar fraction", color=_INK, fontsize=10)
-    ax.set_title(_METRIC_LABELS.get(metric, metric), color=_INK, fontsize=11)
-    _style_axes(ax)
-    if annotate is None:
-        annotate = grid.size <= 60
-    if annotate:
-        for i in range(grid.shape[0]):
-            for j in range(grid.shape[1]):
-                # ink flips to white on the dark end of the ramp
-                dark = grid[i, j] > 0.6
-                ax.text(j, i, f"{grid[i, j]:.2f}", ha="center", va="center",
-                        fontsize=8, color="#ffffff" if dark else _INK)
-    else:
-        ax.figure.colorbar(im, ax=ax, shrink=0.85)
-    return ax
+    return _grid_heatmap(
+        grid, [f"{v:g}" for v in var], [f"{f:g}" for f in lf],
+        "honest-reporter noise (variance)", "liar fraction",
+        _METRIC_LABELS.get(metric, metric), ax=ax, annotate=annotate)
 
 
 def plot_retention_curves(result: dict, metric: str = "liar_rep_share",
@@ -188,6 +210,71 @@ def plot_round_trajectories(result: dict, metric: str = "liar_rep_share",
     ax.set_axisbelow(True)
     _style_axes(ax)
     if len(lf) >= 2:
+        ax.legend(frameon=False, fontsize=8, labelcolor=_INK_2)
+    return ax
+
+
+def plot_cartel_roi_heatmap(econ_result: dict, ax=None,
+                            annotate: Optional[bool] = None):
+    """Cartel-ROI heatmap over the (strategy x round) grid of an econ
+    result dict (:meth:`~pyconsensus_tpu.econ.MarketEconomy.run`):
+    each cell is the mean reputation-captured-per-reputation-staked of
+    one strategy after that round. Renders through the same sequential
+    heatmap core as the collusion-sweep grids; the ramp tops out at the
+    observed maximum (at least 1.0), so a cell visibly darker than the
+    break-even band is a strategy the mechanism is LOSING to. Returns
+    the matplotlib Axes."""
+    traj = np.asarray(econ_result["trajectories"]["cartel_roi"],
+                      dtype=float)                     # (S, rounds)
+    if traj.ndim != 2:
+        raise ValueError(f"cartel_roi trajectory has shape {traj.shape}, "
+                         "expected (strategies, rounds) — pass a "
+                         "MarketEconomy result dict")
+    strategies = econ_result["strategies"]
+    rounds = econ_result["trajectories"]["round"]
+    vmax = max(1.0, float(np.nanmax(traj)) if np.isfinite(traj).any()
+               else 1.0)
+    return _grid_heatmap(
+        traj, [str(r) for r in rounds], strategies, "round",
+        "cartel strategy", "cartel ROI (reputation captured / staked)",
+        ax=ax, vmin=0.0, vmax=vmax, annotate=annotate)
+
+
+def plot_honest_yield_curves(econ_result: dict, ax=None):
+    """Honest-reporter yield vs round, one line per cartel strategy
+    (fixed categorical hue order; raises past the hue budget — subset
+    the scenario's strategies instead). The dashed 1.0 reference is
+    break-even: curves above it mean honest reporting GAINS share while
+    that strategy attacks — the economic-soundness picture at a glance.
+    Returns the matplotlib Axes."""
+    plt = _require_mpl()
+    traj = np.asarray(econ_result["trajectories"]["honest_yield"],
+                      dtype=float)                     # (S, rounds)
+    if traj.ndim != 2:
+        raise ValueError(f"honest_yield trajectory has shape "
+                         f"{traj.shape}, expected (strategies, rounds) "
+                         "— pass a MarketEconomy result dict")
+    strategies = econ_result["strategies"]
+    if len(strategies) > len(_SERIES):
+        raise ValueError(f"{len(strategies)} strategies exceed the "
+                         f"{len(_SERIES)}-hue categorical budget — "
+                         "subset the scenario's strategies")
+    rounds = np.asarray(econ_result["trajectories"]["round"])
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5.2, 3.4), dpi=120)
+    ax.axhline(1.0, color=_INK_2, lw=1, ls="--")
+    for k, s in enumerate(strategies):
+        ax.plot(rounds, traj[k], color=_SERIES[k], lw=2, marker="o",
+                ms=4, label=f"vs {s}")
+    ax.set_xlabel("round", color=_INK, fontsize=10)
+    ax.set_ylabel("honest-reporter yield (share / initial share)",
+                  color=_INK, fontsize=10)
+    if len(rounds) <= 15:
+        ax.set_xticks(rounds)
+    ax.grid(True, color=_GRID, lw=0.5, alpha=0.6)
+    ax.set_axisbelow(True)
+    _style_axes(ax)
+    if len(strategies) >= 2:
         ax.legend(frameon=False, fontsize=8, labelcolor=_INK_2)
     return ax
 

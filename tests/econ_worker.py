@@ -1,0 +1,48 @@
+"""Economy worker for the CI mid-economy SIGKILL stage (ISSUE 11) —
+runs a fleet-backed adversarial economy on a given replication-log
+directory, announcing round boundaries on stdout so the driver can
+``kill -9`` it mid-round. The scenario lives HERE (``make_scenario``)
+so the driver's uninterrupted reference run and the resumed run are
+guaranteed the identical economy.
+
+Usage: ``python tests/econ_worker.py <log_root>``
+"""
+
+import json
+import sys
+
+from pyconsensus_tpu.econ import MarketEconomy, build_scenario
+from pyconsensus_tpu.serve import ServeConfig
+from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+
+ROUNDS = 3
+
+
+def make_scenario():
+    return build_scenario(seed=47, rounds=ROUNDS,
+                          strategies=("camouflage", "slow_drip"),
+                          markets_per_strategy=2, concurrency=4)
+
+
+def make_fleet(log_root):
+    return ConsensusFleet(FleetConfig(
+        n_workers=2, log_dir=str(log_root),
+        worker=ServeConfig(batch_window_ms=1.0))).start(warmup=False)
+
+
+def main(log_root: str) -> int:
+    fleet = make_fleet(log_root)
+    econ = MarketEconomy(fleet, make_scenario())
+    econ.start()
+    for k in range(ROUNDS):
+        print(f"ROUND {k}", flush=True)
+        econ.run_round(k)
+        print(f"ROUND {k} done", flush=True)
+    result = econ.result()
+    fleet.close(drain=True)
+    print(json.dumps({"digest": result["mechanism_digest"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

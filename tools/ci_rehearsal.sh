@@ -744,9 +744,107 @@ PYEOF
   pyconsensus_tpu/serve/placement.py pyconsensus_tpu/serve/admission.py \
   && echo "fleet chaos (3) OK: CL601/CL701/CL801/CL802 green over the fleet modules"
 
+echo "=== Adversarial economy smoke (ISSUE 11: adaptive cartels through a 2-worker fleet) ==="
+# The economic-soundness acceptance criterion end to end: (1) a 3-round
+# camouflage-cartel economy runs through a 2-worker fleet — honest
+# reporters end every round at or above their starting reputation
+# share (honest yield >= 1), the adaptive cartel's ROI comes out < 1
+# (attacking destroyed value), every shed is a structured PYC-coded
+# error the bounded retry absorbs, and drain completes clean;
+# (2) a REAL `kill -9` lands mid-economy and a fresh fleet RESUMES the
+# economy from the replication log alone, finishing with a mechanism
+# digest bit-identical to the never-killed run (the econ determinism
+# contract — docs/ECONOMY.md).
+"$PY" - <<'PYEOF'
+import tempfile
+import numpy as np
+from pyconsensus_tpu.econ import MarketEconomy, build_scenario
+from pyconsensus_tpu.serve import ServeConfig
+from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+
+log_dir = tempfile.mkdtemp(prefix="ci-econ-")
+fleet = ConsensusFleet(FleetConfig(
+    n_workers=2, log_dir=log_dir,
+    worker=ServeConfig(batch_window_ms=1.0))).start(warmup=False)
+scenario = build_scenario(seed=101, rounds=3,
+                          strategies=("camouflage",),
+                          markets_per_strategy=3, concurrency=6)
+result = MarketEconomy(fleet, scenario).run()
+fleet.close(drain=True)                        # drain clean
+
+block = result["per_strategy"]["camouflage"]
+assert block["cartel_roi"] < 1.0, \
+    f"adaptive cartel captured value: ROI {block['cartel_roi']}"
+yld = np.asarray(result["trajectories"]["honest_yield"])[0]
+assert (yld >= 1.0 - 1e-12).all(), \
+    f"honest share fell below its stake: {yld}"
+bad = [c for c in result["service"]["errors"] if not c.startswith("PYC")]
+assert not bad, f"unstructured shed codes: {bad}"
+print(f"econ smoke OK: 9 markets x 3 rounds through the 2-worker fleet "
+      f"— cartel ROI {block['cartel_roi']:.3f} (< 1), honest yield "
+      f"{block['honest_yield']:.3f} every round >= 1, "
+      f"time-to-catch {block['time_to_catch_rounds']} round(s), "
+      f"{result['service']['sheds_observed']} sheds all PYC-coded "
+      f"({result['service']['retried']} retried), drain clean")
+PYEOF
+"$PY" - <<'PYEOF'
+import json, os, signal, subprocess, sys, tempfile, time
+
+log_root = tempfile.mkdtemp(prefix="ci-econ-kill9-")
+proc = subprocess.Popen(
+    [sys.executable, "tests/econ_worker.py", log_root],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+try:
+    deadline = time.monotonic() + 300
+    seen = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        assert line, "econ worker exited early:\n" + "".join(seen)
+        seen.append(line)
+        if line.startswith("ROUND 1\n") or line.strip() == "ROUND 1":
+            break
+    else:
+        raise SystemExit("econ worker never reached round 1")
+    # kill IMMEDIATELY on the marker: round 1 plus round 2 plus the
+    # digest print are still entirely ahead of the worker, so the kill
+    # always preempts exit — a fixed post-marker sleep would race the
+    # economy's completion on a fast machine
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=30)
+finally:
+    if proc.poll() is None:
+        proc.kill()
+assert proc.returncode == -signal.SIGKILL
+
+sys.path.insert(0, "tests")
+from econ_worker import make_fleet, make_scenario
+from pyconsensus_tpu.econ import MarketEconomy
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+# uninterrupted reference: the same scenario through a single service
+# (fleet-vs-service bit-parity is pinned by tests/test_econ.py)
+svc = ConsensusService(ServeConfig(batch_window_ms=1.0)).start(warmup=False)
+ref = MarketEconomy(svc, make_scenario()).run()
+svc.close(drain=True)
+
+fleet = make_fleet(log_root)
+resumed = MarketEconomy(fleet, make_scenario()).run()
+fleet.close(drain=True)
+assert resumed["resumed_markets"] > 0, "resume adopted nothing"
+assert resumed["mechanism_digest"] == ref["mechanism_digest"], (
+    f"resumed economy diverged: {resumed['mechanism_digest']} != "
+    f"{ref['mechanism_digest']}")
+print(f"econ kill -9 OK: worker killed inside round 1, fresh fleet "
+      f"adopted {resumed['resumed_markets']} market log(s) and finished "
+      f"the economy replay-identical to the never-killed run "
+      f"(digest {ref['mechanism_digest'][:16]}...)")
+PYEOF
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
-  --bench-timeout 300 | tail -1 | "$PY" -c \
-  "import json,sys; d=json.load(sys.stdin); print('bench JSON ok:', d['metric'])"
+  --econ-sessions 48 --econ-rounds 2 --bench-timeout 300 | tail -1 | "$PY" -c \
+  "import json,sys; d=json.load(sys.stdin); e=d['economy']; \
+print('bench JSON ok:', d['metric'], '| economy:', e['sessions'], \
+'sessions,', len(e['strategies']), 'strategies')"
 
 echo "=== CI rehearsal GREEN ==="
