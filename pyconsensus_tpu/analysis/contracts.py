@@ -621,6 +621,73 @@ def _builder_retrace_serve_bucket_sharded(spec: dict) -> List[Finding]:
     return findings
 
 
+def _incremental_avals(spec: dict):
+    """Shared arg avals for the incremental-bucket builders: three R×R
+    sufficient statistics, the round reputation, and the warm start."""
+    import jax
+
+    R, _ = _shape(spec)
+    dt = _acc_dtype()
+    return (jax.ShapeDtypeStruct((R, R), dt),     # G
+            jax.ShapeDtypeStruct((R, R), dt),     # M
+            jax.ShapeDtypeStruct((R, R), dt),     # S
+            jax.ShapeDtypeStruct((R,), dt),       # reputation
+            jax.ShapeDtypeStruct((R,), dt))       # warm_u
+
+
+def _builder_serve_bucket_incremental(spec: dict) -> str:
+    """The ``bucket_incremental`` marginal-resolve entry point
+    (serve.incremental.make_incremental_executable): warm-started power
+    iteration + dirfix/row-reward/smooth over R×R session statistics —
+    the hot path of every warm session resolve; must stay collective-,
+    callback-, f64- and bf16-compare-free."""
+    from ..serve.incremental import make_incremental_executable
+
+    fn = make_incremental_executable(_params(spec))
+    return fn.lower(*_incremental_avals(spec),
+                    _params(spec)).compile().as_text()
+
+
+def _builder_retrace_serve_bucket_incremental(spec: dict) -> List[Finding]:
+    """Dynamic check: two identical incremental dispatches share one jit
+    cache entry — the runtime mirror is the steady-state
+    ``serve_bucket_incremental`` retrace pin (one compile per warmed
+    (roster, params), then flat across every marginal resolve)."""
+    import jax.numpy as jnp
+
+    from ..serve.incremental import make_incremental_executable
+
+    R, _ = _shape(spec)
+    budget = int(spec.get("retrace_budget", 1))
+    p = _params(spec)
+    dt = _acc_dtype()
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((R, R))
+    args = [jnp.asarray(a, dt) for a in
+            (A @ A.T, rng.standard_normal((R, R)), A.T @ A,
+             np.full((R,), 1.0 / R), rng.standard_normal(R))]
+    fn = make_incremental_executable(p)
+    before = fn._cache_size()
+    fn(*args, p)
+    mid = fn._cache_size()
+    fn(*args, p)
+    after = fn._cache_size()
+    findings = []
+    if after - mid > 0:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"identical incremental re-dispatch retraced: "
+                    f"cache grew {mid} -> {after}", severity="error",
+            snippet=f"{spec['name']}:recall"))
+    if after - before > budget:
+        findings.append(Finding(
+            rule="CL304", path=f"contract:{spec['name']}", line=0,
+            message=f"two dispatches grew the jit cache by "
+                    f"{after - before} (> budget {budget})",
+            severity="error", snippet=f"{spec['name']}:budget"))
+    return findings
+
+
 BUILDERS: Dict[str, Callable] = {
     "pipeline_sharded": _builder_pipeline_sharded,
     "pipeline_single": _builder_pipeline_single,
@@ -635,6 +702,9 @@ BUILDERS: Dict[str, Callable] = {
     "retrace_serve_bucket": _builder_retrace_serve_bucket,
     "serve_bucket_sharded": _builder_serve_bucket_sharded,
     "retrace_serve_bucket_sharded": _builder_retrace_serve_bucket_sharded,
+    "serve_bucket_incremental": _builder_serve_bucket_incremental,
+    "retrace_serve_bucket_incremental":
+        _builder_retrace_serve_bucket_incremental,
 }
 
 

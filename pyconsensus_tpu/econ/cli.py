@@ -59,6 +59,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--window-ms", type=float, default=2.0)
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--incremental", action="store_true",
+                    help="serve the economy's market sessions through "
+                         "the bucket_incremental marginal-resolve tier "
+                         "(ISSUE 12) — the natural fit for slow_drip / "
+                         "per-round re-resolution traffic. Continuous "
+                         "reputations then sit within the documented "
+                         "drift band between exact refreshes, so the "
+                         "mechanism digest matches a full-resolve run "
+                         "only at --refresh-every 1")
+    ap.add_argument("--refresh-every", type=int, default=None,
+                    metavar="K",
+                    help="incremental exact-refresh cadence (with "
+                         "--incremental; default: the tier default)")
     ap.add_argument("--fault-plan", metavar="PATH",
                     help="arm a seeded FaultPlan JSON over the run "
                          "(activation log printed on exit)")
@@ -86,9 +99,21 @@ def main(argv=None) -> int:
 
     from ..serve import ConsensusService, ServeConfig
 
+    if args.refresh_every is not None and not args.incremental:
+        # refuse rather than silently ignore: a cadence without the
+        # tier has no effect, and the operator should learn that here
+        print("ERROR: --refresh-every requires --incremental (the "
+              "cadence configures the incremental tier's exact-refresh "
+              "anchor)", file=sys.stderr)
+        return 2
+    incr = {}
+    if args.incremental:
+        incr["incremental_sessions"] = True
+        if args.refresh_every is not None:
+            incr["incremental_refresh_every"] = int(args.refresh_every)
     worker_cfg = ServeConfig(batch_window_ms=args.window_ms,
                              max_batch=args.max_batch,
-                             max_queue=args.max_queue)
+                             max_queue=args.max_queue, **incr)
     plan = None
     if args.fault_plan:
         plan = _faults.arm(_faults.FaultPlan.load(args.fault_plan))

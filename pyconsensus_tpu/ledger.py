@@ -92,6 +92,14 @@ class ReputationLedger:
         self.round = 0                 # guarded-by: none
         #: per-round scalars: certainty / participation / convergence
         self.history: list[dict] = []
+        #: auxiliary numeric state checkpointed ATOMICALLY with the
+        #: round commit (optional ``aux__*`` npz fields; absent in older
+        #: checkpoints, which load with an empty dict). The serve
+        #: layer's incremental sessions carry their warm eigenstate
+        #: here so replication-log replay restores the exact bits the
+        #: never-killed session would hold (docs/SERVING.md,
+        #: ``bucket_incremental``).
+        self.aux: dict = {}            # guarded-by: none
 
     # -- rounds --------------------------------------------------------------
 
@@ -149,7 +157,7 @@ class ReputationLedger:
     # -- checkpoint / resume -------------------------------------------------
 
     def _state_tree(self) -> dict:
-        return {
+        state = {
             "format_version": np.int64(_FORMAT_VERSION),
             "reputation": self.reputation,
             "round": np.int64(self.round),
@@ -159,6 +167,9 @@ class ReputationLedger:
                 json.dumps(self.oracle_kwargs,
                            default=_json_scalar).encode(), dtype=np.uint8),
         }
+        for key, value in self.aux.items():
+            state[f"aux__{key}"] = np.asarray(value)
+        return state
 
     def save(self, path, format: str = "npz") -> None:
         """Serialize full ledger state to ``path``.
@@ -247,7 +258,17 @@ class ReputationLedger:
                 raise bad(field, f"decodes to "
                           f"{type(decoded[field]).__name__}, expected "
                           f"{expect.__name__}")
-        return {"reputation": rep, "round": rnd, **decoded}
+        aux = {}
+        for key in keys:
+            if not key.startswith("aux__"):
+                continue
+            arr = np.asarray(data[key])
+            if arr.dtype.kind not in "fiu":
+                raise bad(key, f"has non-numeric dtype {arr.dtype}")
+            if not np.isfinite(arr.astype(np.float64)).all():
+                raise bad(key, "contains non-finite values")
+            aux[key[len("aux__"):]] = arr
+        return {"reputation": rep, "round": rnd, "aux": aux, **decoded}
 
     @classmethod
     def _from_state(cls, state, source="checkpoint") -> "ReputationLedger":
@@ -267,6 +288,8 @@ class ReputationLedger:
         ledger.reputation = rep          # verbatim — no re-normalization,
         ledger.round = state["round"]    # resume is bit-exact
         ledger.history = state["history"]
+        ledger.aux = {k: np.asarray(v)
+                      for k, v in state.get("aux", {}).items()}
         return ledger
 
     @classmethod

@@ -86,7 +86,7 @@ from ..oracle import parse_event_bounds
 from .mesh import effective_median_block
 
 __all__ = ["streaming_consensus", "gram_dirfix", "gram_top_components",
-           "assemble_light_result"]
+           "gram_warm_pc", "gram_pc_scores", "assemble_light_result"]
 
 #: R above which the streamed spectrum comes from orthogonal iteration on
 #: the explicit Gram accumulator instead of ``jnp.linalg.eigh`` — the
@@ -365,11 +365,64 @@ def gram_dirfix(scores, rep_ref, S):
                      set1, -set2)
 
 
-def gram_top_components(G, M, rep_ref, k: int):
+def gram_warm_pc(G, rep_ref, warm_u, n_iters: int = 96,
+                 tol: float = 0.0):
+    """Dominant eigenpair of the normalized Gram accumulator
+    ``Gd = G / (1 - sum(rep^2))`` by power iteration warm-started from
+    ``warm_u`` — the previous round's principal component. Across
+    serving rounds the reputation and the market's report distribution
+    move a little, so ``Gd`` moves a little and the stale eigenvector is
+    an excellent start: the alignment early exit fires after a few
+    O(R²) matvecs where a cold eigh pays O(R³) every time (the
+    ``bucket_incremental`` marginal-resolve algebra). Safety inherits
+    :func:`..ops.jax_kernels._power_loop`'s warm-seed blend — a stale
+    vector can never pass the self-consistency exit while sitting on a
+    demoted eigenvector, because the cold dense seed is mixed back in.
+    ``warm_u=None`` / all-zero falls back to the cold deterministic
+    seed (bitwise the cold start). Returns ``(u, sweeps)`` — the
+    unit-norm dominant eigenvector approximation and the executed
+    in-loop matvec count (the warm-start savings observable)."""
+    denom = 1.0 - jnp.sum(rep_ref ** 2)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    Gd = G / denom
+    return jk._power_loop(lambda v: Gd @ v, G.shape[0], Gd.dtype,
+                          n_iters, tol, v_init=warm_u)
+
+
+def gram_pc_scores(G, M, u):
+    """Scores + first-loading operand from ONE principal component of
+    the Gram accumulator: ``||A^T u|| = sqrt(u^T G u)`` (no extra pass
+    over the source), ``scores = M (u / ||A^T u||)``. The SINGLE copy
+    of the k=1 scoring identity — :func:`gram_top_components`' warm
+    branch and the serve layer's ``bucket_incremental`` kernel both
+    score through here, so the parity the tier's drift band depends on
+    can never drift between two hand-maintained copies. Returns
+    ``(scores (R,), u_over_nAu (R,), nAu scalar)``."""
+    nAu = jnp.sqrt(jnp.clip(u @ (G @ u), 0.0, None))
+    u_over_nAu = u / jnp.where(nAu == 0.0, 1.0, nAu)
+    return M @ u_over_nAu, u_over_nAu, nAu
+
+
+def gram_top_components(G, M, rep_ref, k: int, warm_u=None, delta=None,
+                        warm_iters: int = 96, warm_tol: float = 0.0):
     """Top-k loadings' scores + explained fractions off the Gram
     accumulator (the full nonzero covariance spectrum lives in G —
     jax_kernels.weighted_prin_comps' eigh-gram route, streamed).
     Returns ``(scores (R, k), explained (k,), U (R, k), nAu (k,))``.
+
+    ``delta`` (optional, ``(dG, dM)``): an appended-block low-rank
+    update folded in before solving — callers holding pinned base
+    statistics (e.g. a speculative resolve that must not mutate a
+    session's accumulators) pass the block's ``_pass1_panel``
+    contributions here instead of materializing updated copies.
+    ``warm_u`` (optional, k=1 only): an eigenpair warm start — the
+    spectrum comes from :func:`gram_warm_pc`'s warm-started power
+    iteration instead of a cold ``eigh``/orthogonal-iteration solve.
+    This is the ``bucket_incremental`` serve tier's marginal-resolve
+    path (docs/SERVING.md): continuous outputs then sit within the
+    documented drift band of the exact solve rather than matching it
+    bitwise, which is why the tier pins an exact refresh every K
+    rounds.
 
     Above ``STREAM_EIGH_MAX_R`` reporters the top-k subspace comes
     from blocked orthogonal iteration on the explicit symmetric
@@ -383,11 +436,27 @@ def gram_top_components(G, M, rep_ref, k: int):
     so explained fractions need no full spectrum. Module-level
     (extracted from the streaming driver's closure) — shared with the
     serving layer's session resolution."""
+    if delta is not None:
+        dG, dM = delta
+        G = G + dG
+        M = M + dM
     R = G.shape[0]
     denom = 1.0 - jnp.sum(rep_ref ** 2)
     denom = jnp.where(denom == 0.0, 1.0, denom)
     Gd = G / denom
-    if R <= STREAM_EIGH_MAX_R:
+    if warm_u is not None:
+        if k != 1:
+            raise ValueError(
+                f"gram_top_components: an eigenpair warm start serves "
+                f"the dominant component only (k=1), got k={k}")
+        u, _ = gram_warm_pc(G, rep_ref, warm_u, n_iters=warm_iters,
+                            tol=warm_tol)
+        U = u[:, None]                                # (R, 1)
+        lam = jnp.clip(u @ (Gd @ u), 0.0, None)[None]  # Rayleigh value
+        total = jnp.clip(jnp.trace(Gd), 0.0, None)
+        scores_1, _, nAu_1 = gram_pc_scores(G, M, u)
+        scores, nAu = scores_1[:, None], nAu_1[None]
+    elif R <= STREAM_EIGH_MAX_R:
         eigvals, eigvecs = jnp.linalg.eigh(Gd)
         lam = jnp.clip(eigvals[::-1][:k], 0.0, None)
         U = eigvecs[:, ::-1][:, :k]                   # (R, k)
@@ -399,9 +468,12 @@ def gram_top_components(G, M, rep_ref, k: int):
             "of eigh (R > STREAM_EIGH_MAX_R)").inc()
         lam, U = _sym_topk(Gd, k)
         total = jnp.clip(jnp.trace(Gd), 0.0, None)
-    # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the source
-    nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0, None))
-    scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
+    if warm_u is None:
+        # ||A^T u_c|| = sqrt(u_c^T G u_c) — no extra pass over the
+        # source (the warm branch scored above via gram_pc_scores)
+        nAu = jnp.sqrt(jnp.clip(jnp.sum(U * (G @ U), axis=0), 0.0,
+                                None))
+        scores = M @ (U / jnp.where(nAu == 0.0, 1.0, nAu)[None, :])
     # explained-variance discrepancy bound across the
     # STREAM_EIGH_MAX_R switch: below the cap, lam and total come
     # from the SAME eigh, so the fractions equal the in-memory

@@ -23,6 +23,11 @@ Guarantees (docs/SERVING.md):
 - overload is shed deterministically with ``ServiceOverloadError``
   (PYC401) at admission or at deadline — queues are bounded, waits are
   deadlined;
+- incremental sessions (``serve.incremental``, ISSUE 12) make the
+  marginal resolve O(update): the dominant eigenpair is maintained
+  across rounds by warm-started power iteration, with continuous drift
+  pinned to a documented band by an exact resolve every K rounds
+  (bit-identical to the non-incremental path at every refresh);
 - the replicated fleet (``serve.fleet``, ISSUE 8) survives any worker's
   death mid-traffic: consistent-hash placement moves only the dead
   worker's sessions, the replication log (ledger checkpoints + staged
@@ -41,6 +46,10 @@ from .aotcache import AOT_ENTRY, AotCache, AotExecutable
 from .cache import BucketKey, ExecutableCache, warm_inputs
 from .failover import DurableSession, ReplicationLog, replay_session
 from .fleet import ConsensusFleet, FleetConfig, FleetWorker
+from .incremental import (INCREMENTAL_KERNEL_PATH,
+                          INCREMENTAL_REFRESH_DEFAULT,
+                          incremental_consensus, incremental_drift_band,
+                          make_incremental_executable)
 from .kernels import (SERVE_ALGORITHMS, bucket_inputs, bucket_path_eligible,
                       make_bucket_executable, padded_consensus, slice_result)
 from .loadgen import LoadGenerator
@@ -71,4 +80,7 @@ __all__ = [
     "PlacementError",
     "AotCache", "AotExecutable", "AOT_ENTRY", "AotCacheCorruptionError",
     "warm_inputs",
+    "INCREMENTAL_KERNEL_PATH", "INCREMENTAL_REFRESH_DEFAULT",
+    "incremental_consensus", "incremental_drift_band",
+    "make_incremental_executable",
 ]

@@ -34,6 +34,7 @@ from ..faults import plan as _faults
 from ..oracle import Oracle, assemble_result, record_consensus_result
 from . import kernels as sk
 from .cache import BucketKey
+from .incremental import kernel_path_counter
 from .pallas import PALLAS_KERNEL_PATH, pallas_bucket_inputs
 from .sharded import SINGLE_TOPOLOGY, topology_event_shards
 
@@ -76,11 +77,10 @@ class Microbatcher:
             "pyconsensus_serve_batch_occupancy",
             "requests coalesced per bucketed dispatch",
             buckets=OCCUPANCY_BUCKETS)
-        self._kernel_path = obs.counter(
-            "pyconsensus_kernel_path_total",
-            "resolutions dispatched by kernel family (which kernel "
-            "family actually served traffic — the bench obs block's "
-            "path breakdown)", labels=("path",))
+        # the ONE registration site (serve.incremental) — a second
+        # hand-maintained literal here could silently drift its help
+        # text by import order
+        self._kernel_path = kernel_path_counter()
 
     # -- lifecycle ------------------------------------------------------
 
@@ -275,7 +275,17 @@ class Microbatcher:
         flat = session.resolve(**req.oracle_kwargs)
         result = assemble_result(flat)
         result["quarantined_rows"] = np.array([], dtype=np.int64)
-        self._finish(req, result, "session")
+        # the incremental tier's dispatches (warm marginal resolves AND
+        # their anchoring exact refreshes — both are the tier) are
+        # labeled bucket_incremental; the session itself counts the
+        # warm kernel under pyconsensus_kernel_path_total, so the
+        # counter is honest for direct (non-service) session use too.
+        # Reading after resolve is race-free: this thread is the only
+        # dispatcher.
+        path = ("bucket_incremental"
+                if getattr(session, "last_resolve_path", None)
+                in ("incremental", "incremental_exact") else "session")
+        self._finish(req, result, path)
 
     def _finish(self, req, result, path: str) -> None:
         if not req.future.done():

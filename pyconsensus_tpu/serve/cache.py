@@ -43,6 +43,8 @@ from .. import obs
 from ..faults import plan as _faults
 from . import kernels as sk
 from .aotcache import AotExecutable
+from .incremental import (INCREMENTAL_KERNEL_PATH,
+                          make_incremental_executable)
 from .pallas import (PALLAS_KERNEL_PATH, XLA_KERNEL_PATH,
                      make_pallas_bucket_executable)
 from .sharded import (SINGLE_TOPOLOGY, make_sharded_bucket_executable,
@@ -63,6 +65,16 @@ def warm_inputs(key) -> list:
     rows, events, batch = key.rows, key.events, key.batch
     acc = jnp.asarray(0.0).dtype
     p = key.params
+    if key.kernel_path == INCREMENTAL_KERNEL_PATH:
+        # the incremental executable consumes R×R sufficient statistics
+        # (events = 0 in the key — no panel ever enters it): zero stats
+        # plus a zero warm start compile the full graph (the power
+        # loop's zero-product guard exits on the first sweep; a zero
+        # v_init falls back to the cold deterministic seed)
+        Z = np.zeros((rows, rows))
+        return [jnp.asarray(a, dtype=acc)
+                for a in (Z, Z, Z, np.full((rows,), 1.0 / rows),
+                          np.zeros((rows,)))]
     reports = np.zeros((rows, events))
     if p.has_na:
         reports[-1, 0] = np.nan     # exercise the fill graph
@@ -96,9 +108,11 @@ class BucketKey(tuple):
     two distinct executables and can never be cross-served.
     ``kernel_path`` (ISSUE 7 tentpole c) keys the executable FAMILY the
     same way: ``"xla"`` is the padded bucket kernel, ``"pallas"`` the
-    fused low-latency pipeline at exact shape — one (shape, params) on
-    two kernel paths is two distinct executables that can never collide
-    in the cache."""
+    fused low-latency pipeline at exact shape, ``"incremental"``
+    (ISSUE 12) the warm-started marginal-resolve kernel over R×R
+    session statistics (rows = roster, events = 0 — no panel enters
+    it) — one (shape, params) on two kernel paths is two distinct
+    executables that can never collide in the cache."""
 
     __slots__ = ()
 
@@ -236,11 +250,21 @@ class ExecutableCache:
                     f"bucket_pallas keys are single-topology by "
                     f"definition, got {topology!r}")
             return make_pallas_bucket_executable(key.params)
+        if key.kernel_path == INCREMENTAL_KERNEL_PATH:
+            # the incremental class scores R×R statistics on the host
+            # device — single-topology by definition (a mesh belongs to
+            # the panel-shaped throughput tiers)
+            if topology != SINGLE_TOPOLOGY:
+                raise ValueError(
+                    f"bucket_incremental keys are single-topology by "
+                    f"definition, got {topology!r}")
+            return make_incremental_executable(key.params)
         if key.kernel_path != XLA_KERNEL_PATH:
             raise ValueError(f"unknown bucket kernel path "
                              f"{key.kernel_path!r} (expected "
-                             f"{XLA_KERNEL_PATH!r} or "
-                             f"{PALLAS_KERNEL_PATH!r})")
+                             f"{XLA_KERNEL_PATH!r}, "
+                             f"{PALLAS_KERNEL_PATH!r} or "
+                             f"{INCREMENTAL_KERNEL_PATH!r})")
         if topology == SINGLE_TOPOLOGY:
             return sk.make_bucket_executable(key.params,
                                              batched=key.batch > 1)

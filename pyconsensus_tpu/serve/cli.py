@@ -70,6 +70,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(ISSUE 7): auto = TPU backend only, on = any "
                          "backend (interpreter off-TPU), off = padded "
                          "XLA buckets only")
+    ap.add_argument("--incremental", dest="incremental",
+                    action="store_true", default=None,
+                    help="sessions created through the service ride "
+                         "the bucket_incremental marginal-resolve tier "
+                         "(ISSUE 12): warm-started eigenpair "
+                         "maintenance with an exact refresh every "
+                         "--refresh-every rounds")
+    ap.add_argument("--no-incremental", dest="incremental",
+                    action="store_false",
+                    help="force the incremental session tier OFF "
+                         "(overrides --config), the standard --no-* "
+                         "opt-out")
+    ap.add_argument("--refresh-every", type=int, default=None,
+                    metavar="K",
+                    help="incremental tier exact-refresh cadence "
+                         "(>= 1; K-1 warm resolves ride between exact "
+                         "anchors — the staleness contract's knob)")
     ap.add_argument("--aot-cache", metavar="DIR", default=None,
                     help="zero-cold-start AOT executable cache "
                          "directory (ISSUE 10): warmed bucket "
@@ -105,6 +122,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                        "off": False}[args.pallas_buckets]
     if args.aot_cache is not None:
         overrides["aot_cache_dir"] = args.aot_cache
+    if args.incremental is not None:
+        overrides["incremental_sessions"] = bool(args.incremental)
+    if args.refresh_every is not None:
+        overrides["incremental_refresh_every"] = int(args.refresh_every)
     if overrides:
         cfg = ServeConfig.from_dict({**cfg.__dict__, **overrides})
 

@@ -46,6 +46,7 @@ import hashlib
 import json
 import pathlib
 import re
+from typing import Optional
 
 import numpy as np
 
@@ -54,6 +55,7 @@ from ..faults import plan as _faults
 from ..io import atomic_write
 from ..ledger import ReputationLedger
 from ..oracle import parse_event_bounds
+from .incremental import INCREMENTAL_REFRESH_DEFAULT
 from .session import MarketSession
 
 __all__ = ["ReplicationLog", "DurableSession", "replay_session"]
@@ -91,7 +93,9 @@ class ReplicationLog:
     @classmethod
     def create(cls, root, name: str, n_reporters: int,
                alpha: float = 0.1, catch_tolerance: float = 0.1,
-               convergence_tolerance: float = 1e-6) -> "ReplicationLog":
+               convergence_tolerance: float = 1e-6,
+               incremental: bool = False,
+               refresh_every: Optional[int] = None) -> "ReplicationLog":
         log = cls(root, name)
         if log.meta_path.exists():
             raise InputError(
@@ -101,7 +105,15 @@ class ReplicationLog:
         meta = {"session": log.name, "n_reporters": int(n_reporters),
                 "alpha": float(alpha),
                 "catch_tolerance": float(catch_tolerance),
-                "convergence_tolerance": float(convergence_tolerance)}
+                "convergence_tolerance": float(convergence_tolerance),
+                # incremental-tier policy (ISSUE 12): persisted so a
+                # standby resumes the SAME refresh cadence — optional
+                # fields, absent in pre-incremental logs (which replay
+                # as plain exact sessions)
+                "incremental": bool(incremental),
+                "refresh_every": int(
+                    INCREMENTAL_REFRESH_DEFAULT if refresh_every is None
+                    else refresh_every)}
 
         def write(tmp):
             pathlib.Path(tmp).write_text(json.dumps(meta, indent=2))
@@ -290,15 +302,22 @@ class DurableSession(MarketSession):
     def create(cls, log_root, name: str, n_reporters: int,
                reputation=None, alpha: float = 0.1,
                catch_tolerance: float = 0.1,
-               convergence_tolerance: float = 1e-6) -> "DurableSession":
+               convergence_tolerance: float = 1e-6,
+               incremental: bool = False,
+               refresh_every: int = INCREMENTAL_REFRESH_DEFAULT,
+               executable_provider=None) -> "DurableSession":
         log = ReplicationLog.create(
             log_root, name, n_reporters, alpha=alpha,
             catch_tolerance=catch_tolerance,
-            convergence_tolerance=convergence_tolerance)
+            convergence_tolerance=convergence_tolerance,
+            incremental=incremental, refresh_every=refresh_every)
         ledger = ReputationLedger(n_reporters, reputation=reputation)
         session = cls(log, n_reporters, ledger, alpha=alpha,
                       catch_tolerance=catch_tolerance,
-                      convergence_tolerance=convergence_tolerance)
+                      convergence_tolerance=convergence_tolerance,
+                      incremental=incremental,
+                      refresh_every=refresh_every,
+                      executable_provider=executable_provider)
         # commit round 0: the starting reputation is durable before the
         # first append, so a standby replaying an empty journal starts
         # from the same prior the caller configured
@@ -409,7 +428,8 @@ class DurableSession(MarketSession):
         return result
 
 
-def replay_session(log_root, name: str) -> DurableSession:
+def replay_session(log_root, name: str,
+                   executable_provider=None) -> DurableSession:
     """Hot-standby takeover of one session: verify the dead worker's
     log (preflight — no corrupt log is ever adopted), rebuild the ledger
     bit-exactly, and re-fold the journaled staged blocks in append
@@ -442,7 +462,16 @@ def replay_session(log_root, name: str) -> DurableSession:
         log, int(meta["n_reporters"]), ledger,
         alpha=float(meta["alpha"]),
         catch_tolerance=float(meta["catch_tolerance"]),
-        convergence_tolerance=float(meta["convergence_tolerance"]))
+        convergence_tolerance=float(meta["convergence_tolerance"]),
+        # incremental policy from the meta (optional fields — a
+        # pre-incremental log replays as a plain exact session); the
+        # warm eigenstate itself rides the ledger's aux checkpoint, so
+        # a warm standby continues the EXACT warm trajectory the dead
+        # worker was on
+        incremental=bool(meta.get("incremental", False)),
+        refresh_every=int(meta.get("refresh_every",
+                                   INCREMENTAL_REFRESH_DEFAULT)),
+        executable_provider=executable_provider)
     for block, bounds in staged:
         # fold WITHOUT re-journaling (the records already exist):
         # MarketSession.append is the identical arithmetic the dead

@@ -339,7 +339,10 @@ class ConsensusFleet:
                         # scan is the same work every time
                         warmed_owners.add(new_owner)
                         self._warm_standby(new_owner)
-                    session = replay_session(self.config.log_dir, name)
+                    session = replay_session(
+                        self.config.log_dir, name,
+                        executable_provider=self.workers[
+                            new_owner].service.incremental_executable_for)
                     self.workers[new_owner].service.sessions.add(session)
                     # the fenced stale object leaves the dead worker's
                     # store: the session lives in exactly ONE store, so
@@ -557,6 +560,10 @@ class ConsensusFleet:
                 "cannot fail over")
         _faults.fire("fleet.route")
         owner = self.ring.owner(name)
+        # the owning worker's incremental policy + executable provider
+        # apply (every worker runs the same ServeConfig, so the policy
+        # is fleet-uniform; the provider binds to the owner's cache)
+        kwargs = self.workers[owner].service.session_defaults(kwargs)
         session = DurableSession.create(self.config.log_dir, name,
                                         n_reporters, **kwargs)
         self.workers[owner].service.sessions.add(session)
@@ -582,7 +589,10 @@ class ConsensusFleet:
                 raise InputError(
                     f"session {name!r} is already placed on this fleet")
         owner = self.ring.owner(name)
-        session = replay_session(self.config.log_dir, name)
+        session = replay_session(
+            self.config.log_dir, name,
+            executable_provider=self.workers[
+                owner].service.incremental_executable_for)
         self.workers[owner].service.sessions.add(session)
         with self._lock:
             self._sessions[name] = owner

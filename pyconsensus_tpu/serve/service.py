@@ -40,6 +40,8 @@ from ..oracle import ALGORITHMS, BACKENDS, parse_event_bounds
 from .admission import AdmissionController
 from .batcher import Microbatcher
 from .cache import BucketKey, ExecutableCache
+from .incremental import (INCREMENTAL_KERNEL_PATH,
+                          INCREMENTAL_REFRESH_DEFAULT)
 from .kernels import bucket_path_eligible
 from .pallas import (PALLAS_KERNEL_PATH, pallas_bucket_eligible,
                      pallas_bucket_params)
@@ -115,6 +117,20 @@ class ServeConfig:
     #: traffic (the low-latency tier's warmup ladder; unlike ``warmup``
     #: these are true request shapes, not bucket shapes)
     pallas_warmup: tuple = ()
+    #: incremental session tier (ISSUE 12): sessions created through
+    #: this service maintain the dominant eigenpair of their round
+    #: statistics across rounds (warm-started power iteration — the
+    #: O(update) marginal resolve, dispatch path ``bucket_incremental``)
+    #: instead of cold-eigh'ing every ``resolve()``. False (default)
+    #: keeps every session resolve exact; per-session ``incremental=``
+    #: kwargs override either way.
+    incremental_sessions: bool = False
+    #: the staleness contract's exact-refresh cadence K: one exact
+    #: (eigh) resolve anchors every K rounds, pinning the warm path's
+    #: continuous drift to the documented band (docs/SERVING.md).
+    #: Must be >= 1 (1 = every resolve exact); 0/negative is refused
+    #: with a structured InputError (PYC101) at service construction.
+    incremental_refresh_every: int = INCREMENTAL_REFRESH_DEFAULT
     #: zero-cold-start AOT executable cache directory (ISSUE 10): warmed
     #: bucket executables are AOT-serialized here and a restarted (or
     #: autoscaled, or failed-over) process warms from disk with zero
@@ -156,6 +172,17 @@ class ConsensusService:
             raise InputError("bucket ladders must be ascending")
         if self.config.max_batch < 1:
             raise InputError("max_batch must be >= 1")
+        if int(self.config.incremental_refresh_every) < 1:
+            # PYC101 by contract: a 0/negative cadence would silently
+            # remove the incremental tier's exact-refresh staleness
+            # anchor — refuse loudly at construction instead
+            raise InputError(
+                f"incremental_refresh_every must be >= 1 (the exact "
+                f"resolve every K rounds is the incremental tier's "
+                f"staleness-bound contract), got "
+                f"{self.config.incremental_refresh_every}",
+                incremental_refresh_every=(
+                    self.config.incremental_refresh_every))
         self.queue = RequestQueue(self.config.max_queue)
         self.mesh = self._build_mesh()
         aot = None
@@ -466,9 +493,38 @@ class ConsensusService:
 
     # -- sessions -------------------------------------------------------
 
+    def incremental_executable_for(self, n_reporters: int, params):
+        """The ``bucket_incremental`` executable provider sessions
+        created through this service resolve with: a per-roster
+        BucketKey (rows = R, events = 0 — the executable consumes R×R
+        statistics, never a panel) in the LRU executable cache, so the
+        warm kernels share the cache's eviction, hit/miss metrics, and
+        the ``serve_bucket_incremental`` retrace accounting with every
+        other bucket class."""
+        key = BucketKey.make(n_reporters, 0, 1, params, SINGLE_TOPOLOGY,
+                             kernel_path=INCREMENTAL_KERNEL_PATH)
+        return self.cache.get(key)
+
+    def session_defaults(self, kwargs: dict) -> dict:
+        """Session-construction kwargs with this service's incremental
+        policy and executable provider threaded in — shared by
+        :meth:`create_session` and the fleet's durable-session
+        creation, so both front doors apply one policy."""
+        kwargs = dict(kwargs)
+        if self.config.incremental_sessions:
+            kwargs.setdefault("incremental", True)
+        if kwargs.get("incremental"):
+            kwargs.setdefault(
+                "refresh_every",
+                int(self.config.incremental_refresh_every))
+        kwargs.setdefault("executable_provider",
+                          self.incremental_executable_for)
+        return kwargs
+
     def create_session(self, name: str, n_reporters: int, **kwargs):
         """Create a named market session (see ``serve.session``)."""
-        return self.sessions.create(name, n_reporters, **kwargs)
+        return self.sessions.create(name, n_reporters,
+                                    **self.session_defaults(kwargs))
 
     def append(self, session: str, reports_block,
                event_bounds=None) -> int:

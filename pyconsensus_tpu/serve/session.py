@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
-from ..faults import InputError
+from ..faults import CheckpointCorruptionError, InputError
 from ..faults import plan as _faults
 from ..ledger import ReputationLedger
 from ..ops import jax_kernels as jk
@@ -46,6 +46,9 @@ from ..oracle import parse_event_bounds
 from ..parallel.streaming import (_pass1_panel, _pass2_panel,
                                   assemble_light_result, gram_dirfix,
                                   gram_top_components)
+from .incremental import (INCREMENTAL_REFRESH_DEFAULT,
+                          incremental_executable, incremental_params,
+                          kernel_path_counter)
 
 __all__ = ["MarketSession", "SessionStore", "share_of"]
 
@@ -82,12 +85,29 @@ class MarketSession:
         session across process restarts.
     alpha, catch_tolerance, convergence_tolerance :
         The Oracle knobs the statistics path honors.
+    incremental : bool
+        Enable the ``bucket_incremental`` marginal-resolve tier
+        (ISSUE 12): the dominant eigenpair of the round statistics is
+        maintained across rounds by warm-started power iteration
+        seeded from the previous round's principal component, with an
+        exact (eigh) resolve every ``refresh_every`` rounds anchoring
+        the staleness contract (docs/SERVING.md).
+    refresh_every : int
+        The exact-refresh cadence K (>= 1; 1 = every resolve exact).
+    executable_provider : callable or None
+        ``(n_reporters, params) -> executable`` hook resolving the
+        warm kernel — a :class:`~.service.ConsensusService` injects
+        its LRU executable cache here; standalone sessions share the
+        process-wide default executables.
     """
 
     def __init__(self, name: str, n_reporters: int, reputation=None,
                  ledger: Optional[ReputationLedger] = None,
                  alpha: float = 0.1, catch_tolerance: float = 0.1,
-                 convergence_tolerance: float = 1e-6) -> None:
+                 convergence_tolerance: float = 1e-6,
+                 incremental: bool = False,
+                 refresh_every: int = INCREMENTAL_REFRESH_DEFAULT,
+                 executable_provider=None) -> None:
         self.name = str(name)
         self.n_reporters = int(n_reporters)
         if self.n_reporters < 1:
@@ -118,7 +138,32 @@ class MarketSession:
         self.alpha = float(alpha)
         self.catch_tolerance = float(catch_tolerance)
         self.convergence_tolerance = float(convergence_tolerance)
+        self.incremental = bool(incremental)
+        self.refresh_every = int(refresh_every)
+        if self.refresh_every < 1:
+            # the PYC101 contract: a 0/negative cadence must refuse
+            # loudly instead of silently degrading the staleness anchor
+            raise InputError(
+                f"incremental refresh cadence must be >= 1 (the exact "
+                f"resolve every K rounds is the staleness-bound "
+                f"contract), got {self.refresh_every}",
+                refresh_every=self.refresh_every)
+        self._executable_provider = executable_provider
+        #: the carried warm eigenstate: the previous round's principal
+        #: component (None until the first exact resolve) and how many
+        #: warm resolves have run since the last exact anchor
+        self._warm_u = None
+        self._rounds_since_exact = 0
+        #: how the most recent resolve was served ("incremental" /
+        #: "incremental_exact" / "stats" / "direct") — the batcher's
+        #: dispatch-path label source
+        self.last_resolve_path = None
         self.rounds_resolved = 0
+        if reputation is None and ledger is not None:
+            # ledger-adopted state: restore the warm eigenstate the
+            # round commit persisted (replication-log replay must hold
+            # the identical bits the uninterrupted session would)
+            self._restore_warm_state(ledger)
         self._lock = threading.RLock()
         self._reset_round()
 
@@ -132,6 +177,42 @@ class MarketSession:
         self._S = jnp.zeros((R, R), dtype=dtype)
         #: the reputation the round's statistics are pinned to
         self._round_rep = jnp.asarray(self.reputation, dtype=dtype)
+
+    def _restore_warm_state(self, ledger: ReputationLedger) -> None:
+        """Adopt the warm eigenstate a round commit persisted into the
+        ledger's aux state (absent in non-incremental / pre-incremental
+        checkpoints — the next stats resolve is then exact, which is
+        the contract's anchor behavior anyway)."""
+        u = ledger.aux.get("incremental_warm_u")
+        if u is None:
+            return
+        u = np.asarray(u, dtype=np.float64)
+        if u.shape != (self.n_reporters,) or not np.isfinite(u).all():
+            raise CheckpointCorruptionError(
+                f"session {self.name!r}: ledger aux field "
+                f"'incremental_warm_u' has shape {u.shape} (expected "
+                f"({self.n_reporters},)) or non-finite entries",
+                field="incremental_warm_u", session=self.name)
+        self._warm_u = u  # consensus-lint: disable=CL803 — construction-time restore: called from __init__ only, before any concurrent reader can hold the session
+        age = ledger.aux.get("incremental_rounds_since_exact")
+        if age is not None:
+            self._rounds_since_exact = int(  # consensus-lint: disable=CL803 — construction-time restore (see _warm_u above)
+                np.asarray(age).reshape(-1)[0])
+
+    def _sync_ledger_aux(self) -> None:
+        """Carry the warm eigenstate into the ledger's aux state so the
+        round commit persists it ATOMICALLY with the reputation — the
+        replay / fleet-takeover leg of the incremental determinism
+        contract (a warm vector on disk one round behind memory would
+        let a replayed standby serve different bits)."""
+        if self._warm_u is not None:
+            self.ledger.aux["incremental_warm_u"] = np.asarray(
+                self._warm_u, dtype=np.float64)
+            self.ledger.aux["incremental_rounds_since_exact"] = \
+                np.asarray([self._rounds_since_exact], dtype=np.int64)
+        else:
+            self.ledger.aux.pop("incremental_warm_u", None)
+            self.ledger.aux.pop("incremental_rounds_since_exact", None)
 
     # -- ingestion ------------------------------------------------------
 
@@ -196,7 +277,23 @@ class MarketSession:
                     "rounds_resolved": int(self.rounds_resolved),
                     "staged_blocks": len(self._blocks),
                     "staged_events": self.n_events,
-                    "reputation": np.array(self.reputation, copy=True)}
+                    "reputation": np.array(self.reputation, copy=True),
+                    "incremental": {
+                        "enabled": self.incremental,
+                        "refresh_every": self.refresh_every,
+                        "rounds_since_exact": self._rounds_since_exact,
+                        "has_warm_start": self._warm_u is not None,
+                        "warm_u": (None if self._warm_u is None
+                                   else np.array(self._warm_u,
+                                                 copy=True)),
+                        "next_resolve_warm": self._would_warm(),
+                        "last_resolve_path": self.last_resolve_path}}
+
+    def _would_warm(self) -> bool:
+        """Whether the next stats-path resolve rides the warm kernel
+        (vs the exact anchor) — the cadence rule, in one place."""
+        return (self.incremental and self._warm_u is not None
+                and self._rounds_since_exact + 1 < self.refresh_every)
 
     def reputation_share(self, seats) -> float:
         """Fraction of the carried reputation held by ``seats`` — the
@@ -228,36 +325,118 @@ class MarketSession:
                           events=self.n_events, algorithm=algorithm):
                 if (algorithm == "sztorc" and max_iterations == 1
                         and not oracle_kwargs):
-                    result = self._resolve_stats()
+                    result = self._resolve_stats(
+                        use_warm=self._would_warm())
                 else:
                     result = self._resolve_direct(algorithm,
                                                   max_iterations,
                                                   oracle_kwargs)
+                    # a direct resolve leaves no eigenstate of the
+                    # stats path to warm from — the next stats resolve
+                    # must be an exact anchor
+                    self._warm_u = None
+                    self._rounds_since_exact = 0
+                    self.last_resolve_path = "direct"
             self.reputation = np.asarray(result["smooth_rep"],
                                          dtype=np.float64)
             self.rounds_resolved += 1
             if self.ledger is not None:
+                self._sync_ledger_aux()
                 self.ledger.record_round(result)
             self._reset_round()
         return result
 
-    def _resolve_stats(self) -> dict:
-        """The incremental path: score off the accumulated G/M/S (the
+    def peek_resolve(self) -> dict:
+        """EXACT resolve of the currently staged round with ZERO state
+        mutation: the round stays open, the warm eigenstate, carried
+        reputation and counters are untouched. This is the reference a
+        warm resolve's drift is measured against (the staleness tests
+        and the bench ``incremental`` block both compare
+        ``resolve()``'s warm result to the ``peek_resolve()`` of the
+        same statistics)."""
+        with self._lock:
+            if not self._blocks:
+                raise InputError(
+                    f"session {self.name!r} has no staged reports")
+            return self._resolve_stats(use_warm=False, peek=True)
+
+    def _resolve_stats(self, use_warm: bool = False,
+                       peek: bool = False) -> dict:
+        """The statistics path: score off the accumulated G/M/S (the
         identical arithmetic to ``streaming_consensus`` over the same
-        block split), then one outcome pass over the staged blocks."""
+        block split), then one outcome pass over the staged blocks —
+        only the panel slices this round's update touched.
+
+        ``use_warm`` rides the ``bucket_incremental`` kernel: the
+        dominant eigenpair is maintained by warm-started power
+        iteration from the previous round's principal component
+        (O(update) instead of the O(R³) eigh), continuous outputs
+        within the documented drift band of the exact solve.
+        ``peek`` computes without mutating any session state."""
         rep0 = self._round_rep
         dtype = rep0.dtype
         tol = self.catch_tolerance
         R = self.n_reporters
 
-        scores_k, _, U, nAu = gram_top_components(self._G, self._M,
-                                                  rep0, 1)
-        u_over_nAu = U[:, 0] / jnp.where(nAu[0] == 0.0, 1.0, nAu[0])
-        adj = gram_dirfix(scores_k[:, 0], rep0, self._S)
-        this_rep = jk.row_reward_weighted(adj, rep0)
-        smooth_rep = jk.smooth(this_rep, rep0, self.alpha)
-        delta = float(jnp.max(jnp.abs(smooth_rep - rep0)))
-        converged = delta <= self.convergence_tolerance
+        new_warm = None
+        if use_warm and not peek:
+            p = incremental_params(self.alpha, self.catch_tolerance,
+                                   self.convergence_tolerance)
+            provider = self._executable_provider
+            fn = (provider(R, p) if provider is not None
+                  else incremental_executable(p))
+            out = fn(self._G, self._M, self._S, rep0,
+                     jnp.asarray(self._warm_u, dtype=dtype), p)
+            this_rep = out["this_rep"]
+            smooth_rep = out["smooth_rep"]
+            u_over_nAu = out["u_over_nAu"]
+            delta = float(out["delta"])
+            converged = delta <= self.convergence_tolerance
+            new_warm = np.asarray(out["u"], dtype=np.float64)
+            kernel_path_counter().inc(path="incremental")
+            obs.counter(
+                "pyconsensus_incremental_resolves_total",
+                "incremental-tier session resolves by mode (warm = "
+                "the marginal warm-started kernel, exact = the "
+                "anchoring eigh refresh)", labels=("mode",)).inc(
+                    mode="warm")
+            obs.histogram(
+                "pyconsensus_incremental_power_iters",
+                "warm-started power sweeps per marginal resolve (the "
+                "O(update) eigensolve cost)",
+                buckets=obs.ITERATION_BUCKETS).observe(
+                    int(out["sweeps"]))
+        else:
+            scores_k, _, U, nAu = gram_top_components(self._G, self._M,
+                                                      rep0, 1)
+            u_over_nAu = U[:, 0] / jnp.where(nAu[0] == 0.0, 1.0, nAu[0])
+            adj = gram_dirfix(scores_k[:, 0], rep0, self._S)
+            this_rep = jk.row_reward_weighted(adj, rep0)
+            smooth_rep = jk.smooth(this_rep, rep0, self.alpha)
+            delta = float(jnp.max(jnp.abs(smooth_rep - rep0)))
+            converged = delta <= self.convergence_tolerance
+            if self.incremental and not peek:
+                new_warm = np.asarray(U[:, 0], dtype=np.float64)
+                if self._warm_u is not None:
+                    # the staleness the anchor corrected: misalignment
+                    # between the carried warm vector and the exact
+                    # principal component it stood in for
+                    wn = float(np.linalg.norm(self._warm_u))
+                    if wn > 0.0:
+                        obs.histogram(
+                            "pyconsensus_incremental_drift",
+                            "warm-eigenstate staleness corrected at "
+                            "each exact refresh: 1 - |<u_warm, "
+                            "u_exact>|",
+                            buckets=obs.MAGNITUDE_BUCKETS).observe(
+                                1.0 - abs(float(
+                                    new_warm @ (self._warm_u / wn))))
+                obs.counter(
+                    "pyconsensus_incremental_resolves_total",
+                    "incremental-tier session resolves by mode (warm "
+                    "= the marginal warm-started kernel, exact = the "
+                    "anchoring eigh refresh)", labels=("mode",)).inc(
+                        mode="exact")
 
         E = self.n_events
         outcomes_raw = np.zeros(E)
@@ -289,6 +468,17 @@ class MarketSession:
             na_count += np.asarray(nc)
             start = stop
         first_loading = nk.canon_sign(first_loading)
+        if not peek:
+            if use_warm:
+                self._warm_u = new_warm
+                self._rounds_since_exact += 1
+                self.last_resolve_path = "incremental"
+            elif self.incremental:
+                self._warm_u = new_warm
+                self._rounds_since_exact = 0
+                self.last_resolve_path = "incremental_exact"
+            else:
+                self.last_resolve_path = "stats"
         return assemble_light_result(
             np.asarray(rep0, dtype=float), this_rep, smooth_rep,
             na_count, outcomes_raw, outcomes_adjusted, outcomes_final,

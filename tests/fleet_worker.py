@@ -9,7 +9,13 @@ acknowledged and every resolve commits the ledger before clearing its
 journal, the standby resumes bit-identical no matter which instruction
 the kill landed on.
 
-Usage: fleet_worker.py LOG_ROOT SESSION N_ROUNDS [SLEEP_S]
+Usage: fleet_worker.py LOG_ROOT SESSION N_ROUNDS [SLEEP_S] [REFRESH_K]
+
+``REFRESH_K`` (optional, > 0) makes the session INCREMENTAL with that
+exact-refresh cadence (ISSUE 12): warm marginal resolves between
+anchors, the warm eigenstate committed with every round — so the
+mid-round SIGKILL replay contract covers the ``bucket_incremental``
+tier's warm trajectory too.
 
 Restart-safe by design: if the session's log already exists the worker
 replays it and continues from the durable position — the same recovery
@@ -44,9 +50,16 @@ def main(argv) -> int:
     log_root, name = argv[1], argv[2]
     n_rounds = int(argv[3])
     sleep_s = float(argv[4]) if len(argv) > 4 else 0.15
+    refresh_k = int(argv[5]) if len(argv) > 5 else 0
 
     if ReplicationLog(log_root, name).exists():
+        # the incremental policy (and warm eigenstate) replay from the
+        # log's meta + ledger aux — no flag needed on resume
         session = replay_session(log_root, name)
+    elif refresh_k > 0:
+        session = DurableSession.create(log_root, name, N_REPORTERS,
+                                        incremental=True,
+                                        refresh_every=refresh_k)
     else:
         session = DurableSession.create(log_root, name, N_REPORTERS)
     print(f"READY round={session.ledger.round} "

@@ -22,8 +22,9 @@ def _run(args, timeout=600):
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
     # these tests probe the ladder/JSON contract; the (1000-session)
-    # economy block has its own suite and CI stage
-    args = [*args, "--no-econ"]
+    # economy block and the (1024x8192-session) incremental block have
+    # their own suites and CI stages
+    args = [*args, "--no-econ", "--no-incremental"]
     return subprocess.run([sys.executable, str(BENCH), *args],
                           capture_output=True, text=True, timeout=timeout,
                           env=env)

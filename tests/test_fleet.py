@@ -844,6 +844,13 @@ class TestFleetFailover:
                 fleet.workers[owner].service.sessions.remove("s")
                 fleet.workers["w0"].service.sessions.add(sess)
                 fleet._sessions["s"] = "w0"
+        # refresh both beats with an UNARMED scan first: construction +
+        # create_session include fsync'd replication-log writes whose
+        # latency spikes under a fully loaded suite can age w0's stamp
+        # past the 80 ms window before the first armed scan even runs
+        # (observed full-suite flake; disarmed scans consume no fault
+        # occurrences, so the armed schedule below is unchanged)
+        assert fleet.check_workers() == []
         # with 2 alive workers the scan order is w0, w1: occurrences
         # 0, 2, 4 are w0's beats — every one lost, w1 never touched
         plan = faults.FaultPlan(seed=0, rules=[

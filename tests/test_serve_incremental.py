@@ -1,0 +1,602 @@
+"""``bucket_incremental`` — O(update) marginal resolves (ISSUE 12).
+
+Covers the warm-eigenpair algebra (``gram_warm_pc`` /
+``gram_top_components``'s warm-start + delta forms), the staleness-bound
+contract (property sweep over appended-block size × refresh cadence:
+catch-snapped outcomes + iteration counts bit-identical at every exact
+refresh — against the non-incremental session AND against direct Oracle
+on both backends — with continuous drift ≤ the documented band between
+refreshes), the serve-tier integration (``bucket_incremental`` dispatch
+path, kernel-path counter, ``serve_bucket_incremental`` retrace pin,
+PYC101 cadence validation, CLI opt-outs), and durability (warm
+eigenstate through ``state()``/ledger aux, replication-log replay
+bit-identical after a real mid-round SIGKILL, fleet takeover of a warm
+session).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from conftest import collusion_reports, worker_env
+from fleet_worker import BLOCKS_PER_ROUND, N_REPORTERS, make_block
+from pyconsensus_tpu import Oracle, ReputationLedger, obs
+from pyconsensus_tpu.faults import CheckpointCorruptionError, InputError
+from pyconsensus_tpu.serve import (ConsensusFleet, ConsensusService,
+                                   DurableSession, FleetConfig,
+                                   MarketSession, ServeConfig,
+                                   replay_session)
+from pyconsensus_tpu.serve.incremental import (INCREMENTAL_KERNEL_PATH,
+                                               incremental_drift_band,
+                                               incremental_params)
+
+
+@pytest.fixture(autouse=True)
+def _under_lock_witness(lock_witness):
+    """Incremental-tier tests run under the runtime lock witness
+    (ISSUE 9), like the rest of the serve/fleet suites."""
+    yield
+
+
+#: continuous result keys the drift band covers
+CONT_KEYS = ("smooth_rep", "this_rep", "certainty", "consensus_reward",
+             "reporter_bonus", "author_bonus", "first_loading")
+
+
+def band():
+    import jax.numpy as jnp
+
+    return incremental_drift_band(jnp.asarray(0.0).dtype)
+
+
+def blk(R, e, seed, na_frac=0.1):
+    r = np.random.default_rng(seed)
+    b = r.choice([0.0, 1.0], size=(R, e)).astype(np.float64)
+    if na_frac:
+        b[r.random((R, e)) < na_frac] = np.nan
+    return b
+
+
+def drift_between(a, b):
+    return max(float(np.max(np.abs(np.asarray(a[k]) - np.asarray(b[k]))))
+               for k in CONT_KEYS)
+
+
+# -- the warm-eigenpair algebra (parallel.streaming) -----------------------
+
+
+class TestWarmAlgebra:
+    def _stats(self, rng, R=16, E=64):
+        import jax.numpy as jnp
+
+        from pyconsensus_tpu.parallel.streaming import _pass1_panel
+
+        reports, _ = collusion_reports(rng, R, E, liars=4, na_frac=0.1)
+        rep = jnp.full((R,), 1.0 / R)
+        G, M, S = _pass1_panel(
+            jnp.asarray(reports), rep, rep, jnp.zeros(E, bool),
+            jnp.zeros(E), jnp.ones(E), jnp.ones(E, bool), 0.1, True)
+        return G, M, S, rep
+
+    def test_delta_form_equals_materialized_update(self, rng):
+        """gram_top_components(delta=(dG, dM)) == the solve over G+dG,
+        M+dM — the appended-block low-rank form is pure restructuring."""
+        from pyconsensus_tpu.parallel.streaming import gram_top_components
+
+        G, M, _, rep = self._stats(rng)
+        dG, dM, _, _ = self._stats(np.random.default_rng(7))
+        a = gram_top_components(G + dG, M + dM, rep, 2)
+        b = gram_top_components(G, M, rep, 2, delta=(dG, dM))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_warm_start_converges_to_eigh_direction(self, rng):
+        """The warm-started power path lands on the eigh dominant
+        eigenvector (up to sign) well inside the drift band's scale,
+        even from a deliberately stale start."""
+        from pyconsensus_tpu.parallel.streaming import (gram_top_components,
+                                                        gram_warm_pc)
+
+        G, M, _, rep = self._stats(rng)
+        _, _, U, _ = gram_top_components(G, M, rep, 1)
+        exact = np.asarray(U[:, 0])
+        stale = exact + 0.05 * rng.standard_normal(exact.shape)
+        import jax.numpy as jnp
+
+        u, sweeps = gram_warm_pc(G, rep, jnp.asarray(stale),
+                                 n_iters=incremental_params(
+                                     0.1, 0.1, 1e-6).power_iters)
+        align = abs(float(np.asarray(u) @ exact))
+        assert align >= 1.0 - 1e-9
+        assert int(sweeps) > 0
+
+    def test_warm_scores_match_eigh_scores_closely(self, rng):
+        from pyconsensus_tpu.parallel.streaming import gram_top_components
+
+        G, M, _, rep = self._stats(rng)
+        s_exact, _, U, _ = gram_top_components(G, M, rep, 1)
+        s_warm, _, Uw, _ = gram_top_components(
+            G, M, rep, 1, warm_u=U[:, 0],
+            warm_iters=incremental_params(0.1, 0.1, 1e-6).power_iters)
+        # canonical signs may differ; compare up to sign
+        a, b = np.asarray(s_exact[:, 0]), np.asarray(s_warm[:, 0])
+        if float(a @ b) < 0:
+            b = -b
+        np.testing.assert_allclose(a, b, atol=band(), rtol=0)
+
+    def test_warm_start_requires_k1(self, rng):
+        from pyconsensus_tpu.parallel.streaming import gram_top_components
+
+        G, M, _, rep = self._stats(rng)
+        with pytest.raises(ValueError, match="k=1"):
+            gram_top_components(G, M, rep, 2, warm_u=G[:, 0])
+
+
+# -- the staleness-bound contract ------------------------------------------
+
+
+class TestStalenessContract:
+    def test_refresh_every_one_is_bitwise_the_plain_session(self, rng):
+        """K=1 never engages the warm kernel: every resolve is the
+        exact anchor, bit-identical to a non-incremental session —
+        injecting the tier's machinery must not move a single bit."""
+        R = 12
+        plain = MarketSession("p", R)
+        inc = MarketSession("i", R, incremental=True, refresh_every=1)
+        for k in range(3):
+            b = blk(R, 10, 100 + k)
+            plain.append(b)
+            inc.append(b)
+            a, c = plain.resolve(), inc.resolve()
+            for key in ("smooth_rep", "outcomes_adjusted",
+                        "outcomes_final", "certainty", "iterations"):
+                np.testing.assert_array_equal(np.asarray(a[key]),
+                                              np.asarray(c[key]))
+            assert inc.last_resolve_path == "incremental_exact"
+
+    @pytest.mark.parametrize("block_events", [1, 6, 24])
+    @pytest.mark.parametrize("refresh_every", [2, 3, 5])
+    def test_drift_band_and_refresh_bitwise(self, rng, block_events,
+                                            refresh_every):
+        """The contract property sweep (appended-block size × cadence):
+        warm rounds stay within the documented band of the exact
+        resolve of the SAME statistics (``peek_resolve``) with snapped
+        outcomes + iteration counts identical; exact-refresh rounds run
+        the exact arithmetic bit-identically (the carried reputation
+        diverges from a never-warm twin only within the band, which is
+        precisely what the contract bounds — cross-trajectory bitwise
+        equality is the K=1 case, pinned separately)."""
+        R = 14
+        inc = MarketSession("inc", R, incremental=True,
+                            refresh_every=refresh_every)
+        saw_warm = saw_refresh = False
+        for k in range(2 * refresh_every + 1):
+            b = blk(R, block_events, 31 * block_events + k)
+            inc.append(b)
+            exact_same_stats = inc.peek_resolve()
+            got = inc.resolve()
+            if inc.last_resolve_path == "incremental":
+                saw_warm = True
+                assert drift_between(got, exact_same_stats) <= band()
+                np.testing.assert_array_equal(
+                    got["outcomes_adjusted"],
+                    exact_same_stats["outcomes_adjusted"])
+                assert got["iterations"] == \
+                    exact_same_stats["iterations"] == 1
+            else:
+                saw_refresh = True
+                assert inc.last_resolve_path == "incremental_exact"
+                # an anchor round runs the exact arithmetic on its own
+                # statistics: identical to the peek of the same stats
+                for key in ("smooth_rep", "outcomes_adjusted",
+                            "certainty", "iterations"):
+                    np.testing.assert_array_equal(
+                        np.asarray(got[key]),
+                        np.asarray(exact_same_stats[key]))
+        assert saw_refresh
+        assert saw_warm == (refresh_every > 1)
+
+    def test_exact_refresh_bitwise_vs_oracle_both_backends(self, rng):
+        """At every exact-refresh round the incremental session's
+        catch-snapped outcomes + iteration count equal a direct Oracle
+        resolution of the staged round under the carried reputation —
+        on BOTH backends (the repo's cross-backend snap-parity class)."""
+        R = 12
+        sess = MarketSession("m", R, incremental=True, refresh_every=2)
+        for k in range(4):
+            b = blk(R, 16, 900 + k)
+            rep_in = sess.reputation.copy()
+            sess.append(b)
+            got = sess.resolve()
+            if sess.last_resolve_path != "incremental_exact":
+                continue
+            for backend in ("jax", "numpy"):
+                ref = Oracle(reports=b, reputation=rep_in,
+                             backend=backend).consensus()
+                np.testing.assert_array_equal(
+                    got["outcomes_adjusted"],
+                    np.asarray(ref["events"]["outcomes_adjusted"]),
+                    err_msg=f"round {k} backend {backend}")
+                assert got["iterations"] == ref["iterations"]
+
+    def test_cadence_state_and_counters(self, rng):
+        R = 10
+        before_w = obs.value("pyconsensus_incremental_resolves_total",
+                             mode="warm") or 0
+        before_e = obs.value("pyconsensus_incremental_resolves_total",
+                             mode="exact") or 0
+        before_k = obs.value("pyconsensus_kernel_path_total",
+                             path=INCREMENTAL_KERNEL_PATH) or 0
+        sess = MarketSession("m", R, incremental=True, refresh_every=3)
+        expect = ["incremental_exact", "incremental", "incremental",
+                  "incremental_exact", "incremental"]
+        ages = [0, 1, 2, 0, 1]
+        for k, (path, age) in enumerate(zip(expect, ages)):
+            sess.append(blk(R, 8, 50 + k))
+            sess.resolve()
+            assert sess.last_resolve_path == path
+            st = sess.state()["incremental"]
+            assert st["enabled"] and st["refresh_every"] == 3
+            assert st["rounds_since_exact"] == age
+            assert st["has_warm_start"]
+            assert st["warm_u"].shape == (R,)
+        warm = (obs.value("pyconsensus_incremental_resolves_total",
+                          mode="warm") or 0) - before_w
+        exact = (obs.value("pyconsensus_incremental_resolves_total",
+                           mode="exact") or 0) - before_e
+        kp = (obs.value("pyconsensus_kernel_path_total",
+                        path=INCREMENTAL_KERNEL_PATH) or 0) - before_k
+        assert (warm, exact) == (3, 2)
+        assert kp == 3
+        assert obs.value("pyconsensus_incremental_drift") is not None
+
+    def test_direct_resolve_invalidates_warm_state(self, rng):
+        """A non-stats resolve (full Oracle fallback) leaves no valid
+        eigenstate: the next stats resolve must be an exact anchor."""
+        R = 10
+        sess = MarketSession("m", R, incremental=True, refresh_every=4)
+        sess.append(blk(R, 8, 1))
+        sess.resolve()
+        sess.append(blk(R, 8, 2))
+        sess.resolve(max_iterations=3)          # direct path
+        assert sess.last_resolve_path == "direct"
+        assert not sess.state()["incremental"]["has_warm_start"]
+        sess.append(blk(R, 8, 3))
+        sess.resolve()
+        assert sess.last_resolve_path == "incremental_exact"
+
+    def test_peek_resolve_mutates_nothing(self, rng):
+        R = 10
+        sess = MarketSession("m", R, incremental=True, refresh_every=4)
+        sess.append(blk(R, 8, 1))
+        st0 = sess.state()
+        first = sess.peek_resolve()
+        again = sess.peek_resolve()
+        st1 = sess.state()
+        assert st0["rounds_resolved"] == st1["rounds_resolved"] == 0
+        assert st0["staged_blocks"] == st1["staged_blocks"] == 1
+        assert sess.last_resolve_path is None
+        np.testing.assert_array_equal(first["smooth_rep"],
+                                      again["smooth_rep"])
+
+
+# -- serve-tier integration ------------------------------------------------
+
+
+class TestServiceTier:
+    def test_sessions_ride_bucket_incremental(self, rng):
+        R = 12
+        base_req = obs.value("pyconsensus_serve_requests_total",
+                             path="bucket_incremental",
+                             outcome="ok") or 0
+        base_re = obs.value("pyconsensus_jit_retraces_total",
+                            entry="serve_bucket_incremental") or 0
+        svc = ConsensusService(ServeConfig(
+            incremental_sessions=True, incremental_refresh_every=3,
+            batch_window_ms=1.0)).start(warmup=False)
+        svc.create_session("m", n_reporters=R)
+        paths = []
+        for k in range(4):
+            svc.append("m", blk(R, 8, 70 + k))
+            svc.submit(session="m").result(timeout=120)
+            paths.append(svc.sessions.get("m").last_resolve_path)
+        svc.close(drain=True)
+        assert paths == ["incremental_exact", "incremental",
+                         "incremental", "incremental_exact"]
+        got = (obs.value("pyconsensus_serve_requests_total",
+                         path="bucket_incremental",
+                         outcome="ok") or 0) - base_req
+        assert got == 4
+        # steady-state retrace pin: ONE compile for the (roster,
+        # params) key, flat across every subsequent marginal resolve
+        retraces = (obs.value("pyconsensus_jit_retraces_total",
+                              entry="serve_bucket_incremental") or 0) \
+            - base_re
+        assert retraces == 1
+
+    def test_incremental_executables_live_in_the_cache(self, rng):
+        from pyconsensus_tpu.serve import BucketKey
+        from pyconsensus_tpu.serve.sharded import SINGLE_TOPOLOGY
+
+        svc = ConsensusService(ServeConfig(
+            incremental_sessions=True, batch_window_ms=1.0))
+        svc.create_session("m", n_reporters=10)
+        svc.append("m", blk(10, 8, 3))
+        svc.start(warmup=False)
+        svc.submit(session="m").result(timeout=120)
+        svc.append("m", blk(10, 8, 4))
+        svc.submit(session="m").result(timeout=120)   # warm round
+        svc.close(drain=True)
+        p = incremental_params(0.1, 0.1, 1e-6)
+        key = BucketKey.make(10, 0, 1, p, SINGLE_TOPOLOGY,
+                             kernel_path=INCREMENTAL_KERNEL_PATH)
+        assert key in svc.cache.keys()
+
+    def test_plain_sessions_keep_the_session_path(self, rng):
+        R = 10
+        base = obs.value("pyconsensus_serve_requests_total",
+                         path="session", outcome="ok") or 0
+        svc = ConsensusService(ServeConfig(batch_window_ms=1.0)).start(
+            warmup=False)
+        svc.create_session("m", n_reporters=R)
+        svc.append("m", blk(R, 8, 5))
+        svc.submit(session="m").result(timeout=120)
+        assert svc.sessions.get("m").last_resolve_path == "stats"
+        svc.close(drain=True)
+        got = (obs.value("pyconsensus_serve_requests_total",
+                         path="session", outcome="ok") or 0) - base
+        assert got == 1
+
+    def test_refresh_cadence_zero_refused_pyc101(self):
+        with pytest.raises(InputError) as ei:
+            ConsensusService(ServeConfig(incremental_refresh_every=0))
+        assert ei.value.error_code == "PYC101"
+        with pytest.raises(InputError):
+            ConsensusService(ServeConfig(incremental_refresh_every=-3))
+        with pytest.raises(InputError) as ei:
+            MarketSession("m", 8, incremental=True, refresh_every=0)
+        assert ei.value.error_code == "PYC101"
+
+    def test_config_round_trip(self, tmp_path):
+        import json
+
+        cfg = ServeConfig(incremental_sessions=True,
+                          incremental_refresh_every=7)
+        path = tmp_path / "serve.json"
+        path.write_text(json.dumps({
+            "incremental_sessions": True,
+            "incremental_refresh_every": 7}))
+        loaded = ServeConfig.load(path)
+        assert loaded.incremental_sessions == cfg.incremental_sessions
+        assert (loaded.incremental_refresh_every
+                == cfg.incremental_refresh_every)
+
+    def test_cli_flags_parse(self, tmp_path, capsys):
+        """--incremental / --no-incremental / --refresh-every thread
+        through the serve CLI like the other --no-* flags."""
+        from pyconsensus_tpu.serve.cli import main
+
+        rc = main(["--warmup-only", "--shapes", "8x16",
+                   "--incremental", "--refresh-every", "5"])
+        assert rc == 0
+        rc = main(["--warmup-only", "--shapes", "8x16",
+                   "--no-incremental"])
+        assert rc == 0
+
+    def test_bench_flag_is_known(self):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        try:
+            import bench
+        finally:
+            sys.path.pop(0)
+        args = bench.build_parser().parse_args(
+            ["--no-incremental", "--incremental-shape", "32x64",
+             "--incremental-append-sizes", "2,4",
+             "--incremental-samples", "2"])
+        assert bench._incremental_block(args) is None
+
+
+# -- durability: ledger aux, replay, fleet takeover ------------------------
+
+
+class TestDurability:
+    def test_ledger_aux_round_trips_warm_state(self, rng, tmp_path):
+        R = 8
+        ledger = ReputationLedger(n_reporters=R)
+        sess = MarketSession("m", R, ledger=ledger, incremental=True,
+                             refresh_every=5)
+        for k in range(3):
+            sess.append(blk(R, 8, 20 + k))
+            sess.resolve()
+        assert "incremental_warm_u" in ledger.aux
+        ledger.save(tmp_path / "state.npz")
+        resumed_ledger = ReputationLedger.load(tmp_path / "state.npz")
+        resumed = MarketSession("m", R, ledger=resumed_ledger,
+                                incremental=True, refresh_every=5)
+        np.testing.assert_array_equal(resumed._warm_u, sess._warm_u)
+        assert resumed._rounds_since_exact == sess._rounds_since_exact
+        # and the next round is bit-identical to the uninterrupted one
+        b = blk(R, 8, 99)
+        sess.append(b)
+        resumed.append(b)
+        a, c = sess.resolve(), resumed.resolve()
+        np.testing.assert_array_equal(a["smooth_rep"], c["smooth_rep"])
+        assert sess.last_resolve_path == resumed.last_resolve_path \
+            == "incremental"
+
+    def test_plain_session_writes_no_aux(self, rng, tmp_path):
+        R = 8
+        ledger = ReputationLedger(n_reporters=R)
+        sess = MarketSession("m", R, ledger=ledger)
+        sess.append(blk(R, 8, 1))
+        sess.resolve()
+        assert ledger.aux == {}
+        ledger.save(tmp_path / "s.npz")
+        assert ReputationLedger.load(tmp_path / "s.npz").aux == {}
+
+    def test_corrupt_warm_aux_refused(self, rng, tmp_path):
+        R = 8
+        ledger = ReputationLedger(n_reporters=R)
+        ledger.aux["incremental_warm_u"] = np.zeros(R + 3)  # wrong roster
+        ledger.save(tmp_path / "s.npz")
+        bad = ReputationLedger.load(tmp_path / "s.npz")
+        with pytest.raises(CheckpointCorruptionError):
+            MarketSession("m", R, ledger=bad, incremental=True)
+
+    def test_nonfinite_aux_refused_at_load(self, rng, tmp_path):
+        R = 8
+        ledger = ReputationLedger(n_reporters=R)
+        ledger.aux["incremental_warm_u"] = np.full(R, np.nan)
+        ledger.save(tmp_path / "s.npz")
+        with pytest.raises(CheckpointCorruptionError):
+            ReputationLedger.load(tmp_path / "s.npz")
+
+    def test_replay_continues_warm_trajectory(self, rng, tmp_path):
+        R = 10
+        a = DurableSession.create(str(tmp_path / "a"), "m", R,
+                                  incremental=True, refresh_every=5)
+        twin = DurableSession.create(str(tmp_path / "b"), "m", R,
+                                     incremental=True, refresh_every=5)
+        for k in range(3):
+            b = blk(R, 8, 300 + k)
+            a.append(b)
+            twin.append(b)
+            np.testing.assert_array_equal(
+                a.resolve()["smooth_rep"],
+                twin.resolve()["smooth_rep"])
+        replayed = replay_session(str(tmp_path / "a"), "m")
+        assert replayed.incremental and replayed.refresh_every == 5
+        np.testing.assert_array_equal(replayed._warm_u, twin._warm_u)
+        assert replayed._rounds_since_exact == twin._rounds_since_exact
+        for k in range(3, 6):
+            b = blk(R, 8, 300 + k)
+            replayed.append(b)
+            twin.append(b)
+            got, ref = replayed.resolve(), twin.resolve()
+            assert replayed.last_resolve_path == twin.last_resolve_path
+            np.testing.assert_array_equal(got["smooth_rep"],
+                                          ref["smooth_rep"])
+            np.testing.assert_array_equal(got["outcomes_adjusted"],
+                                          ref["outcomes_adjusted"])
+
+    def test_midround_sigkill_replay_bit_identical(self, tmp_path):
+        """The satellite's chaos leg, on the fleet_worker harness: an
+        INCREMENTAL durable session SIGKILLed mid-round replays onto a
+        standby and finishes with bits identical to the never-killed
+        run — warm rounds included (the warm eigenstate rides the
+        ledger aux checkpoint)."""
+        log_root = tmp_path / "log"
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "fleet_worker.py")
+        env = worker_env()
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, script, str(log_root), "mkt", "4", "0.1",
+             "3"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            deadline = time.monotonic() + 180
+            seen = []
+            # kill inside round 2: a WARM round (round 1 was warm, the
+            # eigenstate is live) with a partial journal ahead
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    pytest.fail("worker exited early:\n" + "".join(seen))
+                seen.append(line)
+                if line.startswith("APPEND 2"):
+                    break
+            else:
+                pytest.fail("worker never reached round 2:\n"
+                            + "".join(seen))
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == -signal.SIGKILL
+
+        standby = replay_session(log_root, "mkt")
+        assert standby.incremental and standby.refresh_every == 3
+        got = []
+        for k in range(standby.ledger.round, 4):
+            for j in range(len(standby._blocks), BLOCKS_PER_ROUND):
+                standby.append(make_block(k, j))
+            got.append(standby.resolve())
+
+        ref_session = MarketSession("ref", N_REPORTERS, incremental=True,
+                                    refresh_every=3)
+        ref = []
+        for k in range(4):
+            for j in range(BLOCKS_PER_ROUND):
+                ref_session.append(make_block(k, j))
+            ref.append(ref_session.resolve())
+        for g, r in zip(got, ref[-len(got):]):
+            np.testing.assert_array_equal(
+                np.asarray(g["smooth_rep"]), np.asarray(r["smooth_rep"]))
+            np.testing.assert_array_equal(
+                np.asarray(g["outcomes_adjusted"]),
+                np.asarray(r["outcomes_adjusted"]))
+            assert int(np.asarray(g["iterations"])) == int(
+                np.asarray(r["iterations"]))
+        np.testing.assert_array_equal(
+            standby.reputation, np.asarray(ref[-1]["smooth_rep"]))
+        assert standby.last_resolve_path == ref_session.last_resolve_path
+
+
+class TestFleetTakeover:
+    def test_takeover_resumes_warm_session_bit_identical(self, rng,
+                                                         tmp_path):
+        """Kill the worker owning a WARM incremental session
+        mid-trajectory: the standby adopts via verify+replay and every
+        remaining round is bit-identical to a never-killed durable twin
+        (warm path labels included)."""
+        fleet = ConsensusFleet(FleetConfig(
+            n_workers=3, log_dir=str(tmp_path / "log"),
+            worker=ServeConfig(warmup=(), batch_window_ms=1.0,
+                               incremental_sessions=True,
+                               incremental_refresh_every=4))).start(
+            warmup=False)
+        twin = DurableSession.create(str(tmp_path / "twin"), "mkt", 12,
+                                     incremental=True, refresh_every=4)
+        try:
+            fleet.create_session("mkt", n_reporters=12)
+            for k in range(2):
+                b = blk(12, 8, 600 + k)
+                fleet.append("mkt", b)
+                twin.append(b)
+                got = fleet.submit(session="mkt").result(timeout=120)
+                ref = twin.resolve()
+                np.testing.assert_array_equal(
+                    np.asarray(got["agents"]["smooth_rep"]),
+                    np.asarray(ref["smooth_rep"]))
+            owner = fleet.owner_of("mkt")
+            fleet.kill_worker(owner)
+            for k in range(2, 5):
+                b = blk(12, 8, 600 + k)
+                fleet.append("mkt", b)
+                twin.append(b)
+                got = fleet.submit(session="mkt").result(timeout=120)
+                ref = twin.resolve()
+                np.testing.assert_array_equal(
+                    np.asarray(got["agents"]["smooth_rep"]),
+                    np.asarray(ref["smooth_rep"]))
+                np.testing.assert_array_equal(
+                    np.asarray(got["events"]["outcomes_adjusted"]),
+                    np.asarray(ref["outcomes_adjusted"]))
+            new_owner = fleet.owner_of("mkt")
+            assert new_owner != owner
+            live = fleet.workers[new_owner].service.sessions.get("mkt")
+            assert live.last_resolve_path == twin.last_resolve_path
+        finally:
+            fleet.close(drain=True)

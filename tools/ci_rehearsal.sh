@@ -840,11 +840,117 @@ print(f"econ kill -9 OK: worker killed inside round 1, fresh fleet "
       f"(digest {ref['mechanism_digest'][:16]}...)")
 PYEOF
 
+echo "=== Incremental serve smoke (ISSUE 12: bucket_incremental marginal resolves) ==="
+# The staleness-bound contract end to end on the live service: a warm
+# session absorbs small appended blocks across rounds, the marginal
+# resolves are SERVED by the bucket_incremental tier (kernel-path
+# counter), continuous drift vs the exact resolve of the same
+# statistics stays inside the documented band, the exact-refresh round
+# is bit-identical to a direct Oracle resolution under the carried
+# reputation, and the steady-state serve_bucket_incremental retrace
+# counter pins at 1 (one compile per warmed (roster, params)).
+"$PY" - <<'PYEOF'
+import numpy as np
+from pyconsensus_tpu import Oracle, obs
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+from pyconsensus_tpu.serve.incremental import incremental_drift_band
+import jax.numpy as jnp
+
+R = 12
+def blk(e, seed):
+    r = np.random.default_rng(seed)
+    b = r.choice([0.0, 1.0], size=(R, e)).astype(float)
+    b[r.random((R, e)) < 0.1] = np.nan
+    return b
+
+band = incremental_drift_band(jnp.asarray(0.0).dtype)
+svc = ConsensusService(ServeConfig(incremental_sessions=True,
+                                   incremental_refresh_every=3,
+                                   batch_window_ms=1.0)).start(warmup=False)
+svc.create_session("inc-market", n_reporters=R)
+sess = svc.sessions.get("inc-market")
+paths, refresh_checked = [], 0
+for k in range(4):
+    b = blk(6, 400 + k)
+    rep_in = sess.reputation.copy()
+    svc.append("inc-market", b)
+    exact = sess.peek_resolve()
+    got = svc.submit(session="inc-market").result(timeout=120)
+    paths.append(sess.last_resolve_path)
+    if paths[-1] == "incremental":
+        drift = max(float(np.max(np.abs(
+            np.asarray(got["agents"][key] if key in got["agents"]
+                       else got["events"][key]) - np.asarray(exact[key]))))
+            for key in ("smooth_rep", "certainty"))
+        assert drift <= band, f"round {k}: drift {drift} > band {band}"
+        assert np.array_equal(np.asarray(got["events"]["outcomes_adjusted"]),
+                              exact["outcomes_adjusted"])
+    else:
+        # exact-refresh round: bit-identical to a direct Oracle resolve
+        # of the staged round under the carried reputation
+        ref = Oracle(reports=b, reputation=rep_in,
+                     backend="jax").consensus()
+        assert np.array_equal(
+            np.asarray(got["events"]["outcomes_adjusted"]),
+            np.asarray(ref["events"]["outcomes_adjusted"]))
+        assert int(got["iterations"]) == int(ref["iterations"])
+        refresh_checked += 1
+svc.close(drain=True)
+assert paths == ["incremental_exact", "incremental", "incremental",
+                 "incremental_exact"], paths
+assert (obs.value("pyconsensus_kernel_path_total", path="incremental")
+        or 0) == 2, "warm resolves not served by the incremental kernel"
+assert (obs.value("pyconsensus_serve_requests_total",
+                  path="bucket_incremental", outcome="ok") or 0) == 4
+assert (obs.value("pyconsensus_jit_retraces_total",
+                  entry="serve_bucket_incremental") or 0) == 1
+print(f"incremental smoke OK: 4 rounds (2 warm, 2 exact anchors incl. "
+      f"{refresh_checked} Oracle-bitwise refresh check), drift inside "
+      f"the {band:g} band, kernel-path counter shows the "
+      f"bucket_incremental tier, retraces pinned at 1")
+PYEOF
+# The econ camouflage smoke routed through the incremental tier: at
+# refresh cadence 1 every resolve is the tier's exact anchor, so the
+# mechanism digest must be BIT-IDENTICAL to the full-resolve run; at
+# cadence 2 the warm kernel serves between anchors and the economy
+# must still be deterministic (two runs, one digest).
+"$PY" - <<'PYEOF'
+from pyconsensus_tpu.econ import MarketEconomy, build_scenario
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+
+def digest(**cfg):
+    svc = ConsensusService(ServeConfig(batch_window_ms=1.0,
+                                       **cfg)).start(warmup=False)
+    scenario = build_scenario(seed=77, rounds=3,
+                              strategies=("camouflage",),
+                              markets_per_strategy=3, concurrency=6)
+    result = MarketEconomy(svc, scenario).run()
+    svc.close(drain=True)
+    return result["mechanism_digest"]
+
+full = digest()
+anchored = digest(incremental_sessions=True, incremental_refresh_every=1)
+assert anchored == full, (
+    f"incremental tier at refresh cadence 1 changed the mechanism "
+    f"digest: {anchored} != {full}")
+warm_a = digest(incremental_sessions=True, incremental_refresh_every=2)
+warm_b = digest(incremental_sessions=True, incremental_refresh_every=2)
+assert warm_a == warm_b, "warm-path economy is not deterministic"
+print(f"econ-through-incremental OK: cadence-1 digest identical to the "
+      f"full-resolve run ({full[:16]}...), cadence-2 warm economy "
+      f"deterministic across runs ({warm_a[:16]}...)")
+PYEOF
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
-  --econ-sessions 48 --econ-rounds 2 --bench-timeout 300 | tail -1 | "$PY" -c \
-  "import json,sys; d=json.load(sys.stdin); e=d['economy']; \
+  --econ-sessions 48 --econ-rounds 2 --bench-timeout 300 \
+  --incremental-shape 128x512 --incremental-append-sizes 4,16 \
+  --incremental-samples 2 | tail -1 | "$PY" -c \
+  "import json,sys; d=json.load(sys.stdin); e=d['economy']; i=d['incremental']; \
+assert all(a['drift_within_band'] and a['outcomes_match_exact'] \
+           for a in i['appends']) and i['refresh_bitwise_outcomes']; \
 print('bench JSON ok:', d['metric'], '| economy:', e['sessions'], \
-'sessions,', len(e['strategies']), 'strategies')"
+'sessions,', len(e['strategies']), 'strategies', '| incremental:', \
+len(i['appends']), 'append sizes, drift in band, refresh bitwise')"
 
 echo "=== CI rehearsal GREEN ==="
