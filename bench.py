@@ -148,6 +148,26 @@ def build_parser() -> argparse.ArgumentParser:
                          "precision. Pass this flag to measure the "
                          "per-resolution-encode form (the pre-round-5 "
                          "series)")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="skip the fail-soft pipelined-dispatch block "
+                         "(depth-N windowed hot loop vs the fully "
+                         "synchronous per-resolution loop at the bench "
+                         "shape, with bit-identical digests and a "
+                         "zero-added-retraces pin, appended to the "
+                         "JSON as 'pipeline')")
+    ap.add_argument("--pipeline-depth", type=int, default=0,
+                    help="in-flight window of the pipelined hot-loop "
+                         "probe (0 = auto: the tune/ winner for this "
+                         "event width, floor 2)")
+    ap.add_argument("--no-roofline", action="store_true",
+                    help="skip the fail-soft roofline block (achieved "
+                         "vs memory-bandwidth-bound res/s per bucket "
+                         "class, appended to the JSON as 'roofline')")
+    ap.add_argument("--roofline-sweeps", type=int, default=6,
+                    help="power-sweep count of the roofline traffic "
+                         "model (the early exit makes the true count "
+                         "data-dependent; the block records the value "
+                         "used)")
     ap.add_argument("--no-device-scaling", action="store_true",
                     help="skip the device-scaling sweep block (the "
                          "1/2/4/.../n_devices submesh rates appended to "
@@ -304,23 +324,27 @@ def run_bench(args) -> None:
     raw_reports = reports
     pre_encoded = False
     encode_s = None
+    raw_itemsize = np.dtype(reports.dtype).itemsize
     if (not args.no_pre_encode and not args.scaled
             and resolved.storage_dtype == "int8"):
-        from pyconsensus_tpu.models.pipeline import encode_reports
+        # ISSUE 13 tentpole a: the DEVICE encode path — int8 sentinel +
+        # NaN mask built on device from the raw panel through the
+        # shared instrumented jit (pipeline.encode_reports_device,
+        # bit-identical to the host reference encoder by test contract)
+        from pyconsensus_tpu.models.pipeline import encode_reports_device
 
-        enc_jit = jax.jit(encode_reports)
-        jax.block_until_ready(enc_jit(reports))     # compile + warm
+        jax.block_until_ready(encode_reports_device(reports))  # warm
         t0 = time.perf_counter()
-        reports = enc_jit(reports)
+        reports = encode_reports_device(reports)
         # force through a fetch — block_until_ready can return before
         # remote execution on the tunneled backend
         float(np.asarray(reports[0, 0], dtype=np.float64))
         encode_s = time.perf_counter() - t0         # includes one RTT
         pre_encoded = True
-        print(f"BENCH-GATE: pre-encoded int8 sentinel storage "
-              f"(one-time {encode_s * 1e3:.0f} ms incl. tunnel RTT; "
-              f"--no-pre-encode for the per-resolution-encode form)",
-              file=sys.stderr)
+        print(f"BENCH-GATE: pre-encoded int8 sentinel storage on "
+              f"device (one-time {encode_s * 1e3:.0f} ms incl. tunnel "
+              f"RTT; --no-pre-encode for the per-resolution-encode "
+              f"form)", file=sys.stderr)
 
     def resolve():
         return sharded_consensus(reports, event_bounds=bounds, mesh=mesh,
@@ -432,17 +456,227 @@ def run_bench(args) -> None:
     if pre_encoded:
         out_json["pre_encoded"] = True
         out_json["encode_s"] = round(encode_s, 4)
+    # ISSUE 13 satellite: the encode story as a structured JSON block
+    # (bytes, MB/s, which path ran, one-time seconds) instead of only a
+    # stderr gate line
+    if pre_encoded:
+        out_json["encode"] = {
+            "path": "device",
+            "pre_encoded": True,
+            "one_time_s": round(encode_s, 4),
+            "bytes_in": int(R) * int(E) * raw_itemsize,
+            "bytes_out": int(R) * int(E),
+            "mb_per_s": round(R * E * raw_itemsize / 1e6
+                              / max(encode_s, 1e-9), 1),
+        }
+    else:
+        out_json["encode"] = {
+            "path": None,
+            "pre_encoded": False,
+            "reason": ("--no-pre-encode" if args.no_pre_encode
+                       else "scaled events" if args.scaled
+                       else f"storage_dtype={resolved.storage_dtype!r}"
+                            " is not int8"),
+        }
+        if not args.no_pre_encode:
+            # the hot loop is not consuming int8 here, but the artifact
+            # should still carry the measured one-time device-encode
+            # cost of THIS matrix (fail-soft probe, clearly labeled)
+            try:
+                from pyconsensus_tpu.models.pipeline import \
+                    encode_reports_device
+
+                jax.block_until_ready(encode_reports_device(raw_reports))
+                t0 = time.perf_counter()
+                probe = encode_reports_device(raw_reports)
+                float(np.asarray(probe[0, 0], dtype=np.float64))
+                dt = time.perf_counter() - t0
+                out_json["encode"].update({
+                    "path": "device-probe",
+                    "one_time_s": round(dt, 4),
+                    "bytes_in": int(R) * int(E) * raw_itemsize,
+                    "bytes_out": int(R) * int(E),
+                    "mb_per_s": round(R * E * raw_itemsize / 1e6
+                                      / max(dt, 1e-9), 1),
+                })
+            except Exception as exc:          # noqa: BLE001
+                print(f"WARNING: device-encode probe unavailable: "
+                      f"{type(exc).__name__}: {exc}", file=sys.stderr)
     out_json["obs"] = _obs_columns(out)
+    out_json["pipeline"] = _pipeline_block(args, resolve, force)
     out_json["device_scaling"] = _device_scaling_block(args, reports,
                                                        params, n_dev,
                                                        value)
     out_json["latency"] = _latency_block(args)
+    out_json["roofline"] = _roofline_block(args, resolved, value,
+                                           out_json["obs"], raw_itemsize,
+                                           out_json["latency"])
     out_json["incremental"] = _incremental_block(args)
     out_json["serve"] = _serve_block(args)
     out_json["cold_start"] = _cold_start_block(args)
     out_json["fleet"] = _fleet_block(args)
     out_json["economy"] = _economy_block(args)
     print(json.dumps(out_json))
+
+
+def _pipeline_block(args, resolve, force):
+    """ISSUE 13 tentpole b, at the bench shape: the hot loop run two
+    ways — fully SYNCHRONOUS (submit → dispatch → block per
+    resolution, the pre-ISSUE-13 loop the motivation names) and
+    PIPELINED with a depth-N in-flight window (block only on the
+    oldest dispatch once the window fills). Reports both rates, the
+    depth, a bit-identity digest over the catch-snapped outcomes +
+    reputations of a representative resolution from each mode (the
+    determinism contract: pipelining changes WHEN results are fetched,
+    never what they are), and the jit-retrace delta across the
+    pipelined run (must be 0 — pipelining re-uses the warmed
+    executables). FAIL-SOFT like the serve block."""
+    if args.no_pipeline:
+        return None
+    try:
+        import hashlib
+
+        import numpy as np
+
+        from pyconsensus_tpu import obs
+
+        depth = int(args.pipeline_depth)
+        if depth <= 0:
+            from pyconsensus_tpu.tune import tuned_pipeline_depth
+
+            depth = max(2, tuned_pipeline_depth(args.events))
+        n = max(4, min(args.repeats, 12))
+
+        def digest(o):
+            h = hashlib.sha256()
+            for k in ("outcomes_adjusted", "smooth_rep", "iterations"):
+                h.update(np.ascontiguousarray(np.asarray(o[k])).tobytes())
+            return h.hexdigest()
+
+        def retraces():
+            return sum(int(obs.value("pyconsensus_jit_retraces_total",
+                                     entry=e) or 0)
+                       for e in ("fused_sharded", "consensus_light"))
+
+        # synchronous rung: one blocking fetch per resolution
+        t0 = time.perf_counter()
+        for _ in range(n):
+            last_sync = resolve()
+            force(last_sync)
+        sync_rate = n / (time.perf_counter() - t0)
+
+        r0 = retraces()
+        t0 = time.perf_counter()
+        ring = []
+        last_pipe = None
+        for _ in range(n):
+            o = resolve()
+            last_pipe = o
+            ring.append(o)
+            while len(ring) >= depth:
+                force(ring.pop(0))
+        for o in ring:
+            force(o)
+        pipe_rate = n / (time.perf_counter() - t0)
+        added_retraces = retraces() - r0
+
+        block = {
+            "depth": depth,
+            "sync_resolutions_per_sec": round(sync_rate, 4),
+            "pipelined_resolutions_per_sec": round(pipe_rate, 4),
+            "speedup": round(pipe_rate / sync_rate, 3),
+            "digest_match": digest(last_sync) == digest(last_pipe),
+            "added_retraces": int(added_retraces),
+        }
+        if not block["digest_match"]:
+            print("WARNING: pipelined hot loop digest differs from the "
+                  "synchronous loop — determinism contract violated",
+                  file=sys.stderr)
+        if added_retraces:
+            print(f"WARNING: pipelined hot loop added {added_retraces} "
+                  f"retrace(s); expected 0", file=sys.stderr)
+        if block["speedup"] < 1.0:
+            print(f"WARNING: pipelined depth-{depth} dispatch "
+                  f"({pipe_rate:.2f} res/s) did not beat the "
+                  f"synchronous loop ({sync_rate:.2f} res/s)",
+                  file=sys.stderr)
+        return block
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: pipeline block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
+def _roofline_block(args, resolved, headline_rate, obs_cols, raw_itemsize,
+                    latency_block):
+    """ISSUE 13 tentpole d: achieved vs memory-bandwidth-bound res/s
+    per bucket class, so the BENCH trajectory distinguishes host-bound
+    rungs (fixed by ingestion/pipelining work) from bandwidth-bound
+    ones (fixed by storage compression or more chips). The bound is
+    the measured device stream bandwidth divided by the modeled HBM
+    traffic of one resolution (``tune.roofline``); the model's one
+    free parameter — power sweeps per iteration, data-dependent via
+    the early exit — is recorded alongside the rungs
+    (``--roofline-sweeps``). Rungs: the headline shape (achieved = the
+    measured throughput) plus every latency-block (shape, path) rung
+    (achieved = 1000 / p50_ms). FAIL-SOFT like the serve block."""
+    if args.no_roofline:
+        return None
+    try:
+        import jax
+
+        from pyconsensus_tpu.tune import (bound_resolutions_per_sec,
+                                          classify_regime,
+                                          resolution_traffic_bytes,
+                                          stream_bandwidth_bytes_per_s)
+
+        def itemsize(storage: str) -> int:
+            return {"int8": 1, "bfloat16": 2, "": raw_itemsize,
+                    "full": raw_itemsize}.get(storage, raw_itemsize)
+
+        bw = stream_bandwidth_bytes_per_s(
+            mbytes=min(64, max(8, args.reporters * args.events * 4
+                               // (1 << 20) or 8)), repeats=3)
+        sweeps = max(1, int(args.roofline_sweeps))
+        iters = int(obs_cols.get("iterations") or 1)
+
+        def rung(cls, R, E, storage, achieved):
+            traffic = resolution_traffic_bytes(
+                R, E, itemsize(storage), sweeps, iterations=iters,
+                acc_itemsize=raw_itemsize)
+            bound = bound_resolutions_per_sec(bw, traffic)
+            return {
+                "class": cls,
+                "achieved_rps": round(achieved, 4),
+                "bound_rps": round(bound, 4),
+                "fraction_of_roof": round(achieved / bound, 4),
+                "regime": classify_regime(achieved, bound),
+            }
+
+        storage = resolved.storage_dtype or ""
+        rungs = [rung(f"{args.reporters}x{args.events}/"
+                      f"{storage or 'full'}", args.reporters,
+                      args.events, storage, headline_rate)]
+        for entry in latency_block or []:
+            R, E = (int(x) for x in entry["shape"].split("x"))
+            for path, stats in (entry.get("paths") or {}).items():
+                if not stats or not stats.get("p50_ms"):
+                    continue
+                rungs.append(rung(
+                    f"{entry['shape']}/{path}/{stats['storage']}",
+                    R, E, stats["storage"], 1e3 / stats["p50_ms"]))
+        return {
+            "stream_bandwidth_gbps": round(bw / 1e9, 3),
+            "backend": jax.default_backend(),
+            "model": {"sweeps_per_iteration": sweeps,
+                      "iterations": iters,
+                      "acc_itemsize": int(raw_itemsize)},
+            "rungs": rungs,
+        }
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: roofline block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
 
 
 def _latency_block(args):
@@ -568,8 +802,12 @@ def _device_scaling_block(args, reports, params, n_dev: int, headline):
     repeats = max(2, min(args.repeats, 8))
     deadline = time.perf_counter() + min(300.0, args.bench_timeout / 3.0)
     block = []
+    # each rung carries its backend (ISSUE 13 satellite): 8 "devices"
+    # on a CPU host are virtual slices of one memory system, so the
+    # inverse scaling a CPU artifact records must be readable as such
+    backend = jax.default_backend()
     for d in ladder:
-        entry = {"n_devices": d}
+        entry = {"n_devices": d, "backend": backend}
         if d == n_dev:
             entry["headline_resolutions_per_sec"] = round(headline, 4)
         if time.perf_counter() > deadline:
@@ -812,6 +1050,7 @@ def _serve_block(args):
             "latency_p50_ms": stats["latency_p50_ms"],
             "latency_p99_ms": stats["latency_p99_ms"],
             "mean_batch_occupancy": mean_occ,
+            "pipeline_depth": svc.pipeline_depth,
             **device_block(svc),
             "cache_hit_ratio": svc.cache.hit_ratio(),
             "warmed_buckets": len(buckets),

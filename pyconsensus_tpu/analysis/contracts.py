@@ -54,6 +54,11 @@ CONTRACT_RULES = {
     "CL305": ("error", "bf16/i8-operand compare in compiled HLO "
                        "(Mosaic rejects the lowered cmpf/cmpi — "
                        "BENCH_r02's compile-failure class)"),
+    "CL306": ("error", "donated input buffers not aliased in compiled "
+                       "HLO (the padded-bucket donation contract: XLA "
+                       "must re-use the donated pad storage for "
+                       "outputs, or every dispatch allocates fresh "
+                       "buffers)"),
 }
 
 _DEFAULT_CONTRACTS = pathlib.Path(__file__).with_name("contracts.json")
@@ -132,6 +137,37 @@ def host_callbacks(hlo_text: str) -> List[str]:
 #: structural guard, this is its post-lowering mirror inside the lint
 #: gate.
 _ILLEGAL_CMP_RE = re.compile(r"compare\([^)]*\b(bf16|s8|u8)\[")
+
+
+def input_output_aliases(hlo_text: str) -> List[tuple]:
+    """``[(output_index, param_number), ...]`` parsed from the compiled
+    module's ``input_output_alias={ {out}: (param, {}, may-alias), … }``
+    header attribute — the artifact donation leaves behind when XLA
+    actually re-uses a donated input buffer for an output. An HLO
+    module with no alias table (nothing donated, or nothing usable)
+    parses as the empty list."""
+    out: List[tuple] = []
+    for line in hlo_text.splitlines():
+        if "input_output_alias={" not in line:
+            continue
+        seg = line.split("input_output_alias={", 1)[1]
+        # the table nests braces ({0}: (2, {}, may-alias)); walk to the
+        # matching close instead of trusting a regex across the header
+        depth, end = 1, 0
+        for i, ch in enumerate(seg):
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        table = seg[:end]
+        for m in re.finditer(r"\{\s*([0-9]*)[0-9, ]*\}:\s*\(([0-9]+)",
+                             table):
+            out.append((int(m.group(1) or 0), int(m.group(2))))
+        break
+    return out
 
 
 def bf16_compare_ops(hlo_text: str) -> List[str]:
@@ -497,10 +533,14 @@ def _serve_bucket_args(spec: dict):
 def _builder_serve_bucket(spec: dict) -> str:
     """The serving layer's padded bucket entry point
     (serve.kernels.padded_consensus) — the hot path every bucketed
-    dispatch rides; must stay collective- and callback-free."""
+    dispatch rides; must stay collective- and callback-free.
+    ``"donate": true`` in the spec builds the serving cache's DONATED
+    form (ISSUE 13) so the CL306 aliasing assertion sees the artifact
+    dispatch actually runs."""
     from ..serve.kernels import make_bucket_executable
 
-    fn = make_bucket_executable(_params(spec))
+    fn = make_bucket_executable(_params(spec),
+                                donate=bool(spec.get("donate")))
     return fn.lower(*_serve_bucket_args(spec),
                     _params(spec)).compile().as_text()
 
@@ -567,7 +607,8 @@ def _builder_serve_bucket_sharded(spec: dict) -> str:
     R, E = _shape(spec)
     mesh, p, B = _serve_mesh_setup(spec)
     dt = _acc_dtype()
-    fn = make_sharded_bucket_executable(p, mesh, batched=B > 1)
+    fn = make_sharded_bucket_executable(p, mesh, batched=B > 1,
+                                        donate=bool(spec.get("donate")))
     lead = (B,) if B > 1 else ()
     args = (jax.ShapeDtypeStruct(lead + (R, E), dt),
             jax.ShapeDtypeStruct(lead + (R,), dt),
@@ -764,6 +805,17 @@ def check_artifact(name: str, hlo_text: str, spec: dict) -> List[Finding]:
                         f"compiled HLO — Mosaic rejects the lowered "
                         f"form (first: {bad[0][:120]})",
                 severity="error", snippet=f"{name}:bf16cmp"))
+    if "min_donated_aliases" in spec:
+        aliases = input_output_aliases(hlo_text)
+        want = int(spec["min_donated_aliases"])
+        if len(aliases) < want:
+            out.append(Finding(
+                rule="CL306", path=path, line=0,
+                message=f"compiled module aliases only {len(aliases)} "
+                        f"donated input buffer(s) to outputs (contract "
+                        f"requires >= {want}) — donated pad storage is "
+                        f"not being re-used",
+                severity="error", snippet=f"{name}:alias"))
     return out
 
 

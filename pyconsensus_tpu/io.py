@@ -32,7 +32,8 @@ from .faults import InputError
 from .faults import plan as _faults
 
 __all__ = ["save_reports", "load_reports", "load_reports_sharded",
-           "csv_to_npy", "ensure_parent", "atomic_write"]
+           "load_reports_encoded", "csv_to_npy", "ensure_parent",
+           "atomic_write"]
 
 
 def ensure_parent(path) -> pathlib.Path:
@@ -383,3 +384,22 @@ def load_reports_sharded(path, mesh=None, dtype=None):
         block = np.ascontiguousarray(src[idx], dtype=dtype)
         arrays.append(jax.device_put(block, d))
     return jax.make_array_from_single_device_arrays((R, E), sharding, arrays)
+
+
+def load_reports_encoded(path, mesh=None, dtype=None):
+    """Device-resident int8 sentinel ingestion (ISSUE 13 tentpole a):
+    load a ``.npy`` reports matrix event-sharded over ``mesh``
+    (:func:`load_reports_sharded` — host peak of one shard), then build
+    the int8 sentinel + NaN mask ON DEVICE
+    (:func:`~pyconsensus_tpu.models.pipeline.encode_reports_device`,
+    elementwise, so GSPMD keeps the event sharding). The host never
+    runs an encode pass over the panel: the one-time host cost is the
+    shard copies, and every subsequent resolution reads one byte per
+    element. Values off the {0, 0.5, 1} lattice are rounded onto it at
+    the accumulation dtype (``encode_reports``'s documented contract —
+    the rounding a float input would get from ``storage_dtype='int8'``
+    anyway, just at ingestion time)."""
+    from .models.pipeline import encode_reports_device
+
+    return encode_reports_device(
+        load_reports_sharded(path, mesh=mesh, dtype=dtype))

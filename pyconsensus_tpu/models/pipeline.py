@@ -42,7 +42,9 @@ from .sztorc import (fixed_variance_k, fixed_variance_scores_jax,
                      sztorc_scores_np)
 
 __all__ = ["ConsensusParams", "consensus_np", "consensus_jax",
-           "JIT_ALGORITHMS", "encode_reports", "decode_reports"]
+           "JIT_ALGORITHMS", "encode_reports", "decode_reports",
+           "encode_reports_host", "encode_reports_device",
+           "lattice_exact"]
 
 #: algorithms whose full pipeline compiles to one XLA graph
 JIT_ALGORITHMS = ("sztorc", "fixed-variance", "ica", "k-means", "dbscan-jit")
@@ -550,6 +552,73 @@ def encode_reports(reports):
     na = jnp.isnan(reports)
     return jnp.where(na, -1, jnp.round(jnp.clip(reports, 0.0, 1.0) * 2.0)
                      ).astype(jnp.int8)
+
+
+def _record_encode(n_elems: int, path: str) -> None:
+    """ISSUE 13: ingestion-encode accounting (docs/OBSERVABILITY.md).
+    ``path`` says WHERE the sentinel bytes were produced — ``device``
+    (the jitted encode, the production ingestion path) or ``host`` (the
+    numpy reference mirror)."""
+    obs.counter(
+        "pyconsensus_ingest_encodes_total",
+        "report panels encoded to int8 sentinel storage at ingestion",
+        labels=("path",)).inc(path=path)
+    obs.counter(
+        "pyconsensus_ingest_encoded_bytes_total",
+        "int8 sentinel bytes produced by ingestion encodes (one byte "
+        "per panel element)", labels=("path",)).inc(int(n_elems),
+                                                    path=path)
+
+
+#: the process-wide jitted encode entry — ONE instrumented jit so the
+#: retrace counter (``entry="encode_reports"``) stays at one compile per
+#: distinct panel shape/dtype instead of one per caller
+_ENCODE_JIT = None
+
+
+def encode_reports_device(reports):
+    """:func:`encode_reports` on device, through the process-wide
+    instrumented jit: the int8 sentinel + NaN mask are built from the
+    raw float panel ON DEVICE (ISSUE 13 tentpole a) — the host never
+    touches the panel again after the initial placement, and repeated
+    ingests of the same shape pay zero retraces. Bit-identical to
+    :func:`encode_reports_host` on the same-dtype input (pinned by
+    tests and the CI parity probe). Returns a device int8 array."""
+    global _ENCODE_JIT
+    if _ENCODE_JIT is None:
+        _ENCODE_JIT = obs.instrument_jit(jax.jit(encode_reports),
+                                         "encode_reports")
+    out = _ENCODE_JIT(jnp.asarray(reports))
+    _record_encode(out.size, "device")
+    return out
+
+
+def encode_reports_host(reports) -> np.ndarray:
+    """The HOST (numpy) mirror of :func:`encode_reports` — the reference
+    the device encode is pinned bit-identical against (same clip/
+    round-half-to-even semantics; parity holds per input dtype, since
+    rounding of off-lattice values is dtype-dependent by construction).
+    Kept as the fallback/reference path, not the production one."""
+    reports = np.asarray(reports)
+    na = np.isnan(reports)
+    enc = np.where(na, -1,
+                   np.round(np.clip(reports, 0.0, 1.0) * 2.0)
+                   ).astype(np.int8)
+    _record_encode(enc.size, "host")
+    return enc
+
+
+def lattice_exact(reports) -> bool:
+    """Whether every value of a float panel is EXACTLY representable in
+    int8 sentinel storage — on the {0, 0.5, 1} lattice or NaN — so
+    ``decode(encode(panel))`` reproduces the panel bit-for-bit
+    (``-0.0`` is excluded: the lattice only carries ``+0.0``, and the
+    sign of zero is observable downstream). The gate the serve
+    session's encoded staging applies per appended block."""
+    a = np.asarray(reports)
+    ok = (np.isnan(a) | (a == 0.5) | (a == 1.0)
+          | ((a == 0.0) & ~np.signbit(a)))
+    return bool(ok.all())
 
 
 def looks_encoded(arr) -> bool:

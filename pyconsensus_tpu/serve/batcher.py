@@ -13,6 +13,22 @@ cost is that a singleton dispatch computes ``max_batch`` lanes —
 latency-focused deployments set ``max_batch=1`` to trade coalescing
 away.
 
+**Pipelined dispatch (ISSUE 13 tentpole b).** Bucketed dispatches are
+ASYNC: jax returns device futures, so the batcher pushes each dispatch
+onto a bounded in-flight ring (``ServeConfig.pipeline_depth``) and
+fetches results — the only blocking step — only when the ring exceeds
+its depth, the queue goes idle, or the service drains. With depth N,
+the host builds and transfers dispatch k+1's padded lanes UNDER
+dispatch k's device compute instead of idling on the fetch round-trip.
+Determinism is untouched: each dispatch is a pure function of its own
+inputs, so retiring later never changes a bit (pinned by tests —
+depth-N results are bit-identical to the synchronous depth-1 loop),
+and no executable changes, so pipelining adds zero retraces. Host pad
+buffers are per-key :class:`~.kernels.BucketTemplates` (reused, not
+reallocated per dispatch); reuse under in-flight dispatches is safe
+because the host→device placement copies out of the numpy buffer
+before dispatch returns.
+
 Requests whose configuration the bucket kernel does not serve
 (``kernels.bucket_path_eligible``), whose shape exceeds the bucket
 ladders, or whose backend is numpy dispatch DIRECTLY — a per-request
@@ -25,7 +41,9 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict, deque
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,6 +64,20 @@ OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
 #: keys of the flat light dict a lane must carry into assemble_result
 _SCALAR_KEYS = ("iterations", "convergence", "percent_na",
                 "avg_certainty")
+
+
+class _Inflight:
+    """One dispatched bucket group awaiting retirement: the device
+    result futures plus everything the host-side finish needs."""
+
+    __slots__ = ("key", "path", "live", "raw", "capacity")
+
+    def __init__(self, key, path, live, raw, capacity) -> None:
+        self.key = key
+        self.path = path
+        self.live = live
+        self.raw = raw
+        self.capacity = capacity
 
 
 class Microbatcher:
@@ -81,6 +113,27 @@ class Microbatcher:
         # hand-maintained literal here could silently drift its help
         # text by import order
         self._kernel_path = kernel_path_counter()
+        # pipelined dispatch (ISSUE 13): bounded in-flight ring +
+        # per-key reusable pad templates; depth resolved from config
+        # (0 = auto: the tune/ winner for this ladder's shape class,
+        # falling back to the measured-good default of 2)
+        self._ring: deque = deque()
+        self._templates: OrderedDict = OrderedDict()
+        depth = int(getattr(config, "pipeline_depth", 1) or 0)
+        if depth == 0:
+            from ..tune.autotune import tuned_pipeline_depth
+
+            depth = tuned_pipeline_depth(config.event_buckets[-1])
+        self._depth = max(1, depth)
+        obs.gauge(
+            "pyconsensus_serve_pipeline_depth",
+            "configured dispatch pipeline depth (in-flight bucketed "
+            "dispatches the batcher keeps before blocking on a "
+            "fetch)").set(self._depth)
+        self._inflight_gauge = obs.gauge(
+            "pyconsensus_serve_inflight_dispatches",
+            "bucketed dispatches currently in flight on the async "
+            "dispatch ring")
 
     # -- lifecycle ------------------------------------------------------
 
@@ -100,8 +153,11 @@ class Microbatcher:
 
     def _run(self) -> None:
         while True:
-            req = self.queue.take(timeout=0.05)
+            # with dispatches in flight poll fast: an idle tick is what
+            # retires the ring tail, so its latency bound must be small
+            req = self.queue.take(timeout=0.002 if self._ring else 0.05)
             if req is None:
+                self._drain_ring(0)          # idle: retire everything
                 if self.queue.closed:
                     return
                 continue
@@ -118,6 +174,15 @@ class Microbatcher:
                      - req.submitted_at)
             self._requests.inc(path=req.dispatch_path, outcome="shed")
             return
+        ring_path = (req.dispatch_path == "bucket"
+                     and req.batch_key.kernel_path != PALLAS_KERNEL_PATH)
+        if not ring_path and self._ring:
+            # any non-ring dispatch is a synchronization point: older
+            # in-flight bucket results retire FIRST — sustained
+            # direct/pallas/session traffic keeps take() returning
+            # work, so without this a finished ring result could sit
+            # undelivered until its waiter's deadline
+            self._drain_ring(0)
         if req.dispatch_path == "bucket":
             group = [req] + self._coalesce(req)
             self._dispatch_bucket(group)
@@ -172,13 +237,17 @@ class Microbatcher:
             _faults.fire("serve.dispatch")
             self._kernel_path.inc(len(live), path="xla")
             capacity = key.batch
-            lanes = []
-            for r in live:
-                lanes.append(sk.bucket_inputs(
-                    r.reports, r.reputation, r.scaled, r.mins, r.maxs,
-                    key.rows, key.events, has_na=key.params.has_na))
-            while len(lanes) < capacity:
-                lanes.append(lanes[0])   # pure lanes: replication is free
+            tmpl = self._template_for(key)
+            for i, r in enumerate(live):
+                tmpl.fill_lane(i, r.reports, r.reputation, r.scaled,
+                               r.mins, r.maxs,
+                               has_na=key.params.has_na)
+            for i in range(len(live), capacity if capacity > 1 else 1):
+                # unoccupied lanes ride in the pad-default state (pure
+                # lanes: their outputs are computed and discarded; the
+                # all-pad lane is exactly the warmup input, resolving
+                # degenerately fast)
+                tmpl.reset_lane(i)
             entry = self.cache.get(key)
             if key.topology != SINGLE_TOPOLOGY:
                 # the serve/fused bucket dispatch emits the mesh-width
@@ -194,13 +263,18 @@ class Microbatcher:
                           bucket=f"{key.rows}x{key.events}",
                           topology=key.topology,
                           occupancy=len(live)):
-                if capacity > 1:
-                    stacked = [jnp.asarray(np.stack(field))
-                               for field in zip(*lanes)]
-                else:
-                    stacked = [jnp.asarray(a) for a in lanes[0]]
+                stacked = [jnp.asarray(a) for a in tmpl.arrays()]
+                # pin the host→device TRANSFER complete before the
+                # template may be refilled (BucketTemplates' reuse
+                # contract): on TPU the placement can return with the
+                # copy still in flight, and the next dispatch of this
+                # key rewrites these very buffers. Blocking here waits
+                # on the transfer only — the compute below stays async
+                # (the ring's whole point). Must run BEFORE the entry
+                # call: the executable DONATES the vector buffers, so
+                # afterwards they are deleted.
+                jax.block_until_ready(stacked)
                 raw = entry(*stacked, key.params)
-                host = {k: np.asarray(v) for k, v in raw.items()}
         except BaseException as exc:  # noqa: BLE001 — EVERY waiter must
             # learn of a group failure; resolving only the opener would
             # leave the coalesced members hanging to their timeouts
@@ -209,17 +283,57 @@ class Microbatcher:
                     r.future.set_exception(exc)
                     self._requests.inc(path=path, outcome="error")
             raise
-        for i, r in enumerate(live):
-            lane = {k: (v[i] if capacity > 1 else v)
+        # async hand-off: the device result rides the in-flight ring;
+        # the fetch (the only blocking step) happens at _retire
+        self._ring.append(_Inflight(key, path, live, raw, capacity))
+        self._drain_ring(self._depth - 1)
+
+    def _template_for(self, key: BucketKey):
+        """The per-key reusable pad template (LRU-bounded alongside the
+        executable cache so a many-bucket workload cannot grow host pad
+        buffers without bound)."""
+        tmpl = self._templates.get(key)
+        if tmpl is None:
+            tmpl = self._templates[key] = sk.BucketTemplates(
+                key.rows, key.events, key.batch)
+            while len(self._templates) > self.config.cache_capacity:
+                self._templates.popitem(last=False)
+        else:
+            self._templates.move_to_end(key)
+        return tmpl
+
+    def _drain_ring(self, allowed: int) -> None:
+        """Retire in-flight dispatches (oldest first) until at most
+        ``allowed`` remain."""
+        while len(self._ring) > allowed:
+            self._retire(self._ring.popleft())
+        self._inflight_gauge.set(len(self._ring))
+
+    def _retire(self, inf: _Inflight) -> None:
+        """Fetch one in-flight dispatch's results and resolve its
+        waiters — the synchronous tail of ``_dispatch_bucket``. A
+        device-side failure surfaces here, on THIS group's waiters."""
+        try:
+            host = {k: np.asarray(v) for k, v in inf.raw.items()}
+        except BaseException as exc:  # noqa: BLE001 — every waiter of
+            # the failed dispatch must learn of it; later dispatches
+            # are independent and keep retiring
+            for r in inf.live:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+                    self._requests.inc(path=inf.path, outcome="error")
+            return
+        for i, r in enumerate(inf.live):
+            lane = {k: (v[i] if inf.capacity > 1 else v)
                     for k, v in host.items()}
             flat = sk.slice_result(lane, r.shape[0], r.shape[1])
             for k in _SCALAR_KEYS:
                 flat[k] = np.asarray(flat[k]).item()
             result = assemble_result(flat)
             result["quarantined_rows"] = r.quarantined_rows
-            record_consensus_result(result, key.params.algorithm,
+            record_consensus_result(result, inf.key.params.algorithm,
                                     "serve")
-            self._finish(r, result, path)
+            self._finish(r, result, inf.path)
 
     def _dispatch_pallas(self, key: BucketKey, live) -> None:
         """The ``bucket_pallas`` low-latency dispatch: per-request,

@@ -265,9 +265,15 @@ class ExecutableCache:
                              f"{XLA_KERNEL_PATH!r}, "
                              f"{PALLAS_KERNEL_PATH!r} or "
                              f"{INCREMENTAL_KERNEL_PATH!r})")
+        # the padded xla/sharded bucket classes build DONATED (ISSUE 13
+        # tentpole c): the batcher hands each dispatch fresh device
+        # arrays, so XLA may alias the padded vector inputs to outputs
+        # — callers that re-call with the same arrays must build their
+        # own undonated executable via make_*_bucket_executable
         if topology == SINGLE_TOPOLOGY:
             return sk.make_bucket_executable(key.params,
-                                             batched=key.batch > 1)
+                                             batched=key.batch > 1,
+                                             donate=True)
         if topology != self.mesh_topology:
             raise ValueError(
                 f"wrong-topology bucket key {topology!r}: this cache "
@@ -275,7 +281,8 @@ class ExecutableCache:
                 f"key minted for another mesh/device kind must never "
                 f"reach this executable cache")
         return make_sharded_bucket_executable(key.params, self.mesh,
-                                              batched=key.batch > 1)
+                                              batched=key.batch > 1,
+                                              donate=True)
 
     def warm(self, key: BucketKey) -> None:
         """Materialize ``key``'s executable AND populate its call cache

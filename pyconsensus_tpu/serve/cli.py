@@ -62,6 +62,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "summary reports retried/abandoned counts)")
     ap.add_argument("--window-ms", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    metavar="N",
+                    help="in-flight bucketed dispatches before the "
+                         "batcher blocks on a fetch (ISSUE 13): 1 = "
+                         "synchronous, N >= 2 overlaps host transfer "
+                         "with device compute, 0 = auto-tuned "
+                         "(bit-identical results at any depth)")
     ap.add_argument("--rate-limit", type=float, default=None,
                     help="per-tenant admission rate (req/s)")
     ap.add_argument("--pallas-buckets", choices=["auto", "on", "off"],
@@ -115,6 +122,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         overrides["batch_window_ms"] = float(args.window_ms)
     if args.max_batch is not None:
         overrides["max_batch"] = int(args.max_batch)
+    if args.pipeline_depth is not None:
+        overrides["pipeline_depth"] = int(args.pipeline_depth)
     if args.rate_limit is not None:
         overrides["rate_limit_rps"] = float(args.rate_limit)
     if args.pallas_buckets is not None:
@@ -186,6 +195,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     occ = mean_batch_occupancy()
     if occ is not None:
         stats["mean_batch_occupancy"] = round(occ, 3)
+    stats["pipeline_depth"] = svc.pipeline_depth
     # mesh interpretability (ISSUE 6): throughput numbers mean nothing
     # without knowing how many devices served them
     stats.update(device_block(svc))

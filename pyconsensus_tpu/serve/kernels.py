@@ -83,7 +83,20 @@ from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 
 __all__ = ["padded_consensus", "make_bucket_executable", "bucket_inputs",
-           "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS"]
+           "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS",
+           "BucketTemplates", "DONATED_ARGS"]
+
+#: positions of the bucket-call args donated to XLA on the serving path
+#: (``reports, reputation, scaled, mins, maxs, row_valid, col_valid,
+#: seed``): the dt-typed PADDED VECTORS — reputation aliases one of the
+#: six (R,) float outputs, mins/maxs/seed three of the nine (E,) float
+#: outputs, so XLA re-uses their pad storage for outputs instead of
+#: allocating fresh buffers every dispatch. The (R, E) matrix and the
+#: bool masks have no same-shape/dtype output to alias (XLA only
+#: re-uses donated buffers through output aliasing), so donating them
+#: would just trip the unusable-donation warning. Verified per compile
+#: by the CL306 aliasing contract (analysis.contracts).
+DONATED_ARGS = (1, 3, 4, 7)
 
 #: algorithms the padded bucket kernel scores (see module docstring);
 #: everything else takes the direct per-shape dispatch path
@@ -315,13 +328,22 @@ def padded_consensus(reports, reputation, scaled, mins, maxs, row_valid,
     return result
 
 
-def make_bucket_executable(p: ConsensusParams, batched: bool = False):
+def make_bucket_executable(p: ConsensusParams, batched: bool = False,
+                           donate: bool = False):
     """A FRESH jitted executable for one (params[, batch]) cache entry —
     its compile cache is private, so evicting the entry from the serve
     cache actually frees the executable. Instrumented under the shared
     ``serve_bucket`` entry label: after warmup the retrace counter equals
     the number of compiled buckets and must stay there under steady
-    traffic (the runtime CL304 invariant the CI smoke pins)."""
+    traffic (the runtime CL304 invariant the CI smoke pins).
+
+    ``donate=True`` (the serving cache's build mode, ISSUE 13 tentpole
+    c) donates the :data:`DONATED_ARGS` input buffers so XLA aliases
+    their pad storage to same-shaped outputs — a dispatch then
+    invalidates those device arrays, which is safe on the serving path
+    (the batcher builds fresh device arrays per dispatch) but NOT for
+    callers that re-call with the same arrays; donation never changes
+    results (pinned by tests), only buffer lifetime."""
     if batched:
         def fn(reports, reputation, scaled, mins, maxs, row_valid,
                col_valid, seed, p):
@@ -332,7 +354,54 @@ def make_bucket_executable(p: ConsensusParams, batched: bool = False):
     else:
         fn = jk.exact_matmuls(padded_consensus)
     return obs.instrument_jit(
-        jax.jit(fn, static_argnames=("p",)), "serve_bucket")
+        jax.jit(fn, static_argnames=("p",),
+                donate_argnums=DONATED_ARGS if donate else ()),
+        "serve_bucket")
+
+
+@functools.lru_cache(maxsize=1024)
+def _seed_host(E: int, dtype_name: str) -> np.ndarray:
+    """The TRUE-width power seed as a cached READ-ONLY host array —
+    ``jk._power_seed`` is a device computation + fetch, deterministic
+    per (width, dtype), so a serving hot loop must not recompute it on
+    every dispatch (ISSUE 13 ingestion satellite). Callers copy out of
+    it (the fill core writes it into the padded seed buffer)."""
+    seed = np.asarray(jk._power_seed(E, np.dtype(dtype_name)))
+    seed.setflags(write=False)
+    return seed
+
+
+def _fill_bucket_views(views, reports, reputation, scaled, mins, maxs,
+                       has_na: bool):
+    """The ONE copy of the pad construction (module contract), writing
+    a request into pre-defaulted bucket-shaped buffers:
+    ``views = (padded, rep, sc, mn, mx, row_valid, col_valid, seed)``
+    must arrive in the pad-default state (zeros; ``mx`` ones) —
+    :func:`bucket_inputs` allocates fresh defaults, a
+    :class:`BucketTemplates` lane restores them before refill."""
+    padded, rep, sc, mn, mx, row_valid, col_valid, seed = views
+    reports = np.asarray(reports, dtype=np.float64)
+    R, E = reports.shape
+    bucket_rows, bucket_events = padded.shape
+    if not (R <= bucket_rows and E <= bucket_events):
+        raise ValueError(f"shape {(R, E)} exceeds bucket "
+                         f"{(bucket_rows, bucket_events)}")
+    # pad rows: NaN in real columns (absent, 0-weight) on the NA path,
+    # present zeros on the dense path; pad columns: present zeros
+    # everywhere (exactly-zero deviation columns)
+    padded[:R, :E] = reports
+    if bucket_rows > R and has_na:
+        padded[R:, :E] = np.nan
+    rep[:R] = np.asarray(reputation, dtype=np.float64)
+    sc[:E] = np.asarray(scaled, dtype=bool)
+    mn[:E] = np.asarray(mins, dtype=np.float64)
+    mx[:E] = np.asarray(maxs, dtype=np.float64)
+    row_valid[:R] = True
+    col_valid[:E] = True
+    # the TRUE-width power seed, zero-extended (threefry draws are not
+    # prefix-stable across lengths — module docstring)
+    seed[:E] = _seed_host(E, seed.dtype.name)
+    return R, E
 
 
 def bucket_inputs(reports, reputation, scaled, mins, maxs,
@@ -351,40 +420,98 @@ def bucket_inputs(reports, reputation, scaled, mins, maxs,
     arithmetic as the direct path (the static hint changes which exact
     reduction computes the outcome means, so it must MATCH the direct
     resolution, not just be semantically equivalent). Present zero rows
-    are exact: zero reputation zeroes them out of every contraction."""
+    are exact: zero reputation zeroes them out of every contraction.
+
+    Allocates fresh buffers per call; the batcher's hot loop goes
+    through :class:`BucketTemplates` instead (same fill core, reused
+    buffers)."""
     reports = np.asarray(reports, dtype=np.float64)
-    R, E = reports.shape
     if has_na is None:
         has_na = bool(np.isnan(reports).any())
-    if not (R <= bucket_rows and E <= bucket_events):
-        raise ValueError(f"shape {(R, E)} exceeds bucket "
-                         f"{(bucket_rows, bucket_events)}")
-    pr, pe = bucket_rows - R, bucket_events - E
-    # pad rows: NaN in real columns (absent, 0-weight) on the NA path,
-    # present zeros on the dense path; pad columns: present zeros
-    # everywhere (exactly-zero deviation columns)
-    padded = np.full((bucket_rows, bucket_events), 0.0, dtype=np.float64)
-    padded[:R, :E] = reports
-    if pr and has_na:
-        padded[R:, :E] = np.nan
-    rep = np.zeros(bucket_rows, dtype=np.float64)
-    rep[:R] = np.asarray(reputation, dtype=np.float64)
-    sc = np.zeros(bucket_events, dtype=bool)
-    sc[:E] = np.asarray(scaled, dtype=bool)
-    mn = np.zeros(bucket_events, dtype=np.float64)
-    mn[:E] = np.asarray(mins, dtype=np.float64)
-    mx = np.ones(bucket_events, dtype=np.float64)
-    mx[:E] = np.asarray(maxs, dtype=np.float64)
-    row_valid = np.zeros(bucket_rows, dtype=bool)
-    row_valid[:R] = True
-    col_valid = np.zeros(bucket_events, dtype=bool)
-    col_valid[:E] = True
-    # the TRUE-width power seed, zero-extended (threefry draws are not
-    # prefix-stable across lengths — module docstring)
     acc = jnp.asarray(0.0).dtype
-    seed = np.zeros(bucket_events, dtype=np.dtype(acc))
-    seed[:E] = np.asarray(jk._power_seed(E, acc))
-    return padded, rep, sc, mn, mx, row_valid, col_valid, seed
+    views = (np.zeros((bucket_rows, bucket_events), dtype=np.float64),
+             np.zeros(bucket_rows, dtype=np.float64),
+             np.zeros(bucket_events, dtype=bool),
+             np.zeros(bucket_events, dtype=np.float64),
+             np.ones(bucket_events, dtype=np.float64),
+             np.zeros(bucket_rows, dtype=bool),
+             np.zeros(bucket_events, dtype=bool),
+             np.zeros(bucket_events, dtype=np.dtype(acc)))
+    _fill_bucket_views(views, reports, reputation, scaled, mins, maxs,
+                       has_na)
+    return views
+
+
+class BucketTemplates:
+    """Reusable host pad buffers for one bucket key (ISSUE 13
+    satellite): the batcher previously allocated-and-zeroed eight
+    full-capacity pad buffers per dispatch (``np.full`` churn that
+    shows up at high request rates); a template keeps ONE set of
+    bucket-shaped buffers per key — batched to the key's capacity when
+    it coalesces — and per dispatch only (a) restores pad defaults over
+    each lane's previously-dirty extent and (b) writes the new request
+    in. The reuse contract: the dispatcher pins the host→device
+    TRANSFER complete (``jax.block_until_ready`` on the placed arrays)
+    before this template may be refilled — jax never zero-copy-aliases
+    the numpy buffers (that needs explicit dlpack), but on TPU the
+    placement can return with the copy still in flight, so blocking on
+    the transfer (not the compute) is what makes refilling under an
+    in-flight pipelined dispatch safe. Single-threaded by contract
+    (the batcher thread owns dispatch)."""
+
+    def __init__(self, rows: int, events: int, capacity: int) -> None:
+        self.rows, self.events = int(rows), int(events)
+        self.capacity = int(capacity)
+        lead = (self.capacity,) if self.capacity > 1 else ()
+        acc = jnp.asarray(0.0).dtype
+        self._fields = (
+            np.zeros(lead + (rows, events), dtype=np.float64),
+            np.zeros(lead + (rows,), dtype=np.float64),
+            np.zeros(lead + (events,), dtype=bool),
+            np.zeros(lead + (events,), dtype=np.float64),
+            np.ones(lead + (events,), dtype=np.float64),
+            np.zeros(lead + (rows,), dtype=bool),
+            np.zeros(lead + (events,), dtype=bool),
+            np.zeros(lead + (events,), dtype=np.dtype(acc)))
+        #: per-lane (R, E) extent of the last fill (None = pad-default)
+        self._dirty = [None] * max(self.capacity, 1)
+
+    def _lane_views(self, i: int):
+        if self.capacity > 1:
+            return tuple(f[i] for f in self._fields)
+        return self._fields
+
+    def reset_lane(self, i: int) -> None:
+        """Restore lane ``i`` to the pad-default state — only over the
+        extent the previous fill dirtied."""
+        dirty = self._dirty[i]
+        if dirty is None:
+            return
+        R_d, E_d = dirty
+        padded, rep, sc, mn, mx, rv, cv, seed = self._lane_views(i)
+        padded[:, :E_d] = 0.0          # covers the NaN pad-row band too
+        rep[:R_d] = 0.0
+        sc[:E_d] = False
+        mn[:E_d] = 0.0
+        mx[:E_d] = 1.0
+        rv[:R_d] = False
+        cv[:E_d] = False
+        seed[:E_d] = 0.0
+        self._dirty[i] = None
+
+    def fill_lane(self, i: int, reports, reputation, scaled, mins, maxs,
+                  has_na: bool) -> None:
+        """Write one request into lane ``i`` (pad construction per the
+        module contract — the :func:`bucket_inputs` fill core)."""
+        self.reset_lane(i)
+        self._dirty[i] = _fill_bucket_views(
+            self._lane_views(i), reports, reputation, scaled, mins,
+            maxs, has_na)
+
+    def arrays(self):
+        """The template's field buffers, dispatch-ordered (the bucket
+        executable's call signature)."""
+        return self._fields
 
 
 #: result keys sliced on the row axis / event axis when trimming a

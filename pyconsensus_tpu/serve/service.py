@@ -76,6 +76,17 @@ class ServeConfig:
     #: batch capacity: every bucketed dispatch runs this many lanes
     #: (fixed — the determinism contract; 1 disables batching)
     max_batch: int = 8
+    #: dispatch pipeline depth (ISSUE 13 tentpole b): how many bucketed
+    #: dispatches the batcher keeps IN FLIGHT before blocking on a
+    #: result fetch — depth N overlaps dispatch k+1's host pad/transfer
+    #: work with dispatch k's device compute. 1 = the synchronous
+    #: submit→dispatch→block loop; 0 (default) = auto: the tune/
+    #: winner-cache depth for this ladder's shape class, falling back
+    #: to the measured-good default of 2. Pipelining never changes
+    #: results (each dispatch is a pure function of its own inputs —
+    #: bit-identity depth-N vs depth-1 is pinned by tests) and adds
+    #: zero retraces.
+    pipeline_depth: int = 0
     #: default per-request shed deadline (ms; None = no deadline)
     default_deadline_ms: Optional[float] = 30_000.0
     #: per-tenant token-bucket rate (req/s; 0 disables rate limiting)
@@ -172,6 +183,12 @@ class ConsensusService:
             raise InputError("bucket ladders must be ascending")
         if self.config.max_batch < 1:
             raise InputError("max_batch must be >= 1")
+        if int(self.config.pipeline_depth) < 0:
+            raise InputError(
+                f"pipeline_depth must be >= 0 (0 = auto-tuned, 1 = "
+                f"synchronous dispatch, N = N in-flight dispatches), "
+                f"got {self.config.pipeline_depth}",
+                pipeline_depth=self.config.pipeline_depth)
         if int(self.config.incremental_refresh_every) < 1:
             # PYC101 by contract: a 0/negative cadence would silently
             # remove the incremental tier's exact-refresh staleness
@@ -220,6 +237,13 @@ class ConsensusService:
                 f"got {mode!r}")
         return serve_mesh(self.config.max_batch,
                           mesh_batch=self.config.mesh_batch)
+
+    @property
+    def pipeline_depth(self) -> int:
+        """The RESOLVED dispatch pipeline depth the batcher runs with
+        (config 0 = auto resolves through the tune/ winner cache) — the
+        loadgen/CLI/bench summary column."""
+        return self.batcher._depth
 
     @property
     def n_devices(self) -> int:

@@ -40,6 +40,7 @@ from .. import obs
 from ..faults import CheckpointCorruptionError, InputError
 from ..faults import plan as _faults
 from ..ledger import ReputationLedger
+from ..models.pipeline import lattice_exact
 from ..ops import jax_kernels as jk
 from ..ops import numpy_kernels as nk
 from ..oracle import parse_event_bounds
@@ -99,6 +100,17 @@ class MarketSession:
         warm kernel — a :class:`~.service.ConsensusService` injects
         its LRU executable cache here; standalone sessions share the
         process-wide default executables.
+    encoded_staging : bool
+        Device-resident int8 staging of appended blocks (ISSUE 13
+        tentpole a): a lattice-exact block ({0, 0.5, 1, NaN} values)
+        is encoded to int8 sentinel storage ON DEVICE at append
+        (``encode_reports_device``) and STAYS there — the statistics
+        fold reads the decoded device form (bit-identical for lattice
+        values), and the resolve-time outcome pass reads the resident
+        int8 array with ZERO re-transfer instead of re-shipping the
+        8-byte float block. Blocks off the lattice keep the float
+        staging unchanged. Default True; False pins every block to
+        host float64 staging.
     """
 
     def __init__(self, name: str, n_reporters: int, reputation=None,
@@ -107,7 +119,8 @@ class MarketSession:
                  convergence_tolerance: float = 1e-6,
                  incremental: bool = False,
                  refresh_every: int = INCREMENTAL_REFRESH_DEFAULT,
-                 executable_provider=None) -> None:
+                 executable_provider=None,
+                 encoded_staging: bool = True) -> None:
         self.name = str(name)
         self.n_reporters = int(n_reporters)
         if self.n_reporters < 1:
@@ -139,6 +152,7 @@ class MarketSession:
         self.catch_tolerance = float(catch_tolerance)
         self.convergence_tolerance = float(convergence_tolerance)
         self.incremental = bool(incremental)
+        self.encoded_staging = bool(encoded_staging)
         self.refresh_every = int(refresh_every)
         if self.refresh_every < 1:
             # the PYC101 contract: a 0/negative cadence must refuse
@@ -246,8 +260,9 @@ class MarketSession:
         with self._lock, obs.span("serve.session_append",
                                   session=self.name, events=e):
             dtype = self._round_rep.dtype
+            staged, panel = self._stage_block(block, dtype)
             dG, dM, dS = _pass1_panel(
-                jnp.asarray(block, dtype=dtype), self._round_rep,
+                panel, self._round_rep,
                 self._round_rep, jnp.asarray(scaled),
                 jnp.asarray(mins, dtype=dtype),
                 jnp.asarray(maxs, dtype=dtype),
@@ -255,7 +270,7 @@ class MarketSession:
             self._G = self._G + dG
             self._M = self._M + dM
             self._S = self._S + dS
-            self._blocks.append(block)
+            self._blocks.append(staged)
             self._bounds.append(
                 list(event_bounds) if event_bounds is not None
                 else [None] * e)
@@ -264,6 +279,43 @@ class MarketSession:
             "pyconsensus_serve_session_appends_total",
             "event blocks appended to market sessions").inc()
         return total
+
+    def _stage_block(self, block: np.ndarray, dtype):
+        """The staging decision (ISSUE 13): returns ``(staged, panel)``
+        — the form kept in ``_blocks`` and the device panel the
+        statistics fold reads. A lattice-exact block is encoded to int8
+        sentinel ON DEVICE and staged as the resident device array (the
+        decode back to ``dtype`` is exact — 1, 2 and the -1 sentinel
+        map to 0.5, 1.0 and NaN bit-for-bit), so the resolve-time
+        outcome pass re-reads it with zero host↔device traffic; any
+        other block keeps the host float64 staging."""
+        if self.encoded_staging and lattice_exact(block):
+            from ..models.pipeline import encode_reports_device
+
+            enc = encode_reports_device(block)
+            return enc, self._panel_device(enc, dtype)
+        return block, jnp.asarray(block, dtype=dtype)
+
+    @staticmethod
+    def _panel_device(block, dtype):
+        """A staged block as the device float panel the streaming
+        kernels consume — the int8-sentinel decode for encoded blocks
+        (``encode_reports``'s lattice: exact at any float dtype), a
+        plain placement otherwise."""
+        if block.dtype == np.int8:
+            b = jnp.asarray(block)
+            return jnp.where(b < 0, jnp.nan, b.astype(dtype) * 0.5)
+        return jnp.asarray(block, dtype=dtype)
+
+    @staticmethod
+    def _staged_host(block) -> np.ndarray:
+        """A staged block back on host as float64 (the direct-resolve /
+        ``_assembled`` form) — exact for encoded blocks by the lattice
+        contract."""
+        if block.dtype == np.int8:
+            enc = np.asarray(block)
+            return np.where(enc < 0, np.nan, enc.astype(np.float64) * 0.5)
+        return np.asarray(block, dtype=np.float64)
 
     def state(self) -> dict:
         """Consistent operator snapshot (one lock hold): rounds
@@ -305,7 +357,8 @@ class MarketSession:
     # -- resolution -----------------------------------------------------
 
     def _assembled(self):
-        reports = np.concatenate(self._blocks, axis=1)
+        reports = np.concatenate(
+            [self._staged_host(b) for b in self._blocks], axis=1)
         bounds = [b for chunk in self._bounds for b in chunk]
         if all(b is None for b in bounds):
             bounds = None
@@ -453,7 +506,7 @@ class MarketSession:
             scaled, mins, maxs = parse_event_bounds(
                 None if all(b is None for b in bounds) else bounds, e)
             raw, adjd, fin, cert, pc, pr, nc, ld = _pass2_panel(
-                jnp.asarray(block, dtype=dtype), rep0, rep0, smooth_rep,
+                self._panel_device(block, dtype), rep0, rep0, smooth_rep,
                 u_over_nAu, jnp.asarray(scaled),
                 jnp.asarray(mins, dtype=dtype),
                 jnp.asarray(maxs, dtype=dtype), tol)

@@ -361,7 +361,8 @@ def _out_specs(batched: bool):
 
 
 def make_sharded_bucket_executable(p: ConsensusParams, mesh: Mesh,
-                                   batched: bool = False):
+                                   batched: bool = False,
+                                   donate: bool = False):
     """A FRESH jitted shard_map executable for one mesh-topology cache
     entry — same call signature as ``kernels.make_bucket_executable``
     (``fn(*bucket_arrays, p)`` with ``p`` static), so the batcher and
@@ -369,7 +370,14 @@ def make_sharded_bucket_executable(p: ConsensusParams, mesh: Mesh,
     under the ``serve_bucket_sharded`` entry label: after warmup the
     retrace counter equals the number of compiled sharded buckets and
     must stay there under steady traffic (the runtime CL304 invariant
-    the multi-device CI smoke pins)."""
+    the multi-device CI smoke pins).
+
+    ``donate=True`` donates the same :data:`kernels.DONATED_ARGS`
+    vector buffers as the single-device kernel (reputation aliases an
+    (R,)-replicated output, mins/maxs/seed alias event-sharded
+    outputs — sharding-compatible aliases, verified by the CL306
+    contract); the serving cache builds donated, direct callers that
+    re-use arrays must not."""
     built_p = p
     lane = functools.partial(jk.exact_matmuls(padded_consensus_lane), p=p)
     if batched:
@@ -400,4 +408,6 @@ def make_sharded_bucket_executable(p: ConsensusParams, mesh: Mesh,
                       col_valid, seed)
 
     return obs.instrument_jit(
-        jax.jit(fn, static_argnames=("p",)), "serve_bucket_sharded")
+        jax.jit(fn, static_argnames=("p",),
+                donate_argnums=sk.DONATED_ARGS if donate else ()),
+        "serve_bucket_sharded")

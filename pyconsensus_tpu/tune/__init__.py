@@ -20,11 +20,19 @@ key layout, the fallback rules, and how to re-tune
 """
 
 from .autotune import (FALLBACK_TABLE, TuneCache, autotune_cov,
-                       autotune_resolve, cache_path, default_provider,
-                       install, shape_class, tpu_generation)
+                       autotune_pipeline_depth, autotune_resolve,
+                       cache_path, default_provider, depth_candidates,
+                       install, shape_class, tpu_generation,
+                       tuned_pipeline_depth)
 from .fingerprint import device_generation, runtime_fingerprint
+from .roofline import (bound_resolutions_per_sec, classify_regime,
+                       resolution_traffic_bytes,
+                       stream_bandwidth_bytes_per_s)
 
-__all__ = ["autotune_cov", "autotune_resolve", "default_provider",
+__all__ = ["autotune_cov", "autotune_resolve", "autotune_pipeline_depth",
+           "tuned_pipeline_depth", "depth_candidates", "default_provider",
            "install", "TuneCache", "cache_path", "shape_class",
            "tpu_generation", "FALLBACK_TABLE",
-           "device_generation", "runtime_fingerprint"]
+           "device_generation", "runtime_fingerprint",
+           "stream_bandwidth_bytes_per_s", "resolution_traffic_bytes",
+           "bound_resolutions_per_sec", "classify_regime"]

@@ -48,7 +48,8 @@ from ..faults import plan as _faults
 from ..ops import pallas_kernels as pk
 from .fingerprint import device_generation
 
-__all__ = ["autotune_cov", "autotune_resolve", "default_provider",
+__all__ = ["autotune_cov", "autotune_resolve", "autotune_pipeline_depth",
+           "tuned_pipeline_depth", "depth_candidates", "default_provider",
            "install", "TuneCache", "cache_path", "shape_class",
            "tpu_generation", "FALLBACK_TABLE"]
 
@@ -69,6 +70,11 @@ FALLBACK_TABLE = {
     ("resolve_block_cols", "cpu"): 128,
     ("cov_tile_rows", "*"): None,
     ("resolve_block_cols", "*"): None,
+    # dispatch pipeline depth (ISSUE 13): 2 overlaps one host transfer
+    # under one device compute — the measured-good default everywhere;
+    # deeper rings only pay off when per-dispatch host time exceeds
+    # device time, which the sweep detects per generation
+    ("pipeline_depth", "*"): 2,
 }
 
 
@@ -355,6 +361,128 @@ def autotune_resolve(n_reporters: int, n_events: int = 512,
              "probe_shape": [int(Rp), int(n_events)],
              "storage_dtype": storage_dtype or "full"}
     if not interpret:
+        entry["timings_ms"] = {str(c): round(t * 1e3, 4)
+                               for c, t in timings.items()}
+    cache.put(key, entry)
+    return entry
+
+
+def depth_candidates(max_depth: int = 4) -> tuple:
+    """The dispatch pipeline-depth sweep space (ISSUE 13 tentpole d):
+    1 (synchronous) through ``max_depth`` in-flight dispatches. Depth
+    is a HOST dispatch-loop knob, never a compile-time constant, so
+    every candidate is trivially "legal" — the sweep's job is ranking
+    and the depth-never-changes-results assertion."""
+    return tuple(range(1, max(1, int(max_depth)) + 1))
+
+
+def tuned_pipeline_depth(n_events: int, path=None) -> int:
+    """The dispatch pipeline depth for this event shape class:
+    persisted winner first (cache hit), then the deterministic
+    :data:`FALLBACK_TABLE` row (2 everywhere). The
+    ``ServeConfig.pipeline_depth = 0`` auto policy resolves through
+    here. Multi-process programs take the fallback unconditionally —
+    depth does not change compiled programs (no compile-divergence
+    hazard), but per-host winner files must not make two hosts of one
+    fleet pace their rings differently under one load-balancing
+    model."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() > 1:
+        _fallback_counter().inc(kind="pipeline_depth")
+        return int(_fallback("pipeline_depth", "multiprocess") or 2)
+    itemsize = jnp.asarray(0.0).dtype.itemsize
+    generation = tpu_generation()
+    key = _entry_key("pipeline_depth", generation, itemsize,
+                     shape_class(n_events))
+    entry = TuneCache(path).get(key)
+    if entry is not None:
+        _hits_counter().inc(kind="pipeline_depth")
+        return int(entry["value"])
+    _fallback_counter().inc(kind="pipeline_depth")
+    return int(_fallback("pipeline_depth", generation) or 2)
+
+
+def autotune_pipeline_depth(n_reporters: int = 32, n_events: int = 256,
+                            *, deterministic: bool = False, path=None,
+                            force: bool = False, repeats: int = 3,
+                            dispatches: int = 8, seed: int = 0) -> dict:
+    """Sweep the dispatch pipeline depth for this event shape class and
+    persist the winner (the block-shape sweeps' winner-cache
+    discipline, keyed generation/itemsize/shape-class). Each candidate
+    drives ``dispatches`` seeded padded-bucket dispatches through the
+    REAL serve bucket executable with a depth-``d`` in-flight ring —
+    the batcher's hot loop in miniature — and every candidate's
+    retired outputs are asserted identical before a winner persists
+    (depth changes WHEN results are fetched, never what they are).
+    ``deterministic=True`` (CPU tests, the CI smoke) still executes
+    every candidate but ranks by the analytic fallback instead of wall
+    time — CPU ring timings say nothing about the TPU dispatch
+    overlap. On hardware the median of timed runs decides."""
+    import jax.numpy as jnp
+
+    from ..models.pipeline import ConsensusParams
+    from ..serve.kernels import bucket_inputs, make_bucket_executable
+
+    itemsize = jnp.asarray(0.0).dtype.itemsize
+    generation = "interpret" if deterministic else tpu_generation()
+    key = _entry_key("pipeline_depth", generation, itemsize,
+                     shape_class(n_events))
+    cache = TuneCache(path)
+    if not force:
+        hit = cache.get(key)
+        if hit is not None:
+            _hits_counter().inc(kind="pipeline_depth")
+            return hit
+    candidates = depth_candidates()
+    _sweeps_counter().inc(kind="pipeline_depth")
+    p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                        has_na=True, any_scaled=False, n_scaled=0)
+    fn = make_bucket_executable(p)          # undonated: the sweep owns
+    rng = np.random.default_rng(seed)       # no template discipline
+    panels = [rng.choice([0.0, 1.0], size=(n_reporters, n_events))
+              for _ in range(max(2, dispatches))]
+    for m in panels:
+        m[0, 0] = np.nan                    # exercise the fill graph
+    lanes = [bucket_inputs(m, np.full(n_reporters, 1.0 / n_reporters),
+                           np.zeros(n_events, bool), np.zeros(n_events),
+                           np.ones(n_events), n_reporters, n_events,
+                           has_na=True) for m in panels]
+    timings, results = {}, {}
+    for d in candidates:
+        _configs_counter().inc(kind="pipeline_depth")
+
+        def run(d=d):
+            import jax.numpy as jnp
+
+            def fetch(raw):  # the blocking step the ring schedules
+                return {k: np.asarray(v) for k, v in raw.items()}
+
+            ring, out = [], []
+            for lane in lanes:
+                ring.append(fn(*[jnp.asarray(a) for a in lane], p))
+                while len(ring) >= d:
+                    out.append(fetch(ring.pop(0)))
+            out.extend(fetch(r) for r in ring)
+            return [o["outcomes_adjusted"] for o in out] + \
+                   [o["smooth_rep"] for o in out]
+
+        results[d] = tuple(run())           # also warms the executable
+        timings[d] = None if deterministic else _median_time(run, repeats)
+    if deterministic:
+        pick = int(_fallback("pipeline_depth", generation) or 2)
+        if pick not in candidates:
+            pick = candidates[-1]
+    else:
+        pick = min(candidates, key=lambda d: (timings[d], d))
+    pick = _agreeing_winner(results, candidates, pick, "pipeline_depth")
+    entry = {"value": int(pick), "kind": "pipeline_depth",
+             "candidates": [int(c) for c in candidates],
+             "mode": "deterministic" if deterministic else "timed",
+             "probe_shape": [int(n_reporters), int(n_events)],
+             "dispatches": int(len(lanes))}
+    if not deterministic:
         entry["timings_ms"] = {str(c): round(t * 1e3, 4)
                                for c, t in timings.items()}
     cache.put(key, entry)

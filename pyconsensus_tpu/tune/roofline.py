@@ -1,0 +1,92 @@
+"""Memory-bandwidth roofline model for consensus resolutions
+(ISSUE 13 tentpole d).
+
+The BENCH trajectory needs to say WHY a rung is slow: a host-bound rung
+(encode passes, synchronous dispatch, fetch round-trips) is fixed by
+the ingestion/pipelining work this subsystem exists for, while a
+bandwidth-bound rung is already running as fast as the memory system
+allows and only storage compression or more chips move it. The bench
+``roofline`` block reports, per bucket class, the ACHIEVED
+resolutions/sec against the MEMORY-BANDWIDTH-BOUND rate:
+
+- :func:`stream_bandwidth_bytes_per_s` measures the device's achievable
+  stream bandwidth with a jitted read+write triad over a matrix-scale
+  buffer — the same kind of HBM traffic the resolution kernels issue,
+  so the bound is an achievable roof, not a datasheet number;
+- :func:`resolution_traffic_bytes` models one light-pipeline
+  resolution's HBM traffic from the docs/PERFORMANCE.md pass
+  accounting: the fill pass reads the accumulation-dtype matrix once
+  and writes storage once, then every power sweep, the scores+dirfix
+  pass, and the fused back half each read storage once per outer
+  iteration;
+- :func:`bound_resolutions_per_sec` divides the two.
+
+The model's one free parameter is the power sweep count (the early
+exit makes it data-dependent and the fused kernels do not export it);
+callers pass their measured or assumed value and the bench block
+records which it was — an honest bracket beats a silently wrong point
+estimate.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["stream_bandwidth_bytes_per_s", "resolution_traffic_bytes",
+           "bound_resolutions_per_sec", "classify_regime"]
+
+
+def stream_bandwidth_bytes_per_s(mbytes: int = 64, repeats: int = 5):
+    """Measured device stream bandwidth (bytes/s): a jitted
+    read+modify+write pass over an ``mbytes`` f32 buffer, timed to a
+    blocking fetch, median over ``repeats``. Bytes counted = one read
+    + one write of the buffer per pass."""
+    import jax
+    import jax.numpy as jnp
+
+    n = max(1, int(mbytes) * (1 << 20) // 4)
+    x = jnp.ones((n,), dtype=jnp.float32)
+    f = jax.jit(lambda v: v * 1.0000001 + 0.5)
+    jax.block_until_ready(f(x))                 # compile + warm
+    samples = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        samples.append(time.perf_counter() - t0)
+    dt = float(np.median(samples))
+    return 2.0 * n * 4 / dt
+
+
+def resolution_traffic_bytes(R: int, E: int, storage_itemsize: int,
+                             sweeps: int, iterations: int = 1,
+                             acc_itemsize: int = 4) -> int:
+    """Modeled HBM bytes of one light-pipeline resolution at (R, E):
+    fill/encode pass (one acc-dtype read + one storage write) plus, per
+    outer iteration, ``sweeps`` power-sweep storage reads and two more
+    storage passes (scores+direction-fix; the fused back half)."""
+    cells = int(R) * int(E)
+    fill = cells * (int(acc_itemsize) + int(storage_itemsize))
+    per_iter = (int(sweeps) + 2) * cells * int(storage_itemsize)
+    return fill + max(1, int(iterations)) * per_iter
+
+
+def bound_resolutions_per_sec(bandwidth_bytes_per_s: float,
+                              traffic_bytes: int) -> float:
+    """The memory-bandwidth-bound resolution rate for a traffic model —
+    the roof the achieved rate is compared against."""
+    return float(bandwidth_bytes_per_s) / max(1, int(traffic_bytes))
+
+
+def classify_regime(achieved: float, bound: float,
+                    threshold: float = 0.5) -> str:
+    """``"bandwidth-bound"`` when the achieved rate is within
+    ``threshold`` of the roof, else ``"host-bound"`` — the distinction
+    the BENCH trajectory exists to make (a host-bound rung is fixed by
+    ingestion/pipelining work; a bandwidth-bound one by storage
+    compression or more chips)."""
+    if bound <= 0:
+        return "unknown"
+    return ("bandwidth-bound" if achieved / bound >= threshold
+            else "host-bound")

@@ -355,10 +355,14 @@ class TestReplicationLog:
             session.append(make_block(0, 0))
         # exactly one fire: the seam moved pre-journal, it did not fork
         assert plan.fired == [("serve.session_append", 0, "nan_storm")]
-        assert np.isnan(session._blocks[0]).any()
+        # read through the staging decode (ISSUE 13: lattice-exact
+        # blocks stage as device-resident int8 sentinel arrays)
+        assert np.isnan(DurableSession._staged_host(
+            session._blocks[0])).any()
         standby = replay_session(tmp_path, "s")
         np.testing.assert_array_equal(
-            standby._blocks[0], session._blocks[0],
+            DurableSession._staged_host(standby._blocks[0]),
+            DurableSession._staged_host(session._blocks[0]),
             err_msg="journal and fold diverged under injected corruption")
 
 

@@ -1,0 +1,258 @@
+"""Device-resident ingestion (ISSUE 13 tentpole a).
+
+Covers the device/host encoder bit-identity contract
+(``encode_reports_device`` vs ``encode_reports_host`` — the pin the
+tentpole names), the ``lattice_exact`` staging gate, the event-sharded
+``load_reports_encoded`` loader, and the market session's encoded
+device-resident staging (resolves bit-identical to float staging for
+lattice panels; off-lattice blocks keep the float path).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conftest import collusion_reports
+from pyconsensus_tpu import io as pio
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.models.pipeline import (decode_reports,
+                                             encode_reports,
+                                             encode_reports_device,
+                                             encode_reports_host,
+                                             lattice_exact)
+from pyconsensus_tpu.serve.session import MarketSession
+
+
+def lattice_panel(rng, R=24, E=64, na_frac=0.1):
+    m = rng.choice([0.0, 0.5, 1.0], size=(R, E))
+    m[rng.random((R, E)) < na_frac] = np.nan
+    return m
+
+
+class TestEncoderParity:
+    """The tentpole's pin: device and host encoders are bit-identical
+    on the same-dtype input."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_lattice_parity(self, rng, dtype):
+        panel = lattice_panel(rng).astype(dtype)
+        host = encode_reports_host(panel)
+        dev = np.asarray(encode_reports_device(jnp.asarray(panel)))
+        np.testing.assert_array_equal(host, dev)
+        assert host.dtype == np.int8
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_off_lattice_rounding_parity(self, rng, dtype):
+        """Off-lattice values round onto the lattice identically on
+        both paths (clip + round-half-to-even, per input dtype)."""
+        panel = (rng.random((32, 48)) * 1.6 - 0.3).astype(dtype)
+        panel[rng.random((32, 48)) < 0.1] = np.nan
+        host = encode_reports_host(panel)
+        dev = np.asarray(encode_reports_device(jnp.asarray(panel)))
+        np.testing.assert_array_equal(host, dev)
+
+    def test_parity_with_traceable_core(self, rng):
+        """Both front doors agree with the raw traceable encode the
+        fused pipeline already uses."""
+        panel = lattice_panel(rng)
+        np.testing.assert_array_equal(
+            encode_reports_host(panel),
+            np.asarray(encode_reports(jnp.asarray(panel))))
+
+    def test_decode_round_trip_exact(self, rng):
+        panel = lattice_panel(rng)
+        dec = decode_reports(encode_reports_host(panel))
+        np.testing.assert_array_equal(dec, panel)
+
+    def test_ingest_metrics_emitted(self, rng):
+        before_d = obs.value("pyconsensus_ingest_encoded_bytes_total",
+                             path="device") or 0
+        before_h = obs.value("pyconsensus_ingest_encodes_total",
+                             path="host") or 0
+        panel = lattice_panel(rng, R=8, E=16)
+        encode_reports_device(jnp.asarray(panel))
+        encode_reports_host(panel)
+        after_d = obs.value("pyconsensus_ingest_encoded_bytes_total",
+                            path="device") or 0
+        assert after_d - before_d == panel.size
+        assert (obs.value("pyconsensus_ingest_encodes_total",
+                          path="host") or 0) == before_h + 1
+
+    def test_retrace_pinned_across_same_shape_encodes(self, rng):
+        """The shared jitted encode entry compiles once per shape —
+        repeated ingests at one shape must not grow the retrace
+        counter."""
+        panel = lattice_panel(rng, R=16, E=32)
+        encode_reports_device(jnp.asarray(panel))
+        before = obs.value("pyconsensus_jit_retraces_total",
+                           entry="encode_reports") or 0
+        for _ in range(3):
+            encode_reports_device(jnp.asarray(lattice_panel(
+                np.random.default_rng(7), R=16, E=32)))
+        after = obs.value("pyconsensus_jit_retraces_total",
+                          entry="encode_reports") or 0
+        assert after == before
+
+
+class TestLatticeGate:
+    def test_lattice_values_pass(self):
+        assert lattice_exact(np.array([[0.0, 0.5, 1.0, np.nan]]))
+
+    @pytest.mark.parametrize("bad", [0.25, -0.5, 2.0, np.inf, -np.inf,
+                                     1.0 + 1e-12])
+    def test_off_lattice_refused(self, bad):
+        assert not lattice_exact(np.array([[0.0, bad]]))
+
+    def test_negative_zero_refused(self):
+        """-0.0 is observably different downstream (sign of zero
+        products) and the lattice only carries +0.0."""
+        assert not lattice_exact(np.array([[-0.0, 1.0]]))
+
+    def test_empty_is_exact(self):
+        assert lattice_exact(np.zeros((0, 4)))
+
+
+class TestEncodedLoader:
+    def test_loader_matches_host_encode(self, rng, tmp_path):
+        panel = lattice_panel(rng, R=16, E=64)
+        path = tmp_path / "reports.npy"
+        pio.save_reports(path, panel)
+        enc = pio.load_reports_encoded(path)
+        assert np.asarray(enc).dtype == np.int8
+        np.testing.assert_array_equal(
+            np.asarray(enc), encode_reports_host(panel))
+
+    def test_loader_keeps_event_sharding(self, rng, tmp_path):
+        import jax
+
+        from pyconsensus_tpu.parallel.mesh import make_mesh
+
+        n = len(jax.devices())
+        mesh = make_mesh(batch=1, event=n)
+        panel = lattice_panel(rng, R=8, E=8 * n)
+        path = tmp_path / "reports.npy"
+        pio.save_reports(path, panel)
+        enc = pio.load_reports_encoded(path, mesh=mesh)
+        assert enc.shape == panel.shape
+        # the encode is elementwise: the event axis stays sharded
+        assert len(enc.sharding.device_set) == n
+        np.testing.assert_array_equal(
+            np.asarray(enc), encode_reports_host(panel))
+
+
+class TestSessionEncodedStaging:
+    """ISSUE 13: lattice-exact appended blocks stage as device-resident
+    int8; resolves are bit-identical to the float-staged session."""
+
+    def _rounds(self, seed, R=12, widths=(16, 8, 24)):
+        g = np.random.default_rng(seed)
+        return [lattice_panel(g, R=R, E=w) for w in widths]
+
+    def _run(self, blocks, **kw):
+        s = MarketSession("m", blocks[0].shape[0], **kw)
+        results = []
+        for b in blocks:
+            s.append(b)
+            results.append(s.resolve())
+        return s, results
+
+    def test_staging_forms(self, rng):
+        s = MarketSession("m", 8)
+        s.append(lattice_panel(rng, R=8, E=8))
+        assert s._blocks[0].dtype == np.int8        # device-resident
+        s.append(rng.random((8, 4)))                # off-lattice
+        assert s._blocks[1].dtype == np.float64     # float staging
+        s2 = MarketSession("m2", 8, encoded_staging=False)
+        s2.append(lattice_panel(rng, R=8, E=8))
+        assert s2._blocks[0].dtype == np.float64
+
+    def test_stats_resolve_bitwise_vs_float_staging(self):
+        blocks = self._rounds(3)
+        _, enc = self._run(blocks)
+        _, flo = self._run(blocks, encoded_staging=False)
+        for a, b in zip(enc, flo):
+            assert a.keys() == b.keys()
+            for k in a:
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+    def test_direct_resolve_bitwise_vs_float_staging(self):
+        """The assembled/direct path decodes staged blocks back to the
+        exact host float panel."""
+        blocks = self._rounds(4, widths=(12, 12))
+        s_enc = MarketSession("a", 12)
+        s_flo = MarketSession("b", 12, encoded_staging=False)
+        for b in blocks:
+            s_enc.append(b)
+            s_flo.append(b)
+        r_enc = s_enc.resolve(max_iterations=3)     # direct Oracle path
+        r_flo = s_flo.resolve(max_iterations=3)
+        for k in r_enc:
+            np.testing.assert_array_equal(
+                np.asarray(r_enc[k]), np.asarray(r_flo[k]), err_msg=k)
+
+    def test_peek_resolve_on_encoded_staging(self):
+        blocks = self._rounds(5, widths=(16,))
+        s = MarketSession("m", 12)
+        s.append(blocks[0])
+        peek = s.peek_resolve()
+        res = s.resolve()
+        for k in ("outcomes_adjusted", "smooth_rep", "certainty"):
+            np.testing.assert_array_equal(np.asarray(peek[k]),
+                                          np.asarray(res[k]))
+
+    def test_incremental_session_rides_encoded_staging(self):
+        """The warm tier and encoded staging compose: warm resolves on
+        encoded-staged rounds match the float-staged session's bits."""
+        blocks = self._rounds(6, widths=(16, 16, 16, 16))
+        _, enc = self._run(blocks, incremental=True, refresh_every=3)
+        _, flo = self._run(blocks, incremental=True, refresh_every=3,
+                           encoded_staging=False)
+        for a, b in zip(enc, flo):
+            for k in a:
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+    def test_service_session_bitwise_vs_oracle(self, rng):
+        """End to end through the service: an encoded-staged session
+        resolve matches a direct streaming-equivalent resolution (the
+        existing session contract, now over int8-staged blocks)."""
+        from pyconsensus_tpu.parallel.streaming import streaming_consensus
+
+        R = 10
+        b1 = lattice_panel(rng, R=R, E=12)
+        b2 = lattice_panel(rng, R=R, E=20)
+        s = MarketSession("m", R)
+        s.append(b1)
+        s.append(b2)
+        assert all(b.dtype == np.int8 for b in s._blocks)
+        res = s.resolve()
+        ref = streaming_consensus(np.concatenate([b1, b2], axis=1),
+                                  panel_events=12)
+        np.testing.assert_array_equal(res["outcomes_adjusted"],
+                                      np.asarray(ref["outcomes_adjusted"]))
+
+    def test_mixed_staging_round(self, rng):
+        """A round mixing encoded and float-staged blocks resolves
+        bit-identically to the all-float session."""
+        R = 8
+        lat = lattice_panel(rng, R=R, E=8)
+        off = rng.random((R, 6)) * 0.9
+        a = MarketSession("a", R)
+        b = MarketSession("b", R, encoded_staging=False)
+        for s in (a, b):
+            s.append(lat)
+            s.append(off)
+        assert a._blocks[0].dtype == np.int8
+        assert a._blocks[1].dtype == np.float64
+        ra, rb = a.resolve(), b.resolve()
+        for k in ra:
+            np.testing.assert_array_equal(np.asarray(ra[k]),
+                                          np.asarray(rb[k]), err_msg=k)
+
+
+class TestCollusionPanelStaging:
+    def test_collusion_panel_is_lattice_exact(self, rng):
+        reports, _ = collusion_reports(rng, 16, 32, liars=4, na_frac=0.1)
+        assert lattice_exact(reports)

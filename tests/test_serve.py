@@ -560,7 +560,10 @@ class TestFaultSites:
         with faults.armed(plan):
             session.append(block)
         # the staged block was poisoned, the caller's array untouched
-        assert np.isnan(session._blocks[0]).any()
+        # (read through the staging decode: ISSUE 13 stages lattice-
+        # exact blocks as device-resident int8 sentinel arrays)
+        staged = MarketSession._staged_host(session._blocks[0])
+        assert np.isnan(staged).any()
         assert not np.isnan(block).any()
 
 

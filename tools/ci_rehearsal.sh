@@ -941,6 +941,89 @@ print(f"econ-through-incremental OK: cadence-1 digest identical to the "
       f"deterministic across runs ({warm_a[:16]}...)")
 PYEOF
 
+echo "=== Pipelined-ingest smoke (ISSUE 13: device encode parity + depth-2 digest + aliasing contract) ==="
+# (1) the device encoder is bit-identical to the host reference on
+# lattice AND off-lattice (rounding) panels; (2) a depth-2 pipelined
+# serve run is digest-identical to the synchronous depth-1 run with
+# retraces pinned at the warmed bucket count; (3) the CL306 aliasing
+# contract holds on the live donated bucket executables (also gated by
+# --strict above — this asserts the alias table directly so a silent
+# contract-scoping regression cannot hide it).
+"$PY" - <<'PYEOF'
+import hashlib
+import numpy as np
+import jax.numpy as jnp
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.models.pipeline import (encode_reports_device,
+                                             encode_reports_host)
+rng = np.random.default_rng(5)
+lat = rng.choice([0.0, 0.5, 1.0, np.nan], size=(64, 256),
+                 p=[.4, .2, .3, .1]).astype(np.float32)
+off = (rng.random((32, 64), dtype=np.float32) * 1.4 - 0.2)
+for panel in (lat, off):
+    host = encode_reports_host(panel)
+    dev = np.asarray(encode_reports_device(jnp.asarray(panel)))
+    assert np.array_equal(host, dev), "device encode != host encode"
+assert (obs.value("pyconsensus_ingest_encodes_total", path="device")
+        or 0) >= 2
+print("device-encode parity probe OK (lattice + off-lattice rounding)")
+
+from pyconsensus_tpu.serve import ConsensusService, ServeConfig
+panels = [rng.choice([0.0, 1.0, np.nan], size=(12, 48),
+                     p=[.45, .45, .1]) for _ in range(10)]
+
+def run(depth):
+    obs.reset()
+    cfg = ServeConfig(warmup=((16, 64),), batch_window_ms=1.0,
+                      pipeline_depth=depth, sharded_buckets=False,
+                      pallas_buckets=False)
+    with ConsensusService(cfg) as svc:
+        outs = [svc.submit(reports=p).result(60) for p in panels]
+        retr = obs.value("pyconsensus_jit_retraces_total",
+                         entry="serve_bucket")
+    h = hashlib.sha256()
+    for o in outs:
+        for sec in ("events", "agents"):
+            for k in sorted(o[sec]):
+                h.update(np.ascontiguousarray(
+                    np.asarray(o[sec][k])).tobytes())
+    return h.hexdigest(), retr
+
+d1, r1 = run(1)
+d2, r2 = run(2)
+assert d1 == d2, f"depth-2 digest {d2[:16]} != sync digest {d1[:16]}"
+assert r1 == r2 == 1, f"retraces drifted: sync {r1}, depth-2 {r2}"
+print(f"depth-2 pipelined serve digest-identical to sync "
+      f"({d1[:16]}...), retraces pinned at 1")
+
+from pyconsensus_tpu.analysis.contracts import (input_output_aliases,
+                                                run_contracts)
+findings = run_contracts(names=["serve-bucket",
+                                "serve-bucket-scaled-alias",
+                                "serve-bucket-sharded"])
+assert not findings, findings
+from pyconsensus_tpu.models.pipeline import ConsensusParams
+from pyconsensus_tpu.serve.kernels import make_bucket_executable
+import jax
+p = ConsensusParams(algorithm="sztorc", pca_method="power",
+                    has_na=True, any_scaled=True, n_scaled=0)
+dt = jnp.asarray(0.0).dtype
+args = (jax.ShapeDtypeStruct((16, 64), dt),
+        jax.ShapeDtypeStruct((16,), dt),
+        jax.ShapeDtypeStruct((64,), bool),
+        jax.ShapeDtypeStruct((64,), dt),
+        jax.ShapeDtypeStruct((64,), dt),
+        jax.ShapeDtypeStruct((16,), bool),
+        jax.ShapeDtypeStruct((64,), bool),
+        jax.ShapeDtypeStruct((64,), dt))
+txt = make_bucket_executable(p, donate=True).lower(*args, p)\
+    .compile().as_text()
+aliases = input_output_aliases(txt)
+assert len(aliases) >= 4, f"expected >= 4 donated aliases, {aliases}"
+print(f"aliasing contract OK: {len(aliases)} donated pad buffers "
+      f"aliased in the compiled module")
+PYEOF
+
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
   --econ-sessions 48 --econ-rounds 2 --bench-timeout 300 \
@@ -949,8 +1032,16 @@ echo "=== bench.py JSON contract (tiny shape, CPU) ==="
   "import json,sys; d=json.load(sys.stdin); e=d['economy']; i=d['incremental']; \
 assert all(a['drift_within_band'] and a['outcomes_match_exact'] \
            for a in i['appends']) and i['refresh_bitwise_outcomes']; \
+p=d['pipeline']; assert p['digest_match'] and p['added_retraces'] == 0 \
+    and p['depth'] >= 2; \
+r=d['roofline']; assert r['rungs'] and all(x['bound_rps'] > 0 \
+    for x in r['rungs']); \
+assert 'path' in d['encode']; \
+assert all('backend' in x for x in d['device_scaling'] or []); \
 print('bench JSON ok:', d['metric'], '| economy:', e['sessions'], \
 'sessions,', len(e['strategies']), 'strategies', '| incremental:', \
-len(i['appends']), 'append sizes, drift in band, refresh bitwise')"
+len(i['appends']), 'append sizes, drift in band, refresh bitwise', \
+'| pipeline: depth', p['depth'], 'speedup', p['speedup'], \
+'digests match | roofline:', len(r['rungs']), 'rungs')"
 
 echo "=== CI rehearsal GREEN ==="
