@@ -234,6 +234,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated adaptive cartel strategies "
                          "the economy probe runs (>= 3 for the "
                          "acceptance shape)")
+    ap.add_argument("--no-multiproc", action="store_true",
+                    help="skip the fail-soft multiproc block (ISSUE 15:"
+                         " in-process vs socket-transport fleet "
+                         "throughput, per-RPC overhead p50/p99, and "
+                         "takeover-window comparison — spawns real "
+                         "worker processes)")
+    ap.add_argument("--multiproc-requests", type=int, default=24,
+                    help="stateless requests per transport in the "
+                         "multiproc throughput comparison")
+    ap.add_argument("--multiproc-workers", type=int, default=2)
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fail-soft fleet chaos probe (worker "
                          "kill mid-traffic + session failover, appended "
@@ -515,6 +525,7 @@ def run_bench(args) -> None:
     out_json["serve"] = _serve_block(args)
     out_json["cold_start"] = _cold_start_block(args)
     out_json["fleet"] = _fleet_block(args)
+    out_json["multiproc"] = _multiproc_block(args)
     out_json["economy"] = _economy_block(args)
     print(json.dumps(out_json))
 
@@ -1308,6 +1319,128 @@ def _fleet_block(args):
             shutil.rmtree(log_dir, ignore_errors=True)
 
 
+def _multiproc_block(args):
+    """ISSUE 15 satellite: what the process boundary COSTS — the same
+    fleet workload run over the in-process transport and the socket
+    transport (real supervised worker processes, wire protocol, log
+    shipping), side by side. Reports per-transport stateless
+    throughput, the socket tier's per-RPC overhead (p50/p99 of a ping
+    round trip — pure wire + dispatch, no resolution), worker-process
+    spawn time, and the takeover window (kill the session owner,
+    measure until the standby serves) per transport. FAIL-SOFT like
+    every probe block: any failure is a stderr WARNING and a null
+    block; ``--no-multiproc`` opts out."""
+    if args.no_multiproc:
+        return None
+
+    import tempfile
+    import shutil
+
+    def run_one(transport: str) -> dict:
+        import numpy as np
+
+        from pyconsensus_tpu.serve import ServeConfig
+        from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+        from pyconsensus_tpu.serve.loadgen import quantile
+
+        log_dir = tempfile.mkdtemp(prefix=f"bench-mp-{transport}-")
+        fleet = None
+        try:
+            t0 = time.monotonic()
+            fleet = ConsensusFleet(FleetConfig(
+                n_workers=max(2, args.multiproc_workers),
+                transport=transport, log_dir=log_dir,
+                worker=ServeConfig(warmup=(), batch_window_ms=1.0,
+                                   pallas_buckets=False))).start(
+                                       warmup=False)
+            spawn_s = time.monotonic() - t0
+            rng = np.random.default_rng(args.serve_seed)
+            matrix = rng.choice([0.0, 1.0], size=(16, 24))
+
+            # stateless throughput (numpy path measures the TRANSPORT
+            # + routing layer, not kernel speed — the fleet-block
+            # convention)
+            n = max(8, args.multiproc_requests)
+            t0 = time.monotonic()
+            futs = [fleet.submit(reports=matrix, backend="numpy")
+                    for _ in range(n)]
+            for f in futs:
+                f.result(timeout=120)
+            wall = time.monotonic() - t0
+            block = {"transport": transport,
+                     "workers": len(fleet.workers),
+                     "spawn_s": round(spawn_s, 3),
+                     "requests": n,
+                     "throughput_rps": round(n / max(wall, 1e-9), 2)}
+
+            # per-RPC overhead: socket handles expose the raw wire
+            if transport == "socket":
+                w = next(iter(fleet.workers.values()))
+                pings = []
+                for _ in range(60):
+                    t1 = time.monotonic()
+                    w.call("ping", timeout_s=5.0)
+                    pings.append((time.monotonic() - t1) * 1e3)
+                pings.sort()        # quantile() wants an already-sorted
+                block["rpc_overhead_ms_p50"] = round(   # sequence
+                    quantile(pings, 0.50), 3)
+                block["rpc_overhead_ms_p99"] = round(
+                    quantile(pings, 0.99), 3)
+
+            # takeover window: one durable session, kill its owner,
+            # time until the standby serves it again
+            fleet.create_session("mp-market", n_reporters=12)
+            fleet.append("mp-market",
+                         rng.choice([0.0, 1.0], size=(12, 6)))
+            fleet.submit(session="mp-market").result(timeout=120)
+            # round 1 staged BEFORE the kill: the takeover-window probe
+            # measures time-to-serve, so the standby must have a
+            # resolvable round waiting
+            fleet.append("mp-market",
+                         rng.choice([0.0, 1.0], size=(12, 6)))
+            owner = fleet.owner_of("mp-market")
+            t0 = time.monotonic()
+            fleet.kill_worker(owner)
+            deadline = t0 + 60.0
+            while True:
+                try:
+                    fleet.submit(session="mp-market").result(timeout=30)
+                    break
+                except Exception:           # noqa: BLE001 — retry the
+                    if time.monotonic() > deadline:     # takeover until
+                        raise                           # the bound
+                    time.sleep(0.05)
+            block["takeover_ms"] = round(
+                (time.monotonic() - t0) * 1e3, 1)
+            return block
+        finally:
+            if fleet is not None:
+                try:
+                    fleet.close(drain=False, timeout=5.0)
+                except Exception:             # noqa: BLE001
+                    pass
+            shutil.rmtree(log_dir, ignore_errors=True)
+
+    try:
+        inproc = run_one("inprocess")
+        sock = run_one("socket")
+        return {
+            "workers": inproc["workers"],
+            "requests": inproc["requests"],
+            "inprocess": inproc,
+            "socket": sock,
+            # the headline comparison: what fraction of in-process
+            # routing throughput survives the process boundary
+            "socket_vs_inprocess_throughput": round(
+                sock["throughput_rps"]
+                / max(inproc["throughput_rps"], 1e-9), 3),
+        }
+    except Exception as exc:                  # noqa: BLE001
+        print(f"WARNING: multiproc block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
 def _economy_block(args):
     """ISSUE 11 tentpole (c): the "is the oracle economically sound
     under production traffic" number — an adversarial economy of
@@ -1614,6 +1747,10 @@ def main() -> None:
         # ditto the incremental probe: its session shape defaults to
         # 1024x8192 regardless of the smoke's toy headline shape
         smoke_argv.append("--no-incremental")
+    if "--no-multiproc" not in smoke_argv:
+        # ditto the multiproc probe: spawning worker subprocesses is
+        # not smoke material
+        smoke_argv.append("--no-multiproc")
     if args.scaled:
         smoke_argv += ["--scaled", str(max(1, min(args.scaled, 256)))]
     smoke_line, smoke_reason = _run_child(

@@ -38,8 +38,9 @@ from .degrade import (POWER_METHODS, fallback_steps, quarantine_nonfinite,
                       raise_exhausted, record_fallback, result_nonfinite)
 from .errors import (ERROR_CODES, AotCacheCorruptionError,
                      CheckpointCorruptionError, ConsensusError,
-                     ConvergenceError, FailoverInProgressError, InputError,
-                     NumericsError, PlacementError, ServiceOverloadError,
+                     ConvergenceError, FailoverInProgressError,
+                     HandshakeError, InputError, NumericsError,
+                     PlacementError, ServiceOverloadError, TransportError,
                      WorkerLostError)
 from .plan import (FAULT_SITES, FaultPlan, FaultRule, SimulatedCrash,
                    active_plan, arm, armed, corrupt, disarm, fire)
@@ -52,6 +53,7 @@ __all__ = [
     "CheckpointCorruptionError", "AotCacheCorruptionError",
     "ServiceOverloadError",
     "WorkerLostError", "FailoverInProgressError", "PlacementError",
+    "TransportError", "HandshakeError",
     "ERROR_CODES",
     "retry", "retry_call",
     "quarantine_nonfinite", "result_nonfinite", "record_fallback",

@@ -36,6 +36,20 @@ Code space (documented in docs/ROBUSTNESS.md):
   honest ``retry_after_s`` (the expected takeover window) — the client
   retries and lands on the survivor; ``PYC503`` is a deployment error
   (empty fleet / unknown worker), not retryable.
+- ``PYC6xx`` — transport: the out-of-process socket/RPC layer
+  (``pyconsensus_tpu.serve.transport``) refused a frame or a peer.
+  ``PYC601`` is a damaged or ill-formed WIRE artifact (torn/truncated
+  frame, payload digest mismatch, oversized frame, foreign magic) —
+  the bytes are refused, never half-decoded; whether to reconnect is
+  the caller's call (the fleet translates a dead peer into PYC501).
+  ``PYC602`` is a HANDSHAKE refusal: the peer speaks a different
+  protocol version or carries a different runtime fingerprint
+  (jax/jaxlib version, platform, device generation, x64) — a
+  wrong-toolchain worker must be refused at connect, before any
+  request could be served with bits compiled by a different world.
+  Neither is retryable through ``faults.retry`` (retrying identical
+  bytes or an identical fingerprint cannot succeed); transient SOCKET
+  errors stay ``OSError`` and ride the bounded-reconnect path.
 
 ``context`` keyword arguments are stored on the exception (``.context``)
 for structured logging; the message stays human-first.
@@ -47,7 +61,8 @@ __all__ = ["ConsensusError", "InputError", "NumericsError",
            "ConvergenceError", "CheckpointCorruptionError",
            "AotCacheCorruptionError", "ServiceOverloadError",
            "WorkerLostError", "FailoverInProgressError",
-           "PlacementError", "ERROR_CODES"]
+           "PlacementError", "TransportError", "HandshakeError",
+           "ERROR_CODES"]
 
 
 class ConsensusError(Exception):
@@ -163,6 +178,39 @@ class PlacementError(ConsensusError, RuntimeError):
     error_code = "PYC503"
 
 
+class TransportError(ConsensusError, RuntimeError):
+    """A wire-level artifact of the out-of-process transport
+    (``serve.transport.wire``) failed validation: truncated/torn frame,
+    payload SHA-256 mismatch (a bit flip in transit or on a proxy),
+    frame length beyond the bounded-read limit, or foreign magic bytes.
+    The frame is REFUSED before any payload byte is decoded — a damaged
+    RPC must surface loudly, never as a half-parsed request.
+
+    Deliberately a ``RuntimeError``, NOT an ``OSError``: the transport's
+    bounded reconnect retries ``retry_on=(OSError,)``, and a structured
+    refusal must never ride that path (identical bytes re-read from a
+    broken stream stay broken; an identical fingerprint re-offered
+    stays refused — the PYC4xx/5xx double-inheritance precedent).
+    Transient SOCKET failures keep their builtin ``OSError`` types and
+    DO reconnect, counted under
+    ``pyconsensus_transport_reconnects_total``."""
+
+    error_code = "PYC601"
+
+
+class HandshakeError(TransportError):
+    """The versioned connect handshake refused the peer: protocol
+    version mismatch, or a runtime-fingerprint field
+    (``tune.fingerprint.runtime_fingerprint``: jax/jaxlib version,
+    platform, device generation, x64 flag) differs between router and
+    worker. A wrong-toolchain worker could serve bits compiled by a
+    different world — the refusal happens at connect, before any
+    request is routed. ``context`` carries the offending field with
+    expected vs found values."""
+
+    error_code = "PYC602"
+
+
 #: stable code -> class registry (docs/ROBUSTNESS.md table is generated
 #: from the same source of truth; tests pin the codes)
 ERROR_CODES = {
@@ -170,5 +218,6 @@ ERROR_CODES = {
     for cls in (ConsensusError, InputError, NumericsError,
                 ConvergenceError, CheckpointCorruptionError,
                 AotCacheCorruptionError, ServiceOverloadError,
-                WorkerLostError, FailoverInProgressError, PlacementError)
+                WorkerLostError, FailoverInProgressError, PlacementError,
+                TransportError, HandshakeError)
 }

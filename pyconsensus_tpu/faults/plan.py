@@ -69,6 +69,8 @@ FAULT_SITES = (
     "fleet.route", "fleet.heartbeat", "fleet.takeover",
     "fleet.ledger_replay",
     "econ.round", "econ.panel", "econ.submit",
+    "transport.send", "transport.recv", "transport.connect",
+    "shipping.append",
 )
 
 

@@ -24,6 +24,7 @@ the same user code runs from 1 chip to a multi-pod fleet unchanged.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -67,6 +68,27 @@ def initialize(coordinator_address: Optional[str] = None,
     # single-process jobs
     explicit = any(a is not None for a in (coordinator_address, num_processes,
                                            process_id, local_device_ids))
+    # On a CPU platform, cross-process computations need the gloo
+    # collectives client selected BEFORE the backend initializes — the
+    # env-var spelling alone does not reach the XLA CpuClient on this
+    # jax/jaxlib line, and a distributed CPU run without it fails at
+    # the first collective with "Multiprocess computations aren't
+    # implemented on the CPU backend" (ISSUE 15: this one line is what
+    # stood between the multiprocess tests and the capability). The
+    # platform decision reads the ENV, not jax.default_backend() —
+    # querying the backend here would initialize it and break the
+    # must-be-first contract above. An UNSET platform counts as
+    # CPU-eligible (the common bare-machine case — and the same
+    # decision ``transport.multihost.multihost_capability`` makes, so
+    # the gate and this knob can never disagree); on accelerator
+    # hosts the knob only configures the secondary CPU client.
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if explicit and (platform == "" or platform.startswith("cpu")):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except (AttributeError, ValueError):
+            pass    # older/newer jax without the knob: leave defaults
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

@@ -33,7 +33,16 @@ Guarantees (docs/SERVING.md):
   worker's sessions, the replication log (ledger checkpoints + staged
   journals) resumes them bit-identical on the standby, and everything
   in between sheds with PYC5xx errors carrying honest ``retry_after_s``
-  — never a silent drop.
+  — never a silent drop;
+- the out-of-process fleet (``serve.transport``, ISSUE 15) carries the
+  same contract across REAL process boundaries:
+  ``FleetConfig(transport="socket")`` runs supervised worker processes
+  behind a digest-framed socket RPC protocol (wrong-toolchain workers
+  refused at connect, structured errors crossing intact), ships every
+  journal record to the standby's disk before acknowledging it, and
+  warms adopting processes from the shared AOT cache with zero
+  retraces — a worker process SIGKILLed mid-traffic still loses
+  nothing.
 """
 
 from __future__ import annotations

@@ -100,6 +100,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "executables persist here; a restarted "
                          "process warms from disk with zero pipeline "
                          "retraces")
+    ap.add_argument("--fleet-workers", type=int, default=0, metavar="N",
+                    help="drive the loadgen through an N-worker "
+                         "ConsensusFleet instead of a single service "
+                         "(0 = single service; ISSUE 8/15)")
+    ap.add_argument("--transport", choices=["inprocess", "socket"],
+                    default="inprocess",
+                    help="fleet worker transport (with --fleet-workers):"
+                         " inprocess = function-call workers, socket = "
+                         "real supervised worker processes behind the "
+                         "RPC wire protocol (docs/SERVING.md "
+                         "\"Out-of-process fleet\")")
+    ap.add_argument("--log-dir", default=None, metavar="DIR",
+                    help="fleet replication-log directory (required "
+                         "for fleet sessions; the socket transport "
+                         "also roots worker log + shipped-log dirs "
+                         "here)")
     ap.add_argument("--allow-shed", action="store_true",
                     help="shed requests (PYC401) do not fail the run — "
                          "the expected outcome of an overload probe")
@@ -142,6 +158,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         shapes = _parse_shapes(args.shapes)
     except ValueError:
         ap.error(f"--shapes: cannot parse {args.shapes!r} (want RxE,...)")
+
+    if args.fleet_workers > 0:
+        return _fleet_main(args, cfg, shapes)
 
     svc = ConsensusService(cfg)
     warm = list(cfg.warmup) or svc.buckets_for(shapes)
@@ -204,6 +223,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         obs.write_prom(args.metrics_out, obs.REGISTRY)
         print(f"metrics written to {args.metrics_out}", file=sys.stderr)
 
+    hard_failures = stats["failed"]
+    if args.allow_shed:
+        hard_failures -= stats["errors"].get("PYC401", 0)
+    return 0 if hard_failures == 0 else 1
+
+
+def _fleet_main(args, cfg, shapes) -> int:
+    """``--fleet-workers N``: the same loadgen run against a
+    ConsensusFleet (ISSUE 8), over either transport (ISSUE 15) — the
+    operational front door of the out-of-process deployment."""
+    from .. import obs
+    from .fleet import ConsensusFleet, FleetConfig
+    from .loadgen import LoadGenerator
+
+    fleet = ConsensusFleet(FleetConfig(
+        n_workers=args.fleet_workers, transport=args.transport,
+        log_dir=args.log_dir, worker=cfg)).start()
+    try:
+        gen = LoadGenerator(fleet, shapes=shapes, na_frac=args.na_frac,
+                            seed=args.seed, max_retries=args.retries)
+        if args.rate:
+            stats = gen.run_open(args.requests, args.rate)
+        else:
+            stats = gen.run_closed(args.requests, args.concurrency)
+        status = fleet.status()     # before the drain marks workers down
+    finally:
+        fleet.close(drain=True)
+    stats["transport"] = args.transport
+    stats["fleet"] = status
+    print(json.dumps(stats, indent=2))
+    if args.metrics_out:
+        obs.write_prom(args.metrics_out, obs.REGISTRY)
+        print(f"metrics written to {args.metrics_out}", file=sys.stderr)
     hard_failures = stats["failed"]
     if args.allow_shed:
         hard_failures -= stats["errors"].get("PYC401", 0)

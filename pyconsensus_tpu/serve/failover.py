@@ -147,10 +147,16 @@ class ReplicationLog:
                                   f"_block_{int(block_idx):06d}.npz")
 
     def journal_block(self, round_idx: int, block_idx: int, block,
-                      event_bounds=None) -> pathlib.Path:
+                      event_bounds=None,
+                      append_id: Optional[str] = None) -> pathlib.Path:
         """Durably journal one appended event block (atomic + digested).
         Returns the journal path. Runs BEFORE the in-memory fold — see
-        the module-docstring ordering argument."""
+        the module-docstring ordering argument. ``append_id`` is the
+        caller's idempotency token (ISSUE 15): persisted with the
+        record so a replayed standby knows which logical appends the
+        journal already carries — a client whose append LANDED but
+        whose acknowledgment was lost to a worker death can retry it
+        without double-folding the block."""
         block = np.ascontiguousarray(block, dtype=np.float64)
         bounds_json = json.dumps(
             None if event_bounds is None else list(event_bounds)).encode()
@@ -162,6 +168,12 @@ class ReplicationLog:
             "digest": np.frombuffer(
                 _digest(block, bounds_json).encode(), dtype=np.uint8),
         }
+        if append_id is not None:
+            # optional field: pre-ISSUE-15 records (and id-less
+            # appends) simply lack it — the digest covers content, the
+            # id covers retry identity
+            state["append_id"] = np.frombuffer(
+                str(append_id).encode(), dtype=np.uint8)
         path = self._block_path(round_idx, block_idx)
 
         def write(tmp):
@@ -170,7 +182,8 @@ class ReplicationLog:
 
     def _read_block(self, path: pathlib.Path) -> tuple:
         """Load + integrity-check one journaled block. Returns
-        ``(index, block, bounds)``; raises CheckpointCorruptionError
+        ``(index, block, bounds, append_id)`` (``append_id`` None on
+        id-less/older records); raises CheckpointCorruptionError
         naming the file on any structural or digest failure."""
         def bad(why, **ctx):
             return CheckpointCorruptionError(
@@ -190,6 +203,9 @@ class ReplicationLog:
                 digest = bytes(np.asarray(data["digest"],
                                           dtype=np.uint8)).decode()
                 index = int(np.asarray(data["index"]).item())
+                append_id = (bytes(np.asarray(data["append_id"],
+                                              dtype=np.uint8)).decode()
+                             if "append_id" in fields else None)
         except CheckpointCorruptionError:
             raise
         except Exception as exc:
@@ -201,11 +217,13 @@ class ReplicationLog:
             raise bad("content digest mismatch (torn or tampered "
                       "replication record)")
         bounds = json.loads(bounds_json.decode())
-        return index, block, bounds
+        return index, block, bounds, append_id
 
     def staged(self, round_idx: int) -> list:
         """The journaled blocks of round ``round_idx`` in append order:
-        ``[(block, bounds), ...]``. Validates digests and index
+        ``[(block, bounds, append_id), ...]`` (the id element is None
+        for id-less records; existing positional consumers of
+        ``[0]``/``[1]`` are unaffected). Validates digests and index
         contiguity (a gap means a deleted/lost record — replication is
         torn, refuse)."""
         found = []
@@ -216,9 +234,9 @@ class ReplicationLog:
                     found.append(p)
         out, indices = [], []
         for p in found:
-            index, block, bounds = self._read_block(p)
+            index, block, bounds, append_id = self._read_block(p)
             indices.append(index)
-            out.append((block, bounds))
+            out.append((block, bounds, append_id))
         if indices != list(range(len(indices))):
             raise CheckpointCorruptionError(
                 f"{self.staged_dir}: staged blocks of round {round_idx} "
@@ -297,6 +315,12 @@ class DurableSession(MarketSession):
         self._log = log
         self._fenced = None
         self.rounds_resolved = ledger.round
+        #: idempotency tokens of appends this session has applied
+        #: (ISSUE 15) — a retried append whose original landed (its
+        #: ack lost to a worker death) folds NOTHING the second time.
+        #: Seeded from the journal at replay; a few bytes per append
+        #: for the session's lifetime.
+        self._applied_append_ids: set = set()   # guarded-by: _lock
 
     @classmethod
     def create(cls, log_root, name: str, n_reporters: int,
@@ -342,7 +366,8 @@ class DurableSession(MarketSession):
         with self._lock:
             self._fenced = exc
 
-    def append(self, reports_block, event_bounds=None) -> int:
+    def append(self, reports_block, event_bounds=None,
+               append_id: Optional[str] = None) -> int:
         # journal-then-fold under the session lock: the journal index is
         # the in-memory block count, and no interleaved append may slip
         # between the durable write and the fold (replay order must be
@@ -350,6 +375,14 @@ class DurableSession(MarketSession):
         with self._lock:
             if self._fenced is not None:
                 raise self._fenced
+            if append_id is not None \
+                    and append_id in self._applied_append_ids:
+                # the retry of an append that already landed (ISSUE 15:
+                # the worker died between durability and the ack) —
+                # idempotent: acknowledge without journaling or folding
+                # a second copy, or the standby's bits would diverge
+                # from the never-killed run
+                return self.n_events
             block = np.asarray(reports_block, dtype=np.float64)
             if block.ndim == 1:
                 block = block[:, None]
@@ -372,9 +405,13 @@ class DurableSession(MarketSession):
             # against a racing takeover (the PR-8 contract)
             path = self._log.journal_block(self.ledger.round,  # consensus-lint: disable=CL802 — ack-iff-durable needs the journal write inside the critical section
                                            len(self._blocks), block,
-                                           event_bounds)
+                                           event_bounds,
+                                           append_id=append_id)
             try:
-                return super().append(block, event_bounds)
+                total = super().append(block, event_bounds)
+                if append_id is not None:
+                    self._applied_append_ids.add(append_id)
+                return total
             except BaseException:
                 # the fold failed AFTER the journal write: the caller is
                 # told this append never happened, so the record must
@@ -472,9 +509,14 @@ def replay_session(log_root, name: str,
         refresh_every=int(meta.get("refresh_every",
                                    INCREMENTAL_REFRESH_DEFAULT)),
         executable_provider=executable_provider)
-    for block, bounds in staged:
+    for block, bounds, append_id in staged:
         # fold WITHOUT re-journaling (the records already exist):
         # MarketSession.append is the identical arithmetic the dead
-        # worker ran, against the identical ledger-carried reputation
+        # worker ran, against the identical ledger-carried reputation;
+        # the journal's idempotency tokens seed the standby's dedupe
+        # set, so a client's retried append (its ack died with the
+        # worker) folds nothing twice
         MarketSession.append(session, block, bounds)
+        if append_id is not None:
+            session._applied_append_ids.add(append_id)
     return session

@@ -33,12 +33,18 @@ Architecture (docs/SERVING.md "Replicated fleet"):
   sheds quote retry hints scaled by surviving capacity; per-worker
   queue depths export as gauges.
 
-The workers are in-process ``ConsensusService`` instances — the fleet
-is the ROUTING + DURABILITY + FAILOVER layer, deliberately below any
-network protocol (the library's long-standing stance; a deployment
-wraps workers in processes/pods and this module's semantics carry over
-because all shared state lives in the replication log, which the chaos
-suite exercises with a REAL ``kill -9`` against a worker process).
+The router speaks to its workers through the ``serve.transport``
+worker-handle surface (ISSUE 15): with the default
+``FleetConfig.transport = "inprocess"`` the workers are in-process
+``ConsensusService`` instances behind function calls (this module's
+:class:`FleetWorker` — the PR-8 fleet, bit-for-bit); with
+``transport = "socket"`` they are REAL OS processes behind the
+length-prefixed, digest-framed socket RPC protocol, supervised and
+SIGKILL-able, with replication logs SHIPPED to the standby's disk
+(``serve.transport.supervisor`` / ``.shipping``). The routing,
+placement, admission, and failover semantics in this module are
+written once against the handle surface and hold for both — the
+transport-parametrized fleet tests pin that.
 Fault sites ``fleet.route`` / ``fleet.heartbeat`` / ``fleet.takeover``
 / ``fleet.ledger_replay`` let a seeded ``FaultPlan`` inject worker
 loss, heartbeat flap, and torn ledger replication deterministically.
@@ -61,6 +67,7 @@ from .admission import ClusterCapacity
 from .failover import DurableSession, replay_session
 from .placement import DEFAULT_VNODES, HashRing
 from .service import ConsensusService, ServeConfig
+from .transport.base import WorkerBase, resolve_transport
 
 __all__ = ["FleetConfig", "FleetWorker", "ConsensusFleet"]
 
@@ -69,7 +76,7 @@ __all__ = ["FleetConfig", "FleetWorker", "ConsensusFleet"]
 # without a full cycle) and mirrored at runtime by the lock witness:
 # a worker's declare lock is always outermost — the takeover path holds
 # it across fleet-state, ring, and capacity updates.
-# consensus-lint: lock-order FleetWorker.declare_lock < ConsensusFleet._lock
+# consensus-lint: lock-order WorkerBase.declare_lock < ConsensusFleet._lock
 # consensus-lint: lock-order ConsensusFleet._lock < HashRing._lock
 # consensus-lint: lock-order ConsensusFleet._lock < ClusterCapacity._lock
 
@@ -91,7 +98,11 @@ class FleetConfig:
     #: heartbeat staleness beyond which a worker is declared dead
     heartbeat_timeout_s: float = 2.0
     #: monitor scan period (``monitor=True`` runs a background thread;
-    #: otherwise call :meth:`ConsensusFleet.check_workers` yourself)
+    #: otherwise call :meth:`ConsensusFleet.check_workers` yourself).
+    #: A transport may DEMAND the monitor (``Transport.wants_monitor``,
+    #: e.g. the socket transport: an organically-dead worker PROCESS is
+    #: only discoverable by probing) — the fleet then runs it
+    #: regardless of this flag.
     heartbeat_interval_s: float = 0.5
     monitor: bool = False
     #: honest takeover-window estimate quoted in PYC501/PYC502 retry
@@ -102,33 +113,50 @@ class FleetConfig:
     #: virtual points per worker on the placement ring
     vnodes: int = DEFAULT_VNODES
     #: stateless requests spill to the next ring arc when the owner's
-    #: queue is full (sessions never spill — they are sticky by design)
+    #: queue is full (sessions never spill — they are sticky by design).
+    #: Spillover needs the owner's refusal SYNCHRONOUSLY, so it is an
+    #: in-process behavior; socket workers answer through their
+    #: futures and clients retry on the structured shed instead.
     spillover: bool = True
+    #: worker transport (ISSUE 15): ``"inprocess"`` (default — function
+    #: calls, today's behavior), ``"socket"`` (real worker processes
+    #: behind the RPC wire protocol, supervised, logs shipped), or a
+    #: ready ``serve.transport.base.Transport`` instance.
+    transport: object = "inprocess"
 
 
-class FleetWorker:
-    """One worker: a named :class:`ConsensusService` plus the liveness
-    bookkeeping the router needs. ``hard_kill`` is the in-process
-    SIGKILL model: fence (no new work, no drain) and shed everything
-    queued as ``WorkerLostError`` — in-flight device dispatches finish
-    (their callers get correct bits; a real kill would have dropped
-    them, which the REAL ``kill -9`` chaos stage covers via the
-    replication log instead)."""
+class FleetWorker(WorkerBase):
+    """One IN-PROCESS worker: a named :class:`ConsensusService` plus
+    the liveness bookkeeping the router needs — the default transport's
+    worker handle (``serve.transport.base``; the socket twin is
+    ``serve.transport.supervisor.SocketWorkerHandle``). ``hard_kill``
+    is the in-process SIGKILL model: fence (no new work, no drain) and
+    shed everything queued as ``WorkerLostError`` — in-flight device
+    dispatches finish (their callers get correct bits; a real kill
+    would have dropped them, which the REAL ``kill -9`` chaos stages
+    cover via the replication log instead)."""
 
-    def __init__(self, name: str, config: ServeConfig) -> None:
-        self.name = str(name)
+    def __init__(self, name: str, config: ServeConfig,
+                 log_dir=None) -> None:
+        # Racy liveness reads are this codebase's documented idiom —
+        # see WorkerBase (`alive` monotonic True -> False under
+        # declare_lock's single-claim takeover; a stale
+        # `last_heartbeat` read only DELAYS a staleness scan).
+        super().__init__(name)
         self.service = ConsensusService(config)
-        # Racy reads are this codebase's documented idiom for monotonic
-        # liveness state: `alive` only ever transitions True -> False
-        # (the transition itself is serialized by declare_lock's
-        # single-claim takeover), and a stale `last_heartbeat` read can
-        # only DELAY a staleness declaration by one scan.
-        self.alive = True                       # guarded-by: none
-        self.last_heartbeat = time.monotonic()  # guarded-by: none
-        #: serializes concurrent death declarations for THIS worker
-        #: (kill_worker vs routing-time discovery vs monitor scan) —
-        #: exactly one takeover runs; the losers observe its result
-        self.declare_lock = threading.Lock()
+        self._log_dir = log_dir
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> None:
+        self.service.start(warmup=warmup)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 60.0) -> None:
+        if self.alive:
+            self.service.close(drain=drain, timeout=timeout)
+
+    # -- liveness -------------------------------------------------------
 
     def heartbeat(self) -> bool:
         """Record one liveness beat. Returns False — the beat is LOST —
@@ -143,9 +171,6 @@ class FleetWorker:
             return False
         self.last_heartbeat = time.monotonic()
         return True
-
-    def stale(self, timeout_s: float) -> bool:
-        return (time.monotonic() - self.last_heartbeat) > timeout_s
 
     def queue_depth(self) -> int:
         return len(self.service.queue)
@@ -168,6 +193,78 @@ class FleetWorker:
                 shed += 1
         return shed
 
+    # -- the request plane ----------------------------------------------
+
+    def submit_stateless(self, reports, tenant: str, **kwargs):
+        return self.service.submit(reports=reports, tenant=tenant,
+                                   **kwargs)
+
+    def submit_session(self, session: str, tenant: str, **kwargs):
+        return self.service.submit(session=session, tenant=tenant,
+                                   **kwargs)
+
+    # -- the session plane ----------------------------------------------
+
+    def create_session(self, name: str, n_reporters: int,
+                       kwargs: dict) -> None:
+        """A durable session on this worker's shared log directory —
+        the owning worker's incremental policy + executable provider
+        apply (every worker runs the same ServeConfig, so the policy is
+        fleet-uniform; the provider binds to the owner's cache)."""
+        kwargs = self.service.session_defaults(dict(kwargs))
+        session = DurableSession.create(self._log_dir, name,
+                                        int(n_reporters), **kwargs)
+        self.service.sessions.add(session)
+
+    def adopt_session(self, name: str) -> None:
+        """Verify + replay ``name``'s log from the shared directory
+        onto this worker (both the takeover path and the cross-fleet
+        resume use this)."""
+        session = replay_session(
+            self._log_dir, name,
+            executable_provider=self.service.incremental_executable_for)
+        self.service.sessions.add(session)
+
+    def evict_session(self, name: str) -> None:
+        """Drop the (fenced) in-memory object after its log replayed
+        elsewhere: the session lives in exactly ONE store, so the
+        live-session gauge stays honest."""
+        self.service.sessions.remove(name)
+
+    def fence_session(self, name: str, exc: BaseException) -> None:
+        """Fence this worker's in-memory session object BEFORE a
+        standby replays its log. A client that resolved the owner just
+        ahead of the kill still holds that object; without the fence
+        its ``append`` could journal a block the already-replayed
+        standby never folds — an acknowledged write the fleet then
+        forgets. The fence (under the session lock) makes the race
+        two-sided: a mutation that completed its journal write is read
+        by the replay; anything later raises the retryable worker-loss
+        error and was never acknowledged."""
+        try:
+            stale = self.service.sessions.get(name)
+        except InputError:
+            return      # not in this store (e.g. retried stranded take)
+        fence = getattr(stale, "fence", None)
+        if fence is not None:
+            fence(exc)
+
+    def append(self, session: str, reports_block, event_bounds=None,
+               append_id: Optional[str] = None) -> int:
+        target = self.service.sessions.get(session)
+        if append_id is not None:
+            # fleet sessions are DurableSessions (the only kind the
+            # router creates) — the id rides to the journal's dedupe
+            return target.append(reports_block, event_bounds,
+                                 append_id=append_id)
+        return target.append(reports_block, event_bounds)
+
+    def session_state(self, name: str) -> dict:
+        return self.service.sessions.get(name).state()
+
+    def warm_from_disk(self) -> int:
+        return self.service.warm_from_disk()
+
 
 class ConsensusFleet:
     """The replicated serve fleet (see module docstring).
@@ -189,12 +286,12 @@ class ConsensusFleet:
         self.config = config or FleetConfig()
         if self.config.n_workers < 1:
             raise InputError("a fleet needs at least one worker")
-        self.workers = {f"w{i}": FleetWorker(f"w{i}", self.config.worker)
-                        for i in range(self.config.n_workers)}
+        self.transport = resolve_transport(self.config.transport)
+        self.workers = self.transport.make_workers(self.config)
         self.ring = HashRing(self.workers, vnodes=self.config.vnodes)
         self.capacity = ClusterCapacity(self.config.base_retry_s)
-        for name, w in self.workers.items():
-            self.capacity.register(name, w.service.config.max_queue)
+        for name in self.workers:
+            self.capacity.register(name, self.config.worker.max_queue)
         #: session name -> owning worker name (None while failed)
         self._sessions: dict = {}           # guarded-by: _lock
         #: sessions currently replaying onto their standby (fenced)
@@ -216,8 +313,10 @@ class ConsensusFleet:
 
     def start(self, warmup: bool = True) -> "ConsensusFleet":
         for w in self.workers.values():
-            w.service.start(warmup=warmup)
-        if self.config.monitor and self._monitor is None:
+            w.start(warmup=warmup)
+        monitor = (self.config.monitor
+                   or getattr(self.transport, "wants_monitor", False))
+        if monitor and self._monitor is None:
             self._monitor = threading.Thread(
                 target=self._monitor_loop,
                 name="pyconsensus-fleet-monitor", daemon=True)
@@ -236,9 +335,12 @@ class ConsensusFleet:
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
             self._monitor = None
+        # EVERY handle closes — a dead socket worker has no service to
+        # drain but still owns client pools/threads to release (each
+        # handle guards its own drain on liveness)
         for w in self.workers.values():
-            if w.alive:
-                w.service.close(drain=drain, timeout=timeout)
+            w.close(drain=drain, timeout=timeout)
+        self.transport.close()
 
     # -- liveness -------------------------------------------------------
 
@@ -339,15 +441,17 @@ class ConsensusFleet:
                         # scan is the same work every time
                         warmed_owners.add(new_owner)
                         self._warm_standby(new_owner)
-                    session = replay_session(
-                        self.config.log_dir, name,
-                        executable_provider=self.workers[
-                            new_owner].service.incremental_executable_for)
-                    self.workers[new_owner].service.sessions.add(session)
+                    # verify + replay onto the standby: the in-process
+                    # handle replays the shared log directory; a socket
+                    # handle asks the standby PROCESS to adopt the
+                    # SHIPPED copy of the dead process's log — either
+                    # way a corrupt log refuses with PYC301 (the
+                    # taxonomy crosses the wire intact)
+                    self.workers[new_owner].adopt_session(name)
                     # the fenced stale object leaves the dead worker's
                     # store: the session lives in exactly ONE store, so
                     # the live-session gauge stays honest
-                    self.workers[dead].service.sessions.remove(name)
+                    self.workers[dead].evict_session(name)
                     with self._lock:
                         self._sessions[name] = new_owner
                     self._migrated.inc()
@@ -391,7 +495,7 @@ class ConsensusFleet:
         stall on top of a failover. Fail-soft: warming can shrink the
         PYC502 window, it must never abort the takeover."""
         try:
-            adopted = self.workers[owner].service.warm_from_disk()
+            adopted = self.workers[owner].warm_from_disk()
         except Exception as exc:   # noqa: BLE001 — the takeover wins
             print(f"WARNING: standby {owner!r} AOT warm failed "
                   f"({type(exc).__name__}: {exc}); takeover continues",
@@ -405,29 +509,18 @@ class ConsensusFleet:
 
     def _fence_stale(self, dead: str, name: str) -> None:
         """Fence the dead worker's in-memory session object BEFORE the
-        replay reads its log. A client that resolved the owner just
-        ahead of the kill still holds that object; without the fence its
-        ``append`` could journal a block the already-replayed standby
-        never folds — an acknowledged write the fleet then forgets. The
-        fence (under the session lock) makes the race two-sided: a
-        mutation that completed its journal write is read by the replay;
-        anything later raises the retryable worker-loss error and was
-        never acknowledged."""
-        try:
-            stale = self.workers[dead].service.sessions.get(name)
-        except InputError:
-            return      # not in this store (e.g. retried stranded take)
-        fence = getattr(stale, "fence", None)
-        if fence is not None:
-            fence(WorkerLostError(
-                f"session {name!r} migrated off dead worker {dead!r}",
-                worker=dead, session=name,
-                retry_after_s=self.config.takeover_window_s))
+        replay reads its log (see :meth:`FleetWorker.fence_session` for
+        the race this closes; a SIGKILL'd socket worker has no stale
+        object to fence — its handle's fence is structurally a no-op)."""
+        self.workers[dead].fence_session(name, WorkerLostError(
+            f"session {name!r} migrated off dead worker {dead!r}",
+            worker=dead, session=name,
+            retry_after_s=self.config.takeover_window_s))
 
     # -- routing --------------------------------------------------------
 
     def _session_worker(self, session: str,
-                        _retried: bool = False) -> FleetWorker:
+                        _retried: bool = False) -> WorkerBase:
         """Resolve a session to its live owning worker, surfacing the
         takeover states as their structured errors."""
         with self._lock:
@@ -492,13 +585,16 @@ class ConsensusFleet:
         policy), PYC501/502 (worker loss / takeover, retryable),
         PYC503 (no placeable worker)."""
         _faults.fire("fleet.route")
+        if (reports is None) == (session is None):
+            # the service front-door contract, enforced AT THE ROUTER:
+            # a malformed call must refuse synchronously on every
+            # transport, not as a worker-side future error
+            raise InputError(
+                "exactly one of reports= / session= is required")
         if session is not None:
-            if reports is not None:   # same contract as the service's
-                raise InputError(     # submit — never silently drop one
-                    "exactly one of reports= / session= is required")
             w = self._session_worker(session)
             try:
-                return w.service.submit(session=session, tenant=tenant,
+                return w.submit_session(session, tenant=tenant,
                                         **kwargs)
             except ServiceOverloadError as exc:
                 if exc.context.get("reason") == "draining" and not w.alive:
@@ -527,8 +623,8 @@ class ConsensusFleet:
             if not w.alive:
                 continue
             try:
-                return w.service.submit(reports=reports, tenant=tenant,
-                                        **kwargs)
+                return w.submit_stateless(reports, tenant=tenant,
+                                          **kwargs)
             except ServiceOverloadError as exc:
                 if exc.context.get("reason") not in ("queue_full",
                                                      "draining"):
@@ -560,13 +656,7 @@ class ConsensusFleet:
                 "cannot fail over")
         _faults.fire("fleet.route")
         owner = self.ring.owner(name)
-        # the owning worker's incremental policy + executable provider
-        # apply (every worker runs the same ServeConfig, so the policy
-        # is fleet-uniform; the provider binds to the owner's cache)
-        kwargs = self.workers[owner].service.session_defaults(kwargs)
-        session = DurableSession.create(self.config.log_dir, name,
-                                        n_reporters, **kwargs)
-        self.workers[owner].service.sessions.add(session)
+        self.workers[owner].create_session(name, n_reporters, kwargs)
         with self._lock:
             self._sessions[name] = owner
         return owner
@@ -589,11 +679,7 @@ class ConsensusFleet:
                 raise InputError(
                     f"session {name!r} is already placed on this fleet")
         owner = self.ring.owner(name)
-        session = replay_session(
-            self.config.log_dir, name,
-            executable_provider=self.workers[
-                owner].service.incremental_executable_for)
-        self.workers[owner].service.sessions.add(session)
+        self.workers[owner].adopt_session(name)
         with self._lock:
             self._sessions[name] = owner
         return owner
@@ -602,16 +688,26 @@ class ConsensusFleet:
         """The owning worker's :meth:`MarketSession.state` snapshot,
         routed like any session request (PYC5xx during takeovers)."""
         w = self._session_worker(name)
-        return w.service.sessions.get(name).state()
+        return w.session_state(name)
 
-    def append(self, session: str, reports_block,
-               event_bounds=None) -> int:
+    def append(self, session: str, reports_block, event_bounds=None,
+               append_id: Optional[str] = None) -> int:
         """Append an event block to a fleet session (durable before
-        acknowledged — the replication-log write order)."""
+        acknowledged — the replication-log write order; over the socket
+        transport, SHIPPED to the standby's disk before acknowledged
+        too). ``append_id`` is the client's idempotency token: a
+        retried append (a PYC501 whose original may have LANDED before
+        the worker died — durability and the lost acknowledgment are
+        indistinguishable from outside) must pass the SAME id, and the
+        standby acknowledges without folding the block twice. Blind
+        retries without an id risk a duplicate fold on exactly that
+        race."""
         _faults.fire("fleet.route")
         w = self._session_worker(session)
-        return w.service.sessions.get(session).append(reports_block,
-                                                      event_bounds)
+        if append_id is not None:
+            return w.append(session, reports_block, event_bounds,
+                            append_id=append_id)
+        return w.append(session, reports_block, event_bounds)
 
     def owner_of(self, session: str) -> Optional[str]:
         with self._lock:

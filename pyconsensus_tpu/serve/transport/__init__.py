@@ -1,0 +1,63 @@
+"""pyconsensus_tpu.serve.transport — the out-of-process fleet
+(ISSUE 15 tentpole): socket RPC transport, worker-process supervision,
+and replication-log shipping behind the ``ConsensusFleet`` router's
+unchanged front door.
+
+Layers (each its own module, each independently testable):
+
+- ``wire``    — length-prefixed, SHA-256-digest-framed messages
+  (msgpack/JSON), the versioned runtime-fingerprint handshake
+  (wrong-jaxlib workers refused at connect, PYC602), and structured
+  PYC-coded error marshalling (``WorkerLostError`` /
+  ``FailoverInProgressError`` / ``ServiceOverloadError`` cross the
+  wire intact).
+- ``rpc``     — pooled client with ``retry_call``-bounded reconnect on
+  transient socket errors + the per-connection-thread server.
+- ``worker``  — the ``pyconsensus-fleet-worker`` subprocess body: a
+  full ``ConsensusService`` + durable sessions behind the RPC surface,
+  shipping every journal record before acknowledging it.
+- ``supervisor`` — spawn/health-check/drain/SIGKILL real worker
+  processes; ``SocketWorkerHandle`` (the router-side face) and
+  ``SocketTransport`` (the fleet factory).
+- ``shipping`` — per-round journal records streamed to the standby's
+  disk with verify-before-adopt; ``adopt_shipped`` is the
+  cross-process takeover replay.
+- ``base``    — the transport abstraction ``ConsensusFleet`` routes
+  through: ``InProcessTransport`` (default, today's behavior) and
+  ``SocketTransport`` implement one worker-handle surface.
+- ``multihost`` — the capability-gated ``jax.distributed`` stage for
+  environments whose jaxlib supports cross-process collectives.
+
+Quick use::
+
+    from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+
+    fleet = ConsensusFleet(FleetConfig(
+        n_workers=3, transport="socket",
+        log_dir="/var/lib/pyconsensus/fleet")).start()
+    fleet.create_session("btc-settles", n_reporters=50)
+    fleet.append("btc-settles", block)       # shipped before acked
+    result = fleet.submit(session="btc-settles").result()
+    # SIGKILL a worker PROCESS: the standby adopts the shipped log,
+    # warms from the AOT cache, and serves bit-identical results
+    fleet.kill_worker("w1")
+"""
+
+from __future__ import annotations
+
+from .base import (InProcessTransport, Transport, WorkerBase,
+                   resolve_transport)
+from .rpc import RpcClient, RpcServer
+from .shipping import LogShipper, ShippingReceiver, adopt_shipped
+from .wire import (MAX_FRAME_BYTES, WIRE_PROTOCOL_VERSION, client_hello,
+                   marshal_error, recv_msg, send_msg, server_handshake,
+                   unmarshal_error)
+
+__all__ = [
+    "Transport", "InProcessTransport", "WorkerBase", "resolve_transport",
+    "RpcClient", "RpcServer",
+    "LogShipper", "ShippingReceiver", "adopt_shipped",
+    "WIRE_PROTOCOL_VERSION", "MAX_FRAME_BYTES",
+    "send_msg", "recv_msg", "marshal_error", "unmarshal_error",
+    "client_hello", "server_handshake",
+]

@@ -1,0 +1,104 @@
+"""The fleet's transport abstraction (ISSUE 15 tentpole d).
+
+``ConsensusFleet`` routes every request through a per-worker **handle**
+implementing one surface — liveness (``heartbeat``/``stale``/
+``queue_depth``/``hard_kill``), the request plane (``submit_stateless``
+/ ``submit_session``), and the session plane (``create_session`` /
+``append`` / ``session_state`` / ``adopt_session`` plus the takeover
+hooks ``fence_session`` / ``evict_session`` / ``warm_from_disk``) — so
+the router's placement, admission, and failover logic is written ONCE
+and runs unchanged over:
+
+- :class:`InProcessTransport` (default): workers are in-process
+  ``ConsensusService`` instances behind function calls — exactly the
+  PR-8 fleet, today's behavior and test substrate;
+- :class:`~.supervisor.SocketTransport`: workers are real OS processes
+  behind the socket RPC protocol (``wire.py``), supervised, heartbeat
+  over the wire, their replication logs shipped to the standby's disk.
+
+The split keeps the semantics in one place: "any worker can die
+mid-traffic with zero lost resolutions" is a ROUTER property pinned by
+the transport-parametrized fleet tests, not something each transport
+re-implements.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ...faults import InputError
+
+__all__ = ["WorkerBase", "Transport", "InProcessTransport",
+           "resolve_transport"]
+
+
+class WorkerBase:
+    """Shared liveness bookkeeping every worker handle carries. The
+    conventions are the fleet's (see ``serve.fleet``): ``alive`` only
+    ever transitions True -> False (serialized by ``declare_lock``'s
+    single-claim takeover), ``last_heartbeat`` is racy-monotonic (a
+    stale read can only DELAY a staleness declaration by one scan)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = str(name)
+        self.alive = True                       # guarded-by: none
+        self.last_heartbeat = time.monotonic()  # guarded-by: none
+        #: serializes concurrent death declarations for THIS worker —
+        #: exactly one takeover runs; the losers observe its result
+        self.declare_lock = threading.Lock()
+
+    def stale(self, timeout_s: float) -> bool:
+        return (time.monotonic() - self.last_heartbeat) > timeout_s
+
+
+class Transport:
+    """Factory for a fleet's worker handles. ``make_workers`` is called
+    once at fleet construction; ``close`` tears down transport-level
+    machinery (a supervisor's processes, the shipping receiver) after
+    the workers themselves closed."""
+
+    name = "abstract"
+    #: True forces the fleet's heartbeat monitor on regardless of
+    #: ``FleetConfig.monitor`` — set by transports whose worker deaths
+    #: are only discoverable by probing (the socket transport: a
+    #: crashed/OOM-killed PROCESS raises no in-process signal)
+    wants_monitor = False
+
+    def make_workers(self, config) -> dict:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Transport-level teardown (default: nothing)."""
+
+
+class InProcessTransport(Transport):
+    """Today's fleet: N in-process ``ConsensusService`` workers behind
+    function calls, sharing one replication-log directory."""
+
+    name = "inprocess"
+
+    def make_workers(self, config) -> dict:
+        from ..fleet import FleetWorker
+
+        return {f"w{i}": FleetWorker(f"w{i}", config.worker,
+                                     log_dir=config.log_dir)
+                for i in range(config.n_workers)}
+
+
+def resolve_transport(spec) -> Transport:
+    """``FleetConfig.transport`` -> a :class:`Transport`:
+    ``"inprocess"`` (default), ``"socket"`` (lazy import — the socket
+    machinery costs nothing unless asked for), or a ready-made
+    instance for tests/custom deployments."""
+    if isinstance(spec, Transport):
+        return spec
+    if spec == "inprocess" or spec is None:
+        return InProcessTransport()
+    if spec == "socket":
+        from .supervisor import SocketTransport
+
+        return SocketTransport()
+    raise InputError(
+        f"unknown fleet transport {spec!r} — choose 'inprocess', "
+        f"'socket', or pass a Transport instance", transport=spec)

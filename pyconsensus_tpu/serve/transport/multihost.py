@@ -1,0 +1,90 @@
+"""Capability-gated ``jax.distributed`` multi-host stage (ISSUE 15).
+
+The socket transport moves the SERVING plane (requests, sessions,
+failover) across process boundaries without any jax-level coupling —
+each worker is a complete single-process jax runtime. The COMPUTE
+plane crossing hosts (one sharded bucket spanning machines) is a
+separate capability: it needs a jaxlib whose backend client supports
+cross-process collectives. On CPU that is the gloo collectives client
+(``jax_cpu_collectives_implementation = "gloo"`` — now selected by
+``parallel.initialize`` automatically, the one-line fix that converted
+the multiprocess test suite from xfail to exercised); on TPU it is the
+platform's ICI/DCN fabric.
+
+:func:`cpu_collectives_available` is the cheap static probe the tests'
+xfail gates use: where it returns True the multi-host tests RUN (and
+pass — tests/test_distributed.py); where a jaxlib genuinely lacks the
+client, they xfail naming exactly the absent feature instead of a
+guess. :func:`init_multihost` is the launcher-side helper: initialize
+the distributed runtime (idempotent, collectives selected) and report
+what world this process joined.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["cpu_collectives_available", "multihost_capability",
+           "init_multihost"]
+
+
+def cpu_collectives_available() -> bool:
+    """Whether this jax/jaxlib can run CROSS-PROCESS computations on
+    the CPU backend: the config knob selecting a CPU collectives
+    implementation must exist AND the bundled xla client must expose
+    the gloo constructor. Import-probing only — no backend is
+    initialized (the probe must stay legal before
+    ``jax.distributed.initialize``)."""
+    try:
+        import jax
+
+        if not hasattr(jax.config, "jax_cpu_collectives_implementation"):
+            return False
+        from jax._src.lib import xla_client
+
+        return hasattr(xla_client._xla, "make_gloo_tcp_collectives")
+    except Exception:   # noqa: BLE001 — any probe failure = absent
+        return False
+
+
+def multihost_capability() -> Optional[str]:
+    """None when this environment can form a cross-process jax mesh;
+    otherwise a string naming the genuinely absent feature — the
+    xfail reason the multiprocess tests carry where they cannot run."""
+    import os
+
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform and not platform.startswith("cpu"):
+        return None     # accelerator fabrics carry their own collectives
+    if cpu_collectives_available():
+        return None
+    return ("jaxlib lacks a CPU cross-process collectives client "
+            "(no jax_cpu_collectives_implementation knob or no "
+            "make_gloo_tcp_collectives in xla_client) — multi-host "
+            "meshes need a gloo-enabled jaxlib or multi-host TPU")
+
+
+def init_multihost(coordinator_address: str, num_processes: int,
+                   process_id: int) -> dict:
+    """Join the distributed jax runtime (via ``parallel.initialize`` —
+    must run before any backend-initializing jax call) and return the
+    world this process sees. Raises with the capability reason where
+    the environment cannot support it, instead of the backend's
+    late-and-cryptic collective failure."""
+    reason = multihost_capability()
+    if reason is not None:
+        from ...faults import InputError
+
+        raise InputError(f"multi-host initialization refused: {reason}",
+                         coordinator=coordinator_address)
+    from ...parallel import initialize
+
+    initialize(coordinator_address=coordinator_address,
+               num_processes=int(num_processes),
+               process_id=int(process_id))
+    import jax
+
+    return {"process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "n_devices": int(jax.device_count()),
+            "local_devices": int(jax.local_device_count())}

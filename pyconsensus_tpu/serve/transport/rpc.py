@@ -1,0 +1,301 @@
+"""Socket RPC over the fleet wire protocol (ISSUE 15 tentpole a).
+
+One request/response per frame pair: ``{"id", "method", "params"}`` up,
+``{"id", "result"}`` or ``{"id", "error": <marshalled>}`` back. The
+machinery is deliberately small — the semantics live in ``wire.py``
+(framing, digests, handshake, error fidelity); this module adds only
+
+- :class:`RpcClient`: a bounded CONNECTION POOL (a long resolve on one
+  connection must not block a heartbeat ping on another) with
+  ``retry_call``-based bounded reconnect on transient socket errors —
+  ``OSError`` during dial is retried with the deterministic jitter
+  discipline of ``faults.retry``; taxonomy errors (PYC601/602 and every
+  marshalled worker error) are NEVER retried here, matching the
+  repo-wide rule that structured refusals do not become valid by
+  retrying. A connection that failed mid-call is closed and replaced
+  (counted under ``pyconsensus_transport_reconnects_total``), never
+  returned to the pool, and the failure surfaces to the caller — the
+  transport does not silently re-send a non-idempotent request.
+- :class:`RpcServer`: listener + one thread per connection, handshake
+  first, then a dispatch loop that marshals handler results and
+  exceptions (``wire.marshal_error`` — taxonomy errors cross intact).
+
+Client-side per-call latency lands in
+``pyconsensus_transport_rpc_seconds{method}`` — the per-RPC overhead
+column of the bench ``multiproc`` block.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+from ... import obs
+from ...faults import SimulatedCrash, TransportError
+from ...faults import plan as _faults
+from ...faults.retry import retry_call
+from . import wire
+
+__all__ = ["RpcClient", "RpcServer"]
+
+#: the fleet's transport lock hierarchy (consensus-lint CL801): pool
+#: bookkeeping is innermost — no send/recv ever runs under it.
+
+
+class RpcClient:
+    """Pooled RPC client for one worker endpoint. ``call`` checks a
+    connection out of the pool (dialing a new one up to ``pool`` when
+    none is idle), performs exactly one request/response, and returns
+    the connection only on success."""
+
+    def __init__(self, host: str, port: int, pool: int = 4,
+                 timeout_s: float = 60.0, connect_retries: int = 4,
+                 label: str = "worker",
+                 expect_fingerprint: Optional[dict] = None) -> None:
+        self.host, self.port = str(host), int(port)
+        self.pool = max(1, int(pool))
+        self.timeout_s = float(timeout_s)
+        self.connect_retries = int(connect_retries)
+        self.label = str(label)
+        self.expect_fingerprint = expect_fingerprint
+        self._idle: list = []       # guarded-by: _cond
+        self._n_open = 0            # guarded-by: _cond
+        self._ever_connected = False   # guarded-by: _cond
+        self._closed = False        # guarded-by: _cond
+        self._cond = threading.Condition()
+        self._seq = 0               # guarded-by: _cond
+
+    # -- connections ----------------------------------------------------
+
+    def _dial(self, reconnect: bool):
+        """One pooled connection: bounded-retry TCP connect (transient
+        ``OSError`` only — a worker still booting refuses the first
+        attempts), then the versioned fingerprint handshake. A
+        handshake refusal (PYC602) propagates immediately — retrying an
+        identical fingerprint cannot succeed."""
+        _faults.fire("transport.connect")
+
+        def connect():
+            return socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout_s)
+
+        sock = retry_call(connect, retries=self.connect_retries,
+                          base_delay=0.05, max_delay=1.0,
+                          retry_on=(OSError,),
+                          label=f"transport.connect:{self.label}")
+        try:
+            sock.settimeout(self.timeout_s)
+            wire.client_hello(sock, self.expect_fingerprint)
+        except BaseException:
+            sock.close()
+            raise
+        if reconnect:
+            obs.counter(
+                "pyconsensus_transport_reconnects_total",
+                "replacement connections dialed after a transport "
+                "failure").inc()
+        return sock
+
+    def _checkout(self):
+        grow = False
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise TransportError(
+                        f"rpc client for {self.label!r} is closed",
+                        reason="closed")
+                if self._idle:
+                    return self._idle.pop()
+                if self._n_open < self.pool:
+                    self._n_open += 1
+                    reconnect = self._ever_connected
+                    grow = True
+                    break
+                self._cond.wait(timeout=self.timeout_s)
+        # dial OUTSIDE the condition (CL802: no socket I/O under a
+        # lock); on failure the reserved slot is released
+        assert grow
+        try:
+            sock = self._dial(reconnect)
+        except BaseException:
+            with self._cond:
+                self._n_open -= 1
+                self._cond.notify()
+            raise
+        with self._cond:
+            self._ever_connected = True
+        return sock
+
+    def _checkin(self, sock) -> None:
+        with self._cond:
+            if self._closed:
+                self._n_open -= 1
+            else:
+                self._idle.append(sock)
+            self._cond.notify()
+        if self._closed:
+            sock.close()
+
+    def _discard(self, sock) -> None:
+        with self._cond:
+            self._n_open -= 1
+            self._cond.notify()
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # -- the call -------------------------------------------------------
+
+    def call(self, method: str, params: Optional[dict] = None,
+             timeout_s: Optional[float] = None):
+        """One RPC. Raises the unmarshalled taxonomy error the worker
+        raised, ``TransportError`` on a damaged frame, or ``OSError``
+        on a dead socket (the fleet translates those into worker-loss
+        semantics — this layer stays honest about what it saw)."""
+        sock = self._checkout()
+        with self._cond:
+            self._seq += 1
+            rid = self._seq
+        start = time.monotonic()
+        try:
+            if timeout_s is not None:
+                sock.settimeout(float(timeout_s))
+            wire.send_msg(sock, {"id": rid, "method": str(method),
+                                 "params": dict(params or {})})
+            reply = wire.recv_msg(sock)
+        except BaseException:
+            # a connection that failed mid-call is never reused: the
+            # stream position is unknown, and re-sending would be a
+            # silent replay of a possibly non-idempotent request
+            self._discard(sock)
+            raise
+        finally:
+            if timeout_s is not None:
+                try:
+                    sock.settimeout(self.timeout_s)
+                except OSError:
+                    pass
+        if reply is None:
+            self._discard(sock)
+            raise TransportError(
+                f"worker {self.label!r} closed the connection "
+                f"mid-call ({method})", reason="closed", method=method)
+        self._checkin(sock)
+        obs.histogram(
+            "pyconsensus_transport_rpc_seconds",
+            "client-observed RPC round-trip latency by method",
+            labels=("method",)).observe(
+                time.monotonic() - start, method=str(method))
+        if "error" in reply:
+            raise wire.unmarshal_error(reply["error"])
+        return reply.get("result")
+
+    def ping(self, timeout_s: float = 1.0):
+        return self.call("ping", timeout_s=timeout_s)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            idle, self._idle = self._idle, []
+            self._n_open -= len(idle)
+            self._cond.notify_all()
+        for sock in idle:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class RpcServer:
+    """Serve a dict of ``{method: callable(params) -> result}`` on a
+    listening socket. One thread per connection; the versioned
+    fingerprint handshake runs before any RPC is dispatched."""
+
+    def __init__(self, handlers: dict, name: str = "worker",
+                 host: str = "127.0.0.1", port: int = 0,
+                 fingerprint: Optional[dict] = None) -> None:
+        self.handlers = dict(handlers)
+        self.name = str(name)
+        self.fingerprint = fingerprint
+        self._listener = socket.create_server((host, int(port)))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._threads: list = []    # guarded-by: _lock
+        self._conns: list = []      # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._stopping = False      # guarded-by: none — monotonic flag,
+        # racy reads only delay loop exit by one accept (house idiom)
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RpcServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"pyconsensus-rpc-{self.name}", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return              # listener closed — shutdown
+            with self._lock:
+                if self._stopping:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True)
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn) -> None:
+        try:
+            wire.server_handshake(conn, self.name, self.fingerprint)
+            while True:
+                msg = wire.recv_msg(conn)
+                if msg is None:
+                    return          # clean close between frames
+                self._dispatch(conn, msg)
+        except (OSError, TransportError, SimulatedCrash):
+            return                  # connection-scoped: drop the peer
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, msg: dict) -> None:
+        rid = msg.get("id")
+        method = msg.get("method")
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise TransportError(f"unknown rpc method {method!r}",
+                                     reason="method", method=method)
+            result = handler(dict(msg.get("params") or {}))
+        except Exception as exc:    # noqa: BLE001 — EVERY handler error
+            # crosses as a marshalled frame (taxonomy intact); only
+            # BaseException (SimulatedCrash — the injected SIGKILL
+            # model) tears the connection like a real kill would
+            wire.send_msg(conn, {"id": rid,
+                                 "error": wire.marshal_error(exc)})
+            return
+        wire.send_msg(conn, {"id": rid, "result": result})
+
+    def close(self) -> None:
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass

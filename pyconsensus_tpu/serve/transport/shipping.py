@@ -1,0 +1,177 @@
+"""Replication-log shipping: a dead process's journal on the standby's
+disk (ISSUE 15 tentpole c).
+
+The in-process fleet's durability story assumes every worker can reach
+one shared replication-log directory. Across real process and machine
+boundaries that assumption is the deployment's weakest link — so the
+out-of-process fleet SHIPS the log instead: every record a worker's
+:class:`~pyconsensus_tpu.serve.failover.ReplicationLog` writes (session
+meta, per-round ledger checkpoints, staged-block journal records) is
+streamed over the wire protocol to a :class:`ShippingReceiver` writing
+the standby's copy, **before the mutation is acknowledged** — the
+ack-iff-durable ordering of ``DurableSession``, extended one hop.
+
+The discipline is verify-before-adopt at BOTH ends:
+
+- the receiver recomputes the SHA-256 of every shipped record against
+  the digest in the frame and refuses a mismatch with PYC301 (the
+  sender's retry cannot fix damaged bytes — only re-reading the source
+  file can), writes through ``io.atomic_write``, and confines paths to
+  the session's directory (a hostile relpath cannot escape the root);
+- a takeover runs the full :meth:`ReplicationLog.verify` preflight over
+  the SHIPPED copy — :func:`adopt_shipped` seeds the standby's local
+  log root only from a log that verified whole, then
+  ``replay_session`` rebuilds the session bit-identical, exactly as an
+  in-process takeover would from the shared directory.
+
+The ``shipping.append`` fault site fires on every sender-side ship;
+transient ``OSError`` rides the ``retry_call`` bounded-reconnect path,
+structured refusals do not (docs/ROBUSTNESS.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pathlib
+import re
+
+from ... import obs
+from ...faults import CheckpointCorruptionError
+from ...faults import plan as _faults
+from ...faults.retry import retry_call
+from ...io import atomic_write
+from .rpc import RpcClient, RpcServer
+
+__all__ = ["ShippingReceiver", "LogShipper", "adopt_shipped"]
+
+#: the only file names a shipped record may claim — session meta, the
+#: ledger checkpoint, and journal records (the ReplicationLog layout);
+#: anything else is refused before any byte lands on disk
+_RELPATH_RE = re.compile(
+    r"^(meta\.json|ledger\.npz|staged/round_\d{6}_block_\d{6}\.npz)$")
+#: session directory names: never a pure-dot path component ("."/"..")
+_SESSION_RE = re.compile(r"^(?!\.+$)[A-Za-z0-9._~-]+$")
+
+
+def _records(kind: str) -> None:
+    obs.counter("pyconsensus_shipping_records_total",
+                "replication-log records shipped to a standby's disk",
+                labels=("kind",)).inc(kind=kind)
+
+
+class ShippingReceiver:
+    """The standby's disk: an RPC server whose single ``ship`` method
+    writes digest-verified replication records under ``root``. Hosted
+    by the fleet's :class:`~.supervisor.SocketTransport` (one receiver
+    per standby substrate in a spread deployment)."""
+
+    def __init__(self, root, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._server = RpcServer({"ship": self._ship},
+                                 name="shipping-receiver",
+                                 host=host, port=port)
+        self.host, self.port = self._server.host, self._server.port
+
+    def start(self) -> "ShippingReceiver":
+        self._server.start()
+        return self
+
+    def close(self) -> None:
+        self._server.close()
+
+    def _ship(self, params: dict) -> dict:
+        session = str(params.get("session", ""))
+        relpath = str(params.get("relpath", ""))
+        data = params.get("data")
+        if not _SESSION_RE.match(session) or not _RELPATH_RE.match(relpath):
+            raise CheckpointCorruptionError(
+                f"shipped record names a path outside the replication "
+                f"layout: session={session!r} relpath={relpath!r}",
+                session=session, relpath=relpath)
+        if not isinstance(data, (bytes, bytearray)):
+            raise CheckpointCorruptionError(
+                "shipped record carries no byte payload",
+                session=session, relpath=relpath)
+        digest = hashlib.sha256(bytes(data)).hexdigest()
+        if digest != str(params.get("digest", "")):
+            # damaged in transit or read torn at the sender: refuse —
+            # adopting it would hand the standby a record the verify
+            # preflight (or worse, the replay) chokes on later
+            raise CheckpointCorruptionError(
+                f"shipped record {session}/{relpath} digest mismatch",
+                session=session, relpath=relpath, expected=digest,
+                found=params.get("digest"))
+        path = self.root / session / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+
+        def write(tmp):
+            pathlib.Path(tmp).write_bytes(bytes(data))
+        atomic_write(path, write)
+        kind = ("staged" if relpath.startswith("staged/")
+                else relpath.split(".", 1)[0])
+        _records(kind)
+        obs.counter("pyconsensus_shipping_bytes_total",
+                    "replication-record bytes landed on the standby's "
+                    "disk").inc(len(data))
+        return {"ok": True, "bytes": len(data)}
+
+
+class LogShipper:
+    """Sender side, owned by a worker process: reads a just-committed
+    replication record back off local disk (the durable bytes, not the
+    in-memory copy — what shipped is what a local recovery would also
+    see) and streams it to the receiver. ``shipping.append`` is the
+    injection seam; transient socket errors retry with the
+    ``faults.retry`` discipline, bounded."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 retries: int = 3, label: str = "shipper") -> None:
+        self._client = RpcClient(host, port, pool=1,
+                                 timeout_s=timeout_s, label=label)
+        self.retries = int(retries)
+
+    def ship_file(self, session: str, relpath: str, path) -> None:
+        _faults.fire("shipping.append", path=path)  # consensus-lint: disable=CL802 — the injected tear must land inside the ship-before-ack critical section it tests (the caller's shipped-set bookkeeping and the ship are one atomic step)
+        data = pathlib.Path(path).read_bytes()
+        digest = hashlib.sha256(data).hexdigest()
+        retry_call(self._client.call, "ship",
+                   {"session": str(session), "relpath": str(relpath),
+                    "data": data, "digest": digest},
+                   retries=self.retries, base_delay=0.05, max_delay=1.0,
+                   retry_on=(OSError,),
+                   label=f"shipping.append:{session}")
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def adopt_shipped(shipped_root, local_root, name: str,
+                  executable_provider=None):
+    """Cross-process takeover: verify the SHIPPED copy of session
+    ``name`` whole (the :meth:`ReplicationLog.verify` preflight — a
+    standby never adopts a corrupt log, PYC301 names the offending
+    record), seed the standby's OWN log root with the verified files
+    (atomic writes; the standby journals its continued rounds there and
+    keeps shipping), and replay the session bit-identical. Returns the
+    adopted :class:`~pyconsensus_tpu.serve.failover.DurableSession`."""
+    from ..failover import ReplicationLog, replay_session
+
+    shipped = ReplicationLog(shipped_root, name)
+    shipped.verify()
+    src_dir = shipped.dir
+    dst_dir = pathlib.Path(local_root) / str(name)
+    for src in sorted(src_dir.rglob("*")):
+        if not src.is_file():
+            continue
+        rel = src.relative_to(src_dir)
+        dst = dst_dir / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        payload = src.read_bytes()
+
+        def write(tmp, payload=payload):
+            pathlib.Path(tmp).write_bytes(payload)
+        atomic_write(dst, write)
+    return replay_session(local_root, name,
+                          executable_provider=executable_provider)

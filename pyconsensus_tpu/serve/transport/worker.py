@@ -1,0 +1,313 @@
+"""``pyconsensus-fleet-worker`` — one fleet worker as a real OS process
+(ISSUE 15 tentpole b).
+
+The subprocess body behind :class:`~.supervisor.WorkerSupervisor`: a
+full ``ConsensusService`` (micro-batcher, bucket cache, AOT disk cache,
+admission) plus durable sessions, served over the socket RPC protocol.
+Every mutation follows the fleet's write ordering, extended one hop for
+the process boundary:
+
+- ``append``: journal locally (``DurableSession`` — ack-iff-durable),
+  then SHIP the new journal record to the standby's disk, then ack.
+  An acknowledged append is durable in BOTH places a takeover can read.
+- ``submit_session`` (a resolve): the round commits locally (ledger
+  checkpoint), then the checkpoint ships, then the result returns. A
+  kill between commit and ship loses only the shipped CHECKPOINT — the
+  shipped journal still carries the round's full inputs, and replay
+  re-resolves it bit-identical (the crash-before-commit path of
+  ``serve.failover``).
+- a ship failure after local durability FENCES the session (PYC301):
+  memory, local disk, and the standby's disk may never disagree about
+  an acknowledged write — the fence discipline of ``DurableSession``.
+
+The worker prints ``READY <port>`` once the RPC server listens and the
+service is warm (AOT cache consulted first — a respawned worker adopts
+persisted executables with zero retraces), and exits on SIGTERM via a
+graceful drain. SIGKILL needs no cooperation: that is the chaos suite's
+job, and the shipped log is what survives it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import sys
+import threading
+from typing import Optional, Sequence
+
+__all__ = ["FleetWorkerProcess", "main"]
+
+
+class FleetWorkerProcess:
+    """The RPC handler set around one ``ConsensusService`` (see module
+    docstring). Separated from :func:`main` so tests can run a worker
+    in-process against real sockets without a subprocess."""
+
+    def __init__(self, name: str, service, log_root,
+                 shipped_root=None, shipper=None,
+                 result_wait_s: float = 300.0) -> None:
+        self.name = str(name)
+        self.service = service
+        self.log_root = pathlib.Path(log_root)
+        self.shipped_root = (None if shipped_root is None
+                             else pathlib.Path(shipped_root))
+        self.shipper = shipper
+        self.result_wait_s = float(result_wait_s)
+        #: (session, relpath) records already shipped — staged journal
+        #: records are immutable once written, so filename identity is
+        #: enough; ledger.npz changes every round and is ALWAYS re-shipped
+        self._shipped: set = set()          # guarded-by: _ship_lock
+        self._ship_lock = threading.Lock()
+
+    # -- shipping -------------------------------------------------------
+
+    def _ship_session(self, name: str, ledger: bool) -> None:
+        """Ship every not-yet-shipped record of ``name``'s local log
+        (plus the ledger checkpoint when ``ledger``). Runs BEFORE the
+        RPC ack; a failure fences the session — an acknowledged write
+        must exist on the standby's disk, or not be acknowledged."""
+        if self.shipper is None:
+            return
+        from ..failover import ReplicationLog
+
+        log = ReplicationLog(self.log_root, name)
+        todo = []
+        with self._ship_lock:
+            for rel in ("meta.json", "ledger.npz"):
+                path = log.dir / rel
+                if not path.exists():
+                    continue
+                if rel == "ledger.npz" and ledger:
+                    todo.append((rel, path))    # re-ship every commit
+                elif (name, rel) not in self._shipped:
+                    todo.append((rel, path))
+            if log.staged_dir.exists():
+                for path in sorted(log.staged_dir.iterdir()):
+                    rel = f"staged/{path.name}"
+                    if (name, rel) not in self._shipped:
+                        todo.append((rel, path))
+            try:
+                for rel, path in todo:
+                    # the ship deliberately completes inside the
+                    # critical section: ship-before-ack is the ordering
+                    # contract, and the shipped-set must only record
+                    # what actually landed
+                    self.shipper.ship_file(name, rel, path)  # consensus-lint: disable=CL802 — ack-iff-shipped needs the ship inside the bookkeeping section
+                    self._shipped.add((name, rel))
+            except Exception as exc:    # noqa: BLE001 — any ship
+                # failure (transport, receiver refusal) fences: serving
+                # on with the standby's disk behind an acknowledged
+                # write is the divergence this class exists to prevent
+                from ...faults import CheckpointCorruptionError
+
+                fence = CheckpointCorruptionError(
+                    f"session {name!r} is fenced: replication-log "
+                    f"shipping failed ({type(exc).__name__}: {exc}) — "
+                    f"the local log is durable; re-ship and replay to "
+                    f"resume", session=name, worker=self.name)
+                try:
+                    self.service.sessions.get(name).fence(fence)
+                except Exception:   # noqa: BLE001 — fence best-effort
+                    pass
+                raise fence from exc
+
+    def _seed_shipped(self, name: str) -> None:
+        """After adopting a shipped log: every record already in the
+        local copy is, by construction, on the standby's disk too."""
+        from ..failover import ReplicationLog
+
+        log = ReplicationLog(self.log_root, name)
+        with self._ship_lock:
+            self._shipped.add((name, "meta.json"))
+            if log.staged_dir.exists():
+                for path in sorted(log.staged_dir.iterdir()):
+                    self._shipped.add((name, f"staged/{path.name}"))
+
+    # -- handlers -------------------------------------------------------
+
+    def _wait(self, params: dict) -> float:
+        return float(params.get("wait_s") or self.result_wait_s)
+
+    def ping(self, params: dict) -> dict:
+        return {"ok": True, "worker": self.name, "pid": os.getpid(),
+                "queue_depth": len(self.service.queue)}
+
+    def submit(self, params: dict) -> dict:
+        fut = self.service.submit(
+            reports=params.get("reports"),
+            event_bounds=params.get("event_bounds"),
+            reputation=params.get("reputation"),
+            tenant=str(params.get("tenant", "default")),
+            deadline_ms=params.get("deadline_ms"),
+            backend=params.get("backend"),
+            **dict(params.get("oracle_kwargs") or {}))
+        return fut.result(timeout=self._wait(params))
+
+    def submit_session(self, params: dict) -> dict:
+        name = str(params["session"])
+        fut = self.service.submit(
+            session=name, tenant=str(params.get("tenant", "default")),
+            deadline_ms=params.get("deadline_ms"),
+            **dict(params.get("oracle_kwargs") or {}))
+        result = fut.result(timeout=self._wait(params))
+        # the resolve committed the round locally; ship the checkpoint
+        # (and any journal record the commit has not yet GC'd) before
+        # the result is acknowledged
+        self._ship_session(name, ledger=True)
+        return result
+
+    def create_session(self, params: dict) -> dict:
+        from ..failover import DurableSession
+
+        kwargs = self.service.session_defaults(
+            dict(params.get("kwargs") or {}))
+        session = DurableSession.create(
+            self.log_root, str(params["name"]),
+            int(params["n_reporters"]), **kwargs)
+        self.service.sessions.add(session)
+        self._ship_session(session.name, ledger=True)
+        return {"ok": True, "worker": self.name}
+
+    def append(self, params: dict) -> dict:
+        name = str(params["session"])
+        session = self.service.sessions.get(name)
+        # the idempotency token (threaded from the router) makes a
+        # RETRIED append safe across this process's death: if the
+        # original landed in the (shipped) journal, the standby's
+        # dedupe set acknowledges without folding twice
+        total = session.append(params["block"],
+                               params.get("event_bounds"),
+                               append_id=params.get("append_id"))
+        self._ship_session(name, ledger=False)
+        return {"total_events": int(total)}
+
+    def session_state(self, params: dict) -> dict:
+        return self.service.sessions.get(str(params["name"])).state()
+
+    def adopt_session(self, params: dict) -> dict:
+        from .shipping import adopt_shipped
+
+        if self.shipped_root is None:
+            from ...faults import InputError
+
+            raise InputError(
+                f"worker {self.name!r} has no shipped-log root to "
+                f"adopt from", worker=self.name)
+        name = str(params["name"])
+        session = adopt_shipped(
+            self.shipped_root, self.log_root, name,
+            executable_provider=self.service.incremental_executable_for)
+        self.service.sessions.add(session)
+        self._seed_shipped(name)
+        return {"ok": True, "rounds_resolved": int(session.ledger.round),
+                "staged_blocks": len(session._blocks)}
+
+    def release_session(self, params: dict) -> dict:
+        name = str(params["name"])
+        self.service.sessions.remove(name)
+        # the shipped-set entries die with the session object: a later
+        # re-creation under the same name writes NEW bytes under the
+        # same filenames, and skipping their ship (stale dedup) would
+        # acknowledge writes the standby's disk never received
+        with self._ship_lock:
+            self._shipped = {(s, rel) for s, rel in self._shipped
+                             if s != name}
+        return {"ok": True}
+
+    def warm_from_disk(self, params: dict) -> dict:
+        return {"adopted": int(self.service.warm_from_disk())}
+
+    def metric(self, params: dict) -> dict:
+        from ... import obs
+
+        value = obs.value(str(params["name"]),
+                          **dict(params.get("labels") or {}))
+        return {"value": value}
+
+    def stats(self, params: dict) -> dict:
+        return {"worker": self.name, "pid": os.getpid(),
+                "queue_depth": len(self.service.queue),
+                "cache_size": len(self.service.cache),
+                "sessions": self.service.sessions.names()}
+
+    def drain(self, params: dict) -> dict:
+        self.service.close(drain=True,
+                           timeout=params.get("timeout_s", 60.0))
+        return {"ok": True}
+
+    def handlers(self) -> dict:
+        return {"ping": self.ping, "submit": self.submit,
+                "submit_session": self.submit_session,
+                "create_session": self.create_session,
+                "append": self.append,
+                "session_state": self.session_state,
+                "adopt_session": self.adopt_session,
+                "release_session": self.release_session,
+                "warm_from_disk": self.warm_from_disk,
+                "metric": self.metric, "stats": self.stats,
+                "drain": self.drain}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pyconsensus-fleet-worker",
+        description="one out-of-process consensus fleet worker: a full "
+                    "ConsensusService + replication log behind the "
+                    "socket RPC protocol (docs/SERVING.md)")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--port", type=int, default=0,
+                    help="RPC listen port (0 = OS-assigned; the chosen "
+                         "port is announced as 'READY <port>')")
+    ap.add_argument("--log-root", required=True,
+                    help="this worker's LOCAL replication-log root")
+    ap.add_argument("--shipped-root", default=None,
+                    help="the standby-side shipped-log root this worker "
+                         "adopts sessions from at takeover")
+    ap.add_argument("--ship-host", default="127.0.0.1")
+    ap.add_argument("--ship-port", type=int, default=0,
+                    help="shipping receiver port (0 disables shipping)")
+    ap.add_argument("--config-json", default=None,
+                    help="inline ServeConfig JSON")
+    ap.add_argument("--result-wait-s", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    from ..service import ConsensusService, ServeConfig
+    from .rpc import RpcServer
+    from .shipping import LogShipper
+
+    cfg = (ServeConfig.from_dict(json.loads(args.config_json))
+           if args.config_json else ServeConfig())
+    service = ConsensusService(cfg)
+    # warm BEFORE announcing readiness: with an AOT cache dir the warm
+    # adopts persisted executables (zero retraces — the cross-process
+    # warm-start medium); without one it compiles, once, before traffic
+    if cfg.aot_cache_dir:
+        service.warm_from_disk()
+    service.start(warmup=True)
+    shipper = (LogShipper(args.ship_host, args.ship_port,
+                          label=f"{args.name}-shipper")
+               if args.ship_port else None)
+    worker = FleetWorkerProcess(args.name, service, args.log_root,
+                                shipped_root=args.shipped_root,
+                                shipper=shipper,
+                                result_wait_s=args.result_wait_s)
+    server = RpcServer(worker.handlers(), name=args.name,
+                       port=args.port).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    print(f"READY {server.port}", flush=True)
+    stop.wait()
+    try:
+        service.close(drain=True, timeout=30.0)
+    finally:
+        server.close()
+        if shipper is not None:
+            shipper.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -658,7 +658,9 @@ def test_lock_order_edges_shape():
     names = set(g["locks"].values())
     # the lock-dense serve tier is represented by its known identities
     assert "MarketSession._lock" in names
-    assert "FleetWorker.declare_lock" in names
+    # the per-worker declare lock moved to the shared transport handle
+    # base (ISSUE 15) so BOTH transports' handles carry one identity
+    assert "WorkerBase.declare_lock" in names
 
 
 def test_cli_select_and_exit_codes(tmp_path, capsys):

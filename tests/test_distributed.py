@@ -18,18 +18,27 @@ from pyconsensus_tpu import Oracle
 _WORKER = pathlib.Path(__file__).resolve().parent / "distributed_worker.py"
 _WORKER4 = pathlib.Path(__file__).resolve().parent / "distributed_worker4.py"
 
-#: ISSUE 3 triage: this jaxlib's CPU client rejects cross-process
-#: computations outright ("Multiprocess computations aren't implemented
-#: on the CPU backend"), so the multi-process global-mesh story cannot
-#: execute here at all — it needs a CPU collectives (gloo)-enabled
-#: jaxlib or real multi-host hardware. strict=False: the tests PASS
-#: where the capability exists.
+#: ISSUE 15 re-triage: the "missing capability" of the ISSUE-3 triage
+#: was ONE unset knob — ``parallel.initialize`` now selects the gloo
+#: CPU collectives client before the backend initializes
+#: (``jax_cpu_collectives_implementation``; the env-var spelling alone
+#: never reached the XLA CpuClient on this jax line), so on any jaxlib
+#: that SHIPS the client these tests run and pass. The xfail survives
+#: only as a capability gate, naming the genuinely absent jaxlib
+#: feature where one is absent (``transport.multihost``). Now that
+#: they RUN (~60 s each: subprocess jax imports + five phases of
+#: cross-process collectives), they carry the ``slow`` mark — the CI
+#: rehearsal's unfiltered suite exercises them; the tier-1 wall-time
+#: budget does not.
+from pyconsensus_tpu.serve.transport.multihost import multihost_capability
+
+_MULTIHOST_REASON = multihost_capability()
 _MULTIPROC_XFAIL = pytest.mark.xfail(
-    strict=False,
-    reason="environmental: jaxlib CPU backend lacks multiprocess "
-           "computations (needs gloo CPU collectives or multi-host TPU)")
+    condition=_MULTIHOST_REASON is not None, strict=False,
+    reason=f"environmental: {_MULTIHOST_REASON}")
 
 
+@pytest.mark.slow
 @_MULTIPROC_XFAIL
 def test_four_process_global_mesh():
     """Round-5 (VERDICT r4 item 8): rendezvous, collective lockstep, and
@@ -102,6 +111,7 @@ def test_four_process_global_mesh():
                                   local_k["outcomes_adjusted"])
 
 
+@pytest.mark.slow
 @_MULTIPROC_XFAIL
 def test_two_process_global_mesh(tmp_path):
     port = free_port()

@@ -1,0 +1,225 @@
+"""The fleet contract, parametrized over BOTH transports (ISSUE 15
+acceptance): every black-box property the router guarantees — parity,
+session stickiness, durability, kill-mid-traffic takeover, structured
+shedding — holds identically whether the workers are in-process
+``ConsensusService`` instances (``InProcessTransport``, the PR-8
+fleet) or real OS processes behind the socket RPC wire
+(``SocketTransport``). The white-box fleet internals (declare-lock
+races, fence ordering, injected takeover faults) stay in
+tests/test_fleet.py against the in-process handles they poke."""
+
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.faults import (FailoverInProgressError, InputError,
+                                    PlacementError, ServiceOverloadError,
+                                    TransportError, WorkerLostError)
+from pyconsensus_tpu.serve.failover import DurableSession
+from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+from pyconsensus_tpu.serve.service import ServeConfig
+
+TRANSPORTS = ["inprocess", "socket"]
+
+
+def make_block(round_idx: int, block_idx: int) -> np.ndarray:
+    rng = np.random.default_rng([11, round_idx, block_idx])
+    block = rng.choice([0.0, 1.0], size=(10, 4))
+    block[rng.random(block.shape) < 0.1] = np.nan
+    return block
+
+
+def retried(fn, attempts=40):
+    """The polite fleet client: bounded retry on the retryable
+    taxonomy (and raw transport loss before the monitor declares)."""
+    last = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except (WorkerLostError, FailoverInProgressError,
+                TransportError, OSError) as exc:
+            last = exc
+            hint = getattr(exc, "context", {})
+            time.sleep(float(hint.get("retry_after_s", 0.25) or 0.25))
+    raise last
+
+
+@pytest.fixture(scope="module", params=TRANSPORTS)
+def fleet(request):
+    """One 2-worker fleet per transport (module-scoped: the socket
+    variant's worker processes are the expensive resource)."""
+    log_dir = tempfile.mkdtemp(prefix=f"fleet-{request.param}-")
+    f = ConsensusFleet(FleetConfig(
+        n_workers=2, transport=request.param, log_dir=log_dir,
+        monitor=True, heartbeat_timeout_s=1.5,
+        heartbeat_interval_s=0.25,
+        worker=ServeConfig(warmup=(), batch_window_ms=1.0,
+                           pallas_buckets=False))).start()
+    f._test_transport = request.param
+    yield f
+    f.close(drain=False, timeout=10.0)
+
+
+class TestFrontDoorContract:
+    def test_stateless_submit_resolves(self, fleet, rng):
+        reports = rng.choice([0.0, 1.0], size=(10, 8))
+        res = fleet.submit(reports=reports).result(timeout=120)
+        assert set(res) >= {"events", "agents", "iterations"}
+        assert np.isin(
+            np.asarray(res["events"]["outcomes_adjusted"]),
+            [0.0, 0.5, 1.0]).all()
+
+    def test_stateless_deterministic_across_workers(self, fleet, rng):
+        """The any-worker-same-bits routing freedom: repeated submits
+        of one matrix (spread over the ring) return ONE bit pattern."""
+        reports = rng.choice([0.0, 1.0], size=(10, 8))
+        futures = [fleet.submit(reports=reports) for _ in range(6)]
+        outs = [f.result(timeout=120) for f in futures]
+        for out in outs[1:]:
+            np.testing.assert_array_equal(
+                np.asarray(out["agents"]["smooth_rep"]),
+                np.asarray(outs[0]["agents"]["smooth_rep"]))
+            np.testing.assert_array_equal(
+                np.asarray(out["events"]["outcomes_adjusted"]),
+                np.asarray(outs[0]["events"]["outcomes_adjusted"]))
+
+    def test_exactly_one_of_reports_session(self, fleet):
+        with pytest.raises(InputError):
+            fleet.submit()
+        with pytest.raises(InputError):
+            fleet.submit(reports=np.zeros((4, 4)), session="x")
+
+    def test_unknown_session_refused(self, fleet):
+        with pytest.raises(InputError):
+            fleet.submit(session="never-created")
+
+
+class TestSessionContract:
+    def test_session_rounds_bit_identical_to_single_box(self, fleet,
+                                                        tmp_path):
+        """create/append/resolve through the fleet == the same traffic
+        on a lone DurableSession, bit for bit, on either transport."""
+        name = f"rounds-{fleet._test_transport}"
+        owner = fleet.create_session(name, n_reporters=10)
+        assert owner in fleet.workers
+        ref = DurableSession.create(tmp_path / "ref", name, 10)
+        for k in range(2):
+            for j in range(2):
+                n = fleet.append(name, make_block(k, j))
+                ref.append(make_block(k, j))
+                assert n == ref.n_events
+            got = fleet.submit(session=name).result(timeout=120)
+            want = ref.resolve()
+            np.testing.assert_array_equal(
+                np.asarray(got["agents"]["smooth_rep"]),
+                np.asarray(want["smooth_rep"]), err_msg=f"round {k}")
+            np.testing.assert_array_equal(
+                np.asarray(got["events"]["outcomes_adjusted"]),
+                np.asarray(want["outcomes_adjusted"]),
+                err_msg=f"round {k}")
+
+    def test_session_state_routes(self, fleet):
+        name = f"state-{fleet._test_transport}"
+        fleet.create_session(name, n_reporters=10)
+        fleet.append(name, make_block(9, 0))
+        st = fleet.session_state(name)
+        assert st["session"] == name
+        assert st["rounds_resolved"] == 0
+        assert st["staged_blocks"] == 1
+
+    def test_duplicate_session_refused(self, fleet):
+        name = f"dup-{fleet._test_transport}"
+        fleet.create_session(name, n_reporters=10)
+        with pytest.raises(InputError):
+            fleet.create_session(name, n_reporters=10)
+
+    def test_bad_append_shape_refused_structured(self, fleet):
+        name = f"shape-{fleet._test_transport}"
+        fleet.create_session(name, n_reporters=10)
+        with pytest.raises(InputError):
+            fleet.append(name, np.zeros((7, 3)))
+
+
+class TestKillMidTraffic:
+    def test_kill_worker_zero_lost_rounds(self, tmp_path, rng):
+        """The chaos contract on BOTH transports: kill the session's
+        owner mid-round; the standby adopts the (shipped) log and every
+        round resolves bit-identical to the never-killed run."""
+        for transport in TRANSPORTS:
+            fleet = ConsensusFleet(FleetConfig(
+                n_workers=3, transport=transport, monitor=True,
+                heartbeat_timeout_s=1.0, heartbeat_interval_s=0.25,
+                log_dir=str(tmp_path / f"fleet-{transport}"),
+                worker=ServeConfig(warmup=(), batch_window_ms=1.0,
+                                   pallas_buckets=False))).start()
+            try:
+                owner = fleet.create_session("m", n_reporters=10)
+                fleet.append("m", make_block(0, 0))
+                r0 = fleet.submit(session="m").result(timeout=120)
+                fleet.append("m", make_block(1, 0))   # mid-round 1
+                fleet.kill_worker(owner)
+                st = retried(lambda: fleet.session_state("m"))
+                assert st["rounds_resolved"] == 1
+                assert st["staged_blocks"] == 1       # journal survived
+                assert fleet.owner_of("m") != owner
+                r1 = retried(
+                    lambda: fleet.submit(session="m").result(120))
+
+                ref = DurableSession.create(
+                    tmp_path / f"ref-{transport}", "m", 10)
+                for k, got in enumerate((r0, r1)):
+                    ref.append(make_block(k, 0))
+                    want = ref.resolve()
+                    np.testing.assert_array_equal(
+                        np.asarray(got["agents"]["smooth_rep"]),
+                        np.asarray(want["smooth_rep"]),
+                        err_msg=f"{transport} round {k}")
+                    np.testing.assert_array_equal(
+                        np.asarray(got["events"]["outcomes_adjusted"]),
+                        np.asarray(want["outcomes_adjusted"]),
+                        err_msg=f"{transport} round {k}")
+            finally:
+                fleet.close(drain=False, timeout=10.0)
+
+    def test_all_workers_dead_is_placement_error(self, tmp_path):
+        for transport in TRANSPORTS:
+            fleet = ConsensusFleet(FleetConfig(
+                n_workers=1, transport=transport,
+                log_dir=str(tmp_path / f"dead-{transport}"),
+                worker=ServeConfig(warmup=(),
+                                   pallas_buckets=False))).start()
+            try:
+                fleet.create_session("s", n_reporters=10)
+                fleet.kill_worker("w0")
+                with pytest.raises((PlacementError, WorkerLostError,
+                                    FailoverInProgressError)):
+                    retried(lambda: fleet.submit(session="s")
+                            .result(10), attempts=3)
+            finally:
+                fleet.close(drain=False, timeout=10.0)
+
+
+class TestSheddingContract:
+    def test_draining_fleet_sheds_structured(self, tmp_path):
+        """After close(), submits shed PYC-coded on both transports
+        (never a hang, never a raw socket error)."""
+        for transport in TRANSPORTS:
+            fleet = ConsensusFleet(FleetConfig(
+                n_workers=1, transport=transport,
+                log_dir=str(tmp_path / f"drain-{transport}"),
+                worker=ServeConfig(warmup=(),
+                                   pallas_buckets=False))).start()
+            fleet.close(drain=True, timeout=30.0)
+            with pytest.raises((ServiceOverloadError, WorkerLostError)):
+                fut = fleet.submit(reports=np.zeros((4, 4)) + 1.0)
+                fut.result(timeout=30)
+
+    def test_status_shape(self, fleet):
+        status = fleet.status()
+        assert set(status) >= {"workers", "alive", "alive_slots",
+                               "sessions", "failovers"}
+        assert status["alive"] == 2
+        for w in status["workers"].values():
+            assert set(w) == {"alive", "queue_depth"}
