@@ -27,6 +27,7 @@ from .baseline import (default_baseline_path, load_baseline, match_baseline,
 from .concurrency import CONCURRENCY_RULES
 from .dataflow import DATAFLOW_RULES
 from .findings import Finding, fingerprints
+from .protocol import PROTOCOL_RULES
 from .rules import RULES, lint_paths
 
 
@@ -58,6 +59,11 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="skip the Layer 4 host-concurrency analysis "
                          "(lock-order cycles, blocking-under-lock, "
                          "guarded-by inference, fault-site drift)")
+    ap.add_argument("--no-protocol", action="store_true",
+                    help="skip the Layer 5 distributed-protocol "
+                         "analysis (durability ordering, RPC surface "
+                         "drift, error taxonomy, idempotency, "
+                         "retry scope)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default: "
                          f"{default_baseline_path()})")
@@ -93,6 +99,9 @@ def _list_rules() -> str:
     lines.append("Layer 4 (host concurrency):")
     for rid, (sev, desc) in sorted(CONCURRENCY_RULES.items()):
         lines.append(f"  {rid} [{sev:7s}] {desc}")
+    lines.append("Layer 5 (distributed protocol):")
+    for rid, (sev, desc) in sorted(PROTOCOL_RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
     return "\n".join(lines)
 
 
@@ -124,6 +133,16 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
 
         findings.extend(analyze_concurrency(args.paths or None,
                                             select=select))
+
+    # Layer 5 runs on every lint (it is pure AST work, no tracing):
+    # the durability-order walk is exactly the guard ROADMAP items 3-4
+    # churn against, so it must not hide behind --strict
+    if not args.no_protocol and (select is None
+                                 or select & PROTOCOL_RULES.keys()):
+        from .protocol import analyze_protocol
+
+        findings.extend(analyze_protocol(args.paths or None,
+                                         select=select))
 
     run_contracts_layer = (args.strict or args.contracts
                            or args.contract) and not args.no_contracts
@@ -162,6 +181,8 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
                 return True
             if entry["rule"] in CONCURRENCY_RULES and args.no_concurrency:
                 return True
+            if entry["rule"] in PROTOCOL_RULES and args.no_protocol:
+                return True
             if entry["path"] not in scanned:
                 return True
             return bool(select) and entry["rule"] not in select
@@ -197,13 +218,34 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
                 return False
             if e["rule"] in CONCURRENCY_RULES and args.no_concurrency:
                 return False
+            if e["rule"] in PROTOCOL_RULES and args.no_protocol:
+                return False
             return e["path"] in scanned and (
                 not select or e["rule"] in select)
 
         stale = [fp for fp in stale if in_scope(fp)]
 
     if args.format == "json":
+        # stable finding schema (ISSUE 16 satellite): one "findings"
+        # list covering new AND baselined entries, each row carrying its
+        # pragma/baseline state, so CI stages and bots consume a keyed
+        # record instead of scraping render() text. The legacy "new"/
+        # "baselined"/"stale_baseline" keys stay — exit codes and
+        # existing consumers are unchanged; "schema" gates evolution.
+        def _row(f: Finding, fp: str, state: str) -> dict:
+            return {"rule": f.rule, "path": f.path, "line": f.line,
+                    "severity": f.severity, "message": f.message,
+                    "snippet": f.snippet, "fingerprint": fp,
+                    "state": state}
+
         payload = {
+            "schema": 1,
+            "findings": sorted(
+                [_row(f, fp, "new")
+                 for f, fp in zip(new, fingerprints(new))]
+                + [_row(f, fp, "baselined")
+                   for f, fp in zip(matched, fingerprints(matched))],
+                key=lambda r: (r["path"], r["line"], r["rule"])),
             "new": [vars(f) | {"fingerprint": fp}
                     for f, fp in zip(new, fingerprints(new))],
             "baselined": len(matched),

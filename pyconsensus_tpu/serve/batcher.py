@@ -44,7 +44,6 @@ import time
 from collections import OrderedDict, deque
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
@@ -263,16 +262,18 @@ class Microbatcher:
                           bucket=f"{key.rows}x{key.events}",
                           topology=key.topology,
                           occupancy=len(live)):
-                stacked = [jnp.asarray(a) for a in tmpl.arrays()]
+                stacked = sk.place_bucket_operands(tmpl)
                 # pin the host→device TRANSFER complete before the
                 # template may be refilled (BucketTemplates' reuse
-                # contract): on TPU the placement can return with the
-                # copy still in flight, and the next dispatch of this
-                # key rewrites these very buffers. Blocking here waits
-                # on the transfer only — the compute below stays async
-                # (the ring's whole point). Must run BEFORE the entry
-                # call: the executable DONATES the vector buffers, so
-                # afterwards they are deleted.
+                # contract; the placement above is a guaranteed COPY —
+                # jnp.asarray can zero-copy-alias an aligned numpy
+                # buffer on CPU): on TPU the placement can return with
+                # the copy still in flight, and the next dispatch of
+                # this key rewrites these very buffers. Blocking here
+                # waits on the transfer only — the compute below stays
+                # async (the ring's whole point). Must run BEFORE the
+                # entry call: the executable DONATES the vector
+                # buffers, so afterwards they are deleted.
                 jax.block_until_ready(stacked)
                 raw = entry(*stacked, key.params)
         except BaseException as exc:  # noqa: BLE001 — EVERY waiter must

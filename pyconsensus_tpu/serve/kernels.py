@@ -450,14 +450,16 @@ class BucketTemplates:
     bucket-shaped buffers per key — batched to the key's capacity when
     it coalesces — and per dispatch only (a) restores pad defaults over
     each lane's previously-dirty extent and (b) writes the new request
-    in. The reuse contract: the dispatcher pins the host→device
-    TRANSFER complete (``jax.block_until_ready`` on the placed arrays)
-    before this template may be refilled — jax never zero-copy-aliases
-    the numpy buffers (that needs explicit dlpack), but on TPU the
-    placement can return with the copy still in flight, so blocking on
-    the transfer (not the compute) is what makes refilling under an
-    in-flight pipelined dispatch safe. Single-threaded by contract
-    (the batcher thread owns dispatch)."""
+    in. The reuse contract: dispatch places through
+    :func:`place_bucket_operands` (a GUARANTEED copy — ``jnp.asarray``
+    may zero-copy-alias a suitably-aligned numpy buffer on CPU, and an
+    aliased operand would read the NEXT request after a refill) and
+    pins the host→device TRANSFER complete (``jax.block_until_ready``
+    on the placed arrays) before this template may be refilled — on
+    TPU the placement can return with the copy still in flight, so
+    blocking on the transfer (not the compute) is what makes refilling
+    under an in-flight pipelined dispatch safe. Single-threaded by
+    contract (the batcher thread owns dispatch)."""
 
     def __init__(self, rows: int, events: int, capacity: int) -> None:
         self.rows, self.events = int(rows), int(events)
@@ -512,6 +514,17 @@ class BucketTemplates:
         """The template's field buffers, dispatch-ordered (the bucket
         executable's call signature)."""
         return self._fields
+
+
+def place_bucket_operands(tmpl: BucketTemplates) -> list:
+    """Device operands for one dispatch of ``tmpl``, DETACHED from the
+    template's host buffers. ``copy=True`` is load-bearing:
+    ``jnp.asarray`` zero-copy-aliases a numpy buffer whose allocation
+    happens to satisfy the CPU client's alignment (observed flaking by
+    alignment luck), and an aliased operand is mutated by the next
+    ``reset_lane``/``fill_lane`` — or worse, written by the executable
+    itself, which donates the vector buffers."""
+    return [jnp.array(a, copy=True) for a in tmpl.arrays()]
 
 
 #: result keys sliced on the row axis / event axis when trimming a

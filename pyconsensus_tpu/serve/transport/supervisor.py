@@ -330,7 +330,7 @@ class SocketWorkerHandle(WorkerBase):
 
     # -- introspection ---------------------------------------------------
 
-    def call(self, method: str, params: Optional[dict] = None,
+    def call(self, method: str, params: Optional[dict] = None,  # consensus-lint: disable=CL902 — deliberate escape hatch: raw RPC for tests/bench/operator tooling, not part of the Transport contract FleetWorker must mirror
              timeout_s: Optional[float] = None):
         """Raw RPC escape hatch (tests, bench, operator tooling)."""
         return self._data.call(method, params, timeout_s=timeout_s)

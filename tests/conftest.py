@@ -16,6 +16,23 @@ if "xla_force_host_platform_device_count" not in flags:
 # forced via jax.config before any backend initializes.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Persistent XLA compilation cache for the whole test session. Two
+# structural costs make the suite compile the SAME programs repeatedly:
+# the module-boundary ``jax.clear_caches()`` below (the mmap-count
+# bound) forces cross-module recompiles of every shared executable, and
+# the subprocess tests (fleet worker processes, CLI smokes, kill -9
+# workers) each compile their world from scratch. With the cache dir
+# exported — env vars, not jax.config, precisely so child processes
+# inherit it — an identical program deserializes the compiled artifact
+# instead of recompiling (numerics unchanged: it is the same
+# executable), which keeps full-suite wall time safely inside the
+# tier-1 870 s budget on a slow 1-CPU host. The 0.5 s floor keeps tiny
+# jits out of the cache (disk churn for no win).
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = "/tmp/pyconsensus-xla-cache"
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -74,6 +91,37 @@ def lock_witness(_static_lock_graph, tmp_path):
         w.uninstall()
     w.check(static=_static_lock_graph,
             dump_path=tmp_path / "lock_witness.json")
+
+
+@pytest.fixture(scope="session")
+def _static_protocol_graph():
+    """The static happens-before graph, computed once per session —
+    the reference the runtime protocol witness validates against."""
+    from pyconsensus_tpu.analysis.protocol_witness import \
+        static_protocol_graph
+
+    return static_protocol_graph()
+
+
+@pytest.fixture
+def protocol_witness(_static_protocol_graph, tmp_path):
+    """Run a test under the runtime protocol witness (ISSUE 16): the
+    durability-event order of every replicated operation the test
+    executes (journal/commit/ship, then ack) must be consistent with
+    the static CL901 happens-before graph. On violation the witness
+    JSON lands in the test's tmp_path. The durability-dense suites
+    (test_transport.py, test_fleet.py) opt in wholesale via a
+    module-level autouse fixture — the dynamic mirror of CL901, as
+    ``lock_witness`` is of CL801."""
+    from pyconsensus_tpu.analysis.protocol_witness import ProtocolWitness
+
+    w = ProtocolWitness().install()
+    try:
+        yield w
+    finally:
+        w.uninstall()
+    w.check(static=_static_protocol_graph,
+            dump_path=tmp_path / "protocol_witness.json")
 
 
 def free_port() -> int:

@@ -47,6 +47,14 @@ def _under_lock_witness(lock_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _under_protocol_witness(protocol_witness):
+    """And under the runtime protocol witness (ISSUE 16): every
+    durable-session operation's observed journal/commit/ship/ack order
+    must be consistent with the static CL901 happens-before graph."""
+    yield
+
+
 def small_fleet(tmp_path, n=3, **cfg_kwargs):
     cfg = FleetConfig(
         n_workers=n, log_dir=str(tmp_path / "log"),

@@ -175,12 +175,14 @@ class TestPadTemplates:
         np.testing.assert_array_equal(t.arrays()[4][2], np.ones(16))
 
     def test_transfer_pin_makes_reuse_safe(self):
-        """The reuse contract the batcher enforces: after
-        ``jax.block_until_ready`` on the placed arrays the template may
-        be refilled without changing the placed data. (Placement alone
-        is NOT enough — the host→device copy can still be in flight
-        when ``jnp.asarray`` returns, observed flaking on a loaded CPU
-        host; the batcher blocks on the transfer before dispatching.)"""
+        """The reuse contract the batcher enforces: placement is a
+        GUARANTEED copy (``place_bucket_operands`` — ``jnp.asarray``
+        zero-copy-aliases a numpy buffer whose allocation happens to
+        satisfy the CPU client's alignment, so the aliased template
+        read back the pad-default after a reset; this test flaked on
+        exactly that alignment luck) and the transfer is pinned
+        complete before the template may be refilled, after which the
+        placed data must be immune to lane resets and refills."""
         import jax
 
         t = sk.BucketTemplates(8, 16, 1)
@@ -188,10 +190,13 @@ class TestPadTemplates:
         m, _ = collusion_reports(g, 8, 16, liars=2)
         t.fill_lane(0, m, np.full(8, 1 / 8), np.zeros(16, bool),
                     np.zeros(16), np.ones(16), has_na=False)
-        placed = jnp.asarray(t.arrays()[0])
+        placed = sk.place_bucket_operands(t)
         jax.block_until_ready(placed)      # the batcher's transfer pin
         t.reset_lane(0)
-        np.testing.assert_array_equal(np.asarray(placed), m)
+        m2, _ = collusion_reports(g, 8, 16, liars=2)
+        t.fill_lane(0, m2, np.full(8, 1 / 8), np.zeros(16, bool),
+                    np.zeros(16), np.ones(16), has_na=False)
+        np.testing.assert_array_equal(np.asarray(placed[0]), m)
 
 
 def _flat(d, prefix=""):

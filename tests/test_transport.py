@@ -28,6 +28,17 @@ from pyconsensus_tpu.serve.transport.shipping import (LogShipper,
                                                       adopt_shipped)
 
 
+@pytest.fixture(autouse=True)
+def _under_protocol_witness(protocol_witness):
+    """Every transport test runs under the runtime protocol witness
+    (ISSUE 16): the observed durability-event order of each replicated
+    operation — journal/commit/ship, then ack — must be consistent
+    with the static CL901 happens-before graph, or the test fails with
+    the witness JSON dumped (the dynamic mirror of CL901, exactly as
+    ``lock_witness`` mirrors CL801 in test_fleet.py)."""
+    yield
+
+
 def pair():
     a, b = socket.socketpair()
     a.settimeout(10.0)
@@ -138,6 +149,32 @@ class TestWireFrames:
         with pytest.raises(TransportError) as ei:
             wire.recv_msg(b)
         assert ei.value.context["reason"] == "oversized"
+
+    def test_oversized_boundary_exact_at_limit_accepted(self):
+        """A declared length EXACTLY at max_bytes is legal (the check
+        is ``length > max_bytes``, not ``>=``) — the previous test
+        exercises the refusal only far past the bound; this pair pins
+        the boundary itself (ISSUE 16 satellite)."""
+        obj = {"k": "v" * 100}
+        _, payload = wire._pack(obj)
+        a, b = pair()
+        wire.send_msg(a, obj)
+        assert wire.recv_msg(b, max_bytes=len(payload)) == obj
+
+    def test_oversized_boundary_limit_plus_one_refused_with_context(self):
+        """One byte past the limit refuses, and the PYC601 context
+        carries the offending declared length AND the limit — what an
+        operator needs to tell a fat-but-legitimate frame (raise the
+        limit) from a corrupt length field (don't)."""
+        obj = {"k": "v" * 100}
+        _, payload = wire._pack(obj)
+        a, b = pair()
+        wire.send_msg(a, obj)
+        with pytest.raises(TransportError) as ei:
+            wire.recv_msg(b, max_bytes=len(payload) - 1)
+        assert ei.value.context["reason"] == "oversized"
+        assert ei.value.context["length"] == len(payload)
+        assert ei.value.context["limit"] == len(payload) - 1
 
     def test_refusals_counted(self):
         before = obs.value("pyconsensus_transport_refused_total",

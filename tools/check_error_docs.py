@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Error-code drift check: code vs docs/ROBUSTNESS.md (ISSUE 16).
+
+Every class in the ``faults.ERROR_CODES`` taxonomy must have a row in
+docs/ROBUSTNESS.md's error-code table carrying its stable PYC code and
+its class name, and every table row must correspond to a registered
+class — the table is what operators grep when a structured refusal
+crosses the wire, so a missing or stale row is a lie at debug time.
+The ``check_metric_docs.py`` pattern (which caught real drift at 44
+metrics), applied to the error taxonomy; the registry's internal
+soundness (registration, marshalability, retry semantics) is
+consensus-lint CL903's job — this script only pins the docs.
+
+Zero dependencies; importable — :func:`check` returns the drift lists
+so the test suite can assert on them directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+ERRORS = REPO / "pyconsensus_tpu" / "faults" / "errors.py"
+CATALOG = REPO / "docs" / "ROBUSTNESS.md"
+
+#: a catalog table row: first cell the bare PYC code, second cell the
+#: backticked class name (later cells are prose and may mention other
+#: codes/classes — only the leading pair identifies the row)
+_ROW_RE = re.compile(r"^\|\s*(PYC\d+)\s*\|\s*`(\w+)`")
+
+
+def collect_registered(errors: pathlib.Path = ERRORS) -> Dict[str, str]:
+    """{code: class name} for every class in faults/errors.py that is
+    both taxonomy-shaped (class-level ``error_code`` string) and named
+    in the ``ERROR_CODES`` registry tuple."""
+    tree = ast.parse(errors.read_text(encoding="utf-8"),
+                     filename=str(errors))
+    by_class: Dict[str, str] = {}
+    registered: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.Assign) \
+                        and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and sub.targets[0].id == "error_code" \
+                        and isinstance(sub.value, ast.Constant) \
+                        and isinstance(sub.value.value, str):
+                    by_class[node.name] = sub.value.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "ERROR_CODES" \
+                and isinstance(node.value, ast.DictComp):
+            it = node.value.generators[0].iter
+            if isinstance(it, (ast.Tuple, ast.List)):
+                registered |= {e.id for e in it.elts
+                               if isinstance(e, ast.Name)}
+    return {code: name for name, code in sorted(by_class.items())
+            if name in registered}
+
+
+def collect_documented(catalog: pathlib.Path = CATALOG) -> Dict[str, str]:
+    """{code: class name} from the error-code table rows of
+    docs/ROBUSTNESS.md."""
+    out: Dict[str, str] = {}
+    for line in catalog.read_text(encoding="utf-8").splitlines():
+        m = _ROW_RE.match(line.strip())
+        if m:
+            out[m.group(1)] = m.group(2)
+    return out
+
+
+def check() -> Tuple[List[str], List[str], List[str]]:
+    """(undocumented, unregistered, mismatched) drift lists — each
+    entry human-readable. Empty lists = green."""
+    registered = collect_registered()
+    documented = collect_documented()
+    undocumented = [f"{code} ({registered[code]})"
+                    for code in sorted(set(registered) - set(documented))]
+    unregistered = [f"{code} ({documented[code]})"
+                    for code in sorted(set(documented) - set(registered))]
+    mismatched = [f"{code}: code has {registered[code]}, docs say "
+                  f"{documented[code]}"
+                  for code in sorted(set(registered) & set(documented))
+                  if registered[code] != documented[code]]
+    return undocumented, unregistered, mismatched
+
+
+def main() -> int:
+    undocumented, unregistered, mismatched = check()
+    rel = CATALOG.relative_to(REPO)
+    for entry in undocumented:
+        print(f"DRIFT: error code {entry} is in faults.ERROR_CODES but "
+              f"has no row in {rel}")
+    for entry in unregistered:
+        print(f"DRIFT: {rel} catalogs error code {entry} but "
+              f"faults.ERROR_CODES does not register it")
+    for entry in mismatched:
+        print(f"DRIFT: class-name mismatch for {entry} ({rel})")
+    if undocumented or unregistered or mismatched:
+        return 1
+    print(f"error-code docs in sync: {len(collect_registered())} "
+          f"registered code(s) all cataloged, no dead rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

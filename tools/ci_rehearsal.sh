@@ -40,12 +40,27 @@ echo "=== Lint (consensus-lint: AST rules + contracts + deadlock pass) ==="
 # divergence taint, CL401-404) over the package, Layer 2 (collective
 # inventory / f64 / host-callback / retrace contracts, compiled on the
 # 8-virtual-device CPU mesh), Layer 3b (collective-schedule deadlock
-# detection over the ring/fused/pipeline jaxprs, CL410-413), and
+# detection over the ring/fused/pipeline jaxprs, CL410-413),
 # Layer 4 (host-concurrency: lock-order cycles, blocking-under-lock,
-# guarded-by inference, fault-site drift, CL801-805). Fails on any
-# non-baselined finding or stale baseline entry; see
-# docs/STATIC_ANALYSIS.md.
+# guarded-by inference, fault-site drift, CL801-805), and Layer 5
+# (distributed protocol: durability-order happens-before, RPC surface
+# drift, error-taxonomy soundness, idempotency threading, retry scope,
+# CL901-905). Fails on any non-baselined finding or stale baseline
+# entry; see docs/STATIC_ANALYSIS.md.
 "$PY" -m pyconsensus_tpu.analysis --strict
+# The static layers — everything Layer 5 extends — must stay under the
+# 25 s pre-push budget (ISSUE 16) so the lint remains a habit, not a
+# CI-only chore. Timed with --no-contracts: the Layer 2/3b contract
+# pass compiles real executables on the 8-virtual-device mesh, which
+# is hardware-bound and already gated for correctness by the full
+# --strict run above.
+STRICT_T0=$(date +%s)
+"$PY" -m pyconsensus_tpu.analysis --strict --no-contracts
+STRICT_ELAPSED=$(( $(date +%s) - STRICT_T0 ))
+if [ "$STRICT_ELAPSED" -ge 25 ]; then
+  echo "--strict static layers took ${STRICT_ELAPSED}s (budget: < 25 s)"; exit 1
+fi
+echo "--strict static layers wall time ${STRICT_ELAPSED}s (< 25 s budget) OK"
 "$VENV/bin/consensus-lint" --list-rules >/dev/null && echo "console script consensus-lint OK"
 
 echo "=== Layer 4 seeded violations (ISSUE 9: each must exit 1) ==="
@@ -99,8 +114,39 @@ fi
 echo "seeded blocking-under-lock -> exit 1 (CL802) OK"
 rm -rf "$L4DIR"
 
+echo "=== Layer 5 seeded durability reorder (ISSUE 16: must exit 1) ==="
+# The acceptance criterion for the distributed-protocol layer: a
+# dispatch handler that resolves its Future BEFORE the journal write
+# (the ack-before-journal reorder — an acknowledged request a crash
+# can silently lose) is planted in a throwaway file, and the --strict
+# gate must fail it under CL901 naming BOTH events, or the layer has
+# gone blind to the one ordering it exists to forbid.
+L5DIR=$(mktemp -d /tmp/ci-l5-seed-XXXX)
+cat > "$L5DIR/reorder.py" <<'SEED'
+class Worker:
+    def handlers(self):
+        return {"append": self.append}
+
+    def append(self, params):
+        self._fut.set_result(1)
+        self._log.journal_block(params["block"])
+        return {"total": 1}
+SEED
+L5OUT=$("$PY" -m pyconsensus_tpu.analysis --strict --no-contracts \
+    --select CL901 --no-baseline "$L5DIR/reorder.py" 2>&1) && {
+  echo "seeded ack-before-journal reorder NOT detected"; exit 1; }
+echo "$L5OUT" | grep -q "set_result" || {
+  echo "CL901 finding does not name the ack event"; exit 1; }
+echo "$L5OUT" | grep -q "journal_block" || {
+  echo "CL901 finding does not name the durability event"; exit 1; }
+echo "seeded ack-before-journal -> exit 1 (CL901, names both events) OK"
+rm -rf "$L5DIR"
+
 echo "=== Metric-name drift (code vs docs/OBSERVABILITY.md) ==="
 "$PY" tools/check_metric_docs.py
+
+echo "=== Error-code drift (code vs docs/ROBUSTNESS.md) ==="
+"$PY" tools/check_error_docs.py
 
 echo "=== Test suite (8-virtual-device CPU mesh) ==="
 "$PY" -m pytest tests/ -q --durations=15
@@ -546,14 +592,24 @@ echo "=== Fleet chaos smoke (ISSUE 8: kill a worker mid-traffic, zero lost resol
 # (ISSUE 9): every package lock acquisition is recorded, and the
 # observed order must come out acyclic and consistent with the static
 # CL801 may-hold-before graph, or this stage fails with the witness
-# JSON dumped to /tmp/ci-fleet-witness.json.
+# JSON dumped to /tmp/ci-fleet-witness.json. It ALSO runs under the
+# RUNTIME PROTOCOL WITNESS (ISSUE 16): every journal/commit/ship on
+# the chaos path is recorded against its enclosing replicated
+# operation, and the observed order must come out consistent with the
+# static CL901 happens-before graph — an ack that beat its durability
+# write in any real interleaving fails this stage with the witness
+# JSON at /tmp/ci-fleet-protocol-witness.json.
 "$PY" - <<'PYEOF'
 import tempfile, threading, time
 import numpy as np
 from pyconsensus_tpu.analysis.witness import LockWitness, static_lock_graph
+from pyconsensus_tpu.analysis.protocol_witness import (ProtocolWitness,
+                                                       static_protocol_graph)
 
 _static = static_lock_graph()
+_pstatic = static_protocol_graph()
 _witness = LockWitness().install()
+_pwitness = ProtocolWitness().install()
 
 from pyconsensus_tpu import Oracle, obs
 from pyconsensus_tpu.serve import (ConsensusFleet, FleetConfig,
@@ -667,6 +723,7 @@ print(f"fleet chaos (1) OK: 40/40 resolutions bit-identical through the "
       f"3 session rounds bit-identical to the single-box run across the "
       f"failover, drain clean")
 
+_pwitness.uninstall()
 _witness.uninstall()
 rep = _witness.check(static=_static,
                      dump_path="/tmp/ci-fleet-witness.json")
@@ -674,6 +731,14 @@ print(f"lock witness OK: {len(rep['edges'])} observed acquisition "
       f"edge(s) over {len(rep['locks'])} lock site(s) — acyclic and "
       f"consistent with the static CL801 graph "
       f"({len(_static['edges'])} static edges)")
+prep = _pwitness.check(static=_pstatic,
+                       dump_path="/tmp/ci-fleet-protocol-witness.json")
+acked = [r for r in prep["ops"] if r["ok"]]
+assert acked, "protocol witness observed no acked replicated operation"
+print(f"protocol witness OK: {len(acked)} acked operation(s) "
+      f"({len(prep['ops'])} total) — every observed "
+      f"journal/commit/ship/ack order consistent with the static CL901 "
+      f"happens-before graph")
 PYEOF
 "$PY" - <<'PYEOF'
 import os, signal, subprocess, sys, tempfile, time
@@ -751,7 +816,12 @@ echo "=== Multi-process fleet chaos (ISSUE 15: SIGKILL a worker PROCESS mid-traf
 # worker process is SIGKILLed under concurrent traffic, and the
 # standby adopts the SHIPPED log with zero lost resolutions, zero
 # retraces (the shared AOT cache is the cross-process warm-start
-# medium), serving bits identical to the never-killed run.
+# medium), serving bits identical to the never-killed run. The parent
+# runs under the RUNTIME PROTOCOL WITNESS (ISSUE 16): the reference
+# DurableSession's journal/commit order — the same code path the
+# workers execute in their own processes — is recorded across the real
+# cross-process chaos and checked against the static CL901
+# happens-before graph (/tmp/ci-mp-protocol-witness.json on failure).
 MPDIR=$(mktemp -d)
 "$PY" - "$MPDIR" <<'PYEOF'
 import os
@@ -761,6 +831,12 @@ import threading
 import time
 
 import numpy as np
+
+from pyconsensus_tpu.analysis.protocol_witness import (ProtocolWitness,
+                                                       static_protocol_graph)
+
+_pstatic = static_protocol_graph()
+_pwitness = ProtocolWitness().install()
 
 from pyconsensus_tpu.faults import (FailoverInProgressError,
                                     ServiceOverloadError, TransportError,
@@ -895,20 +971,26 @@ for k, got in enumerate(results):
         np.asarray(got["agents"]["smooth_rep"]),
         np.asarray(want["smooth_rep"]), err_msg=f"round {k}")
 fleet.close(drain=True)
+_pwitness.uninstall()
+prep = _pwitness.check(static=_pstatic,
+                       dump_path="/tmp/ci-mp-protocol-witness.json")
+acked = [r for r in prep["ops"] if r["ok"]]
+assert acked, "protocol witness observed no acked replicated operation"
 print(f"multi-process chaos OK: worker process {owner} SIGKILLed "
       f"mid-traffic ({served[0]} stateless requests served around the "
       f"kill), standby {new_owner} adopted the shipped log with zero "
       f"retraces, both session rounds bit-identical to the "
-      f"never-killed run")
+      f"never-killed run; protocol witness consistent over "
+      f"{len(acked)} acked op(s)")
 PYEOF
 rm -rf "$MPDIR"
-# the taint/lock layers stay green over the new transport modules
-# (shipped baseline EMPTY — the full --strict gate above already
-# covers the package; this names the check the ISSUE asks for)
+# the taint/lock/protocol layers stay green over the new transport
+# modules (shipped baseline EMPTY — the full --strict gate above
+# already covers the package; this names the check the ISSUE asks for)
 "$PY" -m pyconsensus_tpu.analysis \
-  --select CL401,CL402,CL403,CL404,CL801,CL802,CL803,CL804,CL805 \
+  --select CL401,CL402,CL403,CL404,CL801,CL802,CL803,CL804,CL805,CL901,CL902,CL903,CL904,CL905 \
   pyconsensus_tpu/serve/transport \
-  && echo "multi-process chaos lint OK: CL401-404 + CL801-805 green over serve/transport"
+  && echo "multi-process chaos lint OK: CL401-404 + CL801-805 + CL901-905 green over serve/transport"
 
 echo "=== Adversarial economy smoke (ISSUE 11: adaptive cartels through a 2-worker fleet) ==="
 # The economic-soundness acceptance criterion end to end: (1) a 3-round
