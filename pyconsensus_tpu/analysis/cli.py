@@ -26,6 +26,7 @@ from .baseline import (default_baseline_path, load_baseline, match_baseline,
                        save_baseline)
 from .concurrency import CONCURRENCY_RULES
 from .dataflow import DATAFLOW_RULES
+from .determinism import DETERMINISM_RULES, STATIC_DETERMINISM_RULES
 from .findings import Finding, fingerprints
 from .protocol import PROTOCOL_RULES
 from .rules import RULES, lint_paths
@@ -64,6 +65,12 @@ def _build_parser() -> argparse.ArgumentParser:
                          "analysis (durability ordering, RPC surface "
                          "drift, error taxonomy, idempotency, "
                          "retry scope)")
+    ap.add_argument("--no-determinism", action="store_true",
+                    help="skip the Layer 6 bit-determinism analysis "
+                         "(order/completion/host-nondeterminism taint "
+                         "into digests/journals/artifacts, float-fold "
+                         "hazards, and the CL1005 compiled-artifact "
+                         "checks inside the traced layer)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default: "
                          f"{default_baseline_path()})")
@@ -72,7 +79,8 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to accept the current tree "
                          "(keeps existing reasons)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--select", default=None, metavar="CL101,CL203",
                     help="comma-separated rule subset for Layer 1")
     ap.add_argument("--list-rules", action="store_true")
@@ -102,7 +110,74 @@ def _list_rules() -> str:
     lines.append("Layer 5 (distributed protocol):")
     for rid, (sev, desc) in sorted(PROTOCOL_RULES.items()):
         lines.append(f"  {rid} [{sev:7s}] {desc}")
+    lines.append("Layer 6 (bit determinism):")
+    for rid, (sev, desc) in sorted(DETERMINISM_RULES.items()):
+        lines.append(f"  {rid} [{sev:7s}] {desc}")
     return "\n".join(lines)
+
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def _all_rule_meta() -> dict:
+    """Every layer's {rule: (severity, description)} in one table."""
+    from .contracts import CONTRACT_RULES
+    from .schedule import SCHEDULE_RULES
+
+    meta: dict = {}
+    for table in (RULES, CONTRACT_RULES, DATAFLOW_RULES, SCHEDULE_RULES,
+                  CONCURRENCY_RULES, PROTOCOL_RULES, DETERMINISM_RULES):
+        meta.update(table)
+    return meta
+
+
+def _sarif_payload(rows) -> dict:
+    """SARIF 2.1.0 view of the finding rows (``--format sarif``): rule
+    metadata for every rule a result references, one result per finding
+    with its location, the stable fingerprint as a partialFingerprint,
+    and the pragma/baseline state mapped onto SARIF's ``baselineState``
+    vocabulary — the shape code-scanning UIs ingest directly. Exit
+    codes are the JSON format's, unchanged."""
+    meta = _all_rule_meta()
+    rule_ids = sorted({r["rule"] for r in rows})
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    rules = []
+    for rid in rule_ids:
+        sev, desc = meta.get(rid, ("warning", rid))
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": desc},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(sev, "note")},
+        })
+    results = []
+    for r in rows:
+        results.append({
+            "ruleId": r["rule"],
+            "ruleIndex": index[r["rule"]],
+            "level": _SARIF_LEVEL.get(r["severity"], "note"),
+            "message": {"text": r["message"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": r["path"]},
+                    "region": {"startLine": max(int(r["line"]), 1)},
+                }}],
+            "partialFingerprints": {"consensusLint/v1": r["fingerprint"]},
+            "baselineState": ("unchanged" if r["state"] == "baselined"
+                              else "new"),
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "consensus-lint",
+                "informationUri": "docs/STATIC_ANALYSIS.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
 
 
 def run(argv: Optional[List[str]] = None, stdout=None) -> int:
@@ -144,6 +219,17 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
         findings.extend(analyze_protocol(args.paths or None,
                                          select=select))
 
+    # Layer 6 rides every lint like Layer 5 (pure AST + the shared
+    # dataflow fixpoint): bit-determinism regressions are exactly what
+    # the replay/shipping digest contract churns against. CL1005 is the
+    # layer's compiled-artifact half and rides the traced gate below.
+    if not args.no_determinism and (select is None
+                                    or select & STATIC_DETERMINISM_RULES):
+        from .determinism import analyze_determinism
+
+        findings.extend(analyze_determinism(args.paths or None,
+                                            select=select))
+
     run_contracts_layer = (args.strict or args.contracts
                            or args.contract) and not args.no_contracts
     if run_contracts_layer:
@@ -151,7 +237,12 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
         from .schedule import run_schedules
 
         ensure_cpu_devices()
-        findings.extend(run_contracts(names=args.contract))
+        # --no-determinism also silences Layer 6's compiled-artifact
+        # half (CL1005 scatter scan + StableHLO pins) — one opt-out
+        # covers the whole layer
+        findings.extend(
+            f for f in run_contracts(names=args.contract)
+            if not (args.no_determinism and f.rule == "CL1005"))
         # Layer 3b rides the traced gate: the schedule targets need jax
         # + the virtual device mesh, same environment as the contracts.
         # --contract NAME runs are contract-focused; schedules are
@@ -174,7 +265,8 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
 
         def preserve(entry):
             if entry["path"].startswith("contract:"):
-                return not run_contracts_layer
+                return not run_contracts_layer or (
+                    args.no_determinism and entry["rule"] == "CL1005")
             if entry["path"].startswith("schedule:"):
                 return not run_schedules_layer
             if entry["rule"] in DATAFLOW_RULES and args.no_dataflow:
@@ -182,6 +274,9 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
             if entry["rule"] in CONCURRENCY_RULES and args.no_concurrency:
                 return True
             if entry["rule"] in PROTOCOL_RULES and args.no_protocol:
+                return True
+            if (entry["rule"] in STATIC_DETERMINISM_RULES
+                    and args.no_determinism):
                 return True
             if entry["path"] not in scanned:
                 return True
@@ -211,7 +306,8 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
             if e is None:
                 return True
             if e["path"].startswith("contract:"):
-                return run_contracts_layer
+                return run_contracts_layer and not (
+                    args.no_determinism and e["rule"] == "CL1005")
             if e["path"].startswith("schedule:"):
                 return run_schedules_layer
             if e["rule"] in DATAFLOW_RULES and args.no_dataflow:
@@ -220,39 +316,53 @@ def run(argv: Optional[List[str]] = None, stdout=None) -> int:
                 return False
             if e["rule"] in PROTOCOL_RULES and args.no_protocol:
                 return False
+            if (e["rule"] in STATIC_DETERMINISM_RULES
+                    and args.no_determinism):
+                return False
             return e["path"] in scanned and (
                 not select or e["rule"] in select)
 
         stale = [fp for fp in stale if in_scope(fp)]
 
-    if args.format == "json":
+    if args.format in ("json", "sarif"):
         # stable finding schema (ISSUE 16 satellite): one "findings"
         # list covering new AND baselined entries, each row carrying its
         # pragma/baseline state, so CI stages and bots consume a keyed
         # record instead of scraping render() text. The legacy "new"/
         # "baselined"/"stale_baseline" keys stay — exit codes and
         # existing consumers are unchanged; "schema" gates evolution.
+        # --format sarif re-maps the SAME rows onto SARIF 2.1.0.
         def _row(f: Finding, fp: str, state: str) -> dict:
             return {"rule": f.rule, "path": f.path, "line": f.line,
                     "severity": f.severity, "message": f.message,
                     "snippet": f.snippet, "fingerprint": fp,
                     "state": state}
 
-        payload = {
-            "schema": 1,
-            "findings": sorted(
-                [_row(f, fp, "new")
-                 for f, fp in zip(new, fingerprints(new))]
-                + [_row(f, fp, "baselined")
-                   for f, fp in zip(matched, fingerprints(matched))],
-                key=lambda r: (r["path"], r["line"], r["rule"])),
-            "new": [vars(f) | {"fingerprint": fp}
-                    for f, fp in zip(new, fingerprints(new))],
-            "baselined": len(matched),
-            "stale_baseline": stale,
-            "elapsed_s": round(time.monotonic() - t0, 2),
-        }
-        print(json.dumps(payload, indent=2), file=out)
+        rows = sorted(
+            [_row(f, fp, "new")
+             for f, fp in zip(new, fingerprints(new))]
+            + [_row(f, fp, "baselined")
+               for f, fp in zip(matched, fingerprints(matched))],
+            key=lambda r: (r["path"], r["line"], r["rule"]))
+        if args.format == "sarif":
+            # results are explicitly sorted and the SARIF envelope is a
+            # fixed literal schema
+            print(json.dumps(_sarif_payload(rows), indent=2),  # consensus-lint: disable=CL1001
+                  file=out)
+        else:
+            payload = {
+                "schema": 1,
+                "findings": rows,
+                "new": [vars(f) | {"fingerprint": fp}
+                        for f, fp in zip(new, fingerprints(new))],
+                "baselined": len(matched),
+                "stale_baseline": stale,
+                "elapsed_s": round(time.monotonic() - t0, 2),
+            }
+            # findings rows are explicitly sorted above and the payload
+            # keys are a fixed literal schema — insertion order IS the
+            # documented order
+            print(json.dumps(payload, indent=2), file=out)  # consensus-lint: disable=CL1001
     else:
         for f in new:
             print(f.render(), file=out)

@@ -22,6 +22,12 @@ over that artifact:
 - **retrace budget** (CL304): calling an entry point twice with identical
   (shape, dtype, params) must not grow the jit cache — a retrace on a
   steady-state serving path is a silent multi-second stall.
+- **run-to-run determinism** (CL1005, Layer 6's compiled-artifact half):
+  no scatter-family op (arrival-order combining) outside a contract's
+  blessed list, and the ``stablehlo_pin`` dynamic builder traces an
+  entry point twice through fresh jit wrappers and pins the StableHLO
+  modules to byte equality — two workers must compile the SAME program
+  from the same source (the fleet's bit-identity contract).
 
 A builder that raises reports CL300 (contract-trace-failure): the entry
 point could not even be traced — e.g. a host sync seeded into a jitted
@@ -76,6 +82,13 @@ _HOST_CALLBACK_RE = re.compile(
 
 _F64_RE = re.compile(r"\b(f64|c128)\[")
 
+# XLA-documented run-to-run nondeterministic op families (Layer 6 /
+# CL1005): scatter with duplicate indices combines in hardware-arrival
+# order, and select-and-scatter ties break nondeterministically on some
+# backends. `reduce-scatter` is a collective, NOT this family — the
+# leading space in the pattern keeps it out.
+_NONDET_OP_RE = re.compile(r"= [^=]*? (select-and-scatter|scatter)\(")
+
 
 # dtype token = letters, a digit, then optional alphanumerics: matches
 # f32/bf16/u32/c128 AND fp8 names (f8e4m3fn), but NOT annotation tokens
@@ -127,6 +140,21 @@ def host_callbacks(hlo_text: str) -> List[str]:
     """HLO lines that re-enter the host mid-graph."""
     return [ln.strip() for ln in hlo_text.splitlines()
             if _HOST_CALLBACK_RE.search(ln)]
+
+
+def nondeterministic_ops(hlo_text: str, blessed=()) -> List[str]:
+    """HLO lines carrying an op from the run-to-run nondeterministic
+    family (scatter / select-and-scatter — CL1005's compiled-artifact
+    half). ``blessed`` names op kinds an individual contract has
+    audited as safe (e.g. a scatter whose indices are provably unique);
+    anything else in the family is a finding. Ignores metadata-only
+    mentions, like :func:`f64_ops`."""
+    out: List[str] = []
+    for ln in hlo_text.splitlines():
+        m = _NONDET_OP_RE.search(ln.split("metadata=")[0])
+        if m and m.group(1) not in blessed:
+            out.append(ln.strip())
+    return out
 
 
 #: compare instruction whose OPERAND region names a dtype Mosaic rejects
@@ -584,6 +612,54 @@ def _builder_retrace_serve_bucket(spec: dict) -> List[Finding]:
     return findings
 
 
+def _first_divergence(a: str, b: str) -> str:
+    """First line where two artifacts differ (for the CL1005 message)."""
+    for i, (la, lb) in enumerate(zip(a.splitlines(), b.splitlines())):
+        if la != lb:
+            return (f"line {i + 1}: {la.strip()[:80]!r} vs "
+                    f"{lb.strip()[:80]!r}")
+    return (f"length {len(a)} vs {len(b)} bytes "
+            f"(common prefix identical)")
+
+
+def _builder_stablehlo_pin(spec: dict) -> List[Finding]:
+    """Dynamic check (Layer 6 / CL1005): trace the entry point TWICE
+    through fresh jit wrappers and pin the StableHLO modules to byte
+    equality. A divergence means trace-time Python fed
+    order/iteration/id()-dependent structure into the graph — the exact
+    class of bug that makes two workers compile different programs from
+    the same source and break the fleet's bit-identity contract.
+    ``spec["entry"]`` picks the registered entry point."""
+    entry = spec.get("entry", "serve_bucket")
+    texts = []
+    for _ in range(2):
+        if entry == "serve_bucket":
+            from ..serve.kernels import make_bucket_executable
+            fn = make_bucket_executable(_params(spec),
+                                        donate=bool(spec.get("donate")))
+            texts.append(fn.lower(*_serve_bucket_args(spec),
+                                  _params(spec)).as_text())
+        elif entry == "serve_bucket_incremental":
+            from ..serve.incremental import make_incremental_executable
+            fn = make_incremental_executable(_params(spec))
+            texts.append(fn.lower(*_incremental_avals(spec),
+                                  _params(spec)).as_text())
+        else:
+            return [Finding(
+                rule="CL300", path=f"contract:{spec['name']}", line=0,
+                message=f"stablehlo_pin: unknown entry {entry!r}",
+                severity="error", snippet=f"{spec['name']}:entry")]
+    if texts[0] != texts[1]:
+        return [Finding(
+            rule="CL1005", path=f"contract:{spec['name']}", line=0,
+            message=f"entry {entry!r} lowered to DIFFERENT StableHLO "
+                    f"on two fresh traces ({_first_divergence(*texts)})"
+                    f" — trace-time Python is feeding nondeterministic "
+                    f"structure into the graph",
+            severity="error", snippet=f"{spec['name']}:stablehlo")]
+    return []
+
+
 def _serve_mesh_setup(spec: dict):
     """Shared (mesh, params, batch capacity) for the sharded serve-bucket
     builders."""
@@ -746,6 +822,7 @@ BUILDERS: Dict[str, Callable] = {
     "serve_bucket_incremental": _builder_serve_bucket_incremental,
     "retrace_serve_bucket_incremental":
         _builder_retrace_serve_bucket_incremental,
+    "stablehlo_pin": _builder_stablehlo_pin,
 }
 
 
@@ -805,6 +882,17 @@ def check_artifact(name: str, hlo_text: str, spec: dict) -> List[Finding]:
                         f"compiled HLO — Mosaic rejects the lowered "
                         f"form (first: {bad[0][:120]})",
                 severity="error", snippet=f"{name}:bf16cmp"))
+    if spec.get("forbid_nondeterministic_ops", True):
+        bad = nondeterministic_ops(
+            hlo_text, blessed=tuple(
+                spec.get("blessed_nondeterministic_ops", ())))
+        if bad:
+            out.append(Finding(
+                rule="CL1005", path=path, line=0,
+                message=f"{len(bad)} run-to-run nondeterministic op(s) "
+                        f"in compiled HLO — scatter-family combines in "
+                        f"arrival order (first: {bad[0][:120]})",
+                severity="error", snippet=f"{name}:nondet"))
     if "min_donated_aliases" in spec:
         aliases = input_output_aliases(hlo_text)
         want = int(spec["min_donated_aliases"])

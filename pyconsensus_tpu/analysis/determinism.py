@@ -1,0 +1,458 @@
+"""Layer 6: bit-determinism & numerics-flow analysis (CL1001-CL1004).
+
+Every contract this codebase ships — catch-snap parity, replication-log
+replay, cross-worker takeover, the economy's ``mechanism_digest`` — is a
+*bit-identity* claim: identical inputs must reproduce identical bytes.
+Layers 1-5 guard traced-code hygiene, host divergence, locks, and
+durability order; nothing before this pass statically proves the code
+cannot feed *nondeterminism* into the digests those claims rest on. An
+unordered ``os.listdir`` sweep feeding a size sum, a ``dict.items()``
+fold assembling an npz, a completion-order ``as_completed`` collection
+folded into reputation — each replays differently on another host (or
+the same host under a different ``PYTHONHASHSEED``), and the replay
+tests only catch the interleaving that actually fired.
+
+This pass rides the Layer 3a machinery (:mod:`.dataflow`'s package
+table, call-graph fixpoint, and flow-sensitive abstract interpreter)
+with its own source/sanitizer/sink model. Taint origins are
+category-prefixed strings; the category at the sink selects the rule:
+
+- **order** (CL1001) — unordered iteration: ``dict``/``set``/
+  ``frozenset`` iteration (``.items()``/``.keys()``/``.values()``, set
+  literals/comprehensions/constructors), ``os.listdir``/``scandir``/
+  ``walk``, non-sorted ``glob``/``Path.iterdir``/``rglob``. Python
+  dicts iterate in insertion order, but the *insertion* order is
+  rarely pinned across processes, and set/str-hash order changes under
+  ``PYTHONHASHSEED`` — a digest over either is a per-run number.
+- **completion** (CL1002) — completion-order collection:
+  ``as_completed``, ``imap_unordered`` — thread/future scheduling
+  decides the fold order.
+- **hostnd** (CL1003) — host nondeterminism: ``id()``, builtin
+  ``hash()`` (str/bytes hashes are salted per process), ``time.*``
+  clocks, ``uuid.*``, unseeded host RNG (``random.*``,
+  ``numpy.random.*``, ``default_rng()`` with NO seed argument —
+  seeded constructions and the economy's ``strategy_rng`` key
+  derivation are clean by design).
+- **floatacc** (CL1004) — float-accumulation hazard: builtin ``sum()``
+  or an ``+=`` fold over an order-/completion-tainted collection.
+  Float addition is not associative: the same multiset of summands in
+  a different order is a different float, so an unordered accumulation
+  reaching reputation/ledger/digest state breaks bit-replay even when
+  every element is identical.
+
+**Sinks** — the places where a nondeterministic value becomes a
+persisted or compared artifact: digest computation (``hashlib.*``
+constructor arguments and ``.update()`` on handles built from them,
+``mechanism_digest``), replication-journal and ledger payloads
+(``journal_block``/``record_round`` arguments), npz state assembly
+(``np.savez``/``savez_compressed``), JSON artifacts
+(``json.dump``/``dumps`` WITHOUT ``sort_keys=True``), and operands of
+traced entry points (a trace-time constant derived from an unordered
+fold bakes per-run bytes into the executable).
+
+**Sanitizers** — ``sorted()`` (strips order/completion taint: a sorted
+fold is exactly the fix; host nondeterminism passes through — sorting
+a wall-clock reading does not make it reproducible), ``min``/``max``
+(order-insensitive reductions), ``strategy_rng``/seeded
+``default_rng(seed)`` (keyed RNG is the blessed randomness path), and
+``collections.OrderedDict``-by-construction (needs no special case:
+its pass-through semantics are already order-clean when its inputs
+are).
+
+CL1005 (compiled-artifact determinism) lives in :mod:`.contracts`: the
+``stablehlo_pin`` builder compiles registered entries twice in fresh
+contexts and pins StableHLO byte equality, and ``check_artifact``
+scans post-GSPMD HLO for ops XLA documents as run-to-run
+nondeterministic (the scatter-add family) outside a blessed list. The
+rule is declared here so ``--list-rules`` and the docs table keep one
+Layer 6 inventory.
+
+The runtime mirror is :mod:`.determinism_witness` (DigestWitness) —
+see its docstring. ``# consensus-lint: disable=CL100x`` line
+directives suppress in place, with the written rationale on the same
+comment (house rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .dataflow import _Analyzer, _FuncInfo, _Package, _src_line
+from .findings import Finding
+from .rules import _line_directives, scan_targets
+
+#: rule ID -> (severity, one-line description)
+DETERMINISM_RULES = {
+    "CL1001": ("error", "unordered iteration (dict/set iteration, "
+                        "os.listdir, non-sorted glob/iterdir) reaches a "
+                        "digest/journal/ledger/serialization sink — "
+                        "iteration order feeds bytes that must replay "
+                        "bit-identical; sort first"),
+    "CL1002": ("error", "completion-order collection (as_completed / "
+                        "imap_unordered) reaches a digest/journal/"
+                        "serialization sink — scheduler timing decides "
+                        "the fold order; key the fold by sequence "
+                        "instead"),
+    "CL1003": ("error", "host nondeterminism (id(), salted hash(), "
+                        "time.*, uuid.*, unseeded host RNG) reaches a "
+                        "digest/journal/serialization sink — the value "
+                        "differs per process/run; derive from a seeded "
+                        "key (strategy_rng) or drop it from the "
+                        "payload"),
+    "CL1004": ("error", "float accumulation (sum() / '+=' fold) over an "
+                        "order-tainted collection reaches reputation/"
+                        "ledger/digest state — float addition is not "
+                        "associative, so an unordered fold breaks "
+                        "bit-replay; sort the iterate or fold by "
+                        "sequence key"),
+    "CL1005": ("error", "compiled artifact is not bit-deterministic: "
+                        "double-compiled StableHLO bytes differ, or "
+                        "post-GSPMD HLO contains an XLA-documented "
+                        "run-to-run nondeterministic op (scatter-add "
+                        "family) outside the blessed list"),
+}
+
+#: rules the STATIC taint pass can emit (CL1005 is the contracts-layer
+#: compiled pass; it gates with Layer 2, not with this walk)
+STATIC_DETERMINISM_RULES = frozenset(
+    r for r in DETERMINISM_RULES if r != "CL1005")
+
+_CATEGORY_RULE = {"order": "CL1001", "completion": "CL1002",
+                  "hostnd": "CL1003", "floatacc": "CL1004"}
+
+_CATEGORY_NOUN = {
+    "order": "an unordered-iteration value",
+    "completion": "a completion-order value",
+    "hostnd": "a host-nondeterministic value",
+    "floatacc": "an order-dependent float accumulation",
+}
+
+#: canonical dotted calls yielding ORDER taint (filesystem enumeration
+#: without a pinned order)
+_ORDER_CALLS = {
+    "os.listdir", "os.scandir", "os.walk",
+    "glob.glob", "glob.iglob",
+}
+
+#: attribute-call tails yielding ORDER taint on any receiver:
+#: Path.iterdir/glob/rglob enumerate in readdir order; dict views
+#: iterate in insertion order (unpinned across processes)
+_ORDER_TAILS = {"iterdir", "glob", "rglob", "items", "keys", "values"}
+
+#: set construction — str-hash iteration order changes per process
+#: under PYTHONHASHSEED
+_SET_CTOR_TAILS = {"set", "frozenset"}
+
+#: completion-order collection
+_COMPLETION_TAILS = {"as_completed", "imap_unordered"}
+
+#: host-nondeterminism call prefixes (canonical dotted)
+_HOSTND_PREFIXES = (
+    "time.", "uuid.uuid", "random.", "numpy.random.", "secrets.",
+    "os.urandom", "os.getpid",
+)
+
+#: bare builtins whose results differ per process
+_HOSTND_BUILTINS = {"id", "hash"}
+
+#: sanitizer tails: sorted() pins the order; min/max are
+#: order-insensitive reductions; strategy_rng is the economy's seeded
+#: key-derivation path (blessed randomness)
+_ORDER_SANITIZER_TAILS = {"sorted", "min", "max"}
+_RNG_SANITIZER_TAILS = {"strategy_rng"}
+
+#: sink tails: replication-journal / ledger payload construction
+_JOURNAL_SINK_TAILS = {"journal_block", "record_round"}
+
+#: sink tails: npz state assembly
+_SAVEZ_TAILS = {"savez", "savez_compressed"}
+
+#: container mutators that fold a tainted operand into their receiver
+_MUTATOR_TAILS = {"append", "add", "extend", "update", "insert",
+                  "setdefault", "appendleft"}
+
+
+def _category(origin: Optional[str]) -> str:
+    return origin.split(":", 1)[0] if origin else ""
+
+
+class _DetAnalyzer(_Analyzer):
+    """The Layer 3a abstract interpreter with the determinism
+    source/sanitizer/sink model. State values are category-prefixed
+    origin strings (``order: d.items() at path:line``)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        #: names lexically assigned from a hashlib constructor in this
+        #: function — their ``.update(x)`` calls are digest sinks
+        self._digest_handles: Set[str] = set()
+
+    # ---- expression taint --------------------------------------------
+
+    def eval(self, node, state):
+        # set literals / comprehensions iterate in hash order
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            for child in ast.iter_child_nodes(node):
+                org = self.eval(child, state)
+                if org and _category(org) != "order":
+                    return org
+            return (f"order: set literal at "
+                    f"{self.mod.path}:{node.lineno}")
+        return super().eval(node, state)
+
+    def _origin(self, kind: str, what: str, node: ast.AST) -> str:
+        return f"{kind}: {what} at {self.mod.path}:{node.lineno}"
+
+    def _eval_call(self, node: ast.Call, state):
+        from .dataflow import _canon
+
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        arg_origins = [self.eval(a, state) for a in args]
+        tainted_arg = next((o for o in arg_origins if o), None)
+        if not isinstance(node.func, (ast.Name, ast.Attribute)):
+            tainted_arg = self.eval(node.func, state) or tainted_arg
+
+        dotted = _canon(self.mod, node.func) or ""
+        tail = dotted.split(".")[-1] if dotted else ""
+
+        # -- sanitizers -------------------------------------------------
+        if tail in _ORDER_SANITIZER_TAILS:
+            # sorted()/min()/max() pin or erase the order; host
+            # nondeterminism passes through (sorting a uuid does not
+            # make it reproducible)
+            if tainted_arg and _category(tainted_arg) in ("order",
+                                                          "completion",
+                                                          "floatacc"):
+                return None
+            return tainted_arg
+        if tail in _RNG_SANITIZER_TAILS:
+            return None
+        if dotted in ("json.dump", "json.dumps") and any(
+                kw.arg == "sort_keys" and isinstance(kw.value, ast.Constant)
+                and kw.value.value for kw in node.keywords):
+            # canonical JSON: key order is pinned regardless of the
+            # input dict's insertion/hash order — the serialization IS
+            # the sort
+            return None
+        if tail == "default_rng":
+            # seeded default_rng(seed) is the blessed reproducible RNG;
+            # default_rng() with no arguments draws OS entropy
+            if args:
+                return tainted_arg
+            return self._origin("hostnd", "unseeded default_rng()", node)
+
+        # -- sources ----------------------------------------------------
+        if dotted in _ORDER_CALLS:
+            return self._origin("order", f"{dotted}()", node)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _ORDER_TAILS:
+            return self._origin("order", f".{node.func.attr}()", node)
+        if tail in _SET_CTOR_TAILS and "." not in dotted:
+            org = tainted_arg
+            if org and _category(org) != "order":
+                return org
+            return self._origin("order", f"{tail}(...)", node)
+        if tail in _COMPLETION_TAILS:
+            return self._origin("completion", f"{tail}()", node)
+        if dotted in _HOSTND_BUILTINS:
+            return self._origin("hostnd", f"{dotted}()", node)
+        for pref in _HOSTND_PREFIXES:
+            if dotted == pref.rstrip(".") or dotted.startswith(pref):
+                return self._origin("hostnd", f"{dotted}()", node)
+
+        # -- CL1004: unordered float accumulation via builtin sum() ----
+        if dotted == "sum" and tainted_arg and \
+                _category(tainted_arg) in ("order", "completion"):
+            return (f"floatacc: sum() over {tainted_arg}")
+
+        # receiver taint flows through method-call results
+        if isinstance(node.func, ast.Attribute):
+            tainted_arg = self.eval(node.func.value, state) or tainted_arg
+
+        if self.findings is not None:
+            self._check_call_sinks(node, args, arg_origins, state)
+
+        # container-fold propagation: lst.append(v) / d.update(v) / s.add(v)
+        # with a tainted operand taints the RECEIVER name — the dominant
+        # payload-assembly idiom (append inside an items() loop)
+        if tainted_arg and isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_TAILS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id not in self._digest_handles:
+            state[node.func.value.id] = tainted_arg
+
+        callee = self.pkg.resolve(self.mod, node.func)
+        if callee is not None:
+            self._bind_params(callee, node, arg_origins)
+            if callee.returns_taint:
+                # keep the category prefix at the front so the sink can
+                # still classify the wrapped origin
+                return (f"{_category(callee.returns_taint)}: "
+                        f"{callee.fn.name}() <- {callee.returns_taint}")
+            if callee.propagates_params and tainted_arg:
+                return tainted_arg
+            return None
+        return tainted_arg                  # unresolved: pass through
+
+    # ---- sinks --------------------------------------------------------
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        sup = self.directives.get(line, set())
+        if "*" in sup or rule in sup:
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.path, line=line, message=message,
+            severity=DETERMINISM_RULES[rule][0],
+            snippet=_src_line(self.mod, node).strip()))
+
+    def _sink_hit(self, node: ast.Call, origin: str, sink: str) -> None:
+        cat = _category(origin)
+        rule = _CATEGORY_RULE.get(cat)
+        if rule is None:
+            return
+        noun = _CATEGORY_NOUN[cat]
+        fix = {"order": "sort the iterate before it reaches the sink",
+               "completion": "fold by sequence key, not completion "
+                             "order",
+               "hostnd": "derive from a seeded key or drop it from the "
+                         "payload",
+               "floatacc": "sort the iterate (or fold by sequence key) "
+                           "so the accumulation order is pinned",
+               }[cat]
+        self._emit(node, rule,
+                   f"{sink} in '{self.info.fn.name}' consumes {noun} "
+                   f"({origin}) — the bytes cannot replay "
+                   f"bit-identically; {fix}")
+
+    def _check_call_sinks(self, node: ast.Call, args, arg_origins,
+                          state) -> None:
+        from .dataflow import _canon
+
+        dotted = _canon(self.mod, node.func) or ""
+        tail = dotted.split(".")[-1] if dotted else ""
+        org = next((o for o in arg_origins if o), None)
+
+        if org:
+            if dotted.startswith("hashlib."):
+                self._sink_hit(node, org,
+                               f"digest computation '{tail}(...)'")
+                return
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "update" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in self._digest_handles:
+                self._sink_hit(node, org, "digest '.update(...)'")
+                return
+            if tail == "mechanism_digest":
+                self._sink_hit(node, org, "'mechanism_digest(...)'")
+                return
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _JOURNAL_SINK_TAILS:
+                self._sink_hit(node, org,
+                               f"replication payload "
+                               f"'.{node.func.attr}(...)'")
+                return
+            if tail in _SAVEZ_TAILS and dotted.startswith("numpy."):
+                self._sink_hit(node, org, f"npz assembly '{tail}(...)'")
+                return
+            if dotted in ("json.dump", "json.dumps"):
+                sort_keys = any(
+                    kw.arg == "sort_keys"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value
+                    for kw in node.keywords)
+                if not sort_keys:
+                    self._sink_hit(node, org,
+                                   f"JSON artifact '{dotted}(...)' "
+                                   f"(no sort_keys=True)")
+                return
+            callee = self.pkg.resolve(self.mod, node.func)
+            if callee is not None and callee.fn in callee.mod.traced:
+                self._sink_hit(node, org,
+                               f"traced-entry operand of "
+                               f"'{callee.fn.name}(...)'")
+
+    def _branch_sink(self, node: ast.AST, state) -> None:
+        # no branch sink in this layer — only evaluate the test so its
+        # side effects (walrus, call-site param binding) still happen
+        self.eval(node.test, state)
+
+    # ---- statement execution -----------------------------------------
+
+    def exec_stmt(self, st: ast.stmt, state):
+        if isinstance(st, ast.Assign):
+            # track digest handles: h = hashlib.sha256(...) makes
+            # h.update(x) a sink in this function
+            from .dataflow import _canon
+
+            if isinstance(st.value, ast.Call):
+                vdotted = _canon(self.mod, st.value.func) or ""
+                if vdotted.startswith("hashlib."):
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            self._digest_handles.add(t.id)
+        elif isinstance(st, ast.AugAssign):
+            # '+=' fold whose operand (or accumulator) carries order
+            # taint is an order-dependent accumulation (CL1004 origin)
+            vorg = self.eval(st.value, state)
+            torg = self.eval(st.target, state)
+            org = vorg or torg
+            if org and _category(org) in ("order", "completion") and \
+                    isinstance(st.op, ast.Add):
+                self._assign_target(st.target,
+                                    f"floatacc: '+=' fold over {org}",
+                                    state)
+                return state
+            self._assign_target(st.target, org, state)
+            return state
+        return super().exec_stmt(st, state)
+
+
+def _det_propagates(pkg: _Package, info: _FuncInfo) -> bool:
+    """Param-to-return reachability under the determinism model."""
+    probe = _DetAnalyzer(pkg, info, synthetic=True)
+    state = {p: "param" for p in info.params}
+    try:
+        probe.exec_block(info.fn.body, state)
+    except RecursionError:                            # pragma: no cover
+        return True
+    return probe.returned_taint is not None
+
+
+def analyze_determinism(paths=None, root=None,
+                        select: Optional[Set[str]] = None
+                        ) -> List[Finding]:
+    """Run the Layer 6 determinism taint analysis over ``paths``
+    (default: the installed package). Same driver discipline as
+    :func:`.dataflow.analyze_paths`: summaries grown to a fixpoint,
+    then one findings pass with line-directive suppression; findings
+    sorted by (path, line, rule)."""
+    files = scan_targets(paths, root)
+    pkg = _Package(files)
+
+    for _ in range(8):
+        changed = False
+        for info in pkg.infos:
+            if not info.propagates_params and _det_propagates(pkg, info):
+                info.propagates_params = True
+                changed = True
+            a = _DetAnalyzer(pkg, info)
+            a.run()
+            changed |= a.changed
+        if not changed:
+            break
+
+    findings: List[Finding] = []
+    directives = {rel: _line_directives(mod.text)
+                  for rel, mod in pkg.mods.items()}
+    for info in pkg.infos:
+        _DetAnalyzer(pkg, info, findings=findings,
+                     directives=directives.get(info.mod.path, {})).run()
+    if select is not None:
+        findings = [f for f in findings if f.rule in select]
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.message), f)
+    return sorted(uniq.values(), key=lambda f: (f.path, f.line, f.rule))

@@ -167,7 +167,11 @@ class ReputationLedger:
                 json.dumps(self.oracle_kwargs,
                            default=_json_scalar).encode(), dtype=np.uint8),
         }
-        for key, value in self.aux.items():
+        # sorted: npz members are written in dict order, so the aux
+        # insertion order would otherwise decide the checkpoint's BYTES
+        # — two workers carrying identical state must serialize
+        # identical files (the replay/shipping digest contract)
+        for key, value in sorted(self.aux.items()):
             state[f"aux__{key}"] = np.asarray(value)
         return state
 

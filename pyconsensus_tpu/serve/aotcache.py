@@ -217,7 +217,9 @@ class AotCache:
 
         try:
             now = time.time()
-            for f in self.dir.glob("*.tmp.aotx"):
+            # sorted: glob order is readdir order, which varies with
+            # directory history — keep unlink order host-independent
+            for f in sorted(self.dir.glob("*.tmp.aotx")):
                 try:
                     if now - f.stat().st_mtime > 3600.0:
                         f.unlink()
@@ -239,7 +241,8 @@ class AotCache:
         # "*.aotx" also matches mkstemp's "*.tmp.aotx" names — exclude
         # them: in-flight (or orphaned) temporaries are not cache content
         try:
-            total = sum(f.stat().st_size for f in self.dir.glob("*.aotx")
+            total = sum(f.stat().st_size
+                        for f in sorted(self.dir.glob("*.aotx"))
                         if ".tmp." not in f.name)
         except OSError:
             return

@@ -218,7 +218,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # mesh interpretability (ISSUE 6): throughput numbers mean nothing
     # without knowing how many devices served them
     stats.update(device_block(svc))
-    print(json.dumps(stats, indent=2))
+    # sort_keys: the stats JSON is a comparable artifact (metric folds
+    # feed it) — canonical key order keeps two identical runs
+    # byte-identical
+    print(json.dumps(stats, indent=2, sort_keys=True))
     if args.metrics_out:
         obs.write_prom(args.metrics_out, obs.REGISTRY)
         print(f"metrics written to {args.metrics_out}", file=sys.stderr)
@@ -252,7 +255,7 @@ def _fleet_main(args, cfg, shapes) -> int:
         fleet.close(drain=True)
     stats["transport"] = args.transport
     stats["fleet"] = status
-    print(json.dumps(stats, indent=2))
+    print(json.dumps(stats, indent=2, sort_keys=True))
     if args.metrics_out:
         obs.write_prom(args.metrics_out, obs.REGISTRY)
         print(f"metrics written to {args.metrics_out}", file=sys.stderr)

@@ -381,7 +381,9 @@ def main(argv=None) -> int:
     svc.close(drain=True)
     stats.update(device_block(svc))
     stats["kernel_paths"] = kernel_path_block() or None
-    print(json.dumps(stats, indent=2))
+    # sort_keys: metric folds feed this artifact — canonical key order
+    # keeps two identical runs byte-identical
+    print(json.dumps(stats, indent=2, sort_keys=True))
     return 0
 
 

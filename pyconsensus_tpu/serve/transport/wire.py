@@ -128,7 +128,11 @@ def _encode_obj(obj, binary: bool):
             return bytes(obj)
         return {"__b64__": base64.b64encode(bytes(obj)).decode("ascii")}
     if isinstance(obj, dict):
-        return {str(k): _encode_obj(v, binary) for k, v in obj.items()}
+        # sorted: canonical wire form — msgpack (and JSON) serialize
+        # dicts in iteration order, and the frame bytes must not
+        # depend on the sender's dict insertion history
+        return {str(k): _encode_obj(v, binary)
+                for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))}
     if isinstance(obj, (list, tuple)):
         return [_encode_obj(v, binary) for v in obj]
     return obj
@@ -142,7 +146,10 @@ def _decode_obj(obj):
             data = _decode_obj(obj["data"])
             return np.frombuffer(data, dtype=np.dtype(obj["dtype"])) \
                 .reshape([int(d) for d in obj["shape"]]).copy()
-        return {k: _decode_obj(v) for k, v in obj.items()}
+        # sorted: decoded dicts carry the same canonical key order the
+        # encoder writes, so a decode -> re-encode round trip (router
+        # relaying a worker reply) is byte-stable
+        return {k: _decode_obj(v) for k, v in sorted(obj.items())}
     if isinstance(obj, (list, tuple)):
         return [_decode_obj(v) for v in obj]
     return obj
@@ -154,7 +161,12 @@ def _pack(obj) -> tuple:
     if _msgpack is not None:
         return _CODEC_MSGPACK, _msgpack.packb(_encode_obj(obj, True),
                                               use_bin_type=True)
-    return _CODEC_JSON, json.dumps(_encode_obj(obj, False)).encode()
+    # sort_keys: canonical frame bytes — the header's SHA-256 covers
+    # the payload, so two processes packing the same logical message
+    # must produce the same bytes (dict insertion order is not part of
+    # the message)
+    return _CODEC_JSON, json.dumps(_encode_obj(obj, False),
+                                   sort_keys=True).encode()
 
 
 def _unpack(codec: int, payload: bytes):
@@ -255,8 +267,14 @@ def _json_safe(value):
     if isinstance(value, np.ndarray):
         return value.tolist()
     if isinstance(value, dict):
-        return {str(k): _json_safe(v) for k, v in value.items()}
-    if isinstance(value, (list, tuple, set)):
+        # sorted: canonical wire form, same contract as _encode_obj
+        return {str(k): _json_safe(v)
+                for k, v in sorted(value.items(), key=lambda kv: str(kv[0]))}
+    if isinstance(value, set):
+        # a set has no order at all — pick one so the marshalled error
+        # context is byte-stable across processes
+        return [_json_safe(v) for v in sorted(value, key=str)]
+    if isinstance(value, (list, tuple)):
         return [_json_safe(v) for v in value]
     if value is None or isinstance(value, (bool, int, float, str)):
         return value

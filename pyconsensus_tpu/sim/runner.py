@@ -138,7 +138,9 @@ class CheckpointedSweep:
         import time
 
         cutoff = time.time() - self._TMP_MAX_AGE_S
-        for f in self.dir.glob("tmp*.tmp*"):
+        # sorted: glob order is readdir order, which varies with
+        # directory history — keep reap order host-independent
+        for f in sorted(self.dir.glob("tmp*.tmp*")):
             try:
                 if f.stat().st_mtime < cutoff:
                     f.unlink()

@@ -50,11 +50,13 @@ def main(argv=None) -> None:
                            storage_dtype=args.storage_dtype,
                            interpret=args.interpret, path=args.cache,
                            force=args.force, repeats=args.repeats)
+    # sort_keys: the winner dicts ride through from the sweep —
+    # canonical key order keeps two identical runs byte-identical
     print(json.dumps({
         "cache": str(cache_path(args.cache)),
         "cov_tile_rows": cov,
         "resolve_block_cols": res,
-    }))
+    }, sort_keys=True))
 
 
 if __name__ == "__main__":

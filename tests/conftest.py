@@ -124,6 +124,28 @@ def protocol_witness(_static_protocol_graph, tmp_path):
             dump_path=tmp_path / "protocol_witness.json")
 
 
+@pytest.fixture
+def digest_witness(tmp_path):
+    """Run a test under the runtime digest witness (ISSUE 17): every
+    digest the test journals, records, or computes must be
+    re-derivable from the durable artifact it claims to describe —
+    journaled blocks re-read through the validating log reader, ledger
+    history records replayed from the committed checkpoint, and
+    ``mechanism_digest`` recomputed under reversed insertion order at
+    every call. On violation the witness JSON lands in the test's
+    tmp_path. The digest-dense suites (test_fleet.py, test_econ.py)
+    opt in wholesale via a module-level autouse fixture — the dynamic
+    mirror of Layer 6, as ``protocol_witness`` is of CL901."""
+    from pyconsensus_tpu.analysis.determinism_witness import DigestWitness
+
+    w = DigestWitness().install()
+    try:
+        yield w
+    finally:
+        w.uninstall()
+    w.check(dump_path=tmp_path / "digest_witness.json")
+
+
 def free_port() -> int:
     """An OS-assigned free TCP port for multi-process coordinator tests."""
     import socket

@@ -1,0 +1,621 @@
+"""consensus-lint Layer 6 (ISSUE 17): trigger/no-trigger corpus for the
+bit-determinism rules CL1001-CL1004 (unordered iteration, completion
+order, host nondeterminism, float accumulation — including the
+sanitizers and the interprocedural category threading), the CL1005
+compiled-artifact half (scatter-family HLO scan + the StableHLO
+double-trace pin over a shipped serve-bucket contract), the live
+package-is-clean invariant, the runtime DigestWitness (green over real
+durable-session operations, a tampered digest and a reordered fold both
+flagged naming the op and BOTH digests), the shuffled-directory
+bit-identical replay regression, the lint-rule docs drift checker, and
+the ``--format sarif`` output schema."""
+
+import io
+import json
+import pathlib
+import shutil
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu.analysis.cli import run as cli_run
+from pyconsensus_tpu.analysis.contracts import (_builder_stablehlo_pin,
+                                                _first_divergence,
+                                                nondeterministic_ops)
+from pyconsensus_tpu.analysis.determinism import (DETERMINISM_RULES,
+                                                  STATIC_DETERMINISM_RULES,
+                                                  analyze_determinism)
+from pyconsensus_tpu.analysis.determinism_witness import (
+    DeterminismWitnessViolation, DigestWitness, _canonical_record_digest,
+    digest_witnessed)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _det(tmp_path, **files):
+    """Write ``name -> source`` modules and run Layer 6 over the dir."""
+    for name, src in files.items():
+        (tmp_path / f"{name}.py").write_text(textwrap.dedent(src))
+    return analyze_determinism(paths=[tmp_path])
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- CL1001
+
+
+class TestUnorderedIteration:
+    def test_dict_fold_into_digest_triggers(self, tmp_path):
+        """The seeded violation of the acceptance criteria: a digest
+        folded over dict iteration order. The finding names the sink
+        AND the unordered source."""
+        fs = _det(tmp_path, m="""
+            import hashlib
+
+            def round_digest(votes):
+                h = hashlib.sha256()
+                for name, vote in votes.items():
+                    h.update(f"{name}={vote}".encode())
+                return h.hexdigest()
+            """)
+        assert _rules(fs) == ["CL1001"]
+        (f,) = fs
+        assert "digest" in f.message and ".items()" in f.message
+
+    def test_sorted_dict_fold_is_clean(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            import hashlib
+
+            def round_digest(votes):
+                h = hashlib.sha256()
+                for name, vote in sorted(votes.items()):
+                    h.update(f"{name}={vote}".encode())
+                return h.hexdigest()
+            """)
+        assert fs == []
+
+    def test_glob_into_journal_triggers_sorted_is_clean(self, tmp_path):
+        """The filesystem-enumeration direction satellite 3 fixed in
+        aotcache/sim: readdir order reaching a replication payload."""
+        fs = _det(tmp_path, bad="""
+            def ship(log, root):
+                for p in root.glob("*.npz"):
+                    log.journal_block(p.read_bytes())
+            """, ok="""
+            def ship(log, root):
+                for p in sorted(root.glob("*.npz")):
+                    log.journal_block(p.read_bytes())
+            """)
+        assert _rules(fs) == ["CL1001"]
+        assert all(f.path.endswith("bad.py") for f in fs)
+
+    def test_set_iteration_into_digest_triggers(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            import hashlib
+
+            def digest(names):
+                h = hashlib.sha256()
+                for n in {x.strip() for x in names}:
+                    h.update(n.encode())
+                return h.hexdigest()
+            """)
+        assert _rules(fs) == ["CL1001"]
+
+    def test_json_without_sort_keys_triggers_canonical_is_clean(
+            self, tmp_path):
+        fs = _det(tmp_path, bad="""
+            import json
+
+            def artifact(stats):
+                rows = [v for v in stats.values()]
+                return json.dumps(rows)
+            """, ok="""
+            import json
+
+            def artifact(stats):
+                rows = [v for v in stats.values()]
+                return json.dumps(rows, sort_keys=True)
+            """)
+        assert _rules(fs) == ["CL1001"]
+        assert all(f.path.endswith("bad.py") for f in fs)
+        assert "sort_keys" in fs[0].message
+
+    def test_interprocedural_category_threads_through_helper(
+            self, tmp_path):
+        """The helper RETURNS the unordered value; the caller digests
+        it. The category must survive the summary round trip."""
+        fs = _det(tmp_path, m="""
+            import hashlib
+
+            def collect(stats):
+                out = []
+                for k, v in stats.items():
+                    out.append(f"{k}={v}")
+                return out
+
+            def digest(stats):
+                h = hashlib.sha256()
+                for row in collect(stats):
+                    h.update(row.encode())
+                return h.hexdigest()
+            """)
+        assert any(f.rule == "CL1001" and "digest" in f.message
+                   for f in fs)
+
+    def test_pragma_with_rationale_suppresses(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            import hashlib
+
+            def round_digest(votes):
+                h = hashlib.sha256()
+                for name, vote in votes.items():
+                    # fixed field set; order never reaches the bytes
+                    h.update(name.encode())  # consensus-lint: disable=CL1001
+                return h.hexdigest()
+            """)
+        assert fs == []
+
+
+# ------------------------------------------------------------- CL1002
+
+
+class TestCompletionOrder:
+    def test_as_completed_fold_into_digest_triggers(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            import hashlib
+            from concurrent.futures import as_completed
+
+            def digest(futures):
+                h = hashlib.sha256()
+                for fut in as_completed(futures):
+                    h.update(fut.result())
+                return h.hexdigest()
+            """)
+        assert _rules(fs) == ["CL1002"]
+        assert "as_completed" in fs[0].message
+
+    def test_sequence_keyed_fold_is_clean(self, tmp_path):
+        """The fix the rule text prescribes: collect by completion,
+        fold by sequence key."""
+        fs = _det(tmp_path, m="""
+            import hashlib
+            from concurrent.futures import as_completed
+
+            def digest(futures):
+                pairs = []
+                for fut in as_completed(futures):
+                    pairs.append((futures[fut], fut.result()))
+                h = hashlib.sha256()
+                for key, payload in sorted(pairs):
+                    h.update(payload)
+                return h.hexdigest()
+            """)
+        assert fs == []
+
+
+# ------------------------------------------------------------- CL1003
+
+
+class TestHostNondeterminism:
+    def test_wallclock_into_journal_triggers(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            import time
+
+            def stamp(log, block):
+                log.journal_block({"t": time.time(), "block": block})
+            """)
+        assert _rules(fs) == ["CL1003"]
+        assert "time.time()" in fs[0].message
+
+    def test_id_into_digest_triggers(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            import hashlib
+
+            def digest(obj):
+                return hashlib.sha256(str(id(obj)).encode()).hexdigest()
+            """)
+        assert _rules(fs) == ["CL1003"]
+
+    def test_seeded_rng_is_clean_unseeded_triggers(self, tmp_path):
+        fs = _det(tmp_path, bad="""
+            import numpy as np
+
+            def record(ledger, result):
+                rng = np.random.default_rng()
+                ledger.record_round({"jitter": rng.random(), **result})
+            """, ok="""
+            import numpy as np
+
+            def record(ledger, result, seed):
+                rng = np.random.default_rng(seed)
+                ledger.record_round({"jitter": rng.random(), **result})
+            """)
+        assert _rules(fs) == ["CL1003"]
+        assert all(f.path.endswith("bad.py") for f in fs)
+
+
+# ------------------------------------------------------------- CL1004
+
+
+class TestFloatAccumulation:
+    def test_augassign_fold_over_values_triggers(self, tmp_path):
+        fs = _det(tmp_path, m="""
+            def record(ledger, stakes, result):
+                total = 0.0
+                for s in stakes.values():
+                    total += s
+                ledger.record_round({"total": total, **result})
+            """)
+        assert _rules(fs) == ["CL1004"]
+        assert "+=" in fs[0].message
+
+    def test_sum_over_unordered_triggers_sorted_is_clean(self, tmp_path):
+        fs = _det(tmp_path, bad="""
+            def record(ledger, stakes, result):
+                total = sum(stakes.values())
+                ledger.record_round({"total": total, **result})
+            """, ok="""
+            def record(ledger, stakes, result):
+                total = sum(sorted(stakes.values()))
+                ledger.record_round({"total": total, **result})
+            """)
+        assert _rules(fs) == ["CL1004"]
+        assert all(f.path.endswith("bad.py") for f in fs)
+
+
+# ------------------------------------------------- registry + package
+
+
+class TestLayerSurface:
+    def test_rules_registered(self):
+        assert set(DETERMINISM_RULES) == {"CL1001", "CL1002", "CL1003",
+                                          "CL1004", "CL1005"}
+        assert all(sev == "error"
+                   for sev, _ in DETERMINISM_RULES.values())
+        assert STATIC_DETERMINISM_RULES == \
+            frozenset({"CL1001", "CL1002", "CL1003", "CL1004"})
+
+    def test_package_is_clean(self):
+        """The shipped baseline stays EMPTY: Layer 6 over the installed
+        package — every real first-run finding was fixed (ledger aux
+        sort, canonical wire encoding, sort_keys artifacts, sorted
+        filesystem sweeps) or pragma'd with rationale in place."""
+        fs = analyze_determinism()
+        assert fs == [], [f.render() for f in fs]
+
+
+# ------------------------------------------------------------- CL1005
+
+
+class TestCompiledArtifact:
+    SCATTER = ("  %sc.1 = f32[8]{0} scatter(f32[8]{0} %p, s32[2]{0} %i, "
+               "f32[2]{0} %u), to_apply=%add")
+    SELECT = ("  %ss.1 = f32[4]{0} select-and-scatter(f32[8]{0} %o, "
+              "f32[4]{0} %s, f32[] %z), select=%ge, scatter=%add")
+    REDUCE_SCATTER = ("  %rs.1 = f32[4]{0} reduce-scatter(f32[8]{0} %p), "
+                      "replica_groups={{0,1}}, dimensions={0}")
+
+    def test_scatter_family_flagged(self):
+        hlo = "\n".join(["HloModule m", self.SCATTER, self.SELECT])
+        hits = nondeterministic_ops(hlo)
+        assert len(hits) == 2
+        assert any("select-and-scatter" in h for h in hits)
+
+    def test_reduce_scatter_is_not_in_the_family(self):
+        """``reduce-scatter`` is a deterministic collective that merely
+        contains the substring — the leading-space anchor excludes it."""
+        assert nondeterministic_ops(
+            "\n".join(["HloModule m", self.REDUCE_SCATTER])) == []
+
+    def test_blessed_list_suppresses(self):
+        hlo = "\n".join(["HloModule m", self.SCATTER])
+        assert nondeterministic_ops(hlo, blessed=("scatter",)) == []
+        assert nondeterministic_ops(hlo, blessed=("select-and-scatter",))
+
+    def test_metadata_mention_ignored(self):
+        line = ('  %c.1 = f32[8]{0} copy(f32[8]{0} %p), '
+                'metadata={op_name="jit(f)/scatter(x)"}')
+        assert nondeterministic_ops("\n".join(["HloModule m", line])) == []
+
+    def test_first_divergence_names_the_line(self):
+        msg = _first_divergence("a\nb\nc", "a\nX\nc")
+        assert msg.startswith("line 2:") and "'b'" in msg and "'X'" in msg
+
+    def test_stablehlo_pin_green_on_shipped_contract(self):
+        """The dynamic half on a real shipped spec: serve_bucket traced
+        twice must lower to byte-identical StableHLO."""
+        specs = json.loads(
+            (REPO / "pyconsensus_tpu" / "analysis" /
+             "contracts.json").read_text())["contracts"]
+        spec = next(s for s in specs
+                    if s["name"] == "serve-bucket-stablehlo-pin")
+        assert _builder_stablehlo_pin(spec) == []
+
+    def test_unknown_entry_is_a_loud_cl300(self):
+        fs = _builder_stablehlo_pin({"name": "x", "entry": "nope"})
+        assert [f.rule for f in fs] == ["CL300"]
+
+
+# ------------------------------------------------------------ witness
+
+
+class TestDigestWitness:
+    def _session(self, root, name="dw", n=6):
+        from pyconsensus_tpu.serve.failover import DurableSession
+
+        return DurableSession.create(root, name, n)
+
+    def _run_rounds(self, w, root, rounds=2):
+        rng = np.random.default_rng(0)
+        s = self._session(root)
+        for _ in range(rounds):
+            s.append(rng.choice([0.0, 1.0], size=(6, 4)))
+            s.append(rng.choice([0.0, 1.0], size=(6, 4)))
+            s.resolve()
+        return s
+
+    def test_green_over_real_session_ops(self, tmp_path):
+        """Real journal/commit/record traffic: every digest replays
+        bit-identical from the durable artifacts at check()."""
+        with digest_witnessed(
+                dump_path=tmp_path / "dw.json") as w:
+            self._run_rounds(w, tmp_path / "log")
+        rep = w.check()
+        ops = {r["op"] for r in rep["records"]}
+        assert {"journal_block", "commit_round",
+                "record_round"} <= ops
+        assert rep["checked"] >= 3 and rep["recorded"] >= 6
+
+    def test_tampered_commit_digest_is_flagged(self, tmp_path):
+        """The divergence direction: corrupt ONE recorded history
+        digest — check() must name the op and BOTH digests."""
+        w = DigestWitness().install()
+        try:
+            self._run_rounds(w, tmp_path / "log")
+        finally:
+            w.uninstall()
+        victim = next(r for r in reversed(w.records)
+                      if r["op"] == "commit_round")
+        real = victim["digests"][0]
+        victim["digests"][0] = "0" * 64
+        with pytest.raises(DeterminismWitnessViolation) as ei:
+            w.check(dump_path=tmp_path / "viol.json")
+        assert ei.value.op.startswith("commit_round[")
+        assert ei.value.recorded == "0" * 64
+        assert ei.value.replayed == real
+        assert pathlib.Path(ei.value.dump_path).exists()
+
+    def test_tampered_journal_digest_is_flagged(self, tmp_path):
+        w = DigestWitness().install()
+        try:
+            rng = np.random.default_rng(1)
+            s = self._session(tmp_path / "log")
+            s.append(rng.choice([0.0, 1.0], size=(6, 4)))
+            # no resolve: the staged block survives round GC
+        finally:
+            w.uninstall()
+        victim = next(r for r in w.records
+                      if r["op"] == "journal_block")
+        victim["digest"] = "f" * 64
+        with pytest.raises(DeterminismWitnessViolation) as ei:
+            w.check(dump_path=tmp_path / "viol.json")
+        assert ei.value.op.startswith("journal_block[")
+        assert ei.value.recorded == "f" * 64
+
+    def test_reordered_fold_mock_is_flagged_at_the_call_site(self):
+        """The seeded mock of the acceptance criteria: an
+        insertion-order-dependent mechanism_digest stand-in must raise
+        AT THE CALL under the witness, naming both digests."""
+        import hashlib
+
+        def broken(final_reps):
+            h = hashlib.sha256()
+            for k, v in final_reps.items():   # the reordered fold
+                h.update(f"{k}={v}".encode())
+            return h.hexdigest()
+
+        w = DigestWitness()
+        wrapped = w._wrap_mechanism_digest(broken)
+        with pytest.raises(DeterminismWitnessViolation) as ei:
+            wrapped({"a": 1.0, "b": 2.0})
+        assert ei.value.op == "mechanism_digest"
+        assert ei.value.recorded != ei.value.replayed
+        assert len(ei.value.recorded) == 64
+
+    def test_real_mechanism_digest_is_order_invariant(self):
+        from pyconsensus_tpu.econ import scoreboard
+
+        with digest_witnessed() as w:
+            d = scoreboard.mechanism_digest(
+                {"m1": np.float64(0.25), "m0": np.float64(0.75)})
+        assert len(d) == 64
+        assert any(r["op"] == "mechanism_digest" for r in w.records)
+
+    def test_torn_down_artifacts_are_skipped_not_flagged(self, tmp_path):
+        """A test that removes its log dir (teardown, corruption tests)
+        leaves unreplayable records — skipped, never a violation."""
+        w = DigestWitness().install()
+        try:
+            self._run_rounds(w, tmp_path / "log")
+        finally:
+            w.uninstall()
+        shutil.rmtree(tmp_path / "log")
+        rep = w.check()
+        assert rep["checked"] == 0 and rep["skipped"] >= 3
+
+    def test_uninstall_restores_surfaces(self):
+        from pyconsensus_tpu.econ import scoreboard
+        from pyconsensus_tpu.serve.failover import ReplicationLog
+
+        real_j = ReplicationLog.journal_block
+        real_m = scoreboard.mechanism_digest
+        w = DigestWitness().install()
+        assert ReplicationLog.journal_block is not real_j
+        assert scoreboard.mechanism_digest is not real_m
+        w.uninstall()
+        assert ReplicationLog.journal_block is real_j
+        assert scoreboard.mechanism_digest is real_m
+
+    def test_canonical_record_digest_is_key_order_free(self):
+        a = {"round": 1, "certainty": 0.5}
+        b = {"certainty": 0.5, "round": 1}
+        assert _canonical_record_digest(a) == _canonical_record_digest(b)
+
+
+# -------------------------------------- shuffled-directory replay
+
+
+class TestShuffledDirectoryReplay:
+    def test_replay_is_bit_identical_under_shuffled_readdir(
+            self, tmp_path):
+        """The satellite-3 regression: clone a live log by copying its
+        files in a SHUFFLED creation order (perturbing readdir order,
+        which tracks directory insertion history) — takeover replay and
+        resolve must produce bit-identical outcomes and reputation."""
+        from pyconsensus_tpu.serve.failover import (DurableSession,
+                                                    replay_session)
+
+        rng = np.random.default_rng(7)
+        src = DurableSession.create(tmp_path / "a", "shuf", 6)
+        src.append(rng.choice([0.0, 1.0], size=(6, 4)))
+        src.append(rng.choice([0.0, 1.0], size=(6, 4)))
+        src.resolve()
+        src.append(rng.choice([0.0, 1.0], size=(6, 4)))
+
+        files = sorted((tmp_path / "a" / "shuf").rglob("*"))
+        order = np.random.default_rng(11).permutation(len(files))
+        for i in order:
+            f = files[int(i)]
+            dst = tmp_path / "b" / "shuf" / f.relative_to(
+                tmp_path / "a" / "shuf")
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            if f.is_file():
+                dst.write_bytes(f.read_bytes())
+
+        twin = replay_session(tmp_path / "b", "shuf")
+        block = rng.choice([0.0, 1.0], size=(6, 4))
+        src.append(block.copy())
+        twin.append(block.copy())
+        got, want = twin.resolve(), src.resolve()
+        np.testing.assert_array_equal(
+            np.asarray(got["outcomes_adjusted"]),
+            np.asarray(want["outcomes_adjusted"]))
+        np.testing.assert_array_equal(
+            np.asarray(got["smooth_rep"]),
+            np.asarray(want["smooth_rep"]))
+
+
+# ------------------------------------------------ lint-rule docs pin
+
+
+class TestLintDocs:
+    def _tool(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            import check_lint_docs
+        finally:
+            sys.path.pop(0)
+        return check_lint_docs
+
+    def test_live_tree_in_sync(self):
+        undocumented, unimplemented, sev_drift = self._tool().check()
+        assert undocumented == [], undocumented
+        assert unimplemented == [], unimplemented
+        assert sev_drift == [], sev_drift
+        assert len(self._tool().collect_implemented()) >= 30
+
+    def test_detects_drift_directions(self, tmp_path):
+        tool = self._tool()
+        doc = tmp_path / "SA.md"
+        doc.write_text(
+            "| CL101 | warning | severity drifted |\n"
+            "prose mentioning CL9998 which no table implements\n")
+        mentioned, table_sev = tool.collect_documented(doc)
+        implemented = tool.collect_implemented()
+        assert "CL9998" in mentioned - set(implemented)
+        assert table_sev["CL101"] == "warning"
+        assert implemented["CL101"] == "error"   # i.e. drift detectable
+
+
+# --------------------------------------------------- --format sarif
+
+
+class TestSarifOutput:
+    CORPUS = """
+        import hashlib
+
+        def round_digest(votes):
+            h = hashlib.sha256()
+            for name, vote in votes.items():
+                h.update(f"{name}={vote}".encode())
+            return h.hexdigest()
+        """
+
+    def _run(self, args):
+        buf = io.StringIO()
+        code = cli_run(args, stdout=buf)
+        return code, buf.getvalue()
+
+    def test_schema_and_exit_code(self, tmp_path):
+        target = tmp_path / "m.py"
+        target.write_text(textwrap.dedent(self.CORPUS))
+        code, out = self._run(["--format", "sarif", "--no-baseline",
+                               "--select", "CL1001", str(target)])
+        assert code == 1
+        doc = json.loads(out)
+        assert doc["version"] == "2.1.0"
+        assert "sarif" in doc["$schema"]
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "consensus-lint"
+        rule_ids = [r["id"] for r in driver["rules"]]
+        assert rule_ids == ["CL1001"]
+        (res,) = run["results"]
+        assert res["ruleId"] == "CL1001"
+        assert rule_ids[res["ruleIndex"]] == "CL1001"
+        assert res["level"] == "error"
+        assert res["baselineState"] == "new"
+        assert "consensusLint/v1" in res["partialFingerprints"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("m.py")
+        assert loc["region"]["startLine"] >= 1
+        assert "unordered-iteration" in res["message"]["text"]
+
+    def test_baselined_state_and_exit_zero(self, tmp_path):
+        target = tmp_path / "m.py"
+        target.write_text(textwrap.dedent(self.CORPUS))
+        baseline = tmp_path / "baseline.json"
+        code, _ = self._run(["--update-baseline", "--baseline",
+                             str(baseline), "--select", "CL1001",
+                             str(target)])
+        assert code == 0
+        code, out = self._run(["--format", "sarif", "--baseline",
+                               str(baseline), "--select", "CL1001",
+                               str(target)])
+        assert code == 0
+        (res,) = json.loads(out)["runs"][0]["results"]
+        assert res["baselineState"] == "unchanged"
+
+    def test_clean_tree_empty_results(self, tmp_path):
+        target = tmp_path / "m.py"
+        target.write_text("def ok():\n    return 1\n")
+        code, out = self._run(["--format", "sarif", "--no-baseline",
+                               str(target)])
+        assert code == 0
+        run = json.loads(out)["runs"][0]
+        assert run["results"] == [] and run["tool"]["driver"]["rules"] == []
+
+    def test_no_determinism_excludes_layer6(self, tmp_path):
+        """The opt-out: the same corpus under --no-determinism exits 0
+        with zero findings (CL1005 contract findings are filtered the
+        same way — exercised by the cli preserve/in_scope paths)."""
+        target = tmp_path / "m.py"
+        target.write_text(textwrap.dedent(self.CORPUS))
+        code, out = self._run(["--format", "json", "--no-baseline",
+                               "--no-determinism", str(target)])
+        assert code == 0
+        assert json.loads(out)["findings"] == []

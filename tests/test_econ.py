@@ -41,6 +41,15 @@ def _under_lock_witness(lock_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _under_digest_witness(digest_witness):
+    """And under the runtime digest witness (ISSUE 17): every ledger
+    round and mechanism digest the economy produces must replay
+    bit-identical from the durable artifact / under reordered input —
+    the dynamic mirror of Layer 6's bit-determinism proof."""
+    yield
+
+
 def _ctx(strategy="camouflage", market="m-0", round_idx=0, R=12,
          n_cartel=4, rep=None, seed=0):
     cartel = tuple(range(R - n_cartel, R))

@@ -55,6 +55,15 @@ def _under_protocol_witness(protocol_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _under_digest_witness(digest_witness):
+    """And under the runtime digest witness (ISSUE 17): every digest a
+    fleet test journals or records must replay bit-identical from the
+    durable artifact — the dynamic mirror of Layer 6's bit-determinism
+    proof."""
+    yield
+
+
 def small_fleet(tmp_path, n=3, **cfg_kwargs):
     cfg = FleetConfig(
         n_workers=n, log_dir=str(tmp_path / "log"),

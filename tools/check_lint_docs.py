@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Lint-rule drift check: code vs docs/STATIC_ANALYSIS.md (ISSUE 17).
+
+Every rule ID consensus-lint can emit (the union of the seven rule
+tables behind ``--list-rules``) must appear in docs/STATIC_ANALYSIS.md,
+and every ``CLxxx`` the doc mentions must be a rule the linter actually
+implements. Additionally, wherever the doc carries a catalog table row
+of the form ``| CL101 | error | ... |``, the severity column must match
+the code's severity for that rule. Layers 1-6 each grew both sides by
+hand; this script is what CI trusts instead (tools/ci_rehearsal.sh runs
+it, and tests/test_determinism.py pins the live tree clean).
+
+Importable — :func:`check` returns the drift lists so the test suite
+can assert on them directly.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+DOC = REPO / "docs" / "STATIC_ANALYSIS.md"
+
+#: any full rule ID mentioned anywhere in the doc — prose counts:
+#: CL300-306 are documented in running text, not a table. Shorthand
+#: like "CL80x" deliberately does not match: each rule must be spelled
+#: out in full somewhere so a grep for an emitted ID finds its docs.
+_ID_RE = re.compile(r"\bCL\d{3,4}\b")
+
+#: a catalog table row whose second cell is the severity
+_ROW_RE = re.compile(r"^\|\s*(CL\d{3,4})\s*\|\s*(\w+)\s*\|")
+
+
+def collect_implemented() -> Dict[str, str]:
+    """{rule ID: severity} for every rule the linter can emit — the
+    same seven tables ``--list-rules`` prints."""
+    sys.path.insert(0, str(REPO))
+    from pyconsensus_tpu.analysis.cli import _all_rule_meta
+
+    return {rid: sev for rid, (sev, _desc) in _all_rule_meta().items()}
+
+
+def collect_documented(doc: pathlib.Path = DOC
+                       ) -> Tuple[Set[str], Dict[str, str]]:
+    """(all rule IDs mentioned, {rule ID: severity} for table rows)."""
+    mentioned: Set[str] = set()
+    table_sev: Dict[str, str] = {}
+    for line in doc.read_text(encoding="utf-8").splitlines():
+        mentioned.update(_ID_RE.findall(line))
+        m = _ROW_RE.match(line.strip())
+        if m:
+            table_sev[m.group(1)] = m.group(2)
+    return mentioned, table_sev
+
+
+def check() -> Tuple[List[str], List[str], List[str]]:
+    """(undocumented, unimplemented, severity-drift). Empty = green."""
+    implemented = collect_implemented()
+    mentioned, table_sev = collect_documented()
+    undocumented = sorted(set(implemented) - mentioned)
+    unimplemented = sorted(mentioned - set(implemented))
+    sev_drift = sorted(
+        rid for rid, sev in table_sev.items()
+        if rid in implemented and sev != implemented[rid])
+    return undocumented, unimplemented, sev_drift
+
+
+def main() -> int:
+    undocumented, unimplemented, sev_drift = check()
+    rel = DOC.relative_to(REPO)
+    for rid in undocumented:
+        print(f"DRIFT: rule {rid} is implemented (--list-rules) but "
+              f"never mentioned in {rel}")
+    for rid in unimplemented:
+        print(f"DRIFT: {rel} mentions {rid} but no rule table "
+              f"implements it")
+    for rid in sev_drift:
+        print(f"DRIFT: {rel} catalogs {rid} with a severity different "
+              f"from the implementation's")
+    if undocumented or unimplemented or sev_drift:
+        return 1
+    implemented = collect_implemented()
+    print(f"lint-rule docs in sync: {len(implemented)} implemented "
+          f"rule(s) all documented, no phantom IDs, table severities "
+          f"match")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
