@@ -244,6 +244,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="stateless requests per transport in the "
                          "multiproc throughput comparison")
     ap.add_argument("--multiproc-workers", type=int, default=2)
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="skip the fail-soft telemetry block (ISSUE 18:"
+                         " merged cross-process metric aggregation over "
+                         "a 2-worker socket fleet, wire-propagated "
+                         "trace reconstruction, windowed SLO violation "
+                         "accounting under a deliberately tight target "
+                         "— spawns real worker processes)")
+    ap.add_argument("--telemetry-requests", type=int, default=16,
+                    help="stateless requests driven through the "
+                         "telemetry probe's socket fleet")
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fail-soft fleet chaos probe (worker "
                          "kill mid-traffic + session failover, appended "
@@ -526,6 +536,7 @@ def run_bench(args) -> None:
     out_json["cold_start"] = _cold_start_block(args)
     out_json["fleet"] = _fleet_block(args)
     out_json["multiproc"] = _multiproc_block(args)
+    out_json["telemetry"] = _telemetry_block(args)
     out_json["economy"] = _economy_block(args)
     print(json.dumps(out_json))
 
@@ -1441,6 +1452,113 @@ def _multiproc_block(args):
         return None
 
 
+def _telemetry_block(args):
+    """ISSUE 18 tentpole: the fleet telemetry plane measured END TO
+    END over a real 2-worker socket fleet — merged cross-process
+    metric aggregation (every worker's registry under a ``worker``
+    label, per-worker request counters summing to the client-observed
+    total), wire-propagated tracing (the merged span forest must
+    contain router-rooted traces whose descendants ran in a WORKER
+    process), and the windowed SLO monitor charged against a
+    deliberately impossible p99 target so ``violation_s`` is provably
+    nonzero. FAIL-SOFT like every probe block: any failure is a stderr
+    WARNING and a null block; ``--no-telemetry`` opts out."""
+    if args.no_telemetry:
+        return None
+
+    import json as _json
+    import pathlib
+    import shutil
+    import tempfile
+
+    log_dir = tempfile.mkdtemp(prefix="bench-telemetry-")
+    fleet = None
+    try:
+        import numpy as np
+
+        from pyconsensus_tpu import obs
+        from pyconsensus_tpu.serve import ServeConfig
+        from pyconsensus_tpu.serve.fleet import ConsensusFleet, \
+            FleetConfig
+
+        obs.TRACER.source = "router"
+        fleet = ConsensusFleet(FleetConfig(
+            n_workers=2, transport="socket", log_dir=log_dir,
+            worker=ServeConfig(warmup=(), batch_window_ms=1.0,
+                               pallas_buckets=False))).start(
+                                   warmup=False)
+        slo = obs.SloMonitor(targets={"p99_ms": 1e-6}, window_s=60.0,
+                             snapshot_fn=fleet.merged_snapshot)
+        rng = np.random.default_rng(args.serve_seed)
+        matrix = rng.choice([0.0, 1.0], size=(16, 24))
+        n = max(8, args.telemetry_requests)
+        slo.sample()
+        t0 = time.monotonic()
+        futs = [fleet.submit(reports=matrix, backend="numpy")
+                for _ in range(n)]
+        for f in futs:
+            f.result(timeout=120)
+        wall = time.monotonic() - t0
+        fleet.check_workers()       # land the heartbeat histogram
+        slo.sample()                # charge the (impossible) target
+
+        merged = fleet.merged_snapshot()
+        req = merged.get("pyconsensus_serve_requests_total",
+                         {}).get("series", {})
+        worker_total = 0
+        for skey, v in sorted(req.items()):
+            labels = _json.loads(skey) if skey else {}
+            if labels.get("worker", "").startswith("w"):
+                worker_total += int(v)
+        hb = merged.get("pyconsensus_fleet_heartbeat_seconds",
+                        {}).get("series", {})
+        block = {
+            "transport": "socket",
+            "workers": len(fleet.workers),
+            "requests": n,
+            "throughput_rps": round(n / max(wall, 1e-9), 2),
+            "merged_metric_families": len(merged),
+            "worker_request_sum": worker_total,
+            "heartbeat_series": len(hb),
+            "slo": slo.summary(),
+        }
+
+        # cross-process trace reconstruction: close the fleet (workers
+        # write trace-<name>.jsonl on shutdown), merge every process's
+        # spans, and count router-rooted traces with a worker-side
+        # descendant — the RPC hop crossed with correct parentage
+        fleet.close(drain=True, timeout=30.0)
+        fleet = None
+        trace_files = sorted(
+            str(p) for p in
+            pathlib.Path(log_dir).glob("*/trace-*.jsonl"))
+        events = obs.merge_jsonl(trace_files) + list(obs.events())
+        forest = obs.trace_forest(events)
+
+        def crosses(node, root_src):
+            if node.get("source") != root_src:
+                return True
+            return any(crosses(c, root_src)
+                       for c in node["children"])
+
+        block["traces"] = sum(len(r) for r in forest.values())
+        block["cross_process_traces"] = sum(
+            1 for roots in forest.values() for r in roots
+            if r.get("source") == "router" and crosses(r, "router"))
+        return block
+    except Exception as exc:                  # noqa: BLE001
+        print(f"WARNING: telemetry block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+    finally:
+        if fleet is not None:
+            try:
+                fleet.close(drain=False, timeout=5.0)
+            except Exception:             # noqa: BLE001
+                pass
+        shutil.rmtree(log_dir, ignore_errors=True)
+
+
 def _economy_block(args):
     """ISSUE 11 tentpole (c): the "is the oracle economically sound
     under production traffic" number — an adversarial economy of
@@ -1751,6 +1869,9 @@ def main() -> None:
         # ditto the multiproc probe: spawning worker subprocesses is
         # not smoke material
         smoke_argv.append("--no-multiproc")
+    if "--no-telemetry" not in smoke_argv:
+        # ditto the telemetry probe (it also spawns a socket fleet)
+        smoke_argv.append("--no-telemetry")
     if args.scaled:
         smoke_argv += ["--scaled", str(max(1, min(args.scaled, 256)))]
     smoke_line, smoke_reason = _run_child(

@@ -31,18 +31,27 @@ from __future__ import annotations
 
 from .compilemon import (InstrumentedJit, install_compile_monitor,
                          instrument_jit)
+from .flightrec import FlightRecorder, read_flight_dir
+from .httpd import MetricsServer, start_metrics_server
 from .metrics import (DURATION_BUCKETS, ITERATION_BUCKETS, MAGNITUDE_BUCKETS,
                       Counter, Gauge, Histogram, MetricsRegistry)
-from .sinks import read_jsonl, span_tree, write_jsonl, write_prom
+from .sinks import (merge_jsonl, read_jsonl, span_tree, trace_forest,
+                    write_jsonl, write_prom)
+from .slo import SloMonitor, quantile_from_counts, targets_from_config
 from .tracer import Span, Tracer
 
 __all__ = [
     "REGISTRY", "TRACER",
     "span", "observe", "current_span", "counter", "gauge", "histogram",
     "events", "report", "render_prom", "value", "reset",
+    "trace_root", "span_under", "trace_context",
     "write_jsonl", "read_jsonl", "span_tree", "write_prom",
+    "merge_jsonl", "trace_forest",
     "instrument_jit", "install_compile_monitor", "InstrumentedJit",
     "MetricsRegistry", "Tracer", "Span", "Counter", "Gauge", "Histogram",
+    "SloMonitor", "quantile_from_counts", "targets_from_config",
+    "FlightRecorder", "read_flight_dir",
+    "MetricsServer", "start_metrics_server",
     "DURATION_BUCKETS", "ITERATION_BUCKETS", "MAGNITUDE_BUCKETS",
 ]
 
@@ -56,6 +65,24 @@ TRACER = Tracer(registry=REGISTRY)
 def span(name: str, **attrs):
     """Open a span on the process-wide tracer (context manager)."""
     return TRACER.span(name, **attrs)
+
+
+def trace_root(name: str, trace_id: str, **attrs):
+    """Open a span rooting a distributed trace (ISSUE 18): ``trace_id``
+    from the request's deterministic identity, never ``uuid``/``time``."""
+    return TRACER.trace_root(name, trace_id, **attrs)
+
+
+def span_under(name: str, ctx, **attrs):
+    """Open a span under an explicit wire-propagated trace context
+    (``None`` degrades to a plain span)."""
+    return TRACER.span_under(name, ctx, **attrs)
+
+
+def trace_context():
+    """The current span's propagation context (``None`` when untraced) —
+    what the RPC client injects into the envelope."""
+    return TRACER.context()
 
 
 def observe(value):

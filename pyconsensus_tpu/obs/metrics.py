@@ -210,6 +210,29 @@ class Histogram(_Metric):
             return None if st is None else {"sum": st["sum"],
                                             "count": st["count"]}
 
+    def absorb(self, counts: Sequence[int], sum: float, count: int,
+               **labels) -> None:
+        """Fold an already-bucketed series (another registry's snapshot)
+        into this one — the merge primitive of the fleet-wide telemetry
+        plane (ISSUE 18). ``counts`` must match this histogram's bucket
+        layout (len(edges) + 1, the trailing +Inf bucket included)."""
+        counts = [int(c) for c in counts]
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"histogram {self.name} has {len(self.buckets) + 1} "
+                f"buckets (+Inf included); cannot absorb {len(counts)}")
+        key = self._key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = {"counts": [0] * (len(self.buckets) + 1),
+                      "sum": 0.0, "count": 0}
+                self._series[key] = st
+            for i, c in enumerate(counts):
+                st["counts"][i] += c
+            st["sum"] += float(sum)
+            st["count"] += int(count)
+
     def render(self) -> List[str]:
         lines: List[str] = []
         with self._lock:
@@ -344,12 +367,65 @@ class MetricsRegistry:
                 skey = json.dumps(dict(zip(m.label_names, key)),
                                   sort_keys=True) if key else ""
                 if m.kind == "histogram":
+                    # counts + edges make the snapshot mergeable (the
+                    # fleet collector re-renders cluster-wide buckets,
+                    # ISSUE 18); sum/count stay for bench.py consumers
                     entry["series"][skey] = {"sum": v["sum"],
-                                             "count": v["count"]}
+                                             "count": v["count"],
+                                             "counts": list(v["counts"])}
                 else:
                     entry["series"][skey] = v
+            if m.kind == "histogram":
+                entry["edges"] = list(m.buckets)
             out[m.name] = entry
         return out
+
+    def merge_snapshot(self, snap: dict, **extra_labels) -> None:
+        """Fold another registry's :meth:`snapshot` into this one, every
+        series widened by ``extra_labels`` (the fleet collector passes
+        ``worker=<name>``) — ISSUE 18 tentpole (a). Counters add, gauges
+        take the snapshot value, histograms absorb bucket counts (a
+        pre-ISSUE-18 snapshot without ``counts``/``edges`` cannot be
+        re-bucketed and is skipped). Iteration is sorted throughout: the
+        merged registry feeds serialized artifacts (``/metrics`` scrape,
+        ``--metrics-out``) and must not depend on dict order (CL1001)."""
+        extra_names = tuple(sorted(extra_labels))
+        extra_vals = {ln: str(extra_labels[ln]) for ln in extra_names}
+        for name in sorted(snap):
+            entry = snap[name]
+            kind = entry.get("kind")
+            own_names = tuple(entry.get("labels") or ())
+            # a metric that already carries one of the extra labels
+            # (e.g. the router's own per-worker heartbeat histogram vs
+            # worker=<name>) keeps its OWN value — overwriting would
+            # collapse distinct series onto one key
+            add_names = tuple(ln for ln in extra_names
+                              if ln not in own_names)
+            label_names = own_names + add_names
+            if kind == "histogram":
+                edges = entry.get("edges")
+                if not edges:
+                    continue
+                m = self.histogram(name, labels=label_names, buckets=edges)
+            elif kind == "counter":
+                m = self.counter(name, labels=label_names)
+            elif kind == "gauge":
+                m = self.gauge(name, labels=label_names)
+            else:
+                continue
+            series = entry.get("series") or {}
+            for skey in sorted(series):
+                v = series[skey]
+                labels = dict(json.loads(skey)) if skey else {}
+                labels.update({ln: extra_vals[ln] for ln in add_names})
+                if kind == "histogram":
+                    if "counts" not in v:
+                        continue
+                    m.absorb(v["counts"], v["sum"], v["count"], **labels)
+                elif kind == "counter":
+                    m.inc(float(v), **labels)
+                else:
+                    m.set(float(v), **labels)
 
     def reset(self) -> None:
         with self._lock:

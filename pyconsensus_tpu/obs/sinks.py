@@ -13,7 +13,8 @@ import json
 import pathlib
 from typing import Dict, List, Optional, Sequence
 
-__all__ = ["write_jsonl", "read_jsonl", "span_tree", "write_prom"]
+__all__ = ["write_jsonl", "read_jsonl", "span_tree", "write_prom",
+           "merge_jsonl", "trace_forest"]
 
 
 def write_jsonl(path, events: Sequence[dict], meta: Optional[dict] = None
@@ -66,8 +67,11 @@ def span_tree(events: Sequence[dict]) -> List[dict]:
         by_id[(sp.get("process_index", 0), sp["span_id"])] = sp
     roots: List[dict] = []
     for sp in spans:
-        parent = by_id.get((sp.get("process_index", 0),
-                            sp.get("parent_id", 0)))
+        # a span whose parent lives in ANOTHER source (the far side of an
+        # RPC hop, ISSUE 18) roots the local tree; trace_forest resolves
+        # the cross-source edge over merged logs
+        parent = None if sp.get("parent_src") is not None else by_id.get(
+            (sp.get("process_index", 0), sp.get("parent_id", 0)))
         if parent is not None and parent is not sp:
             parent["children"].append(sp)
         else:
@@ -78,6 +82,64 @@ def span_tree(events: Sequence[dict]) -> List[dict]:
             _sort(n["children"])
     _sort(roots)
     return roots
+
+
+def merge_jsonl(paths: Sequence) -> List[dict]:
+    """Concatenate several span JSONL files (one per process — the router
+    plus each fleet worker, ISSUE 18 tentpole (b)) into one flat event
+    list. Each file's records are tagged with that file's ``source``:
+    spans written since ISSUE 18 self-stamp it; older records inherit the
+    file's meta ``source`` field. Files are read in the order given —
+    callers globbing a directory must sort first (CL1001)."""
+    merged: List[dict] = []
+    for path in paths:
+        events = read_jsonl(path)
+        file_src = None
+        for ev in events:
+            if ev.get("type") == "meta" and ev.get("source"):
+                file_src = str(ev["source"])
+                break
+        for ev in events:
+            ev = dict(ev)
+            if ev.get("type") == "span" and not ev.get("source"):
+                ev["source"] = file_src or str(path)
+            merged.append(ev)
+    return merged
+
+
+def trace_forest(events: Sequence[dict]) -> Dict[str, List[dict]]:
+    """Reconstruct distributed traces from merged multi-process events:
+    ``{trace_id: [root spans]}``, each root carrying nested ``children``
+    sorted by start time. Spans are keyed ``(source, span_id)`` — every
+    process numbers span_ids from 1, so the source label is what keeps a
+    router span and a worker span distinct — and a cross-source parent
+    edge (``parent_src``, the RPC hop) resolves against the parent's
+    source. Untraced spans (no ``trace_id``) are ignored; a traced span
+    whose parent is missing from ``events`` becomes a root."""
+    spans = [dict(ev) for ev in events
+             if ev.get("type") == "span" and ev.get("trace_id")]
+    by_id: Dict[tuple, dict] = {}
+    for sp in spans:
+        sp["children"] = []
+        by_id[(sp.get("source", ""), sp["span_id"])] = sp
+    forest: Dict[str, List[dict]] = {}
+    for sp in spans:
+        src = sp.get("parent_src") or sp.get("source", "")
+        parent = by_id.get((src, sp.get("parent_id", 0)))
+        if parent is not None and parent is not sp \
+                and parent.get("trace_id") == sp.get("trace_id"):
+            parent["children"].append(sp)
+        else:
+            forest.setdefault(str(sp["trace_id"]), []).append(sp)
+
+    def _sort(nodes: List[dict]) -> None:
+        nodes.sort(key=lambda s: s.get("start_s", 0.0))
+        for n in nodes:
+            _sort(n["children"])
+
+    for tid in sorted(forest):
+        _sort(forest[tid])
+    return {tid: forest[tid] for tid in sorted(forest)}
 
 
 def write_prom(path, registry) -> str:

@@ -78,14 +78,27 @@ def _block_all(values: list) -> None:
 
 class Span:
     """One finished-or-open phase. Attributes are small JSON-able values
-    (strings/numbers/bools); anything else is stringified at export."""
+    (strings/numbers/bools); anything else is stringified at export.
+
+    Distributed tracing (ISSUE 18): ``trace_id`` names the end-to-end
+    request this span belongs to (inherited from the parent span, or set
+    explicitly at the trace root — always derived from the request's
+    deterministic identity, never ``uuid``/``time``, so serialized trace
+    artifacts stay CL1003-clean). ``source`` is the emitting tracer's
+    label (worker name / "router"); ``parent_src`` is set when the parent
+    span lives in ANOTHER source (the RPC hop) — ``parent_id`` then refers
+    to ``(parent_src, parent_id)`` in the merged forest, and the span is a
+    root of its local tree."""
 
     __slots__ = ("name", "attrs", "span_id", "parent_id", "depth",
                  "process_index", "start_wall_s", "duration_s", "status",
-                 "error", "_t0", "_pending")
+                 "error", "trace_id", "source", "parent_src", "_t0",
+                 "_pending")
 
     def __init__(self, name: str, attrs: Dict[str, object], parent_id: int,
-                 depth: int) -> None:
+                 depth: int, trace_id: Optional[str] = None,
+                 source: str = "main",
+                 parent_src: Optional[str] = None) -> None:
         self.name = name
         self.attrs = attrs
         self.span_id = _next_id()
@@ -96,6 +109,9 @@ class Span:
         self.duration_s: Optional[float] = None
         self.status = "open"
         self.error: Optional[str] = None
+        self.trace_id = trace_id
+        self.source = source
+        self.parent_src = parent_src
         self._t0 = time.perf_counter()
         self._pending: list = []
 
@@ -115,19 +131,27 @@ class Span:
         for k, v in self.attrs.items():
             attrs[str(k)] = (v if isinstance(v, (str, int, float, bool))
                              or v is None else str(v))
-        return {
+        out = {
             "type": "span",
             "name": self.name,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
             "depth": self.depth,
             "process_index": self.process_index,
+            "source": self.source,
             "start_s": self.start_wall_s,
             "duration_s": self.duration_s,
             "status": self.status,
             "error": self.error,
             "attrs": attrs,
         }
+        # trace context only when traced: untraced spans keep the
+        # pre-ISSUE-18 record shape
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        if self.parent_src is not None:
+            out["parent_src"] = self.parent_src
+        return out
 
 
 class Tracer:
@@ -142,9 +166,14 @@ class Tracer:
     #: the span ring keeps the most recent trees for report()/JSONL
     MAX_SPANS = 100_000
 
-    def __init__(self, registry=None, max_spans: Optional[int] = None
-                 ) -> None:
+    def __init__(self, registry=None, max_spans: Optional[int] = None,
+                 source: str = "main") -> None:
         self._registry = registry
+        #: this tracer's identity in merged multi-process trace logs
+        #: (ISSUE 18): fleet worker processes set it to their worker name,
+        #: the routing process to "router". A deterministic label, never
+        #: pid/uuid — trace artifacts are diffable across runs.
+        self.source = str(source)
         self._max_spans = int(max_spans if max_spans is not None
                               else self.MAX_SPANS)
         self._local = threading.local()
@@ -168,17 +197,71 @@ class Tracer:
         st = self._stack()
         return st[-1] if st else None
 
-    @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:
         """Open a child span of the innermost open span on this thread.
         Exception-safe: an exception inside the body marks the span
         ``status="error"`` (with the exception repr) and re-raises; the
-        span is recorded either way, and the stack is always popped."""
+        span is recorded either way, and the stack is always popped.
+        The child inherits its parent's ``trace_id`` (ISSUE 18)."""
+        return self._open(name, attrs)
+
+    def trace_root(self, name: str, trace_id: str, **attrs
+                   ) -> Iterator[Span]:
+        """Open a span that ROOTS a distributed trace: ``trace_id`` must
+        come from the request's deterministic identity (routing key,
+        session round) — not ``uuid``/``time`` (CL1003). Nests normally
+        under any open local span; descendants and RPC hops inherit the
+        id (ISSUE 18 tentpole (b))."""
+        return self._open(name, attrs, trace_id=str(trace_id))
+
+    def span_under(self, name: str, ctx: Optional[dict], **attrs
+                   ) -> Iterator[Span]:
+        """Open a span whose parent is an EXPLICIT trace context
+        (``{"trace_id", "src", "span_id"}`` from :meth:`context` — the
+        wire-propagated form, ISSUE 18) instead of the thread-local
+        stack: the worker-side RPC extraction point, and the batcher's
+        cross-thread dispatch linkage. ``ctx=None`` degrades to a plain
+        :meth:`span`, so call sites need no branching."""
+        if not ctx:
+            return self._open(name, attrs)
+        src = str(ctx.get("src") or "")
+        parent_id = int(ctx.get("span_id") or 0)
+        trace_id = ctx.get("trace_id")
+        return self._open(
+            name, attrs,
+            trace_id=str(trace_id) if trace_id is not None else None,
+            parent_override=(src, parent_id))
+
+    def context(self) -> Optional[dict]:
+        """The current span's propagation context — ``None`` when no span
+        is open or the span is untraced (so untraced RPC envelopes stay
+        byte-identical to the pre-ISSUE-18 wire form)."""
+        sp = self.current()
+        if sp is None or sp.trace_id is None:
+            return None
+        return {"trace_id": sp.trace_id, "src": self.source,
+                "span_id": sp.span_id}
+
+    @contextlib.contextmanager
+    def _open(self, name: str, attrs: dict,
+              trace_id: Optional[str] = None,
+              parent_override: Optional[tuple] = None) -> Iterator[Span]:
         stack = self._stack()
         parent = stack[-1] if stack else None
-        sp = Span(name, dict(attrs),
-                  parent.span_id if parent is not None else 0,
-                  parent.depth + 1 if parent is not None else 0)
+        if parent_override is not None:
+            src, pid = parent_override
+            remote = src != self.source
+            sp = Span(name, dict(attrs), pid,
+                      0 if remote else 1, trace_id=trace_id,
+                      source=self.source,
+                      parent_src=src if remote else None)
+        else:
+            if trace_id is None and parent is not None:
+                trace_id = parent.trace_id
+            sp = Span(name, dict(attrs),
+                      parent.span_id if parent is not None else 0,
+                      parent.depth + 1 if parent is not None else 0,
+                      trace_id=trace_id, source=self.source)
         stack.append(sp)
         try:
             yield sp
@@ -253,8 +336,11 @@ class Tracer:
         for sp in spans:
             # a child whose parent was evicted from the ring becomes a
             # root (matching sinks.span_tree) instead of silently
-            # vanishing from the report
-            parent = sp.parent_id if sp.parent_id in known else 0
+            # vanishing from the report; a remote parent (parent_src set
+            # — the other side of an RPC hop) is never local, so those
+            # spans root the local tree too
+            parent = sp.parent_id if (sp.parent_src is None
+                                      and sp.parent_id in known) else 0
             by_parent.setdefault(parent, []).append(sp)
         lines: List[str] = []
 

@@ -258,10 +258,16 @@ class Microbatcher:
                     "event-axis width of the mesh used by the latest "
                     "sharded resolution").set(
                         topology_event_shards(key.topology))
-            with obs.span("serve.dispatch",
-                          bucket=f"{key.rows}x{key.events}",
-                          topology=key.topology,
-                          occupancy=len(live)):
+            # the batch's execution span joins the FIRST traced
+            # request's distributed trace (a coalesced batch has one
+            # span but many requests — the others ride as occupancy);
+            # ctx=None degrades to the plain local span (ISSUE 18)
+            with obs.span_under("serve.dispatch",
+                                next((r.trace for r in live if r.trace),
+                                     None),
+                                bucket=f"{key.rows}x{key.events}",
+                                topology=key.topology,
+                                occupancy=len(live)):
                 stacked = sk.place_bucket_operands(tmpl)
                 # pin the host→device TRANSFER complete before the
                 # template may be refilled (BucketTemplates' reuse
@@ -350,10 +356,11 @@ class Microbatcher:
                 _faults.fire("serve.dispatch")
                 self._kernel_path.inc(path="pallas")
                 entry = self.cache.get(key)
-                with obs.span("serve.dispatch",
-                              bucket=f"{key.rows}x{key.events}",
-                              topology=key.topology,
-                              kernel_path=key.kernel_path, occupancy=1):
+                with obs.span_under("serve.dispatch", r.trace,
+                                    bucket=f"{key.rows}x{key.events}",
+                                    topology=key.topology,
+                                    kernel_path=key.kernel_path,
+                                    occupancy=1):
                     raw = entry(*pallas_bucket_inputs(r), key.params)
                     flat = {k: np.asarray(v) for k, v in raw.items()}
             except BaseException as exc:  # noqa: BLE001 — EVERY waiter
@@ -375,8 +382,8 @@ class Microbatcher:
 
     def _dispatch_direct(self, req) -> None:
         _faults.fire("serve.dispatch")
-        with obs.span("serve.direct", backend=req.backend,
-                      shape=str(req.shape)):
+        with obs.span_under("serve.direct", req.trace,
+                            backend=req.backend, shape=str(req.shape)):
             result = Oracle(reports=req.reports,
                             event_bounds=req.event_bounds,
                             reputation=req.reputation,
@@ -387,7 +394,9 @@ class Microbatcher:
     def _dispatch_session(self, req) -> None:
         _faults.fire("serve.dispatch")
         session = self.sessions.get(req.session)
-        flat = session.resolve(**req.oracle_kwargs)
+        with obs.span_under("serve.session", req.trace,
+                            session=str(req.session)):
+            flat = session.resolve(**req.oracle_kwargs)
         result = assemble_result(flat)
         result["quarantined_rows"] = np.array([], dtype=np.int64)
         # the incremental tier's dispatches (warm marginal resolves AND

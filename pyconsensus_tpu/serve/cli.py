@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from typing import Optional, Sequence
 
 __all__ = ["main"]
@@ -121,6 +122,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "the expected outcome of an overload probe")
     ap.add_argument("--metrics-out", metavar="PATH",
                     help="write the Prometheus exposition on exit")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve a live /metrics endpoint on this port "
+                         "(ISSUE 18): the merged cluster view with "
+                         "--fleet-workers (per-worker series under a "
+                         "worker label), this process's registry "
+                         "otherwise; 0 picks a free port (printed to "
+                         "stderr)")
+    ap.add_argument("--metrics-hold-s", type=float, default=0.0,
+                    metavar="S",
+                    help="hold the /metrics endpoint (and a fleet's "
+                         "workers) open this long after the load run — "
+                         "the scrape window an external collector or "
+                         "the CI telemetry stage needs")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="write this process's span events as JSONL on "
+                         "exit (socket fleet workers write their own "
+                         "trace-<name>.jsonl under --log-dir; "
+                         "obs.merge_jsonl + obs.trace_forest "
+                         "reassemble the cross-process forest)")
+    ap.add_argument("--slo-window-s", type=float, default=None,
+                    metavar="S", help="SLO monitor sliding window")
+    ap.add_argument("--slo-p50-ms", type=float, default=None,
+                    help="windowed p50 latency target (0 = unset)")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="windowed p99 latency target (0 = unset)")
+    ap.add_argument("--slo-shed-ratio", type=float, default=None,
+                    help="windowed shed-ratio target (0 = unset)")
+    ap.add_argument("--slo-queue-depth", type=float, default=None,
+                    help="windowed queue-depth target (0 = unset)")
     args = ap.parse_args(argv)
 
     from .. import obs
@@ -151,6 +182,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         overrides["incremental_sessions"] = bool(args.incremental)
     if args.refresh_every is not None:
         overrides["incremental_refresh_every"] = int(args.refresh_every)
+    for slo_key in ("slo_window_s", "slo_p50_ms", "slo_p99_ms",
+                    "slo_shed_ratio", "slo_queue_depth"):
+        val = getattr(args, slo_key)
+        if val is not None:
+            overrides[slo_key] = float(val)
     if overrides:
         cfg = ServeConfig.from_dict({**cfg.__dict__, **overrides})
 
@@ -188,12 +224,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from .loadgen import LoadGenerator
 
     svc.start(warmup=False)
+    # windowed SLO monitor (ISSUE 18): targets come from the config
+    # (all-zero targets still produce the windowed time-series block)
+    slo = obs.SloMonitor(targets=obs.targets_from_config(cfg),
+                         window_s=cfg.slo_window_s)
+    metrics_srv = (obs.start_metrics_server(args.metrics_port,
+                                            obs.render_prom)
+                   if args.metrics_port is not None else None)
+    if metrics_srv is not None:
+        print(f"metrics endpoint: "
+              f"http://127.0.0.1:{metrics_srv.port}/metrics",
+              file=sys.stderr)
     gen = LoadGenerator(svc, shapes=shapes, na_frac=args.na_frac,
-                        seed=args.seed, max_retries=args.retries)
+                        seed=args.seed, max_retries=args.retries,
+                        slo=slo)
     if args.rate:
         stats = gen.run_open(args.requests, args.rate)
     else:
         stats = gen.run_closed(args.requests, args.concurrency)
+    if metrics_srv is not None and args.metrics_hold_s > 0:
+        print(f"holding /metrics open {args.metrics_hold_s:.1f}s",
+              file=sys.stderr)
+        time.sleep(args.metrics_hold_s)
     svc.close(drain=True)
 
     stats["cache"] = {
@@ -222,9 +274,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # feed it) — canonical key order keeps two identical runs
     # byte-identical
     print(json.dumps(stats, indent=2, sort_keys=True))
+    if metrics_srv is not None:
+        metrics_srv.close()
     if args.metrics_out:
         obs.write_prom(args.metrics_out, obs.REGISTRY)
         print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+    if args.trace_out:
+        obs.write_jsonl(args.trace_out, obs.events(),
+                        meta={"source": obs.TRACER.source})
+        print(f"trace written to {args.trace_out}", file=sys.stderr)
 
     hard_failures = stats["failed"]
     if args.allow_shed:
@@ -240,25 +298,59 @@ def _fleet_main(args, cfg, shapes) -> int:
     from .fleet import ConsensusFleet, FleetConfig
     from .loadgen import LoadGenerator
 
+    # the router process's spans carry a distinct source label so the
+    # merged forest keeps router and worker span_ids apart (ISSUE 18)
+    obs.TRACER.source = "router"
     fleet = ConsensusFleet(FleetConfig(
         n_workers=args.fleet_workers, transport=args.transport,
         log_dir=args.log_dir, worker=cfg)).start()
+    metrics_srv = None
     try:
+        # SLO feed: over the socket transport the request counters live
+        # in the WORKER processes, so the monitor samples the merged
+        # cluster snapshot; in-process workers share this process's
+        # registry (the merged view would multiple-count it)
+        snapshot_fn = (fleet.merged_snapshot
+                       if args.transport == "socket"
+                       else obs.REGISTRY.snapshot)
+        slo = obs.SloMonitor(targets=obs.targets_from_config(cfg),
+                             window_s=cfg.slo_window_s,
+                             snapshot_fn=snapshot_fn)
+        if args.metrics_port is not None:
+            metrics_srv = obs.start_metrics_server(args.metrics_port,
+                                                   fleet.render_metrics)
+        if metrics_srv is not None:
+            print(f"metrics endpoint: "
+                  f"http://127.0.0.1:{metrics_srv.port}/metrics",
+                  file=sys.stderr)
         gen = LoadGenerator(fleet, shapes=shapes, na_frac=args.na_frac,
-                            seed=args.seed, max_retries=args.retries)
+                            seed=args.seed, max_retries=args.retries,
+                            slo=slo)
         if args.rate:
             stats = gen.run_open(args.requests, args.rate)
         else:
             stats = gen.run_closed(args.requests, args.concurrency)
+        if metrics_srv is not None and args.metrics_hold_s > 0:
+            # the scrape window: workers stay up (the merged render
+            # needs them answering metrics.snapshot over the wire)
+            print(f"holding /metrics open {args.metrics_hold_s:.1f}s",
+                  file=sys.stderr)
+            time.sleep(args.metrics_hold_s)
         status = fleet.status()     # before the drain marks workers down
     finally:
         fleet.close(drain=True)
+        if metrics_srv is not None:
+            metrics_srv.close()
     stats["transport"] = args.transport
     stats["fleet"] = status
     print(json.dumps(stats, indent=2, sort_keys=True))
     if args.metrics_out:
         obs.write_prom(args.metrics_out, obs.REGISTRY)
         print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+    if args.trace_out:
+        obs.write_jsonl(args.trace_out, obs.events(),
+                        meta={"source": obs.TRACER.source})
+        print(f"trace written to {args.trace_out}", file=sys.stderr)
     hard_failures = stats["failed"]
     if args.allow_shed:
         hard_failures -= stats["errors"].get("PYC401", 0)

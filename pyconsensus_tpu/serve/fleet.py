@@ -52,6 +52,7 @@ loss, heartbeat flap, and torn ledger replication deterministically.
 
 from __future__ import annotations
 
+import pathlib
 import sys
 import threading
 import time
@@ -165,10 +166,20 @@ class FleetWorker(WorkerBase):
         health probe, so the timestamp must NOT advance)."""
         if not self.alive:
             return False
+        start = time.monotonic()
         try:
             _faults.fire("fleet.heartbeat")
         except Exception:   # noqa: BLE001 — a lost probe, not a fault
             return False
+        latency = time.monotonic() - start
+        self.last_heartbeat_latency_s = latency
+        obs.histogram(
+            "pyconsensus_fleet_heartbeat_seconds",
+            "router-observed heartbeat round-trip latency by worker "
+            "(over the socket transport this is a real RPC ping; a "
+            "rising tail is the early-warning signal ahead of a "
+            "staleness declaration)",
+            labels=("worker",)).observe(latency, worker=self.name)
         self.last_heartbeat = time.monotonic()
         return True
 
@@ -265,6 +276,22 @@ class FleetWorker(WorkerBase):
     def warm_from_disk(self) -> int:
         return self.service.warm_from_disk()
 
+    # -- telemetry (ISSUE 18) --------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """This worker's metric registry snapshot. In-process workers
+        share the process-wide ``obs.REGISTRY`` singleton, so every
+        handle answers the SAME process view — per-worker series are
+        only meaningful over the socket transport, where each worker is
+        its own process with its own registry (docs/OBSERVABILITY.md
+        "Telemetry plane")."""
+        return {"worker": self.name, "metrics": obs.REGISTRY.snapshot()}
+
+    def metrics_render(self) -> dict:
+        """This worker's Prometheus text exposition (same in-process
+        caveat as :meth:`metrics_snapshot`)."""
+        return {"worker": self.name, "text": obs.render_prom()}
+
 
 class ConsensusFleet:
     """The replicated serve fleet (see module docstring).
@@ -300,6 +327,11 @@ class ConsensusFleet:
         self._failed_sessions: dict = {}    # guarded-by: _lock
         self._lock = threading.RLock()
         self._seq = 0
+        #: trace-id counter for session submits (ISSUE 18) — separate
+        #: from ``_seq`` so tracing never perturbs stateless routing
+        #: keys; both are deterministic request identities (CL1003: no
+        #: uuid/time in a trace id)
+        self._trace_seq = 0
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._failovers = obs.counter(
@@ -308,6 +340,15 @@ class ConsensusFleet:
         self._migrated = obs.counter(
             "pyconsensus_sessions_migrated_total",
             "sessions replayed onto a standby worker")
+        # router-side flight recorder (ISSUE 18 satellite): when the
+        # worker config asks for one, the router keeps its own bounded
+        # on-disk ring and dumps it at every takeover — a kill -9 chaos
+        # run leaves BOTH sides' last-moments artifacts
+        self._recorder = None
+        if self.config.worker.flightrec_dir:
+            self._recorder = obs.FlightRecorder(
+                pathlib.Path(self.config.worker.flightrec_dir) / "router",
+                source="router")
 
     # -- lifecycle ------------------------------------------------------
 
@@ -365,6 +406,18 @@ class ConsensusFleet:
             if name in self.ring and (
                     not w.alive
                     or w.stale(self.config.heartbeat_timeout_s)):
+                if w.alive:
+                    # heartbeat-staleness declaration: log the last
+                    # SUCCESSFUL beat's round-trip as forensic context
+                    # (a climbing latency before silence reads very
+                    # differently from an instant cut)
+                    latency = w.last_heartbeat_latency_s
+                    print(f"WARNING: worker {name!r} heartbeat stale "
+                          f"(> {self.config.heartbeat_timeout_s:.3f}s); "
+                          f"last observed heartbeat latency "
+                          + (f"{latency * 1e3:.3f}ms" if latency
+                             is not None else "never measured"),
+                          file=sys.stderr)
                 dead.append(name)
         for name in dead:
             self._declare_dead(name)
@@ -404,6 +457,11 @@ class ConsensusFleet:
             migrated = (self._failover(name)
                         if (in_ring or (stranded and len(self.ring)))
                         else [])
+        if self._recorder is not None:
+            try:
+                self._recorder.dump("takeover")
+            except Exception:   # noqa: BLE001 — forensics never block
+                pass            # the takeover's completion
         return {"worker": name, "shed_queued": shed,
                 "sessions_migrated": migrated}
 
@@ -592,29 +650,51 @@ class ConsensusFleet:
             raise InputError(
                 "exactly one of reports= / session= is required")
         if session is not None:
-            w = self._session_worker(session)
-            try:
-                return w.submit_session(session, tenant=tenant,
-                                        **kwargs)
-            except ServiceOverloadError as exc:
-                if exc.context.get("reason") == "draining" and not w.alive:
-                    # lost the race with this worker's death (hard_kill
-                    # fences alive=False before it starts the drain):
-                    # translate to the retryable worker-loss code — the
-                    # standby will own the session shortly. A LIVE
-                    # worker's drain is a graceful shutdown and stays
-                    # PYC401: no takeover is coming, so a client must
-                    # not burn its retry budget waiting for one.
-                    raise WorkerLostError(
-                        f"worker {w.name!r} died while routing session "
-                        f"{session!r}", worker=w.name, session=session,
-                        tenant=tenant,
-                        retry_after_s=self.config.takeover_window_s
-                    ) from exc
-                raise
+            # router-side trace root (ISSUE 18): the trace id is the
+            # request's deterministic identity — session, tenant, and a
+            # router-scoped sequence number; everything the request
+            # touches (the RPC hop, the worker's dispatch, the bucket
+            # execution) parents under this span via the wire context
+            with self._lock:
+                self._trace_seq += 1
+                trace_id = f"{session}:{tenant}:{self._trace_seq}"
+            with obs.trace_root("fleet.submit", trace_id,
+                                session=str(session), tenant=str(tenant)):
+                return self._submit_session_routed(session, tenant,
+                                                   kwargs)
         with self._lock:
             self._seq += 1
             key = f"~{tenant}:{self._seq}"
+        # stateless trace id IS the routing key — one string names both
+        # the ring placement and the trace
+        with obs.trace_root("fleet.submit", key, tenant=str(tenant)):
+            return self._submit_stateless_routed(key, reports, tenant,
+                                                 kwargs)
+
+    def _submit_session_routed(self, session: str, tenant: str,
+                               kwargs: dict):
+        w = self._session_worker(session)
+        try:
+            return w.submit_session(session, tenant=tenant, **kwargs)
+        except ServiceOverloadError as exc:
+            if exc.context.get("reason") == "draining" and not w.alive:
+                # lost the race with this worker's death (hard_kill
+                # fences alive=False before it starts the drain):
+                # translate to the retryable worker-loss code — the
+                # standby will own the session shortly. A LIVE
+                # worker's drain is a graceful shutdown and stays
+                # PYC401: no takeover is coming, so a client must
+                # not burn its retry budget waiting for one.
+                raise WorkerLostError(
+                    f"worker {w.name!r} died while routing session "
+                    f"{session!r}", worker=w.name, session=session,
+                    tenant=tenant,
+                    retry_after_s=self.config.takeover_window_s
+                ) from exc
+            raise
+
+    def _submit_stateless_routed(self, key: str, reports, tenant: str,
+                                 kwargs: dict):
         candidates = (self.ring.preference(key) if self.config.spillover
                       else [self.ring.owner(key)])
         last_exc = None
@@ -716,6 +796,42 @@ class ConsensusFleet:
     def sessions(self) -> dict:
         with self._lock:
             return dict(self._sessions)
+
+    # -- telemetry (ISSUE 18) -------------------------------------------
+
+    def merged_registry(self) -> obs.MetricsRegistry:
+        """The cluster's ONE metric view: every worker's registry
+        snapshot folded into a fresh registry under a ``worker`` label,
+        plus the router's own process registry under
+        ``worker="router"``. Fail-soft per worker — a dead or
+        unreachable worker contributes nothing rather than taking the
+        scrape down (its last-shipped numbers are gone with it; the
+        flight recorder is the forensic path). Over the in-process
+        transport every handle shares the router's registry singleton,
+        so the per-worker series are copies of the process view — the
+        merged scrape is meaningful on the SOCKET transport, where each
+        worker is its own process (docs/OBSERVABILITY.md)."""
+        merged = obs.MetricsRegistry()
+        merged.merge_snapshot(obs.REGISTRY.snapshot(), worker="router")
+        for name, w in sorted(self.workers.items()):
+            try:
+                reply = w.metrics_snapshot()
+                merged.merge_snapshot(
+                    dict(reply.get("metrics") or {}),
+                    worker=str(reply.get("worker", name)))
+            except Exception:   # noqa: BLE001 — a dead worker must not
+                continue        # take the cluster scrape down with it
+        return merged
+
+    def merged_snapshot(self) -> dict:
+        """``merged_registry().snapshot()`` — the SLO monitor's cluster
+        feed and the tests' assertion surface."""
+        return self.merged_registry().snapshot()
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition of the merged cluster view — what
+        ``pyconsensus-serve --metrics-port`` serves at ``/metrics``."""
+        return self.merged_registry().render_prom()
 
     # -- introspection --------------------------------------------------
 

@@ -162,13 +162,20 @@ class LoadGenerator:
     retry_cap_s : float
         Upper bound of any single retry wait — the budget stays bounded
         even against a pathological hint.
+    slo : obs.SloMonitor, optional
+        A windowed SLO monitor (ISSUE 18) sampled on a background
+        thread for the duration of the run; its ``summary()`` lands in
+        the stats dict under ``"slo"`` so every loadgen artifact
+        carries the violation accounting next to the latency numbers.
     """
 
     def __init__(self, service, shapes=((12, 48), (24, 96)),
                  na_frac: float = 0.1, seed: int = 0,
                  tenant: str = "loadgen", oracle_kwargs=None,
-                 max_retries: int = 0, retry_cap_s: float = 2.0) -> None:
+                 max_retries: int = 0, retry_cap_s: float = 2.0,
+                 slo=None) -> None:
         self.service = service
+        self.slo = slo
         self.shapes = [tuple(s) for s in shapes]
         self.tenant = tenant
         self.oracle_kwargs = dict(oracle_kwargs or {})
@@ -266,13 +273,22 @@ class LoadGenerator:
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(max(1, concurrency))]
+        if self.slo is not None:
+            self.slo.run_in_thread()
         t0 = time.monotonic()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        return summarize(latencies, errors, time.monotonic() - t0,
-                         n_requests, **tallies)
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if self.slo is not None:
+                self.slo.stop()
+        stats = summarize(latencies, errors, time.monotonic() - t0,
+                          n_requests, **tallies)
+        if self.slo is not None:
+            stats["slo"] = self.slo.summary()
+        return stats
 
     # -- open loop ------------------------------------------------------
 
@@ -302,6 +318,8 @@ class LoadGenerator:
                 latencies.append(lat)
 
         interval = 1.0 / rate_rps
+        if self.slo is not None:
+            self.slo.run_in_thread()
         t0 = time.monotonic()
         for i in range(n_requests):
             target = t0 + i * interval
@@ -334,8 +352,13 @@ class LoadGenerator:
             lat, err, retried, abandoned = self._one_request(
                 i, timeout_s, first_error=exc)
             tally(err, lat, retried, abandoned)
-        return summarize(latencies, errors, time.monotonic() - t0,
-                         n_requests, **tallies)
+        if self.slo is not None:
+            self.slo.stop()
+        stats = summarize(latencies, errors, time.monotonic() - t0,
+                          n_requests, **tallies)
+        if self.slo is not None:
+            stats["slo"] = self.slo.summary()
+        return stats
 
 
 def main(argv=None) -> int:

@@ -43,6 +43,10 @@ class ResolveRequest:
     deadline: Optional[float] = None
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=_now)
+    #: wire-propagated trace context captured at submit (ISSUE 18):
+    #: ``{"trace_id", "src", "span_id"}`` or None — the batcher parents
+    #: its cross-thread dispatch span under it
+    trace: Optional[dict] = None
     # -- derived at admission ------------------------------------------
     shape: Optional[tuple] = None          # true (R, E)
     bucket: Optional[tuple] = None         # (rows, events) or None=direct

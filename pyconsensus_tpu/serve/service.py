@@ -149,6 +149,25 @@ class ServeConfig:
     #: across a fleet — entries are content-addressed by a full
     #: compatibility fingerprint and verified before adoption.
     aot_cache_dir: Optional[str] = None
+    #: declarative SLO targets (ISSUE 18 tentpole (c)) evaluated by the
+    #: windowed ``obs.SloMonitor`` over ``slo_window_s``-second windows;
+    #: 0 disables a target. Violated seconds accumulate into
+    #: ``pyconsensus_slo_violation_seconds{slo=<target>}`` — the
+    #: ROADMAP-1 autoscaler's control signal.
+    slo_window_s: float = 10.0
+    #: windowed p50 / p99 latency bounds (ms)
+    slo_p50_ms: float = 0.0
+    slo_p99_ms: float = 0.0
+    #: max fraction of windowed requests shed
+    slo_shed_ratio: float = 0.0
+    #: max sampled queue depth
+    slo_queue_depth: float = 0.0
+    #: flight-recorder directory (ISSUE 18 satellite): each process
+    #: keeps a bounded on-disk ring of recent spans + metric deltas
+    #: under ``<flightrec_dir>/<source>/`` , dumped on boot / fence /
+    #: SIGTERM / takeover so kill-9 chaos runs leave a postmortem
+    #: artifact. None disables recording.
+    flightrec_dir: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServeConfig":
@@ -164,6 +183,10 @@ class ServeConfig:
         for key in ("warmup", "pallas_warmup"):
             if key in d:
                 d[key] = tuple((int(r), int(e)) for r, e in d[key])
+        for key in ("slo_window_s", "slo_p50_ms", "slo_p99_ms",
+                    "slo_shed_ratio", "slo_queue_depth"):
+            if key in d:
+                d[key] = float(d[key])
         return cls(**d)
 
     @classmethod
@@ -200,6 +223,18 @@ class ConsensusService:
                 f"{self.config.incremental_refresh_every}",
                 incremental_refresh_every=(
                     self.config.incremental_refresh_every))
+        if float(self.config.slo_window_s) <= 0:
+            raise InputError(
+                f"slo_window_s must be > 0, got "
+                f"{self.config.slo_window_s}",
+                slo_window_s=self.config.slo_window_s)
+        for key in ("slo_p50_ms", "slo_p99_ms", "slo_shed_ratio",
+                    "slo_queue_depth"):
+            if float(getattr(self.config, key)) < 0:
+                raise InputError(
+                    f"{key} must be >= 0 (0 disables the target), got "
+                    f"{getattr(self.config, key)}",
+                    **{key: getattr(self.config, key)})
         self.queue = RequestQueue(self.config.max_queue)
         self.mesh = self._build_mesh()
         aot = None
@@ -490,7 +525,11 @@ class ConsensusService:
             reports=reports, event_bounds=event_bounds,
             reputation=reputation, session=session,
             oracle_kwargs=dict(oracle_kwargs),
-            backend=backend or self.config.backend, tenant=tenant)
+            backend=backend or self.config.backend, tenant=tenant,
+            # capture the submitting thread's trace context (the RPC
+            # dispatch span on a fleet worker) so the batcher thread's
+            # execution span stays in the same trace (ISSUE 18)
+            trace=obs.trace_context())
         if req.backend not in BACKENDS:
             raise InputError(f"unknown backend {req.backend!r}")
         ms = (self.config.default_deadline_ms if deadline_ms is None

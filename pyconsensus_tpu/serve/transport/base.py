@@ -44,6 +44,10 @@ class WorkerBase:
         self.name = str(name)
         self.alive = True                       # guarded-by: none
         self.last_heartbeat = time.monotonic()  # guarded-by: none
+        #: round-trip latency of the last SUCCESSFUL beat (None until
+        #: one lands) — racy like last_heartbeat; the staleness
+        #: declaration logs it as forensic context (ISSUE 18)
+        self.last_heartbeat_latency_s = None    # guarded-by: none
         #: serializes concurrent death declarations for THIS worker —
         #: exactly one takeover runs; the losers observe its result
         self.declare_lock = threading.Lock()

@@ -150,21 +150,35 @@ class RpcClient:
     # -- the call -------------------------------------------------------
 
     def call(self, method: str, params: Optional[dict] = None,
-             timeout_s: Optional[float] = None):
+             timeout_s: Optional[float] = None,
+             trace: Optional[dict] = None):
         """One RPC. Raises the unmarshalled taxonomy error the worker
         raised, ``TransportError`` on a damaged frame, or ``OSError``
         on a dead socket (the fleet translates those into worker-loss
-        semantics — this layer stays honest about what it saw)."""
+        semantics — this layer stays honest about what it saw).
+        ``trace`` overrides the ambient trace context — callers that
+        hop threads between span and wire (the socket handle's future
+        pool) capture it on the SUBMITTING thread and pass it here."""
         sock = self._checkout()
         with self._cond:
             self._seq += 1
             rid = self._seq
         start = time.monotonic()
+        env = {"id": rid, "method": str(method),
+               "params": dict(params or {})}
+        # router-side trace injection (ISSUE 18): when the calling
+        # thread is inside a traced span (or the caller captured one
+        # before hopping threads), its context rides the envelope —
+        # one extra key, canonically encoded by the wire layer (sorted
+        # keys), so traced frames are byte-stable and untraced frames
+        # keep the pre-ISSUE-18 form
+        tctx = trace if trace is not None else obs.TRACER.context()
+        if tctx is not None:
+            env["trace"] = dict(tctx)
         try:
             if timeout_s is not None:
                 sock.settimeout(float(timeout_s))
-            wire.send_msg(sock, {"id": rid, "method": str(method),
-                                 "params": dict(params or {})})
+            wire.send_msg(sock, env)
             reply = wire.recv_msg(sock)
         except BaseException:
             # a connection that failed mid-call is never reused: the
@@ -272,11 +286,21 @@ class RpcServer:
         rid = msg.get("id")
         method = msg.get("method")
         handler = self.handlers.get(method)
+        tctx = msg.get("trace")
         try:
             if handler is None:
                 raise TransportError(f"unknown rpc method {method!r}",
                                      reason="method", method=method)
-            result = handler(dict(msg.get("params") or {}))
+            if tctx:
+                # worker-side trace extraction (ISSUE 18): the dispatch
+                # runs inside a span parented to the wire context, so
+                # the merged forest crosses the RPC hop with correct
+                # parentage; untraced calls skip the span entirely
+                with obs.TRACER.span_under(f"rpc.{method}", dict(tctx),
+                                           method=str(method)):
+                    result = handler(dict(msg.get("params") or {}))
+            else:
+                result = handler(dict(msg.get("params") or {}))
         except Exception as exc:    # noqa: BLE001 — EVERY handler error
             # crosses as a marshalled frame (taxonomy intact); only
             # BaseException (SimulatedCrash — the injected SIGKILL

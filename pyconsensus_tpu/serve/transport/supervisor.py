@@ -182,11 +182,21 @@ class SocketWorkerHandle(WorkerBase):
     def heartbeat(self) -> bool:
         if not self.alive or not self.process.running:
             return False    # an exited process can never beat again
+        start = time.monotonic()
         try:
             _faults.fire("fleet.heartbeat")
             reply = self._ctl.ping(timeout_s=1.0)
         except Exception:   # noqa: BLE001 — a lost probe, not a fault:
             return False    # socket timeout/refusal/injected flap alike
+        latency = time.monotonic() - start
+        self.last_heartbeat_latency_s = latency
+        obs.histogram(
+            "pyconsensus_fleet_heartbeat_seconds",
+            "router-observed heartbeat round-trip latency by worker "
+            "(over the socket transport this is a real RPC ping; a "
+            "rising tail is the early-warning signal ahead of a "
+            "staleness declaration)",
+            labels=("worker",)).observe(latency, worker=self.name)
         self._depth = int(reply.get("queue_depth", 0))
         self.last_heartbeat = time.monotonic()
         return True
@@ -245,9 +255,14 @@ class SocketWorkerHandle(WorkerBase):
         return exc
 
     def _rpc_future(self, method: str, params: dict):
+        # trace context is captured on the SUBMITTING thread (the span
+        # stack is thread-local — the pool thread that performs the
+        # wire call has none of its own) and rides the envelope
+        tctx = obs.trace_context()
+
         def run():
             try:
-                return self._data.call(method, params)
+                return self._data.call(method, params, trace=tctx)
             except Exception as exc:    # noqa: BLE001 — translated and
                 raise self._translate(exc) from exc     # re-raised into
         return self._pool.submit(run)                   # the Future
@@ -327,6 +342,21 @@ class SocketWorkerHandle(WorkerBase):
         except Exception:   # noqa: BLE001 — warming is fail-soft
             return 0        # (the takeover must not abort on it)
         return int(reply.get("adopted", 0))
+
+    # -- telemetry (ISSUE 18) --------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """The worker PROCESS's metric registry snapshot —
+        ``{"worker", "metrics"}`` — fetched over the data plane (a
+        scrape must never delay the control plane's heartbeat ping).
+        The fleet's collector merges these under a ``worker`` label."""
+        return self._data.call("metrics.snapshot", {})
+
+    def metrics_render(self) -> dict:
+        """The worker process's own Prometheus text exposition
+        (``{"worker", "text"}``) — per-worker debugging; the merged
+        cluster view is ``ConsensusFleet.render_metrics``."""
+        return self._data.call("metrics.render", {})
 
     # -- introspection ---------------------------------------------------
 

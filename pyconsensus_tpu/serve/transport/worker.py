@@ -48,7 +48,7 @@ class FleetWorkerProcess:
 
     def __init__(self, name: str, service, log_root,
                  shipped_root=None, shipper=None,
-                 result_wait_s: float = 300.0) -> None:
+                 result_wait_s: float = 300.0, recorder=None) -> None:
         self.name = str(name)
         self.service = service
         self.log_root = pathlib.Path(log_root)
@@ -56,6 +56,9 @@ class FleetWorkerProcess:
                              else pathlib.Path(shipped_root))
         self.shipper = shipper
         self.result_wait_s = float(result_wait_s)
+        #: optional obs.FlightRecorder — dumped on fence/SIGTERM so a
+        #: chaos run leaves a postmortem artifact (ISSUE 18)
+        self.recorder = recorder
         #: (session, relpath) records already shipped — staged journal
         #: records are immutable once written, so filename identity is
         #: enough; ledger.npz changes every round and is ALWAYS re-shipped
@@ -112,6 +115,11 @@ class FleetWorkerProcess:
                     self.service.sessions.get(name).fence(fence)
                 except Exception:   # noqa: BLE001 — fence best-effort
                     pass
+                if self.recorder is not None:
+                    try:            # postmortem artifact (ISSUE 18) —
+                        self.recorder.dump("fence")   # never masks the
+                    except Exception:   # noqa: BLE001 — fence itself
+                        pass
                 raise fence from exc
 
     def _seed_shipped(self, name: str) -> None:
@@ -227,6 +235,21 @@ class FleetWorkerProcess:
                           **dict(params.get("labels") or {}))
         return {"value": value}
 
+    def metrics_snapshot(self, params: dict) -> dict:
+        """The full registry snapshot (histogram bucket counts + edges
+        included) — what the supervisor-side collector merges into the
+        cluster view with a ``worker`` label (ISSUE 18 tentpole (a))."""
+        from ... import obs
+
+        return {"worker": self.name, "metrics": obs.REGISTRY.snapshot()}
+
+    def metrics_render(self, params: dict) -> dict:
+        """This worker's registry as Prometheus text exposition — the
+        per-worker debugging view behind the merged endpoint."""
+        from ... import obs
+
+        return {"worker": self.name, "text": obs.render_prom()}
+
     def stats(self, params: dict) -> dict:
         return {"worker": self.name, "pid": os.getpid(),
                 "queue_depth": len(self.service.queue),
@@ -248,6 +271,8 @@ class FleetWorkerProcess:
                 "release_session": self.release_session,
                 "warm_from_disk": self.warm_from_disk,
                 "metric": self.metric, "stats": self.stats,  # consensus-lint: disable=CL902 — operator surface: scraped by tools/bench and the CI rehearsal via the raw call() hatch, not by the fleet client
+                "metrics.snapshot": self.metrics_snapshot,
+                "metrics.render": self.metrics_render,
                 "drain": self.drain}
 
 
@@ -274,9 +299,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--result-wait-s", type=float, default=300.0)
     args = ap.parse_args(argv)
 
+    from ... import obs
     from ..service import ConsensusService, ServeConfig
     from .rpc import RpcServer
     from .shipping import LogShipper
+
+    # this process's telemetry identity (ISSUE 18): spans written here
+    # carry source=<worker name>, so merged fleet JSONL reconstructs
+    # the cross-process forest without pid/uuid disambiguation
+    obs.TRACER.source = args.name
 
     cfg = (ServeConfig.from_dict(json.loads(args.config_json))
            if args.config_json else ServeConfig())
@@ -290,14 +321,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     shipper = (LogShipper(args.ship_host, args.ship_port,
                           label=f"{args.name}-shipper")
                if args.ship_port else None)
+    recorder = None
+    if cfg.flightrec_dir:
+        recorder = obs.FlightRecorder(
+            pathlib.Path(cfg.flightrec_dir) / args.name,
+            source=args.name)
     worker = FleetWorkerProcess(args.name, service, args.log_root,
                                 shipped_root=args.shipped_root,
                                 shipper=shipper,
-                                result_wait_s=args.result_wait_s)
+                                result_wait_s=args.result_wait_s,
+                                recorder=recorder)
     server = RpcServer(worker.handlers(), name=args.name,
                        port=args.port).start()
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def _sigterm(*_):
+        if recorder is not None:
+            try:        # last-gasp artifact BEFORE the drain: a hung
+                recorder.dump("sigterm")    # drain may never return
+            except Exception:   # noqa: BLE001
+                pass
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    if recorder is not None:
+        # the boot artifact is what a later kill -9 leaves behind:
+        # SIGKILL flushes nothing, so something recent must already be
+        # on disk the moment traffic starts
+        recorder.dump("boot")
     print(f"READY {server.port}", flush=True)
     stop.wait()
     try:
@@ -306,6 +357,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         server.close()
         if shipper is not None:
             shipper.close()
+        if recorder is not None:
+            try:
+                recorder.dump("shutdown")
+            except Exception:   # noqa: BLE001
+                pass
+        # ship this process's span log for the cross-process trace
+        # merge (ISSUE 18 tentpole (b)): obs.merge_jsonl over the
+        # router's and every worker's file rebuilds the forest
+        try:
+            obs.write_jsonl(
+                pathlib.Path(args.log_root) / f"trace-{args.name}.jsonl",
+                obs.events(), meta={"source": args.name})
+        except Exception:       # noqa: BLE001 — telemetry must not
+            pass                # turn a clean drain into a crash
     return 0
 
 

@@ -1,0 +1,458 @@
+"""The fleet-wide telemetry plane (ISSUE 18): cross-process metric
+aggregation (``merge_snapshot`` label algebra, the fleet's merged
+cluster view, the ``/metrics`` exposition endpoint), wire-propagated
+tracing (one span forest across a REAL 2-worker socket fleet's process
+boundaries), the windowed SLO monitor's math pinned against hand-built
+snapshot fixtures, and the flight recorder's postmortem artifacts —
+including the ones a real ``kill -9`` leaves behind."""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.obs import (FlightRecorder, MetricsRegistry,
+                                 SloMonitor, read_flight_dir,
+                                 targets_from_config)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# ----------------------------------------------- merged registry algebra
+
+
+class TestMergedRegistry:
+    def test_worker_label_added_and_counters_sum(self):
+        """Two per-worker snapshots fold into one registry, every
+        series widened by ``worker=<name>``; the merged total is the
+        arithmetic sum."""
+        merged = MetricsRegistry()
+        for name, n in (("w0", 3), ("w1", 5)):
+            src = MetricsRegistry()
+            src.counter("pyconsensus_serve_requests_total",
+                        "requests", labels=("path",)).inc(n, path="resolve")
+            merged.merge_snapshot(src.snapshot(), worker=name)
+        entry = merged.snapshot()["pyconsensus_serve_requests_total"]
+        assert sorted(entry["labels"]) == ["path", "worker"]
+        by_worker = {json.loads(k)["worker"]: v
+                     for k, v in entry["series"].items()}
+        assert by_worker == {"w0": 3.0, "w1": 5.0}
+        text = merged.render_prom()
+        assert 'worker="w0"' in text and 'worker="w1"' in text
+
+    def test_metric_already_carrying_the_label_keeps_its_own(self):
+        """The collision rule: a metric that already has a ``worker``
+        label (the router's own per-worker heartbeat histogram) must
+        NOT have its series collapsed onto the collector's
+        ``worker="router"`` — the series' own label wins."""
+        src = MetricsRegistry()
+        h = src.histogram("pyconsensus_fleet_heartbeat_seconds",
+                          "hb", labels=("worker",),
+                          buckets=(0.01, 0.1))
+        h.observe(0.002, worker="w0")
+        h.observe(0.002, worker="w1")
+        merged = MetricsRegistry()
+        merged.merge_snapshot(src.snapshot(), worker="router")
+        entry = merged.snapshot()["pyconsensus_fleet_heartbeat_seconds"]
+        workers = {json.loads(k)["worker"] for k in entry["series"]}
+        assert workers == {"w0", "w1"}          # not {"router"}
+
+    def test_histogram_counts_and_gauge_semantics(self):
+        """Histograms absorb bucket counts (re-renderable cluster-wide
+        quantiles); gauges take the snapshot value."""
+        src = MetricsRegistry()
+        src.histogram("pyconsensus_serve_request_seconds", "lat",
+                      buckets=(0.1, 1.0)).observe(0.05)
+        src.gauge("pyconsensus_serve_queue_depth", "depth").set(7)
+        merged = MetricsRegistry()
+        merged.merge_snapshot(src.snapshot(), worker="w0")
+        merged.merge_snapshot(src.snapshot(), worker="w0")  # idempotent kind,
+        snap = merged.snapshot()                            # additive counts
+        hist = snap["pyconsensus_serve_request_seconds"]
+        skey = json.dumps({"worker": "w0"}, sort_keys=True)
+        assert hist["series"][skey]["count"] == 2
+        assert hist["series"][skey]["counts"][0] == 2
+        assert hist["edges"] == [0.1, 1.0]
+        assert snap["pyconsensus_serve_queue_depth"]["series"][skey] == 7.0
+
+    def test_metrics_endpoint_golden_scrape(self):
+        """`/metrics` over real HTTP: 200 + Prometheus exposition
+        content type + HELP/TYPE/sample lines; anything else 404."""
+        reg = MetricsRegistry()
+        reg.counter("pyconsensus_serve_requests_total", "requests served",
+                    labels=("worker",)).inc(4, worker="w0")
+        srv = obs.start_metrics_server(0, reg.render_prom)
+        assert srv is not None
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                body = resp.read().decode("utf-8")
+            assert body == reg.render_prom()    # golden: scrape == render
+            assert "# HELP pyconsensus_serve_requests_total" in body
+            assert "# TYPE pyconsensus_serve_requests_total counter" in body
+            assert 'pyconsensus_serve_requests_total{worker="w0"} 4' in body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/other", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.close()
+
+
+# ----------------------------------------------------- SLO window math
+
+
+def _snap(requests=0.0, shed=0.0, queue=None, counts=None,
+          edges=(0.005, 0.05, 0.5)):
+    """Hand-built registry snapshot — the monitor reads snapshots, not
+    live metrics, exactly so these fixtures drive the real math."""
+    snap = {
+        "pyconsensus_serve_requests_total": {
+            "kind": "counter", "labels": [],
+            "series": {"": float(requests)}},
+        "pyconsensus_serve_shed_total": {
+            "kind": "counter", "labels": [],
+            "series": {"": float(shed)}},
+    }
+    if queue is not None:
+        snap["pyconsensus_serve_queue_depth"] = {
+            "kind": "gauge", "labels": [], "series": {"": float(queue)}}
+    if counts is not None:
+        snap["pyconsensus_serve_request_seconds"] = {
+            "kind": "histogram", "labels": [], "edges": list(edges),
+            "series": {"": {"sum": 0.0, "count": sum(counts),
+                            "counts": list(counts)}}}
+    return snap
+
+
+def _feed(monitor, timeline):
+    """Drive ``monitor`` through ``[(now, snapshot), ...]`` with an
+    explicit deterministic clock."""
+    feed = {"snap": {}}
+    monitor._snapshot_fn = lambda: feed["snap"]
+    for now, snap in timeline:
+        feed["snap"] = snap
+        monitor.sample(now=now)
+
+
+class TestSloWindow:
+    def test_rate_and_shed_ratio_from_counter_deltas(self):
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _snap(requests=0, shed=0, queue=2)),
+                  (1.0, _snap(requests=10, shed=1, queue=2))])
+        win = m.window()
+        assert win["request_rate_rps"] == 10.0
+        assert win["shed_ratio"] == 0.1
+        assert win["queue_depth"] == 2.0
+        assert win["window_s"] == 1.0
+
+    def test_quantiles_from_bucket_count_deltas(self):
+        """p50/p99 come from the WINDOW's bucket deltas, hand-checked:
+        100 window requests split 90/9/1 over edges 5ms/50ms/500ms →
+        nearest-rank p50 = 5ms, p99 = 50ms."""
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _snap(counts=[0, 0, 0, 0])),
+                  (1.0, _snap(counts=[90, 9, 1, 0]))])
+        win = m.window()
+        assert win["p50_ms"] == 5.0
+        assert win["p99_ms"] == 50.0
+
+    def test_overflow_bucket_reports_overflow(self):
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _snap(counts=[0, 0, 0, 0])),
+                  (1.0, _snap(counts=[0, 0, 0, 5]))])
+        assert m.summary()["p99_ms"] == "overflow"
+
+    def test_metric_born_inside_window_still_quantiles(self):
+        """The earliest window sample predates the latency metric's
+        first observation — the cumulative distribution IS the window
+        and must not be discarded."""
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _snap()),                       # no histogram yet
+                  (1.0, _snap(counts=[0, 2, 0, 0]))])
+        assert m.window()["p50_ms"] == 50.0
+
+    def test_samples_age_out_of_the_window(self):
+        m = SloMonitor(window_s=10.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _snap(requests=0)),
+                  (5.0, _snap(requests=100)),
+                  (12.0, _snap(requests=110))])
+        win = m.window()
+        # the t=0 sample fell out: rate is over [5, 12] only
+        assert win["samples"] == 3
+        assert win["request_rate_rps"] == round(10 / 7, 3)
+
+    def test_violation_seconds_accumulate_per_target(self):
+        """Every second the window spends past a target is charged to
+        that target's label — 2s sample gap in violation → 2s."""
+        m = SloMonitor(targets={"p99_ms": 10.0}, window_s=60.0,
+                       snapshot_fn=dict)
+        _feed(m, [(0.0, _snap(counts=[0, 0, 0, 0])),
+                  (2.0, _snap(counts=[0, 0, 10, 0]))])  # p99 = 500ms
+        s = m.summary()
+        assert s["p99_ms"] == 500.0
+        assert s["targets"] == {"p99_ms": 10.0}
+        assert s["violation_s"]["p99_ms"] == pytest.approx(2.0)
+        # the accounting counter is the autoscaler-facing mirror
+        assert (obs.value("pyconsensus_slo_violation_seconds",
+                          slo="p99_ms") or 0) >= 2.0
+
+    def test_within_target_charges_nothing(self):
+        m = SloMonitor(targets={"p99_ms": 1000.0, "shed_ratio": 0.5},
+                       window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _snap(requests=0, counts=[0, 0, 0, 0])),
+                  (1.0, _snap(requests=10, counts=[10, 0, 0, 0]))])
+        assert m.summary()["violation_s"] == {}
+
+    def test_unknown_target_refused(self):
+        with pytest.raises(ValueError, match="p95_ms"):
+            SloMonitor(targets={"p95_ms": 1.0})
+
+    def test_targets_from_serve_config(self):
+        from pyconsensus_tpu.serve import ServeConfig
+
+        assert targets_from_config(ServeConfig()) == {}
+        got = targets_from_config(
+            ServeConfig(slo_p99_ms=50.0, slo_shed_ratio=0.01))
+        assert got == {"p99_ms": 50.0, "shed_ratio": 0.01}
+
+
+# ------------------------------------- the real cross-process plane
+
+
+@pytest.fixture
+def router_source():
+    old = obs.TRACER.source
+    obs.TRACER.source = "router"
+    yield
+    obs.TRACER.source = old
+
+
+def test_cross_process_aggregation_and_tracing(tmp_path, router_source):
+    """The tentpole end to end over a REAL 2-worker socket fleet: the
+    merged cluster view carries worker-labeled series summing to the
+    client-observed totals, the merged endpoint scrapes it over HTTP,
+    and after shutdown the shipped span files reconstruct ONE forest
+    whose router-rooted traces descend into worker processes."""
+    from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+    from pyconsensus_tpu.serve.service import ServeConfig
+
+    log_dir = tmp_path / "fleet"
+    fleet = ConsensusFleet(FleetConfig(
+        n_workers=2, transport="socket", log_dir=str(log_dir),
+        worker=ServeConfig(warmup=(), pallas_buckets=False))).start(
+            warmup=False)
+    try:
+        rng = np.random.default_rng(7)
+        matrix = rng.choice([0.0, 1.0], size=(12, 8))
+        futs = [fleet.submit(reports=matrix, backend="numpy",
+                             tenant="telem") for _ in range(4)]
+        for f in futs:
+            f.result(timeout=120)
+        fleet.check_workers()           # land the heartbeat histogram
+
+        # (a) aggregation: worker-labeled sums match the client's view
+        merged = fleet.merged_snapshot()
+        req = merged["pyconsensus_serve_requests_total"]["series"]
+        worker_sum = sum(
+            int(v) for k, v in req.items()
+            if (json.loads(k) if k else {}).get("worker", "")
+            .startswith("w"))
+        assert worker_sum == 4
+        hb = merged["pyconsensus_fleet_heartbeat_seconds"]["series"]
+        assert {json.loads(k)["worker"]
+                for k in hb} >= {"w0", "w1"}
+
+        text = fleet.render_metrics()
+        assert "# TYPE pyconsensus_serve_requests_total counter" in text
+        assert 'worker="w0"' in text and 'worker="w1"' in text
+
+        # the merged endpoint, scraped over real HTTP mid-run
+        srv = obs.start_metrics_server(0, fleet.render_metrics)
+        assert srv is not None
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.status == 200
+                body = resp.read().decode("utf-8")
+            assert 'worker="w0"' in body and 'worker="w1"' in body
+        finally:
+            srv.close()
+    finally:
+        # graceful close: workers write trace-<name>.jsonl on the way out
+        fleet.close(drain=True, timeout=60.0)
+
+    # (b) tracing: merge every process's spans into one forest
+    trace_files = sorted(str(p) for p in
+                         log_dir.glob("*/trace-*.jsonl"))
+    assert len(trace_files) == 2
+    events = obs.merge_jsonl(trace_files) + list(obs.events())
+    forest = obs.trace_forest(events)
+
+    def crosses(node, root_src):
+        if node.get("source") != root_src:
+            return True
+        return any(crosses(c, root_src) for c in node["children"])
+
+    def walk(node):
+        yield node
+        for c in node["children"]:
+            yield from walk(c)
+
+    mine = [r for tid, roots in forest.items()
+            for r in roots
+            if isinstance(tid, str) and tid.startswith("~telem:")]
+    assert len(mine) == 4
+    for root in mine:
+        assert root["name"] == "fleet.submit"
+        assert root["source"] == "router"
+        assert crosses(root, "router"), \
+            "trace never descended into a worker process"
+        spans = list(walk(root))
+        # the RPC hop crossed with parentage intact: a worker-side
+        # rpc.* dispatch span sits under the router's root
+        assert any(s["name"].startswith("rpc.")
+                   and s["source"] in ("w0", "w1") for s in spans)
+
+
+# ------------------------------------------------- flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_deltas_and_dump_tool(self, tmp_path):
+        """Artifacts land in the ring with metric DELTAS between dumps;
+        the pretty-printer renders them and exits 0."""
+        c = obs.counter("pyconsensus_telemetry_probe_total",
+                        "test-only counter (never shipped)")
+        rec = FlightRecorder(tmp_path / "fr", source="t0")
+        with obs.TRACER.span("flightrec.probe"):
+            c.inc(3)
+        rec.dump("boot")
+        c.inc(2)
+        rec.dump("shutdown")
+
+        recs = read_flight_dir(tmp_path / "fr")
+        assert [r["reason"] for r in recs] == ["boot", "shutdown"]
+        assert all(r["format"] == "pyconsensus-flightrec-v1"
+                   for r in recs)
+        delta = recs[1]["metric_deltas"][
+            "pyconsensus_telemetry_probe_total"]
+        assert delta["series"][""] == 2.0       # NOT the cumulative 5
+        assert any(sp["name"] == "flightrec.probe"
+                   for sp in recs[0]["spans"])
+
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "flightrec_dump.py"),
+             str(tmp_path / "fr")],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "reason=shutdown" in out.stdout
+        assert "pyconsensus_telemetry_probe_total" in out.stdout
+
+    @pytest.mark.slow
+    def test_kill9_leaves_postmortem_artifacts(self, tmp_path):
+        """A real ``SIGKILL`` mid-fleet: the victim's boot artifact is
+        already on disk, and the router's monitor dumps a ``takeover``
+        artifact when it declares the death — the black box survives
+        the crash it instruments."""
+        from pyconsensus_tpu.serve.fleet import (ConsensusFleet,
+                                                 FleetConfig)
+        from pyconsensus_tpu.serve.service import ServeConfig
+
+        frd = tmp_path / "flightrec"
+        fleet = ConsensusFleet(FleetConfig(
+            n_workers=3, transport="socket", monitor=True,
+            heartbeat_timeout_s=1.0, heartbeat_interval_s=0.25,
+            log_dir=str(tmp_path / "fleet"),
+            worker=ServeConfig(warmup=(), pallas_buckets=False,
+                               flightrec_dir=str(frd)))).start(
+                                   warmup=False)
+        try:
+            owner = fleet.create_session("chaos", n_reporters=12)
+            handle = fleet.workers[owner]
+            os.kill(handle.process.proc.pid, signal.SIGKILL)
+            handle.process.proc.wait(timeout=30)
+
+            deadline = time.monotonic() + 30.0
+            takeovers = []
+            while time.monotonic() < deadline and not takeovers:
+                takeovers = [r for r in read_flight_dir(frd / "router")
+                             if r["reason"] == "takeover"]
+                time.sleep(0.25)
+            assert takeovers, "monitor never dumped a takeover artifact"
+            assert takeovers[-1]["source"] == "router"
+
+            boots = [r for r in read_flight_dir(frd / owner)
+                     if r["reason"] == "boot"]
+            assert boots and boots[0]["source"] == owner
+
+            out = subprocess.run(
+                [sys.executable,
+                 str(REPO / "tools" / "flightrec_dump.py"),
+                 str(frd), "--all"],
+                capture_output=True, text=True, timeout=60)
+            assert out.returncode == 0, out.stderr
+            assert "reason=takeover" in out.stdout
+            assert "reason=boot" in out.stdout
+        finally:
+            fleet.close(drain=False, timeout=10.0)
+
+
+# ------------------------------------------------------- bench_diff
+
+
+class TestBenchDiff:
+    def _tool(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            import bench_diff
+        finally:
+            sys.path.pop(0)
+        return bench_diff
+
+    def test_digest_mismatch_always_fails(self, tmp_path, capsys):
+        bd = self._tool()
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(
+            {"pipeline": {"digest_match": "aaa", "rps": 100.0}}))
+        b.write_text(json.dumps(
+            {"pipeline": {"digest_match": "bbb", "rps": 100.0}}))
+        assert bd.main([str(a), str(b)]) == 1
+        assert "DIGEST MISMATCH" in capsys.readouterr().out
+
+    def test_numeric_drift_tolerated_unless_gated(self, tmp_path):
+        bd = self._tool()
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps({"serve": {"rps": 100.0, "d": "x"}}))
+        b.write_text(json.dumps({"serve": {"rps": 350.0, "d": "x"}}))
+        assert bd.main([str(a), str(b)]) == 0           # rtol 0.5 default
+        assert bd.main([str(a), str(b), "--fail-on-drift"]) == 1
+        assert bd.main([str(a), str(b), "--rtol", "5.0",
+                        "--fail-on-drift"]) == 0
+
+    def test_bench_wrapper_unwrapped_and_blocks_filter(self, tmp_path):
+        bd = self._tool()
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps({"parsed": {
+            "economy": {"mechanism_digest": "m1"},
+            "serve": {"rps": 1.0}}}))
+        b.write_text(json.dumps({
+            "economy": {"mechanism_digest": "m2"},
+            "serve": {"rps": 1.0}}))
+        assert bd.main([str(a), str(b)]) == 1           # digest differs
+        assert bd.main([str(a), str(b), "--blocks", "serve"]) == 0

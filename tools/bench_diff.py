@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Compare two bench artifacts block by block (ISSUE 18 satellite).
+
+Bench JSON (``bench.py`` stdout, or a ``BENCH_r*.json`` wrapper whose
+payload sits under ``"parsed"``) is a tree of probe blocks. Two runs of
+the same commit should agree on every DIGEST exactly (bit-determinism
+is the repo's contract — a digest drift is a correctness regression,
+never noise) and on every NUMERIC leaf within an honest tolerance
+(throughput numbers wobble; digests do not). This tool encodes that
+split:
+
+- **digest keys** (any key containing ``digest`` — e.g. the pipeline
+  block's ``digest_match``, the economy block's ``mechanism_digest``)
+  must match EXACTLY: any mismatch exits 1 regardless of flags.
+- **numeric leaves** drift within ``--rtol``/``--atol``; out-of-band
+  drift is reported, and fails the run only with ``--fail-on-drift``.
+- **structure** (a block present in one artifact only, a string that
+  changed) is reported as a note — growth PRs add blocks; that is not
+  a regression.
+
+Usage::
+
+    python tools/bench_diff.py BENCH_r07.json BENCH_r08.json
+    python tools/bench_diff.py a.json b.json --rtol 0.5 --fail-on-drift
+    python tools/bench_diff.py a.json b.json --blocks pipeline,serve
+
+Exit code: 0 = digests match (and drift within band, with
+``--fail-on-drift``); 1 = digest mismatch or gated drift; 2 = unusable
+input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+__all__ = ["diff_blocks", "main"]
+
+
+def _load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: top level is not a JSON object")
+    # BENCH_r*.json wraps the bench stdout under "parsed"
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def diff_blocks(a, b, rtol: float, atol: float, path: str = "") -> list:
+    """Recursive aligned walk; returns findings as dicts with ``kind``
+    in {"digest", "drift", "changed", "only_a", "only_b"}. Iteration is
+    sorted throughout — the report is a serialized artifact and must
+    not depend on dict order."""
+    out: list = []
+    if isinstance(a, dict) and isinstance(b, dict):
+        for k in sorted(set(a) | set(b)):
+            sub = f"{path}/{k}"
+            if k not in a:
+                out.append({"kind": "only_b", "path": sub})
+            elif k not in b:
+                out.append({"kind": "only_a", "path": sub})
+            else:
+                out.extend(diff_blocks(a[k], b[k], rtol, atol, sub))
+        return out
+    if isinstance(a, list) and isinstance(b, list):
+        for i in range(max(len(a), len(b))):
+            sub = f"{path}[{i}]"
+            if i >= len(a):
+                out.append({"kind": "only_b", "path": sub})
+            elif i >= len(b):
+                out.append({"kind": "only_a", "path": sub})
+            else:
+                out.extend(diff_blocks(a[i], b[i], rtol, atol, sub))
+        return out
+    # leaves -----------------------------------------------------------
+    key = path.rsplit("/", 1)[-1]
+    if "digest" in key:
+        if a != b:
+            out.append({"kind": "digest", "path": path,
+                        "a": a, "b": b})
+        return out
+    if _is_number(a) and _is_number(b):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) and math.isnan(fb):
+            return out
+        if abs(fa - fb) > atol + rtol * max(abs(fa), abs(fb)):
+            rel = (abs(fa - fb) / max(abs(fa), abs(fb))
+                   if max(abs(fa), abs(fb)) > 0 else math.inf)
+            out.append({"kind": "drift", "path": path, "a": a, "b": b,
+                        "rel": round(rel, 4)})
+        return out
+    if a != b:
+        out.append({"kind": "changed", "path": path, "a": a, "b": b})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare two bench JSON artifacts block by block: "
+                    "digests must match exactly, numerics within "
+                    "tolerance (ISSUE 18 satellite)")
+    ap.add_argument("a", help="first bench artifact (baseline)")
+    ap.add_argument("b", help="second bench artifact (candidate)")
+    ap.add_argument("--rtol", type=float, default=0.5,
+                    help="relative tolerance for numeric leaves "
+                         "(default 0.5 — throughput wobbles; tighten "
+                         "for controlled environments)")
+    ap.add_argument("--atol", type=float, default=1e-9,
+                    help="absolute tolerance floor for numeric leaves")
+    ap.add_argument("--blocks", default=None,
+                    help="comma-separated top-level blocks to compare "
+                         "(default: every block present in either)")
+    ap.add_argument("--fail-on-drift", action="store_true",
+                    help="numeric drift beyond tolerance also exits 1 "
+                         "(digest mismatches always do)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the findings as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    try:
+        a, b = _load(args.a), _load(args.b)
+    except (OSError, ValueError) as exc:
+        print(f"ERROR: {exc}", file=sys.stderr)
+        return 2
+
+    if args.blocks:
+        keep = [s.strip() for s in args.blocks.split(",") if s.strip()]
+        a = {k: a[k] for k in keep if k in a}
+        b = {k: b[k] for k in keep if k in b}
+
+    findings = diff_blocks(a, b, args.rtol, args.atol)
+    digests = [f for f in findings if f["kind"] == "digest"]
+    drifts = [f for f in findings if f["kind"] == "drift"]
+    notes = [f for f in findings if f["kind"] in ("changed", "only_a",
+                                                  "only_b")]
+    if args.as_json:
+        print(json.dumps({"digest_mismatches": digests,
+                          "drift": drifts, "notes": notes,
+                          "rtol": args.rtol, "atol": args.atol},
+                         indent=2, sort_keys=True))
+    else:
+        for f in digests:
+            print(f"DIGEST MISMATCH {f['path']}: "
+                  f"{f['a']!r} != {f['b']!r}")
+        for f in drifts:
+            print(f"drift {f['path']}: {f['a']} -> {f['b']} "
+                  f"(rel {f['rel']})")
+        for f in notes:
+            if f["kind"] == "changed":
+                print(f"note {f['path']}: {f['a']!r} -> {f['b']!r}")
+            else:
+                which = "first" if f["kind"] == "only_a" else "second"
+                print(f"note {f['path']}: only in {which} artifact")
+        print(f"{len(digests)} digest mismatch(es), {len(drifts)} "
+              f"numeric drift(s) beyond rtol={args.rtol}, "
+              f"{len(notes)} structural note(s)")
+    if digests:
+        return 1
+    if drifts and args.fail_on_drift:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

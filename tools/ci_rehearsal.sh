@@ -1062,6 +1062,131 @@ rm -rf "$MPDIR"
   pyconsensus_tpu/serve/transport \
   && echo "multi-process chaos lint OK: CL401-404 + CL801-805 + CL901-905 green over serve/transport"
 
+echo "=== Telemetry plane smoke (ISSUE 18: merged /metrics + cross-process traces + SLO accounting + bench diff) ==="
+# The fleet-wide telemetry plane end to end, through the REAL CLI
+# against a 2-PROCESS socket fleet: (1) the merged /metrics endpoint
+# is scraped over live HTTP inside the --metrics-hold-s window (the
+# workers must still be up — the merged render asks them over the
+# wire), and the worker-labeled ok-request sums must equal the
+# client-observed success total; (2) the per-process span files the
+# workers ship at shutdown plus the router's --trace-out reconstruct
+# ONE forest whose router-rooted traces descend into worker
+# processes; (3) a deliberately impossible p99 target (0.0001 ms)
+# makes the SLO monitor charge provably nonzero
+# pyconsensus_slo_violation_seconds, visible in the CLI JSON summary
+# AND in the merged scrape; (4) two bench artifacts of the same build
+# must agree under tools/bench_diff.py — digests exactly, numerics
+# within tolerance.
+TELDIR=$(mktemp -d /tmp/ci-telemetry.XXXXXX)
+"$VENV/bin/pyconsensus-serve" --fleet-workers 2 --transport socket \
+  --requests 32 --concurrency 4 --shapes 12x48 \
+  --slo-p99-ms 0.0001 --slo-window-s 30 \
+  --metrics-port 0 --metrics-hold-s 8 \
+  --log-dir "$TELDIR/fleet" --trace-out "$TELDIR/router-trace.jsonl" \
+  >"$TELDIR/stats.json" 2>"$TELDIR/stderr.log" &
+TEL_PID=$!
+# discover the bound port from the CLI's stderr announcement, then
+# scrape the merged endpoint once the hold window opens (the counters
+# are final by then — the hold starts after the load run)
+"$PY" - "$TELDIR" <<'PYEOF'
+import pathlib, re, sys, time, urllib.request
+
+d = pathlib.Path(sys.argv[1])
+deadline = time.monotonic() + 180
+
+def stderr_text():
+    p = d / "stderr.log"
+    return p.read_text() if p.exists() else ""
+
+port = None
+while time.monotonic() < deadline and port is None:
+    m = re.search(r"metrics endpoint: http://127\.0\.0\.1:(\d+)/metrics",
+                  stderr_text())
+    port = int(m.group(1)) if m else None
+    port or time.sleep(0.25)
+assert port, "CLI never announced the metrics endpoint"
+while time.monotonic() < deadline and \
+        "holding /metrics open" not in stderr_text():
+    time.sleep(0.25)
+body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                              timeout=30).read().decode("utf-8")
+(d / "scrape.prom").write_text(body)
+print(f"scraped merged /metrics on port {port}: {len(body)} bytes")
+PYEOF
+wait "$TEL_PID"
+"$PY" - "$TELDIR" <<'PYEOF'
+import json, pathlib, re, sys
+
+from pyconsensus_tpu import obs
+
+d = pathlib.Path(sys.argv[1])
+stats = json.loads((d / "stats.json").read_text())
+scrape = (d / "scrape.prom").read_text()
+
+# (1) aggregation: worker-labeled ok-request sums == client total
+pat = re.compile(
+    r'^pyconsensus_serve_requests_total\{([^}]*)\}\s+(\S+)$', re.M)
+per_worker = {}
+for labels, val in pat.findall(scrape):
+    lab = dict(kv.split("=", 1) for kv in labels.split(","))
+    w = lab.get("worker", '""').strip('"')
+    if w.startswith("w") and lab.get("outcome") == '"ok"':
+        per_worker[w] = per_worker.get(w, 0.0) + float(val)
+assert stats["succeeded"] == 32 and stats["failed"] == 0, stats
+total = int(sum(per_worker.values()))
+assert total == stats["succeeded"], (
+    f"worker-labeled sums {per_worker} != client total "
+    f"{stats['succeeded']}")
+assert len(per_worker) == 2, per_worker
+hb = re.findall(r'pyconsensus_fleet_heartbeat_seconds_count'
+                r'\{[^}]*worker="w\d+"[^}]*\}', scrape)
+assert len(hb) >= 2, "merged scrape lost the per-worker heartbeats"
+
+# (3) SLO: the impossible target charged real seconds, in the summary
+# AND in the merged scrape (router-registry series, worker-labeled)
+viol = stats["slo"]["violation_s"].get("p99_ms", 0)
+assert viol and viol > 0, stats["slo"]
+assert re.search(
+    r'pyconsensus_slo_violation_seconds\{[^}]*slo="p99_ms"', scrape), \
+    "violation counter missing from the merged scrape"
+
+# (2) tracing: one merged forest; router-rooted traces must descend
+# across the RPC hop into worker-side spans
+trace_files = sorted(
+    str(p) for p in (d / "fleet").glob("*/trace-*.jsonl"))
+assert len(trace_files) == 2, trace_files
+events = obs.merge_jsonl(trace_files
+                         + [str(d / "router-trace.jsonl")])
+forest = obs.trace_forest(events)
+
+def crosses(node, src):
+    return (node.get("source") != src
+            or any(crosses(c, src) for c in node["children"]))
+
+cross = sum(
+    1 for roots in forest.values() for r in roots
+    if r.get("source") == "router" and r["name"] == "fleet.submit"
+    and crosses(r, "router"))
+assert cross > 0, "no router-rooted trace descended into a worker"
+print(f"telemetry plane OK: {total} worker-labeled ok requests == "
+      f"client total over {len(per_worker)} workers in one scrape, "
+      f"{cross} cross-process trace(s), "
+      f"slo_violation_seconds[p99_ms]={viol}s")
+PYEOF
+
+# (4) bench_diff over two artifacts of the same build: digests must
+# match exactly; throughput wobble stays inside the default tolerance
+for run in a b; do
+  "$PY" bench.py --reporters 48 --events 128 --repeats 1 --batches 1 \
+    --max-iterations 1 --no-latency --no-roofline --no-device-scaling \
+    --no-incremental --no-serve --no-cold-start --no-econ \
+    --no-multiproc --no-telemetry --no-fleet --bench-timeout 300 \
+    | tail -1 >"$TELDIR/bench-$run.json"
+done
+"$PY" tools/bench_diff.py "$TELDIR/bench-a.json" "$TELDIR/bench-b.json" \
+  && echo "bench_diff OK: two same-build artifacts agree (digests exact)"
+rm -rf "$TELDIR"
+
 echo "=== Adversarial economy smoke (ISSUE 11: adaptive cartels through a 2-worker fleet) ==="
 # The economic-soundness acceptance criterion end to end: (1) a 3-round
 # camouflage-cartel economy runs through a 2-worker fleet — honest

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Pretty-print flight-recorder artifacts (ISSUE 18 satellite).
+
+A fleet process with ``ServeConfig.flightrec_dir`` set keeps a bounded
+on-disk ring of its last spans + metric deltas
+(``pyconsensus_tpu.obs.flightrec``), dumped at boot, fence, SIGTERM,
+shutdown, and router takeovers — the artifacts a ``kill -9`` chaos run
+leaves behind. This tool renders a directory of them for a human:
+
+    python tools/flightrec_dump.py /var/log/fleet-flightrec/w0
+    python tools/flightrec_dump.py /var/log/fleet-flightrec --all
+    python tools/flightrec_dump.py DIR --json       # machine-readable
+
+``--all`` recurses one level (the per-process subdirectories the fleet
+lays out: ``router/``, ``w0/``, ...). Exit 0 if any artifact was
+readable, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+__all__ = ["render_flight", "main"]
+
+
+def render_flight(rec: dict) -> str:
+    """One flight record -> human-readable text block."""
+    lines = [f"=== {rec.get('_path', '<memory>')} ===",
+             f"source={rec.get('source', '?')} "
+             f"reason={rec.get('reason', '?')} seq={rec.get('seq', '?')}"]
+    spans = rec.get("spans") or []
+    lines.append(f"-- last {len(spans)} span(s) --")
+    for sp in spans:
+        dur = sp.get("duration_s")
+        dur_txt = f"{dur * 1e3:9.3f}ms" if isinstance(dur, (int, float)) \
+            else "         ?"
+        trace = sp.get("trace_id")
+        lines.append(
+            "  " + "  " * int(sp.get("depth", 0))
+            + f"{sp.get('name', '?')} {dur_txt} "
+            + f"[{sp.get('status', '?')}]"
+            + (f" trace={trace}" if trace else ""))
+    deltas = rec.get("metric_deltas") or {}
+    lines.append(f"-- {len(deltas)} metric delta(s) since previous "
+                 f"dump --")
+    for name in sorted(deltas):
+        entry = deltas[name]
+        kind = entry.get("kind", "?")
+        series = entry.get("series") or {}
+        for skey in sorted(series):
+            d = series[skey]
+            if kind == "histogram" and isinstance(d, dict):
+                txt = (f"+{d.get('count', 0)} obs, "
+                       f"+{d.get('sum', 0.0):.6g}s")
+            else:
+                txt = f"+{d}"
+            lines.append(f"  {name}{skey or ''} ({kind}) {txt}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render flight-recorder dump directories "
+                    "(obs.flightrec) for humans")
+    ap.add_argument("dir", help="a flight-recorder directory (one "
+                                "process's ring of flight-*.json)")
+    ap.add_argument("--all", action="store_true", dest="recurse",
+                    help="treat DIR as the fleet root and render every "
+                         "per-process subdirectory (router/, w0/, ...)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the parsed records as one JSON array")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from pyconsensus_tpu.obs import read_flight_dir
+
+    root = pathlib.Path(args.dir)
+    dirs = ([p for p in sorted(root.iterdir()) if p.is_dir()]
+            if args.recurse else [root])
+    records: list = []
+    for d in dirs:
+        records.extend(read_flight_dir(d))
+    if args.as_json:
+        print(json.dumps(records, indent=2, sort_keys=True))
+    else:
+        for rec in records:
+            print(render_flight(rec))
+            print()
+        print(f"{len(records)} flight record(s) from "
+              f"{len(dirs)} director(ies)")
+    return 0 if records else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
