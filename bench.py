@@ -254,6 +254,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--telemetry-requests", type=int, default=16,
                     help="stateless requests driven through the "
                          "telemetry probe's socket fleet")
+    ap.add_argument("--no-autoscale", action="store_true",
+                    help="skip the fail-soft autoscale block (ISSUE 19:"
+                         " the same flash-crowd rate trace driven "
+                         "through an SLO-autoscaled elastic fleet and a "
+                         "static one — SLO-violation-seconds vs "
+                         "worker-hours, elastic should win both)")
+    ap.add_argument("--autoscale-burst-rps", type=float, default=28.0,
+                    help="flash-crowd peak offered rate of the "
+                         "autoscale probe")
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fail-soft fleet chaos probe (worker "
                          "kill mid-traffic + session failover, appended "
@@ -537,6 +546,7 @@ def run_bench(args) -> None:
     out_json["fleet"] = _fleet_block(args)
     out_json["multiproc"] = _multiproc_block(args)
     out_json["telemetry"] = _telemetry_block(args)
+    out_json["autoscale"] = _autoscale_block(args)
     out_json["economy"] = _economy_block(args)
     print(json.dumps(out_json))
 
@@ -1559,6 +1569,142 @@ def _telemetry_block(args):
         shutil.rmtree(log_dir, ignore_errors=True)
 
 
+def _autoscale_block(args):
+    """ISSUE 19 tentpole: the elastic-fleet headline number. The SAME
+    deterministic flash-crowd rate trace (steady base load, a
+    synchronized burst, quiet again) is driven twice — through a
+    1-worker fleet under the SLO autoscaler (scale-up on sustained
+    violation, graceful drain + live migration when the burst ends)
+    and through a STATIC 2-worker fleet — and the block reports the
+    two costs that trade against each other: SLO-violation-seconds
+    (the monitor's windowed accounting) and worker-hours (alive ring
+    size integrated over the run). Elastic should win BOTH: fewer
+    violation-seconds during the burst (it grows to 3), fewer
+    worker-hours overall (it idles at 1).
+
+    The capacity axis is ADMISSION: each worker carries a per-tenant
+    rate limit, so fleet admission capacity is ``10 rps x workers``
+    and the burst sheds (PYC401, ``shed_ratio`` SLO breach) on any
+    fleet too small for it — a model that holds on any host, unlike
+    compute throughput, which in-process workers on a small CI box
+    cannot scale. FAIL-SOFT like every probe block; ``--no-autoscale``
+    opts out."""
+    if args.no_autoscale:
+        return None
+
+    import shutil
+    import tempfile
+    import threading
+
+    def one_run(n_workers, elastic, trace, targets):
+        from pyconsensus_tpu import obs
+        from pyconsensus_tpu.serve import (AutoScaler, AutoscaleConfig,
+                                           ConsensusFleet, FleetConfig,
+                                           LoadGenerator, ServeConfig)
+
+        log_dir = tempfile.mkdtemp(prefix="bench-autoscale-")
+        fleet = None
+        scaler = None
+        stop = threading.Event()
+        hours = [0.0]
+        try:
+            # rate_limit_rps is per worker: the fleet's admission
+            # capacity grows 10 rps per member — the axis the burst
+            # must overflow on a too-small fleet
+            fleet = ConsensusFleet(FleetConfig(
+                n_workers=n_workers, log_dir=log_dir,
+                worker=ServeConfig(warmup=(), batch_window_ms=2.0,
+                                   rate_limit_rps=10.0,
+                                   pallas_buckets=False))).start(
+                                       warmup=False)
+            slo = obs.SloMonitor(targets=targets, window_s=2.0)
+            if elastic:
+                scaler = AutoScaler(fleet, slo, AutoscaleConfig(
+                    min_workers=1, max_workers=3, interval_s=0.15,
+                    up_signals=2, down_signals=4,
+                    cooldown_s=0.5)).run_in_thread()
+
+            def meter():        # worker-hours: alive ring x wall time
+                last = time.monotonic()
+                while not stop.wait(0.05):
+                    now = time.monotonic()
+                    hours[0] += len(fleet.ring.workers()) \
+                        * (now - last) / 3600.0
+                    last = now
+
+            th = threading.Thread(target=meter, daemon=True)
+            th.start()
+            slo.run_in_thread(interval_s=0.1)
+            # numpy backend: no compile stall pollutes the signal;
+            # retries off so each shed is counted once (this is an
+            # overload probe — PYC401 sheds ARE the measured outcome)
+            gen = LoadGenerator(fleet, shapes=((8, 16),),
+                                seed=args.serve_seed, max_retries=0,
+                                oracle_kwargs={"backend": "numpy"},
+                                slo=slo)
+            stats = gen.run_trace(trace, timeout_s=60.0)
+            stop.set()
+            th.join(timeout=2.0)
+            if scaler is not None:
+                scaler.stop()
+            violation = sum(
+                (stats.get("slo") or {}).get("violation_s",
+                                             {}).values())
+            return {
+                "workers_start": n_workers,
+                "workers_end": len(fleet.ring.workers()),
+                "requests": stats["requests"],
+                "succeeded": stats["succeeded"],
+                "abandoned": stats["abandoned"],
+                "errors": stats["errors"],
+                "latency_p99_ms": stats["latency_p99_ms"],
+                "slo_violation_s": round(violation, 3),
+                "worker_hours": round(hours[0], 6),
+                "autoscale": (scaler.status()["last_decision"]
+                              if scaler is not None else None),
+            }
+        finally:
+            stop.set()
+            if scaler is not None:
+                try:
+                    scaler.stop()
+                except Exception:             # noqa: BLE001
+                    pass
+            if fleet is not None:
+                try:
+                    fleet.close(drain=True, timeout=10.0)
+                except Exception:             # noqa: BLE001
+                    pass
+            shutil.rmtree(log_dir, ignore_errors=True)
+
+    try:
+        from pyconsensus_tpu.serve import RateTrace
+
+        # 28 rps overflows the static pair's 20 rps admission but not
+        # a 3-worker elastic fleet's 30; the long quiet phases are
+        # where the elastic fleet's 1-worker idle wins the hours
+        trace = RateTrace.flash_crowd(
+            base_rps=4.0, burst_rps=args.autoscale_burst_rps,
+            warm_s=3.0, burst_s=3.0, cool_s=5.0)
+        targets = {"shed_ratio": 0.05}
+        elastic = one_run(1, True, trace, targets)
+        static = one_run(2, False, trace, targets)
+        return {
+            "trace": trace.describe(),
+            "targets": targets,
+            "elastic": elastic,
+            "static": static,
+            "elastic_wins_violation": (elastic["slo_violation_s"]
+                                       <= static["slo_violation_s"]),
+            "elastic_wins_hours": (elastic["worker_hours"]
+                                   < static["worker_hours"]),
+        }
+    except Exception as exc:                  # noqa: BLE001
+        print(f"WARNING: autoscale block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
 def _economy_block(args):
     """ISSUE 11 tentpole (c): the "is the oracle economically sound
     under production traffic" number — an adversarial economy of
@@ -1869,6 +2015,10 @@ def main() -> None:
         # ditto the multiproc probe: spawning worker subprocesses is
         # not smoke material
         smoke_argv.append("--no-multiproc")
+    if "--no-autoscale" not in smoke_argv:
+        # the elastic-vs-static comparison runs two multi-second trace
+        # replays — not smoke material
+        smoke_argv.append("--no-autoscale")
     if "--no-telemetry" not in smoke_argv:
         # ditto the telemetry probe (it also spawns a socket fleet)
         smoke_argv.append("--no-telemetry")

@@ -72,6 +72,18 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="K",
                     help="incremental exact-refresh cadence (with "
                          "--incremental; default: the tier default)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO autoscaler over the fleet for "
+                         "the duration of the economy (needs "
+                         "--fleet-workers; ISSUE 19): a cartel's "
+                         "synchronized storm that sheds traffic grows "
+                         "the fleet, quiet rounds drain it back with "
+                         "live session migration")
+    ap.add_argument("--autoscale-max", type=int, default=3,
+                    help="autoscaler fleet-size ceiling")
+    ap.add_argument("--autoscale-shed-ratio", type=float, default=0.05,
+                    help="windowed shed-ratio SLO target driving the "
+                         "autoscaler")
     ap.add_argument("--fault-plan", metavar="PATH",
                     help="arm a seeded FaultPlan JSON over the run "
                          "(activation log printed on exit)")
@@ -117,7 +129,13 @@ def main(argv=None) -> int:
     plan = None
     if args.fault_plan:
         plan = _faults.arm(_faults.FaultPlan.load(args.fault_plan))
+    if args.autoscale and args.fleet_workers <= 0:
+        print("ERROR: --autoscale needs --fleet-workers (the "
+              "autoscaler resizes a fleet)", file=sys.stderr)
+        return 2
     service = None
+    scaler = None
+    slo = None
     try:
         if args.fleet_workers > 0:
             from ..serve.fleet import ConsensusFleet, FleetConfig
@@ -129,10 +147,42 @@ def main(argv=None) -> int:
             service = ConsensusFleet(FleetConfig(
                 n_workers=args.fleet_workers, worker=worker_cfg,
                 log_dir=args.log_dir)).start(warmup=False)
+            if args.autoscale:
+                from ..serve.autoscale import AutoScaler, AutoscaleConfig
+
+                slo = obs.SloMonitor(
+                    targets={"shed_ratio": args.autoscale_shed_ratio},
+                    window_s=2.0)
+                slo.run_in_thread(interval_s=0.1)
+                scaler = AutoScaler(service, slo, AutoscaleConfig(
+                    min_workers=args.fleet_workers,
+                    max_workers=args.autoscale_max,
+                    interval_s=0.2, up_signals=2, down_signals=8,
+                    cooldown_s=1.0)).run_in_thread()
         else:
             service = ConsensusService(worker_cfg).start(warmup=False)
         result = MarketEconomy(service, scenario).run()
+        if scaler is not None:
+            scaler.stop()
+            slo.stop()
+            status = scaler.status()
+            result["autoscale"] = {
+                "workers_start": args.fleet_workers,
+                "workers_end": len(service.ring.workers()),
+                "target": status["target"],
+                "decisions": {
+                    action: int(obs.value(
+                        "pyconsensus_autoscale_decisions_total",
+                        action=action) or 0)
+                    for action in ("scale_up", "scale_down",
+                                   "replace", "error")},
+            }
     finally:
+        if scaler is not None:
+            try:
+                scaler.stop()
+            except Exception:             # noqa: BLE001
+                pass
         if service is not None:
             service.close(drain=True)
         if plan is not None:

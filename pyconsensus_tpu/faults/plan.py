@@ -68,6 +68,7 @@ FAULT_SITES = (
     "tune.cache_write",
     "fleet.route", "fleet.heartbeat", "fleet.takeover",
     "fleet.ledger_replay",
+    "autoscale.decide", "autoscale.spawn", "autoscale.drain",
     "econ.round", "econ.panel", "econ.submit",
     "transport.send", "transport.recv", "transport.connect",
     "shipping.append",

@@ -73,13 +73,38 @@ def targets_from_config(cfg) -> Dict[str, float]:
     return out
 
 
-def _sum_counter(snap: dict, name: str) -> float:
+def _counter_series(snap: dict, name: str) -> Dict[str, float]:
+    """Per-series counter values — the window math differences each
+    labeled series independently (ISSUE 19): a worker joining or
+    leaving mid-window must not bend the cluster-wide delta."""
     entry = snap.get(name)
     if not entry:
-        return 0.0
+        return {}
     series = entry.get("series") or {}
+    return {k: float(series[k]) for k in series}
+
+
+def _delta_counter(first: Dict[str, float],
+                   last: Dict[str, float]) -> float:
+    """Windowed counter delta, summed over per-series deltas:
+
+    - series present in both samples: ``last - first``, and a NEGATIVE
+      per-series delta means the series' process restarted (counter
+      reset to 0) — charge ``last`` (requests since the reset), the
+      Prometheus ``rate()`` convention;
+    - series born inside the window (absent from ``first``): the whole
+      cumulative value is window-local (a fresh worker's counters start
+      at 0 when it joins) — charge ``last``;
+    - series gone by ``last`` (worker drained/died): contributes 0 —
+      conservative, never negative, never a phantom rate.
+    """
+    d = 0.0
     # sorted: float accumulation order must not depend on dict order
-    return float(sum(float(series[k]) for k in sorted(series)))
+    for k in sorted(last):
+        cur = float(last[k])
+        step = cur - float(first.get(k, 0.0))
+        d += cur if step < 0 else step
+    return d
 
 
 def _last_gauge(snap: dict, name: str) -> Optional[float]:
@@ -94,10 +119,11 @@ def _last_gauge(snap: dict, name: str) -> Optional[float]:
     return float(sum(float(series[k]) for k in sorted(series)))
 
 
-def _hist_counts(snap: dict, name: str):
-    """(edges, summed bucket counts) across every series of a histogram
-    snapshot entry — label sets (path, worker) collapse into one
-    cluster-wide latency distribution."""
+def _hist_series(snap: dict, name: str):
+    """(edges, per-series bucket counts) of a histogram snapshot entry.
+    Series stay separate until the WINDOW delta is taken — collapsing
+    first would let a disappearing series (worker drain/death) drive
+    bucket deltas negative (ISSUE 19)."""
     entry = snap.get(name)
     if not entry:
         return None, None
@@ -105,14 +131,35 @@ def _hist_counts(snap: dict, name: str):
     series = entry.get("series") or {}
     if edges is None or not series:
         return None, None
-    total = [0] * (len(edges) + 1)
-    for k in sorted(series):
+    n = len(edges) + 1
+    out: Dict[str, List[int]] = {}
+    for k in series:
         counts = series[k].get("counts")
-        if not counts or len(counts) != len(total):
+        if not counts or len(counts) != n:
             continue
-        for i, c in enumerate(counts):
-            total[i] += int(c)
-    return list(edges), total
+        out[k] = [int(c) for c in counts]
+    return list(edges), (out or None)
+
+
+def _delta_hist(first: Optional[Dict[str, List[int]]],
+                last: Dict[str, List[int]], n: int) -> List[int]:
+    """Windowed bucket-count deltas, per series then summed — the same
+    membership rules as :func:`_delta_counter` (born-inside-window
+    series charge their full counts; a reset series charges its
+    post-reset counts; a vanished series charges nothing)."""
+    total = [0] * n
+    first = first or {}
+    for k in sorted(last):
+        cur = last[k]
+        prev = first.get(k)
+        if prev is None or len(prev) != len(cur) or any(
+                int(b) < int(a) for a, b in zip(prev, cur)):
+            delta = [int(c) for c in cur]
+        else:
+            delta = [int(b) - int(a) for a, b in zip(prev, cur)]
+        for i, c in enumerate(delta):
+            total[i] += c
+    return total
 
 
 class SloMonitor:
@@ -158,12 +205,13 @@ class SloMonitor:
         since the previous sample. Returns the current window summary."""
         t = time.monotonic() if now is None else float(now)
         snap = self._snapshot()
-        edges, counts = _hist_counts(snap, self.latency_metric)
+        edges, counts = _hist_series(snap, self.latency_metric)
         rec = {
             "t": t,
-            "requests": _sum_counter(
+            "requests": _counter_series(
                 snap, "pyconsensus_serve_requests_total"),
-            "shed": _sum_counter(snap, "pyconsensus_serve_shed_total"),
+            "shed": _counter_series(
+                snap, "pyconsensus_serve_shed_total"),
             "queue_depth": _last_gauge(
                 snap, "pyconsensus_serve_queue_depth"),
             "edges": edges,
@@ -212,8 +260,11 @@ class SloMonitor:
                 first = rec
                 break
         dt = last["t"] - first["t"]
-        d_req = last["requests"] - first["requests"]
-        d_shed = last["shed"] - first["shed"]
+        single = first is last
+        d_req = 0.0 if single else _delta_counter(
+            first["requests"], last["requests"])
+        d_shed = 0.0 if single else _delta_counter(
+            first["shed"], last["shed"])
         out: dict = {
             "samples": len(self._samples),
             "window_s": round(min(self.window_s, max(dt, 0.0)), 3),
@@ -225,19 +276,20 @@ class SloMonitor:
             "p99_ms": None,
         }
         if last["counts"] is not None:
-            if (first is not last and first["counts"] is not None
-                    and last["edges"] == first["edges"]):
-                delta = [int(b) - int(a)
-                         for a, b in zip(first["counts"],
-                                         last["counts"])]
+            if (not single and last["edges"] == first["edges"]):
+                # per-series bucket deltas: a series born inside the
+                # window (new worker, or a latency metric the earliest
+                # sample predates) charges its full — window-local —
+                # counts; a vanished or reset series never drives a
+                # bucket delta negative (ISSUE 19)
+                delta = _delta_hist(first["counts"], last["counts"],
+                                    len(last["edges"]) + 1)
             else:
-                # a single sample, a latency metric BORN inside the
-                # window (the earliest sample predates its first
-                # observation), or a changed bucket layout: the
-                # cumulative distribution is entirely window-local (or
-                # the best available read) — better than reporting
-                # nothing
-                delta = [int(c) for c in last["counts"]]
+                # a single sample or a changed bucket layout: the
+                # cumulative distribution is the best available read —
+                # better than reporting nothing
+                delta = _delta_hist(None, last["counts"],
+                                    len(last["edges"]) + 1)
             for q, key in ((0.50, "p50_ms"), (0.99, "p99_ms")):
                 v = quantile_from_counts(last["edges"], delta, q)
                 if v is not None:

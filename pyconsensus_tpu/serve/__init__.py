@@ -52,6 +52,7 @@ from ..faults import (AotCacheCorruptionError, FailoverInProgressError,
                       WorkerLostError)
 from .admission import ClusterCapacity
 from .aotcache import AOT_ENTRY, AotCache, AotExecutable
+from .autoscale import AutoScaler, AutoscaleConfig
 from .cache import BucketKey, ExecutableCache, warm_inputs
 from .failover import DurableSession, ReplicationLog, replay_session
 from .fleet import ConsensusFleet, FleetConfig, FleetWorker
@@ -61,7 +62,7 @@ from .incremental import (INCREMENTAL_KERNEL_PATH,
                           make_incremental_executable)
 from .kernels import (SERVE_ALGORITHMS, bucket_inputs, bucket_path_eligible,
                       make_bucket_executable, padded_consensus, slice_result)
-from .loadgen import LoadGenerator
+from .loadgen import LoadGenerator, RateTrace
 from .pallas import (PALLAS_KERNEL_PATH, XLA_KERNEL_PATH,
                      make_pallas_bucket_executable, pallas_bucket_eligible)
 from .placement import HashRing
@@ -76,7 +77,8 @@ __all__ = [
     "ConsensusService", "ServeConfig", "ServiceOverloadError",
     "MarketSession", "SessionStore",
     "ResolveRequest", "RequestQueue",
-    "ExecutableCache", "BucketKey", "LoadGenerator",
+    "ExecutableCache", "BucketKey", "LoadGenerator", "RateTrace",
+    "AutoScaler", "AutoscaleConfig",
     "padded_consensus", "make_bucket_executable", "bucket_inputs",
     "slice_result", "bucket_path_eligible", "SERVE_ALGORITHMS",
     "SINGLE_TOPOLOGY", "make_sharded_bucket_executable",

@@ -158,6 +158,19 @@ class ClusterCapacity:
                 self._workers[str(worker)]["alive"] = False
             self._gauge.set(self._alive_locked())
 
+    def forget(self, worker: str) -> None:
+        """Remove ``worker`` from the capacity view entirely — the
+        graceful-drain exit (ISSUE 19). A DEATH keeps its tombstone:
+        the dead fraction scales retry hints because the fleet is
+        degraded below its intended size. A DRAINED worker left on
+        purpose (the autoscaler shrank the fleet), so its tombstone
+        must not inflate ``registered/alive`` forever — the smaller
+        fleet IS the intended size, and its retry hints should read
+        healthy."""
+        with self._lock:
+            self._workers.pop(str(worker), None)
+            self._gauge.set(self._alive_locked())
+
     def _alive_locked(self) -> int:
         return sum(1 for w in self._workers.values() if w["alive"])
 

@@ -53,6 +53,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="closed-loop workers (ignored with --rate)")
     ap.add_argument("--rate", type=float, default=None,
                     help="open-loop arrival rate (req/s)")
+    ap.add_argument("--trace", default=None, metavar="JSON",
+                    help="trace-driven open loop (ISSUE 19): a JSON "
+                         "rate trace (path or literal; [[duration_s, "
+                         "rps], ...]) — overrides --rate/--requests")
     ap.add_argument("--shapes", default="12x48,24x96",
                     help="comma-separated RxE request shapes")
     ap.add_argument("--na-frac", type=float, default=0.1)
@@ -117,6 +121,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "for fleet sessions; the socket transport "
                          "also roots worker log + shipped-log dirs "
                          "here)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-driven autoscaler control loop "
+                         "over the fleet (with --fleet-workers; ISSUE "
+                         "19): sustained SLO violation spawns workers, "
+                         "sustained idleness drains them with live "
+                         "session migration, a declared death is "
+                         "replaced (docs/SERVING.md \"Elastic fleet\")")
+    ap.add_argument("--autoscale-min", type=int, default=1, metavar="N",
+                    help="autoscaler fleet-size floor")
+    ap.add_argument("--autoscale-max", type=int, default=4, metavar="N",
+                    help="autoscaler fleet-size ceiling")
+    ap.add_argument("--autoscale-interval-s", type=float, default=0.5,
+                    metavar="S", help="autoscaler control period")
+    ap.add_argument("--autoscale-cooldown-s", type=float, default=3.0,
+                    metavar="S",
+                    help="quiet period after a membership change")
     ap.add_argument("--allow-shed", action="store_true",
                     help="shed requests (PYC401) do not fail the run — "
                          "the expected outcome of an overload probe")
@@ -238,7 +258,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     gen = LoadGenerator(svc, shapes=shapes, na_frac=args.na_frac,
                         seed=args.seed, max_retries=args.retries,
                         slo=slo)
-    if args.rate:
+    if args.trace:
+        from .loadgen import RateTrace
+
+        stats = gen.run_trace(RateTrace.from_json(args.trace))
+    elif args.rate:
         stats = gen.run_open(args.requests, args.rate)
     else:
         stats = gen.run_closed(args.requests, args.concurrency)
@@ -326,10 +350,30 @@ def _fleet_main(args, cfg, shapes) -> int:
         gen = LoadGenerator(fleet, shapes=shapes, na_frac=args.na_frac,
                             seed=args.seed, max_retries=args.retries,
                             slo=slo)
-        if args.rate:
-            stats = gen.run_open(args.requests, args.rate)
-        else:
-            stats = gen.run_closed(args.requests, args.concurrency)
+        scaler = None
+        if args.autoscale:
+            from .autoscale import AutoScaler, AutoscaleConfig
+
+            scaler = AutoScaler(fleet, slo, AutoscaleConfig(
+                min_workers=args.autoscale_min,
+                max_workers=args.autoscale_max,
+                interval_s=args.autoscale_interval_s,
+                cooldown_s=args.autoscale_cooldown_s)).run_in_thread()
+            # the scaler consumes the monitor's window — make sure it
+            # samples for the whole run even on the closed-loop path
+            slo.run_in_thread()
+        try:
+            if args.trace:
+                from .loadgen import RateTrace
+
+                stats = gen.run_trace(RateTrace.from_json(args.trace))
+            elif args.rate:
+                stats = gen.run_open(args.requests, args.rate)
+            else:
+                stats = gen.run_closed(args.requests, args.concurrency)
+        finally:
+            if scaler is not None:
+                scaler.stop()
         if metrics_srv is not None and args.metrics_hold_s > 0:
             # the scrape window: workers stay up (the merged render
             # needs them answering metrics.snapshot over the wire)
@@ -337,12 +381,15 @@ def _fleet_main(args, cfg, shapes) -> int:
                   file=sys.stderr)
             time.sleep(args.metrics_hold_s)
         status = fleet.status()     # before the drain marks workers down
+        scaler_status = scaler.status() if scaler is not None else None
     finally:
         fleet.close(drain=True)
         if metrics_srv is not None:
             metrics_srv.close()
     stats["transport"] = args.transport
     stats["fleet"] = status
+    if scaler_status is not None:
+        stats["autoscale"] = scaler_status
     print(json.dumps(stats, indent=2, sort_keys=True))
     if args.metrics_out:
         obs.write_prom(args.metrics_out, obs.REGISTRY)

@@ -80,6 +80,11 @@ __all__ = ["FleetConfig", "FleetWorker", "ConsensusFleet"]
 # consensus-lint: lock-order WorkerBase.declare_lock < ConsensusFleet._lock
 # consensus-lint: lock-order ConsensusFleet._lock < HashRing._lock
 # consensus-lint: lock-order ConsensusFleet._lock < ClusterCapacity._lock
+# The autoscaler's control lock (ISSUE 19) is OUTERMOST of all: one
+# membership change in flight means the loop holds its lock across
+# add_worker/drain_worker, which take a worker's declare lock and then
+# the fleet lock.
+# consensus-lint: lock-order AutoScaler._lock < WorkerBase.declare_lock
 
 
 @dataclass(frozen=True)
@@ -156,6 +161,10 @@ class FleetWorker(WorkerBase):
               timeout: Optional[float] = 60.0) -> None:
         if self.alive:
             self.service.close(drain=drain, timeout=timeout)
+        # a closed worker is not alive — the socket twin flips this
+        # too, and the drain path relies on it so a drained worker is
+        # reported honestly (and never re-drained, never re-declared)
+        self.alive = False
 
     # -- liveness -------------------------------------------------------
 
@@ -326,6 +335,11 @@ class ConsensusFleet:
         #: session name -> CheckpointCorruptionError (refused takeovers)
         self._failed_sessions: dict = {}    # guarded-by: _lock
         self._lock = threading.RLock()
+        #: monotonic worker-name counter (ISSUE 19): autoscaled workers
+        #: continue ``w<i>`` past the boot-time fleet and a name is
+        #: NEVER reused — a replacement must not inherit a dead
+        #: worker's metric series, log root, or capacity tombstone
+        self._next_worker_id = len(self.workers)    # guarded-by: _lock
         self._seq = 0
         #: trace-id counter for session submits (ISSUE 18) — separate
         #: from ``_seq`` so tracing never perturbs stateless routing
@@ -379,7 +393,9 @@ class ConsensusFleet:
         # EVERY handle closes — a dead socket worker has no service to
         # drain but still owns client pools/threads to release (each
         # handle guards its own drain on liveness)
-        for w in self.workers.values():
+        with self._lock:
+            handles = list(self.workers.values())
+        for w in handles:
             w.close(drain=drain, timeout=timeout)
         self.transport.close()
 
@@ -399,7 +415,9 @@ class ConsensusFleet:
         monitor thread calls this on its interval; tests and synchronous
         deployments call it directly)."""
         dead = []
-        for name, w in list(self.workers.items()):
+        with self._lock:        # snapshot: add_worker mutates the dict
+            scan = list(self.workers.items())
+        for name, w in scan:
             if w.alive:
                 w.heartbeat()
                 self.capacity.observe_queue_depth(name, w.queue_depth())
@@ -574,6 +592,126 @@ class ConsensusFleet:
             f"session {name!r} migrated off dead worker {dead!r}",
             worker=dead, session=name,
             retry_after_s=self.config.takeover_window_s))
+
+    # -- elastic membership (ISSUE 19) ----------------------------------
+
+    def add_worker(self, name: Optional[str] = None,
+                   warmup: bool = True) -> str:
+        """Grow the fleet by ONE worker — the autoscaler's scale-up and
+        dead-worker-replacement primitive. The transport spawns the
+        handle (a real OS process on the socket transport, warm from
+        the shared AOT disk cache before it announces READY — zero
+        retraces when the cache is primed), the fleet starts it, warms
+        its bucket executables from disk, and only THEN places it on
+        the ring: no request routes to a cold worker. Returns the new
+        worker's name (``w<i>`` names continue monotonically; a name is
+        never reused)."""
+        with self._lock:
+            if name is None:
+                while True:
+                    name = f"w{self._next_worker_id}"
+                    self._next_worker_id += 1
+                    if name not in self.workers:
+                        break
+            elif name in self.workers:
+                raise InputError(
+                    f"worker {name!r} already exists in this fleet",
+                    worker=name)
+        handle = self.transport.spawn_worker(self.config, name)
+        try:
+            handle.start(warmup=warmup)
+        except BaseException:
+            try:
+                handle.close(drain=False, timeout=5.0)
+            except Exception:   # noqa: BLE001 — spawn failure wins
+                pass
+            raise
+        with self._lock:
+            self.workers[name] = handle
+        self._warm_standby(name)        # AOT adoption — fail-soft
+        with self._lock:
+            self.ring.add(name)
+        self.capacity.register(name, self.config.worker.max_queue)
+        return name
+
+    def drain_worker(self, name: str,
+                     timeout: Optional[float] = 60.0) -> dict:
+        """Shrink the fleet by ONE worker, gracefully: take ``name``
+        off the ring (no new placements), LIVE-migrate each of its
+        sessions onto the surviving ring owners — fence at the source
+        (an in-flight mutation finishes its journal write first;
+        anything later was never acknowledged), verify + replay the log
+        on the adopting worker, exactly the takeover machinery minus
+        the death — then drain in-flight work and shut the worker
+        down. Every acknowledged round lands exactly once; clients
+        racing the migration see the retryable PYC501/PYC502 taxonomy,
+        never loss.
+
+        Holding the worker's declare lock across the whole migration
+        serializes drain against a concurrent death declaration: a
+        SIGKILL mid-drain blocks the monitor's declaration until the
+        drain finishes, and the ``_migrating`` claim set guarantees
+        each session is moved by exactly one of the two paths."""
+        w = self.workers.get(name)
+        if w is None:
+            raise PlacementError(f"unknown worker {name!r}", worker=name)
+        with w.declare_lock:
+            with self._lock:
+                in_ring = name in self.ring
+                if in_ring and len(self.ring) <= 1:
+                    raise PlacementError(
+                        f"cannot drain {name!r}: it is the last worker "
+                        f"on the ring", worker=name)
+                # sessions a previous (aborted) drain or takeover left
+                # behind get another chance, exactly like _declare_dead
+                stranded = any(o == name
+                               for o in self._sessions.values())
+                if not w.alive or (not in_ring and not stranded):
+                    # already dead (the takeover owns its sessions) or
+                    # already fully drained — nothing to do
+                    return {"worker": name, "drained": False,
+                            "sessions_migrated": []}
+                peers = [self.workers[p] for p in self.ring.workers()
+                         if p != name] if in_ring else []
+            # ring membership is not liveness: between a peer's death
+            # and its heartbeat-staleness DECLARATION the ring still
+            # lists the corpse, and counting it as surviving capacity
+            # would let a drain shut down the last LIVE worker (total
+            # outage, with this worker's sessions migrated onto a
+            # corpse). Probe before committing: at least one surviving
+            # ring peer must answer a beat right now.
+            if in_ring and not any(p.heartbeat() for p in peers):
+                raise PlacementError(
+                    f"cannot drain {name!r}: no surviving ring peer "
+                    f"answers a heartbeat (undeclared deaths?)",
+                    worker=name)
+            with self._lock:
+                self.ring.remove(name)
+            migrated = (self._failover(name) if len(self.ring) else [])
+            with self._lock:
+                leftover = sorted(s for s, o in self._sessions.items()
+                                  if o == name)
+            if leftover:
+                # a transient replay failure stranded sessions on the
+                # (still live, still serving) worker: the drain did NOT
+                # complete — leave it running; a retried drain or a
+                # death declaration moves them later
+                return {"worker": name, "drained": False,
+                        "sessions_migrated": migrated,
+                        "stranded": leftover}
+            w.close(drain=True, timeout=timeout)
+            # the drained worker LEFT the fleet — forget it entirely, so
+            # its tombstone does not inflate retry hints the way a
+            # death's does (the smaller fleet is the intended size)
+            self.capacity.forget(name)
+            self.capacity.observe_queue_depth(name, 0)
+        if self._recorder is not None:
+            try:
+                self._recorder.dump("drain")
+            except Exception:   # noqa: BLE001 — forensics never block
+                pass
+        return {"worker": name, "drained": True,
+                "sessions_migrated": migrated}
 
     # -- routing --------------------------------------------------------
 
@@ -813,7 +951,9 @@ class ConsensusFleet:
         worker is its own process (docs/OBSERVABILITY.md)."""
         merged = obs.MetricsRegistry()
         merged.merge_snapshot(obs.REGISTRY.snapshot(), worker="router")
-        for name, w in sorted(self.workers.items()):
+        with self._lock:        # snapshot: add_worker mutates the dict
+            scan = sorted(self.workers.items())
+        for name, w in scan:
             try:
                 reply = w.metrics_snapshot()
                 merged.merge_snapshot(
@@ -840,10 +980,11 @@ class ConsensusFleet:
         with self._lock:
             sessions = dict(self._sessions)
             failed = sorted(self._failed_sessions)
+            scan = list(self.workers.items())
         return {
             "workers": {n: {"alive": w.alive,
                             "queue_depth": w.queue_depth()}
-                        for n, w in self.workers.items()},
+                        for n, w in scan},
             "alive": self.capacity.alive,
             "alive_slots": self.capacity.alive_slots(),
             "sessions": sessions,

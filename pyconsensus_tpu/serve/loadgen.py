@@ -21,9 +21,117 @@ import time
 
 import numpy as np
 
-__all__ = ["LoadGenerator", "summarize", "mean_batch_occupancy",
-           "device_block", "kernel_path_block", "quantile",
-           "RETRYABLE_CODES"]
+__all__ = ["LoadGenerator", "RateTrace", "summarize",
+           "mean_batch_occupancy", "device_block", "kernel_path_block",
+           "quantile", "RETRYABLE_CODES"]
+
+
+class RateTrace:
+    """A trace-driven open-loop arrival schedule (ISSUE 19 satellite):
+    piecewise-constant rate segments ``[[duration_s, rps], ...]``,
+    loadable from JSON, with the two canonical shapes the elastic-fleet
+    work needs as constructors — a smooth :meth:`diurnal` cycle (does
+    the loop track a slow swing without flapping?) and a
+    :meth:`flash_crowd` step (does it absorb a synchronized storm and
+    then give the capacity back?).
+
+    The ARRIVAL SCHEDULE is a pure function of the segments — two runs
+    of the same trace offer identical load at identical offsets; all
+    remaining run-to-run variation comes from the generator's seeded
+    matrix corpus and the service itself. That is what makes
+    elastic-vs-static bench comparisons and the CI chaos smoke
+    reproducible."""
+
+    def __init__(self, segments) -> None:
+        self.segments = []
+        for seg in segments:
+            dur, rps = float(seg[0]), float(seg[1])
+            if dur <= 0 or rps < 0:
+                raise ValueError(
+                    f"trace segment needs duration_s > 0 and rps >= 0, "
+                    f"got {seg!r}")
+            self.segments.append((dur, rps))
+        if not self.segments:
+            raise ValueError("a rate trace needs at least one segment")
+
+    # -- canonical shapes ----------------------------------------------
+
+    @classmethod
+    def diurnal(cls, base_rps: float, peak_rps: float,
+                period_s: float, steps: int = 8) -> "RateTrace":
+        """One sinusoidal day quantized to ``steps`` flat segments:
+        base at the trough, ``peak_rps`` at the crest."""
+        import math as _math
+
+        mid = (float(base_rps) + float(peak_rps)) / 2.0
+        amp = (float(peak_rps) - float(base_rps)) / 2.0
+        dur = float(period_s) / int(steps)
+        return cls([(dur,
+                     mid + amp * _math.sin(2 * _math.pi * (i + 0.5)
+                                           / steps - _math.pi / 2))
+                    for i in range(int(steps))])
+
+    @classmethod
+    def flash_crowd(cls, base_rps: float, burst_rps: float,
+                    warm_s: float, burst_s: float,
+                    cool_s: float) -> "RateTrace":
+        """Steady base load, a synchronized storm, then quiet — the
+        cartel-burst shape of the econ driver and the CI chaos smoke."""
+        return cls([(warm_s, base_rps), (burst_s, burst_rps),
+                    (cool_s, base_rps)])
+
+    # -- JSON round-trip -----------------------------------------------
+
+    @classmethod
+    def from_json(cls, source) -> "RateTrace":
+        """Load from a JSON text or a path to one. Accepts the bare
+        segment list or ``{"segments": [...]}``."""
+        import json as _json
+        import os as _os
+
+        text = source
+        if isinstance(source, (bytes, str)) and _os.path.exists(source):
+            with open(source, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        data = _json.loads(text)
+        if isinstance(data, dict):
+            data = data["segments"]
+        return cls(data)
+
+    def to_json(self) -> str:
+        import json as _json
+
+        return _json.dumps({"segments": [[d, r]
+                                         for d, r in self.segments]})
+
+    # -- the schedule --------------------------------------------------
+
+    @property
+    def duration_s(self) -> float:
+        return sum(d for d, _ in self.segments)
+
+    @property
+    def n_requests(self) -> int:
+        return sum(int(round(d * r)) for d, r in self.segments)
+
+    def arrivals(self):
+        """The deterministic arrival offsets (seconds from trace
+        start), evenly spaced within each segment."""
+        out, t = [], 0.0
+        for dur, rps in self.segments:
+            n = int(round(dur * rps))
+            for i in range(n):
+                out.append(t + i / rps)
+            t += dur
+        return out
+
+    def describe(self) -> dict:
+        """JSON-ready shape summary for bench/loadgen artifacts."""
+        return {"segments": [[round(d, 3), round(r, 3)]
+                             for d, r in self.segments],
+                "duration_s": round(self.duration_s, 3),
+                "requests": self.n_requests,
+                "peak_rps": round(max(r for _, r in self.segments), 3)}
 
 
 def kernel_path_block():
@@ -360,6 +468,74 @@ class LoadGenerator:
             stats["slo"] = self.slo.summary()
         return stats
 
+    # -- trace-driven open loop -----------------------------------------
+
+    def run_trace(self, trace: "RateTrace",
+                  timeout_s: float = 120.0) -> dict:
+        """Open-loop arrivals on a :class:`RateTrace` schedule — the
+        elastic-fleet probe (ISSUE 19). Identical semantics to
+        :meth:`run_open` (fixed schedule, sheds tallied, retryable
+        failures deferred to a sequential drain phase) except the
+        offered rate varies by segment, so a run can carry a diurnal
+        swing or a flash crowd through an autoscaled fleet. The trace
+        shape lands in the stats under ``"trace"``."""
+        offsets = trace.arrivals()
+        latencies: list = []
+        errors: dict = {}
+        futures: list = []
+        deferred: list = []
+        tallies = {"retried": 0, "abandoned": 0}
+
+        def tally(err, lat, retried=0, abandoned=0):
+            tallies["retried"] += retried
+            tallies["abandoned"] += abandoned
+            if err is not None:
+                errors[err] = errors.get(err, 0) + 1
+            else:
+                latencies.append(lat)
+
+        if self.slo is not None:
+            self.slo.run_in_thread()
+        t0 = time.monotonic()
+        for i, offset in enumerate(offsets):
+            delay = (t0 + offset) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            start = time.monotonic()
+            try:
+                fut = self._submit(i)
+            except Exception as exc:  # noqa: BLE001 — shed at admission
+                code = getattr(exc, "error_code", None)
+                if self.max_retries > 0 and code in RETRYABLE_CODES:
+                    deferred.append((i, exc))
+                else:
+                    tally(code or type(exc).__name__, None)
+                continue
+            futures.append((i, start, fut))
+        for i, start, fut in futures:
+            try:
+                fut.result(timeout=timeout_s)
+            except Exception as exc:  # noqa: BLE001
+                code = getattr(exc, "error_code", None)
+                if self.max_retries > 0 and code in RETRYABLE_CODES:
+                    deferred.append((i, exc))
+                else:
+                    tally(code or type(exc).__name__, None)
+            else:
+                tally(None, time.monotonic() - start)
+        for i, exc in deferred:
+            lat, err, retried, abandoned = self._one_request(
+                i, timeout_s, first_error=exc)
+            tally(err, lat, retried, abandoned)
+        if self.slo is not None:
+            self.slo.stop()
+        stats = summarize(latencies, errors, time.monotonic() - t0,
+                          len(offsets), **tallies)
+        stats["trace"] = trace.describe()
+        if self.slo is not None:
+            stats["slo"] = self.slo.summary()
+        return stats
+
 
 def main(argv=None) -> int:
     import argparse
@@ -375,6 +551,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rate", type=float, default=None,
                     help="open-loop arrival rate (req/s); omit for "
                          "closed loop")
+    ap.add_argument("--trace", default=None,
+                    help="trace-driven open loop: a JSON rate trace "
+                         "(path or literal; [[duration_s, rps], ...]) "
+                         "— overrides --rate/--requests")
     ap.add_argument("--shapes", default="12x48,24x96",
                     help="comma-separated RxE request shapes")
     ap.add_argument("--na-frac", type=float, default=0.1)
@@ -397,7 +577,9 @@ def main(argv=None) -> int:
     if not args.no_warmup:
         svc.warm_buckets(svc.buckets_for(shapes))
     svc.start(warmup=False)
-    if args.rate:
+    if args.trace:
+        stats = gen.run_trace(RateTrace.from_json(args.trace))
+    elif args.rate:
         stats = gen.run_open(args.requests, args.rate)
     else:
         stats = gen.run_closed(args.requests, args.concurrency)

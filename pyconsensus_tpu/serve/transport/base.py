@@ -72,6 +72,15 @@ class Transport:
     def make_workers(self, config) -> dict:
         raise NotImplementedError
 
+    def spawn_worker(self, config, name: str):
+        """Create ONE additional worker handle after fleet construction
+        (ISSUE 19 — the autoscaler's scale-up / replacement primitive).
+        Returns an un-started handle; the fleet starts it and adds it to
+        the ring. Transports that cannot grow raise ``InputError``."""
+        raise InputError(
+            f"transport {self.name!r} cannot spawn workers after fleet "
+            f"construction", transport=self.name, worker=name)
+
     def close(self) -> None:
         """Transport-level teardown (default: nothing)."""
 
@@ -88,6 +97,11 @@ class InProcessTransport(Transport):
         return {f"w{i}": FleetWorker(f"w{i}", config.worker,
                                      log_dir=config.log_dir)
                 for i in range(config.n_workers)}
+
+    def spawn_worker(self, config, name: str):
+        from ..fleet import FleetWorker
+
+        return FleetWorker(name, config.worker, log_dir=config.log_dir)
 
 
 def resolve_transport(spec) -> Transport:

@@ -332,9 +332,28 @@ class SocketWorkerHandle(WorkerBase):
                 pass
 
     def fence_session(self, name: str, exc: BaseException) -> None:
-        """No stale in-memory object survives a SIGKILL — the fence the
-        in-process transport needs is structural here: the process is
-        gone, and anything it acknowledged is in the shipped log."""
+        """A DEAD worker's fence is structural — no stale in-memory
+        object survives a SIGKILL, and anything it acknowledged is in
+        the shipped log. A LIVE worker being gracefully drained
+        (ISSUE 19) is the case that needs the real thing: the fence RPC
+        fences the session object under its lock (an in-flight append
+        finishes its journal write first; anything later raises the
+        retryable loss and was never acknowledged) and then RE-SHIPS
+        the full fenced log, so the adoption that follows reads every
+        journaled record even though this process never died. Fail-soft
+        on a worker that died mid-drain: its acknowledged writes are
+        already on the standby's disk (ship-before-ack), so the
+        takeover-style adoption is safe without the fence."""
+        if not self.alive:
+            return
+        try:
+            self._data.call("fence_session", {
+                "name": name,
+                "retry_after_s": float(
+                    getattr(exc, "context", {}).get("retry_after_s")
+                    or self.takeover_window_s)})
+        except Exception:   # noqa: BLE001 — died mid-drain: the shipped
+            pass            # log already carries every acknowledged write
 
     def warm_from_disk(self) -> int:
         try:
@@ -378,22 +397,45 @@ class WorkerSupervisor:
         self.base.mkdir(parents=True, exist_ok=True)
         self.receiver = ShippingReceiver(self.base / "_shipped").start()
         self.rpc_timeout_s = float(rpc_timeout_s)
+        self.ready_timeout_s = float(ready_timeout_s)
         cfg = dict(worker_config.__dict__)
         if aot_cache_dir is not None:
             cfg["aot_cache_dir"] = str(aot_cache_dir)
-        env = worker_subprocess_env()
+        # kept for post-construction spawns (ISSUE 19 scale-up): a
+        # worker spawned by the autoscaler boots with the SAME config —
+        # including the shared AOT cache dir, so its warmup adopts
+        # persisted executables instead of compiling — and the same
+        # fingerprint-matched environment
+        self._worker_cfg = cfg
+        self._env = worker_subprocess_env()
         self.processes: dict = {}
         try:
             for i in range(int(n_workers)):
                 name = f"w{i}"
-                self.processes[name] = self._spawn(name, cfg, env,
+                self.processes[name] = self._spawn(name, cfg, self._env,
                                                    ready_timeout_s)
         except BaseException:
             self.close()
             raise
-        obs.counter("pyconsensus_transport_workers_spawned_total",
-                    "fleet worker processes spawned by the supervisor"
-                    ).inc(len(self.processes))
+        self._spawned = obs.counter(
+            "pyconsensus_transport_workers_spawned_total",
+            "fleet worker processes spawned by the supervisor")
+        self._spawned.inc(len(self.processes))
+
+    def spawn_worker(self, name: str) -> WorkerProcess:
+        """Spawn ONE additional worker process after construction (the
+        autoscaler's scale-up / replacement path, ISSUE 19). Same
+        config, environment, shipping receiver, and readiness contract
+        as the boot-time workers."""
+        name = str(name)
+        if name in self.processes and self.processes[name].running:
+            raise InputError(
+                f"worker process {name!r} already exists", worker=name)
+        proc = self._spawn(name, self._worker_cfg, self._env,
+                           self.ready_timeout_s)
+        self.processes[name] = proc
+        self._spawned.inc()
+        return proc
 
     def _spawn(self, name: str, cfg: dict, env: dict,
                ready_timeout_s: float) -> WorkerProcess:
@@ -454,6 +496,20 @@ class SocketTransport(Transport):
                     name, proc, rpc_timeout_s=self.rpc_timeout_s,
                     takeover_window_s=config.takeover_window_s)
                 for name, proc in self.supervisor.processes.items()}
+
+    def spawn_worker(self, config, name: str) -> SocketWorkerHandle:
+        """One additional worker PROCESS (autoscaler scale-up /
+        replacement, ISSUE 19): spawned by the same supervisor, shipping
+        to the same standby root, warm from the shared AOT cache before
+        it announces READY."""
+        if self.supervisor is None:
+            raise InputError(
+                "socket transport has no supervisor yet — spawn_worker "
+                "is only valid after make_workers", worker=name)
+        proc = self.supervisor.spawn_worker(name)
+        return SocketWorkerHandle(
+            name, proc, rpc_timeout_s=self.rpc_timeout_s,
+            takeover_window_s=config.takeover_window_s)
 
     def close(self) -> None:
         if self.supervisor is not None:

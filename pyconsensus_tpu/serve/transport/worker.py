@@ -213,6 +213,32 @@ class FleetWorkerProcess:
         return {"ok": True, "rounds_resolved": int(session.ledger.round),
                 "staged_blocks": len(session._blocks)}
 
+    def fence_session(self, params: dict) -> dict:
+        """Live-migration fence (ISSUE 19 graceful drain): fence the
+        session object under its lock — an in-flight mutation completes
+        its journal write FIRST; anything later raises the retryable
+        worker-loss error and was never acknowledged — then re-ship the
+        full fenced log so the standby's disk carries every journaled
+        record BEFORE the adopting worker reads it. After this returns,
+        this process can never mutate (or acknowledge anything about)
+        the session again; the router's adopt-then-release completes
+        the migration."""
+        from ...faults import InputError, WorkerLostError
+
+        name = str(params["name"])
+        try:
+            session = self.service.sessions.get(name)
+        except InputError:
+            return {"ok": True, "fenced": False}    # not in this store
+        fence = getattr(session, "fence", None)
+        if fence is not None:
+            fence(WorkerLostError(
+                f"session {name!r} migrated off draining worker "
+                f"{self.name!r}", worker=self.name, session=name,
+                retry_after_s=float(params.get("retry_after_s") or 1.0)))
+        self._ship_session(name, ledger=True)
+        return {"ok": True, "fenced": fence is not None}
+
     def release_session(self, params: dict) -> dict:
         name = str(params["name"])
         self.service.sessions.remove(name)
@@ -268,6 +294,7 @@ class FleetWorkerProcess:
                 "append": self.append,
                 "session_state": self.session_state,
                 "adopt_session": self.adopt_session,
+                "fence_session": self.fence_session,
                 "release_session": self.release_session,
                 "warm_from_disk": self.warm_from_disk,
                 "metric": self.metric, "stats": self.stats,  # consensus-lint: disable=CL902 — operator surface: scraped by tools/bench and the CI rehearsal via the raw call() hatch, not by the fleet client

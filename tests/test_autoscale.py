@@ -1,0 +1,718 @@
+"""SLO-driven elastic fleet (ISSUE 19): the autoscaler control loop,
+elastic fleet membership, and the drain-vs-death race.
+
+The contract under test, end to end: sustained SLO violation grows the
+fleet, sustained idleness shrinks it through a graceful drain that
+LIVE-migrates every session (zero lost acknowledged rounds, bits
+identical to a single-box run), a declared death is replaced by a FRESH
+worker without double-firing against the heartbeat takeover, and a
+SIGKILL landing mid-drain still moves every session exactly once — no
+matter which migration step the kill interrupts.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fleet_worker import N_REPORTERS, make_block
+from pyconsensus_tpu import faults, obs
+from pyconsensus_tpu.faults import InputError, PlacementError
+from pyconsensus_tpu.obs import SloMonitor
+from pyconsensus_tpu.serve import (AutoScaler, AutoscaleConfig,
+                                   ConsensusFleet, DurableSession,
+                                   FleetConfig, MarketSession,
+                                   ServeConfig)
+
+
+@pytest.fixture(autouse=True)
+def _under_lock_witness(lock_witness):
+    """Every autoscale test runs under the runtime lock witness (ISSUE
+    9): the autoscaler's lock is declared OUTERMOST of the fleet
+    hierarchy, and the observed acquisition order across scaler /
+    declare / router locks must stay acyclic."""
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _under_protocol_witness(protocol_witness):
+    """And under the runtime protocol witness (ISSUE 16): a drain's
+    live migration replays durable sessions, so every observed
+    journal/commit/ship/ack order must match the CL901 graph."""
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _under_digest_witness(digest_witness):
+    """And under the runtime digest witness (ISSUE 17): every digest a
+    migration journals must replay bit-identical from the log."""
+    yield
+
+
+def mini_fleet(tmp_path, n=2, **cfg_kwargs):
+    cfg = FleetConfig(
+        n_workers=n, log_dir=str(tmp_path / "log"),
+        worker=ServeConfig(warmup=(), batch_window_ms=1.0),
+        **cfg_kwargs)
+    return ConsensusFleet(cfg)
+
+
+class StubMonitor:
+    """The autoscaler consumes exactly ``targets`` + ``window()`` — a
+    stub drives the control law with hand-built windowed views, the
+    same way the SloMonitor tests drive the window math with hand-built
+    snapshots."""
+
+    def __init__(self, targets):
+        self.targets = dict(targets)
+        self.win = {}
+
+    def window(self):
+        return dict(self.win)
+
+
+#: any observed signal above its target (p99 target 50ms)
+BREACHED = {"p99_ms": 120.0}
+#: every observed signal at/below half (down_headroom) of its target
+IDLE = {"p99_ms": 10.0, "queue_depth": 1.0}
+#: under the target but above the scale-down headroom — neither
+#: breached nor idle; streaks must reset
+MID_BAND = {"p99_ms": 40.0}
+
+
+def make_scaler(fleet, targets=None, **cfg):
+    mon = StubMonitor(targets or {"p99_ms": 50.0, "queue_depth": 8.0})
+    defaults = dict(min_workers=1, max_workers=4, up_signals=2,
+                    down_signals=3, cooldown_s=5.0, warmup=False)
+    defaults.update(cfg)
+    return AutoScaler(fleet, mon, AutoscaleConfig(**defaults)), mon
+
+
+def decisions(action):
+    return obs.value("pyconsensus_autoscale_decisions_total",
+                     action=action) or 0
+
+
+# -- config validation -------------------------------------------------------
+
+
+class TestAutoscaleConfig:
+    def test_min_workers_must_be_positive(self, tmp_path):
+        fleet = mini_fleet(tmp_path)
+        with pytest.raises(InputError, match="min_workers"):
+            AutoScaler(fleet, StubMonitor({}),
+                       AutoscaleConfig(min_workers=0))
+
+    def test_max_must_cover_min(self, tmp_path):
+        fleet = mini_fleet(tmp_path)
+        with pytest.raises(InputError, match="max_workers"):
+            AutoScaler(fleet, StubMonitor({}),
+                       AutoscaleConfig(min_workers=3, max_workers=2))
+
+
+# -- the control law ---------------------------------------------------------
+
+
+class TestControlLaw:
+    def test_first_evaluate_adopts_ring_size_as_target(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet)
+        d = scaler.evaluate(now=0.0)
+        assert d["action"] == "hold"
+        assert d["target"] == 2
+        assert scaler.status()["target"] == 2
+        # an empty window (no samples yet) is neither breached nor idle
+        assert d["breached"] == []
+        assert d["idle"] is False
+
+    def test_single_breach_is_hysteresis_hold(self, tmp_path):
+        """One bad sample never scales — up_signals are CONSECUTIVE."""
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet)
+        mon.win = BREACHED
+        d = scaler.evaluate(now=0.0)
+        assert d["action"] == "hold"
+        assert d["up_streak"] == 1
+        assert len(fleet.ring.workers()) == 2
+
+    def test_sustained_breach_scales_up(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet)
+        holds0, ups0 = decisions("hold"), decisions("scale_up")
+        mon.win = BREACHED
+        scaler.evaluate(now=0.0)
+        d = scaler.evaluate(now=0.5)
+        assert d["action"] == "scale_up"
+        assert d["worker"] == "w2"          # monotonic fresh name
+        assert d["breached"] == ["p99_ms"]
+        assert sorted(fleet.ring.workers()) == ["w0", "w1", "w2"]
+        assert d["target"] == 3
+        assert decisions("hold") - holds0 == 1
+        assert decisions("scale_up") - ups0 == 1
+        assert obs.value("pyconsensus_autoscale_target_workers") == 3
+        fleet.close(drain=False, timeout=10.0)
+
+    def test_cooldown_blocks_back_to_back_changes(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=1)
+        scaler, mon = make_scaler(fleet, cooldown_s=5.0)
+        mon.win = BREACHED
+        scaler.evaluate(now=0.0)
+        assert scaler.evaluate(now=0.5)["action"] == "scale_up"
+        # still breached, streak builds past up_signals — but the
+        # cool-down quiet period holds the line
+        for t in (1.0, 2.0, 4.0):
+            assert scaler.evaluate(now=t)["action"] == "hold"
+        assert len(fleet.ring.workers()) == 2
+        assert scaler.evaluate(now=6.0)["action"] == "scale_up"
+        assert len(fleet.ring.workers()) == 3
+        fleet.close(drain=False, timeout=10.0)
+
+    def test_max_workers_is_a_hard_ceiling(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet, max_workers=2)
+        mon.win = BREACHED
+        for t in (0.0, 0.5, 1.0, 1.5):
+            assert scaler.evaluate(now=t)["action"] == "hold"
+        assert len(fleet.ring.workers()) == 2
+
+    def test_mid_band_resets_both_streaks(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet, up_signals=2)
+        mon.win = BREACHED
+        scaler.evaluate(now=0.0)                        # streak 1
+        mon.win = MID_BAND
+        d = scaler.evaluate(now=0.5)
+        assert d["action"] == "hold"
+        assert scaler.status()["up_streak"] == 0
+        assert scaler.status()["down_streak"] == 0
+        mon.win = BREACHED
+        d = scaler.evaluate(now=1.0)                    # streak 1 again
+        assert d["action"] == "hold"
+        assert len(fleet.ring.workers()) == 2
+
+    def test_sustained_idle_drains_one_worker(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=3).start(warmup=False)
+        scaler, mon = make_scaler(fleet, down_signals=3)
+        mon.win = IDLE
+        assert scaler.evaluate(now=0.0)["action"] == "hold"
+        assert scaler.evaluate(now=0.5)["action"] == "hold"
+        d = scaler.evaluate(now=1.0)
+        assert d["action"] == "scale_down"
+        assert d["worker"] == "w2"      # newest on the 0-session tie
+        assert d["drained"] is True
+        assert d["target"] == 2
+        assert sorted(fleet.ring.workers()) == ["w0", "w1"]
+        fleet.close(drain=True, timeout=10.0)
+
+    def test_min_workers_is_a_hard_floor(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=1).start(warmup=False)
+        scaler, mon = make_scaler(fleet, down_signals=2)
+        mon.win = IDLE
+        for t in (0.0, 0.5, 1.0, 1.5):
+            assert scaler.evaluate(now=t)["action"] == "hold"
+        assert len(fleet.ring.workers()) == 1
+        fleet.close(drain=True, timeout=10.0)
+
+    def test_empty_window_is_not_idle(self, tmp_path):
+        """No observed signals must never read as 'idle' — a monitor
+        that has not sampled yet would otherwise drain the fleet."""
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet, down_signals=1)
+        mon.win = {}
+        d = scaler.evaluate(now=0.0)
+        assert d["action"] == "hold"
+        assert d["idle"] is False
+        assert len(fleet.ring.workers()) == 2
+
+    def test_victim_fewest_sessions_then_newest(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=3)
+        scaler, _ = make_scaler(fleet)
+        ring = tuple(fleet.ring.workers())
+        # no sessions anywhere: a three-way tie — the NEWEST worker is
+        # the victim (boot workers are the last to go)
+        assert scaler._victim(ring) == "w2"
+        # load w2 with a session: the tie is now w0/w1 — newest wins
+        name = next(f"m{i}" for i in range(200)
+                    if fleet.ring.owner(f"m{i}") == "w2")
+        fleet.create_session(name, n_reporters=6)
+        assert scaler._victim(ring) == "w1"
+
+
+# -- replacement composes with the heartbeat declaration ---------------------
+
+
+class TestReplacement:
+    def test_dead_worker_replaced_without_streaks_or_cooldown(
+            self, tmp_path):
+        """A declared death is replaced on the very next evaluation —
+        no streaks (serving below target IS the incident), no cool-down
+        (a death is monotonic; it cannot flap) — and the replacement is
+        a FRESH name, never the corpse's."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        scaler, mon = make_scaler(fleet, cooldown_s=60.0)
+        scaler.evaluate(now=0.0)                # adopt target = 2
+        fleet.kill_worker("w1")
+        d = scaler.evaluate(now=0.1)            # single eval suffices
+        assert d["action"] == "replace"
+        assert d["worker"] == "w2"
+        assert sorted(fleet.ring.workers()) == ["w0", "w2"]
+        assert scaler.status()["target"] == 2
+        # a second death INSIDE the cool-down window set by the first
+        # replacement is still replaced immediately
+        fleet.kill_worker("w2")
+        d = scaler.evaluate(now=0.5)
+        assert d["action"] == "replace"
+        assert d["worker"] == "w3"
+        # back at target: the loop settles, no double-fire
+        assert scaler.evaluate(now=0.6)["action"] == "hold"
+        fleet.close(drain=False, timeout=10.0)
+
+    def test_refused_drain_restores_target_for_replacement(
+            self, tmp_path):
+        """The scale-down actuator lowers the target BEFORE draining
+        (so the mid-drain ring shrink is not read as a death). A drain
+        the fleet REFUSES — here: the only surviving peer is an
+        undeclared corpse — must roll that back, or the lowered target
+        would silently absorb the corpse's eventual declaration and no
+        replacement would ever fire."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        scaler, mon = make_scaler(fleet, down_signals=1, cooldown_s=0.0)
+        mon.win = IDLE
+        fleet.workers["w0"].hard_kill(0.2)      # dead, NOT declared
+        d = scaler.evaluate(now=0.0)            # drains w1 -> refused
+        assert d["action"] == "error"
+        assert "no surviving ring" in d["error"]
+        assert scaler.status()["target"] == 2   # rolled back, not 1
+        fleet.check_workers()                   # the declaration lands
+        mon.win = MID_BAND
+        d = scaler.evaluate(now=0.5)
+        assert d["action"] == "replace"
+        assert sorted(fleet.ring.workers()) == ["w1", "w2"]
+        fleet.close(drain=False, timeout=10.0)
+
+    def test_replacement_composes_with_takeover_bit_identical(
+            self, tmp_path):
+        """Chaos pin (a) in-process: SIGKILL a session's owner — the
+        heartbeat declaration fails the session over (exactly one
+        takeover), the autoscaler only ADDS capacity, and the session's
+        resolved bits match a single box that saw the same appends —
+        zero lost acknowledged rounds."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        scaler, mon = make_scaler(fleet)
+        scaler.evaluate(now=0.0)
+        owner = fleet.create_session("mkt", n_reporters=N_REPORTERS)
+        fleet.append("mkt", make_block(0, 0))   # acknowledged
+        failovers0 = obs.value("pyconsensus_failovers_total") or 0
+        fleet.kill_worker(owner)                # declaration + takeover
+        survivor = fleet.owner_of("mkt")
+        assert survivor != owner
+        d = scaler.evaluate(now=0.1)
+        assert d["action"] == "replace"
+        replacement = d["worker"]
+        assert replacement not in (owner, survivor)
+        # the replacement never re-ran the takeover: one failover, and
+        # the session stayed where the declaration put it
+        assert (obs.value("pyconsensus_failovers_total")
+                - failovers0) == 1
+        assert fleet.owner_of("mkt") == survivor
+        # the acknowledged append survived the whole dance, bit for bit
+        fleet.append("mkt", make_block(0, 1))
+        got = fleet.submit(session="mkt").result(timeout=60)
+        ref = MarketSession("ref", N_REPORTERS)
+        ref.append(make_block(0, 0))
+        ref.append(make_block(0, 1))
+        want = ref.resolve()
+        np.testing.assert_array_equal(
+            np.asarray(got["agents"]["smooth_rep"]),
+            np.asarray(want["smooth_rep"]))
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_final"]),
+            np.asarray(want["outcomes_final"]))
+        fleet.close(drain=True, timeout=30.0)
+
+
+# -- fault injection ---------------------------------------------------------
+
+
+class TestAutoscaleFaults:
+    def test_decide_fault_costs_one_period(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2)
+        scaler, mon = make_scaler(fleet)
+        errors0 = decisions("error")
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "autoscale.decide", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            d = scaler.evaluate(now=0.0)
+        assert plan.fired == [("autoscale.decide", 0, "raise")]
+        assert d["action"] == "error"
+        assert "OSError" in d["error"]
+        assert decisions("error") - errors0 == 1
+        # the loop outlives the fault: the next period decides normally
+        assert scaler.evaluate(now=0.5)["action"] == "hold"
+
+    def test_spawn_fault_never_half_changes_membership(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=1)
+        scaler, mon = make_scaler(fleet, up_signals=1, cooldown_s=0.0)
+        mon.win = BREACHED
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "autoscale.spawn", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            d = scaler.evaluate(now=0.0)
+        assert d["action"] == "error"
+        assert len(fleet.ring.workers()) == 1   # nothing half-spawned
+        # re-attempted from fresh signals the next period
+        assert scaler.evaluate(now=0.5)["action"] == "scale_up"
+        assert len(fleet.ring.workers()) == 2
+        fleet.close(drain=False, timeout=10.0)
+
+    def test_drain_fault_never_half_drains(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        scaler, mon = make_scaler(fleet, down_signals=1, cooldown_s=0.0)
+        mon.win = IDLE
+        plan = faults.FaultPlan(seed=0, rules=[
+            {"site": "autoscale.drain", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            d = scaler.evaluate(now=0.0)
+        assert d["action"] == "error"
+        # an aborted decision, never a half-drained fleet
+        assert sorted(fleet.ring.workers()) == ["w0", "w1"]
+        assert scaler.evaluate(now=0.5)["action"] == "scale_down"
+        assert list(fleet.ring.workers()) == ["w0"]
+        fleet.close(drain=True, timeout=10.0)
+
+
+# -- the production loop -----------------------------------------------------
+
+
+class TestAutoscalerThread:
+    def test_run_in_thread_is_idempotent_and_stops(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=1)
+        scaler, mon = make_scaler(fleet)
+        scaler.config = AutoscaleConfig(interval_s=0.02, warmup=False)
+        assert scaler.run_in_thread() is scaler
+        th = scaler._thread
+        assert scaler.run_in_thread() is scaler     # idempotent
+        assert scaler._thread is th
+        deadline = 100
+        while not scaler.status()["last_decision"] and deadline:
+            deadline -= 1
+            threading.Event().wait(0.02)
+        assert scaler.status()["last_decision"]["action"] == "hold"
+        scaler.stop()
+        assert scaler._thread is None
+        scaler.stop()                               # stop is idempotent
+        # stopping the loop is not a scale-to-zero
+        assert len(fleet.ring.workers()) == 1
+
+
+# -- elastic membership ------------------------------------------------------
+
+
+class TestElasticMembership:
+    def test_worker_names_are_monotonic_never_reused(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        assert fleet.drain_worker("w1")["drained"] is True
+        assert fleet.add_worker(warmup=False) == "w2"   # not "w1"
+        fleet.kill_worker("w2")
+        assert fleet.add_worker(warmup=False) == "w3"   # nor "w2"
+        with pytest.raises(InputError, match="already exists"):
+            fleet.add_worker(name="w0")
+        fleet.close(drain=True, timeout=10.0)
+
+    def test_drain_refuses_the_last_ring_worker(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=1)
+        with pytest.raises(PlacementError, match="last worker"):
+            fleet.drain_worker("w0")
+
+    def test_drain_unknown_worker_is_placement_error(self, tmp_path):
+        fleet = mini_fleet(tmp_path, n=2)
+        with pytest.raises(PlacementError, match="unknown worker"):
+            fleet.drain_worker("w99")
+
+    def test_drain_migrates_every_live_session_bit_identical(
+            self, tmp_path):
+        """Chaos pin (b) in-process: scale-down live-migrates EVERY
+        session off the victim with zero loss — the survivors' bits
+        match a single box that saw the same appends, and the drained
+        worker has left the fleet entirely."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        names = [f"m{i}" for i in range(5)]
+        for n in names:
+            fleet.create_session(n, n_reporters=6)
+            fleet.append(n, make_block(0, 0)[:6])
+            fleet.submit(session=n).result(timeout=60)  # acked round
+        victim = fleet.owner_of(names[0])
+        mine = sorted(n for n in names if fleet.owner_of(n) == victim)
+        migrated0 = obs.value("pyconsensus_sessions_migrated_total") or 0
+        res = fleet.drain_worker(victim)
+        assert res["drained"] is True
+        assert sorted(s for s, _ in res["sessions_migrated"]) == mine
+        assert victim not in fleet.ring.workers()
+        assert not fleet.workers[victim].alive
+        assert ((obs.value("pyconsensus_sessions_migrated_total") or 0)
+                - migrated0) == len(mine)
+        # every session still serves, on the survivor, bit-identical to
+        # the never-drained single box (a DurableSession on its own
+        # log: the same journal-staged fold the fleet runs — the
+        # migration contract is exactly "as if the drain never
+        # happened", staging machinery included)
+        survivor = fleet.ring.workers()[0]
+        for n in names:
+            assert fleet.owner_of(n) == survivor
+            fleet.append(n, make_block(1, 0)[:6])
+            got = fleet.submit(session=n).result(timeout=60)
+            ref = DurableSession.create(tmp_path / "refs", n, 6)
+            ref.append(make_block(0, 0)[:6])
+            ref.resolve()
+            ref.append(make_block(1, 0)[:6])
+            want = ref.resolve()
+            np.testing.assert_array_equal(
+                np.asarray(got["agents"]["smooth_rep"]),
+                np.asarray(want["smooth_rep"]))
+            np.testing.assert_array_equal(
+                np.asarray(got["events"]["outcomes_final"]),
+                np.asarray(want["outcomes_final"]))
+        # a second drain of the departed worker is a structured no-op
+        again = fleet.drain_worker(victim)
+        assert again["drained"] is False
+        assert again["sessions_migrated"] == []
+        fleet.close(drain=True, timeout=30.0)
+
+    def test_killing_a_drained_worker_runs_no_takeover(self, tmp_path):
+        """Death after departure: the drained worker owns nothing, so a
+        late declaration (monitor scan, chaos kill) must not re-run a
+        takeover or disturb the migrated sessions."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        fleet.create_session("s", n_reporters=6)
+        victim = fleet.owner_of("s")
+        fleet.drain_worker(victim)
+        owner = fleet.owner_of("s")
+        failovers0 = obs.value("pyconsensus_failovers_total") or 0
+        info = fleet.kill_worker(victim)
+        assert info["sessions_migrated"] == []
+        assert (obs.value("pyconsensus_failovers_total") or 0) \
+            == failovers0
+        assert fleet.owner_of("s") == owner
+        fleet.close(drain=True, timeout=10.0)
+
+
+# -- the drain-vs-death race -------------------------------------------------
+
+
+class TestDrainVsDeathRace:
+    def test_death_before_drain_is_a_noop_drain(self, tmp_path):
+        """The declaration wins outright: a worker killed BEFORE the
+        drain starts has already handed its sessions to the takeover —
+        the drain observes the corpse and does nothing."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        fleet.create_session("s", n_reporters=6)
+        fleet.append("s", make_block(0, 0)[:6])
+        victim = fleet.owner_of("s")
+        fleet.kill_worker(victim)
+        owner = fleet.owner_of("s")
+        assert owner != victim
+        res = fleet.drain_worker(victim)
+        assert res["drained"] is False
+        assert res["sessions_migrated"] == []
+        assert fleet.owner_of("s") == owner
+        fleet.close(drain=True, timeout=10.0)
+
+    def test_drain_refuses_when_only_peer_is_an_undeclared_corpse(
+            self, tmp_path):
+        """Ring membership is not liveness: between a peer's death and
+        its heartbeat-staleness declaration the ring still lists the
+        corpse. A drain that counted it as surviving capacity would
+        shut down the last LIVE worker and migrate its sessions onto a
+        corpse — the drain must probe and refuse instead."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        fleet.workers["w0"].hard_kill(0.2)      # dead, NOT declared
+        assert sorted(fleet.ring.workers()) == ["w0", "w1"]
+        with pytest.raises(PlacementError, match="no surviving ring"):
+            fleet.drain_worker("w1")
+        # the refused drain left w1 untouched: on the ring, alive
+        assert "w1" in fleet.ring.workers()
+        assert fleet.workers["w1"].alive
+        # once the monitor declares the corpse, w1 is the last ring
+        # worker — still undrainable, by the last-worker rule
+        fleet.check_workers()
+        assert list(fleet.ring.workers()) == ["w1"]
+        with pytest.raises(PlacementError, match="last worker"):
+            fleet.drain_worker("w1")
+        fleet.close(drain=False, timeout=10.0)
+
+    @pytest.mark.parametrize("kill_point", [0, 1, 2])
+    def test_sigkill_mid_drain_single_takeover_bit_identical(
+            self, tmp_path, kill_point):
+        """The satellite property test: SIGKILL the worker being
+        gracefully drained, at every migration step the fence sequence
+        exposes. Holding the victim's declare lock across the drain
+        serializes the racing declaration — it blocks, then observes an
+        off-ring worker with nothing left to move. Exactly ONE takeover
+        runs, every session lands exactly once, and the resolved bits
+        match a never-killed run."""
+        fleet = mini_fleet(tmp_path, n=2).start(warmup=False)
+        names = [f"m{i}" for i in range(5)]
+        for n in names:
+            fleet.create_session(n, n_reporters=6)
+            fleet.append(n, make_block(0, 0)[:6])
+            fleet.submit(session=n).result(timeout=60)  # acked round
+        by_owner = {}
+        for n in names:
+            by_owner.setdefault(fleet.owner_of(n), []).append(n)
+        # the majority owner has >= 3 of 5 sessions (pigeonhole), so
+        # every parametrized kill point lands inside its fence sequence
+        victim = max(by_owner, key=lambda w: len(by_owner[w]))
+        mine = sorted(by_owner[victim])
+        assert len(mine) > kill_point
+        w = fleet.workers[victim]
+        failovers0 = obs.value("pyconsensus_failovers_total") or 0
+        migrated0 = obs.value("pyconsensus_sessions_migrated_total") or 0
+
+        race = []
+        killer = threading.Thread(
+            target=lambda: race.append(fleet.kill_worker(victim)))
+        orig_fence = w.fence_session
+        calls = {"n": 0}
+
+        def fence_and_die(name, exc):
+            if calls["n"] == kill_point:
+                # the in-process SIGKILL model lands mid-migration, and
+                # a concurrent declaration races the rest of the drain
+                w.hard_kill(0.2)
+                killer.start()
+            calls["n"] += 1
+            return orig_fence(name, exc)
+
+        w.fence_session = fence_and_die
+        res = fleet.drain_worker(victim)
+        killer.join(timeout=30.0)
+        assert not killer.is_alive()
+        # the drain completed: the log is the source of truth, so the
+        # mid-drain death changes nothing about what migrates
+        assert res["drained"] is True
+        assert sorted(s for s, _ in res["sessions_migrated"]) == mine
+        # the racing declaration blocked on the declare lock, then
+        # observed nothing left to move: exactly one takeover ran and
+        # each session landed exactly once
+        assert race and race[0]["sessions_migrated"] == []
+        assert ((obs.value("pyconsensus_failovers_total") or 0)
+                - failovers0) == 1
+        assert ((obs.value("pyconsensus_sessions_migrated_total") or 0)
+                - migrated0) == len(mine)
+        survivor = fleet.ring.workers()[0]
+        assert survivor != victim
+        assert set(fleet.sessions()) == set(names)
+        assert set(fleet.sessions().values()) == {survivor}
+        # bit-identity against the never-killed single box (a durable
+        # session on its own log — the same journal-staged fold)
+        for n in names:
+            fleet.append(n, make_block(1, 0)[:6])
+            got = fleet.submit(session=n).result(timeout=60)
+            ref = DurableSession.create(tmp_path / "refs", n, 6)
+            ref.append(make_block(0, 0)[:6])
+            ref.resolve()
+            ref.append(make_block(1, 0)[:6])
+            want = ref.resolve()
+            np.testing.assert_array_equal(
+                np.asarray(got["agents"]["smooth_rep"]),
+                np.asarray(want["smooth_rep"]))
+            np.testing.assert_array_equal(
+                np.asarray(got["events"]["outcomes_final"]),
+                np.asarray(want["outcomes_final"]))
+        fleet.close(drain=True, timeout=30.0)
+
+
+# -- the SLO window under membership change ----------------------------------
+
+
+def _member_snap(requests=None, counts=None, edges=(0.005, 0.05, 0.5)):
+    """Hand-built MERGED-registry snapshot with per-worker series — the
+    membership-change shape the fleet's merged cluster view produces."""
+    snap = {}
+    if requests is not None:
+        snap["pyconsensus_serve_requests_total"] = {
+            "kind": "counter", "labels": ["worker"],
+            "series": {k: float(v) for k, v in requests.items()}}
+    if counts is not None:
+        snap["pyconsensus_serve_request_seconds"] = {
+            "kind": "histogram", "labels": ["worker"],
+            "edges": list(edges),
+            "series": {k: {"sum": 0.0, "count": sum(v),
+                           "counts": list(v)}
+                       for k, v in counts.items()}}
+    return snap
+
+
+def _feed(monitor, timeline):
+    feed = {"snap": {}}
+    monitor._snapshot_fn = lambda: feed["snap"]
+    for now, snap in timeline:
+        feed["snap"] = snap
+        monitor.sample(now=now)
+
+
+class TestSloWindowMembership:
+    def test_worker_born_inside_window_charges_window_local_counts(
+            self):
+        """A scale-up mid-window: the new worker's cumulative counters
+        ARE window-local (they started at zero when it joined) — the
+        cluster rate is the sum, not a phantom."""
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _member_snap(requests={"w0": 100.0})),
+                  (1.0, _member_snap(requests={"w0": 110.0,
+                                               "w1": 5.0}))])
+        assert m.window()["request_rate_rps"] == 15.0
+
+    def test_drained_worker_vanishing_series_never_negative(self):
+        """A scale-down mid-window: the departed worker's series
+        vanishes from the merged snapshot — it contributes zero, never
+        a negative delta that bends the cluster rate."""
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _member_snap(requests={"w0": 100.0,
+                                               "w1": 80.0})),
+                  (1.0, _member_snap(requests={"w0": 110.0}))])
+        assert m.window()["request_rate_rps"] == 10.0
+
+    def test_histogram_membership_change_keeps_quantiles_honest(self):
+        """Bucket deltas are taken per series THEN summed: the joining
+        worker's window-local counts drive the quantile, the steady
+        worker's unchanged cumulative counts contribute nothing."""
+        m = SloMonitor(window_s=60.0, snapshot_fn=dict)
+        _feed(m, [(0.0, _member_snap(counts={"w0": [90, 9, 1, 0]})),
+                  (1.0, _member_snap(counts={"w0": [90, 9, 1, 0],
+                                             "w1": [10, 0, 0, 0]}))])
+        assert m.window()["p50_ms"] == 5.0      # w1's 10 fast requests
+
+    def test_real_scale_up_mid_window_keeps_rate_honest(self, tmp_path):
+        """The REAL thing: sample the fleet's merged snapshot, grow the
+        fleet mid-window, and the windowed request rate counts exactly
+        the requests served — no double count, no negative bend."""
+        fleet = mini_fleet(tmp_path, n=1).start(warmup=False)
+        m = SloMonitor(window_s=60.0, snapshot_fn=fleet.merged_snapshot)
+
+        def req_total(snap):
+            series = snap.get("pyconsensus_serve_requests_total",
+                              {}).get("series") or {}
+            return sum(series.values())
+        for _ in range(3):
+            fleet.submit(reports=np.ones((3, 3)),
+                         backend="numpy").result(timeout=60)
+        before = req_total(fleet.merged_snapshot())
+        m.sample(now=0.0)
+        fleet.add_worker(warmup=False)          # membership change
+        for _ in range(4):
+            fleet.submit(reports=np.ones((3, 3)),
+                         backend="numpy").result(timeout=60)
+        after = req_total(fleet.merged_snapshot())
+        win = m.sample(now=1.0)
+        # on a pure scale-up no series vanishes, so the per-series
+        # window delta must equal the plain cluster-total difference —
+        # a double count (or the new worker's series read as phantom
+        # history) would bend it
+        assert win["request_rate_rps"] == pytest.approx(after - before)
+        assert after - before > 0
+        assert win["shed_ratio"] == 0.0         # nothing shed
+        fleet.close(drain=True, timeout=30.0)
