@@ -263,6 +263,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--autoscale-burst-rps", type=float, default=28.0,
                     help="flash-crowd peak offered rate of the "
                          "autoscale probe")
+    ap.add_argument("--no-state-plane", action="store_true",
+                    help="skip the fail-soft state-plane block (ISSUE "
+                         "20: sessions/GB and p50/p99 touch latency "
+                         "over --state-plane-sessions durable sessions, "
+                         "tiered vs all-hot, plus time-to-takeover with "
+                         "compacted vs uncompacted logs — fsync-bound, "
+                         "the slowest probe block)")
+    ap.add_argument("--state-plane-sessions", type=int, default=10000,
+                    help="live durable sessions in the state-plane "
+                         "probe (the acceptance floor is 10k+)")
+    ap.add_argument("--state-plane-hot", type=int, default=512,
+                    help="hot-tier capacity of the state-plane probe's "
+                         "tiered store")
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fail-soft fleet chaos probe (worker "
                          "kill mid-traffic + session failover, appended "
@@ -547,6 +560,7 @@ def run_bench(args) -> None:
     out_json["multiproc"] = _multiproc_block(args)
     out_json["telemetry"] = _telemetry_block(args)
     out_json["autoscale"] = _autoscale_block(args)
+    out_json["state_plane"] = _state_plane_block(args)
     out_json["economy"] = _economy_block(args)
     print(json.dumps(out_json))
 
@@ -1705,6 +1719,178 @@ def _autoscale_block(args):
         return None
 
 
+def _state_plane_block(args):
+    """ISSUE 20: the million-session state-plane numbers — can one
+    worker OWN far more sessions than it HOLDS, and what do compaction
+    and tiering buy? Seeds ``--state-plane-sessions`` durable sessions
+    through a ``--state-plane-hot``-capacity TieredSessionStore
+    (eviction bounds residency as the seed pass runs), then measures:
+    RSS and sessions/GB tiered vs all-hot (the same on-disk logs
+    re-registered into an evict-nothing store), p50/p99 cold-touch
+    latency (get + append: the tiered p99 PAYS the hydration — that is
+    the tax the tier charges) vs the all-hot baseline, a sampled
+    bit-identity check (hydrated resolve vs a replay of the
+    pre-resolve log copy), and time-to-takeover — ``replay_session``
+    wall time over a fat open round, uncompacted log vs snapshot +
+    suffix. FAIL-SOFT like every probe block; ``--no-state-plane``
+    opts out."""
+    if args.no_state_plane:
+        return None
+
+    import gc
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    def rss_mb():
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+
+    try:
+        import numpy as np
+
+        from pyconsensus_tpu import obs
+        from pyconsensus_tpu.serve.failover import (DurableSession,
+                                                    replay_session)
+        from pyconsensus_tpu.serve.stateplane import (TieredSessionStore,
+                                                      hydrate_session)
+
+        S = max(int(args.state_plane_sessions), 8)
+        HOT = max(1, min(int(args.state_plane_hot), S))
+        sample_n = min(2000, S)
+        root = tempfile.mkdtemp(prefix="bench-stateplane-")
+        logs = os.path.join(root, "logs")
+        rng = np.random.default_rng(args.serve_seed)
+        block = rng.choice([0.0, 1.0], size=(12, 5))
+        names = [f"sp-{i:06d}" for i in range(S)]
+
+        def hydrations():
+            return int(obs.value(
+                "pyconsensus_sessions_hydrated_total") or 0)
+
+        try:
+            # warm the lazy import graph (jax, the serve modules)
+            # before the RSS baseline so the deltas measure SESSIONS,
+            # not modules
+            warm = DurableSession.create(os.path.join(root, "warm"),
+                                         "warm", 12)
+            warm.append(block)
+            replay_session(os.path.join(root, "warm"), "warm")
+            del warm
+            gc.collect()
+            rss_base = rss_mb()
+
+            # -- tiered: seed S sessions THROUGH the tier (LRU
+            # eviction keeps residency bounded while ownership grows)
+            tiered = TieredSessionStore(HOT)
+            tiered.hydrator = lambda n: hydrate_session(logs, n)
+
+            def seed(name):
+                s = DurableSession.create(logs, name, 12)
+                s.append(block)
+                tiered.add(s)
+
+            with ThreadPoolExecutor(16) as ex:
+                list(ex.map(seed, names))
+            gc.collect()
+            rss_tiered = rss_mb()
+            assert len(tiered.hot_names()) <= HOT
+
+            # cold-touch latency: get + append; with sample_n >> HOT
+            # nearly every touch hydrates first
+            hyd0 = hydrations()
+            touch_tiered = []
+            for name in names[:sample_n]:
+                t0 = time.perf_counter()
+                tiered.get(name).append(block)
+                touch_tiered.append((time.perf_counter() - t0) * 1e3)
+            hydrated = hydrations() - hyd0
+
+            # sampled bit-identity: hydrated resolve vs a replay of
+            # the log copied BEFORE the resolve mutated it
+            bit_identical = True
+            for name in names[:8]:
+                ref_dir = os.path.join(root, "ref")
+                shutil.copytree(os.path.join(logs, name),
+                                os.path.join(ref_dir, name))
+                got = tiered.get(name).resolve()
+                want = replay_session(ref_dir, name).resolve()
+                bit_identical = bit_identical and all(
+                    np.array_equal(np.asarray(got[k]),
+                                   np.asarray(want[k]))
+                    for k in ("outcomes_final", "smooth_rep"))
+                shutil.rmtree(ref_dir, ignore_errors=True)
+            del tiered
+            gc.collect()
+
+            # -- all-hot baseline: the SAME logs re-registered into a
+            # store big enough that nothing ever leaves memory
+            all_hot = TieredSessionStore(S)
+
+            def register(name):
+                all_hot.add(hydrate_session(logs, name))
+
+            with ThreadPoolExecutor(16) as ex:
+                list(ex.map(register, names))
+            gc.collect()
+            rss_all_hot = rss_mb()
+            touch_hot = []
+            for name in names[:sample_n]:
+                t0 = time.perf_counter()
+                all_hot.get(name).append(block)
+                touch_hot.append((time.perf_counter() - t0) * 1e3)
+
+            # -- time-to-takeover: a fat open round (120 staged
+            # appends) replayed from the raw journal vs from its
+            # snapshot + suffix after one compaction
+            tk = DurableSession.create(os.path.join(root, "tk"),
+                                       "takeover", 12)
+            for _ in range(120):
+                tk.append(block)
+            jb_before = tk.journal_bytes()
+            t0 = time.perf_counter()
+            replay_session(os.path.join(root, "tk"), "takeover")
+            takeover_raw = (time.perf_counter() - t0) * 1e3
+            tk.compact()
+            jb_after = tk.journal_bytes()
+            t0 = time.perf_counter()
+            replay_session(os.path.join(root, "tk"), "takeover")
+            takeover_compacted = (time.perf_counter() - t0) * 1e3
+
+            def pct(xs, q):
+                return round(float(np.percentile(np.asarray(xs), q)), 3)
+
+            def per_gb(rss_delta):
+                return (None if rss_delta <= 0
+                        else int(S / (rss_delta / 1024.0)))
+
+            return {
+                "sessions": S,
+                "hot_capacity": HOT,
+                "rss_mb_tiered": round(rss_tiered - rss_base, 1),
+                "rss_mb_all_hot": round(rss_all_hot - rss_base, 1),
+                "sessions_per_gb_tiered": per_gb(rss_tiered - rss_base),
+                "sessions_per_gb_all_hot": per_gb(rss_all_hot - rss_base),
+                "touch_ms_p50_tiered": pct(touch_tiered, 50),
+                "touch_ms_p99_tiered": pct(touch_tiered, 99),
+                "touch_ms_p50_all_hot": pct(touch_hot, 50),
+                "touch_ms_p99_all_hot": pct(touch_hot, 99),
+                "hydrations": hydrated,
+                "bit_identical_sample": bool(bit_identical),
+                "takeover_ms_uncompacted": round(takeover_raw, 2),
+                "takeover_ms_compacted": round(takeover_compacted, 2),
+                "journal_bytes_uncompacted": jb_before,
+                "journal_bytes_compacted": jb_after,
+            }
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    except Exception as exc:                      # noqa: BLE001
+        print(f"WARNING: state-plane block unavailable: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
 def _economy_block(args):
     """ISSUE 11 tentpole (c): the "is the oracle economically sound
     under production traffic" number — an adversarial economy of
@@ -2022,6 +2208,10 @@ def main() -> None:
     if "--no-telemetry" not in smoke_argv:
         # ditto the telemetry probe (it also spawns a socket fleet)
         smoke_argv.append("--no-telemetry")
+    if "--no-state-plane" not in smoke_argv:
+        # the fsync-bound 10k-session seed pass is the slowest probe
+        # of all — not smoke material
+        smoke_argv.append("--no-state-plane")
     if args.scaled:
         smoke_argv += ["--scaled", str(max(1, min(args.scaled, 256)))]
     smoke_line, smoke_reason = _run_child(

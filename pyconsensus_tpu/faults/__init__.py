@@ -40,7 +40,8 @@ from .errors import (ERROR_CODES, AotCacheCorruptionError,
                      CheckpointCorruptionError, ConsensusError,
                      ConvergenceError, FailoverInProgressError,
                      HandshakeError, InputError, NumericsError,
-                     PlacementError, ServiceOverloadError, TransportError,
+                     PlacementError, ServiceOverloadError,
+                     SnapshotCorruptionError, TransportError,
                      WorkerLostError)
 from .plan import (FAULT_SITES, FaultPlan, FaultRule, SimulatedCrash,
                    active_plan, arm, armed, corrupt, disarm, fire)
@@ -51,7 +52,7 @@ __all__ = [
     "arm", "disarm", "armed", "active_plan", "fire", "corrupt",
     "ConsensusError", "InputError", "NumericsError", "ConvergenceError",
     "CheckpointCorruptionError", "AotCacheCorruptionError",
-    "ServiceOverloadError",
+    "SnapshotCorruptionError", "ServiceOverloadError",
     "WorkerLostError", "FailoverInProgressError", "PlacementError",
     "TransportError", "HandshakeError",
     "ERROR_CODES",

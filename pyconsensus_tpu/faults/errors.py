@@ -59,7 +59,8 @@ from __future__ import annotations
 
 __all__ = ["ConsensusError", "InputError", "NumericsError",
            "ConvergenceError", "CheckpointCorruptionError",
-           "AotCacheCorruptionError", "ServiceOverloadError",
+           "AotCacheCorruptionError", "SnapshotCorruptionError",
+           "ServiceOverloadError",
            "WorkerLostError", "FailoverInProgressError",
            "PlacementError", "TransportError", "HandshakeError",
            "ERROR_CODES"]
@@ -128,6 +129,26 @@ class AotCacheCorruptionError(CheckpointCorruptionError):
     source of truth) are the checkpoint discipline's."""
 
     error_code = "PYC302"
+
+
+class SnapshotCorruptionError(CheckpointCorruptionError):
+    """A compaction snapshot (``serve.stateplane``, ISSUE 20) failed
+    verify-before-adopt AND the journal suffix behind it was already
+    truncated — the one state-plane failure that cannot self-heal from
+    local disk alone. A torn/corrupt snapshot whose journal is still
+    intact (the crash landed between snapshot write and truncation) is
+    NOT this error: replay simply ignores the bad snapshot, rebuilds
+    from the untruncated journal, and the next compaction sweep
+    replaces it (``pyconsensus_compactions_total{outcome="refused"}``).
+    This class fires only when records the snapshot was supposed to
+    cover are gone, so adopting the session locally would lose
+    acknowledged rounds; recovery is a shipped copy or an operator
+    restoring the snapshot file. ``context`` carries the refusing
+    check (``reason``), the snapshot ``path``, and the missing prefix
+    length. A corruption subclass of PYC301 like PYC302: same
+    never-adopt discipline, narrower blast radius."""
+
+    error_code = "PYC303"
 
 
 class ServiceOverloadError(ConsensusError, RuntimeError):
@@ -217,7 +238,8 @@ ERROR_CODES = {
     cls.error_code: cls
     for cls in (ConsensusError, InputError, NumericsError,
                 ConvergenceError, CheckpointCorruptionError,
-                AotCacheCorruptionError, ServiceOverloadError,
+                AotCacheCorruptionError, SnapshotCorruptionError,
+                ServiceOverloadError,
                 WorkerLostError, FailoverInProgressError, PlacementError,
                 TransportError, HandshakeError)
 }

@@ -72,6 +72,7 @@ FAULT_SITES = (
     "econ.round", "econ.panel", "econ.submit",
     "transport.send", "transport.recv", "transport.connect",
     "shipping.append",
+    "state.snapshot", "state.compact", "state.hydrate", "state.migrate",
 )
 
 

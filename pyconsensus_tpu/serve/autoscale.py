@@ -106,6 +106,16 @@ class AutoscaleConfig:
     #: warm-up policy handed to ``ConsensusFleet.add_worker`` (the AOT
     #: disk cache makes this retrace-free when primed)
     warmup: bool = True
+    #: after a scale-up, live-rebalance onto the new worker the
+    #: sessions whose ring home it now is (ISSUE 20:
+    #: ``ConsensusFleet.rebalance_to``, fail-soft — a refused migration
+    #: leaves the session serving where it was). Without this a grown
+    #: fleet only spreads NEW sessions; the hot ones that triggered the
+    #: scale-up stay crowded on the old workers.
+    rebalance_on_scale_up: bool = True
+    #: bound on sessions moved per scale-up rebalance (None = all of
+    #: the new worker's keys) — caps the one-time migration burst
+    rebalance_max_sessions: Optional[int] = None
 
 
 class AutoScaler:
@@ -260,6 +270,23 @@ class AutoScaler:
         with obs.span("autoscale.spawn", action=action,
                       breached=",".join(decision["breached"])):
             name = self.fleet.add_worker(warmup=self.config.warmup)
+        if self.config.rebalance_on_scale_up and action == "scale_up":
+            # placement pressure (ISSUE 20): move the new worker's ring
+            # keys onto it. Fail-soft — rebalancing is advisory, and a
+            # failed migration leaves the session serving where it was;
+            # the scale-up itself already succeeded. A REPLACEMENT is
+            # exempt: the takeover just placed the dead worker's
+            # sessions on survivors deliberately, and migrating them
+            # again right after the incident would double the
+            # disruption for zero durability gain.
+            try:
+                with obs.span("autoscale.rebalance", worker=name):
+                    moved = self.fleet.rebalance_to(
+                        name,
+                        max_sessions=self.config.rebalance_max_sessions)
+                decision["sessions_rebalanced"] = len(moved)
+            except Exception:   # noqa: BLE001 — the grown fleet still
+                decision["sessions_rebalanced"] = 0     # serves
         self._target = max(self._target, len(self.fleet.ring.workers()))
         self._target = min(self._target, self.config.max_workers)
         self._last_change_t = t

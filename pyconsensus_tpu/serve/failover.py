@@ -14,6 +14,11 @@ deployment substrate the checkpointed sweep uses). Layout per session::
                                         # round (atomic, fsynced)
         staged/round_<k>_block_<i>.npz  # round k's journaled appends,
                                         # SHA-256 content-digested
+        snapshot.npz                    # optional compaction record
+                                        # (serve.stateplane, ISSUE 20):
+                                        # the open round's journaled
+                                        # prefix + dedupe set + ledger
+                                        # tree, truncating the journal
 
 Write ordering is what makes "zero lost resolutions" true:
 
@@ -50,7 +55,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..faults import CheckpointCorruptionError, InputError
+from ..faults import (CheckpointCorruptionError, InputError,
+                      SnapshotCorruptionError)
 from ..faults import plan as _faults
 from ..io import atomic_write
 from ..ledger import ReputationLedger
@@ -87,6 +93,7 @@ class ReplicationLog:
         self.staged_dir = self.dir / "staged"
         self.ledger_path = self.dir / "ledger.npz"
         self.meta_path = self.dir / "meta.json"
+        self.snapshot_path = self.dir / "snapshot.npz"
 
     # -- creation / opening ---------------------------------------------
 
@@ -219,31 +226,77 @@ class ReplicationLog:
         bounds = json.loads(bounds_json.decode())
         return index, block, bounds, append_id
 
-    def staged(self, round_idx: int) -> list:
+    def _staged_entries(self, round_idx: int) -> list:
+        """Sorted ``[(index, path), ...]`` of round ``round_idx``'s
+        on-disk journal records (index from the filename — content is
+        not read here)."""
+        entries = []
+        if self.staged_dir.exists():
+            for p in sorted(self.staged_dir.iterdir()):
+                m = _BLOCK_RE.match(p.name)
+                if m and int(m.group(1)) == int(round_idx):
+                    entries.append((int(m.group(2)), p))
+        entries.sort()
+        return entries
+
+    def staged(self, round_idx: int, start: int = 0) -> list:
         """The journaled blocks of round ``round_idx`` in append order:
         ``[(block, bounds, append_id), ...]`` (the id element is None
         for id-less records; existing positional consumers of
         ``[0]``/``[1]`` are unaffected). Validates digests and index
         contiguity (a gap means a deleted/lost record — replication is
-        torn, refuse)."""
-        found = []
-        if self.staged_dir.exists():
-            for p in sorted(self.staged_dir.iterdir()):
-                m = _BLOCK_RE.match(p.name)
-                if m and int(m.group(1)) == int(round_idx):
-                    found.append(p)
+        torn, refuse). ``start`` is the compaction suffix mode (ISSUE
+        20): records below it are covered by the snapshot — any still
+        on disk are the harmless artifact of a crash between snapshot
+        write and truncation, ignored — and contiguity is required
+        from ``start`` instead of 0."""
         out, indices = [], []
-        for p in found:
+        for name_idx, p in self._staged_entries(round_idx):
+            if name_idx < int(start):
+                continue            # snapshot-covered duplicate prefix
             index, block, bounds, append_id = self._read_block(p)
             indices.append(index)
             out.append((block, bounds, append_id))
-        if indices != list(range(len(indices))):
+        if indices != list(range(int(start), int(start) + len(indices))):
             raise CheckpointCorruptionError(
                 f"{self.staged_dir}: staged blocks of round {round_idx} "
-                f"are not contiguous from 0 (got indices {indices}) — a "
+                f"are not contiguous from {int(start)} (got indices "
+                f"{indices}) — a "
                 f"journal record is missing", source=str(self.staged_dir),
                 round=int(round_idx), indices=indices)
         return out
+
+    def truncate_staged(self, round_idx: int, upto: int) -> int:
+        """Compaction truncation (ISSUE 20): unlink round
+        ``round_idx``'s records with index below ``upto`` — the
+        snapshot now carries them. Only ever called AFTER the snapshot
+        write landed (its atomic rename is the commit point); the
+        ``state.compact`` fault site fires before each unlink, so a
+        chaos rule can kill the truncation at any fence point and
+        replay still folds snapshot prefix + surviving records whole.
+        Returns the number of records removed."""
+        removed = 0
+        for name_idx, p in self._staged_entries(round_idx):
+            if name_idx < int(upto):
+                # raise-only form: a chaos rule kills the truncation at
+                # this fence; torn_write is meaningless on an unlink
+                _faults.fire("state.compact")
+                p.unlink(missing_ok=True)
+                removed += 1
+        return removed
+
+    def journal_bytes(self) -> int:
+        """Total on-disk bytes of the staged journal (the truncatable
+        part — what compaction shrinks and the
+        ``pyconsensus_session_journal_bytes`` gauge reports)."""
+        total = 0
+        if self.staged_dir.exists():
+            for p in self.staged_dir.iterdir():
+                try:
+                    total += p.stat().st_size
+                except OSError:
+                    pass            # racing a truncation is not an error
+        return total
 
     def commit_round(self, ledger: ReputationLedger) -> None:
         """Persist the post-round ledger state, then clear every staged
@@ -273,15 +326,31 @@ class ReplicationLog:
 
     def verify_collect(self) -> tuple:
         """:meth:`verify` plus everything the takeover replay needs:
-        ``(summary, [(block, bounds), ...], ledger_state_or_None)``.
+        ``(summary, [(block, bounds, append_id), ...],
+        ledger_state_or_None, dedupe_ids)``.
         The takeover path uses this so the journal AND the ledger
         checkpoint are each read and validated ONCE — re-reading either
         after the preflight would double the I/O inside the exact
-        window clients are being shed with PYC502."""
+        window clients are being shed with PYC502.
+
+        Snapshot-aware (ISSUE 20): a valid ``snapshot.npz`` at the
+        ledger's open round contributes its journaled prefix (the
+        staged list is snapshot prefix + on-disk suffix — bit-identical
+        input to what the full journal would have yielded, because the
+        snapshot was built FROM that journal); a snapshot at an older
+        round is stale — its prefix is ignored but its dedupe set (the
+        only durable record of committed rounds' idempotency tokens)
+        is still honored. A torn/corrupt snapshot over an intact
+        journal is refused and ignored
+        (``pyconsensus_compactions_total{outcome="refused"}`` — the
+        next sweep rebuilds it); over an already-truncated journal it
+        raises PYC303, the one state-plane failure local disk cannot
+        heal."""
         meta = self.meta()
         summary = {"session": meta["session"],
                    "n_reporters": int(meta["n_reporters"]),
-                   "round": 0, "staged_blocks": 0, "ledger": None}
+                   "round": 0, "staged_blocks": 0, "ledger": None,
+                   "snapshot": None}
         state = None
         if self.ledger_path.exists():
             state = ReputationLedger._read_state(self.ledger_path)
@@ -296,9 +365,75 @@ class ReplicationLog:
                                  "round": int(state["round"]),
                                  "rounds_recorded": len(state["history"])}
             summary["round"] = int(state["round"])
-        staged = self.staged(summary["round"])
+        open_round = summary["round"]
+        prefix, dedupe, start, hint = [], set(), 0, None
+        if self.snapshot_path.exists():
+            from .stateplane import (count_compaction, load_snapshot,
+                                     snapshot_hint)
+            try:
+                snap = load_snapshot(self.snapshot_path)
+            except CheckpointCorruptionError as exc:
+                snap = None
+                summary["snapshot"] = {"refused": str(exc)}
+                count_compaction("refused")
+                # best-effort coverage hint off the refused bytes: if
+                # the torn file still declares (round, blocks), the
+                # journal below must account for that prefix or the
+                # truncation already ate records only the snapshot
+                # carried (checked after the suffix read)
+                hint = snapshot_hint(self.snapshot_path)
+            if snap is not None:
+                dedupe = set(snap["dedupe"])
+                stale = int(snap["round"]) != open_round
+                summary["snapshot"] = {"round": int(snap["round"]),
+                                       "blocks": len(snap["blocks"]),
+                                       "stale": stale}
+                if not stale:
+                    prefix = snap["blocks"]
+                    start = len(prefix)
+        try:
+            suffix = self.staged(open_round, start=start)
+        except CheckpointCorruptionError:
+            if start == 0:
+                # the journal does not start at 0 and no usable
+                # snapshot covers the gap: if a snapshot FILE exists
+                # (refused or stale) the missing prefix was truncated
+                # behind it — PYC303, unrecoverable from local disk
+                entries = self._staged_entries(open_round)
+                if entries and entries[0][0] > 0 \
+                        and self.snapshot_path.exists():
+                    raise SnapshotCorruptionError(
+                        f"{self.snapshot_path}: the journal of round "
+                        f"{open_round} was truncated behind a snapshot "
+                        f"that cannot be used "
+                        f"({summary.get('snapshot')}) — "
+                        f"{entries[0][0]} prefix record(s) are gone; "
+                        f"recover from the shipped copy",
+                        path=str(self.snapshot_path),
+                        reason="truncated-journal",
+                        missing_prefix=int(entries[0][0]),
+                        round=int(open_round))
+            raise
+        if start == 0 and hint is not None:
+            hint_round, hint_blocks = hint
+            if hint_round == open_round and len(suffix) < hint_blocks:
+                # the journal reads clean but holds FEWER records than
+                # the refused snapshot declared it covered: the
+                # truncation landed and the only copy of the missing
+                # prefix is the unreadable snapshot
+                raise SnapshotCorruptionError(
+                    f"{self.snapshot_path}: the refused snapshot "
+                    f"declares {hint_blocks} covered block(s) of round "
+                    f"{open_round} but only {len(suffix)} journal "
+                    f"record(s) survive — the truncated prefix exists "
+                    f"nowhere readable; recover from the shipped copy",
+                    path=str(self.snapshot_path),
+                    reason="truncated-journal",
+                    missing_prefix=int(hint_blocks - len(suffix)),
+                    round=int(open_round))
+        staged = list(prefix) + suffix
         summary["staged_blocks"] = len(staged)
-        return summary, staged, state
+        return summary, staged, state, dedupe
 
 
 class DurableSession(MarketSession):
@@ -321,6 +456,11 @@ class DurableSession(MarketSession):
         #: Seeded from the journal at replay; a few bytes per append
         #: for the session's lifetime.
         self._applied_append_ids: set = set()   # guarded-by: _lock
+        #: last compaction snapshot's (round, covered-block-count) —
+        #: what the compaction policy measures staleness against; None
+        #: round means never snapshotted (ISSUE 20)
+        self._snap_round: Optional[int] = None  # guarded-by: _lock
+        self._snap_blocks: int = 0              # guarded-by: _lock
 
     @classmethod
     def create(cls, log_root, name: str, n_reporters: int,
@@ -365,6 +505,66 @@ class DurableSession(MarketSession):
         with nothing lost."""
         with self._lock:
             self._fenced = exc
+
+    def journal_bytes(self) -> int:
+        """On-disk bytes of this session's staged journal — the
+        compaction policy's size signal."""
+        return self._log.journal_bytes()
+
+    def compact(self) -> dict:
+        """Snapshot-truncate this session's journal (ISSUE 20): write
+        ``snapshot.npz`` covering the open round's journaled prefix +
+        the cumulative append-dedupe set + the ledger checkpoint tree,
+        then unlink the covered records. The snapshot is built from the
+        VERIFIED on-disk journal (the same read path a takeover replay
+        folds), never from in-memory staging — snapshot + suffix is
+        bit-identical to the full-log replay by construction. Runs
+        under the session lock: no append may journal between the read
+        and the truncation, so the covered prefix is exact. A crash
+        anywhere in here loses nothing — before the snapshot's atomic
+        rename the old state is whole; after it, truncation is
+        idempotent garbage collection replay tolerates."""
+        from .stateplane import (count_compaction, load_snapshot,
+                                 write_snapshot)
+
+        with self._lock:
+            if self._fenced is not None:
+                raise self._fenced
+            bytes_before = self._log.journal_bytes()
+            # the verified read runs under the session lock BY DESIGN:
+            # the snapshot must cover an exact journal prefix, and a
+            # racing append would journal a record the truncation
+            # below could then orphan
+            summary, staged, state, dedupe = self._log.verify_collect()  # consensus-lint: disable=CL802 — the snapshot's covered prefix must be exact against racing appends
+            open_round = int(summary["round"])
+            # the cumulative dedupe set: what the old snapshot carried,
+            # plus every journaled token, plus the in-memory tokens of
+            # already-committed rounds (their journal records were
+            # GC'd — this snapshot is their only durable record)
+            dedupe = set(dedupe)
+            dedupe.update(aid for _, _, aid in staged if aid is not None)
+            dedupe.update(self._applied_append_ids)
+            write_snapshot(self._log, open_round, staged, dedupe,  # consensus-lint: disable=CL802 — ack-iff-durable: the snapshot write IS the commit point truncation depends on
+                           self.ledger._state_tree())
+            # verify-before-truncate (the AOT-cache discipline): a torn
+            # snapshot write must be caught while the journal is still
+            # whole — truncating behind bytes that do not load is how
+            # acknowledged rounds would die. Raises PYC301 naming the
+            # refusing check; the journal stays intact and the next
+            # sweep retries.
+            try:
+                load_snapshot(self._log.snapshot_path)  # consensus-lint: disable=CL802 — verify-before-truncate must see the exact bytes truncation will trust
+            except CheckpointCorruptionError:
+                count_compaction("refused")
+                raise
+            removed = self._log.truncate_staged(open_round, len(staged))  # consensus-lint: disable=CL802 — truncation must not interleave with an append journaling under the covered prefix
+            self._snap_round = open_round
+            self._snap_blocks = len(staged)
+            bytes_after = self._log.journal_bytes()
+        return {"session": self.name, "round": open_round,
+                "blocks": len(staged), "records_removed": removed,
+                "bytes_before": int(bytes_before),
+                "bytes_after": int(bytes_after)}
 
     def append(self, reports_block, event_bounds=None,
                append_id: Optional[str] = None) -> int:
@@ -488,7 +688,7 @@ def replay_session(log_root, name: str,
     _faults.fire("fleet.ledger_replay",  # consensus-lint: disable=CL802 — torn-log injection must land inside the takeover window it tests
                  path=log.ledger_path if log.ledger_path.exists()
                  else None)
-    summary, staged, state = log.verify_collect()  # consensus-lint: disable=CL802 — exactly-one-takeover: the log is read once, under the claim
+    summary, staged, state, dedupe = log.verify_collect()  # consensus-lint: disable=CL802 — exactly-one-takeover: the log is read once, under the claim
     if state is not None:       # the preflight's validated read — the
         ledger = ReputationLedger._from_state(  # checkpoint is opened
             state, source=log.ledger_path)      # once per takeover
@@ -509,6 +709,11 @@ def replay_session(log_root, name: str,
         refresh_every=int(meta.get("refresh_every",
                                    INCREMENTAL_REFRESH_DEFAULT)),
         executable_provider=executable_provider)
+    # the snapshot's cumulative dedupe set first (ISSUE 20): it is the
+    # only durable record of COMMITTED rounds' idempotency tokens (the
+    # commit GC'd their journal records) — without it a client's
+    # retried append from a closed round would re-fold after takeover
+    session._applied_append_ids.update(dedupe)
     for block, bounds, append_id in staged:
         # fold WITHOUT re-journaling (the records already exist):
         # MarketSession.append is the identical arithmetic the dead
@@ -519,4 +724,11 @@ def replay_session(log_root, name: str,
         MarketSession.append(session, block, bounds)
         if append_id is not None:
             session._applied_append_ids.add(append_id)
+    snap = summary.get("snapshot") or {}
+    if snap.get("round") == summary["round"] and not snap.get("stale"):
+        # the adopted session inherits the snapshot's coverage marker,
+        # so the compaction policy measures staleness from the right
+        # baseline instead of re-compacting immediately
+        session._snap_round = int(snap["round"])
+        session._snap_blocks = int(snap["blocks"])
     return session

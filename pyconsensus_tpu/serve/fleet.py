@@ -47,7 +47,11 @@ written once against the handle surface and hold for both — the
 transport-parametrized fleet tests pin that.
 Fault sites ``fleet.route`` / ``fleet.heartbeat`` / ``fleet.takeover``
 / ``fleet.ledger_replay`` let a seeded ``FaultPlan`` inject worker
-loss, heartbeat flap, and torn ledger replication deterministically.
+loss, heartbeat flap, and torn ledger replication deterministically;
+``state.migrate`` (ISSUE 20) fires at the top of every session
+relocation — takeover, drain, and voluntary rebalancing all pass
+through the one primitive (:meth:`ConsensusFleet._relocate_session`),
+so a chaos rule kills them all at the same fence.
 """
 
 from __future__ import annotations
@@ -151,6 +155,17 @@ class FleetWorker(WorkerBase):
         super().__init__(name)
         self.service = ConsensusService(config)
         self._log_dir = log_dir
+        if log_dir is not None and hasattr(self.service.sessions,
+                                           "hydrator"):
+            # tiered store (ISSUE 20): cold sessions hydrate from this
+            # worker's view of the shared log directory, through the
+            # same executable provider an adopting takeover would use
+            from .stateplane import hydrate_session
+            self.service.sessions.hydrator = (
+                lambda session_name: hydrate_session(
+                    log_dir, session_name,
+                    executable_provider=self.service
+                    .incremental_executable_for))
 
     # -- lifecycle ------------------------------------------------------
 
@@ -354,6 +369,10 @@ class ConsensusFleet:
         self._migrated = obs.counter(
             "pyconsensus_sessions_migrated_total",
             "sessions replayed onto a standby worker")
+        self._rebalanced = obs.counter(
+            "pyconsensus_sessions_rebalanced_total",
+            "sessions live-migrated between two healthy workers "
+            "(voluntary placement rebalancing, e.g. after a scale-up)")
         # router-side flight recorder (ISSUE 18 satellite): when the
         # worker config asks for one, the router keeps its own bounded
         # on-disk ring and dumps it at every takeover — a kill -9 chaos
@@ -510,26 +529,17 @@ class ConsensusFleet:
         try:
             for name in moving:
                 try:
-                    self._fence_stale(dead, name)
                     new_owner = self.ring.owner(name)
                     if new_owner not in warmed_owners:
                         # once per ADOPTING owner, not per session — the
                         # scan is the same work every time
                         warmed_owners.add(new_owner)
                         self._warm_standby(new_owner)
-                    # verify + replay onto the standby: the in-process
-                    # handle replays the shared log directory; a socket
-                    # handle asks the standby PROCESS to adopt the
-                    # SHIPPED copy of the dead process's log — either
-                    # way a corrupt log refuses with PYC301 (the
-                    # taxonomy crosses the wire intact)
-                    self.workers[new_owner].adopt_session(name)
-                    # the fenced stale object leaves the dead worker's
-                    # store: the session lives in exactly ONE store, so
-                    # the live-session gauge stays honest
-                    self.workers[dead].evict_session(name)
-                    with self._lock:
-                        self._sessions[name] = new_owner
+                    self._relocate_session(dead, name, new_owner,
+                                           WorkerLostError(
+                        f"session {name!r} migrated off dead worker "
+                        f"{dead!r}", worker=dead, session=name,
+                        retry_after_s=self.config.takeover_window_s))
                     self._migrated.inc()
                     migrated.append((name, new_owner))
                 except CheckpointCorruptionError as exc:
@@ -583,15 +593,40 @@ class ConsensusFleet:
                 "bucket executables a standby adopted from the AOT "
                 "disk cache inside a takeover window").inc(adopted)
 
-    def _fence_stale(self, dead: str, name: str) -> None:
-        """Fence the dead worker's in-memory session object BEFORE the
-        replay reads its log (see :meth:`FleetWorker.fence_session` for
-        the race this closes; a SIGKILL'd socket worker has no stale
-        object to fence — its handle's fence is structurally a no-op)."""
-        self.workers[dead].fence_session(name, WorkerLostError(
-            f"session {name!r} migrated off dead worker {dead!r}",
-            worker=dead, session=name,
-            retry_after_s=self.config.takeover_window_s))
+    def _relocate_session(self, src: str, name: str, target: str,
+                          fence_exc: BaseException) -> None:
+        """Move ONE session ``src`` -> ``target`` — the primitive every
+        relocation path shares (dead-worker takeover, graceful drain,
+        and ISSUE 20's voluntary rebalancing), so the fence discipline
+        is written once:
+
+        1. the ``state.migrate`` fault site fires (chaos rules kill any
+           relocation at the same fence);
+        2. the source's in-memory object is FENCED with ``fence_exc``
+           before the replay reads its log (see
+           :meth:`FleetWorker.fence_session` for the race this closes —
+           a mutation that completed its journal write is read by the
+           replay, anything later was never acknowledged; over the
+           socket transport the fence handler also re-ships the fenced
+           log whole, snapshot included, so the adopter reads a current
+           copy; a SIGKILL'd worker has no stale object and its fence
+           is structurally a no-op);
+        3. the adopter verifies + replays the log (in-process: the
+           shared directory; socket: the SHIPPED copy) — a corrupt log
+           refuses with PYC301 either way;
+        4. the fenced stale object leaves the source store (a session
+           lives in exactly ONE store — the gauges stay honest) and the
+           ownership map flips.
+
+        Raises on failure with the source store untouched past the
+        fence — the CALLER owns the ``_migrating`` claim and the
+        failure policy (strand vs. mark-failed vs. re-adopt)."""
+        _faults.fire("state.migrate")
+        self.workers[src].fence_session(name, fence_exc)
+        self.workers[target].adopt_session(name)
+        self.workers[src].evict_session(name)
+        with self._lock:
+            self._sessions[name] = target
 
     # -- elastic membership (ISSUE 19) ----------------------------------
 
@@ -712,6 +747,131 @@ class ConsensusFleet:
                 pass
         return {"worker": name, "drained": True,
                 "sessions_migrated": migrated}
+
+    # -- live rebalancing (ISSUE 20) ------------------------------------
+
+    def migrate_session(self, name: str,
+                        target: Optional[str] = None) -> str:
+        """Voluntarily LIVE-migrate one session between two HEALTHY
+        workers (``target`` defaults to the session's ring home). The
+        sequence is the shared :meth:`_relocate_session` primitive:
+        fence at the source (clients racing the move see retryable
+        PYC502, never loss), verify + replay on the adopter, evict,
+        remap — every acknowledged round lands exactly once, bits
+        identical, because the log is the session. On an adopt failure
+        the SOURCE re-adopts its own log and keeps serving: rebalancing
+        must never turn a healthy session into a stranded one.
+
+        Holding the source's declare lock serializes the move against a
+        concurrent death declaration or drain of that worker — each
+        session moves by exactly one path (the ``_migrating`` claim is
+        the second, finer-grained guarantee). Returns the adopting
+        worker's name (the source's own name when the session is
+        already home)."""
+        with self._lock:
+            if name in self._failed_sessions:
+                raise self._failed_sessions[name]
+            src = self._sessions.get(name)
+        if src is None:
+            raise InputError(f"unknown fleet session {name!r}")
+        if target is None:
+            target = self.ring.owner(name)
+        if target == src:
+            return src
+        if target not in self.workers:
+            raise PlacementError(f"unknown worker {target!r}",
+                                 worker=target)
+        w_src = self.workers.get(src)
+        if w_src is None or not w_src.alive:
+            # the source is dead (or dying): the takeover path owns
+            # this session — surface the retryable loss, not a raw race
+            raise WorkerLostError(
+                f"session {name!r} cannot rebalance: its owner {src!r} "
+                f"is not alive", worker=src, session=name,
+                retry_after_s=self.config.takeover_window_s)
+        with w_src.declare_lock:
+            with self._lock:
+                if (self._sessions.get(name) != src
+                        or name in self._migrating):
+                    # moved (or claimed) under us while we waited for
+                    # the declare lock — whoever claimed it owns it
+                    raise FailoverInProgressError(
+                        f"session {name!r} is already relocating",
+                        session=name,
+                        retry_after_s=max(
+                            self.capacity.takeover_remaining(), 0.05))
+                self._migrating.add(name)
+            try:
+                if not (w_src.alive
+                        and self.workers[target].alive):
+                    raise WorkerLostError(
+                        f"session {name!r} cannot rebalance "
+                        f"{src!r} -> {target!r}: both ends must be "
+                        f"alive", worker=(src if not w_src.alive
+                                          else target), session=name,
+                        retry_after_s=self.config.takeover_window_s)
+                try:
+                    self._relocate_session(
+                        src, name, target, FailoverInProgressError(
+                            f"session {name!r} is rebalancing from "
+                            f"{src!r} to {target!r}", session=name,
+                            reason="rebalance",
+                            retry_after_s=self.config.takeover_window_s))
+                except BaseException:
+                    # the adopt did not land: put the source back in
+                    # service from its own durable log (replay builds a
+                    # fresh, un-fenced object in place of the fenced
+                    # one). If even that fails the session is
+                    # stranded-but-durable — still mapped to the live
+                    # source, so a retried migrate/drain moves it later.
+                    try:
+                        w_src.evict_session(name)
+                        w_src.adopt_session(name)
+                    except Exception:   # noqa: BLE001 — original error
+                        pass            # wins; recovery is best-effort
+                    raise
+                self._rebalanced.inc()
+            finally:
+                with self._lock:
+                    self._migrating.discard(name)
+        return target
+
+    def rebalance_to(self, target: str,
+                     max_sessions: Optional[int] = None) -> list:
+        """Placement-pressure hook (ISSUE 20): after a scale-up puts
+        ``target`` on the ring, sessions whose ring home is now
+        ``target`` still live on their old owners (sessions are sticky
+        — membership change alone never moves them). Voluntarily
+        migrate those onto ``target`` so the grown fleet actually
+        carries the load it grew for; the autoscaler calls this
+        fail-soft after ``add_worker``. Per-session failures are
+        swallowed (the session keeps serving where it is — rebalancing
+        is advisory, durability is not at stake); ``max_sessions``
+        bounds the disruption per call. Returns ``[(name, old_owner),
+        ...]`` for the sessions that moved."""
+        if target not in self.workers:
+            raise PlacementError(f"unknown worker {target!r}",
+                                 worker=target)
+        with self._lock:
+            candidates = sorted(
+                s for s, o in self._sessions.items()
+                if o is not None and o != target
+                and s not in self._migrating
+                and s not in self._failed_sessions)
+        moved = []
+        for name in candidates:
+            if max_sessions is not None and len(moved) >= max_sessions:
+                break
+            try:
+                if self.ring.owner(name) != target:
+                    continue        # not this worker's key — stay put
+                src = self.owner_of(name)
+                if self.migrate_session(name, target) == target \
+                        and src != target:
+                    moved.append((name, src))
+            except Exception:   # noqa: BLE001 — advisory: the session
+                continue        # keeps serving on its current owner
+        return moved
 
     # -- routing --------------------------------------------------------
 

@@ -168,6 +168,22 @@ class ServeConfig:
     #: SIGTERM / takeover so kill-9 chaos runs leave a postmortem
     #: artifact. None disables recording.
     flightrec_dir: Optional[str] = None
+    #: tiered session residency (ISSUE 20): keep at most this many
+    #: sessions hot in memory (LRU) and hydrate the rest on demand from
+    #: their compacted replication logs — a worker OWNS far more
+    #: sessions than it HOLDS. 0 (default) keeps the classic
+    #: everything-hot store; > 0 requires the owning worker to inject a
+    #: hydrator (the fleet workers do).
+    hot_sessions: int = 0
+    #: journal-compaction policy (ISSUE 20): snapshot-truncate a
+    #: session's staged journal after this many resolved rounds since
+    #: its last snapshot / once the journal reaches this many bytes
+    #: (whichever fires first; 0 disables that threshold — both 0, the
+    #: default, disables the background compactor entirely)
+    compact_rounds: int = 0
+    compact_journal_bytes: int = 0
+    #: background compaction sweep interval (seconds)
+    compact_interval_s: float = 5.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServeConfig":
@@ -184,9 +200,14 @@ class ServeConfig:
             if key in d:
                 d[key] = tuple((int(r), int(e)) for r, e in d[key])
         for key in ("slo_window_s", "slo_p50_ms", "slo_p99_ms",
-                    "slo_shed_ratio", "slo_queue_depth"):
+                    "slo_shed_ratio", "slo_queue_depth",
+                    "compact_interval_s"):
             if key in d:
                 d[key] = float(d[key])
+        for key in ("hot_sessions", "compact_rounds",
+                    "compact_journal_bytes"):
+            if key in d:
+                d[key] = int(d[key])
         return cls(**d)
 
     @classmethod
@@ -235,6 +256,18 @@ class ConsensusService:
                     f"{key} must be >= 0 (0 disables the target), got "
                     f"{getattr(self.config, key)}",
                     **{key: getattr(self.config, key)})
+        for key in ("hot_sessions", "compact_rounds",
+                    "compact_journal_bytes"):
+            if int(getattr(self.config, key)) < 0:
+                raise InputError(
+                    f"{key} must be >= 0 (0 disables it), got "
+                    f"{getattr(self.config, key)}",
+                    **{key: getattr(self.config, key)})
+        if float(self.config.compact_interval_s) <= 0:
+            raise InputError(
+                f"compact_interval_s must be > 0, got "
+                f"{self.config.compact_interval_s}",
+                compact_interval_s=self.config.compact_interval_s)
         self.queue = RequestQueue(self.config.max_queue)
         self.mesh = self._build_mesh()
         aot = None
@@ -246,9 +279,17 @@ class ConsensusService:
                                      mesh=self.mesh, aot=aot)
         self.admission = AdmissionController(self.config.rate_limit_rps,
                                              self.config.rate_burst)
-        self.sessions = SessionStore()
+        if int(self.config.hot_sessions) > 0:
+            from .stateplane import TieredSessionStore
+
+            self.sessions = TieredSessionStore(self.config.hot_sessions)
+        else:
+            self.sessions = SessionStore()
         self.batcher = Microbatcher(self.queue, self.cache, self.config,
                                     self.sessions, self.admission)
+        #: background journal compactor (ISSUE 20) — built at start()
+        #: when either compaction threshold is set, stopped at close()
+        self.compactor = None
         self._started = False
         self._start_lock = threading.Lock()
 
@@ -301,6 +342,18 @@ class ConsensusService:
                 if warmup and self.config.warmup:
                     self.warm_buckets()
                 self.batcher.start()
+                if (self.config.compact_rounds
+                        or self.config.compact_journal_bytes):
+                    from .stateplane import CompactionPolicy, Compactor
+
+                    self.compactor = Compactor(
+                        self.sessions,
+                        CompactionPolicy(
+                            rounds=self.config.compact_rounds,
+                            journal_bytes=(
+                                self.config.compact_journal_bytes)),
+                        interval_s=self.config.compact_interval_s
+                    ).run_in_thread()
                 self._started = True
         return self
 
@@ -373,9 +426,18 @@ class ConsensusService:
             n += 1
         return n
 
+    def _stop_compactor(self) -> None:
+        with self._start_lock:
+            compactor, self.compactor = self.compactor, None
+        if compactor is not None:
+            # join OUTSIDE the lock: the sweep thread takes store +
+            # session locks and must not serialize against start()
+            compactor.stop()
+
     def drain(self, timeout: Optional[float] = 60.0) -> None:
         """Graceful shutdown: refuse new work, finish everything
-        queued, stop the batcher."""
+        queued, stop the batcher (and the background compactor)."""
+        self._stop_compactor()
         self.admission.start_drain()
         self.queue.close()
         self.batcher.join(timeout)
@@ -385,6 +447,7 @@ class ConsensusService:
         if drain:
             self.drain(timeout)
             return
+        self._stop_compactor()
         self.admission.start_drain()
         self.queue.close()
         for req in self.queue.drain_pending():

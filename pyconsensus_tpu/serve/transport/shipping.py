@@ -45,10 +45,12 @@ from .rpc import RpcClient, RpcServer
 __all__ = ["ShippingReceiver", "LogShipper", "adopt_shipped"]
 
 #: the only file names a shipped record may claim — session meta, the
-#: ledger checkpoint, and journal records (the ReplicationLog layout);
-#: anything else is refused before any byte lands on disk
+#: ledger checkpoint, the compaction snapshot (ISSUE 20), and journal
+#: records (the ReplicationLog layout); anything else is refused before
+#: any byte lands on disk
 _RELPATH_RE = re.compile(
-    r"^(meta\.json|ledger\.npz|staged/round_\d{6}_block_\d{6}\.npz)$")
+    r"^(meta\.json|ledger\.npz|snapshot\.npz"
+    r"|staged/round_\d{6}_block_\d{6}\.npz)$")
 #: session directory names: never a pure-dot path component ("."/"..")
 _SESSION_RE = re.compile(r"^(?!\.+$)[A-Za-z0-9._~-]+$")
 

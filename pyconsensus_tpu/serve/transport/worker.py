@@ -64,6 +64,14 @@ class FleetWorkerProcess:
         #: enough; ledger.npz changes every round and is ALWAYS re-shipped
         self._shipped: set = set()          # guarded-by: _ship_lock
         self._ship_lock = threading.Lock()
+        # tiered residency (ISSUE 20): a tiered store hydrates cold
+        # sessions from THIS worker's local log on first touch
+        if hasattr(self.service.sessions, "hydrator"):
+            from ..stateplane import hydrate_session
+
+            self.service.sessions.hydrator = lambda name: hydrate_session(
+                self.log_root, name,
+                executable_provider=self.service.incremental_executable_for)
 
     # -- shipping -------------------------------------------------------
 
@@ -84,22 +92,38 @@ class FleetWorkerProcess:
                 if not path.exists():
                     continue
                 if rel == "ledger.npz" and ledger:
-                    todo.append((rel, path))    # re-ship every commit
+                    todo.append((rel, path, (name, rel)))  # re-ship every commit
                 elif (name, rel) not in self._shipped:
-                    todo.append((rel, path))
+                    todo.append((rel, path, (name, rel)))
+            # the compaction snapshot (ISSUE 20) is REWRITTEN in place
+            # by every compaction, so filename identity is not enough —
+            # the shipped-set key carries (size, mtime_ns) and a
+            # changed snapshot ships again; the standby's copy keeps
+            # its older staged records, which the snapshot-aware replay
+            # ignores as the covered prefix
+            snap = log.snapshot_path
+            if snap.exists():
+                try:
+                    st = snap.stat()
+                    key = (name, "snapshot.npz",
+                           st.st_size, st.st_mtime_ns)
+                    if key not in self._shipped:
+                        todo.append(("snapshot.npz", snap, key))
+                except OSError:
+                    pass            # racing a compaction: next ship
             if log.staged_dir.exists():
                 for path in sorted(log.staged_dir.iterdir()):
                     rel = f"staged/{path.name}"
                     if (name, rel) not in self._shipped:
-                        todo.append((rel, path))
+                        todo.append((rel, path, (name, rel)))
             try:
-                for rel, path in todo:
+                for rel, path, key in todo:
                     # the ship deliberately completes inside the
                     # critical section: ship-before-ack is the ordering
                     # contract, and the shipped-set must only record
                     # what actually landed
                     self.shipper.ship_file(name, rel, path)  # consensus-lint: disable=CL802 — ack-iff-shipped needs the ship inside the bookkeeping section
-                    self._shipped.add((name, rel))
+                    self._shipped.add(key)
             except Exception as exc:    # noqa: BLE001 — any ship
                 # failure (transport, receiver refusal) fences: serving
                 # on with the standby's disk behind an acknowledged
@@ -130,6 +154,13 @@ class FleetWorkerProcess:
         log = ReplicationLog(self.log_root, name)
         with self._ship_lock:
             self._shipped.add((name, "meta.json"))
+            if log.snapshot_path.exists():
+                try:        # the adopted snapshot CAME from the
+                    st = log.snapshot_path.stat()   # standby's disk
+                    self._shipped.add((name, "snapshot.npz",
+                                       st.st_size, st.st_mtime_ns))
+                except OSError:
+                    pass
             if log.staged_dir.exists():
                 for path in sorted(log.staged_dir.iterdir()):
                     self._shipped.add((name, f"staged/{path.name}"))
@@ -247,8 +278,8 @@ class FleetWorkerProcess:
         # same filenames, and skipping their ship (stale dedup) would
         # acknowledge writes the standby's disk never received
         with self._ship_lock:
-            self._shipped = {(s, rel) for s, rel in self._shipped
-                             if s != name}
+            self._shipped = {key for key in self._shipped
+                             if key[0] != name}
         return {"ok": True}
 
     def warm_from_disk(self, params: dict) -> dict:

@@ -1,0 +1,732 @@
+"""The million-session state plane (ISSUE 20): log compaction, tiered
+residency, and live rebalancing.
+
+The chaos matrix this file pins: a SIGKILL (the in-process
+``SimulatedCrash`` model — a BaseException that escapes every recovery
+``except Exception``) during snapshot write, journal truncation, or
+migration, at EVERY fence point, never loses an acknowledged round —
+the replay is digest-equal to an uninterrupted reference run. A torn or
+corrupt snapshot over an intact journal is refused and rebuilt; a torn
+snapshot over a truncated journal is the one unrecoverable local state
+and raises the structured PYC303. Cold-vs-hot resolution is bitwise
+identical, and LRU eviction respects the durability fence.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fleet_worker import N_REPORTERS, make_block
+from pyconsensus_tpu import faults, obs
+from pyconsensus_tpu.faults import (CheckpointCorruptionError,
+                                    FailoverInProgressError, FaultPlan,
+                                    InputError, SimulatedCrash,
+                                    SnapshotCorruptionError)
+from pyconsensus_tpu.serve import (ConsensusFleet, DurableSession,
+                                   FleetConfig, MarketSession,
+                                   ServeConfig, replay_session)
+from pyconsensus_tpu.serve.service import ConsensusService
+from pyconsensus_tpu.serve.stateplane import (CompactionPolicy, Compactor,
+                                              TieredSessionStore,
+                                              load_snapshot, snapshot_hint,
+                                              write_snapshot)
+
+BITS_KEYS = ("smooth_rep", "outcomes_final", "outcomes_adjusted",
+             "old_rep", "avg_certainty")
+
+
+@pytest.fixture(autouse=True)
+def _under_lock_witness(lock_witness):
+    """State-plane tests run under the runtime lock witness (ISSUE 9):
+    compactor / tiered-store / migration acquisitions must stay
+    consistent with the declared CL801 hierarchy."""
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _under_protocol_witness(protocol_witness):
+    """And under the protocol witness (ISSUE 16): compaction must not
+    reorder any journal/commit/ack edge the CL901 graph declares."""
+    yield
+
+
+def assert_same_bits(got: dict, ref: dict, msg: str = "") -> None:
+    for key in BITS_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(got[key]), np.asarray(ref[key]),
+            err_msg=f"{msg} [{key}]")
+
+
+def drive(session, rounds=2, blocks=3, resolve_last=False):
+    """Deterministic traffic: ``blocks`` appends then a resolve per
+    round; the final round's journal is left OPEN (staged but
+    unresolved) unless ``resolve_last`` — compaction's target state."""
+    results = []
+    for k in range(rounds):
+        for j in range(blocks):
+            session.append(make_block(k, j))
+        if k < rounds - 1 or resolve_last:
+            results.append(session.resolve())
+    return results
+
+
+def reference_session(tmp_path, name="ref", rounds=2, blocks=3):
+    ref = DurableSession.create(str(tmp_path / "refroot"), name,
+                                N_REPORTERS)
+    results = drive(ref, rounds=rounds, blocks=blocks)
+    return ref, results
+
+
+# -- the snapshot record ----------------------------------------------------
+
+
+class TestSnapshotRecord:
+    def test_round_trip_bit_identical(self, tmp_path):
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        staged = session._log.staged(session.ledger.round)
+        path = write_snapshot(session._log, session.ledger.round, staged,
+                              {"a1", "a2"}, session.ledger._state_tree())
+        snap = load_snapshot(path)
+        assert snap["round"] == session.ledger.round
+        assert snap["dedupe"] == {"a1", "a2"}
+        assert len(snap["blocks"]) == len(staged)
+        for (got_b, got_bounds, got_aid), (b, bounds, aid) in zip(
+                snap["blocks"], staged):
+            np.testing.assert_array_equal(got_b, np.asarray(b))
+            assert got_bounds == bounds and got_aid == aid
+        np.testing.assert_array_equal(
+            snap["ledger"]["reputation"],
+            session.ledger._state_tree()["reputation"])
+
+    def test_torn_file_refused_with_hint(self, tmp_path):
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        staged = session._log.staged(session.ledger.round)
+        path = write_snapshot(session._log, session.ledger.round, staged,
+                              set(), session.ledger._state_tree())
+        raw = bytearray(path.read_bytes())
+        mid = len(raw) // 2             # a block member's payload: the
+        raw[mid:mid + 8] = b"\xff" * 8  # zip directory stays readable
+        path.write_bytes(bytes(raw))
+        with pytest.raises(CheckpointCorruptionError):
+            load_snapshot(path)
+        assert snapshot_hint(path) == (session.ledger.round, len(staged))
+
+    def test_unreadable_file_refused_without_hint(self, tmp_path):
+        path = tmp_path / "snapshot.npz"
+        path.write_bytes(b"not a zip archive at all")
+        with pytest.raises(CheckpointCorruptionError, match="unreadable"):
+            load_snapshot(path)
+        assert snapshot_hint(path) is None
+
+
+# -- compaction -------------------------------------------------------------
+
+
+class TestCompaction:
+    def test_compact_replay_bit_identical(self, tmp_path):
+        """THE contract: snapshot + suffix replays bit-identical to the
+        full, never-compacted log — compaction changes bytes on disk,
+        never bits in any result."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        report = session.compact()
+        assert report["records_removed"] > 0
+        assert report["bytes_after"] < report["bytes_before"]
+
+        replayed = replay_session(tmp_path, "s")
+        ref, _ = reference_session(tmp_path)
+        np.testing.assert_array_equal(replayed.ledger.reputation,
+                                      ref.ledger.reputation)
+        assert replayed.ledger.round == ref.ledger.round
+        assert len(replayed._blocks) == len(ref._blocks)
+        replayed.append(make_block(1, 3))
+        ref.append(make_block(1, 3))
+        assert_same_bits(replayed.resolve(), ref.resolve(),
+                         "post-compaction resolve")
+
+    def test_journal_bytes_shrink(self, tmp_path):
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        before = session.journal_bytes()
+        session.compact()
+        assert session.journal_bytes() < before
+
+    def test_dedupe_survives_compaction(self, tmp_path):
+        """A committed round's idempotency tokens used to die with the
+        journal GC; the snapshot's cumulative dedupe set is their ONLY
+        durable record — a replayed session must still acknowledge a
+        retried append without folding it twice."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        session.append(make_block(0, 0), append_id="tok-0")
+        session.resolve()
+        session.append(make_block(1, 0), append_id="tok-1")
+        session.compact()
+        replayed = replay_session(tmp_path, "s")
+        n_before = len(replayed._blocks)
+        replayed.append(make_block(1, 0), append_id="tok-1")   # dup
+        assert len(replayed._blocks) == n_before
+        replayed.append(make_block(0, 0), append_id="tok-0")   # dup from
+        assert len(replayed._blocks) == n_before               # round 0
+
+    def test_crash_between_write_and_truncate(self, tmp_path):
+        """SIGKILL after the snapshot landed but before ANY journal
+        record was unlinked: replay sees snapshot + a fully duplicate
+        prefix and must ignore the stale records — bit-identical."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "state.compact", "kind": "crash",
+             "occurrences": [0]}])
+        with faults.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                session.compact()
+        assert plan.fired == [("state.compact", 0, "crash")]
+        self._assert_replay_matches_reference(tmp_path)
+
+    @pytest.mark.parametrize("occurrence", [1, 2])
+    def test_crash_mid_truncation(self, tmp_path, occurrence):
+        """SIGKILL between unlinks: a PARTIAL duplicate prefix remains
+        on disk; the snapshot-aware replay must skip exactly the
+        covered records and fold the suffix once."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "state.compact", "kind": "crash",
+             "occurrences": [occurrence]}])
+        with faults.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                session.compact()
+        self._assert_replay_matches_reference(tmp_path)
+
+    def test_torn_snapshot_write_never_truncates(self, tmp_path):
+        """A snapshot torn INSIDE its atomic-write window is caught by
+        the verify-before-truncate read-back: compact refuses, the
+        journal stays whole, replay rebuilds, and the next compact
+        replaces the torn file."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        records_before = len(session._log.staged(session.ledger.round))
+        refused0 = obs.value("pyconsensus_compactions_total",
+                             outcome="refused") or 0
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "state.snapshot", "kind": "torn_write",
+             "occurrences": [0]}])
+        with faults.armed(plan):
+            with pytest.raises(CheckpointCorruptionError):
+                session.compact()
+        assert len(session._log.staged(session.ledger.round)) \
+            == records_before
+        assert (obs.value("pyconsensus_compactions_total",
+                          outcome="refused") or 0) > refused0
+        # the journal survived, so a clean retry compacts for real and
+        # replaces the torn file
+        report = replay_session(tmp_path, "s").compact()
+        assert report["records_removed"] == records_before
+        self._assert_replay_matches_reference(tmp_path)
+
+    def test_crash_inside_snapshot_write(self, tmp_path):
+        """SIGKILL inside the snapshot's atomic-write window: the temp
+        file dies with the process, no snapshot exists, the journal is
+        untouched — replay is the plain full-log replay."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "state.snapshot", "kind": "crash",
+             "occurrences": [0]}])
+        with faults.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                session.compact()
+        assert not session._log.snapshot_path.exists()
+        self._assert_replay_matches_reference(tmp_path)
+
+    def test_truncated_journal_with_corrupt_snapshot_is_pyc303(
+            self, tmp_path):
+        """The one unrecoverable local state: the journal was truncated
+        behind a snapshot that then went bad. Refusing with a structured
+        PYC303 (naming the missing prefix) is the contract — silently
+        replaying the survivors would serve different bits."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        session.compact()
+        path = session._log.snapshot_path
+        raw = bytearray(path.read_bytes())
+        mid = len(raw) // 2
+        raw[mid:mid + 8] = b"\xff" * 8
+        path.write_bytes(bytes(raw))
+        with pytest.raises(SnapshotCorruptionError) as exc_info:
+            replay_session(tmp_path, "s")
+        assert exc_info.value.error_code == "PYC303"
+        assert exc_info.value.context.get("missing_prefix", 0) > 0
+
+    def test_gap_behind_snapshot_is_pyc303(self, tmp_path):
+        """Journal records missing BELOW the surviving indices while a
+        snapshot file exists: the gap can only be a truncation whose
+        snapshot no longer accounts for it — PYC303, not the generic
+        contiguity error."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        session.compact()
+        session = replay_session(tmp_path, "s")
+        session.append(make_block(1, 3))
+        session.append(make_block(1, 4))
+        # corrupt the snapshot AND delete the covered suffix's first
+        # record: survivors start above the snapshot's coverage
+        path = session._log.snapshot_path
+        raw = bytearray(path.read_bytes())
+        raw[len(raw) // 2:len(raw) // 2 + 8] = b"\xff" * 8
+        path.write_bytes(bytes(raw))
+        entries = session._log._staged_entries(session.ledger.round)
+        entries[0][1].unlink()
+        with pytest.raises(SnapshotCorruptionError):
+            replay_session(tmp_path, "s")
+
+    def test_stale_snapshot_prefix_ignored_after_commit(self, tmp_path):
+        """A resolve AFTER a compaction commits the snapshot's round:
+        the snapshot is now stale — replay must ignore its block prefix
+        (those blocks folded into the committed ledger) while still
+        honoring its dedupe set."""
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        session.compact()
+        session = replay_session(tmp_path, "s")
+        session.resolve()                       # commits round 1
+        session.append(make_block(2, 0), append_id="tok-2")
+        replayed = replay_session(tmp_path, "s")
+        ref, _ = reference_session(tmp_path, rounds=2)
+        ref.resolve()
+        ref.append(make_block(2, 0))
+        assert_same_bits(replayed.resolve(), ref.resolve(),
+                         "stale-snapshot replay")
+
+    @staticmethod
+    def _assert_replay_matches_reference(tmp_path):
+        replayed = replay_session(tmp_path, "s")
+        ref, _ = reference_session(tmp_path)
+        np.testing.assert_array_equal(replayed.ledger.reputation,
+                                      ref.ledger.reputation)
+        assert len(replayed._blocks) == len(ref._blocks)
+        replayed.append(make_block(1, 3))
+        ref.append(make_block(1, 3))
+        assert_same_bits(replayed.resolve(), ref.resolve(),
+                         "post-crash replay")
+
+
+# -- compaction policy + sweeper -------------------------------------------
+
+
+class TestCompactionPolicy:
+    def test_thresholds(self, tmp_path):
+        session = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(session)
+        assert not CompactionPolicy().enabled()
+        assert not CompactionPolicy().due(session)
+        assert CompactionPolicy(rounds=1).due(session)
+        assert not CompactionPolicy(rounds=10).due(session)
+        assert CompactionPolicy(journal_bytes=1).due(session)
+        assert not CompactionPolicy(
+            journal_bytes=10 ** 9).due(session)
+        assert not CompactionPolicy(rounds=1).due(
+            MarketSession("m", N_REPORTERS))
+
+    def test_negative_thresholds_refused(self):
+        with pytest.raises(InputError):
+            CompactionPolicy(rounds=-1)
+
+    def test_sweep_compacts_and_counts(self, tmp_path):
+        store = TieredSessionStore(hot_capacity=8)
+        for i in range(3):
+            s = DurableSession.create(tmp_path, f"s{i}", N_REPORTERS)
+            drive(s)
+            store.add(s)
+        compactor = Compactor(store, CompactionPolicy(rounds=1))
+        counts = compactor.sweep()
+        assert counts == {"compacted": 3, "skipped": 0, "failed": 0}
+        assert obs.value("pyconsensus_session_journal_bytes") \
+            is not None
+        # nothing due on the second pass (no rounds resolved since)
+        assert compactor.sweep() == {"compacted": 0, "skipped": 0,
+                                     "failed": 0}
+
+    def test_sweep_skips_fenced_session(self, tmp_path):
+        store = TieredSessionStore(hot_capacity=8)
+        s = DurableSession.create(tmp_path, "s", N_REPORTERS)
+        drive(s)
+        s.fence(FailoverInProgressError("migrating", session="s"))
+        store.add(s)
+        counts = Compactor(store, CompactionPolicy(rounds=1)).sweep()
+        assert counts["skipped"] == 1 and counts["compacted"] == 0
+
+    def test_service_lifecycle(self, tmp_path):
+        cfg = ServeConfig(warmup=(), hot_sessions=4, compact_rounds=1,
+                          compact_interval_s=3600.0)
+        service = ConsensusService(cfg)
+        service.start(warmup=False)
+        try:
+            assert isinstance(service.sessions, TieredSessionStore)
+            assert service.compactor is not None
+        finally:
+            service.close(drain=False)
+        assert service.compactor is None
+
+    def test_config_validation(self):
+        with pytest.raises(InputError):
+            ConsensusService(ServeConfig(hot_sessions=-1))
+        with pytest.raises(InputError):
+            ConsensusService(ServeConfig(compact_rounds=-1))
+        with pytest.raises(InputError):
+            ConsensusService(ServeConfig(compact_interval_s=0.0))
+
+
+# -- tiered residency -------------------------------------------------------
+
+
+class TestTieredStore:
+    def _store(self, tmp_path, capacity=2, n=4):
+        from pyconsensus_tpu.serve.stateplane import hydrate_session
+        store = TieredSessionStore(hot_capacity=capacity)
+        store.hydrator = lambda name: hydrate_session(tmp_path, name)
+        for i in range(n):
+            s = DurableSession.create(tmp_path, f"s{i}", N_REPORTERS)
+            s.append(make_block(0, 0))
+            store.add(s)
+        return store
+
+    def test_lru_eviction_and_owned_accounting(self, tmp_path):
+        store = self._store(tmp_path)
+        assert len(store.hot_names()) == 2
+        assert set(store.names()) == {"s0", "s1", "s2", "s3"}
+        assert store.cold_names() == ["s0", "s1"]   # LRU-first
+
+    def test_cold_resolve_bit_identical(self, tmp_path):
+        """One hydration brings a cold session back with EXACTLY the
+        bits an always-hot session would have produced."""
+        store = self._store(tmp_path)
+        hydrated0 = obs.value(
+            "pyconsensus_sessions_hydrated_total") or 0
+        cold = store.get("s0")                  # pays one hydration
+        assert (obs.value("pyconsensus_sessions_hydrated_total")
+                - hydrated0) == 1
+        ref = DurableSession.create(str(tmp_path / "ref"), "r",
+                                    N_REPORTERS)
+        ref.append(make_block(0, 0))
+        assert_same_bits(cold.resolve(), ref.resolve(), "cold resolve")
+        # now hot: the second touch pays nothing
+        store.get("s0")
+        assert (obs.value("pyconsensus_sessions_hydrated_total")
+                - hydrated0) == 1
+
+    def test_evicted_object_is_fenced(self, tmp_path):
+        """ack-iff-durable, object side: a caller still holding the
+        evicted OBJECT must not journal beside the hydrated copy — its
+        next mutation is a retryable PYC502."""
+        store = TieredSessionStore(hot_capacity=1)
+        store.hydrator = lambda name: replay_session(tmp_path, name)
+        a = DurableSession.create(tmp_path, "a", N_REPORTERS)
+        store.add(a)
+        b = DurableSession.create(tmp_path, "b", N_REPORTERS)
+        store.add(b)                            # evicts a
+        assert store.cold_names() == ["a"]
+        with pytest.raises(FailoverInProgressError, match="evicted"):
+            a.append(make_block(0, 0))
+        fresh = store.get("a")                  # hydrated replacement
+        assert fresh is not a
+        fresh.append(make_block(0, 0))
+
+    def test_busy_session_not_evicted(self, tmp_path):
+        """An in-flight mutation holds the session lock; evicting it
+        would break ack-iff-durable — the tier soft-overflows
+        instead."""
+        store = TieredSessionStore(hot_capacity=1)
+        a = DurableSession.create(tmp_path, "a", N_REPORTERS)
+        store.add(a)
+        # a "mutation in flight": another thread holds a's session lock
+        # (holding it on THIS thread would hand the lock witness a
+        # session-before-store edge no real code path creates)
+        acquired, release = threading.Event(), threading.Event()
+
+        def hold():
+            with a._lock:
+                acquired.set()
+                release.wait(timeout=30.0)
+
+        holder = threading.Thread(target=hold)
+        holder.start()
+        try:
+            assert acquired.wait(timeout=10.0)
+            b = DurableSession.create(tmp_path, "b", N_REPORTERS)
+            store.add(b)
+            # a's in-flight mutation pins it hot; the eviction falls
+            # through to the next candidate (b, idle and durable)
+            assert store.hot_names() == ["a"]
+            assert store.cold_names() == ["b"]
+        finally:
+            release.set()
+            holder.join()
+
+    def test_plain_sessions_pinned_hot(self, tmp_path):
+        store = TieredSessionStore(hot_capacity=1)
+        store.add(MarketSession("m0", N_REPORTERS))
+        store.add(MarketSession("m1", N_REPORTERS))
+        assert store.hot_names() == ["m0", "m1"]    # nothing durable
+        assert store.cold_names() == []             # to evict to
+
+    def test_cold_get_without_hydrator_refused(self, tmp_path):
+        store = self._store(tmp_path)
+        store.hydrator = None
+        with pytest.raises(InputError, match="no hydrator"):
+            store.get("s0")
+
+    def test_duplicate_names_refused_across_tiers(self, tmp_path):
+        store = self._store(tmp_path)
+        assert "s0" in store.cold_names()
+        with pytest.raises(InputError, match="already exists"):
+            store.create("s0", N_REPORTERS)
+        with pytest.raises(InputError, match="already exists"):
+            store.add(MarketSession("s0", N_REPORTERS))
+
+    def test_remove_cold_session(self, tmp_path):
+        store = self._store(tmp_path)
+        store.remove("s0")                      # cold at this point
+        assert "s0" not in store.names()
+        with pytest.raises(InputError):
+            store.get("s0")
+
+    def test_exactly_one_hydration_under_contention(self, tmp_path):
+        store = self._store(tmp_path, capacity=2, n=3)
+        assert store.cold_names() == ["s0"]
+        hydrated0 = obs.value(
+            "pyconsensus_sessions_hydrated_total") or 0
+        got, errors = [], []
+
+        def touch():
+            try:
+                got.append(store.get("s0"))
+            except Exception as exc:    # noqa: BLE001 — assert below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=touch) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len({id(s) for s in got}) == 1   # one shared object
+        assert (obs.value("pyconsensus_sessions_hydrated_total")
+                - hydrated0) == 1
+
+    def test_hydration_fault_retries_clean(self, tmp_path):
+        """A failed hydration (state.hydrate raise) surfaces to the
+        caller; the NEXT getter becomes leader and succeeds — no wedged
+        event, no half-hydrated session."""
+        store = self._store(tmp_path)
+        plan = FaultPlan(seed=0, rules=[
+            {"site": "state.hydrate", "kind": "raise",
+             "occurrences": [0], "args": {"error": "os_error"}}])
+        with faults.armed(plan):
+            with pytest.raises(OSError):
+                store.get("s0")
+            session = store.get("s0")           # retried: occurrence 1
+        session.append(make_block(1, 0))
+
+
+# -- live rebalancing -------------------------------------------------------
+
+
+def tiered_fleet(tmp_path, n=2, **worker_kwargs):
+    cfg = FleetConfig(
+        n_workers=n, log_dir=str(tmp_path / "log"),
+        worker=ServeConfig(warmup=(), batch_window_ms=1.0,
+                           **worker_kwargs))
+    return ConsensusFleet(cfg)
+
+
+class TestLiveRebalancing:
+    def _seed(self, fleet, names, rounds=1, blocks=2):
+        refroot = str(fleet.config.log_dir) + "-ref"
+        refs = {}
+        for n in names:
+            fleet.create_session(n, n_reporters=N_REPORTERS)
+            refs[n] = DurableSession.create(refroot, n, N_REPORTERS)
+            for k in range(rounds):
+                for j in range(blocks):
+                    fleet.append(n, make_block(k, j))
+                    refs[n].append(make_block(k, j))
+        return refs
+
+    def _assert_serves_identical(self, fleet, refs, seed=0):
+        for n, ref in sorted(refs.items()):
+            block = make_block(90 + seed, 0)
+            fleet.append(n, block)
+            ref.append(block)
+            got = fleet.resolve(session=n)
+            want = ref.resolve()
+            np.testing.assert_array_equal(
+                np.asarray(got["agents"]["smooth_rep"]),
+                np.asarray(want["smooth_rep"]), err_msg=n)
+            np.testing.assert_array_equal(
+                np.asarray(got["events"]["outcomes_final"]),
+                np.asarray(want["outcomes_final"]), err_msg=n)
+
+    def test_migrate_session_bit_identical(self, tmp_path):
+        with tiered_fleet(tmp_path) as fleet:
+            refs = self._seed(fleet, ["mkt"])
+            src = fleet.owner_of("mkt")
+            target = next(w for w in fleet.workers if w != src)
+            rebal0 = obs.value(
+                "pyconsensus_sessions_rebalanced_total") or 0
+            assert fleet.migrate_session("mkt", target) == target
+            assert fleet.owner_of("mkt") == target
+            assert (obs.value("pyconsensus_sessions_rebalanced_total")
+                    - rebal0) == 1
+            self._assert_serves_identical(fleet, refs)
+
+    def test_migrate_to_current_owner_is_noop(self, tmp_path):
+        with tiered_fleet(tmp_path) as fleet:
+            self._seed(fleet, ["mkt"])
+            src = fleet.owner_of("mkt")
+            rebal0 = obs.value(
+                "pyconsensus_sessions_rebalanced_total") or 0
+            assert fleet.migrate_session("mkt", src) == src
+            assert (obs.value("pyconsensus_sessions_rebalanced_total")
+                    or 0) == rebal0
+
+    def test_migrate_unknown_refused(self, tmp_path):
+        with tiered_fleet(tmp_path) as fleet:
+            with pytest.raises(InputError, match="unknown"):
+                fleet.migrate_session("nope")
+
+    def test_migrate_fault_leaves_source_serving(self, tmp_path):
+        """An injected state.migrate failure must NOT strand the
+        session: the source re-adopts its own log and keeps serving,
+        bits identical — rebalancing can fail, durability cannot."""
+        with tiered_fleet(tmp_path) as fleet:
+            refs = self._seed(fleet, ["mkt"])
+            src = fleet.owner_of("mkt")
+            target = next(w for w in fleet.workers if w != src)
+            plan = FaultPlan(seed=0, rules=[
+                {"site": "state.migrate", "kind": "raise",
+                 "occurrences": [0], "args": {"error": "os_error"}}])
+            with faults.armed(plan):
+                with pytest.raises(OSError):
+                    fleet.migrate_session("mkt", target)
+            assert fleet.owner_of("mkt") == src
+            self._assert_serves_identical(fleet, refs)
+            # and a clean retry completes the move
+            assert fleet.migrate_session("mkt", target) == target
+            self._assert_serves_identical(fleet, refs, seed=1)
+
+    def test_rebalance_to_moves_ring_home_keys(self, tmp_path):
+        with tiered_fleet(tmp_path) as fleet:
+            names = [f"mkt-{i}" for i in range(8)]
+            refs = self._seed(fleet, names)
+            new = fleet.add_worker()
+            moved = fleet.rebalance_to(new)
+            expect = sorted(n for n in names
+                            if fleet.ring.owner(n) == new)
+            assert sorted(n for n, _src in moved) == expect
+            for n in names:
+                want = new if fleet.ring.owner(n) == new \
+                    else fleet.owner_of(n)
+                assert fleet.owner_of(n) == want
+            self._assert_serves_identical(fleet, refs)
+
+    def test_rebalance_max_sessions_bounds_burst(self, tmp_path):
+        with tiered_fleet(tmp_path) as fleet:
+            names = [f"mkt-{i}" for i in range(8)]
+            self._seed(fleet, names, blocks=1)
+            new = fleet.add_worker()
+            full = sorted(n for n in names
+                          if fleet.ring.owner(n) == new)
+            if len(full) < 2:
+                pytest.skip("ring placed too few keys on the new "
+                            "worker for a bound to bite")
+            moved = fleet.rebalance_to(new, max_sessions=1)
+            assert len(moved) == 1
+
+    @pytest.mark.parametrize("occurrence", [0, 1, 2])
+    def test_sigkill_mid_drain_strands_nothing(self, tmp_path,
+                                               occurrence):
+        """The ISSUE 20 regression pin: a SIGKILL landing mid-drain at
+        ANY migration fence point must strand nothing — the sessions
+        the interrupted drain left behind are moved by the death
+        declaration, and every acknowledged round survives."""
+        fleet = tiered_fleet(tmp_path, n=3).start(warmup=False)
+        try:
+            names = [f"mkt-{i}" for i in range(6)]
+            refs = self._seed(fleet, names, blocks=1)
+            owned: dict = {}
+            for n in names:
+                owned.setdefault(fleet.owner_of(n), []).append(n)
+            # the most-loaded owner reaches the deepest fence point
+            victim = max(sorted(owned), key=lambda w: len(owned[w]))
+            n_owned = len(owned[victim])
+            if n_owned <= occurrence:
+                pytest.skip(f"victim owns {n_owned} sessions; fence "
+                            f"point {occurrence} unreachable")
+            plan = FaultPlan(seed=0, rules=[
+                {"site": "state.migrate", "kind": "crash",
+                 "occurrences": [occurrence]}])
+            with faults.armed(plan):
+                with pytest.raises(SimulatedCrash):
+                    fleet.drain_worker(victim)
+            # the kill: the drain died mid-flight, the worker dies for
+            # real — the declaration path must finish the job
+            fleet.kill_worker(victim)
+            assert all(fleet.owner_of(n) != victim for n in names)
+            self._assert_serves_identical(fleet, refs)
+        finally:
+            fleet.close(drain=False)
+
+    def test_retried_drain_completes_after_fault(self, tmp_path):
+        """An interrupted drain leaves the worker ALIVE and serving;
+        retrying the drain moves the leftovers and shuts it down."""
+        fleet = tiered_fleet(tmp_path, n=3).start(warmup=False)
+        try:
+            names = [f"mkt-{i}" for i in range(4)]
+            refs = self._seed(fleet, names, blocks=1)
+            victim = sorted({fleet.owner_of(n) for n in names})[0]
+            plan = FaultPlan(seed=0, rules=[
+                {"site": "state.migrate", "kind": "raise",
+                 "occurrences": [0], "args": {"error": "os_error"}}])
+            with faults.armed(plan):
+                result = fleet.drain_worker(victim)
+            assert not result["drained"]
+            assert result.get("stranded")
+            result = fleet.drain_worker(victim)
+            assert result["drained"]
+            assert all(fleet.owner_of(n) != victim for n in names)
+            self._assert_serves_identical(fleet, refs)
+        finally:
+            fleet.close(drain=False)
+
+    def test_tiered_fleet_cold_sessions_serve_identical(self, tmp_path):
+        """End to end: a fleet whose workers hold 2 hot sessions while
+        owning 6, with per-round compaction — every resolution (hot or
+        hydrated, before or after compaction) matches the reference."""
+        with tiered_fleet(tmp_path, hot_sessions=2, compact_rounds=1,
+                          compact_interval_s=3600.0) as fleet:
+            names = [f"mkt-{i}" for i in range(6)]
+            refs = self._seed(fleet, names)
+            self._assert_serves_identical(fleet, refs)
+            for w in fleet.workers.values():
+                if w.service.compactor is not None:
+                    w.service.compactor.sweep()
+            self._assert_serves_identical(fleet, refs, seed=1)
+            assert (obs.value("pyconsensus_sessions_hydrated_total")
+                    or 0) > 0
+
+    def test_migration_preserves_compacted_state(self, tmp_path):
+        """Migrate AFTER a compaction: the adopter replays snapshot +
+        suffix and must land on the same bits."""
+        with tiered_fleet(tmp_path) as fleet:
+            refs = self._seed(fleet, ["mkt"], rounds=2)
+            src = fleet.owner_of("mkt")
+            w = fleet.workers[src]
+            w.service.sessions.get("mkt").compact()
+            target = next(n for n in fleet.workers if n != src)
+            assert fleet.migrate_session("mkt", target) == target
+            self._assert_serves_identical(fleet, refs)

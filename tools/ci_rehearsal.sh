@@ -1448,6 +1448,154 @@ rm -rf "$ASDIR"
   pyconsensus_tpu/serve/autoscale.py \
   && echo "autoscale lint OK: CL401-404 + CL801-805 + CL901-905 green over serve/autoscale"
 
+echo "=== State-plane smoke (ISSUE 20: 5k sessions, hot-capacity 256, compaction + rebalance) ==="
+# The million-session acceptance criterion end to end: 5k durable
+# sessions on a 2-worker fleet whose hot tier holds only 256 — drip
+# traffic forces thousands of cold-session hydrations (each paid
+# exactly once, from the compacted LOCAL log), a mid-run compaction
+# sweep folds every session's journal into its digest-verified
+# snapshot (staged-journal bytes must SHRINK), one live rebalance
+# migrates 50 sessions between the two healthy workers over the
+# shipping path, and every resolved round — hydrated, compacted,
+# migrated or not — must be bit-identical to a direct single-box
+# DurableSession run of the same blocks. See docs/SERVING.md
+# "State plane".
+SPDIR=$(mktemp -d)
+"$PY" - "$SPDIR" <<'PYEOF'
+import os
+import sys
+import time
+
+import numpy as np
+
+from pyconsensus_tpu import obs
+from pyconsensus_tpu.serve import ServeConfig
+from pyconsensus_tpu.serve.failover import DurableSession
+from pyconsensus_tpu.serve.fleet import ConsensusFleet, FleetConfig
+
+base = sys.argv[1]
+N, VARIANTS, N_REPORTERS, HOT = 5000, 4, 12, 256
+t_all = time.time()
+
+
+def make_block(v, k, j):
+    rng = np.random.default_rng([11, v, k, j])
+    return rng.choice([0.0, 1.0], size=(N_REPORTERS, 5))
+
+
+def staged_bytes():
+    # the truncatable journal only — what compaction shrinks (each
+    # session's snapshot.npz lives OUTSIDE its staged/ dir)
+    total = 0
+    for root, dirs, files in os.walk(os.path.join(base, "fleet")):
+        if os.path.basename(root) == "staged":
+            for f in files:
+                try:
+                    total += os.stat(os.path.join(root, f)).st_size
+                except OSError:
+                    pass
+    return total
+
+
+# hot-capacity 256 against 5k sessions: almost every touch after the
+# seed pass lands COLD and must pay exactly one hydration
+cfg = ServeConfig(warmup=(), pallas_buckets=False, batch_window_ms=1.0,
+                  hot_sessions=HOT, compact_rounds=1,
+                  compact_interval_s=3600.0)
+fleet = ConsensusFleet(FleetConfig(
+    n_workers=2, log_dir=os.path.join(base, "fleet"),
+    worker=cfg)).start()
+names = [f"sp-{i:05d}" for i in range(N)]
+
+# phase A: seed every session with one ACKNOWLEDGED round plus two
+# staged open-round appends — the journal prefix compaction will fold
+# into snapshots
+rounds0 = {}
+for i, name in enumerate(names):
+    v = i % VARIANTS
+    fleet.create_session(name, n_reporters=N_REPORTERS)
+    fleet.append(name, make_block(v, 0, 0))
+    fleet.append(name, make_block(v, 0, 1))
+    rounds0[name] = fleet.submit(session=name).result(timeout=120)
+    fleet.append(name, make_block(v, 1, 0))
+bytes_before = staged_bytes()
+assert bytes_before > 0, bytes_before
+
+# phase B: drip traffic over every session, with the mid-run
+# compaction: sweeping each worker's compactor every 200 touches
+# catches every session while it is still hot (the sweep walks the
+# hot tier only — compaction never forces a hydration)
+hyd0 = obs.value("pyconsensus_sessions_hydrated_total") or 0
+compacted = 0
+for i, name in enumerate(names):
+    fleet.append(name, make_block(i % VARIANTS, 1, 1))
+    if (i + 1) % 200 == 0:
+        for w in fleet.workers.values():
+            compacted += w.service.compactor.sweep()["compacted"]
+for w in fleet.workers.values():
+    compacted += w.service.compactor.sweep()["compacted"]
+hydrated = int((obs.value("pyconsensus_sessions_hydrated_total") or 0)
+               - hyd0)
+assert hydrated >= N - 2 * HOT, hydrated
+assert compacted >= N * 0.9, compacted
+bytes_after = staged_bytes()
+assert bytes_after < bytes_before, (bytes_before, bytes_after)
+
+# phase C: one rebalance — live-migrate 50 sessions between the two
+# HEALTHY workers (snapshot + suffix over the shipping path, counted
+# by pyconsensus_sessions_rebalanced_total)
+reb0 = obs.value("pyconsensus_sessions_rebalanced_total") or 0
+w0, w1 = sorted(fleet.workers)
+for name in names[:50]:
+    dst = w1 if fleet.owner_of(name) == w0 else w0
+    fleet.migrate_session(name, dst)
+    assert fleet.owner_of(name) == dst, name
+moved = int((obs.value("pyconsensus_sessions_rebalanced_total") or 0)
+            - reb0)
+assert moved == 50, moved
+
+# phase D: resolve round 1 everywhere (cold sessions hydrate from
+# snapshot + suffix; 50 just crossed the wire) and pin every round of
+# every session bit-identical to a direct single-box DurableSession
+# run of the same blocks
+refs = {}
+for v in range(VARIANTS):
+    ref = DurableSession.create(os.path.join(base, f"ref{v}"),
+                                f"ref{v}", N_REPORTERS)
+    ref.append(make_block(v, 0, 0))
+    ref.append(make_block(v, 0, 1))
+    r0 = ref.resolve()
+    ref.append(make_block(v, 1, 0))
+    ref.append(make_block(v, 1, 1))
+    refs[v] = (r0, ref.resolve())
+for i, name in enumerate(names):
+    got1 = fleet.submit(session=name).result(timeout=120)
+    want0, want1 = refs[i % VARIANTS]
+    for got, want in ((rounds0[name], want0), (got1, want1)):
+        np.testing.assert_array_equal(
+            np.asarray(got["events"]["outcomes_final"]),
+            np.asarray(want["outcomes_final"]), err_msg=name)
+        np.testing.assert_array_equal(
+            np.asarray(got["agents"]["smooth_rep"]),
+            np.asarray(want["smooth_rep"]), err_msg=name)
+
+fleet.close(drain=True)
+print(f"state-plane smoke OK: {N} sessions on 2 workers at "
+      f"hot-capacity {HOT}, {hydrated} cold hydrations, "
+      f"{compacted} compactions shrank the staged journal "
+      f"{bytes_before} -> {bytes_after} bytes, {moved} live "
+      f"migrations, all {2 * N} session rounds bit-identical to the "
+      f"single-box run; {time.time() - t_all:.0f}s")
+PYEOF
+rm -rf "$SPDIR"
+# the taint/lock/protocol layers stay green over the new state-plane
+# module (shipped baseline EMPTY — the full --strict gate above
+# already covers the package; this names the check the ISSUE asks for)
+"$PY" -m pyconsensus_tpu.analysis \
+  --select CL401,CL402,CL403,CL404,CL801,CL802,CL803,CL804,CL805,CL901,CL902,CL903,CL904,CL905 \
+  pyconsensus_tpu/serve/stateplane.py \
+  && echo "state-plane lint OK: CL401-404 + CL801-805 + CL901-905 green over serve/stateplane"
+
 echo "=== Adversarial economy smoke (ISSUE 11: adaptive cartels through a 2-worker fleet) ==="
 # The economic-soundness acceptance criterion end to end: (1) a 3-round
 # camouflage-cartel economy runs through a 2-worker fleet — honest
@@ -1730,7 +1878,8 @@ PYEOF
 
 echo "=== bench.py JSON contract (tiny shape, CPU) ==="
 "$PY" bench.py --reporters 64 --events 256 --repeats 2 --batches 2 \
-  --econ-sessions 48 --econ-rounds 2 --bench-timeout 300 \
+  --econ-sessions 48 --econ-rounds 2 --bench-timeout 420 \
+  --state-plane-sessions 200 --state-plane-hot 32 \
   --incremental-shape 128x512 --incremental-append-sizes 4,16 \
   --incremental-samples 2 | tail -1 | "$PY" -c \
   "import json,sys; d=json.load(sys.stdin); e=d['economy']; i=d['incremental']; \
@@ -1746,6 +1895,10 @@ m=d['multiproc']; assert m and m['socket']['throughput_rps'] > 0 \
     and m['socket']['takeover_ms'] > 0 \
     and m['socket']['rpc_overhead_ms_p50'] > 0 \
     and m['inprocess']['throughput_rps'] > 0; \
+sp=d['state_plane']; assert sp and sp['bit_identical_sample'] \
+    and sp['hydrations'] > 0 and sp['touch_ms_p99_tiered'] > 0 \
+    and sp['takeover_ms_compacted'] > 0 \
+    and sp['journal_bytes_compacted'] < sp['journal_bytes_uncompacted']; \
 print('bench JSON ok:', d['metric'], '| economy:', e['sessions'], \
 'sessions,', len(e['strategies']), 'strategies', '| incremental:', \
 len(i['appends']), 'append sizes, drift in band, refresh bitwise', \
@@ -1753,6 +1906,8 @@ len(i['appends']), 'append sizes, drift in band, refresh bitwise', \
 'digests match | roofline:', len(r['rungs']), 'rungs', \
 '| multiproc: socket', m['socket']['throughput_rps'], 'rps,', \
 m['socket']['rpc_overhead_ms_p50'], 'ms/rpc, takeover', \
-m['socket']['takeover_ms'], 'ms')"
+m['socket']['takeover_ms'], 'ms', '| state_plane:', sp['sessions'], \
+'sessions at hot', sp['hot_capacity'], ',', sp['hydrations'], \
+'hydrations, bit-identical sample')"
 
 echo "=== CI rehearsal GREEN ==="
